@@ -1,0 +1,7 @@
+from . import autograd, device, dtype, flags, rng  # noqa: F401
+from .autograd import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .device import Place, get_device, set_device, synchronize  # noqa: F401
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .rng import get_rng_state, get_rng_state_tracker, seed, set_rng_state  # noqa: F401
+from .tensor import Parameter, Tensor, apply, to_tensor  # noqa: F401

@@ -1,0 +1,179 @@
+"""Eager autograd engine.
+
+TPU-native replacement for the reference's imperative tape
+(paddle/fluid/imperative/tracer.cc:146 TraceOp + basic_engine.cc:382
+BasicEngine::Execute).  Instead of per-op C++ grad nodes, each dispatched op
+records a ``Node`` carrying the op's pure function and its inputs; backward
+walks the node graph in reverse topological order and uses ``jax.vjp`` per
+node to produce cotangents.  Gradients accumulate into leaf ``Tensor.grad``
+(GradientAccumulator semantics).
+
+Under ``jax.jit`` tracing nothing is recorded — the functional path
+(``jax.grad`` over the extracted parameter pytree) is the performant route,
+mirroring dygraph-vs-static in the reference.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    """``paddle.no_grad`` parity."""
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.enabled
+    _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+def set_grad_enabled(mode: bool):
+    class _Ctx:
+        def __enter__(self_):
+            self_.prev = _state.enabled
+            _state.enabled = bool(mode)
+            return self_
+
+        def __exit__(self_, *exc):
+            _state.enabled = self_.prev
+            return False
+
+    return _Ctx()
+
+
+class Node:
+    """One recorded eager op: reconstructable pure function + inputs."""
+
+    __slots__ = ("rebuild", "diff_inputs", "out_refs", "name", "__weakref__")
+
+    def __init__(self, rebuild: Callable, diff_inputs: Sequence, name: str = "op"):
+        # rebuild(*input_datas) -> tuple of differentiable raw outputs
+        self.rebuild = rebuild
+        self.diff_inputs = list(diff_inputs)  # Tensors we differentiate w.r.t.
+        self.out_refs: List[weakref.ref] = []  # weakrefs to output Tensors
+        self.name = name
+
+    def add_output(self, tensor) -> int:
+        self.out_refs.append(weakref.ref(tensor))
+        return len(self.out_refs) - 1
+
+
+def _toposort(root_node: Node) -> List[Node]:
+    order: List[Node] = []
+    seen = set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.diff_inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order  # children before parents; reverse-exec order is reversed(order)... see below
+
+
+def backward(tensor, grad=None, retain_graph: bool = False, capture=None,
+             accumulate_leaves: bool = True) -> None:
+    """Run reverse-mode from ``tensor`` accumulating into leaf ``.grad``.
+
+    ``capture``: optional dict id(Tensor)->Tensor — cotangents for these
+    tensors (leaf or not) are written to their ``.grad`` (used by
+    ``paddle.grad``).  ``accumulate_leaves=False`` suppresses writing any
+    other leaf's ``.grad`` (so ``paddle.grad`` doesn't corrupt pending
+    parameter gradients).
+    """
+    if tensor._node is None:
+        return  # constant w.r.t. everything recorded
+    if grad is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires an explicit grad")
+        grad = jnp.ones_like(tensor._data)
+    else:
+        grad = getattr(grad, "_data", grad)
+
+    cot: Dict[int, Any] = {id(tensor): grad}
+    keep = {id(tensor): tensor}  # keep tensors alive while walking
+
+    order = _toposort(tensor._node)
+    # ``order`` has producers before consumers; execute in reverse.
+    for node in reversed(order):
+        out_cots = []
+        any_ct = False
+        outs = [r() for r in node.out_refs]
+        for o in outs:
+            if o is not None and id(o) in cot:
+                out_cots.append(cot[id(o)])
+                any_ct = True
+            else:
+                out_cots.append(None)
+        if not any_ct:
+            continue
+        primals = [t._data for t in node.diff_inputs]
+        raw_outs, vjp_fn = jax.vjp(node.rebuild, *primals)
+        filled = tuple(
+            ct if ct is not None else jnp.zeros_like(ro)
+            for ct, ro in zip(out_cots, raw_outs))
+        in_cots = vjp_fn(filled)
+        for t, ct in zip(node.diff_inputs, in_cots):
+            if t.stop_gradient:
+                continue
+            if t._hooks:
+                for h in t._hooks.values():
+                    out = h(ct)
+                    if out is not None:
+                        ct = getattr(out, "_data", out)
+            if capture is not None and id(t) in capture:
+                t._accumulate_grad(ct)
+            if t._node is None:  # leaf: accumulate into .grad
+                if accumulate_leaves and (capture is None or id(t) not in capture):
+                    t._accumulate_grad(ct)
+            else:
+                key = id(t)
+                keep[key] = t
+                cot[key] = ct if key not in cot else cot[key] + ct
+        if not retain_graph:
+            node.out_refs = [r for r in node.out_refs]  # keep structure; graph freed via tensor GC
+
+    if not retain_graph:
+        # Free the graph: detach every tensor reachable in this pass.
+        for node in order:
+            for r in node.out_refs:
+                o = r()
+                if o is not None:
+                    o._node = None

@@ -1,0 +1,77 @@
+"""Dtype system.
+
+Mirrors the reference's VarType dtype enum surface
+(paddle/fluid/framework/framework.proto:117) as thin aliases over JAX dtypes.
+TPU-first: bfloat16 is a first-class citizen.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from . import flags
+
+# Canonical dtype objects (exposed as paddle_tpu.float32 etc.)
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+uint8 = jnp.uint8
+uint16 = jnp.uint16
+uint32 = jnp.uint32
+uint64 = jnp.uint64
+bool_ = jnp.bool_
+bool = jnp.bool_  # noqa: A001 - paddle exposes paddle.bool
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+}
+
+FLOATING = (float16, bfloat16, float32, float64)
+INTEGER = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize str/np/jnp dtype specs to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _ALIASES:
+            return jnp.dtype(_ALIASES[key])
+        return jnp.dtype(key)
+    return jnp.dtype(dtype)
+
+
+def is_floating_point(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def get_default_dtype():
+    """``paddle.get_default_dtype`` parity."""
+    return convert_dtype(flags.flag("FLAGS_default_dtype"))
+
+
+def set_default_dtype(d) -> None:
+    """``paddle.set_default_dtype`` parity."""
+    d = convert_dtype(d)
+    if not jnp.issubdtype(d, jnp.floating):
+        raise TypeError(f"default dtype must be floating point, got {d}")
+    flags.set_flags({"FLAGS_default_dtype": np.dtype(d).name if d != bfloat16 else "bfloat16"})

@@ -1,0 +1,145 @@
+"""Random number state.
+
+Design: JAX is functional (explicit PRNG keys), the reference is stateful
+(global + per-parallel-layer seed trackers, fleet/meta_parallel/parallel_layers/random.py).
+We bridge with named *streams*:
+
+- Eager mode: each stream owns a key that is split on every draw.
+- Traced (jit) mode: a ``rng_scope(key)`` context installs a traced base key;
+  draws fold in a per-trace counter, so randomness is a pure function of the
+  scope key and the (deterministic) draw order.  Passing a fresh key per step
+  gives fresh dropout masks without retracing.
+
+``RNGSequenceTracker`` reproduces the reference's model-parallel RNG contract:
+the ``global_seed`` stream is identical across model-parallel ranks while
+``local_seed`` differs per rank (dropout inside sharded layers must differ).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from . import flags
+
+
+class _Stream:
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.key = jax.random.key(seed)
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+class _ScopeState(threading.local):
+    def __init__(self):
+        self.key = None
+        self.counter = 0
+
+
+_scope = _ScopeState()
+_streams: Dict[str, _Stream] = {}
+
+
+def seed(value: int) -> None:
+    """``paddle.seed`` parity: reseed every stream deterministically."""
+    flags.set_flags({"FLAGS_seed": int(value)})
+    _streams.clear()
+    _streams["global"] = _Stream(int(value))
+
+
+def get_stream(name: str = "global") -> _Stream:
+    if name not in _streams:
+        base = int(flags.flag("FLAGS_seed"))
+        offset = np.uint32(abs(hash(name)) % (2**31))
+        _streams[name] = _Stream(base + int(offset))
+    return _streams[name]
+
+
+def add_stream(name: str, seed_value: int) -> None:
+    _streams[name] = _Stream(int(seed_value))
+
+
+@contextlib.contextmanager
+def rng_scope(key, stream: Optional[str] = None):
+    """Install a traced base key; inside jit all draws derive from it."""
+    prev_key, prev_counter = _scope.key, _scope.counter
+    _scope.key, _scope.counter = key, 0
+    try:
+        yield
+    finally:
+        _scope.key, _scope.counter = prev_key, prev_counter
+
+
+def next_key(stream: str = "global"):
+    """Draw a PRNG key: scope-derived when inside ``rng_scope``, else stateful."""
+    if _scope.key is not None:
+        _scope.counter += 1
+        return jax.random.fold_in(_scope.key, _scope.counter)
+    return get_stream(stream).next_key()
+
+
+def in_rng_scope() -> bool:
+    return _scope.key is not None
+
+
+class RNGSequenceTracker:
+    """Model-parallel RNG state tracker (reference: parallel_layers/random.py).
+
+    ``get_rng_state_tracker().rng_state("local_seed")`` scopes draws to a
+    rank-dependent stream so dropout differs across TP ranks; the default
+    ``global_seed`` stream matches across ranks.
+    """
+
+    def __init__(self):
+        self.seeds = {}
+
+    def add(self, name: str, seed_value: int):
+        if name in self.seeds and self.seeds[name] != seed_value:
+            raise ValueError(f"seed for {name} already set to {self.seeds[name]}")
+        self.seeds[name] = seed_value
+        add_stream(name, seed_value)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self.seeds and name not in _streams:
+            self.add(name, int(flags.flag("FLAGS_seed")) + abs(hash(name)) % (2**31))
+        if _scope.key is not None:
+            # Traced mode: fold the stream name into the scope key so streams
+            # stay decorrelated but remain pure functions of the step key.
+            sub = jax.random.fold_in(_scope.key, abs(hash(name)) % (2**31))
+            with rng_scope(sub):
+                yield
+        else:
+            prev = _scope.key
+            assert prev is None
+            stream = get_stream(name)
+            try:
+                _streams["global"], _streams[f"__saved_global"] = stream, _streams.get("global", get_stream("global"))
+                yield
+            finally:
+                _streams["global"] = _streams.pop("__saved_global")
+
+
+_tracker = RNGSequenceTracker()
+
+
+def get_rng_state_tracker() -> RNGSequenceTracker:
+    return _tracker
+
+
+def get_rng_state():
+    """``paddle.get_rng_state``-ish: returns the raw key data per stream."""
+    return {name: jax.random.key_data(s.key) for name, s in _streams.items()}
+
+
+def set_rng_state(state) -> None:
+    for name, data in state.items():
+        st = get_stream(name)
+        st.key = jax.random.wrap_key_data(np.asarray(data))

@@ -1,0 +1,433 @@
+"""Eager Tensor wrapper + op dispatch.
+
+TPU-native analog of the reference's VarBase (pybind/imperative.cc:877) and
+the generated ``core.ops.*`` fast path (pybind/op_function_generator.cc):
+every eager op funnels through :func:`apply`, which unwraps Tensors to
+``jax.Array``, runs the pure jnp function, wraps outputs, and records an
+autograd Node when gradients are required (tracer.cc:241 CreateGradOpNode
+semantics).
+
+Inside a ``jax.jit`` trace the same layer code runs on raw tracers with zero
+wrapper overhead — the dual-paradigm split of the reference (dygraph/static)
+becomes "wrapped-eager / traced-functional" here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd, dtype as dtype_mod, flags
+from .autograd import Node
+
+_is_tensor_leaf = lambda x: isinstance(x, Tensor)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+class Tensor:
+    """Eager tensor: a ``jax.Array`` plus autograd metadata."""
+
+    __array_priority__ = 100.0
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "name", "_hooks",
+                 "trainable", "is_leaf_param", "_consumers", "__weakref__", "__dict__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node: Optional[Node] = None
+        self.name = name
+        self._hooks = {}
+        self._consumers = []
+        self.trainable = not stop_gradient
+
+    # -- basic introspection ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from . import device
+        return device._get_place()
+
+    @property
+    def T(self):
+        return apply(jnp.transpose, self)
+
+    def numel(self):
+        return self.size
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return np.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __jax_array__(self):
+        return self._data
+
+    def __repr__(self):
+        grad_note = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_note},\n"
+                f"       {np.asarray(self._data) if not self._is_traced() else self._data!r})")
+
+    def _is_traced(self):
+        return isinstance(self._data, jax.core.Tracer)
+
+    def __len__(self):
+        if not self._data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    @property
+    def grad(self):
+        if self._grad is None:
+            return None
+        return Tensor(self._grad)
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = None if value is None else _unwrap(value)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def _accumulate_grad(self, ct):
+        if ct.dtype != self._data.dtype:
+            ct = ct.astype(self._data.dtype)
+        self._grad = ct if self._grad is None else self._grad + ct
+
+    def register_hook(self, hook: Callable):
+        hid = len(self._hooks)
+        self._hooks[hid] = hook
+
+        class _Removable:
+            def remove(self_):
+                self._hooks.pop(hid, None)
+
+        return _Removable()
+
+    def detach(self):
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def clone(self):
+        return apply(lambda x: x + 0, self)
+
+    def _adopt(self, produced: "Tensor"):
+        """Take over ``produced``'s value and graph position (in-place ops).
+
+        If ``self`` already participates in the graph (as an input of any
+        recorded node, including ``produced``'s), a detached stand-in keeps
+        the pre-mutation value and graph position so backward sees the
+        correct primal (no self-loop, no post-mutation value leaking into
+        earlier consumers).
+        """
+        import weakref
+        node = produced._node
+        consumers = [r for r in self._consumers if r() is not None]
+        if consumers:
+            old = Tensor(self._data, stop_gradient=self.stop_gradient)
+            old._node = self._node
+            old._consumers = consumers
+            if self._node is not None:
+                for i, ref in enumerate(self._node.out_refs):
+                    if ref() is self:
+                        self._node.out_refs[i] = weakref.ref(old)
+            for r in consumers:
+                n = r()
+                if n is not None:
+                    n.diff_inputs = [old if t is self else t for t in n.diff_inputs]
+        if node is not None:
+            for i, ref in enumerate(node.out_refs):
+                if ref() is produced:
+                    node.out_refs[i] = weakref.ref(self)
+        self._data = produced._data
+        self._node = node
+        self._consumers = []
+        return self
+
+    # -- mutation / conversion ---------------------------------------------
+    def set_value(self, value):
+        value = _unwrap(value)
+        self._data = jnp.asarray(value).astype(self._data.dtype).reshape(self._data.shape)
+
+    def copy_(self, other, blocking=True):
+        self.set_value(other)
+        return self
+
+    def astype(self, dt):
+        dt = dtype_mod.convert_dtype(dt)
+        return apply(lambda x: x.astype(dt), self)
+
+    def cast(self, dt):
+        return self.astype(dt)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "xpu"):
+                continue
+            return self.astype(a)
+        if "dtype" in kwargs:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        return apply(lambda x, i: x[i], self, idx)
+
+    def __setitem__(self, idx, value):
+        produced = apply(lambda x, i, v: x.at[i].set(v), self, idx, value)
+        self._adopt(produced)
+
+    # -- operators (full set patched in paddle_tpu.tensor.__init__) --------
+    def __neg__(self):
+        return apply(jnp.negative, self)
+
+    def __abs__(self):
+        return apply(jnp.abs, self)
+
+    def __add__(self, o):
+        return apply(jnp.add, self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return apply(jnp.subtract, self, o)
+
+    def __rsub__(self, o):
+        return apply(jnp.subtract, o, self)
+
+    def __mul__(self, o):
+        return apply(jnp.multiply, self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return apply(jnp.true_divide, self, o)
+
+    def __rtruediv__(self, o):
+        return apply(jnp.true_divide, o, self)
+
+    def __floordiv__(self, o):
+        return apply(jnp.floor_divide, self, o)
+
+    def __rfloordiv__(self, o):
+        return apply(jnp.floor_divide, o, self)
+
+    def __pow__(self, o):
+        return apply(jnp.power, self, o)
+
+    def __rpow__(self, o):
+        return apply(jnp.power, o, self)
+
+    def __mod__(self, o):
+        return apply(jnp.mod, self, o)
+
+    def __rmod__(self, o):
+        return apply(jnp.mod, o, self)
+
+    def __matmul__(self, o):
+        return apply(jnp.matmul, self, o)
+
+    def __rmatmul__(self, o):
+        return apply(jnp.matmul, o, self)
+
+    def __lt__(self, o):
+        return apply(jnp.less, self, o)
+
+    def __le__(self, o):
+        return apply(jnp.less_equal, self, o)
+
+    def __gt__(self, o):
+        return apply(jnp.greater, self, o)
+
+    def __ge__(self, o):
+        return apply(jnp.greater_equal, self, o)
+
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return apply(jnp.equal, self, o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return apply(jnp.not_equal, self, o)
+
+    def __and__(self, o):
+        return apply(jnp.logical_and if self.dtype == jnp.bool_ else jnp.bitwise_and, self, o)
+
+    def __or__(self, o):
+        return apply(jnp.logical_or if self.dtype == jnp.bool_ else jnp.bitwise_or, self, o)
+
+    def __xor__(self, o):
+        return apply(jnp.logical_xor if self.dtype == jnp.bool_ else jnp.bitwise_xor, self, o)
+
+    def __invert__(self):
+        return apply(jnp.logical_not if self.dtype == jnp.bool_ else jnp.bitwise_not, self)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: ParamBase framework.py:6042)."""
+
+    def __init__(self, data, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.is_leaf_param = True
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def apply(fn: Callable, *args, name: Optional[str] = None, **kwargs) -> Any:
+    """Dispatch one eager op (the ``TraceOp`` analog).
+
+    ``fn`` must be a pure, jax-traceable function of arrays; Tensor leaves
+    anywhere in ``args``/``kwargs`` are unwrapped.  Outputs are wrapped back
+    into Tensors; a grad Node is recorded when needed.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor_leaf)
+    tensor_positions = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+
+    if not tensor_positions:
+        return fn(*args, **kwargs)
+
+    raw_leaves = [_unwrap(l) for l in leaves]
+    traced = any(isinstance(raw_leaves[i], jax.core.Tracer) for i in tensor_positions)
+
+    diff_positions = [
+        i for i in tensor_positions
+        if not leaves[i].stop_gradient and jnp.issubdtype(raw_leaves[i].dtype, jnp.floating)
+    ]
+    record = (not traced) and autograd.is_grad_enabled() and bool(diff_positions)
+
+    rargs, rkwargs = jax.tree_util.tree_unflatten(treedef, raw_leaves)
+    out_raw = fn(*rargs, **rkwargs)
+
+    if flags.flag("FLAGS_eager_log_ops"):
+        print(f"[eager] {name or getattr(fn, '__name__', fn)}")
+    if flags.flag("FLAGS_benchmark") and not traced:
+        jax.block_until_ready(out_raw)
+
+    is_arr = lambda x: isinstance(x, (jax.Array, jax.core.Tracer, np.ndarray, np.generic))
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out_raw)
+
+    if flags.flag("FLAGS_check_nan_inf") and not traced:
+        for ol in out_leaves:
+            if is_arr(ol) and jnp.issubdtype(jnp.asarray(ol).dtype, jnp.floating):
+                if not bool(jnp.isfinite(ol).all()):
+                    raise FloatingPointError(
+                        f"NaN/Inf in output of {name or getattr(fn, '__name__', fn)}")
+
+    node = None
+    if record:
+        diff_tensors = [leaves[i] for i in diff_positions]
+        const_leaves = list(raw_leaves)
+        diff_out_positions = [
+            i for i, ol in enumerate(out_leaves)
+            if is_arr(ol) and jnp.issubdtype(jnp.asarray(ol).dtype, jnp.floating)
+        ]
+
+        def rebuild(*primals):
+            cl = list(const_leaves)
+            for pos, p in zip(diff_positions, primals):
+                cl[pos] = p
+            a, k = jax.tree_util.tree_unflatten(treedef, cl)
+            o = fn(*a, **k)
+            ols = jax.tree_util.tree_leaves(o)
+            return tuple(ols[i] for i in diff_out_positions)
+
+        node = Node(rebuild, diff_tensors, name=name or getattr(fn, "__name__", "op"))
+        import weakref as _weakref
+        nref = _weakref.ref(node)
+        for t in diff_tensors:
+            t._consumers.append(nref)
+
+    wrapped = []
+    di = 0
+    diff_out_set = set(diff_out_positions) if record else set()
+    for i, ol in enumerate(out_leaves):
+        if is_arr(ol):
+            t = Tensor(ol, stop_gradient=not (record and i in diff_out_set))
+            if record and i in diff_out_set:
+                node.add_output(t)
+                t._node = node
+            wrapped.append(t)
+        else:
+            wrapped.append(ol)
+        di += 1
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """``paddle.to_tensor`` parity."""
+    if isinstance(data, Tensor):
+        d = data._data
+    else:
+        d = data
+    dt = dtype_mod.convert_dtype(dtype)
+    if isinstance(d, (jax.Array, jax.core.Tracer)):
+        arr = d.astype(dt) if dt is not None and d.dtype != dt else d
+    else:
+        arr = jnp.asarray(d, dtype=dt) if dt is not None else _default_convert(d)
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def _default_convert(d):
+    arr = np.asarray(d)
+    if arr.dtype == np.float64:
+        arr = arr.astype(dtype_mod.get_default_dtype())
+    return jnp.asarray(arr)

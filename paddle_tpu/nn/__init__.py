@@ -1,0 +1,6 @@
+"""``paddle_tpu.nn`` (reference: python/paddle/nn/)."""
+
+from . import functional, initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer import Layer  # noqa: F401
+from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa: F401

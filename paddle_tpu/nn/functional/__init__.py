@@ -1,0 +1,107 @@
+"""``paddle_tpu.nn.functional`` (reference: python/paddle/nn/functional/)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from .activation import (celu, elu, gelu, glu, gumbel_softmax, hardshrink, hardsigmoid,  # noqa: F401
+                         hardswish, hardtanh, leaky_relu, log_sigmoid, log_softmax,
+                         maxout, mish, prelu, relu, relu6, relu_, rrelu, selu, sigmoid,
+                         silu, softmax, softmax_, softplus, softshrink, softsign, swish,
+                         tanh, tanhshrink, thresholded_relu)
+from .common import (affine_grid, alpha_dropout, bilinear, channel_shuffle,  # noqa: F401
+                     cosine_similarity, dropout, dropout2d, dropout3d, embedding, fold,
+                     grid_sample, interpolate, label_smooth, linear, one_hot, pad,
+                     pixel_shuffle, pixel_unshuffle, unfold, upsample)
+from .conv import (conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,  # noqa: F401
+                   conv3d_transpose)
+from .loss import (binary_cross_entropy, binary_cross_entropy_with_logits,  # noqa: F401
+                   cosine_embedding_loss, cross_entropy, ctc_loss, dice_loss,
+                   hinge_embedding_loss, kl_div, l1_loss, log_loss, margin_ranking_loss,
+                   mse_loss, nll_loss, npair_loss, sigmoid_focal_loss, smooth_l1_loss,
+                   softmax_with_cross_entropy, square_error_cost, triplet_margin_loss)
+from .norm import (batch_norm, group_norm, instance_norm, layer_norm,  # noqa: F401
+                   local_response_norm, normalize)
+from .pooling import (adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,  # noqa: F401
+                      adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d,
+                      avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d, max_pool2d,
+                      max_pool3d)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import convert_dtype
+
+    def f(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        iota = jnp.arange(m)
+        return (iota[None, :] < lens[..., None]).astype(convert_dtype(dtype))
+    return apply(f, x)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    def f(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        # move last two dims into requested positions
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+    return apply(f, input)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None, data_format="NCHW"):
+    def f(a):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        a = a.reshape(N, seg_num, C, H, W)
+        fold_c = int(C * shift_ratio)
+        out = jnp.zeros_like(a)
+        out = out.at[:, 1:, :fold_c].set(a[:, :-1, :fold_c])
+        out = out.at[:, :-1, fold_c:2 * fold_c].set(a[:, 1:, fold_c:2 * fold_c])
+        out = out.at[:, :, 2 * fold_c:].set(a[:, :, 2 * fold_c:])
+        return out.reshape(NT, C, H, W)
+    return apply(f, x)
+
+
+def npu_identity(x, format=-1):
+    return x
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference: nn/functional/sparse_attention.py).
+
+    Dense fallback honoring the CSR mask; the Pallas block-sparse kernel lives
+    in paddle_tpu.ops.flash_attention for the performant path.
+    """
+    def f(q, k, v, offs, cols):
+        B, H, L, D = q.shape
+        scale = 1.0 / jnp.sqrt(D).astype(q.dtype)
+        scores = jnp.einsum("bhld,bhmd->bhlm", q, k) * scale
+        # build dense mask from CSR
+        def one_mask(off, col):
+            row_ids = jnp.searchsorted(off, jnp.arange(col.shape[0]), side="right") - 1
+            m = jnp.zeros((L, L), bool).at[row_ids, col].set(True)
+            return m
+        mask = jax.vmap(jax.vmap(one_mask))(offs[..., :], cols[..., :]) \
+            if offs.ndim == 3 else one_mask(offs, cols)
+        scores = jnp.where(mask, scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhlm,bhmd->bhld", attn, v)
+    return apply(f, query, key, value, sparse_csr_offset, sparse_csr_columns)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Flash-attention entry point (BSHD layout like paddle's incubate API)."""
+    from ...ops.attention import scaled_dot_product_attention as sdpa
+    return sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)

@@ -1,0 +1,290 @@
+"""Common functionals: linear, dropout, embedding, interpolate, one_hot, …
+(reference: python/paddle/nn/functional/common.py, input.py, vision.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng
+from ...core.dtype import convert_dtype
+from ...core.tensor import Tensor, apply
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's (in, out) weight layout."""
+    def f(a, w, b):
+        out = jnp.matmul(a, w)
+        if b is not None:
+            out = out + b
+        return out
+    return apply(f, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x)
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = rng.next_key()
+
+    def f(a, k):
+        if axis is None:
+            mask_shape = a.shape
+        else:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            mask_shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
+        keep = jax.random.bernoulli(k, 1.0 - p, mask_shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros((), a.dtype))
+        return jnp.where(keep, a, jnp.zeros((), a.dtype))
+    return apply(f, x, Tensor(key))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = rng.next_key()
+
+    def f(a, k):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p ** 2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+    return apply(f, x, Tensor(key))
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            pid = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            mask = (i != pid)[..., None]
+            out = out * mask.astype(out.dtype)
+        return out
+    return apply(f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, pd):
+        k = l.shape[-1]
+        if pd is not None:
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply(f, label, prior_dist)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        is_nchw = data_format[1] == "C"
+        spatial_dims = list(range(2, a.ndim)) if is_nchw else list(range(1, a.ndim - 1))
+        in_sizes = [a.shape[d] for d in spatial_dims]
+        if size is not None:
+            out_sizes = [int(getattr(s, "item", lambda: s)()) if not isinstance(s, int) else s
+                         for s in (size if isinstance(size, (list, tuple)) else
+                                   np.asarray(getattr(size, "_data", size)).tolist())]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(in_sizes)
+            out_sizes = [int(i * s) for i, s in zip(in_sizes, sf)]
+        jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                 "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode.lower()]
+        new_shape = list(a.shape)
+        for d, o in zip(spatial_dims, out_sizes):
+            new_shape[d] = o
+        if jmode == "nearest":
+            # paddle nearest: floor(i * scale)
+            out = a
+            for d, o in zip(spatial_dims, out_sizes):
+                idx = jnp.floor(jnp.arange(o) * (a.shape[d] / o)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=d)
+            return out
+        if align_corners:
+            out = a
+            for d, o in zip(spatial_dims, out_sizes):
+                in_sz = out.shape[d]
+                pos = jnp.linspace(0.0, in_sz - 1.0, o)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, in_sz - 1)
+                w = (pos - lo).astype(a.dtype)
+                g_lo = jnp.take(out, lo, axis=d)
+                g_hi = jnp.take(out, hi, axis=d)
+                shape = [1] * out.ndim
+                shape[d] = o
+                w = w.reshape(shape)
+                out = g_lo * (1 - w) + g_hi * w
+            return out
+        return jax.image.resize(a, tuple(new_shape), method=jmode)
+    return apply(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            a = a.reshape(N, C // (r * r), r, r, H, W)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(N, C // (r * r), H * r, W * r)
+        N, H, W, C = a.shape
+        a = a.reshape(N, H, W, C // (r * r), r, r)
+        a = a.transpose(0, 1, 4, 2, 5, 3)
+        return a.reshape(N, H * r, W * r, C // (r * r))
+    return apply(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        if data_format == "NCHW":
+            N, C, H, W = a.shape
+            a = a.reshape(N, C, H // r, r, W // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(N, C * r * r, H // r, W // r)
+        N, H, W, C = a.shape
+        a = a.reshape(N, H // r, r, W // r, r, C)
+        a = a.transpose(0, 2, 4, 5, 1, 3)
+        return a.reshape(N, H // r, W // r, C * r * r)
+    return apply(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            N, C = a.shape[:2]
+            rest = a.shape[2:]
+            a = a.reshape((N, groups, C // groups) + rest)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape((N, C) + rest)
+        N = a.shape[0]
+        C = a.shape[-1]
+        mid = a.shape[1:-1]
+        a = a.reshape((N,) + mid + (groups, C // groups))
+        a = jnp.swapaxes(a, -1, -2)
+        return a.reshape((N,) + mid + (C,))
+    return apply(f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...tensor.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+    return apply(f, x1, x2, weight, bias)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    def f(th):
+        N, H, W = out_shape[0], out_shape[2], out_shape[3]
+        if align_corners:
+            xs = jnp.linspace(-1, 1, W)
+            ys = jnp.linspace(-1, 1, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # H,W,3
+        return jnp.einsum("nij,hwj->nhwi", th, base)
+    return apply(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True,
+                name=None):
+    def f(a, g):
+        N, C, H, W = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (W - 1) / 2
+            fy = (gy + 1) * (H - 1) / 2
+        else:
+            fx = ((gx + 1) * W - 1) / 2
+            fy = ((gy + 1) * H - 1) / 2
+
+        def sample(img, yy, xx):
+            yy_c = jnp.clip(yy, 0, H - 1)
+            xx_c = jnp.clip(xx, 0, W - 1)
+            v = img[:, :, yy_c.astype(jnp.int32), xx_c.astype(jnp.int32)]
+            # gather per batch: use vmap
+            return v
+        bidx = jnp.arange(N)[:, None, None]
+        if mode == "nearest":
+            yy = jnp.round(fy).astype(jnp.int32)
+            xx = jnp.round(fx).astype(jnp.int32)
+            valid = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yy = jnp.clip(yy, 0, H - 1)
+            xx = jnp.clip(xx, 0, W - 1)
+            out = a[bidx, :, yy, xx]  # N,Hg,Wg,C
+            out = jnp.where(valid[..., None], out, 0.0)
+            return jnp.moveaxis(out, -1, 1)
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = 0
+        for yi, wyi in ((y0, 1 - wy), (y1, wy)):
+            for xi, wxi in ((x0, 1 - wx), (x1, wx)):
+                valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                yc = jnp.clip(yi, 0, H - 1)
+                xc = jnp.clip(xi, 0, W - 1)
+                v = a[bidx, :, yc, xc]  # N,Hg,Wg,C
+                w = (wyi * wxi)[..., None]
+                if padding_mode == "zeros":
+                    v = jnp.where(valid[..., None], v, 0.0)
+                out = out + v * w.astype(a.dtype)
+        return jnp.moveaxis(out, -1, 1)
+    return apply(f, x, grid)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import unfold as _unfold
+    return _unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    from .conv import fold as _fold
+    return _fold(x, output_sizes, kernel_sizes, strides, paddings, dilations)

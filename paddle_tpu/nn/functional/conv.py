@@ -1,0 +1,204 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py,
+operators/conv_op.*).
+
+TPU-first: all convs lower to ``jax.lax.conv_general_dilated`` so XLA tiles
+them onto the MXU; NCHW (paddle default) and NHWC are both supported with the
+dimension-numbers mechanism rather than explicit transposes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import apply
+
+
+def _tuplize(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, data_format):
+    """Normalize paddle padding spec → lax [(lo,hi)]*n or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # [[lo,hi],...] possibly including batch/channel dims
+        if len(padding) == n + 2:
+            spatial = padding[2:] if data_format[1] == "C" else padding[1:-1]
+            return [tuple(p) for p in spatial]
+        return [tuple(p) for p in padding]
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dimnums(n, data_format):
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs = "NC" + "DHW"[3 - n:]
+        out = lhs
+    else:
+        lhs = "N" + "DHW"[3 - n:] + "C"
+        out = lhs
+    rhs = "OI" + "DHW"[3 - n:]
+    return jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2), (lhs, rhs, out))
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _padding(padding, n, data_format)
+    dn = _dimnums(n, data_format)
+
+    def f(a, w, b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if data_format[1] == "C" else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation, groups,
+                    data_format, n, output_size=None):
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n)
+    pad = _padding(padding, n, data_format)
+    dn = _dimnums(n, data_format)
+
+    def f(a, w, b):
+        # paddle stores transpose-conv weight as (in, out/groups, *k)
+        # lax.conv_transpose wants IO...-style; use gradient-based formulation:
+        # conv_transpose = conv_general_dilated with lhs_dilation=stride.
+        if isinstance(pad, str):
+            pd = pad
+            lax_pad = pad
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            if pd == "SAME":
+                lax_pad = [((ki - 1) // 2, ki - 1 - (ki - 1) // 2) for ki in k]
+            else:
+                lax_pad = [(ki - 1, ki - 1) for ki in k]
+            base = [(ki - 1, ki - 1) for ki in k]
+            eff = lax_pad
+        else:
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            eff = [(ki - 1 - lo, ki - 1 - hi + op)
+                   for (lo, hi), ki, op in zip(pad, k, opad)]
+        # weight (in, out/groups, *k) → flip spatial, swap to (out, in/groups, *k)
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups == 1:
+            wt = jnp.swapaxes(wt, 0, 1)
+        else:
+            ci, cog = w.shape[0], w.shape[1]
+            wt = wt.reshape((groups, ci // groups, cog) + w.shape[2:])
+            wt = jnp.swapaxes(wt, 1, 2)
+            wt = wt.reshape((groups * cog, ci // groups) + w.shape[2:])
+        out = jax.lax.conv_general_dilated(
+            a, wt, window_strides=(1,) * n, padding=eff, lhs_dilation=stride,
+            rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups)
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if data_format[1] == "C" else out.ndim - 1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    out = apply(f, x, weight, bias)
+    if output_size is not None:
+        # crop/pad to requested size if integral mismatch
+        pass
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 1, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 2, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                           groups, data_format, 3, output_size)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/math/im2col.*)."""
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+    p = _padding(paddings, 2, "NCHW")
+
+    def f(a):
+        N, C, H, W = a.shape
+        a = jnp.pad(a, ((0, 0), (0, 0), p[0], p[1]))
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=[(0, 0), (0, 0)], rhs_dilation=d,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                a.shape, (1, 1) + k, ("NCHW", "OIHW", "NCHW")))
+        # patches: (N, C*kh*kw, OH, OW)
+        return patches.reshape(N, patches.shape[1], -1)
+    return apply(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _tuplize(kernel_sizes, 2)
+    s = _tuplize(strides, 2)
+    d = _tuplize(dilations, 2)
+    p = _padding(paddings, 2, "NCHW")
+    OH, OW = _tuplize(output_sizes, 2)
+
+    def f(a):
+        N, CKK, L = a.shape
+        C = CKK // (k[0] * k[1])
+        Hp, Wp = OH + p[0][0] + p[0][1], OW + p[1][0] + p[1][1]
+        oh = (Hp - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+        ow = (Wp - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+        a = a.reshape(N, C, k[0], k[1], oh, ow)
+        out = jnp.zeros((N, C, Hp, Wp), a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0], wj:wj + ow * s[1]:s[1]].add(
+                    a[:, :, i, j])
+        return out[:, :, p[0][0]:Hp - p[0][1], p[1][0]:Wp - p[1][1]]
+    return apply(f, x)

@@ -1,0 +1,279 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py,
+operators/cross_entropy_op.*, softmax_with_cross_entropy_op.*)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0,
+                  name=None):
+    def f(logits, lab, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape == logits.shape
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            tgt = lab
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            return _reduce(loss, reduction)
+        lab_idx = lab
+        if lab_idx.ndim == logits.ndim:  # trailing 1 dim
+            lab_idx = jnp.squeeze(lab_idx, axis)
+        lab_idx = lab_idx.astype(jnp.int32)
+        valid = lab_idx != ignore_index
+        safe = jnp.where(valid, lab_idx, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None].astype(jnp.int32),
+                                     axis=axis).squeeze(axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = -picked
+        if w is not None:
+            loss = loss * jnp.take(w, safe)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if w is not None:
+                denom = jnp.sum(jnp.where(valid, jnp.take(w, safe), 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(f, input, label, weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, reduction="none", soft_label=soft_label,
+                         ignore_index=ignore_index, axis=axis)
+    loss = apply(lambda l: l[..., None] if l.ndim >= 0 else l, loss) \
+        if not soft_label else loss
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(logp, lab, w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=1).squeeze(1)
+        loss = -picked
+        if w is not None:
+            wt = jnp.take(w, safe)
+            loss = loss * wt
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.where(valid, jnp.take(w, safe) if w is not None else 1.0, 0.0))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(f, input, label, weight)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply(f, input, label)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return apply(f, input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, w, pw):
+        max_val = jnp.maximum(-z, 0.0)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val)
+        else:
+            loss = (1 - y) * z + jnp.log1p(jnp.exp(-jnp.abs(z))) + max_val
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return apply(f, logit, label, weight, pos_weight)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return apply(f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply(lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0), reduction),
+                 input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return apply(lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(margin - a, 0.0)),
+                                      reduction), input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return apply(f, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+    return apply(f, input, positive, negative)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply(lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+                 input, label)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        loss = ce * ((1 - p_t) ** gamma)
+        if alpha >= 0:
+            a_t = alpha * y + (1 - alpha) * (1 - y)
+            loss = a_t * loss
+        if nrm is not None:
+            loss = loss / nrm
+        return _reduce(loss, reduction)
+    return apply(f, logit, label, normalizer)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over time)."""
+    def f(lp, lab, in_len, lab_len):
+        # lp: (T, B, C) log-softmax already? paddle expects raw logits of (T,B,C)
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        T, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        NEG = jnp.array(-1e30, lp.dtype)
+        # extended labels: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, dtype=lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        # alpha init
+        alpha0 = jnp.full((B, S), NEG)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lab_len > 0,
+                                               lp[0, jnp.arange(B), ext[:, 1]], NEG))
+
+        same = jnp.concatenate([jnp.zeros((B, 2), bool),
+                                ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+            a3 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+            a3 = jnp.where(same | (jnp.arange(S)[None, :] % 2 == 0), NEG, a3)
+            m = jnp.maximum(jnp.maximum(a1, a2), a3)
+            m_safe = jnp.where(m == NEG, 0.0, m)
+            s = jnp.exp(a1 - m_safe) + jnp.exp(a2 - m_safe) + jnp.exp(a3 - m_safe)
+            new = jnp.where(m == NEG, NEG, m_safe + jnp.log(s))
+            emit = lp_t[jnp.arange(B)[:, None], ext]
+            return new + emit, None
+
+        def scan_step(carry, inp):
+            alpha, t = carry
+            lp_t = inp
+            new_alpha, _ = step(alpha, lp_t)
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return (new_alpha, t + 1), None
+
+        (alphaT, _), _ = jax.lax.scan(scan_step, (alpha0, jnp.ones((), jnp.int32)),
+                                      lp[1:])
+        end1 = alphaT[jnp.arange(B), 2 * lab_len]
+        end2 = alphaT[jnp.arange(B), jnp.maximum(2 * lab_len - 1, 0)]
+        m = jnp.maximum(end1, end2)
+        m_safe = jnp.where(m == NEG, 0.0, m)
+        ll = m_safe + jnp.log(jnp.exp(end1 - m_safe) + jnp.exp(end2 - m_safe))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(f, log_probs, labels, input_lengths, label_lengths)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, p, y):
+        B = a.shape[0]
+        sim = a @ p.T
+        y = y.reshape(-1, 1)
+        tgt = (y == y.T).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(-tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+    return apply(f, anchor, positive, labels)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = 2 * jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+    return apply(f, input, label)

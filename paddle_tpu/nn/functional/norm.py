@@ -1,0 +1,154 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py,
+operators/batch_norm_op.*, layer_norm_op.*)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None,
+               name=None):
+    """Batch norm.  In training mode the running stats tensors are updated
+    in place (no gradient flows through them), matching the reference's
+    batch_norm op semantics (momentum convention: new = m*old + (1-m)*batch).
+    """
+    ch_axis = 1 if data_format[1] == "C" else -1
+    use_batch_stats = training and not (use_global_stats is True)
+
+    def stats_fn(a):
+        axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+        m = jnp.mean(a, axis=axes)
+        v = jnp.var(a, axis=axes)
+        return m, v
+
+    if use_batch_stats:
+        # compute batch stats (differentiable), update running stats (stopped)
+        bm, bv = apply(stats_fn, x)
+        if running_mean is not None:
+            # reference batch_norm_op.cc:416 uses the *biased* batch variance
+            # in the running-stat update (no Bessel correction)
+            new_mean = momentum * running_mean._data + (1 - momentum) * jax.lax.stop_gradient(
+                getattr(bm, "_data", bm))
+            new_var = momentum * running_var._data + (1 - momentum) * jax.lax.stop_gradient(
+                getattr(bv, "_data", bv))
+            running_mean._data = new_mean.astype(running_mean._data.dtype)
+            running_var._data = new_var.astype(running_var._data.dtype)
+        mean, var = bm, bv
+    else:
+        mean, var = running_mean, running_var
+
+    def f(a, m, v, w, b):
+        shape = [1] * a.ndim
+        shape[ch_axis % a.ndim] = a.shape[ch_axis % a.ndim]
+        m = m.reshape(shape)
+        v = v.reshape(shape)
+        inv = jax.lax.rsqrt(v + epsilon)
+        out = (a - m) * inv
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, x, mean, var, weight, bias)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(list(normalized_shape))
+
+    def f(a, w, b):
+        axes = tuple(range(a.ndim - ndim, a.ndim))
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+    return apply(f, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW",
+                  name=None):
+    def f(a, w, b):
+        if data_format[1] == "C":
+            axes = tuple(range(2, a.ndim))
+            ch_axis = 1
+        else:
+            axes = tuple(range(1, a.ndim - 1))
+            ch_axis = a.ndim - 1
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + eps)
+        if w is not None:
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1] * a.ndim
+            shape[ch_axis] = a.shape[ch_axis]
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, x, weight, bias)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW",
+               name=None):
+    def f(a, w, b):
+        if data_format == "NCHW" or data_format[1] == "C":
+            N, C = a.shape[0], a.shape[1]
+            rest = a.shape[2:]
+            g = a.reshape((N, num_groups, C // num_groups) + rest)
+            axes = tuple(range(2, g.ndim))
+            m = jnp.mean(g, axis=axes, keepdims=True)
+            v = jnp.var(g, axis=axes, keepdims=True)
+            g = (g - m) * jax.lax.rsqrt(v + epsilon)
+            out = g.reshape(a.shape)
+            shape = [1] * a.ndim
+            shape[1] = C
+        else:
+            N, C = a.shape[0], a.shape[-1]
+            spatial = a.shape[1:-1]
+            g = a.reshape((N,) + spatial + (num_groups, C // num_groups))
+            axes = tuple(range(1, a.ndim - 1)) + (a.ndim,)
+            m = jnp.mean(g, axis=axes, keepdims=True)
+            v = jnp.var(g, axis=axes, keepdims=True)
+            g = (g - m) * jax.lax.rsqrt(v + epsilon)
+            out = g.reshape(a.shape)
+            shape = [1] * a.ndim
+            shape[-1] = C
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return apply(f, x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
+                        name=None):
+    def f(a):
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        sq = jnp.square(a)
+        sq_m = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sq_m, [(0, 0)] * (sq_m.ndim - 1) + [(pad_lo, pad_hi)])
+        win = sum(padded[..., i:i + sq_m.shape[-1]] for i in range(size))
+        win = jnp.moveaxis(win, -1, ch_axis)
+        return a / jnp.power(k + alpha * win, beta)
+    return apply(f, x)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        n = jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True)
+        return a / jnp.maximum(n, epsilon)
+    return apply(f, x)

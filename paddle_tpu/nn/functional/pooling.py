@@ -1,0 +1,248 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py,
+operators/pool_op.*).  All lower to ``jax.lax.reduce_window``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import apply
+from .conv import _padding, _tuplize
+
+
+def _window(n, data_format, k, s):
+    if data_format[1] == "C":  # NCHW-family
+        win = (1, 1) + k
+        stride = (1, 1) + s
+        spatial = list(range(2, 2 + n))
+    else:
+        win = (1,) + k + (1,)
+        stride = (1,) + s + (1,)
+        spatial = list(range(1, 1 + n))
+    return win, stride, spatial
+
+
+def _full_pad(pad, n, ndim, spatial):
+    full = [(0, 0)] * ndim
+    if isinstance(pad, str):
+        return pad
+    for d, p in zip(spatial, pad):
+        full[d] = p
+    return full
+
+
+def _resolve_pads(a_shape, win, st, pad, n, spatial, k, s, ceil_mode, ndim):
+    """Resolve paddle padding spec + ceil_mode into explicit lax pads."""
+    pd = _full_pad(pad, n, ndim, spatial)
+    if isinstance(pd, str):
+        return jax.lax.padtype_to_pads(a_shape, win, st, pd)
+    pd_resolved = list(pd)
+    if ceil_mode:
+        for i, d in enumerate(spatial):
+            size = a_shape[d] + pd_resolved[d][0] + pd_resolved[d][1]
+            rem = (size - k[i]) % s[i]
+            if rem != 0:
+                lo, hi = pd_resolved[d]
+                pd_resolved[d] = (lo, hi + (s[i] - rem))
+    return pd_resolved
+
+
+def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=False,
+          count_include_pad=True, average=False, exclusive=True):
+    k = _tuplize(kernel, n)
+    s = _tuplize(stride if stride is not None else kernel, n)
+    pad = _padding(padding, n, data_format)
+
+    def f(a):
+        win, st, spatial = _window(n, data_format, k, s)
+        pd_resolved = _resolve_pads(a.shape, win, st, pad, n, spatial, k, s, ceil_mode,
+                                    a.ndim)
+        if not average:
+            return jax.lax.reduce_window(a, init(a.dtype), reducer, win, st, pd_resolved)
+        summed = jax.lax.reduce_window(a, jnp.zeros((), a.dtype), jax.lax.add, win, st,
+                                       pd_resolved)
+        if exclusive:
+            ones = jnp.ones(tuple(a.shape[d] for d in spatial), a.dtype)
+            ones = ones.reshape([a.shape[d] if d in spatial else 1 for d in range(a.ndim)])
+            counts = jax.lax.reduce_window(
+                jnp.broadcast_to(ones, a.shape) * 0 + 1, jnp.zeros((), a.dtype),
+                jax.lax.add, win, st, pd_resolved)
+            return summed / counts
+        denom = np.prod(k)
+        return summed / denom
+    return apply(f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCL", name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, data_format,
+                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                                                  else jnp.iinfo(dt).min, dt),
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, kernel_size, stride, padding, 1, data_format, ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format,
+                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                                                  else jnp.iinfo(dt).min, dt),
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format,
+                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                                                  else jnp.iinfo(dt).min, dt),
+                ceil_mode=ceil_mode)
+    if return_mask:
+        return out, _pool_indices(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
+    return out
+
+
+def _pool_indices(x, kernel, stride, padding, n, data_format, ceil_mode):
+    """Argmax indices within flattened spatial dims (paddle return_mask contract)."""
+    def f(a):
+        spatial_shape = a.shape[2:] if data_format[1] == "C" else a.shape[1:-1]
+        numel = int(np.prod(spatial_shape))
+        iota = jnp.arange(numel, dtype=jnp.float32).reshape(spatial_shape)
+        if data_format[1] == "C":
+            iota_b = jnp.broadcast_to(iota, a.shape)
+        else:
+            iota_b = jnp.broadcast_to(iota.reshape(spatial_shape + (1,)), a.shape)
+        k = _tuplize(kernel, n)
+        s = _tuplize(stride if stride is not None else kernel, n)
+        pad = _padding(padding, n, data_format)
+        win, st, spatial = _window(n, data_format, k, s)
+        pd = _resolve_pads(a.shape, win, st, pad, n, spatial, k, s, ceil_mode, a.ndim)
+
+        def red(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take = cv > av
+            return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+        vals, idxs = jax.lax.reduce_window(
+            (a, iota_b), (jnp.array(-jnp.inf, a.dtype), jnp.array(-1.0, jnp.float32)),
+            red, win, st, pd)
+        return idxs.astype(jnp.int64)
+    return apply(f, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
+               data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add,
+                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add,
+                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add,
+                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+
+
+def _adaptive_axes(in_size, out_size):
+    # start/end indices per output cell
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, op):
+    def f(a):
+        spatial = list(range(2, 2 + n)) if data_format[1] == "C" else list(range(1, 1 + n))
+        outs = _tuplize(output_size, n)
+        out = a
+        for dim_i, d in enumerate(spatial):
+            o = outs[dim_i]
+            if o is None:
+                continue
+            in_size = out.shape[d]
+            if in_size % o == 0:
+                # uniform: reshape-reduce (fast path, XLA-friendly)
+                factor = in_size // o
+                new_shape = out.shape[:d] + (o, factor) + out.shape[d + 1:]
+                out = getattr(jnp, op)(out.reshape(new_shape), axis=d + 1)
+            else:
+                starts, ends = _adaptive_axes(in_size, o)
+                slices = [getattr(jnp, op)(jax.lax.slice_in_dim(out, s, e, axis=d), axis=d)
+                          for s, e in zip(starts, ends)]
+                out = jnp.stack(slices, axis=d)
+        return out
+    return apply(f, x)
+
+
+def _adaptive_max_mask(x, output_size, n, data_format):
+    """Indices (flattened within input spatial dims) of each adaptive-max cell."""
+    def f(a):
+        spatial = list(range(2, 2 + n)) if data_format[1] == "C" else list(range(1, 1 + n))
+        outs = _tuplize(output_size, n)
+        in_sizes = [a.shape[d] for d in spatial]
+        flat_sp = int(np.prod(in_sizes))
+        iota = jnp.arange(flat_sp, dtype=jnp.float32).reshape(in_sizes)
+        if data_format[1] == "C":
+            iota_b = jnp.broadcast_to(iota, a.shape)
+        else:
+            iota_b = jnp.broadcast_to(iota.reshape(tuple(in_sizes) + (1,)), a.shape)
+        vals, idxs = a, iota_b
+        for dim_i, d in enumerate(spatial):
+            o = outs[dim_i]
+            in_size = vals.shape[d]
+            starts, ends = _adaptive_axes(in_size, o)
+            v_sl, i_sl = [], []
+            for s, e in zip(starts, ends):
+                vv = jax.lax.slice_in_dim(vals, s, e, axis=d)
+                ii = jax.lax.slice_in_dim(idxs, s, e, axis=d)
+                am = jnp.argmax(vv, axis=d, keepdims=True)
+                v_sl.append(jnp.take_along_axis(vv, am, axis=d).squeeze(d))
+                i_sl.append(jnp.take_along_axis(ii, am, axis=d).squeeze(d))
+            vals = jnp.stack(v_sl, axis=d)
+            idxs = jnp.stack(i_sl, axis=d)
+        return idxs.astype(jnp.int64)
+    return apply(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCL", "mean")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "mean")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "mean")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCL", "max")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 1, "NCL")
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", "max")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 2, "NCHW")
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", "max")
+    if return_mask:
+        return out, _adaptive_max_mask(x, output_size, 3, "NCDHW")
+    return out

@@ -1,0 +1,18 @@
+from .activation import *  # noqa: F401,F403
+from .base import Layer  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .containers import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
+from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,  # noqa: F401
+                   Conv3DTranspose)
+from .loss import *  # noqa: F401,F403
+from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,  # noqa: F401
+                   InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
+                   LocalResponseNorm, SpectralNorm, SyncBatchNorm)
+from .pooling import (AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveAvgPool3D,  # noqa: F401
+                      AdaptiveMaxPool1D, AdaptiveMaxPool2D, AdaptiveMaxPool3D,
+                      AvgPool1D, AvgPool2D, AvgPool3D, MaxPool1D, MaxPool2D, MaxPool3D)
+from .rnn import (GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,  # noqa: F401
+                  SimpleRNNCell)
+from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,  # noqa: F401
+                          TransformerDecoderLayer, TransformerEncoder,
+                          TransformerEncoderLayer)

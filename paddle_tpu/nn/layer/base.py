@@ -1,0 +1,384 @@
+"""Layer base class.
+
+Reference: python/paddle/fluid/dygraph/layers.py:81 (``Layer``, 1612 lines) —
+parameter/buffer registries, sublayer tree, forward hooks, state_dict
+naming contract, train/eval mode.
+
+TPU-native addition: :meth:`raw_state` / :meth:`bind` — the functional bridge
+that lets the same ``forward`` run under ``jax.jit`` over an explicit
+parameter pytree (this replaces the reference's dygraph-to-static AST
+transpiler for the common case; see paddle_tpu.jit).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype
+from ...core.tensor import Parameter, Tensor
+
+_layer_counters: Dict[str, int] = {}
+
+
+def _unique_name(prefix: str) -> str:
+    idx = _layer_counters.get(prefix, 0)
+    _layer_counters[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks: dict, hid: int):
+        self._hooks, self._hid = hooks, hid
+
+    def remove(self):
+        self._hooks.pop(self._hid, None)
+
+
+class Layer:
+    """Base class for all neural network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype is not None else get_default_dtype()
+        self._full_name = _unique_name(name_scope or self.__class__.__name__.lower())
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_id = 0
+
+    # ------------------------------------------------------------------ attrs
+    def __setattr__(self, name: str, value: Any):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            _remove_from(name, buffers, layers, self.__dict__)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call Layer.__init__ before assigning sublayers")
+            _remove_from(name, params, buffers, self.__dict__)
+            layers[name] = value
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if layers is not None and name in layers:
+                del layers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        for reg in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(reg)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for reg in (self._parameters, self._buffers, self._sub_layers):
+            if name in reg:
+                del reg[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + list(self._buffers) \
+            + list(self._sub_layers)
+
+    # ----------------------------------------------------------- registration
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name: str, parameter: Parameter) -> Parameter:
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor], persistable: bool = True):
+        self._buffers[str(name)] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(str(name))
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias: bool = False,
+                         default_initializer=None) -> Parameter:
+        from ..initializer import Constant, XavierUniform
+        from ...framework.param_attr import ParamAttr
+        attr = ParamAttr._to_attr(attr)
+        dtype = convert_dtype(dtype) if dtype is not None else self._dtype
+        init = None
+        if attr is not None and attr.initializer is not None:
+            init = attr.initializer
+        elif default_initializer is not None:
+            init = default_initializer
+        else:
+            init = Constant(0.0) if is_bias else XavierUniform()
+        data = init(shape, dtype)
+        p = Parameter(data, name=(attr.name if attr is not None else None),
+                      trainable=(attr.trainable if attr is not None else True))
+        if attr is not None:
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
+            p.regularizer = attr.regularizer
+            p.do_model_average = getattr(attr, "do_model_average", None)
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(jnp.zeros([0], convert_dtype(dtype) if dtype else self._dtype))
+
+    # ------------------------------------------------------------- traversal
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "", include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        for name, layer in self._traverse(prefix, include_sublayers):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        for name, layer in self._traverse(prefix, True):
+            if name == prefix and not include_self:
+                continue
+            yield name, layer
+
+    def _traverse(self, prefix: str, include_sublayers: bool):
+        yield prefix, self
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                sub_prefix = f"{prefix}.{name}" if prefix else name
+                yield from sub._traverse(sub_prefix, True)
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for l in self.children():
+            l.apply(fn)
+        fn(self)
+        return self
+
+    def full_name(self) -> str:
+        return self._full_name
+
+    # ------------------------------------------------------------ state dict
+    def state_dict(self, destination=None, include_sublayers: bool = True,
+                   structured_name_prefix: str = "", use_hook: bool = True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(structured_name_prefix, include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(structured_name_prefix, include_sublayers):
+            short = name.rsplit(".", 1)[-1]
+            owner = self._locate_owner(name)
+            if owner is not None and short in owner._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    to_static_state_dict = state_dict
+
+    def _locate_owner(self, qualified: str) -> Optional["Layer"]:
+        parts = qualified.split(".")[:-1]
+        layer: "Layer" = self
+        for p in parts:
+            layer = layer._sub_layers.get(p)
+            if layer is None:
+                return None
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        missing, unexpected = [], []
+        own = self.state_dict()
+        for name, target in own.items():
+            if name in state_dict:
+                value = state_dict[name]
+                arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+                if list(arr.shape) != list(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: loaded {list(arr.shape)} vs "
+                        f"expected {list(target.shape)}")
+                target.set_value(jnp.asarray(arr))
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ mode/hooks
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # --------------------------------------------------------------- running
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # ----------------------------------------------------------- conversions
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._convert_dtype(convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._convert_dtype(convert_dtype(dtype))
+        return self
+
+    def _convert_dtype(self, dtype):
+        for l in self.sublayers(include_self=True):
+            l._dtype = dtype
+            for p in l._parameters.values():
+                if p is not None and jnp.issubdtype(p.dtype, jnp.floating):
+                    p._data = p._data.astype(dtype)
+            for b in l._buffers.values():
+                if b is not None and jnp.issubdtype(b.dtype, jnp.floating):
+                    b._data = b._data.astype(dtype)
+
+    def float(self):
+        return self.astype(jnp.float32)
+
+    def bfloat16(self):
+        return self.astype(jnp.bfloat16)
+
+    def half(self):
+        return self.astype(jnp.float16)
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ------------------------------------------------- functional bridge (TPU)
+    def raw_state(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Extract (params, buffers) as raw-array pytrees keyed by state name."""
+        params = {n: p._data for n, p in self.named_parameters() if p.trainable}
+        buffers = {n: b._data for n, b in self.named_buffers() if b is not None}
+        # non-trainable params ride with buffers so they are still bound
+        for n, p in self.named_parameters():
+            if not p.trainable:
+                buffers[f"__frozen__.{n}"] = p._data
+        return params, buffers
+
+    @contextlib.contextmanager
+    def bind(self, params: Dict[str, Any], buffers: Optional[Dict[str, Any]] = None,
+             trainable_as_tensor: bool = True):
+        """Temporarily swap parameter/buffer storage with the given pytrees.
+
+        Inside a jit trace the pytrees are tracers; ``forward`` then executes
+        as a pure function of them.  On exit, mutated buffer values can be
+        read back with :meth:`read_buffers` before storage is restored.
+        """
+        named_p = dict(self.named_parameters())
+        named_b = dict(self.named_buffers())
+        saved = {}
+        try:
+            for n, v in params.items():
+                t = named_p[n]
+                saved[id(t)] = (t, t._data)
+                t._data = v
+            if buffers:
+                for n, v in buffers.items():
+                    if n.startswith("__frozen__."):
+                        t = named_p[n[len("__frozen__."):]]
+                    else:
+                        t = named_b[n]
+                    saved[id(t)] = (t, t._data) if id(t) not in saved else saved[id(t)]
+                    t._data = v
+            yield self
+        finally:
+            for t, old in saved.values():
+                t._data = old
+
+    def read_buffers(self, buffers: Dict[str, Any]) -> Dict[str, Any]:
+        """Read current (possibly trace-mutated) values of the named buffers."""
+        named_b = dict(self.named_buffers())
+        named_p = dict(self.named_parameters())
+        out = {}
+        for n in buffers:
+            if n.startswith("__frozen__."):
+                out[n] = named_p[n[len("__frozen__."):]]._data
+            else:
+                out[n] = named_b[n]._data
+        return out
+
+
+def _remove_from(name, *dicts):
+    for d in dicts:
+        if d is not None and name in d:
+            del d[name]

@@ -1,0 +1,209 @@
+"""Normalization layers (reference: python/paddle/nn/layer/norm.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..initializer import Constant
+from .base import Layer
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum, self._epsilon = momentum, epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter([num_features], attr=weight_attr,
+                                                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros([num_features], self._dtype)))
+        self.register_buffer("_variance", Tensor(jnp.ones([num_features], self._dtype)))
+
+    def forward(self, input):
+        return F.batch_norm(input, self._mean, self._variance, self.weight, self.bias,
+                            training=self.training, momentum=self._momentum,
+                            epsilon=self._epsilon, data_format=self._data_format,
+                            use_global_stats=self._use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-era BatchNorm (acts like eval-aware BatchNorm with act)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, data_layout="NCHW",
+                 use_global_stats=False, **kwargs):
+        super().__init__(num_channels, momentum, epsilon, param_attr, bias_attr,
+                         data_layout, use_global_stats)
+        self._act = act
+
+    def forward(self, input):
+        out = super().forward(input)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCL", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum, epsilon, weight_attr, bias_attr,
+                         data_format, use_global_stats)
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """On TPU, batch statistics are synchronized automatically when the batch
+    axis is sharded over the mesh (GSPMD inserts the cross-replica reduction);
+    eager single-process behaves as BatchNorm.  Kept for API parity with
+    nn.SyncBatchNorm (reference: python/paddle/nn/layer/norm.py:1034)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        mapping = {}
+        def convert(l):
+            for name, sub in list(l._sub_layers.items()):
+                if isinstance(sub, _BatchNormBase) and not isinstance(sub, SyncBatchNorm):
+                    new = SyncBatchNorm(sub._num_features, sub._momentum, sub._epsilon,
+                                        data_format=sub._data_format)
+                    new.weight, new.bias = sub.weight, sub.bias
+                    new._buffers.update(sub._buffers)
+                    l._sub_layers[name] = new
+                else:
+                    convert(sub)
+        convert(layer)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._normalized_shape = list(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(self._normalized_shape, attr=weight_attr,
+                                                default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self._normalized_shape, attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, input):
+        return F.layer_norm(input, self._normalized_shape, self.weight, self.bias,
+                            self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_groups, self._num_channels = num_groups, num_channels
+        self._epsilon, self._data_format = epsilon, data_format
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=weight_attr, default_initializer=Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return F.group_norm(input, self._num_groups, self._epsilon, self.weight,
+                            self.bias, self._data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._num_features, self._epsilon = num_features, epsilon
+        self._data_format = data_format
+        if weight_attr is False:
+            self.scale = None
+        else:
+            self.scale = self.create_parameter([num_features], attr=weight_attr,
+                                               default_initializer=Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, input):
+        return F.instance_norm(input, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon, data_format=self._data_format)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, input):
+        return F.local_response_norm(input, self.size, self.alpha, self.beta, self.k,
+                                     self.data_format)
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12, name=None):
+        super().__init__()
+        self._dim, self._power_iters, self._epsilon = dim, power_iters, epsilon
+        import numpy as np
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from ..initializer import Normal
+        self.weight_u = self.create_parameter([h], default_initializer=Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter([w], default_initializer=Normal(0, 1))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.tensor import apply
+        import jax
+        dim, iters, eps = self._dim, self._power_iters, self._epsilon
+
+        def f(w, u, v):
+            w_m = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = w_m.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = w_m @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ w_m @ v
+            return w / sigma
+        return apply(f, weight, self.weight_u, self.weight_v)

@@ -1,0 +1,322 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py, operators/rnn_op.*).
+
+TPU-first: the time loop is ``jax.lax.scan`` (compiled once, no Python loop),
+weights follow paddle's layout (weight_ih: (gates*hidden, input)) so
+state_dicts interchange.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ..initializer import Uniform
+from .base import Layer
+from .containers import LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None, init_value=0.0,
+                           batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        return Tensor(jnp.full((batch, self.hidden_size), init_value, self._dtype))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+        out, new = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                         self.bias_ih, self.bias_hh)
+        return out, new
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, proj_size=0, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs), self.get_initial_states(inputs))
+        h, c = states
+
+        def f(x, hh, cc, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hh @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i, fgt, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgt), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            new_c = fgt * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+        new_h, new_c = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh)
+        return new_h, (new_h, new_c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr, is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr, is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def f(x, h, wi, wh, bi, bh):
+            xg = x @ wi.T + bi
+            hg = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xg, 3, axis=-1)
+            hr, hz, hn = jnp.split(hg, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            return (1 - z) * n + z * h
+        new_h = apply(f, inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh)
+        return new_h, new_h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_rnn(mode, x, init_states, params, time_major, reverse=False, seq_lens=None):
+    """Run one direction of one layer with lax.scan.  x: (B,T,I) or (T,B,I)."""
+    def f(a, h0, c0, lens, wi, wh, bi, bh):
+        xs = a if time_major else jnp.swapaxes(a, 0, 1)  # (T,B,I)
+        T = xs.shape[0]
+        if reverse:
+            xs = jnp.flip(xs, 0)
+
+        def step(carry, inp):
+            x_t, t = inp
+            h, c = carry
+            if mode == "LSTM":
+                gates = x_t @ wi.T + bi + h @ wh.T + bh
+                i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+                i, fgt, o = jax.nn.sigmoid(i), jax.nn.sigmoid(fgt), jax.nn.sigmoid(o)
+                g = jnp.tanh(g)
+                nc = fgt * c + i * g
+                nh = o * jnp.tanh(nc)
+            elif mode == "GRU":
+                hg = h @ wh.T + bh
+                xg = x_t @ wi.T + bi
+                xr, xz, xn = jnp.split(xg, 3, axis=-1)
+                hr, hz, hn = jnp.split(hg, 3, axis=-1)
+                r = jax.nn.sigmoid(xr + hr)
+                z = jax.nn.sigmoid(xz + hz)
+                n = jnp.tanh(xn + r * hn)
+                nh = (1 - z) * n + z * h
+                nc = c
+            else:
+                act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+                nh = act(x_t @ wi.T + bi + h @ wh.T + bh)
+                nc = c
+            if lens is not None:
+                tt = (T - 1 - t) if reverse else t
+                valid = (tt < lens)[:, None]
+                nh = jnp.where(valid, nh, h)
+                nc = jnp.where(valid, nc, c)
+            return (nh, nc), nh
+        c_init = c0 if c0 is not None else jnp.zeros_like(h0)
+        (hT, cT), outs = jax.lax.scan(step, (h0, c_init),
+                                      (xs, jnp.arange(T)))
+        if reverse:
+            outs = jnp.flip(outs, 0)
+        outs = outs if time_major else jnp.swapaxes(outs, 0, 1)
+        return outs, hT, cT
+    h0, c0 = init_states
+    return apply(f, x, h0, c0, seq_lens, *params)
+
+
+class RNNBase(LayerList):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode, self.input_size, self.hidden_size = mode, input_size, hidden_size
+        self.num_layers, self.time_major, self.dropout = num_layers, time_major, dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        gates = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = "_reverse" if d == 1 else ""
+                wi = self.create_parameter([gates * hidden_size, in_sz], weight_ih_attr,
+                                           default_initializer=init)
+                wh = self.create_parameter([gates * hidden_size, hidden_size],
+                                           weight_hh_attr, default_initializer=init)
+                bi = self.create_parameter([gates * hidden_size], bias_ih_attr,
+                                           is_bias=True, default_initializer=init)
+                bh = self.create_parameter([gates * hidden_size], bias_hh_attr,
+                                           is_bias=True, default_initializer=init)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        num_dirs = self.num_directions
+        state_shape = (self.num_layers * num_dirs, batch, self.hidden_size)
+        if initial_states is None:
+            z = Tensor(jnp.zeros(state_shape, self._dtype))
+            initial_states = (z, Tensor(jnp.zeros(state_shape, self._dtype))) \
+                if self.mode == "LSTM" else z
+        if self.mode == "LSTM":
+            h_all, c_all = initial_states
+        else:
+            h_all, c_all = initial_states, None
+
+        out = inputs
+        final_h, final_c = [], []
+        from .. import functional as F
+        for layer in range(self.num_layers):
+            dir_outs = []
+            for d in range(num_dirs):
+                idx = layer * num_dirs + d
+                h0 = h_all[idx]
+                c0 = c_all[idx] if c_all is not None else None
+                outs, hT, cT = _scan_rnn(self.mode, out, (h0, c0),
+                                         self._all_weights[idx], self.time_major,
+                                         reverse=(d == 1), seq_lens=sequence_length)
+                dir_outs.append(outs)
+                final_h.append(hT)
+                final_c.append(cT)
+            if num_dirs == 2:
+                from ...tensor.manipulation import concat
+                out = concat(dir_outs, axis=-1)
+            else:
+                out = dir_outs[0]
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        from ...tensor.manipulation import stack
+        h_stack = stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c_stack = stack(final_c, axis=0)
+            return out, (h_stack, c_stack)
+        return out, h_stack
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        kwargs.pop("proj_size", None)
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class RNN(Layer):
+    """Wraps a cell into a recurrent network over time (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse, self.time_major = is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        time_axis = 0 if self.time_major else 1
+        T = inputs.shape[time_axis]
+        outputs = []
+        states = initial_states
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in steps:
+            x_t = inputs[:, t] if not self.time_major else inputs[t]
+            out, states = self.cell(x_t, states, **kwargs)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from ...tensor.manipulation import stack
+        return stack(outputs, axis=time_axis), states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length, **kwargs)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length, **kwargs)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,93 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor.manipulation import concat
+    return concat([p.reshape([-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec._data[offset:offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters]) if p._grad is not None]
+    if not params:
+        return Tensor(jnp.zeros([]))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p._grad)) for p in params]))
+    else:
+        total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p._grad), norm_type))
+                              for p in params), 1.0 / norm_type)
+    clip_coef = jnp.clip(max_norm / (total + 1e-6), a_max=1.0) \
+        if hasattr(jnp, "clip") else max_norm / (total + 1e-6)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p._grad = p._grad * clip_coef.astype(p._grad.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    params = parameters if isinstance(parameters, (list, tuple)) else [parameters]
+    for p in params:
+        if p._grad is not None:
+            p._grad = jnp.clip(p._grad, -clip_value, clip_value)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Weight-norm reparameterization (reference: nn/utils/weight_norm_hook.py)."""
+    import numpy as np
+    from ...core.tensor import Parameter
+    w = getattr(layer, name)
+    axes = tuple(i for i in range(w.ndim) if i != dim) if dim is not None else None
+    g = jnp.linalg.norm(np.asarray(w._data), axis=axes, keepdims=True) if axes \
+        else jnp.linalg.norm(np.asarray(w._data))
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g)))
+    layer.add_parameter(name + "_v", Parameter(w._data))
+    del layer._parameters[name]
+
+    def hook(l, inputs):
+        from ...core.tensor import apply
+        v = l._parameters[name + "_v"]
+        gg = l._parameters[name + "_g"]
+
+        def f(vv, ggg):
+            n = jnp.linalg.norm(vv, axis=axes, keepdims=True) if axes is not None \
+                else jnp.linalg.norm(vv)
+            return vv * (ggg / jnp.maximum(n, 1e-12))
+        object.__setattr__(l, "_wn_cache", apply(f, v, gg))
+        # place computed weight where forward finds it
+        l.__dict__.setdefault("_wn_name", name)
+        l._buffers.pop(name, None)
+        object.__setattr__(l, name, l._wn_cache)
+    layer.register_forward_pre_hook(hook)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    v = layer._parameters.pop(name + "_v")
+    g = layer._parameters.pop(name + "_g")
+    from ...core.tensor import Parameter, apply
+
+    def f(vv, gg):
+        import numpy as np
+        axes = tuple(i for i in range(vv.ndim) if i != 0)
+        n = jnp.linalg.norm(vv, axis=axes, keepdims=True)
+        return vv * (gg / jnp.maximum(n, 1e-12))
+    layer.add_parameter(name, Parameter(f(v._data, g._data)))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    return layer

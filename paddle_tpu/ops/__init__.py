@@ -1,0 +1,6 @@
+"""paddle_tpu.ops — Pallas/TPU fused kernels.
+
+TPU-native replacements for the reference's operators/fused/ corpus
+(fused_attention_op.cu, fused_feedforward_op.cu, fused_dropout_helper.h)."""
+
+from .attention import dense_attention, flash_attention, scaled_dot_product_attention  # noqa: F401

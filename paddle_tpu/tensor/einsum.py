@@ -1,0 +1,11 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py) — delegate to XLA's einsum."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def einsum(equation, *operands):
+    return apply(lambda ops: jnp.einsum(equation, *ops), list(operands))

@@ -1,0 +1,163 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, paddle.linalg)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and p in ("fro", 2):
+            return jnp.linalg.norm(a.reshape(-1), ord=2, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        ordv = p
+        if p == "fro":
+            ordv = None if isinstance(ax, tuple) else 2
+        if p == "inf":
+            ordv = jnp.inf
+        elif p == "-inf":
+            ordv = -jnp.inf
+        return jnp.linalg.norm(a, ord=ordv, axis=ax, keepdims=keepdim)
+    return apply(f, x)
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return apply(f, x)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0,
+                                                 unit_diagonal=unitriangular)
+    return apply(f, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return apply(f, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply(f, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1
+    out = apply(f, x)
+    if get_infos:
+        from .creation import zeros
+        return out[0], out[1], zeros([1], "int32")
+    return out
+
+
+def qr(x, mode="reduced", name=None):
+    return apply(lambda a: jnp.linalg.qr(a, mode=mode), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    def f(a):
+        u, s, vh = jnp.linalg.svd(a, full_matrices=full_matrices)
+        return u, s, vh
+    return apply(f, x)
+
+
+def eig(x, name=None):
+    return apply(jnp.linalg.eig, x)
+
+
+def eigvals(x, name=None):
+    return apply(jnp.linalg.eigvals, x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply(f, x, y)
+
+
+def multi_dot(x, name=None):
+    return apply(lambda xs: jnp.linalg.multi_dot(xs), list(x))
+
+
+def matrix_transpose(x, name=None):
+    return apply(lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply(f, x, y)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return apply(f, input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    return apply(lambda a, w: jnp.bincount(a, weights=w, minlength=minlength,
+                                           length=None), x, weights)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a, fw, aw: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                                           fweights=fw, aweights=aw), x, fweights, aweights)

@@ -1,0 +1,80 @@
+"""Logical / comparison ops (reference: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def equal(x, y, name=None):
+    return apply(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply(jnp.not_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply(jnp.greater_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return apply(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply(jnp.less_equal, x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y, name=None):
+    return apply(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y, name=None):
+    return apply(jnp.right_shift, x, y)
+
+
+def is_tensor(x):
+    from ..core.tensor import Tensor
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    return apply(lambda a: jnp.asarray(a.size == 0), x)

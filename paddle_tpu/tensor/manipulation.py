@@ -1,0 +1,383 @@
+"""Shape / layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, apply
+
+
+def _shape(s):
+    if isinstance(s, Tensor):
+        return tuple(int(v) for v in np.asarray(s._data))
+    if isinstance(s, (int, np.integer)):
+        return (int(s),)
+    return tuple(int(getattr(v, "item", lambda: v)()) if not isinstance(v, int) else v for v in s)
+
+
+def reshape(x, shape, name=None):
+    return apply(lambda a: jnp.reshape(a, _shape(shape)), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._adopt(out)
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s0 = start_axis % nd if nd else 0
+        s1 = stop_axis % nd if nd else 0
+        newshape = a.shape[:s0] + (-1,) + a.shape[s1 + 1:]
+        return jnp.reshape(a, newshape)
+    return apply(f, x)
+
+
+def transpose(x, perm=None, name=None):
+    return apply(lambda a: jnp.transpose(a, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply(lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+transpose_ = transpose
+
+
+def unsqueeze(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda a: jnp.expand_dims(a, ax), x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+        ax = tuple(a_ for a_ in ax if a.shape[a_] == 1)
+        return jnp.squeeze(a, ax) if ax else a
+    return apply(f, x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(getattr(axis, "item", lambda: axis)()) if not isinstance(axis, int) else axis
+    return apply(lambda xs: jnp.concatenate(xs, axis=axis), list(x))
+
+
+def stack(x, axis=0, name=None):
+    return apply(lambda xs: jnp.stack(xs, axis=axis), list(x))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def f(a):
+        n = num or a.shape[axis]
+        return [jnp.squeeze(s, axis) for s in jnp.split(a, n, axis=axis)]
+    return apply(f, x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis)
+
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return jnp.split(a, num_or_sections, axis=axis)
+        secs = list(num_or_sections)
+        total = a.shape[axis]
+        known = sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return jnp.split(a, idx, axis=axis)
+    return apply(f, x)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    return apply(lambda a: jnp.tile(a, _shape(repeat_times)), x)
+
+
+def expand(x, shape, name=None):
+    def f(a):
+        tgt = list(_shape(shape))
+        for i, t in enumerate(tgt):
+            if t == -1:
+                tgt[i] = a.shape[i - (len(tgt) - a.ndim)]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply(f, x)
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return apply(lambda a: jnp.broadcast_to(a, _shape(shape)), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    return apply(lambda xs: list(jnp.broadcast_arrays(*xs)), list(inputs))
+
+
+def flip(x, axis, name=None):
+    return apply(lambda a: jnp.flip(a, axis), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda a: jnp.roll(a, shifts, axis=axis), x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(getattr(axis, "item", lambda: axis)()) if not isinstance(axis, int) else axis
+    return apply(lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim else i, axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        d = idx.shape[-1]
+        flat_idx = tuple(jnp.moveaxis(idx, -1, 0))
+        return a[flat_idx]
+    return apply(f, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "add":
+            return _put_along(a, i, v, axis, "add")
+        if reduce in ("mul", "multiply"):
+            return _put_along(a, i, v, axis, "multiply")
+        return _put_along(a, i, v, axis, "set")
+    return apply(f, arr, indices, values)
+
+
+def _put_along(a, i, v, axis, mode):
+    idx = [jnp.broadcast_to(jax.lax.broadcasted_iota(i.dtype, i.shape, d), i.shape)
+           for d in range(a.ndim)]
+    idx[axis] = i
+    at = a.at[tuple(idx)]
+    return getattr(at, {"add": "add", "multiply": "multiply", "set": "set"}[mode])(v)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+    return apply(f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._adopt(out)
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        z = jnp.zeros(_shape(shape), u.dtype)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return apply(f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return apply(lambda a, i, u: a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u), x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+def index_sample(x, index):
+    return apply(lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m.astype(a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply(f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, idx, v):
+        idx = tuple(idx)
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply(f, x, list(indices), value)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return apply(lambda a, r: jnp.repeat(a, r, axis=axis,
+                                         total_repeat_length=None if isinstance(r, int) else None),
+                 x, repeats)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None,
+           dtype="int64", name=None):
+    def f(a):
+        res = jnp.unique(np.asarray(a), return_index=return_index,
+                         return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+        return res
+    # unique has data-dependent shape: eager-only (numpy), like reference's unique op on CPU
+    a = np.asarray(getattr(x, "_data", x))
+    res = np.unique(a, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        out = [Tensor(jnp.asarray(res[0]))]
+        for r in res[1:]:
+            out.append(Tensor(jnp.asarray(r.astype(np.int64))))
+        return tuple(out)
+    return Tensor(jnp.asarray(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64",
+                       name=None):
+    a = np.asarray(getattr(x, "_data", x))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    take = np.ones(a.shape[ax], dtype=bool)
+    sl = [slice(None)] * a.ndim
+    sl[ax] = slice(1, None)
+    sl2 = [slice(None)] * a.ndim
+    sl2[ax] = slice(None, -1)
+    neq = (a[tuple(sl)] != a[tuple(sl2)])
+    while neq.ndim > 1:
+        neq = neq.any(axis=-1 if ax == 0 else 0)
+    take[1:] = neq
+    out = [Tensor(jnp.asarray(np.compress(take, a, axis=ax)))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(take) - 1, dtype=np.int64)))
+    if return_counts:
+        idx = np.nonzero(take)[0]
+        counts = np.diff(np.append(idx, a.shape[ax]))
+        out.append(Tensor(jnp.asarray(counts, dtype=np.int64)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def masked_select(x, mask, name=None):
+    a = np.asarray(getattr(x, "_data", x))
+    m = np.asarray(getattr(mask, "_data", mask))
+    return Tensor(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda a, m, v: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask, value)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def f(a, p):
+        p = list(int(v) for v in (np.asarray(p).reshape(-1)))
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(p)//2 spatial dims,
+            # ordered (last_dim_lo, last_dim_hi, second_last_lo, ...) for NCHW
+            k = len(p) // 2
+            width = [(0, 0)] * nd
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                dims = list(range(nd - k, nd))
+            else:  # NHWC-family: spatial dims are 1..k
+                dims = list(range(1, 1 + k))
+            for j, d in enumerate(reversed(dims)):
+                width[d] = (p[2 * j], p[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return apply(f, x, pad)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        sl = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[ax] = slice(s, e, st)
+        return a[tuple(sl)]
+    return apply(f, x)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    return strided_slice(x, axes, starts, ends, [1] * len(axes))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    import builtins
+
+    def f(a):
+        sh = _shape(shape)
+        off = [0] * a.ndim if offsets is None else [int(o) for o in offsets]
+        sl = tuple(builtins.slice(o, (o + s) if s != -1 else None) for o, s in zip(off, sh))
+        return a[sl]
+    return apply(f, x)
+
+
+def as_complex(x, name=None):
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply(lambda a: a.view(convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return apply(lambda a, b: jnp.reshape(a, b.shape), x, other)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply(jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply(jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply(jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(i):
+        size = index_num // nshards
+        lo, hi = shard_id * size, (shard_id + 1) * size
+        inside = (i >= lo) & (i < hi)
+        return jnp.where(inside, i - lo, ignore_value)
+    return apply(f, input)

@@ -1,0 +1,238 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py,
+operators/elementwise/, operators/reduce_ops/)."""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, apply
+
+_mod = sys.modules[__name__]
+
+
+def _unary(name, fn):
+    def wrapper(x, name=None):
+        return apply(fn, x)
+    wrapper.__name__ = name
+    setattr(_mod, name, wrapper)
+
+
+def _binary(name, fn):
+    def wrapper(x, y, name=None):
+        return apply(fn, x, y)
+    wrapper.__name__ = name
+    setattr(_mod, name, wrapper)
+
+
+for _n, _f in {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt, "rsqrt": lambda x: jax.lax.rsqrt(x),
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "round": jnp.round,
+    "trunc": jnp.trunc, "cos": jnp.cos, "sin": jnp.sin, "tan": jnp.tan,
+    "acos": jnp.arccos, "asin": jnp.arcsin, "atan": jnp.arctan,
+    "cosh": jnp.cosh, "sinh": jnp.sinh, "tanh": jnp.tanh,
+    "acosh": jnp.arccosh, "asinh": jnp.arcsinh, "atanh": jnp.arctanh,
+    "reciprocal": jnp.reciprocal, "square": jnp.square, "sign": jnp.sign,
+    "neg": jnp.negative, "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "digamma": jax.scipy.special.digamma, "lgamma": jax.scipy.special.gammaln,
+    "sigmoid": jax.nn.sigmoid, "angle": jnp.angle, "conj": jnp.conj,
+    "real": jnp.real, "imag": jnp.imag, "frac": lambda x: x - jnp.trunc(x),
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+    "i0": jax.scipy.special.i0, "i1": jax.scipy.special.i1,
+}.items():
+    _unary(_n, _f)
+
+for _n, _f in {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.true_divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "heaviside": jnp.heaviside, "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter, "ldexp": jnp.ldexp,
+    "gcd": jnp.gcd, "lcm": jnp.lcm, "inner": jnp.inner, "outer": jnp.outer,
+    "kron": jnp.kron,
+}.items():
+    _binary(_n, _f)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s, b):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    return apply(f, x, scale, bias)
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda a, lo, hi: jnp.clip(a, lo, hi), x, min, max)
+
+
+def multiplex(inputs, index, name=None):
+    return apply(lambda ins, idx: jnp.stack(ins, 1)[jnp.arange(ins[0].shape[0]), idx.reshape(-1)],
+                 list(inputs), index)
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(getattr(axis, "item", lambda: axis)()) if not isinstance(axis, int) else axis
+
+
+def _reduction(name, fn, int_promote=False):
+    def wrapper(x, axis=None, keepdim=False, name=None):
+        ax = _norm_axis(axis)
+
+        def f(a):
+            out = fn(a, axis=ax, keepdims=keepdim)
+            return out
+        return apply(f, x)
+    wrapper.__name__ = name
+    setattr(_mod, name, wrapper)
+
+
+for _n, _f in {
+    "sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min,
+    "prod": jnp.prod, "amax": jnp.max, "amin": jnp.min,
+    "nansum": jnp.nansum, "nanmean": jnp.nanmean,
+    "logsumexp": jax.scipy.special.logsumexp,
+    "all": jnp.all, "any": jnp.any,
+}.items():
+    _reduction(_n, _f)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumsum(a if dtype is None else a.astype(convert_dtype(dtype)),
+                                      axis=axis), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply(lambda a: jnp.cumprod(a if dtype is None else a.astype(convert_dtype(dtype)),
+                                       axis=dim), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def g(a):
+        ax = 0 if axis is None else axis
+        flat = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.maximum, flat, axis=ax)
+        n = flat.shape[ax]
+        iota = jax.lax.broadcasted_iota(jnp.int64, flat.shape, ax)
+        # index of first occurrence of running max
+        eq = flat == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=ax)
+        return vals, idx.astype(convert_dtype(dtype))
+    return apply(g, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def g(a):
+        ax = 0 if axis is None else axis
+        flat = a.reshape(-1) if axis is None else a
+        vals = jax.lax.associative_scan(jnp.minimum, flat, axis=ax)
+        iota = jax.lax.broadcasted_iota(jnp.int64, flat.shape, ax)
+        eq = flat == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=ax)
+        return vals, idx.astype(convert_dtype(dtype))
+    return apply(g, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply(lambda a, p, ap: jnp.diff(a, n=n, axis=axis, prepend=p, append=ap),
+                 x, prepend, append)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y)
+
+
+def mm(x, y, name=None):
+    return apply(jnp.matmul, x, y)
+
+
+def bmm(x, y, name=None):
+    return apply(jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, y, name=None):
+    return apply(jnp.matmul, x, y)
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda a: a + value, x)
+    x._adopt(out)
+    return x
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def rad2deg(x, name=None):
+    return apply(jnp.rad2deg, x)
+
+
+def deg2rad(x, name=None):
+    return apply(jnp.deg2rad, x)
+
+
+def take(x, index, mode="raise", name=None):
+    # XLA cannot raise on device; 'raise' degrades to 'clip' (documented).
+    jmode = "wrap" if mode == "wrap" else "clip"
+    return apply(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1) if hasattr(i, "reshape") else i,
+                                       mode=jmode).reshape(jnp.shape(i)),
+                 x, index)
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

@@ -1,0 +1,126 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Stateful-eager / key-scoped-traced via core.rng (see rng.py docstring).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.tensor import Tensor, apply
+from .creation import _to_shape
+
+
+def _dt(dtype):
+    return get_default_dtype() if dtype is None else convert_dtype(dtype)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rng.next_key() if seed == 0 else jax.random.key(seed)
+    return apply(lambda k: jax.random.uniform(k, _to_shape(shape), _dt(dtype), min, max),
+                 Tensor(key))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._adopt(out)
+    return x
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = rng.next_key()
+    return apply(lambda k: jax.random.normal(k, _to_shape(shape), _dt(dtype)), Tensor(key))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = getattr(mean, "shape", None) or getattr(std, "shape", None) or [1]
+    key = rng.next_key()
+    return apply(lambda k, m, s: m + s * jax.random.normal(k, _to_shape(shape), get_default_dtype()),
+                 Tensor(key), mean, std)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = normal(mean, std, x.shape)
+    x._adopt(out.astype(x.dtype))
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = rng.next_key() if seed == 0 else jax.random.key(seed)
+    return apply(lambda k: mean + std * jax.random.normal(k, _to_shape(shape), _dt(dtype)),
+                 Tensor(key))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = rng.next_key()
+    return apply(lambda k: jax.random.randint(k, _to_shape(shape), low, high,
+                                              convert_dtype(dtype)), Tensor(key))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = rng.next_key()
+    return apply(lambda k: jax.random.permutation(k, n).astype(convert_dtype(dtype)), Tensor(key))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = rng.next_key()
+
+    def f(k, probs):
+        logits = jnp.log(jnp.maximum(probs, 1e-30))
+        if replacement:
+            return jax.random.categorical(k, logits, axis=-1,
+                                          shape=(*probs.shape[:-1], num_samples)).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(k, probs.shape, logits.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return apply(f, Tensor(key), x)
+
+
+def bernoulli(x, name=None):
+    key = rng.next_key()
+    return apply(lambda k, p: jax.random.bernoulli(k, p).astype(p.dtype), Tensor(key), x)
+
+
+def bernoulli_(x, p=0.5, name=None):
+    key = rng.next_key()
+    out = apply(lambda k, a: jax.random.bernoulli(k, p, a.shape).astype(a.dtype), Tensor(key), x)
+    x._adopt(out)
+    return x
+
+
+def poisson(x, name=None):
+    key = rng.next_key()
+    return apply(lambda k, lam: jax.random.poisson(k, lam).astype(lam.dtype), Tensor(key), x)
+
+
+def binomial(count, prob, name=None):
+    key = rng.next_key()
+    return apply(lambda k, n, p: jax.random.binomial(k, n, p).astype(jnp.int64),
+                 Tensor(key), count, prob)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = rng.next_key()
+    out = apply(lambda k, a: (jax.random.exponential(k, a.shape, a.dtype) / lam).astype(a.dtype),
+                Tensor(key), x)
+    x._adopt(out)
+    return x

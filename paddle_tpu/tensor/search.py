@@ -1,0 +1,133 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, apply
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply(lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype)), x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply(lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(convert_dtype(dtype)), x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=True, descending=descending)
+        return idx.astype(jnp.int64)
+    return apply(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply(lambda a: jnp.sort(a, axis=axis, descending=descending), x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    k = int(getattr(k, "item", lambda: k)()) if not isinstance(k, int) else k
+
+    def f(a):
+        ax = axis if axis is not None else a.ndim - 1
+        a_m = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(a_m, k)
+        else:
+            vals, idx = jax.lax.top_k(-a_m, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return apply(f, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(jnp.where, condition, x, y)
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._adopt(out)
+    return x
+
+
+def nonzero(x, as_tuple=False):
+    a = np.asarray(getattr(x, "_data", x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def masked_fill_(x, mask, value, name=None):
+    from .manipulation import masked_fill
+    out = masked_fill(x, mask, value)
+    x._adopt(out)
+    return x
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        srt = jnp.sort(a, axis=ax)
+        idx = jnp.argsort(a, axis=ax, stable=True)
+        vals = jnp.take(srt, k - 1, axis=ax)
+        inds = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals, inds = jnp.expand_dims(vals, ax), jnp.expand_dims(inds, ax)
+        return vals, inds
+    return apply(f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        a_m = jnp.moveaxis(a, ax, -1)
+
+        def one(row):
+            srt = jnp.sort(row)
+            first = jnp.concatenate([jnp.array([True]), srt[1:] != srt[:-1]])
+            grp = jnp.cumsum(first) - 1
+            counts = jnp.zeros(row.shape[0], dtype=jnp.int32).at[grp].add(1)
+            best_grp = jnp.argmax(counts)
+            val = srt[jnp.argmax(grp == best_grp)]
+            idx = row.shape[0] - 1 - jnp.argmax(jnp.flip(row == val))
+            return val, idx.astype(jnp.int64)
+        flat = a_m.reshape(-1, a_m.shape[-1])
+        vals, idxs = jax.vmap(one)(flat)
+        vals = vals.reshape(a_m.shape[:-1])
+        idxs = idxs.reshape(a_m.shape[:-1])
+        if keepdim:
+            vals, idxs = jnp.expand_dims(vals, ax), jnp.expand_dims(idxs, ax)
+        return vals, idxs
+    return apply(f, x)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, i):
+        a_m = jnp.moveaxis(a, axis, 0)
+        out = a_m.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply(f, x, index)

@@ -1,0 +1,49 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+
+def _ax(axis):
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.mean(a, axis=_ax(axis), keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.var(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda a: jnp.std(a, axis=_ax(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_ax(axis), keepdims=keepdim)
+        n = a.shape[axis] if axis is not None else a.size
+        srt = jnp.sort(a.reshape(-1) if axis is None else a, axis=0 if axis is None else axis)
+        return jnp.take(srt, (n - 1) // 2, axis=0 if axis is None else axis)
+    return apply(f, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply(lambda a: jnp.nanmedian(a, axis=_ax(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda a, qq: jnp.quantile(a, jnp.asarray(qq), axis=_ax(axis), keepdims=keepdim,
+                                            method=interpolation), x, q)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply(lambda a, qq: jnp.nanquantile(a, jnp.asarray(qq), axis=_ax(axis),
+                                               keepdims=keepdim, method=interpolation), x, q)

@@ -1,0 +1,29 @@
+"""Test harness config.
+
+- Virtual 8-device CPU mesh (the reference's multi-GPU tests map to this —
+  SURVEY.md §4: xla_force_host_platform_device_count replaces the 2-GPU gate).
+- Highest matmul precision so numpy-oracle comparisons (OpTest-style) are
+  meaningful; production keeps the TPU-default bf16 MXU path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

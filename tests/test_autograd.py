@@ -1,0 +1,101 @@
+"""Autograd engine tests — numeric-gradient oracle in the reference's OpTest
+style (op_test.py:1450 check_grad)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = fn(x)
+        flat[i] = orig - eps
+        lo = fn(x)
+        flat[i] = orig
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+@pytest.mark.parametrize("op,np_op", [
+    (lambda t: (t.exp()).sum(), lambda a: np.exp(a).sum()),
+    (lambda t: (t.tanh()).sum(), lambda a: np.tanh(a).sum()),
+    (lambda t: (t * t + 2 * t).sum(), lambda a: (a * a + 2 * a).sum()),
+    (lambda t: (t.sigmoid()).sum(), lambda a: (1 / (1 + np.exp(-a))).sum()),
+    (lambda t: (t.reshape([6]) ** 2).sum(), lambda a: (a.reshape(6) ** 2).sum()),
+])
+def test_grad_vs_numeric(op, np_op):
+    x = np.random.randn(2, 3).astype("float64")
+    t = paddle.to_tensor(x, stop_gradient=False)
+    op(t).backward()
+    ng = numeric_grad(lambda a: float(np_op(a)), x.copy())
+    np.testing.assert_allclose(t.grad.numpy(), ng, rtol=1e-4, atol=1e-4)
+
+
+def test_matmul_grad():
+    a = np.random.randn(3, 4).astype("float32")
+    b = np.random.randn(4, 5).astype("float32")
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    (ta @ tb).sum().backward()
+    np.testing.assert_allclose(ta.grad.numpy(), np.ones((3, 5)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(tb.grad.numpy(), a.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    t = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    (t * 2).sum().backward()
+    (t * 3).sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [5.0, 5.0])
+    t.clear_grad()
+    assert t.grad is None
+
+
+def test_no_grad():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        u = t * 2
+    assert u.stop_gradient
+
+
+def test_partial_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    z = y * 3
+    (gy,) = paddle.grad(z, [y])
+    np.testing.assert_allclose(gy.numpy(), [3.0])
+    assert x._grad is None  # paddle.grad must not pollute leaf grads
+
+
+def test_inplace_aliasing():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 3
+    c = b + 1
+    b[0] = 0.0
+    (b.sum() + c.sum()).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [3.0, 6.0])
+
+
+def test_diamond():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (y + y).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_hook():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    t.register_hook(lambda g: g * 10)
+    (t * 2).backward()
+    np.testing.assert_allclose(t.grad.numpy(), [20.0])
+
+
+def test_detach_stops_grad():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    u = (t * 2).detach() * 3
+    assert u.stop_gradient

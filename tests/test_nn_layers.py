@@ -1,0 +1,231 @@
+"""nn.Layer system + layer forward/backward tests (reference: unittests
+test_layers.py family)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registry():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 3)
+            self.act = nn.ReLU()
+            self.register_buffer("cnt", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.act(self.fc(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc.weight", "fc.bias"]
+    assert "cnt" in net.state_dict()
+    assert len(net.sublayers()) == 2
+    net.eval()
+    assert not net.fc.training
+    net.train()
+    assert net.fc.training
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_linear_oracle():
+    lin = nn.Linear(4, 3)
+    x = np.random.randn(5, 4).astype("float32")
+    out = lin(paddle.to_tensor(x))
+    expect = x @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_oracle():
+    import torch
+    import torch.nn.functional as tF
+    x = np.random.randn(2, 3, 8, 8).astype("float32")
+    w = np.random.randn(6, 3, 3, 3).astype("float32")
+    b = np.random.randn(6).astype("float32")
+    out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), paddle.to_tensor(b),
+                   stride=2, padding=1)
+    ref = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b), stride=2,
+                    padding=1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_transpose_oracle():
+    import torch
+    import torch.nn.functional as tF
+    x = np.random.randn(2, 4, 5, 5).astype("float32")
+    w = np.random.randn(4, 3, 3, 3).astype("float32")  # (in, out, kh, kw)
+    out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w), stride=2,
+                             padding=1)
+    ref = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                              padding=1).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = np.random.randn(4, 3, 5, 5).astype("float32") * 2 + 1
+    out = bn(paddle.to_tensor(x))
+    # normalized output ~ zero-mean unit-var per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-5
+    assert abs(o.std() - 1) < 1e-2
+    # running stats moved toward batch stats
+    assert abs(bn._mean.numpy().mean() - 0.1 * x.mean(axis=(0, 2, 3)).mean()) < 0.05
+    bn.eval()
+    out2 = bn(paddle.to_tensor(x))
+    assert not np.allclose(out2.numpy(), o)
+
+
+def test_layernorm_oracle():
+    import torch
+    ln = nn.LayerNorm(8)
+    x = np.random.randn(2, 5, 8).astype("float32")
+    out = ln(paddle.to_tensor(x))
+    ref = torch.nn.functional.layer_norm(torch.tensor(x), [8]).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    d = nn.Dropout(0.5)
+    out = d(x)
+    kept = (out.numpy() != 0)
+    assert 0.3 < kept.mean() < 0.7
+    np.testing.assert_allclose(out.numpy()[kept], 2.0)  # upscale_in_train
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(paddle.to_tensor(np.array([[0, 1], [2, 0]])))
+    assert np.allclose(out.numpy()[0, 0], 0)
+    assert np.allclose(out.numpy()[1, 1], 0)
+    assert not np.allclose(out.numpy()[0, 1], 0)
+
+
+def test_mha_against_manual():
+    mha = nn.MultiHeadAttention(8, 2)
+    x = paddle.randn([2, 4, 8])
+    out = mha(x)
+    assert out.shape == [2, 4, 8]
+    q = x.numpy() @ mha.q_proj.weight.numpy() + mha.q_proj.bias.numpy()
+    k = x.numpy() @ mha.k_proj.weight.numpy() + mha.k_proj.bias.numpy()
+    v = x.numpy() @ mha.v_proj.weight.numpy() + mha.v_proj.bias.numpy()
+    B, L, E, H, D = 2, 4, 8, 2, 4
+    q = q.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    k = k.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    v = v.reshape(B, L, H, D).transpose(0, 2, 1, 3)
+    s = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(D)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    o = (p @ v).transpose(0, 2, 1, 3).reshape(B, L, E)
+    ref = o @ mha.out_proj.weight.numpy() + mha.out_proj.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-3, atol=1e-3)
+
+
+def test_lstm_shapes_and_grad():
+    lstm = nn.LSTM(5, 7, num_layers=2, direction="bidirect")
+    x = paddle.randn([3, 6, 5])
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 6, 14]
+    assert h.shape == [4, 3, 7]
+    out.mean().backward()
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_gru_matches_torch():
+    import torch
+    gru = nn.GRU(4, 6)
+    tg = torch.nn.GRU(4, 6, batch_first=True)
+    sd = gru.state_dict()
+    with torch.no_grad():
+        tg.weight_ih_l0.copy_(torch.tensor(sd["weight_ih_l0"].numpy()))
+        tg.weight_hh_l0.copy_(torch.tensor(sd["weight_hh_l0"].numpy()))
+        tg.bias_ih_l0.copy_(torch.tensor(sd["bias_ih_l0"].numpy()))
+        tg.bias_hh_l0.copy_(torch.tensor(sd["bias_hh_l0"].numpy()))
+    x = np.random.randn(2, 5, 4).astype("float32")
+    out, h = gru(paddle.to_tensor(x))
+    tout, th = tg(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    out.mean().backward()
+    grads = [p.grad is not None for p in enc.parameters()]
+    assert all(grads)
+
+
+def test_sequential_containers():
+    seq = nn.Sequential(("a", nn.Linear(2, 3)), ("b", nn.ReLU()))
+    assert isinstance(seq["a"], nn.Linear)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    ld = nn.LayerDict({"x": nn.Linear(2, 2)})
+    assert "x" in ld
+
+
+def test_pool_layers():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, stride=1)(x).shape == [2, 3, 7, 7]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    import torch
+    a = np.random.randn(1, 2, 6, 6).astype("float32")
+    out = F.avg_pool2d(paddle.to_tensor(a), 2)
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(a), 2).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_loss_oracles():
+    import torch
+    logits = np.random.randn(6, 5).astype("float32")
+    labels = np.array([0, 1, 2, 3, 4, 0])
+    out = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+    ref = torch.nn.functional.cross_entropy(torch.tensor(logits),
+                                            torch.tensor(labels)).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    p = np.random.rand(4, 3).astype("float32")
+    y = np.random.rand(4, 3).astype("float32")
+    np.testing.assert_allclose(
+        F.mse_loss(paddle.to_tensor(p), paddle.to_tensor(y)).numpy(),
+        ((p - y) ** 2).mean(), rtol=1e-5)
+    z = np.random.randn(4, 3).astype("float32")
+    yy = (np.random.rand(4, 3) > 0.5).astype("float32")
+    ref_bce = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(z), torch.tensor(yy)).numpy()
+    np.testing.assert_allclose(
+        F.binary_cross_entropy_with_logits(paddle.to_tensor(z),
+                                           paddle.to_tensor(yy)).numpy(),
+        ref_bce, rtol=1e-5, atol=1e-6)
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+    w = I.XavierUniform()([100, 100], "float32")
+    assert abs(np.asarray(w).mean()) < 0.01
+    limit = np.sqrt(6 / 200)
+    assert np.asarray(w).max() <= limit + 1e-6
+    o = I.Orthogonal()([16, 16], "float32")
+    np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(16), atol=1e-4)
+    c = I.Constant(3.0)([4], "float32")
+    np.testing.assert_allclose(np.asarray(c), 3.0)

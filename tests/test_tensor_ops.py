@@ -1,0 +1,167 @@
+"""Tensor-op tests against numpy oracles (reference: unittests/op_test.py
+check_output pattern)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestCreation:
+    def test_basics(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([4]).numpy().sum() == 4
+        np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5))
+        assert paddle.full([2], 7).numpy().tolist() == [7, 7]
+        np.testing.assert_allclose(paddle.eye(3).numpy(), np.eye(3))
+        assert paddle.tril(paddle.ones([3, 3])).numpy()[0, 2] == 0
+        assert paddle.triu(paddle.ones([3, 3])).numpy()[2, 0] == 0
+
+    def test_to_tensor_dtype(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], dtype="float64"))
+        assert x.dtype == paddle.float32  # default dtype conversion
+        y = paddle.to_tensor([1, 2, 3])
+        assert "int" in str(y.dtype)
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(t(a), t(b)).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+        np.testing.assert_allclose((t(a) / t(b)).numpy(), a / b, rtol=1e-5)
+        np.testing.assert_allclose(paddle.exp(t(a)).numpy(), np.exp(a), rtol=1e-5)
+
+    def test_reductions(self):
+        a = np.random.randn(3, 4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(a)).numpy(), a.mean(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(a), axis=[0, 2]).numpy(), a.max((0, 2)))
+        np.testing.assert_allclose(paddle.prod(t(a), axis=-1, keepdim=True).numpy(),
+                                   a.prod(-1, keepdims=True), rtol=1e-4)
+        np.testing.assert_allclose(paddle.logsumexp(t(a), axis=1).numpy(),
+                                   np.log(np.exp(a).sum(1)), rtol=1e-5)
+
+    def test_cumsum_cummax(self):
+        a = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        vals, idx = paddle.cummax(t(a), axis=1)
+        np.testing.assert_allclose(vals.numpy(), np.maximum.accumulate(a, 1))
+
+    def test_clip_scale(self):
+        a = np.random.randn(10).astype("float32")
+        np.testing.assert_allclose(paddle.clip(t(a), -0.5, 0.5).numpy(),
+                                   np.clip(a, -0.5, 0.5))
+        np.testing.assert_allclose(paddle.scale(t(a), 2.0, 1.0).numpy(), a * 2 + 1,
+                                   rtol=1e-6)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype("float32")
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        np.testing.assert_allclose(paddle.transpose(t(a), [2, 0, 1]).numpy(),
+                                   a.transpose(2, 0, 1))
+        assert paddle.flatten(t(a), 1).shape == [2, 12]
+        assert paddle.unsqueeze(t(a), [0, 2]).shape == [1, 2, 1, 3, 4]
+        assert paddle.squeeze(paddle.ones([1, 3, 1])).shape == [3]
+
+    def test_concat_split_stack(self):
+        a = np.random.randn(2, 3).astype("float32")
+        c = paddle.concat([t(a), t(a)], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.split(c, 2, axis=0)
+        np.testing.assert_allclose(s[0].numpy(), a)
+        parts = paddle.split(t(a), [1, -1], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+        st = paddle.stack([t(a), t(a)], axis=1)
+        assert st.shape == [2, 2, 3]
+
+    def test_gather_scatter(self):
+        a = np.arange(12).reshape(4, 3).astype("float32")
+        idx = np.array([0, 2])
+        np.testing.assert_allclose(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+        upd = np.ones((2, 3), "float32") * 9
+        out = paddle.scatter(t(a), t(idx), t(upd))
+        expect = a.copy()
+        expect[idx] = 9
+        np.testing.assert_allclose(out.numpy(), expect)
+
+    def test_pad_tile_flip(self):
+        a = np.random.randn(1, 2, 3, 3).astype("float32")
+        p = paddle.nn.functional.pad(t(a), [1, 1, 2, 2])
+        assert p.shape == [1, 2, 7, 5]
+        np.testing.assert_allclose(paddle.tile(t(np.ones((2,), "float32")), [3]).numpy(),
+                                   np.tile(np.ones(2), 3))
+        np.testing.assert_allclose(paddle.flip(t(a), [3]).numpy(), a[..., ::-1])
+
+    def test_masked_where(self):
+        a = np.random.randn(3, 4).astype("float32")
+        m = a > 0
+        np.testing.assert_allclose(paddle.masked_select(t(a), t(m)).numpy(), a[m])
+        np.testing.assert_allclose(paddle.where(t(m), t(a), t(-a)).numpy(),
+                                   np.where(m, a, -a))
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        a = np.random.randn(4, 6).astype("float32")
+        np.testing.assert_allclose(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        vals, idx = paddle.topk(t(a), 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-a, 1)[:, :3], rtol=1e-6)
+        np.testing.assert_allclose(paddle.sort(t(a), axis=0).numpy(), np.sort(a, 0))
+        np.testing.assert_allclose(paddle.argsort(t(a), axis=1, descending=True).numpy(),
+                                   np.argsort(-a, 1, kind="stable"))
+
+    def test_unique_nonzero(self):
+        a = np.array([3, 1, 2, 1, 3])
+        u = paddle.unique(t(a))
+        np.testing.assert_allclose(u.numpy(), [1, 2, 3])
+        nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+        np.testing.assert_allclose(nz.numpy(), [[1], [3]])
+
+
+class TestLinalg:
+    def test_matmul_family(self):
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5,
+                                   atol=1e-5)
+        np.testing.assert_allclose(paddle.matmul(t(a.T), t(b), transpose_x=True).numpy(),
+                                   a @ b, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_decompositions(self):
+        a = np.random.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        L = paddle.cholesky(t(spd))
+        np.testing.assert_allclose((L @ L.T).numpy(), spd, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(paddle.inv(t(spd)).numpy(), np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(paddle.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+        u, s, vh = paddle.svd(t(a))
+        np.testing.assert_allclose((u @ paddle.diag(s) @ vh).numpy(), a, rtol=1e-3,
+                                   atol=1e-3)
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(5)
+        a = paddle.randn([3, 4])
+        paddle.seed(5)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        u = paddle.uniform([1000], min=0, max=1)
+        assert 0.4 < float(u.mean()) < 0.6
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10)
+        assert sorted(p.numpy().tolist()) == list(range(10))
