@@ -1,0 +1,74 @@
+"""Benchmark entry — prints ONE JSON line.
+
+Measures GPT pretraining throughput (tokens/sec) on the available device
+with the jit-compiled train step (bf16 compute, flash attention, fused
+optimizer in-program).  vs_baseline compares against the A100 tokens/sec/chip
+north-star proxy scaled to this model size (BASELINE.json publishes no
+reference numbers — see BASELINE.md).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    on_tpu = jax.default_backend() != "cpu"
+    # GPT-2 small-ish config sized to fit one v5e chip comfortably in bf16
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_attention_heads=12, max_position_embeddings=1024,
+                        compute_dtype="bfloat16")
+        B, L, iters = 8, 1024, 20
+    else:  # CI / smoke sizing
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=128,
+                        compute_dtype="float32")
+        B, L, iters = 2, 128, 3
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    model = GPTModel(cfg)
+    opt = AdamW(3e-4, weight_decay=0.01)
+    step, state = make_gpt_train_step(model, opt, hcg, remat=on_tpu)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+
+    # warmup / compile
+    state, loss = step(state, jax.random.key(0), np.float32(3e-4), x, y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, loss = step(state, jax.random.key(i + 1), np.float32(3e-4), x, y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * L * iters / dt
+    # A100 proxy for GPT-2-small-class training ≈ 150k tokens/s/chip (public
+    # megatron-class numbers); vs_baseline = ours / proxy.
+    baseline_proxy = 150_000.0 if on_tpu else tokens_per_sec
+    print(json.dumps({
+        "metric": "gpt2s_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / baseline_proxy, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

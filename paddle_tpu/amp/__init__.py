@@ -1,0 +1,292 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast:21, decorate:79, GradScaler
+grad_scaler.py:26) + imperative/amp_auto_cast.cc white/black lists.
+
+TPU-first: bf16 is the default mixed dtype (no loss scaling strictly needed —
+bf16 has fp32's exponent range), but the fp16 GradScaler semantics
+(found_inf, dynamic scaling) are implemented for parity and for fp16 use.
+O1 = white-listed ops (matmul/conv family) compute in low precision; O2 =
+whole model cast with fp32 master weights in the optimizer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+
+# reference white/black lists (imperative/amp_auto_cast.cc / fp16_lists.py)
+WHITE_LIST = {"conv2d", "matmul", "matmul_v2", "mul", "einsum", "linear", "conv1d",
+              "conv3d", "attention"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+              "cross_entropy", "layer_norm", "batch_norm", "reduce_sum", "erf"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.white = set(WHITE_LIST)
+        self.black = set(BLACK_LIST)
+        self.version = 0  # bumped on every state change (snapshot cache key)
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def amp_enabled() -> bool:
+    return _state.enabled
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def cast_if_amp(*arrays):
+    """White-list op entry: cast float inputs to the amp dtype when active."""
+    if not _state.enabled:
+        return arrays
+    dt = _state.dtype
+    out = []
+    for a in arrays:
+        if a is not None and hasattr(a, "dtype") and \
+                jnp.issubdtype(a.dtype, jnp.floating) and a.dtype != dt:
+            out.append(a.astype(dt))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+def blacklist_cast(*arrays):
+    """Black-list op entry: promote low-precision floats back to fp32."""
+    if not _state.enabled:
+        return arrays
+    out = []
+    for a in arrays:
+        if a is not None and hasattr(a, "dtype") and a.dtype in (jnp.float16,
+                                                                 jnp.bfloat16):
+            out.append(a.astype(jnp.float32))
+        else:
+            out.append(a)
+    return tuple(out)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None, custom_black_list=None,
+              level: str = "O1", dtype: str = "bfloat16"):
+    """``paddle.amp.auto_cast`` parity."""
+    prev = (_state.enabled, _state.dtype, _state.level, _state.white, _state.black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.white = (set(WHITE_LIST) | set(custom_white_list or ())) - \
+        set(custom_black_list or ())
+    _state.black = (set(BLACK_LIST) | set(custom_black_list or ())) - \
+        set(custom_white_list or ())
+    _state.version += 1
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level, _state.white,
+         _state.black) = prev
+        _state.version += 1
+
+
+amp_guard = auto_cast
+
+
+_capture_cache = {}
+
+
+def capture_autocast():
+    """Snapshot the current autocast state as a re-enterable context factory
+    (used by the autograd tape so backward replay matches the forward).
+    Cached per state version — recording N ops under one auto_cast block
+    reuses a single factory."""
+    ver = _state.version
+    cached = _capture_cache.get(ver)
+    if cached is not None:
+        return cached
+    enabled, dt, level = _state.enabled, _state.dtype, _state.level
+    white, black = frozenset(_state.white), frozenset(_state.black)
+
+    @contextlib.contextmanager
+    def ctx():
+        prev = (_state.enabled, _state.dtype, _state.level, _state.white,
+                _state.black)
+        _state.enabled, _state.dtype, _state.level = enabled, dt, level
+        _state.white, _state.black = set(white), set(black)
+        _state.version += 1
+        try:
+            yield
+        finally:
+            (_state.enabled, _state.dtype, _state.level, _state.white,
+             _state.black) = prev
+            _state.version += 1
+
+    _capture_cache.clear()
+    _capture_cache[ver] = ctx
+    return ctx
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """``paddle.amp.decorate`` parity — O2 casts model params to low precision
+    (the functional optimizer keeps fp32 master copies via multi_precision)."""
+    dt = convert_dtype(dtype)
+    models_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        for m in models_list:
+            m.astype(dt)
+            # keep norms in fp32 (reference keeps bn/ln fp32 in pure-fp16 mode)
+            from ..nn.layer.norm import _BatchNormBase, LayerNorm
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, (_BatchNormBase, LayerNorm)):
+                    sub._convert_dtype(jnp.float32)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: grad_scaler.py:26 + fluid/dygraph/amp
+    AmpScaler; kernels amp/check_finite_and_unscale_op, update_loss_scaling_op)."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._already_unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._already_unscaled:
+            return
+        self._already_unscaled = True
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p._grad is not None:
+                g = p._grad.astype(jnp.float32) * inv
+                if not bool(jnp.isfinite(g).all()):
+                    found = True
+                p._grad = g.astype(p._grad.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        """Unscale (if not already) and apply the optimizer step unless a
+        non-finite gradient was found.  Like the reference, ``update()`` is a
+        separate call (minimize() chains both)."""
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        self._already_unscaled = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # ------------------------------------------------------- functional form
+    def init_state(self):
+        return {"scale": jnp.asarray(self._scale, jnp.float32),
+                "good": jnp.zeros([], jnp.int32), "bad": jnp.zeros([], jnp.int32)}
+
+    def functional_update(self, state, grads):
+        """Pure: unscale grads, compute found_inf, new scaler state.
+
+        Returns (unscaled_grads, found_inf, new_state) — usable inside jit
+        (≙ check_finite_and_unscale + update_loss_scaling ops fused into the
+        step program)."""
+        inv = 1.0 / state["scale"]
+        unscaled = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        finite = jax.tree_util.tree_reduce(
+            lambda acc, g: acc & jnp.isfinite(g).all(), unscaled,
+            jnp.asarray(True))
+        found_inf = ~finite
+        good = jnp.where(found_inf, 0, state["good"] + 1)
+        bad = jnp.where(found_inf, state["bad"] + 1, 0)
+        scale = state["scale"]
+        scale = jnp.where(bad >= self._decr_every_n,
+                          jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        bad = jnp.where(bad >= self._decr_every_n, 0, bad)
+        scale = jnp.where(good >= self._incr_every_n_steps,
+                          scale * self._incr_ratio, scale)
+        good = jnp.where(good >= self._incr_every_n_steps, 0, good)
+        return unscaled, found_inf, {"scale": scale, "good": good, "bad": bad}
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return jax.default_backend() != "cpu"

@@ -74,14 +74,19 @@ def set_grad_enabled(mode: bool):
 class Node:
     """One recorded eager op: reconstructable pure function + inputs."""
 
-    __slots__ = ("rebuild", "diff_inputs", "out_refs", "name", "__weakref__")
+    __slots__ = ("rebuild", "diff_inputs", "out_refs", "name", "ctx_factory",
+                 "__weakref__")
 
-    def __init__(self, rebuild: Callable, diff_inputs: Sequence, name: str = "op"):
+    def __init__(self, rebuild: Callable, diff_inputs: Sequence, name: str = "op",
+                 ctx_factory: Optional[Callable] = None):
         # rebuild(*input_datas) -> tuple of differentiable raw outputs
         self.rebuild = rebuild
         self.diff_inputs = list(diff_inputs)  # Tensors we differentiate w.r.t.
         self.out_refs: List[weakref.ref] = []  # weakrefs to output Tensors
         self.name = name
+        # re-installs ambient dispatch state (e.g. amp autocast) so backward's
+        # vjp replay reproduces the recorded forward exactly
+        self.ctx_factory = ctx_factory
 
     def add_output(self, tensor) -> int:
         self.out_refs.append(weakref.ref(tensor))
@@ -145,7 +150,10 @@ def backward(tensor, grad=None, retain_graph: bool = False, capture=None,
         if not any_ct:
             continue
         primals = [t._data for t in node.diff_inputs]
-        raw_outs, vjp_fn = jax.vjp(node.rebuild, *primals)
+        ctx = node.ctx_factory() if node.ctx_factory is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            raw_outs, vjp_fn = jax.vjp(node.rebuild, *primals)
         filled = tuple(
             ct if ct is not None else jnp.zeros_like(ro)
             for ct, ro in zip(out_cots, raw_outs))

@@ -348,6 +348,29 @@ def apply(fn: Callable, *args, name: Optional[str] = None, **kwargs) -> Any:
     raw_leaves = [_unwrap(l) for l in leaves]
     traced = any(isinstance(raw_leaves[i], jax.core.Tracer) for i in tensor_positions)
 
+    # AMP list-driven dispatch (reference amp_auto_cast.cc white/black lists):
+    # white ops cast float inputs to the amp dtype, black ops promote 16-bit
+    # floats to fp32.  The cast map is baked into the node's rebuild so
+    # backward replay is dtype-identical regardless of ambient state.
+    import sys as _sys
+    amp_cast_map = {}
+    amp_mod = _sys.modules.get("paddle_tpu.amp")
+    if amp_mod is not None and amp_mod.amp_enabled():
+        _st = amp_mod.amp_state()
+        _opname = (name or getattr(fn, "__name__", "")).lower()
+        if _opname in _st.white:
+            for i in tensor_positions:
+                rl = raw_leaves[i]
+                if jnp.issubdtype(rl.dtype, jnp.floating) and rl.dtype != _st.dtype:
+                    amp_cast_map[i] = _st.dtype
+        elif _opname in _st.black:
+            for i in tensor_positions:
+                rl = raw_leaves[i]
+                if rl.dtype in (jnp.float16, jnp.bfloat16):
+                    amp_cast_map[i] = jnp.float32
+        for i, dt in amp_cast_map.items():
+            raw_leaves[i] = raw_leaves[i].astype(dt)
+
     diff_positions = [
         i for i in tensor_positions
         if not leaves[i].stop_gradient and jnp.issubdtype(raw_leaves[i].dtype, jnp.floating)
@@ -384,13 +407,20 @@ def apply(fn: Callable, *args, name: Optional[str] = None, **kwargs) -> Any:
         def rebuild(*primals):
             cl = list(const_leaves)
             for pos, p in zip(diff_positions, primals):
-                cl[pos] = p
+                cl[pos] = p if pos not in amp_cast_map \
+                    else p.astype(amp_cast_map[pos])
             a, k = jax.tree_util.tree_unflatten(treedef, cl)
             o = fn(*a, **k)
             ols = jax.tree_util.tree_leaves(o)
             return tuple(ols[i] for i in diff_out_positions)
 
-        node = Node(rebuild, diff_tensors, name=name or getattr(fn, "__name__", "op"))
+        ctx_factory = None
+        if amp_mod is not None:
+            # snapshot even when amp is OFF — backward may run inside a later
+            # auto_cast block and must replay with the recorded (off) state
+            ctx_factory = amp_mod.capture_autocast()
+        node = Node(rebuild, diff_tensors, name=name or getattr(fn, "__name__", "op"),
+                    ctx_factory=ctx_factory)
         import weakref as _weakref
         nref = _weakref.ref(node)
         for t in diff_tensors:

@@ -1,0 +1,29 @@
+"""``paddle_tpu.distributed`` (reference: python/paddle/distributed/)."""
+
+from . import env, fleet  # noqa: F401
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa: F401
+                         all_reduce, alltoall, alltoall_single, barrier, broadcast,
+                         broadcast_object_list, destroy_process_group, get_group,
+                         irecv, is_initialized, isend, new_group, recv, reduce,
+                         reduce_scatter, scatter, send, split, wait)
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
+from .fleet.meta_parallel import DataParallel  # noqa: F401
+from .spmd import make_spmd_train_step, shard_batch  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference: distributed/spawn.py — multiprocess notebook launcher.
+    On TPU single-process SPMD covers local devices; true multi-host uses
+    ``paddle_tpu.distributed.launch``.  Runs ``func`` in-process when
+    nprocs<=1 (device parallelism comes from the mesh)."""
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return None
+    raise NotImplementedError(
+        "multi-process spawn on one host is not applicable to TPU SPMD; "
+        "use paddle_tpu.distributed.launch for multi-host")
+
+
+def get_backend():
+    return "xla"

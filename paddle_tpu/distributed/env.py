@@ -1,0 +1,74 @@
+"""Distributed environment (reference: python/paddle/distributed/parallel.py
+init_parallel_env:71 — PADDLE_TRAINER_ID/TRAINERS_NUM env contract, mapped to
+``jax.distributed`` + process metadata)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(backend: Optional[str] = None):
+    """``paddle.distributed.init_parallel_env`` parity.
+
+    Multi-host: uses jax.distributed (coordinator = PADDLE_MASTER or first
+    entry of PADDLE_TRAINER_ENDPOINTS, ≙ gen_comm_id_helper.cc TCP
+    rendezvous).  Single-process multi-device needs no init — XLA owns the
+    devices already.
+    """
+    global _initialized
+    if _initialized:
+        return
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world > 1 and jax.process_count() == 1:
+        coord = os.environ.get("PADDLE_MASTER")
+        if coord is None:
+            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+            coord = eps.split(",")[0] if eps else None
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(coordinator_address=coord, num_processes=world,
+                                   process_id=rank)
+    _initialized = True
+
+
+def get_rank() -> int:
+    """``paddle.distributed.get_rank`` parity."""
+    env = os.environ.get("PADDLE_TRAINER_ID")
+    if env is not None:
+        return int(env)
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    """``paddle.distributed.get_world_size`` parity."""
+    env = os.environ.get("PADDLE_TRAINERS_NUM")
+    if env is not None:
+        return int(env)
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+class ParallelEnv:
+    """Reference: fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return int(os.environ.get("FLAGS_selected_tpus", "0").split(",")[0])
+
+    local_rank = rank
+    nranks = world_size

@@ -1,0 +1,136 @@
+"""``paddle_tpu.distributed.fleet`` (reference: fleet/base/fleet_base.py —
+Fleet:170 init, distributed_optimizer:829, distributed_model:882).
+
+The Fleet singleton wires: DistributedStrategy → HybridCommunicateGroup
+(mesh) → SPMD step builders.  Meta-optimizer selection/program-rewrite
+(fleet_base.py:1432 + strategy_compiler.py) is replaced by sharding rules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.tensor import Tensor
+from ..topology import (CommunicateTopology, HybridCommunicateGroup,
+                        get_hybrid_communicate_group,
+                        set_hybrid_communicate_group)
+from .base.distributed_strategy import DistributedStrategy
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (DataParallel, PipelineParallel, ShardingParallel,  # noqa: F401
+                            TensorParallel)
+
+
+class _RoleMaker:
+    """Reference: fleet/base/role_maker.py PaddleCloudRoleMaker:515."""
+
+    def __init__(self, is_collective=True):
+        self._is_collective = is_collective
+
+    def _worker_num(self):
+        from .. import env
+        return env.get_world_size()
+
+    def _worker_index(self):
+        from .. import env
+        return env.get_rank()
+
+    def _is_worker(self):
+        return True
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._role_maker = None
+        self._user_defined_optimizer = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective: bool = True, strategy=None,
+             log_level="INFO"):
+        from .. import env
+        env.init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        self._role_maker = role_maker or _RoleMaker(is_collective)
+        hc = self._strategy.hybrid_configs
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
+            pp_degree=hc.get("pp_degree", 1),
+            sharding_degree=hc.get("sharding_degree", 1),
+            sep_degree=hc.get("sep_degree", 1))
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    # ------------------------------------------------------------- topology
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg
+
+    def worker_num(self):
+        return self._role_maker._worker_num()
+
+    def worker_index(self):
+        return self._role_maker._worker_index()
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def barrier_worker(self):
+        from ..collective import barrier
+        barrier()
+
+    # ------------------------------------------------------------ wrapping
+    def distributed_model(self, model):
+        """Reference fleet_base.py:882 — wrap by parallel mode."""
+        mode = self._hcg.get_parallel_mode()
+        from .meta_parallel.parallel_layers.pp_layers import PipelineLayer
+        if isinstance(model, PipelineLayer) or mode == "PipelineParallel":
+            return PipelineParallel(model, self._hcg, self._strategy)
+        if mode == "TensorParallel":
+            return TensorParallel(model, self._hcg, self._strategy)
+        if mode == "ShardingParallel":
+            return ShardingParallel(model, self._hcg, self._strategy)
+        if mode == "DataParallel":
+            return DataParallel(model, self._strategy)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Reference fleet_base.py:829 — returns a HybridParallelOptimizer
+        facade; sharding/clip behavior is applied inside the SPMD step."""
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        from .meta_optimizers.hybrid_optimizer import HybridParallelOptimizer
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    def distributed_scaler(self, scaler):
+        return scaler
+
+    # ------------------------------------------------------- train builders
+    def distributed_train_step(self, layer, loss_fn, optimizer):
+        """TPU-native: build the jit hybrid step for (layer, loss, opt)."""
+        from ..spmd import make_spmd_train_step
+        zero = 0
+        if self._strategy.sharding:
+            zero = int(self._strategy.sharding_configs.get("stage", 1))
+        acc = int(self._strategy.pipeline_configs.get("accumulate_steps", 1)) \
+            if self._strategy.pipeline else 1
+        inner = getattr(optimizer, "_inner_opt", optimizer)
+        return make_spmd_train_step(layer, loss_fn, inner, self._hcg,
+                                    zero_stage=zero, accumulate_steps=acc)
+
+
+fleet = Fleet()
+
+# module-level API (paddle.distributed.fleet.init style)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+distributed_scaler = fleet.distributed_scaler
+distributed_train_step = fleet.distributed_train_step
+get_hybrid_communicate_group = lambda: fleet._hcg or get_hybrid_communicate_group()  # noqa: E731
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+
+PaddleCloudRoleMaker = _RoleMaker
+UserDefinedRoleMaker = _RoleMaker

@@ -1,0 +1,1 @@
+from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401

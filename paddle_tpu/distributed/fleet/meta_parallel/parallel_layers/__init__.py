@@ -1,0 +1,4 @@
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import LayerDesc, PipelineLayer, SegmentLayers, SharedLayerDesc  # noqa: F401
+from .random import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

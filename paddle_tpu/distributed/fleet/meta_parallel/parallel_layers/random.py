@@ -1,0 +1,29 @@
+"""Model-parallel RNG trackers (reference: parallel_layers/random.py —
+local vs global seed streams so dropout differs across TP ranks)."""
+
+from __future__ import annotations
+
+from .....core.rng import RNGSequenceTracker, get_rng_state_tracker as _core_tracker
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+RNGStatesTracker = RNGSequenceTracker
+
+
+def get_rng_state_tracker() -> RNGSequenceTracker:
+    return _core_tracker()
+
+
+def model_parallel_random_seed(seed: int = None):
+    import random as pyrandom
+    from .... import env
+    rank = env.get_rank()
+    if seed is None:
+        seed = pyrandom.randint(0, 100000)
+    global_seed = seed
+    local_seed = seed + 1024 + rank
+    tracker = get_rng_state_tracker()
+    tracker.seeds.pop(MODEL_PARALLEL_RNG, None)
+    tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    from .....core import rng as core_rng
+    core_rng.seed(global_seed)

@@ -1,0 +1,49 @@
+"""fleet.utils (reference: fleet/utils/ — recompute.py:63, hybrid_parallel_util.py)."""
+
+from __future__ import annotations
+
+import jax
+
+from ....core import rng
+from ....core.tensor import Tensor, apply
+
+
+def recompute(function, *args, **kwargs):
+    """Activation recomputation (reference fleet/utils/recompute.py:63
+    RecomputeFunction PyLayer with RNG-state replay).
+
+    TPU-native: ``jax.checkpoint`` — XLA rematerializes the segment in
+    backward; RNG replay is automatic because draws derive from the traced
+    scope key.  In eager mode the tape already recomputes forward per-node
+    vjp, so this is the identity there."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    leaves = jax.tree_util.tree_leaves(list(args), is_leaf=lambda x: isinstance(x, Tensor))
+    traced = any(isinstance(getattr(l, "_data", l), jax.core.Tracer) for l in leaves)
+    if traced:
+        def pure(*raw):
+            from ....jit.functional import wrap_tree, unwrap_tree
+            return unwrap_tree(function(*wrap_tree(list(raw)), **kwargs))
+        from ....jit.functional import unwrap_tree, wrap_tree
+        out = jax.checkpoint(pure)(*unwrap_tree(list(args)))
+        return wrap_tree(out)
+    return function(*args, **kwargs)
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference fleet/utils/hybrid_parallel_util.py:117 — DP grad fusion +
+    allreduce.  On TPU, DP gradients are reduced by GSPMD (batch sharded on
+    the "data" axis); eager single-process is a no-op.  Kept for API parity."""
+    return None
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None
+
+
+def broadcast_dp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None

@@ -1,0 +1,249 @@
+"""Hybrid-parallel topology.
+
+Reference: python/paddle/distributed/fleet/base/topology.py —
+CommunicateTopology:36 (N-D rank coordinate math) + HybridCommunicateGroup:117
+(comm groups per axis, fixed nesting order dp→pp→sharding→mp).
+
+TPU-native: the topology IS a ``jax.sharding.Mesh``.  Instead of building an
+NCCL ring per axis, we build ONE device mesh whose named axes are the
+parallelism dimensions; every "communication group" of the reference maps to
+a mesh axis name that XLA collectives reference.  The nesting order is kept
+(outermost varies slowest) so rank→coordinate math matches the reference's
+checkpoint layouts.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# canonical axis order, outer → inner (reference topology.py:361
+# ["data", "pipe", "sharding", "sep", "model"])
+HYBRID_AXES = ("data", "pipe", "sharding", "sep", "model")
+
+
+class CommunicateTopology:
+    """Pure coordinate math over an N-D rank grid (no devices needed)."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = ("data", "pipe",
+                                                            "sharding", "model"),
+                 dims: Sequence[int] = (1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(int(d) for d in dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in enumerate(self.coordinate) if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along ``axis_name`` (ranks varying only that coord)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [self._parallel_names[i] for i in range(len(self._parallel_names))
+                 if i != axis]
+        groups = []
+        for combo in itertools.product(*(range(self._dims[i])
+                                         for i in range(len(self._dims)) if i != axis)):
+            fixed = dict(zip(other, combo))
+            group = []
+            for v in range(self._dims[axis]):
+                fixed[axis_name] = v
+                group.append(self.get_rank(**fixed))
+            groups.append(group)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for name, v in kwargs.items():
+            coord[self._parallel_names.index(name)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Reference HybridCommunicateGroup, re-expressed over a jax Mesh.
+
+    Group handles become (mesh, axis_name) pairs; `get_*_parallel_group()`
+    returns a lightweight Group object whose `.name` is the mesh axis —
+    usable directly in shard_map / psum.
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+                 sharding_degree: int = 1, sep_degree: int = 1,
+                 devices: Optional[Sequence] = None, order: Sequence[str] = None):
+        if topology is not None:
+            self._topo = topology
+            dims = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
+            dp_degree = dims.get("data", 1)
+            pp_degree = dims.get("pipe", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sep_degree = dims.get("sep", 1)
+            mp_degree = dims.get("model", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        names = list(order) if order else list(HYBRID_AXES)
+        degrees = {"data": dp_degree, "pipe": pp_degree, "sharding": sharding_degree,
+                   "sep": sep_degree, "model": mp_degree}
+        self._axis_names = [n for n in names if degrees.get(n, 1) >= 1]
+        self._dims = [degrees.get(n, 1) for n in self._axis_names]
+        if topology is None:
+            self._topo = CommunicateTopology(self._axis_names, self._dims)
+        self.nranks = int(np.prod(self._dims))
+        self._devices = list(devices) if devices is not None else None
+        self._mesh: Optional[Mesh] = None
+        from . import env
+        self.global_rank = env.get_rank() if self.nranks > 1 else 0
+
+    # ----------------------------------------------------------- mesh build
+    def build_mesh(self, devices: Optional[Sequence] = None) -> Mesh:
+        """Construct the jax Mesh (≙ _init_hybrid_parallel_env building all
+        NCCL rings at once).  Mesh axes in nesting order; pod-slice-aware
+        device ordering can be injected via ``devices``."""
+        if self._mesh is not None and devices is None:
+            return self._mesh
+        devs = list(devices if devices is not None
+                    else (self._devices or jax.devices()))
+        if len(devs) < self.nranks:
+            raise ValueError(f"need {self.nranks} devices, have {len(devs)}")
+        arr = np.array(devs[: self.nranks]).reshape(self._dims)
+        self._mesh = Mesh(arr, tuple(self._axis_names))
+        return self._mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        return self.build_mesh()
+
+    def axis_name(self, logical: str) -> str:
+        return {"dp": "data", "pp": "pipe", "sharding": "sharding",
+                "sep": "sep", "mp": "model"}.get(logical, logical)
+
+    # ------------------------------------------------- reference API surface
+    def get_hybrid_group_names(self):
+        return self._axis_names
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def _axis_rank(self, name: str) -> int:
+        if name not in self._axis_names or self.nranks == 1:
+            return 0
+        coord = self._topo.get_coord(self.global_rank)
+        return coord[self._axis_names.index(name)]
+
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("data")
+
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("model")
+
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pipe")
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._axis_rank("sep")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def _group(self, name: str):
+        from .collective import Group
+        ranks = self._topo.get_axis_list(name, 0) if name in self._axis_names else [0]
+        return Group(ranks=list(range(self._topo.get_dim(name)
+                                      if name in self._axis_names else 1)),
+                     axis_name=name, hcg=self)
+
+    def get_data_parallel_group(self):
+        return self._group("data")
+
+    def get_model_parallel_group(self):
+        return self._group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._group("model")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1 and self._dp_degree > 1:
+            return "DataParallel"
+        if self._sharding_degree > 1 and self._mp_degree == 1 and self._pp_degree == 1:
+            return "ShardingParallel"
+        if self._pp_degree > 1:
+            return "PipelineParallel"
+        if self._mp_degree > 1:
+            return "TensorParallel"
+        return "Serial"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

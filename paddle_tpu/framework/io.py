@@ -1,0 +1,69 @@
+"""Checkpoint I/O (reference: python/paddle/framework/io.py:553 save, :769
+load — pickle state_dicts with .pdparams/.pdopt convention; >4GB handled by
+pickle protocol 4)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient, "name": obj.name,
+                "is_param": isinstance(obj, Parameter)}
+    if hasattr(obj, "shape") and hasattr(obj, "dtype") and not isinstance(obj, np.ndarray):
+        return {"__tensor__": True, "data": np.asarray(obj), "stop_gradient": True,
+                "name": None, "is_param": False}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            data = obj["data"]
+            if return_numpy:
+                return data
+            cls = Parameter if obj.get("is_param") else Tensor
+            if cls is Parameter:
+                t = Parameter(data, name=obj.get("name"))
+            else:
+                t = Tensor(data, stop_gradient=obj.get("stop_gradient", True),
+                           name=obj.get("name"))
+            return t
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
+    """``paddle.save`` parity."""
+    if protocol < 2 or protocol > 5:
+        raise ValueError("protocol must be in [2, 5]")
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    payload = _to_serializable(obj)
+    with open(path, "wb") as f:
+        pickle.dump(payload, f, protocol=protocol)
+
+
+def load(path: str, **configs) -> Any:
+    """``paddle.load`` parity."""
+    if not os.path.exists(path):
+        raise ValueError(f"path {path} does not exist")
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    return _from_serializable(payload, return_numpy=configs.get("return_numpy", False))

@@ -1,0 +1,233 @@
+"""hapi Model (reference: python/paddle/hapi/model.py:906 — fit:1556,
+evaluate:1786, predict:1889).
+
+TPU-native: there is ONE adapter, not two (Dynamic/StaticGraphAdapter in the
+reference) — the jit-compiled functional train step serves both roles.  The
+step program (fwd+bwd+optimizer) is compiled once per input shape and state
+flows through a donated pytree, so steady-state training has zero Python
+per-op overhead.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from ..core import rng
+from ..core.tensor import Tensor
+from ..jit.functional import (make_eval_step, make_train_step, sync_state_to_layer,
+                              unwrap_tree)
+from .callbacks import config_callbacks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_step = None
+        self._state = None
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        metrics = metrics or []
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        self._amp_configs = amp_configs
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            self._train_step, self._state = make_train_step(
+                self.network, self._loss, self._optimizer)
+
+    def _ensure_eval_step(self):
+        if self._eval_step is None:
+            self._eval_step = make_eval_step(self.network, self._loss)
+
+    # ---------------------------------------------------------------- steps
+    def train_batch(self, inputs, labels=None, update=True):
+        self._ensure_train_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        raw_in = unwrap_tree(list(inputs))
+        raw_lab = unwrap_tree(list(labels)) if labels is not None else []
+        key = rng.next_key()
+        lr = np.float32(self._optimizer.get_lr())
+        self._state, (loss, out) = self._train_step(self._state, key, lr, raw_in, raw_lab)
+        self._optimizer._step_count += 1
+        for m in self._metrics:
+            m.update(m.compute(Tensor(out), *[Tensor(l) for l in raw_lab]),
+                     *[Tensor(l) for l in raw_lab])
+        return [float(np.asarray(loss))]
+
+    def eval_batch(self, inputs, labels=None):
+        self._ensure_eval_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
+        raw_in = unwrap_tree(list(inputs))
+        raw_lab = unwrap_tree(list(labels)) if labels is not None else None
+        if self._state is None:
+            params, buffers = self.network.raw_state()
+            state = {"params": params, "buffers": buffers}
+        else:
+            state = self._state
+        out, loss = self._eval_step(state["params"], state["buffers"], raw_in, raw_lab)
+        return out, (None if loss is None else float(np.asarray(loss)))
+
+    def predict_batch(self, inputs):
+        out, _ = self.eval_batch(inputs)
+        return [np.asarray(out)]
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        eval_loader = None
+        if eval_data is not None:
+            eval_loader = DataLoader(eval_data, batch_size=batch_size) \
+                if isinstance(eval_data, Dataset) else eval_data
+
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
+                                epochs=epochs, steps=steps, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics_name())
+        self.stop_training = False
+        cbks.on_begin("train")
+        it = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs = {"loss": loss[0]}
+                for m in self._metrics:
+                    logs[self._m_name(m)] = m.accumulate()
+                logs["lr"] = self._optimizer.get_lr()
+                cbks.on_batch_end("train", step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=verbose,
+                                          callbacks=cbks)
+                cbks._call("on_eval_end", eval_logs)
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+        self._sync_back()
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(eval_data, batch_size=batch_size) \
+            if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            inputs, labels = self._split_batch(batch)
+            out, loss = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(loss)
+            for m in self._metrics:
+                raw_lab = [getattr(l, "_data", l) for l in (labels or [])]
+                m.update(m.compute(Tensor(out), *[Tensor(l) for l in raw_lab]),
+                         *[Tensor(l) for l in raw_lab])
+        logs = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[self._m_name(m)] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        loader = DataLoader(test_data, batch_size=batch_size) \
+            if isinstance(test_data, Dataset) else test_data
+        outputs = []
+        for batch in loader:
+            inputs, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(inputs)[0])
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # --------------------------------------------------------------- helpers
+    def _split_batch(self, batch):
+        if isinstance(batch, (list, tuple)):
+            n_in = len(self._inputs) if self._inputs else 1
+            inputs = list(batch[:n_in])
+            labels = list(batch[n_in:]) or None
+            return inputs, labels
+        return [batch], None
+
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            names.append(self._m_name(m))
+        return names
+
+    def _m_name(self, m):
+        n = m.name()
+        return n if isinstance(n, str) else n[0]
+
+    def _sync_back(self):
+        if self._state is not None:
+            sync_state_to_layer(self.network, self._state)
+
+    # ----------------------------------------------------------------- io
+    def save(self, path, training=True):
+        self._sync_back()
+        from ..framework import io as fio
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+        state = fio.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(opt_path):
+            self._optimizer.set_state_dict(fio.load(opt_path))
+        # invalidate compiled state so new weights take effect
+        self._train_step = None
+        self._state = None
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+        return summary(self.network, input_size, dtype)

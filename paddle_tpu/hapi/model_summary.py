@@ -1,0 +1,37 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.dtype import get_default_dtype
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total_params = 0
+    trainable = 0
+    for name, layer in net.named_sublayers(include_self=True):
+        n_params = sum(int(np.prod(p.shape)) for p in layer._parameters.values()
+                       if p is not None)
+        if not n_params and layer._sub_layers:
+            continue
+        total = sum(int(np.prod(p.shape)) for _, p in layer.named_parameters())
+        rows.append((name or layer.__class__.__name__, layer.__class__.__name__,
+                     n_params))
+    for _, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total_params += n
+        if p.trainable:
+            trainable += n
+    width = max([len(r[0]) for r in rows] + [10])
+    lines = [f"{'Layer':<{width}}  {'Type':<24}  Params"]
+    lines.append("-" * (width + 34))
+    for name, typ, n in rows:
+        lines.append(f"{name:<{width}}  {typ:<24}  {n:,}")
+    lines.append("-" * (width + 34))
+    lines.append(f"Total params: {total_params:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total_params - trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total_params, "trainable_params": trainable}

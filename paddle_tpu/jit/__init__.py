@@ -1,0 +1,182 @@
+"""``paddle_tpu.jit`` — tracing, export and the dy2static replacement.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ (@to_static AST
+transpiler), jit.save/load (TranslatedLayer).  Here: @to_static = jax.jit
+over the functionalized layer; jit.save exports a StableHLO artifact via
+``jax.export`` (the serialized-program analog of ``__model__`` ProgramDesc).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor
+from .functional import (functionalize, make_eval_step, make_train_step,  # noqa: F401
+                         sync_state_to_layer, unwrap_tree, wrap_tree)
+
+
+class InputSpec:
+    """Reference: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def to_shape_dtype(self, batch_size=1):
+        shape = [batch_size if (s is None or s == -1) else s for s in self.shape]
+        return jax.ShapeDtypeStruct(tuple(shape), self.dtype)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class StaticFunction:
+    """A layer/function wrapped for traced execution (≙ program_translator.py
+    StaticFunction)."""
+
+    def __init__(self, fn_or_layer, input_spec: Optional[Sequence[InputSpec]] = None):
+        from ..nn import Layer
+        self._input_spec = list(input_spec) if input_spec else None
+        if isinstance(fn_or_layer, Layer):
+            self._layer = fn_or_layer
+            self._apply_fn, _, _ = functionalize(fn_or_layer)
+
+            def run(*args, **kwargs):
+                params, buffers = self._layer.raw_state()
+                out, _ = self._jitted(params, buffers, *unwrap_tree(list(args)),
+                                      **unwrap_tree(kwargs))
+                return wrap_tree(out)
+
+            self._jitted = jax.jit(
+                lambda p, b, *a, **k: self._apply_fn(p, b, *a, training=False, **k))
+            self._call = run
+        else:
+            self._layer = None
+            fn = fn_or_layer
+
+            def pure(*args, **kwargs):
+                return unwrap_tree(fn(*wrap_tree(list(args)), **wrap_tree(kwargs)))
+
+            self._jitted = jax.jit(pure)
+            self._call = lambda *a, **k: wrap_tree(self._jitted(*unwrap_tree(list(a)),
+                                                                **unwrap_tree(k)))
+
+    def __call__(self, *args, **kwargs):
+        return self._call(*args, **kwargs)
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """``@paddle.jit.to_static`` parity."""
+    def decorate(fn):
+        return StaticFunction(fn, input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs) -> None:
+    """``paddle.jit.save`` — serialize a StableHLO program + weights.
+
+    Artifact layout (≙ __model__ + params of save_inference_model io.cc):
+      path + ".pdmodel"  — serialized StableHLO (jax.export bytes)
+      path + ".pdiparams" — pickled weights/buffers
+      path + ".pdmeta"   — input specs & structure info
+    """
+    from ..nn import Layer
+    from jax import export as jax_export
+
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer")
+    apply_fn, params, buffers = functionalize(layer)
+    if input_spec is None:
+        spec = getattr(layer, "_input_spec", None)
+        if spec is None:
+            raise ValueError("input_spec is required (layer has no recorded spec)")
+        input_spec = spec
+    shapes = []
+    for s in input_spec:
+        if isinstance(s, InputSpec):
+            shapes.append(s.to_shape_dtype())
+        elif isinstance(s, Tensor):
+            shapes.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+        else:
+            shapes.append(jax.ShapeDtypeStruct(tuple(s.shape), s.dtype))
+
+    def infer(p, b, *args):
+        out, _ = apply_fn(p, b, *args, training=False)
+        return out
+
+    jitted = jax.jit(infer)
+    exported = jax_export.export(jitted)(
+        jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
+        jax.tree_util.tree_map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), buffers),
+        *shapes)
+    blob = exported.serialize()
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(blob)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
+                     "buffers": {k: np.asarray(v) for k, v in buffers.items()}}, f,
+                    protocol=4)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump({"n_inputs": len(shapes)}, f)
+
+
+class TranslatedLayer:
+    """Loaded inference program (≙ dygraph TranslatedLayer)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = {k: jnp.asarray(v) for k, v in params.items()}
+        self._buffers = {k: jnp.asarray(v) for k, v in buffers.items()}
+
+    def __call__(self, *args):
+        out = self._exported.call(self._params, self._buffers,
+                                  *unwrap_tree(list(args)))
+        return wrap_tree(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    """``paddle.jit.load`` parity."""
+    from jax import export as jax_export
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        weights = pickle.load(f)
+    return TranslatedLayer(exported, weights["params"], weights["buffers"])
+
+
+def not_to_static(fn):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+def enable_to_static(flag: bool):
+    pass

@@ -1,0 +1,2 @@
+from .gpt import (GPT_CONFIGS, GPTConfig, GPTForPretraining, GPTModel,  # noqa: F401
+                  gpt_preset, make_gpt_train_step)

@@ -1,0 +1,87 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _clip_eager(self, params, grads: dict) -> dict:
+        raise NotImplementedError
+
+    def _clip_pytree(self, grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # static-graph style [(param, grad)] interface
+        out = []
+        for p, g in params_grads:
+            out.append((p, g))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_eager(self, params, grads):
+        return {k: (None if g is None else jnp.clip(g, self.min, self.max))
+                for k, g in grads.items()}
+
+    def _clip_pytree(self, grads):
+        return jax.tree_util.tree_map(lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _one(self, g):
+        norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def _clip_eager(self, params, grads):
+        return {k: (None if g is None else self._one(g)) for k, g in grads.items()}
+
+    def _clip_pytree(self, grads):
+        return jax.tree_util.tree_map(self._one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip — the hybrid-parallel critical one (reference:
+    clip.py ClipGradByGlobalNorm + hybrid_parallel_optimizer.py
+    HybridParallelClipGrad which psums the squared norm across mesh axes)."""
+
+    def __init__(self, clip_norm, group_name="default_group", axes=None):
+        self.clip_norm = float(clip_norm)
+        self.axes = axes  # mesh axes to reduce over inside pjit (set by fleet)
+
+    def _global_norm(self, leaves):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        if self.axes:
+            # inside shard_map: sum partial norms across model-parallel axes
+            for ax in self.axes:
+                sq = jax.lax.psum(sq, ax)
+        return jnp.sqrt(sq)
+
+    def _clip_eager(self, params, grads):
+        leaves = [g for g in grads.values() if g is not None]
+        if not leaves:
+            return grads
+        gnorm = self._global_norm(leaves)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return {k: (None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype))
+                for k, g in grads.items()}
+
+    def _clip_pytree(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        gnorm = self._global_norm(leaves)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)

@@ -15,9 +15,11 @@ from ...core.tensor import Tensor, apply
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with paddle's (in, out) weight layout."""
     def f(a, w, b):
+        from ...amp import cast_if_amp
+        a, w = cast_if_amp(a, w)
         out = jnp.matmul(a, w)
         if b is not None:
-            out = out + b
+            out = out + b.astype(out.dtype)
         return out
     return apply(f, x, weight, bias)
 

@@ -65,6 +65,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
     dn = _dimnums(n, data_format)
 
     def f(a, w, b):
+        from ...amp import cast_if_amp
+        a, w = cast_if_amp(a, w)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
             dimension_numbers=dn, feature_group_count=groups,
@@ -72,7 +74,7 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
         if b is not None:
             shape = [1] * out.ndim
             shape[1 if data_format[1] == "C" else out.ndim - 1] = b.shape[0]
-            out = out + b.reshape(shape)
+            out = out + b.reshape(shape).astype(out.dtype)
         return out
     return apply(f, x, weight, bias)
 

@@ -62,15 +62,18 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
     ndim = len(list(normalized_shape))
 
     def f(a, w, b):
+        from ...amp import blacklist_cast
+        in_dtype = a.dtype
+        (a,) = blacklist_cast(a)
         axes = tuple(range(a.ndim - ndim, a.ndim))
         m = jnp.mean(a, axis=axes, keepdims=True)
         v = jnp.var(a, axis=axes, keepdims=True)
         out = (a - m) * jax.lax.rsqrt(v + epsilon)
         if w is not None:
-            out = out * w
+            out = out * w.astype(out.dtype)
         if b is not None:
-            out = out + b
-        return out
+            out = out + b.astype(out.dtype)
+        return out.astype(in_dtype)
     return apply(f, x, weight, bias)
 
 

@@ -60,9 +60,10 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
         pd_resolved = _resolve_pads(a.shape, win, st, pad, n, spatial, k, s, ceil_mode,
                                     a.ndim)
         if not average:
-            return jax.lax.reduce_window(a, init(a.dtype), reducer, win, st, pd_resolved)
-        summed = jax.lax.reduce_window(a, jnp.zeros((), a.dtype), jax.lax.add, win, st,
-                                       pd_resolved)
+            iv = init(a.dtype)
+            iv = jnp.asarray(iv, a.dtype) if not isinstance(iv, float) else iv
+            return jax.lax.reduce_window(a, iv, reducer, win, st, pd_resolved)
+        summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, win, st, pd_resolved)
         if exclusive:
             ones = jnp.ones(tuple(a.shape[d] for d in spatial), a.dtype)
             ones = ones.reshape([a.shape[d] if d in spatial else 1 for d in range(a.ndim)])
@@ -78,8 +79,8 @@ def _pool(x, kernel, stride, padding, n, data_format, reducer, init, ceil_mode=F
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCL", name=None):
     out = _pool(x, kernel_size, stride, padding, 1, data_format,
-                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
-                                                  else jnp.iinfo(dt).min, dt),
+                jax.lax.max, lambda dt: (-float("inf") if jnp.issubdtype(dt, jnp.floating)
+                                       else jnp.iinfo(dt).min),
                 ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_indices(x, kernel_size, stride, padding, 1, data_format, ceil_mode)
@@ -89,8 +90,8 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 2, data_format,
-                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
-                                                  else jnp.iinfo(dt).min, dt),
+                jax.lax.max, lambda dt: (-float("inf") if jnp.issubdtype(dt, jnp.floating)
+                                       else jnp.iinfo(dt).min),
                 ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_indices(x, kernel_size, stride, padding, 2, data_format, ceil_mode)
@@ -100,8 +101,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
                data_format="NCDHW", name=None):
     out = _pool(x, kernel_size, stride, padding, 3, data_format,
-                jax.lax.max, lambda dt: jnp.array(-jnp.inf if jnp.issubdtype(dt, jnp.floating)
-                                                  else jnp.iinfo(dt).min, dt),
+                jax.lax.max, lambda dt: (-float("inf") if jnp.issubdtype(dt, jnp.floating)
+                                       else jnp.iinfo(dt).min),
                 ceil_mode=ceil_mode)
     if return_mask:
         return out, _pool_indices(x, kernel_size, stride, padding, 3, data_format, ceil_mode)
@@ -139,19 +140,19 @@ def _pool_indices(x, kernel, stride, padding, n, data_format, ceil_mode):
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False,
                data_format="NCL", name=None):
     return _pool(x, kernel_size, stride, padding, 1, data_format, jax.lax.add,
-                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+                 lambda dt: 0.0, ceil_mode, average=True, exclusive=exclusive)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                divisor_override=None, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, 2, data_format, jax.lax.add,
-                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+                 lambda dt: 0.0, ceil_mode, average=True, exclusive=exclusive)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
                divisor_override=None, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, data_format, jax.lax.add,
-                 lambda dt: jnp.zeros((), dt), ceil_mode, average=True, exclusive=exclusive)
+                 lambda dt: 0.0, ceil_mode, average=True, exclusive=exclusive)
 
 
 def _adaptive_axes(in_size, out_size):

@@ -1,0 +1,195 @@
+"""Optimizer base.
+
+Reference: python/paddle/optimizer/optimizer.py (Optimizer) +
+operators/optimizers/*.  Each optimizer defines one pure update rule
+``_rule(param, grad, slots, lr) -> (new_param, new_slots)`` used by BOTH:
+
+- the eager path (``step()`` reads ``p._grad`` and mutates ``p._data``), and
+- the functional path (``init_state``/``update`` over pytrees) that the jit
+  train step, hapi Model and fleet distributed optimizers consume.  On TPU
+  the functional path is the performant one: the whole update fuses into the
+  step program, and states inherit param shardings (ZeRO = resharding this
+  state pytree).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter, Tensor
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        from .lr import LRScheduler
+        self._parameter_list: Optional[List[Parameter]] = (
+            list(parameters) if parameters is not None else None)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        if isinstance(weight_decay, float):
+            from ..regularizer import L2Decay
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        # eager accumulators: slot_name -> {id(param): array}
+        self._accum: Dict[int, Dict[str, Any]] = {}
+        self._step_count = 0
+
+    # ------------------------------------------------------------------ LR
+    def get_lr(self) -> float:
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when learning rate is a scheduler")
+        self._learning_rate = value
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # --------------------------------------------------------------- eager
+    def _slots_for(self, p: Parameter) -> Dict[str, Any]:
+        key = id(p)
+        if key not in self._accum:
+            self._accum[key] = self._init_slots(p._data)
+        return self._accum[key]
+
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer created without a parameter list; "
+                             "pass parameters= or use the functional API")
+        lr = self.get_lr()
+        grads = {id(p): p._grad for p in params}
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_eager(params, grads)
+        self._step_count += 1
+        for p in params:
+            g = grads.get(id(p))
+            if g is None or not p.trainable:
+                continue
+            g = g.astype(p._data.dtype) if g.dtype != p._data.dtype else g
+            if self._weight_decay is not None and self._use_coupled_wd(p):
+                g = g + jnp.asarray(self._weight_decay.coeff, g.dtype) * p._data
+            plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
+            slots = self._slots_for(p)
+            new_p, new_slots = self._rule(p._data, g, slots, jnp.asarray(plr, jnp.float32),
+                                          step=jnp.asarray(self._step_count, jnp.int32))
+            p._data = new_p
+            self._accum[id(p)] = new_slots
+
+    minimize_step = step
+
+    _decoupled_wd = False  # AdamW-style decoupled decay overrides to True
+
+    def _use_coupled_wd(self, p) -> bool:
+        """L2Decay folds into the gradient (decoupled optimizers override)."""
+        return self._weight_decay is not None and not self._decoupled_wd
+
+    def clear_grad(self, set_to_zero: bool = False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ---------------------------------------------------------- functional
+    def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Build the optimizer-state pytree for a named param pytree."""
+        state = {
+            "step": jnp.zeros([], jnp.int32),
+            "slots": jax.tree_util.tree_map(lambda p: self._init_slots(p), params,
+                                            is_leaf=lambda x: hasattr(x, "shape")),
+        }
+        return state
+
+    def update(self, grads: Dict[str, Any], state: Dict[str, Any],
+               params: Dict[str, Any], lr=None):
+        """Pure functional update: returns (new_params, new_state)."""
+        lr = jnp.asarray(self.get_lr() if lr is None else lr, jnp.float32)
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_pytree(grads)
+        step = state["step"] + 1
+
+        def upd(p, g, slots):
+            if g is None:
+                return p, slots
+            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            if self._weight_decay is not None and self._use_coupled_wd(object()):
+                g = g + jnp.asarray(self._weight_decay.coeff, g.dtype) * p
+            return self._rule(p, g, slots, lr, step=step)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_s = treedef.flatten_up_to(state["slots"])
+        new_p, new_s = [], []
+        for p, g, s in zip(flat_p, flat_g, flat_s):
+            np_, ns_ = upd(p, g, s)
+            new_p.append(np_)
+            new_s.append(ns_)
+        return (jax.tree_util.tree_unflatten(treedef, new_p),
+                {"step": step, "slots": jax.tree_util.tree_unflatten(treedef, new_s)})
+
+    # -------------------------------------------------------------- state io
+    def state_dict(self) -> Dict[str, Any]:
+        sd: Dict[str, Any] = {"__step__": self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                slots = self._accum.get(id(p))
+                if slots:
+                    pname = p.name or f"param_{i}"
+                    for sname, val in slots.items():
+                        sd[f"{pname}.{sname}"] = Tensor(val) if hasattr(val, "shape") \
+                            else val
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: Dict[str, Any]):
+        self._step_count = int(state_dict.get("__step__", 0))
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                pname = p.name or f"param_{i}"
+                slots = self._init_slots(p._data)
+                found = False
+                for sname in list(slots):
+                    key = f"{pname}.{sname}"
+                    if key in state_dict:
+                        val = state_dict[key]
+                        slots[sname] = jnp.asarray(
+                            val.numpy() if hasattr(val, "numpy") else val)
+                        found = True
+                if found:
+                    self._accum[id(p)] = slots
+        from .lr import LRScheduler
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+    # ------------------------------------------------------------ subclass
+    def _init_slots(self, p) -> Dict[str, Any]:
+        return {}
+
+    def _rule(self, p, g, slots, lr, step=None):
+        raise NotImplementedError

@@ -1,0 +1,20 @@
+"""Regularizers (reference: python/paddle/regularizer.py)."""
+
+from __future__ import annotations
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    @property
+    def _regularization_coeff(self):
+        return self.coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    pass
+
+
+class L2Decay(WeightDecayRegularizer):
+    pass

@@ -8,4 +8,5 @@ from ..core.tensor import apply
 
 
 def einsum(equation, *operands):
-    return apply(lambda ops: jnp.einsum(equation, *ops), list(operands))
+    return apply(lambda ops: jnp.einsum(equation, *ops), list(operands),
+                 name="einsum")

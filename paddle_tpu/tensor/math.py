@@ -168,7 +168,7 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
         if transpose_y:
             b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
         return jnp.matmul(a, b)
-    return apply(f, x, y)
+    return apply(f, x, y, name="matmul")
 
 
 def mm(x, y, name=None):

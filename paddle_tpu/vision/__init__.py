@@ -1,0 +1,3 @@
+from . import datasets, models, transforms  # noqa: F401
+from . import ops  # noqa: F401
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,97 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST, Cifar,
+FashionMNIST, Flowers).  Zero-egress build: readers consume locally provided
+files (paths must be given; downloading is not available in this image)."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST reader (reference vision/datasets/mnist.py)."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend="cv2"):
+        self.mode = mode
+        self.transform = transform
+        if image_path is None or label_path is None:
+            raise ValueError(
+                "this build has no network egress: pass image_path/label_path "
+                "to local IDX files (train-images-idx3-ubyte.gz etc.)")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python-pickle tar reader (reference vision/datasets/cifar.py)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True,
+                 backend="cv2"):
+        if data_file is None:
+            raise ValueError("zero-egress build: pass data_file to the local "
+                             "cifar-10-python.tar.gz")
+        self.transform = transform
+        self.data = []
+        self.labels = []
+        want = "data_batch" if mode == "train" else "test_batch"
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                if want in member.name:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    self.data.append(d[b"data"])
+                    self.labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.data = np.concatenate(self.data).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)
+        self.labels = np.asarray(self.labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass

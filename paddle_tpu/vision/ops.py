@@ -1,0 +1,140 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — yolo_box, nms,
+roi_align, deform_conv2d subset; operators/detection/ corpus)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+
+
+def box_area(boxes):
+    return apply(lambda b: (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), boxes)
+
+
+def box_iou(boxes1, boxes2):
+    def f(b1, b2):
+        a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+        a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+        lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+        rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (a1[:, None] + a2[None, :] - inter)
+    return apply(f, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Greedy NMS.  Data-dependent output size → host computation (the
+    reference's nms op is likewise CPU-side in inference postprocessing)."""
+    b = np.asarray(getattr(boxes, "_data", boxes))
+    s = np.asarray(getattr(scores, "_data", scores)) if scores is not None \
+        else np.arange(len(b), 0, -1, dtype="float32")
+    order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.clip(xx2 - xx1, 0) * np.clip(yy2 - yy1, 0)
+        iou = inter / (areas[i] + areas - inter + 1e-10)
+        suppressed |= iou > iou_threshold
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear grid sampling (reference: roi_align_op)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs):
+        N, C, H, W = feat.shape
+        off = 0.5 if aligned else 0.0
+
+        def one_roi(box):
+            x1, y1, x2, y2 = box * spatial_scale - off if aligned else box * spatial_scale
+            if not aligned:
+                x1, y1, x2, y2 = box * spatial_scale
+            w = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+            h = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+            ys = y1 + (jnp.arange(oh) + 0.5) * h / oh - 0.5
+            xs = x1 + (jnp.arange(ow) + 0.5) * w / ow - 0.5
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+
+            def sample(img2d):
+                y0 = jnp.floor(gy).astype(jnp.int32)
+                x0 = jnp.floor(gx).astype(jnp.int32)
+                y1i, x1i = y0 + 1, x0 + 1
+                wy = gy - y0
+                wx = gx - x0
+                def g(yy, xx):
+                    yy = jnp.clip(yy, 0, H - 1)
+                    xx = jnp.clip(xx, 0, W - 1)
+                    return img2d[yy, xx]
+                return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x1i) * (1 - wy) * wx
+                        + g(y1i, x0) * wy * (1 - wx) + g(y1i, x1i) * wy * wx)
+            return jax.vmap(sample)(feat[0])  # (C, oh, ow) assuming batch 1 per roi
+        return jax.vmap(one_roi)(bxs)
+    return apply(f, x, boxes)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    """YOLO box decoding (reference: yolo_box_op)."""
+    na = len(anchors) // 2
+
+    def f(feat, imgs):
+        N, C, H, W = feat.shape
+        feat = feat.reshape(N, na, -1, H, W)
+        grid_x = jnp.arange(W).reshape(1, 1, 1, W)
+        grid_y = jnp.arange(H).reshape(1, 1, H, 1)
+        anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+        bx = (jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y
+              - 0.5 * (scale_x_y - 1) + grid_x) / W
+        by = (jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y
+              - 0.5 * (scale_x_y - 1) + grid_y) / H
+        bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / (W * downsample_ratio)
+        bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / (H * downsample_ratio)
+        conf = jax.nn.sigmoid(feat[:, :, 4])
+        probs = jax.nn.sigmoid(feat[:, :, 5:5 + class_num]) * conf[:, :, None]
+        img_h = imgs[:, 0].reshape(N, 1, 1, 1)
+        img_w = imgs[:, 1].reshape(N, 1, 1, 1)
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, img_w - 1)
+            y1 = jnp.clip(y1, 0, img_h - 1)
+            x2 = jnp.clip(x2, 0, img_w - 1)
+            y2 = jnp.clip(y2, 0, img_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+        mask = conf.reshape(N, -1, 1) > conf_thresh
+        boxes = boxes * mask
+        return boxes, scores
+    return apply(f, x, img_size)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)

@@ -1,0 +1,320 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy-based host-side preprocessing (runs in DataLoader workers; the device
+never sees unbatched images)."""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+def _hwc(img):
+    arr = np.asarray(img)
+    return arr
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def __call__(self, inputs):
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 → CHW float32 / 255 (reference transforms.ToTensor)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        super().__init__(keys)
+        self.mean = np.asarray(mean, dtype="float32").reshape(-1)
+        self.std = np.asarray(std, dtype="float32").reshape(-1)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype="float32")
+        if self.data_format == "CHW":
+            shape = (-1, 1, 1)
+        else:
+            shape = (1, 1, -1)
+        return (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+def _resize_np(arr, size, interpolation="bilinear"):
+    import jax
+    import jax.numpy as jnp
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h < w:
+            oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), size
+    else:
+        oh, ow = size
+    method = {"bilinear": "linear", "nearest": "nearest", "bicubic": "cubic",
+              "lanczos": "lanczos3"}.get(interpolation, "linear")
+    out_shape = (oh, ow) + arr.shape[2:]
+    return np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape,
+                                       method=method)).astype(arr.dtype)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return _resize_np(_hwc(img), self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else [self.padding] * 4
+            pad = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad, constant_values=self.fill)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed:
+            if h < th or w < tw:
+                ph, pw = max(th - h, 0), max(tw - w, 0)
+                arr = np.pad(arr, [(0, ph), (0, pw)] + [(0, 0)] * (arr.ndim - 2),
+                             constant_values=self.fill)
+                h, w = arr.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return arr[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if random.random() < self.prob:
+            return arr[:, ::-1].copy()
+        return arr
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if random.random() < self.prob:
+            return arr[::-1].copy()
+        return arr
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = random.randint(0, h - th)
+                j = random.randint(0, w - tw)
+                crop = arr[i:i + th, j:j + tw]
+                return _resize_np(crop, self.size, self.interpolation)
+        return _resize_np(arr, self.size, self.interpolation)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        if len(p) == 2:
+            p = [p[0], p[1], p[0], p[1]]
+        self.p = p
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        pad = [(self.p[1], self.p[3]), (self.p[0], self.p[2])] + \
+            [(0, 0)] * (arr.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(arr, pad, constant_values=self.fill)
+        return np.pad(arr, pad, mode={"reflect": "reflect", "edge": "edge",
+                                      "symmetric": "symmetric"}[self.mode])
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        if arr.ndim == 2:
+            g = arr
+        else:
+            g = arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+        out = np.repeat(g[..., None], self.n, axis=-1)
+        return out
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return np.clip(arr * f, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        mean = arr.mean()
+        return np.clip(mean + (arr - mean) * f, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+
+    def _apply_image(self, img):
+        arr = img
+        order = list(self.ts)
+        random.shuffle(order)
+        for t in order:
+            arr = t(arr)
+        return arr
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+# functional namespace (reference transforms/functional.py)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return _resize_np(_hwc(img), size, interpolation)
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _hwc(img)[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)

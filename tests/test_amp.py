@@ -1,0 +1,97 @@
+"""AMP tests (reference: unittests test_amp_* family)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_autocast_white_black():
+    lin = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    with amp.auto_cast(dtype="bfloat16"):
+        assert lin(x).dtype == jnp.bfloat16
+        assert paddle.matmul(x, paddle.randn([8, 2])).dtype == jnp.bfloat16
+    assert lin(x).dtype == jnp.float32
+
+
+def test_custom_lists():
+    x = paddle.randn([2, 2])
+    with amp.auto_cast(custom_black_list={"matmul"}):
+        assert paddle.matmul(x, x).dtype == jnp.float32
+    with amp.auto_cast(custom_white_list={"softmax"}):
+        out = F.softmax(paddle.randn([2, 4]).astype("bfloat16"))
+        assert out.dtype == jnp.bfloat16
+
+
+def test_backward_replays_recorded_state():
+    # record outside autocast, backward inside — must stay fp32
+    x = paddle.randn([2, 3])
+    x.stop_gradient = False
+    y = F.linear(x, paddle.randn([3, 3]))
+    with amp.auto_cast():
+        y.sum().backward()
+    assert x.grad.dtype == jnp.float32
+    # record inside autocast, backward outside — replay in bf16
+    a = paddle.randn([2, 2])
+    a.stop_gradient = False
+    with amp.auto_cast():
+        z = paddle.matmul(a, paddle.randn([2, 2]))
+    z.sum().backward()
+    assert a.grad is not None
+
+
+def test_grad_scaler_skip_on_inf():
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1)
+    lin.weight._grad = jnp.full_like(lin.weight._data, jnp.inf)
+    lin.bias._grad = jnp.zeros_like(lin.bias._data)
+    w0 = lin.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(lin.weight.numpy(), w0)
+    assert scaler.get_loss_scaling() == 512.0
+
+
+def test_no_double_unscale():
+    from paddle_tpu.nn.utils import clip_grad_norm_
+    lin = nn.Linear(4, 2)
+    opt = paddle.optimizer.SGD(1.0, parameters=lin.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    loss = lin(paddle.ones([1, 4])).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)
+    g = lin.bias.grad.numpy().copy()
+    np.testing.assert_allclose(g, [1.0, 1.0])
+    clip_grad_norm_(lin.parameters(), 1e9)
+    scaler.step(opt)  # must NOT unscale again
+    scaler.update()
+    assert scaler._already_unscaled is False
+
+
+def test_functional_scaler_under_jit():
+    import jax
+    scaler = amp.GradScaler(init_loss_scaling=256.0, decr_every_n_nan_or_inf=1)
+    state = scaler.init_state()
+    good = {"w": jnp.ones((2,)) * 512.0}
+    u, fi, st = jax.jit(scaler.functional_update)(state, good)
+    assert not bool(fi)
+    np.testing.assert_allclose(np.asarray(u["w"]), 2.0)
+    bad = {"w": jnp.array([jnp.inf, 1.0])}
+    u, fi, st = jax.jit(scaler.functional_update)(state, bad)
+    assert bool(fi)
+    assert float(st["scale"]) == 128.0
+
+
+def test_decorate_o2():
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    amp.decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype == jnp.bfloat16
+    assert net[1].weight.dtype == jnp.float32  # norms stay fp32

@@ -1,0 +1,161 @@
+"""End-to-end model tests (reference: tests/book/test_recognize_digits.py —
+train tiny models, assert convergence; hapi python/paddle/tests/test_model.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.optimizer import Adam, Momentum
+from paddle_tpu.optimizer.lr import StepDecay
+
+BASE = np.random.RandomState(7).randn(10, 1, 28, 28).astype("float32")
+
+
+class SynthMNIST(Dataset):
+    def __init__(self, n=256, seed=0):
+        rng = np.random.RandomState(seed)
+        self.y = rng.randint(0, 10, n)
+        self.x = BASE[self.y] + 0.3 * rng.randn(n, 1, 28, 28).astype("float32")
+
+    def __getitem__(self, i):
+        return self.x[i], np.int64(self.y[i])
+
+    def __len__(self):
+        return len(self.y)
+
+
+class LeNet(nn.Layer):
+    """Reference LeNet (python/paddle/vision/models/lenet.py)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5), nn.ReLU(), nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(nn.Linear(400, 120), nn.Linear(120, 84),
+                                nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        return self.fc(paddle.flatten(self.features(x), 1))
+
+
+def test_model_fit_evaluate_predict_save_load(tmp_path):
+    model = Model(LeNet(), inputs=[None])
+    model.prepare(Adam(0.001, parameters=model.parameters()),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(SynthMNIST(512, 0), epochs=3, batch_size=64, verbose=0)
+    logs = model.evaluate(SynthMNIST(64, 99), batch_size=64)
+    assert logs["acc"] > 0.9
+    assert logs["loss"] < 1.0
+
+    path = str(tmp_path / "ck")
+    model.save(path)
+    m2 = Model(LeNet(), inputs=[None])
+    m2.prepare(Adam(0.001, parameters=m2.parameters()), nn.CrossEntropyLoss(),
+               Accuracy())
+    m2.load(path)
+    logs2 = m2.evaluate(SynthMNIST(64, 99), batch_size=64)
+    assert abs(logs2["acc"] - logs["acc"]) < 1e-6
+
+    preds = model.predict(SynthMNIST(32, 5), batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+
+
+def test_jit_save_load(tmp_path):
+    import paddle_tpu.jit as jit
+    net = LeNet()
+    path = str(tmp_path / "infer")
+    jit.save(net, path, input_spec=[jit.InputSpec([1, 1, 28, 28])])
+    tl = jit.load(path)
+    x = paddle.randn([1, 1, 28, 28])
+    np.testing.assert_allclose(tl(x).numpy(), net(x).numpy(), atol=1e-5)
+
+
+def test_to_static_decorator():
+    import paddle_tpu.jit as jit
+
+    @jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    x = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose(f(x).numpy(), [3.0, 5.0])
+
+
+def test_eager_vs_jit_loss_parity():
+    """Same model/data: eager tape-SGD must match the jit functional path
+    (the reference's dygraph/static consistency oracle)."""
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.optimizer import SGD
+
+    x = np.random.randn(32, 10).astype("float32")
+    y = np.random.randint(0, 3, 32)
+
+    paddle.seed(11)
+    net_e = nn.Sequential(nn.Linear(10, 16), nn.Tanh(), nn.Linear(16, 3))
+    paddle.seed(11)
+    net_j = nn.Sequential(nn.Linear(10, 16), nn.Tanh(), nn.Linear(16, 3))
+
+    opt_e = SGD(0.1, parameters=net_e.parameters())
+    eager_losses = []
+    for _ in range(5):
+        loss = F.cross_entropy(net_e(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+        eager_losses.append(float(loss))
+
+    model = Model(net_j, inputs=[None])
+    model.prepare(SGD(0.1, parameters=net_j.parameters()), nn.CrossEntropyLoss())
+    jit_losses = []
+    for _ in range(5):
+        jit_losses.append(model.train_batch([paddle.to_tensor(x)],
+                                            [paddle.to_tensor(y)])[0])
+    np.testing.assert_allclose(eager_losses, jit_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_dataloader():
+    ds = SynthMNIST(50)
+    dl = DataLoader(ds, batch_size=16, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == [16, 1, 28, 28]
+    dl2 = DataLoader(ds, batch_size=16, drop_last=False)
+    assert len(list(dl2)) == 4
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    lin = nn.Linear(4, 2)
+    opt = Adam(0.01, parameters=lin.parameters())
+    loss = lin(paddle.randn([8, 4])).mean()
+    loss.backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = Adam(0.01, parameters=lin.parameters())
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+
+
+def test_lr_schedulers():
+    from paddle_tpu.optimizer.lr import (CosineAnnealingDecay, LinearWarmup,
+                                         MultiStepDecay, NoamDecay, PiecewiseDecay,
+                                         PolynomialDecay, ReduceOnPlateau)
+    s = StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+    w = LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    assert w() == 0.0
+    w.step()
+    assert abs(w() - 0.025) < 1e-9
+    p = ReduceOnPlateau(0.1, patience=1)
+    p.step(1.0)
+    p.step(1.0)
+    p.step(1.0)
+    assert p() < 0.1 + 1e-12
