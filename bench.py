@@ -28,7 +28,7 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_attention_heads=12, max_position_embeddings=1024,
                         compute_dtype="bfloat16")
-        B, L, iters = 8, 1024, 20
+        B, L, iters = 8, 1024, 30
     else:  # CI / smoke sizing
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_attention_heads=4, max_position_embeddings=128,
@@ -42,25 +42,31 @@ def main():
 
     model = GPTModel(cfg)
     opt = AdamW(3e-4, weight_decay=0.01)
-    step, state = make_gpt_train_step(model, opt, hcg, remat=on_tpu)
+    step, state = make_gpt_train_step(model, opt, hcg, remat=False)
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
     y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
 
-    # warmup / compile
+    # warmup / compile.  NOTE: sync via host transfer (float(...)), not
+    # block_until_ready — measured on this tunneled axon backend,
+    # block_until_ready returned in ~40ms while the 20-step chain took ~3.4s
+    # to actually finish (observed 2026-07-29), silently inflating throughput.
     state, loss = step(state, jax.random.key(0), np.float32(3e-4), x, y)
-    jax.block_until_ready(loss)
+    float(loss)
 
     t0 = time.perf_counter()
     for i in range(iters):
         state, loss = step(state, jax.random.key(i + 1), np.float32(3e-4), x, y)
-    jax.block_until_ready(loss)
+    final_loss = float(loss)  # forces completion of the whole chain
     dt = time.perf_counter() - t0
+    assert np.isfinite(final_loss)
 
     tokens_per_sec = B * L * iters / dt
     # A100 proxy for GPT-2-small-class training ≈ 150k tokens/s/chip (public
-    # megatron-class numbers); vs_baseline = ours / proxy.
+    # megatron-class numbers); vs_baseline = ours / proxy.  Note the local chip
+    # is a v5e (~197 bf16 TFLOP/s peak vs A100's 312), so 1.0 here means beating
+    # an A100 outright, not just matching per-peak-FLOP efficiency.
     baseline_proxy = 150_000.0 if on_tpu else tokens_per_sec
     print(json.dumps({
         "metric": "gpt2s_train_tokens_per_sec",

@@ -122,8 +122,6 @@ def backward(tensor, grad=None, retain_graph: bool = False, capture=None,
     other leaf's ``.grad`` (so ``paddle.grad`` doesn't corrupt pending
     parameter gradients).
     """
-    if tensor._node is None:
-        return  # constant w.r.t. everything recorded
     if grad is None:
         if tensor.size != 1:
             raise RuntimeError(
@@ -131,6 +129,8 @@ def backward(tensor, grad=None, retain_graph: bool = False, capture=None,
         grad = jnp.ones_like(tensor._data)
     else:
         grad = getattr(grad, "_data", grad)
+    if tensor._node is None:
+        return  # constant w.r.t. everything recorded
 
     cot: Dict[int, Any] = {id(tensor): grad}
     keep = {id(tensor): tensor}  # keep tensors alive while walking

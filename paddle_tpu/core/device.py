@@ -9,9 +9,28 @@ current-device notion, and host/device transfer helpers.
 from __future__ import annotations
 
 import functools
+import os
 from typing import List, Optional
 
 import jax
+
+
+def local_devices(platform: Optional[str] = None):
+    """Devices of the requested platform, honoring ``PADDLE_TPU_PLATFORM``.
+
+    Some PJRT plugins register themselves as the default platform regardless of
+    ``JAX_PLATFORMS``; tests that need the virtual 8-device CPU mesh set
+    ``PADDLE_TPU_PLATFORM=cpu`` to force device discovery onto it.
+    """
+    platform = platform or os.environ.get("PADDLE_TPU_PLATFORM")
+    if platform:
+        try:
+            return jax.devices(platform)
+        except RuntimeError as e:
+            import warnings
+            warnings.warn(f"requested platform {platform!r} unavailable "
+                          f"({e}); falling back to default platform")
+    return jax.devices()
 
 
 class Place:
@@ -55,10 +74,7 @@ def _platform_names() -> List[str]:
 
 def _devices_of_kind(kind: str):
     if kind == "cpu":
-        try:
-            return jax.devices("cpu")
-        except RuntimeError:
-            return jax.devices()
+        return local_devices("cpu")
     # "tpu"/"gpu"/"xpu" → default platform accelerators
     return jax.devices()
 

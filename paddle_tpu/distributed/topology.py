@@ -128,8 +128,9 @@ class HybridCommunicateGroup:
         device ordering can be injected via ``devices``."""
         if self._mesh is not None and devices is None:
             return self._mesh
+        from ..core.device import local_devices
         devs = list(devices if devices is not None
-                    else (self._devices or jax.devices()))
+                    else (self._devices or local_devices()))
         if len(devs) < self.nranks:
             raise ValueError(f"need {self.nranks} devices, have {len(devs)}")
         arr = np.array(devs[: self.nranks]).reshape(self._dims)

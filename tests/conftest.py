@@ -9,6 +9,7 @@
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the virtual CPU mesh
+os.environ["PADDLE_TPU_PLATFORM"] = "cpu"  # force CPU even if a PJRT plugin hijacks the default
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"

@@ -15,7 +15,9 @@ import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 
 
-needs8 = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+from paddle_tpu.core.device import local_devices
+
+needs8 = pytest.mark.skipif(len(local_devices()) < 8, reason="needs 8 devices")
 
 
 @pytest.fixture()
@@ -57,7 +59,7 @@ class TestCollectives:
     @needs8
     def test_allreduce_allgather(self):
         import paddle_tpu.distributed as dist
-        mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+        mesh = Mesh(np.array(local_devices()[:4]), ("x",))
         g = dist.Group(ranks=[0, 1, 2, 3], axis_name="x")
         data = np.arange(8, dtype="float32").reshape(4, 2)
 
@@ -74,7 +76,7 @@ class TestCollectives:
     @needs8
     def test_alltoall_and_reduce_scatter(self):
         import paddle_tpu.distributed as dist
-        mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+        mesh = Mesh(np.array(local_devices()[:4]), ("x",))
         g = dist.Group(ranks=[0, 1, 2, 3], axis_name="x")
         data = np.arange(16, dtype="float32").reshape(4, 4)
 
@@ -91,7 +93,7 @@ class TestCollectives:
     @needs8
     def test_send_recv_ppermute(self):
         import paddle_tpu.distributed as dist
-        mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+        mesh = Mesh(np.array(local_devices()[:4]), ("x",))
         data = np.arange(4, dtype="float32").reshape(4, 1)
 
         def body(x):

@@ -216,7 +216,7 @@ def test_loss_oracles():
     np.testing.assert_allclose(
         F.binary_cross_entropy_with_logits(paddle.to_tensor(z),
                                            paddle.to_tensor(yy)).numpy(),
-        ref_bce, rtol=1e-5, atol=1e-6)
+        ref_bce, rtol=1e-4, atol=1e-6)  # fp32 accumulation-order slack vs torch
 
 
 def test_initializers():
