@@ -39,7 +39,8 @@ class GPTConfig:
                  max_position_embeddings=1024, hidden_dropout_prob=0.0,
                  attention_probs_dropout_prob=0.0, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
-                 use_flash_attention=True, tie_word_embeddings=True):
+                 use_flash_attention=True, tie_word_embeddings=True,
+                 sequence_parallel=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -53,6 +54,9 @@ class GPTConfig:
         self.compute_dtype = compute_dtype
         self.use_flash_attention = use_flash_attention
         self.tie_word_embeddings = tie_word_embeddings
+        # None → GSPMD decides (sequence gathered for attention);
+        # "ring"/"ulysses" → explicit context parallelism over the "sep" axis
+        self.sequence_parallel = sequence_parallel
 
 
 # canonical sizes (GPT-3 paper / fleet configs)
@@ -134,8 +138,12 @@ class GPTModel(Layer):
         h = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][pos]
         return h.astype(dt)
 
-    def block_fn(self, sl: Dict[str, Any], h, key=None):
-        """One transformer block given this layer's parameter slice."""
+    def block_fn(self, sl: Dict[str, Any], h, key=None, sp_mesh=None):
+        """One transformer block given this layer's parameter slice.
+
+        ``sp_mesh``: when set (by make_gpt_train_step on a mesh with sep>1)
+        attention runs as explicit ring/Ulysses context parallelism over the
+        "sep" axis instead of letting GSPMD gather the sequence."""
         c = self.config
         dt = h.dtype
         eps = c.layer_norm_epsilon
@@ -155,7 +163,23 @@ class GPTModel(Layer):
         q = q.reshape(B, Lq, nh, hd)
         k = k.reshape(B, Lq, nh, hd)
         v = v.reshape(B, Lq, nh, hd)
-        att = flash_attention(q, k, v, causal=True)
+        sp_mode = getattr(c, "sequence_parallel", None)
+        mesh = sp_mesh
+        if sp_mode and mesh is not None and mesh.shape.get("sep", 1) > 1 \
+                and Lq % mesh.shape["sep"] == 0:
+            # context parallelism: activations stay sequence-sharded on "sep";
+            # ring/Ulysses attention inside a partial-manual shard_map region
+            # (only "sep" is manual — dp/mp stay under GSPMD)
+            from jax.sharding import PartitionSpec as P
+            from ..ops.ring_attention import sequence_parallel_attention
+            att = jax.shard_map(
+                functools.partial(sequence_parallel_attention, axis_name="sep",
+                                  causal=True, mode=sp_mode),
+                mesh=mesh, in_specs=P(None, "sep", None, None),
+                out_specs=P(None, "sep", None, None), axis_names={"sep"},
+            )(q, k, v)
+        else:
+            att = flash_attention(q, k, v, causal=True)
         att = att.reshape(B, Lq, H)
         h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
         m_in = ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"])
@@ -183,17 +207,17 @@ class GPTModel(Layer):
         picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return -picked.mean()
 
-    def scan_blocks(self, params, h, key=None, remat=True):
+    def scan_blocks(self, params, h, key=None, remat=True, sp_mesh=None):
         stacked = {k: params[k] for k in self.stacked_param_names()}
-        fn = self.block_fn
         if remat:
-            fn = jax.checkpoint(lambda sl, hh: self.block_fn(sl, hh, key))
+            fn = jax.checkpoint(
+                lambda sl, hh: self.block_fn(sl, hh, key, sp_mesh=sp_mesh))
 
             def body(carry, sl):
                 return fn(sl, carry), None
         else:
             def body(carry, sl):
-                return self.block_fn(sl, carry, key), None
+                return self.block_fn(sl, carry, key, sp_mesh=sp_mesh), None
         out, _ = jax.lax.scan(body, h, stacked)
         return out
 
@@ -242,8 +266,15 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     mesh = hcg.mesh
     params0 = {n: p._data for n, p in model.named_parameters()}
     S = mesh.shape.get("pipe", 1)
+    sp_mode = getattr(model.config, "sequence_parallel", None)
+    sp_mesh = mesh if (sp_mode and mesh.shape.get("sep", 1) > 1) else None
 
     if S > 1:
+        if sp_mesh is not None:
+            raise ValueError(
+                "sequence_parallel with pp_degree>1 is not supported yet: the "
+                "pipeline engine's shard_map over 'pipe' cannot nest the "
+                "'sep' shard_map region; set sep_degree=1 or pp_degree=1")
         return make_stacked_pipeline_step(
             model.embed_fn, model.block_fn, model.head_loss_fn, params0,
             optimizer, hcg, model.config.num_layers,
@@ -265,7 +296,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         h = model.embed_fn(params, x, key)
         if seq_spec is not None:
             h = jax.lax.with_sharding_constraint(h, NamedSharding(mesh, seq_spec))
-        h = model.scan_blocks(params, h, key, remat=remat)
+        h = model.scan_blocks(params, h, key, remat=remat, sp_mesh=sp_mesh)
         return model.head_loss_fn(params, h, labels)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,153 @@
+"""Sequence/context-parallel attention: ring attention + Ulysses.
+
+The reference snapshot has NO sequence/context parallelism (SURVEY.md §2.4:
+"SP/CP/ring-attention/Ulysses — ABSENT"); its longest-context assets are the
+fused FMHA (operators/fused/fmha_ref.h:57) and block-sparse attention
+(python/paddle/nn/functional/sparse_attention.py).  This module designs
+long-context from first principles for TPU:
+
+- **Ring attention** (`ring_attention`): sequence is sharded over a mesh axis
+  (the "sep" axis of the hybrid topology); K/V blocks rotate around the ring
+  with ``jax.lax.ppermute`` while each device accumulates its queries'
+  online-softmax partials.  Memory per device is O(L/sp); comm rides ICI
+  neighbor links (a ppermute per step overlaps with the block matmul under
+  XLA's async collectives).
+- **Ulysses** (`ulysses_attention`): all_to_all swaps the sharded dimension
+  from sequence to heads, runs dense/flash attention on the full sequence with
+  H/sp local heads, then swaps back.  Two all_to_alls per call; preferable
+  when heads divide the sep degree and L is moderate.
+
+Both are pure SPMD functions meant to run inside ``shard_map`` over the
+hybrid mesh, and are differentiable via JAX AD (the ring scan's backward
+re-runs the ring in reverse — the L×L score matrix is never materialized).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_INF = -1e30
+
+
+def _local_scores(q, k, scale):
+    """q: (B, Lq, H, D), k: (B, Lk, H, D) → (B, H, Lq, Lk) fp32 scores."""
+    return jnp.einsum("blhd,bmhd->bhlm", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale=None):
+    """Ring flash attention over sequence shards.
+
+    Args:
+      q, k, v: local shards ``(B, L_local, H, D)`` — the global sequence is
+        the concatenation of shards along the ``axis_name`` mesh axis.
+      axis_name: mesh axis the sequence is sharded over (the hybrid "sep"
+        axis). Must be called inside shard_map/pjit over that axis.
+      causal: apply a causal mask in *global* sequence coordinates.
+    Returns:
+      local output shard (B, L_local, H, D), same dtype as q.
+    """
+    B, Lc, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+
+    row_g = idx * Lc + lax.broadcasted_iota(jnp.int32, (Lc, Lc), 0)
+
+    def accumulate(k_blk, v_blk, blk_idx, m, l, acc):
+        s = _local_scores(q, k_blk, scale)  # (B,H,Lc,Lc)
+        if causal:
+            col_g = blk_idx * Lc + lax.broadcasted_iota(jnp.int32, (Lc, Lc), 1)
+            s = jnp.where(col_g <= row_g, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)          # (B,H,Lc,1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jnp.einsum(
+            "bhlm,bmhd->bhld", p, v_blk.astype(jnp.float32))
+        return m_new, l_new, acc_new
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        # which global chunk this k/v block came from after t rotations
+        m, l, acc = accumulate(k_blk, v_blk, (idx - t) % sp, m, l, acc)
+        # rotate k/v to the next rank (ring over ICI neighbors)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, acc), None
+
+    # accumulators start device-varying over the ring axis (new-style shard_map
+    # typing: scan carries must keep the same varying-axes set each iteration)
+    def _varying(x):
+        try:
+            return lax.pcast(x, (axis_name,), to="varying")
+        except (AttributeError, TypeError):
+            return x
+    m0 = _varying(jnp.full((B, H, Lc, 1), _NEG_INF, jnp.float32))
+    l0 = _varying(jnp.zeros((B, H, Lc, 1), jnp.float32))
+    acc0 = _varying(jnp.zeros((B, H, Lc, D), jnp.float32))
+    # sp-1 (compute + rotate) steps, then a final compute with no rotation —
+    # the last ppermute's payload would otherwise be exchanged and discarded
+    if sp > 1:
+        (k_last, v_last, m, l, acc), _ = lax.scan(
+            step, (k, v, m0, l0, acc0), jnp.arange(sp - 1))
+    else:
+        k_last, v_last, m, l, acc = k, v, m0, l0, acc0
+    m, l, acc = accumulate(k_last, v_last, (idx - (sp - 1)) % sp, m, l, acc)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,Lc,H,D)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                      scale=None, attention_fn=None):
+    """Ulysses sequence parallelism: head-scatter all_to_all.
+
+    Local shards (B, L_local, H, D) with H divisible by the sep degree.
+    all_to_all re-shards from sequence to heads, computes attention over the
+    FULL sequence with H/sp heads per device, then re-shards back.
+    """
+    from .attention import flash_attention
+    sp = lax.psum(1, axis_name)
+    H = q.shape[2]
+    if H % sp != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({H}) divisible by the "
+            f"'{axis_name}' mesh-axis size ({sp}); use ring attention instead")
+    if attention_fn is None:
+        # flash_attention itself falls back to dense off-TPU / odd shapes
+        attention_fn = partial(flash_attention, causal=causal, scale=scale)
+
+    def seq_to_heads(x):  # (B, Lc, H, D) -> (B, L, H/sp, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # inverse
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attention_fn(qh, kh, vh)
+    return heads_to_seq(out)
+
+
+def sequence_parallel_attention(q, k, v, axis_name: str = "sep",
+                                causal: bool = False, scale=None,
+                                mode: str = "ring"):
+    """Dispatch between ring and Ulysses context parallelism.
+
+    Ulysses needs ``H % sp == 0`` and moves full K/V twice; ring moves K/V
+    sp-1 times in L/sp blocks but keeps everything neighbor-local. Default
+    ring (scales to any head count and rides ICI)."""
+    if mode == "ulysses":
+        return ulysses_attention(q, k, v, axis_name, causal=causal, scale=scale)
+    if mode != "ring":
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+    return ring_attention(q, k, v, axis_name, causal=causal, scale=scale)
