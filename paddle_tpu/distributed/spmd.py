@@ -228,6 +228,36 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
     return step, place(state0), state_sh
 
 
+def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
+                              zero_stage: int = 0, donate: bool = True):
+    """Shared GSPMD train-step builder for functional models (gpt/bert/ernie).
+
+    ``loss_of(params, *batch) -> scalar loss``.  Returns (step, state0) where
+    ``step(state, lr, *batch) -> (state, loss)``; params/opt-state sharded by
+    build_param_specs, params re-constrained each step so shardings stay
+    stable under donation.
+    """
+    p_specs = build_param_specs(params0, mesh, layer, zero_stage)
+    opt_state0 = optimizer.init_state(params0)
+    state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
+    state_sh = build_state_shardings(state0, p_specs, mesh,
+                                     max(zero_stage, 1), params0)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state, lr, *batch):
+        loss, grads = jax.value_and_grad(loss_of)(state["params"], *batch)
+        new_params, new_opt = optimizer.update(grads, state["opt"],
+                                               state["params"], lr=lr)
+        new_params = jax.lax.with_sharding_constraint(
+            new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
+        return {"params": new_params, "opt": new_opt, "buffers": {}}, loss
+
+    state0 = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, s), state0, state_sh,
+        is_leaf=lambda x: hasattr(x, "shape"))
+    return step, state0
+
+
 def shard_batch(batch, hcg):
     mesh = hcg.mesh
     spec = P("data") if "data" in mesh.axis_names and mesh.shape["data"] > 1 else P()

@@ -1,0 +1,68 @@
+"""Expert-parallel exchange utilities.
+
+Reference: python/paddle/distributed/utils.py:57 ``global_scatter`` / :179
+``global_gather`` — ragged token exchange driven by per-expert counts
+(grouped ncclSend/Recv, operators/collective/global_scatter_op.cu.cc).
+
+TPU-native: XLA collectives are static-shape, so the exchange is expressed as
+a **uniform-capacity all_to_all** over the expert mesh axis.  Tokens are laid
+out as ``(world * n_expert * capacity, H)`` with per-slot validity carried in
+the dispatch mask (see ops/moe.topk_gating) instead of ragged counts.  These
+functions must run inside shard_map over the expert axis; for the
+annotation-based path (GSPMD inserts the exchange automatically) use
+``paddle_tpu.ops.moe.moe_ffn``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from jax import lax
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _resolve_axis(group):
+    if group is None:
+        return "data"
+    return getattr(group, "axis_name", group)
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   use_calc_stream=True):
+    """Send each rank's per-destination token blocks to their experts.
+
+    ``x``: local ``(world * n_expert * capacity, H)`` — row block ``w`` holds
+    the tokens this rank routes to rank ``w``'s experts (capacity-padded).
+    Returns ``(world * n_expert * capacity, H)``: the tokens this rank's
+    experts received from every rank.  ``local_count``/``global_count`` are
+    accepted for API parity; when given as concrete values they must be
+    uniform (the static-shape exchange always moves full capacity blocks) —
+    ragged counts raise.  Traced counts cannot be checked and are ignored.
+    """
+    axis = _resolve_axis(group)
+    world = lax.psum(1, axis)
+    rows, H = x.shape
+    for name, counts in (("local_count", local_count),
+                         ("global_count", global_count)):
+        if counts is None:
+            continue
+        try:
+            cvals = np.unique(np.asarray(counts))
+        except Exception:  # traced inside jit — cannot validate
+            continue
+        if cvals.size > 1:
+            raise ValueError(
+                f"TPU global_scatter moves uniform capacity blocks; ragged "
+                f"{name}={cvals.tolist()} is not supported — pad each "
+                f"expert's tokens to a fixed capacity (see ops/moe.py)")
+    if rows % world != 0:
+        raise ValueError(f"global_scatter rows ({rows}) must be a multiple of "
+                         f"the '{axis}' axis size ({world})")
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+
+
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  use_calc_stream=True):
+    """Inverse of :func:`global_scatter` — return expert outputs to the ranks
+    that sent the tokens."""
+    return global_scatter(x, local_count, global_count, group, use_calc_stream)

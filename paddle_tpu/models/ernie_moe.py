@@ -1,0 +1,208 @@
+"""ERNIE-style MoE transformer (reference capability: "ERNIE MoE alltoall"
+config in BASELINE.json; EP transport ≙ global_scatter/global_gather,
+distributed/utils.py:57,179).
+
+Decoder-only transformer where every block's FFN is a top-k routed mixture of
+experts.  TPU-first: blocks stacked for ``lax.scan`` (expert weights get an
+extra leading layer dim: (L, E, H, I)); expert parallelism is a sharding
+constraint on the dispatched (E, C, H) tensor — GSPMD emits the token
+all_to_all over the expert mesh axis.  Aux (load-balance) losses are summed
+over layers via the scan carry.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.layer.base import Layer
+from ..ops.attention import flash_attention
+from ..ops.moe import moe_ffn
+
+
+class ErnieMoeConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
+                 num_attention_heads=12, num_experts=8, top_k=2,
+                 expert_hidden_size=None, capacity_factor=1.25,
+                 max_position_embeddings=1024, initializer_range=0.02,
+                 layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
+                 aux_loss_weight=0.01, expert_axis="data"):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.expert_hidden_size = expert_hidden_size or 4 * hidden_size
+        self.capacity_factor = capacity_factor
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.compute_dtype = compute_dtype
+        self.aux_loss_weight = aux_loss_weight
+        self.expert_axis = expert_axis
+
+
+class ErnieMoeModel(Layer):
+    """Causal LM with MoE FFNs in every block."""
+
+    def __init__(self, config: ErnieMoeConfig):
+        super().__init__()
+        self.config = c = config
+        L, H, V, E = c.num_layers, c.hidden_size, c.vocab_size, c.num_experts
+        I = c.expert_hidden_size
+        std = c.initializer_range
+
+        def normal(shape, s=std):
+            from ..nn.initializer import Normal
+            return Normal(0.0, s)(shape, "float32")
+
+        def param(name, data, mapping=None):
+            p = Parameter(data, name=name)
+            if mapping:
+                p._dims_mapping = mapping
+            self.add_parameter(name.replace(".", "_"), p)
+            return p
+
+        zeros = lambda s: jnp.zeros(s, jnp.float32)
+        ones = lambda s: jnp.ones(s, jnp.float32)
+        self.wte = param("wte", normal([V, H]), {0: "model"})
+        self.wpe = param("wpe", normal([c.max_position_embeddings, H]))
+        self.blocks_ln1_w = param("blocks.ln1_w", ones([L, H]))
+        self.blocks_ln1_b = param("blocks.ln1_b", zeros([L, H]))
+        self.blocks_qkv_w = param("blocks.qkv_w", normal([L, H, 3 * H]), {2: "model"})
+        self.blocks_qkv_b = param("blocks.qkv_b", zeros([L, 3 * H]), {1: "model"})
+        self.blocks_proj_w = param("blocks.proj_w",
+                                   normal([L, H, H], std / math.sqrt(2 * L)),
+                                   {1: "model"})
+        self.blocks_proj_b = param("blocks.proj_b", zeros([L, H]))
+        self.blocks_ln2_w = param("blocks.ln2_w", ones([L, H]))
+        self.blocks_ln2_b = param("blocks.ln2_b", zeros([L, H]))
+        # MoE FFN: gate + stacked experts, leading (L, E) dims
+        self.blocks_gate_w = param("blocks.gate_w", normal([L, H, E]))
+        self.blocks_expert_w1 = param("blocks.expert_w1", normal([L, E, H, I]),
+                                      {1: c.expert_axis})
+        self.blocks_expert_b1 = param("blocks.expert_b1", zeros([L, E, I]),
+                                      {1: c.expert_axis})
+        self.blocks_expert_w2 = param("blocks.expert_w2",
+                                      normal([L, E, I, H], std / math.sqrt(2 * L)),
+                                      {1: c.expert_axis})
+        self.blocks_expert_b2 = param("blocks.expert_b2", zeros([L, E, H]),
+                                      {1: c.expert_axis})
+        self.lnf_w = param("lnf_w", ones([H]))
+        self.lnf_b = param("lnf_b", zeros([H]))
+
+    @staticmethod
+    def stacked_param_names():
+        return [f"blocks_{n}" for n in
+                ("ln1_w", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                 "ln2_w", "ln2_b", "gate_w", "expert_w1", "expert_b1",
+                 "expert_w2", "expert_b2")]
+
+    # -------------------------------------------------------- pure functions
+    def embed_fn(self, params, input_ids, key=None):
+        c = self.config
+        pos = jnp.arange(input_ids.shape[-1])
+        h = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][pos]
+        return h.astype(jnp.dtype(c.compute_dtype))
+
+    def block_fn(self, sl: Dict[str, Any], h, mesh=None):
+        """One block; returns (h, aux_loss)."""
+        c = self.config
+        dt = h.dtype
+        eps = c.layer_norm_epsilon
+        B, Lq, H = h.shape
+        nh = c.num_attention_heads
+        hd = H // nh
+
+        def ln(x, w, b):
+            x32 = x.astype(jnp.float32)
+            m = x32.mean(-1, keepdims=True)
+            v = x32.var(-1, keepdims=True)
+            return ((x32 - m) * jax.lax.rsqrt(v + eps) * w + b).astype(dt)
+
+        a_in = ln(h, sl["blocks_ln1_w"], sl["blocks_ln1_b"])
+        qkv = a_in @ sl["blocks_qkv_w"].astype(dt) + sl["blocks_qkv_b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(B, Lq, nh, hd) for t in (q, k, v))
+        att = flash_attention(q, k, v, causal=True).reshape(B, Lq, H)
+        h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
+        m_in = ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"])
+        tokens = m_in.reshape(B * Lq, H)
+        out, aux = moe_ffn(tokens, sl["blocks_gate_w"], sl["blocks_expert_w1"],
+                           sl["blocks_expert_b1"], sl["blocks_expert_w2"],
+                           sl["blocks_expert_b2"], k=c.top_k,
+                           capacity_factor=c.capacity_factor, mesh=mesh,
+                           expert_axis=c.expert_axis)
+        return h + out.reshape(B, Lq, H), aux
+
+    def scan_blocks(self, params, h, mesh=None, remat=True):
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+        fn = self.block_fn
+        if remat:
+            fn = jax.checkpoint(lambda sl, hh: self.block_fn(sl, hh, mesh))
+        else:
+            fn = lambda sl, hh: self.block_fn(sl, hh, mesh)
+
+        def body(carry, sl):
+            hh, aux_sum = carry
+            hh, aux = fn(sl, hh)
+            return (hh, aux_sum + aux), None
+
+        (out, aux_sum), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                                         stacked)
+        return out, aux_sum
+
+    def head_loss_fn(self, params, h, labels, aux_sum=0.0):
+        c = self.config
+        x32 = h.astype(jnp.float32)
+        m = x32.mean(-1, keepdims=True)
+        v = x32.var(-1, keepdims=True)
+        hn = (x32 - m) * jax.lax.rsqrt(v + c.layer_norm_epsilon) * params["lnf_w"] \
+            + params["lnf_b"]
+        dt = jnp.dtype(c.compute_dtype)
+        logits = (hn.astype(dt) @ params["wte"].astype(dt).T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        return nll + c.aux_loss_weight * aux_sum
+
+    # ------------------------------------------------------------- nn.Layer
+    def forward(self, input_ids, labels=None):
+        raw = getattr(input_ids, "_data", input_ids)
+        params = {n: p._data for n, p in self.named_parameters()}
+        h = self.embed_fn(params, raw)
+        h, aux = self.scan_blocks(params, h, remat=False)
+        if labels is None:
+            c = self.config
+            x32 = h.astype(jnp.float32)
+            m = x32.mean(-1, keepdims=True)
+            v = x32.var(-1, keepdims=True)
+            hn = (x32 - m) * jax.lax.rsqrt(v + c.layer_norm_epsilon) \
+                * params["lnf_w"] + params["lnf_b"]
+            logits = hn @ params["wte"].astype(jnp.float32).T
+            return Tensor(logits) if isinstance(input_ids, Tensor) else logits
+        raw_labels = getattr(labels, "_data", labels)
+        loss = self.head_loss_fn(params, h, raw_labels, aux)
+        return Tensor(loss) if isinstance(input_ids, Tensor) else loss
+
+
+def make_ernie_moe_train_step(model: ErnieMoeModel, optimizer, hcg,
+                              remat: bool = True, donate: bool = True):
+    """Expert-parallel (+dp/mp) train step over the hybrid mesh."""
+    from ..distributed.spmd import make_gspmd_step_from_loss
+
+    mesh = hcg.mesh
+    params0 = {n: p._data for n, p in model.named_parameters()}
+
+    def loss_of(params, input_ids, labels):
+        h = model.embed_fn(params, input_ids)
+        h, aux = model.scan_blocks(params, h, mesh=mesh, remat=remat)
+        return model.head_loss_fn(params, h, labels, aux)
+
+    return make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh,
+                                     layer=model, donate=donate)

@@ -56,6 +56,9 @@ class GPTConfig:
         self.tie_word_embeddings = tie_word_embeddings
         # None → GSPMD decides (sequence gathered for attention);
         # "ring"/"ulysses" → explicit context parallelism over the "sep" axis
+        if sequence_parallel not in (None, "ring", "ulysses"):
+            raise ValueError(f"sequence_parallel must be None, 'ring' or "
+                             f"'ulysses', got {sequence_parallel!r}")
         self.sequence_parallel = sequence_parallel
 
 
@@ -165,8 +168,14 @@ class GPTModel(Layer):
         v = v.reshape(B, Lq, nh, hd)
         sp_mode = getattr(c, "sequence_parallel", None)
         mesh = sp_mesh
-        if sp_mode and mesh is not None and mesh.shape.get("sep", 1) > 1 \
-                and Lq % mesh.shape["sep"] == 0:
+        if sp_mode and mesh is not None and mesh.shape.get("sep", 1) > 1:
+            if Lq % mesh.shape["sep"] != 0:
+                # never fall back silently — gathered attention is exactly the
+                # O(L) per-device memory blowup the user opted out of
+                raise ValueError(
+                    f"sequence_parallel={sp_mode!r} needs seq_len ({Lq}) "
+                    f"divisible by the sep degree ({mesh.shape['sep']}); pad "
+                    f"the sequence or change sep_degree")
             # context parallelism: activations stay sequence-sharded on "sep";
             # ring/Ulysses attention inside a partial-manual shard_map region
             # (only "sep" is manual — dp/mp stay under GSPMD)
@@ -260,7 +269,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     the mesh has pipe>1.  step(state, key, lr, input_ids, labels) -> (state, loss).
     """
     from ..distributed.pipeline_engine import make_stacked_pipeline_step
-    from ..distributed.spmd import build_param_specs, build_state_shardings
+    from ..distributed.spmd import make_gspmd_step_from_loss
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = hcg.mesh
@@ -281,11 +290,6 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
             max(n_microbatches, S), model.stacked_param_names(), layer=model,
             donate=donate, remat=remat)
 
-    p_specs = build_param_specs(params0, mesh, model, 0)
-    opt_state0 = optimizer.init_state(params0)
-    state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
-    state_sh = build_state_shardings(state0, p_specs, mesh, 1, params0)
-
     seq_spec = None
     if "sep" in mesh.shape and mesh.shape["sep"] > 1:
         seq_spec = P("data", "sep", None)
@@ -299,17 +303,10 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         h = model.scan_blocks(params, h, key, remat=remat, sp_mesh=sp_mesh)
         return model.head_loss_fn(params, h, labels)
 
-    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    inner_step, state0 = make_gspmd_step_from_loss(
+        loss_of, params0, optimizer, mesh, layer=model, donate=donate)
+
     def step(state, key, lr, x, labels):
-        loss, grads = jax.value_and_grad(loss_of)(state["params"], key, x, labels)
-        new_params, new_opt = optimizer.update(grads, state["opt"], state["params"],
-                                               lr=lr)
-        new_params = jax.lax.with_sharding_constraint(
-            new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
-        return {"params": new_params, "opt": new_opt, "buffers": {}}, loss
+        return inner_step(state, lr, key, x, labels)
 
-    def place(state):
-        return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), state,
-                                      state_sh, is_leaf=lambda x: hasattr(x, "shape"))
-
-    return step, place(state0)
+    return step, state0

@@ -5,6 +5,7 @@ from .containers import LayerDict, LayerList, ParameterList, Sequential  # noqa:
 from .conv import (Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D,  # noqa: F401
                    Conv3DTranspose)
 from .loss import *  # noqa: F401,F403
+from .moe import MoELayer  # noqa: F401
 from .norm import (BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm,  # noqa: F401
                    InstanceNorm1D, InstanceNorm2D, InstanceNorm3D, LayerNorm,
                    LocalResponseNorm, SpectralNorm, SyncBatchNorm)

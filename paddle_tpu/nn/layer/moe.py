@@ -1,0 +1,81 @@
+"""Mixture-of-Experts layer (dygraph-style wrapper over ops.moe).
+
+The reference ships the EP transport (global_scatter/global_gather,
+distributed/utils.py:57,179) but keeps the gate + MoE layer in downstream
+repos; this build provides both.  Experts are a stacked parameter pytree
+(E leading dim) so expert parallelism is just a sharding annotation on the
+expert axis — no per-expert Python modules to keep in sync.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import rng
+from ...core.tensor import Tensor, apply
+from ..initializer import Normal
+from .base import Layer
+
+
+class MoELayer(Layer):
+    """Top-k routed mixture of expert FFNs.
+
+    Args:
+      d_model: token hidden size.
+      d_hidden: expert FFN intermediate size.
+      num_experts: total experts (global, across the expert mesh axis).
+      top_k: experts per token (1 = Switch, 2 = GShard).
+      capacity_factor: per-expert buffer slack.
+      expert_axis: mesh axis experts shard over (set via ``set_mesh``).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 expert_axis: str = "data", gate_jitter: bool = False,
+                 activation=jax.nn.gelu, name=None):
+        super().__init__()
+        self.d_model, self.d_hidden = d_model, d_hidden
+        self.num_experts, self.top_k = num_experts, top_k
+        self.capacity_factor = capacity_factor
+        self.expert_axis = expert_axis
+        self.gate_jitter = gate_jitter
+        self.activation = activation
+        self._mesh = None
+        E, H, I = num_experts, d_model, d_hidden
+        init = Normal(0.0, 0.02)
+        self.gate_weight = self.create_parameter([H, E], default_initializer=init)
+        self.expert_w1 = self.create_parameter([E, H, I], default_initializer=init)
+        self.expert_b1 = self.create_parameter(
+            [E, I], default_initializer=lambda s, d: jnp.zeros(s, d))
+        self.expert_w2 = self.create_parameter([E, I, H], default_initializer=init)
+        self.expert_b2 = self.create_parameter(
+            [E, H], default_initializer=lambda s, d: jnp.zeros(s, d))
+        self.aux_loss = None  # set on every forward
+
+    def set_mesh(self, mesh):
+        """Enable expert parallelism over ``self.expert_axis`` of ``mesh``."""
+        self._mesh = mesh
+        return self
+
+    def forward(self, x):
+        from ...ops.moe import moe_ffn
+        jitter_key = rng.next_key() if (self.gate_jitter and self.training) else None
+
+        def f(x_, gw, w1, b1, w2, b2):
+            shape = x_.shape
+            tokens = x_.reshape(-1, self.d_model)
+            out, aux = moe_ffn(tokens, gw, w1, b1, w2, b2, k=self.top_k,
+                               capacity_factor=self.capacity_factor,
+                               mesh=self._mesh, expert_axis=self.expert_axis,
+                               jitter_key=jitter_key, activation=self.activation)
+            return out.reshape(shape), aux
+
+        out, aux = apply(f, x, self.gate_weight, self.expert_w1, self.expert_b1,
+                         self.expert_w2, self.expert_b2)
+        self.aux_loss = aux
+        return out
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, d_hidden={self.d_hidden}, "
+                f"num_experts={self.num_experts}, top_k={self.top_k}")

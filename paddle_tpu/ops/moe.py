@@ -1,0 +1,128 @@
+"""Mixture-of-Experts: gating, capacity dispatch, expert parallelism.
+
+Reference capability: expert parallelism via ``global_scatter``/``global_gather``
+(python/paddle/distributed/utils.py:57,179 — ragged ncclSend/Recv loops keyed
+by per-expert counts, operators/collective/global_scatter_op.cu.cc) plus
+``alltoall`` (collective.py:1488).  The gating network itself lives in
+downstream repos (SURVEY.md §2.4 EP row).
+
+TPU-native design: XLA requires static shapes, so the ragged count-driven
+exchange becomes **capacity-based dispatch** (GShard/Switch style): each
+expert receives at most ``capacity`` tokens; dispatch/combine are one-hot
+einsum contractions; expert layout rides a mesh axis and the cross-device
+exchange is the all_to_all GSPMD infers from the sharding constraint on the
+``(E, C, H)`` dispatched tensor (≙ the whole global_scatter/gather machinery).
+Overflowed tokens pass through the residual connection (standard practice).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def topk_gating(logits, k: int = 2, capacity: Optional[int] = None,
+                capacity_factor: float = 1.25, jitter_key=None):
+    """Top-k gating with static per-expert capacity.
+
+    Args:
+      logits: (T, E) raw gate scores.
+      k: experts per token (1 = Switch, 2 = GShard default).
+      capacity: tokens per expert; default ceil(k * T / E * capacity_factor),
+        rounded up to a multiple of 4 for TPU-friendly tiling.
+    Returns:
+      combine:  (T, E, C) float — combine weights (gate probs at slot).
+      dispatch: (T, E, C) bool  — dispatch mask.
+      aux_loss: scalar load-balancing loss (Switch §2.2: E * Σ_e m_e · c_e).
+    """
+    T, E = logits.shape
+    if capacity is None:
+        capacity = int(math.ceil(k * T / E * capacity_factor))
+        capacity = max(4, -(-capacity // 4) * 4)
+    C = capacity
+    if jitter_key is not None:
+        logits = logits + jax.random.uniform(jitter_key, logits.shape,
+                                             logits.dtype, -1e-2, 1e-2)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    dispatch = jnp.zeros((T, E, C), bool)
+    # running number of tokens already assigned to each expert
+    fill = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    ce_acc = jnp.zeros((E,), jnp.float32)  # dispatched-token fractions
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                    # (T,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # (T, E)
+        # position of each token within its chosen expert's buffer
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot       # (T, E)
+        pos = jnp.sum(pos_in_e * onehot, axis=-1) + fill[idx]  # (T,)
+        keep = pos < C
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[:, None]
+        contrib = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        combine = combine + gate[:, None, None] * contrib
+        dispatch = dispatch | (contrib > 0)
+        fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        ce_acc = ce_acc + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        masked = jnp.where(onehot.astype(bool), -jnp.inf, masked)
+    me = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(me * ce_acc / k)
+    return combine, dispatch, aux_loss
+
+
+def moe_dispatch(x, dispatch):
+    """x: (T, H), dispatch: (T, E, C) → (E, C, H)."""
+    return jnp.einsum("th,tec->ech", x.astype(jnp.float32),
+                      dispatch.astype(jnp.float32)).astype(x.dtype)
+
+
+def moe_combine(expert_out, combine, dtype=None):
+    """expert_out: (E, C, H), combine: (T, E, C) → (T, H)."""
+    out = jnp.einsum("ech,tec->th", expert_out.astype(jnp.float32), combine)
+    return out.astype(dtype or expert_out.dtype)
+
+
+def expert_ffn(expert_in, w1, b1, w2, b2, activation=jax.nn.gelu):
+    """Per-expert FFN. expert_in: (E, C, H); w1: (E, H, I); w2: (E, I, H)."""
+    dt = expert_in.dtype
+    h = jnp.einsum("ech,ehi->eci", expert_in, w1.astype(dt)) + \
+        b1.astype(dt)[:, None, :]
+    h = activation(h)
+    return jnp.einsum("eci,eih->ech", h, w2.astype(dt)) + \
+        b2.astype(dt)[:, None, :]
+
+
+def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
+            capacity_factor: float = 1.25, mesh=None, expert_axis: str = "data",
+            jitter_key=None, activation=jax.nn.gelu):
+    """Full MoE FFN over tokens, with optional expert parallelism.
+
+    x: (T, H) tokens.  Experts sharded over ``expert_axis`` when ``mesh`` is
+    given: the sharding constraint on the (E, C, H) dispatched tensor makes
+    GSPMD emit the token all_to_all (≙ global_scatter), and the constraint
+    back to token layout after the expert FFN emits the reverse exchange
+    (≙ global_gather).
+
+    Returns (out (T, H), aux_loss).
+    """
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (T, E)
+    combine, dispatch, aux = topk_gating(logits, k=k,
+                                         capacity_factor=capacity_factor,
+                                         jitter_key=jitter_key)
+    expert_in = moe_dispatch(x, dispatch)                        # (E, C, H)
+    if mesh is not None and mesh.shape.get(expert_axis, 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = NamedSharding(mesh, P(expert_axis, None, None))
+        expert_in = lax.with_sharding_constraint(expert_in, spec)
+    expert_out = expert_ffn(expert_in, w1, b1, w2, b2, activation)
+    if mesh is not None and mesh.shape.get(expert_axis, 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        expert_out = lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(expert_axis, None, None)))
+    out = moe_combine(expert_out, combine, dtype=x.dtype)
+    return out, aux

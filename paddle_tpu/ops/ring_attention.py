@@ -77,7 +77,19 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     def step(carry, t):
         k_blk, v_blk, m, l, acc = carry
         # which global chunk this k/v block came from after t rotations
-        m, l, acc = accumulate(k_blk, v_blk, (idx - t) % sp, m, l, acc)
+        blk_idx = (idx - t) % sp
+        if causal:
+            # skip blocks entirely in the masked future (blk_idx > idx):
+            # on average (sp-1)/2 of sp blocks — halves the wasted FLOPs.
+            # (Load stays imbalanced across ranks within a step; a zigzag
+            # block order would fix that too — future work.)
+            m, l, acc = lax.cond(
+                blk_idx <= idx,
+                lambda a, b, c_, d, e: accumulate(a, b, blk_idx, c_, d, e),
+                lambda a, b, c_, d, e: (c_, d, e),
+                k_blk, v_blk, m, l, acc)
+        else:
+            m, l, acc = accumulate(k_blk, v_blk, blk_idx, m, l, acc)
         # rotate k/v to the next rank (ring over ICI neighbors)
         perm = [(i, (i + 1) % sp) for i in range(sp)]
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
@@ -101,7 +113,15 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
             step, (k, v, m0, l0, acc0), jnp.arange(sp - 1))
     else:
         k_last, v_last, m, l, acc = k, v, m0, l0, acc0
-    m, l, acc = accumulate(k_last, v_last, (idx - (sp - 1)) % sp, m, l, acc)
+    last_idx = (idx - (sp - 1)) % sp
+    if causal and sp > 1:
+        m, l, acc = lax.cond(
+            last_idx <= idx,
+            lambda a, b, c_, d, e: accumulate(a, b, last_idx, c_, d, e),
+            lambda a, b, c_, d, e: (c_, d, e),
+            k_last, v_last, m, l, acc)
+    else:
+        m, l, acc = accumulate(k_last, v_last, last_idx, m, l, acc)
     out = acc / jnp.maximum(l, 1e-30)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,Lc,H,D)
 
