@@ -1,0 +1,7 @@
+"""``python -m paddle_tpu.distributed.launch`` passthrough (reference:
+python -m paddle.distributed.launch)."""
+
+from .launch import launch
+import sys
+
+sys.exit(launch())

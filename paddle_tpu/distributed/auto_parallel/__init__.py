@@ -1,0 +1,150 @@
+"""Auto-parallel (semi-automatic SPMD) annotation API.
+
+Reference: python/paddle/distributed/auto_parallel/ (SURVEY.md §2.4) —
+``ProcessMesh`` (process_mesh.py), ``shard_tensor``/``shard_op``
+(interface.py), per-tensor DistributedAttribute {process_mesh, dims_mapping}
+(dist_attribute.py), plus a 9.6K-LoC propagation/partition/reshard engine
+(completion.py:429, partitioner.py:39, reshard.py).
+
+TPU-native: the user-facing annotation API is kept; the entire propagation
+engine is deleted — ``dims_mapping`` lowers directly to a
+``jax.sharding.NamedSharding`` and **GSPMD propagation** (XLA's sharding
+completion) does what completion.py/partitioner.py/reshard.py did, at
+compile time, provably consistently.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Union
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "get_mesh", "set_mesh"]
+
+_current_mesh: Optional["ProcessMesh"] = None
+
+
+class ProcessMesh:
+    """N-D logical process topology (reference process_mesh.py; IR twin
+    ProcessMeshDesc framework.proto:41).  Wraps a jax Mesh."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray],
+                 dim_names: Optional[List[str]] = None, process_ids=None):
+        arr = np.asarray(mesh)
+        self.shape = list(arr.shape)
+        self.ndim = arr.ndim
+        self.dim_names = list(dim_names) if dim_names else \
+            [f"d{i}" for i in range(arr.ndim)]
+        self.process_ids = arr.reshape(-1).tolist()
+        from ...core.device import local_devices
+        devs = local_devices()
+        if len(devs) < arr.size:
+            raise ValueError(f"ProcessMesh needs {arr.size} devices, "
+                             f"have {len(devs)}")
+        dev_arr = np.array([devs[int(i)] for i in arr.reshape(-1)]).reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    def __enter__(self):
+        global _current_mesh
+        self._prev = _current_mesh
+        _current_mesh = self
+        return self
+
+    def __exit__(self, *exc):
+        global _current_mesh
+        _current_mesh = self._prev
+        return False
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            self.shape == other.shape and self.process_ids == other.process_ids
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names}, "
+                f"process_ids={self.process_ids})")
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _current_mesh
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def _spec_from_dims_mapping(ndim: int, dims_mapping, mesh: ProcessMesh) -> P:
+    """dims_mapping: list of mesh-dim index per tensor dim (-1 = replicate) —
+    the reference's dist_attribute encoding — or a list of dim *names*."""
+    entries = []
+    for d in range(ndim):
+        m = dims_mapping[d] if d < len(dims_mapping) else -1
+        if m is None or m == -1:
+            entries.append(None)
+        elif isinstance(m, str):
+            entries.append(m)
+        else:
+            entries.append(mesh.dim_names[int(m)])
+    return P(*entries)
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 dims_mapping: Optional[Sequence] = None, **kw):
+    """Place/annotate a tensor with a mesh sharding (reference interface.py
+    ``shard_tensor``).  Eager: device_put with NamedSharding.  Traced (inside
+    jit): with_sharding_constraint — GSPMD propagates from there."""
+    pm = process_mesh or _current_mesh
+    if pm is None:
+        raise ValueError("no ProcessMesh: pass process_mesh= or use "
+                         "`with ProcessMesh(...)`")
+    raw = getattr(x, "_data", x)
+    spec = _spec_from_dims_mapping(getattr(raw, "ndim", len(raw.shape)),
+                                   list(dims_mapping or []), pm)
+    sh = NamedSharding(pm.mesh, spec)
+    if isinstance(raw, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(raw, sh)
+    else:
+        out = jax.device_put(raw, sh)
+    if isinstance(x, Tensor):
+        t = Tensor(out)
+        t.stop_gradient = x.stop_gradient
+        return t
+    return out
+
+
+def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
+             in_dims_mappings: Optional[List] = None,
+             out_dims_mappings: Optional[List] = None):
+    """Annotate an op's inputs/outputs with shardings (reference
+    interface.py ``shard_op``).  Returns a wrapped callable; GSPMD decides
+    everything not annotated."""
+    pm = process_mesh or _current_mesh
+
+    def wrapped(*args, **kwargs):
+        mesh = pm or _current_mesh
+        if mesh is None:
+            return op_fn(*args, **kwargs)
+        a = list(args)
+        if in_dims_mappings:
+            for i, dm in enumerate(in_dims_mappings):
+                if dm is not None and i < len(a):
+                    a[i] = shard_tensor(a[i], mesh, dm)
+        out = op_fn(*a, **kwargs)
+        if out_dims_mappings:
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for i, dm in enumerate(out_dims_mappings):
+                if dm is not None and i < len(outs):
+                    outs[i] = shard_tensor(outs[i], mesh, dm)
+            out = type(out)(outs) if isinstance(out, (tuple, list)) else outs[0]
+        return out
+
+    return wrapped

@@ -1,0 +1,144 @@
+"""Elastic training manager.
+
+Reference: fleet/elastic/manager.py:130 ``ElasticManager`` — etcd-backed host
+registration, heartbeat lease (:250), node-change watch (:234), two levels
+(fault-tolerant restart vs true scale-in/out :178), exit-code protocol
+(101 restart, 102 rescale).
+
+TPU-native: membership lives in a shared-filesystem store (GCS/NFS path —
+etcd is not a TPU-pod given) with per-host heartbeat files; a scale event
+maps to *checkpoint → exit(101) → relaunch → re-compile with the new mesh*,
+because XLA programs are specialized on mesh shape (re-compile ≙ the
+reference's program re-build after env rewrite).  The launcher
+(distributed/launch.py) honors the same exit codes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+ELASTIC_EXIT_CODE = 101      # relaunch with same world
+RESCALE_EXIT_CODE = 102      # relaunch with new world size
+
+ElasticLevel = type("ElasticLevel", (), {"FAULT_TOLERANCE": 1, "ELASTIC": 2})
+
+
+class ElasticManager:
+    """File-store membership + heartbeat; decides when the world changed."""
+
+    def __init__(self, store_dir: str, rank: Optional[int] = None,
+                 np_range: str = "", heartbeat_interval: float = 2.0,
+                 lease_ttl: float = 10.0):
+        from .. import env
+        self.store_dir = store_dir
+        self.rank = env.get_rank() if rank is None else rank
+        self.interval = heartbeat_interval
+        self.ttl = lease_ttl
+        lo, _, hi = str(np_range).partition(":")
+        self.np_min = int(lo) if lo else 1
+        self.np_max = int(hi) if hi else max(self.np_min, env.get_world_size())
+        self.elastic_level = (ElasticLevel.ELASTIC if hi
+                              else ElasticLevel.FAULT_TOLERANCE)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._world_at_start: Optional[List[int]] = None
+        os.makedirs(store_dir, exist_ok=True)
+
+    # ------------------------------------------------------------ membership
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.store_dir, f"host-{rank}.json")
+
+    def register(self):
+        """Write this host's heartbeat file and start the lease thread
+        (≙ manager.py:250 heartbeat lease).  The membership baseline is NOT
+        taken here — peers may still be joining; it is snapshotted on the
+        first ``exit_code()`` check (i.e. when training actually starts) or
+        explicitly via ``refresh_world()``."""
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def refresh_world(self):
+        """Re-baseline membership (call after a rescale/restart completes)."""
+        self._world_at_start = self.alive_ranks()
+        return self._world_at_start
+
+    def _beat(self):
+        tmp = self._hb_path(self.rank) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rank": self.rank, "ts": time.time()}, f)
+        os.replace(tmp, self._hb_path(self.rank))
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self._beat()
+
+    def alive_ranks(self, now: Optional[float] = None) -> List[int]:
+        now = time.time() if now is None else now
+        out = []
+        for fn in os.listdir(self.store_dir):
+            if not fn.startswith("host-") or not fn.endswith(".json"):
+                continue
+            try:
+                with open(os.path.join(self.store_dir, fn)) as f:
+                    rec = json.load(f)
+                if now - rec["ts"] <= self.ttl:
+                    out.append(int(rec["rank"]))
+            except (OSError, ValueError, KeyError):
+                continue
+        return sorted(out)
+
+    # ------------------------------------------------------------- decisions
+    def world_changed(self) -> bool:
+        if self._world_at_start is None:
+            self.refresh_world()
+        return self.alive_ranks() != self._world_at_start
+
+    def exit_code(self) -> Optional[int]:
+        """None = keep training; 101 = restart same world (a peer bounced);
+        102 = rescale (world grew/shrank within [np_min, np_max])."""
+        if self._world_at_start is None:
+            self.refresh_world()
+        alive = self.alive_ranks()
+        if alive == self._world_at_start:
+            return None
+        if len(alive) < self.np_min:
+            return ELASTIC_EXIT_CODE  # too few — wait-and-restart
+        if self.elastic_level == ElasticLevel.ELASTIC and \
+                len(alive) != len(self._world_at_start):
+            return RESCALE_EXIT_CODE
+        return ELASTIC_EXIT_CODE
+
+    def run_with_checkpoint(self, train_fn: Callable[[], None],
+                            save_fn: Callable[[], None],
+                            check_every: float = 5.0):
+        """Drive ``train_fn`` (which returns per 'epoch'); on membership
+        change, call ``save_fn`` and exit with the protocol code so the
+        launcher relaunches and the job resumes from checkpoint with a
+        freshly compiled mesh."""
+        import sys
+        last = time.time()
+        while True:
+            more = train_fn()
+            if time.time() - last >= check_every:
+                last = time.time()
+                code = self.exit_code()
+                if code is not None:
+                    save_fn()
+                    sys.exit(code)
+            if not more:
+                return
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 2)
+        try:
+            os.remove(self._hb_path(self.rank))
+        except OSError:
+            pass

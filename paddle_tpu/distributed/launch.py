@@ -1,0 +1,126 @@
+"""Process launcher: ``python -m paddle_tpu.distributed.launch``.
+
+Reference: python/paddle/distributed/launch.py → fleet/launch.py —
+``launch_collective`` (launch.py:333) builds a Cluster/Pod, spawns one
+process per device with PADDLE_* env vars (launch_utils.py), watches
+children and aborts/restarts on failure; elastic mode re-execs with a new
+world (fleet/elastic/manager.py:130).
+
+TPU-native: one process per *host* (not per chip — XLA owns all local chips
+in a single process), ``jax.distributed`` coordination service in place of
+the TCP comm-id rendezvous, and the watch loop keeps the reference's
+exit-code protocol (ELASTIC_EXIT_CODE=101 → relaunch with current peers).
+On a single host with N chips the launcher simply runs ONE process: device
+parallelism comes from the mesh, so nproc_per_node exists only for
+CPU-simulation (`--devices cpu --nproc N` sets
+xla_force_host_platform_device_count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+ELASTIC_EXIT_CODE = 101  # reference fleet/elastic: restart-me protocol
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed paddle_tpu training job")
+    p.add_argument("--nnodes", type=str, default="1",
+                   help="node count, or elastic range 'min:max'")
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""),
+                   help="coordinator host:port (first node's address)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (TPU: leave 1 — XLA owns all "
+                        "local chips; >1 only for CPU simulation)")
+    p.add_argument("--devices", type=str, default="",
+                   help="'cpu' forces CPU simulation with "
+                        "xla_force_host_platform_device_count=nproc_per_node")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="restarts allowed on ELASTIC_EXIT_CODE before giving up")
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _child_env(args, local_rank: int, world: int) -> dict:
+    env = dict(os.environ)
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    env["PADDLE_LOCAL_RANK"] = str(local_rank)
+    env["FLAGS_selected_tpus"] = str(local_rank)
+    if args.devices == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PADDLE_TPU_PLATFORM"] = "cpu"
+        prev = env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in prev:
+            env["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count="
+                                + str(max(args.nproc_per_node, 1))).strip()
+    return env
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    world = nnodes * args.nproc_per_node if args.devices == "cpu" else nnodes
+    nproc = args.nproc_per_node if args.devices == "cpu" else 1
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    restarts = 0
+    while True:
+        procs = []
+        for lr in range(nproc):
+            log = open(os.path.join(args.log_dir, f"workerlog.{lr}"), "a")
+            cmd = [sys.executable, args.training_script] + args.training_script_args
+            procs.append((subprocess.Popen(
+                cmd, env=_child_env(args, lr, world),
+                stdout=log if lr > 0 else None,
+                stderr=subprocess.STDOUT if lr > 0 else None), log))
+
+        # watch loop (≙ launch_utils.py watch_local_trainers): abort the pod
+        # if any child fails; honor the elastic restart exit code
+        exit_code, restart = 0, False
+        try:
+            alive = {p.pid: p for p, _ in procs}
+            while alive:
+                for pid, p in list(alive.items()):
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    del alive[pid]
+                    if rc == ELASTIC_EXIT_CODE:
+                        restart = True
+                    elif rc != 0:
+                        exit_code = rc
+                        for q in alive.values():
+                            q.send_signal(signal.SIGTERM)
+                        alive = {}
+                        break
+                time.sleep(0.5)
+        finally:
+            for _, log in procs:
+                log.close()
+
+        if restart and restarts < args.max_restarts and exit_code == 0:
+            restarts += 1
+            print(f"[launch] elastic restart {restarts}/{args.max_restarts}",
+                  file=sys.stderr)
+            continue
+        return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

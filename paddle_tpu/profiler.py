@@ -1,0 +1,148 @@
+"""Profiler (reference API shape: platform/profiler.h ``RecordEvent``:130,
+``EnableProfiler/DisableProfiler``:216-219 + python fluid/profiler.py:133,200,257
+start/stop/context-manager and the chrome-trace export via tools/timeline.py;
+new-style python/paddle/profiler Profiler class).
+
+TPU-native: backed by jax.profiler — traces are XPlane protos viewable in
+TensorBoard/Perfetto (the chrome-trace viewer role of timeline.py), host-side
+annotations via TraceAnnotation (≙ RecordEvent RAII), and a step-level wall
+clock summary table (≙ the aggregated profiler tables).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "start_profiler", "stop_profiler",
+           "profiler", "summary"]
+
+_events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_active_dir: Optional[str] = None
+
+
+class RecordEvent:
+    """Annotate a host-side region; shows up on the XPlane timeline and in
+    the local summary table (≙ platform::RecordEvent RAII)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        rec = _events[self.name]
+        rec[0] += 1
+        rec[1] += dt
+        return self._ann.__exit__(*exc)
+
+    # fluid/profiler API aliases
+    begin = __enter__
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def start_profiler(log_dir: str = "./profiler_log", state: str = "All",
+                   tracer_option: str = "Default"):
+    """≙ fluid/profiler.py:200 start_profiler (state/tracer_option accepted
+    for API parity; the XPlane trace always captures host+device)."""
+    global _active_dir
+    if _active_dir is not None:
+        return
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir)
+    _active_dir = log_dir
+
+
+def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
+    """≙ fluid/profiler.py:257 stop_profiler; prints the aggregated event
+    table and finalizes the trace directory."""
+    global _active_dir
+    if _active_dir is None:
+        return
+    jax.profiler.stop_trace()
+    print(summary(sorted_key))
+    print(f"[profiler] trace written to {_active_dir} "
+          f"(open with TensorBoard / xprof)")
+    _active_dir = None
+
+
+@contextlib.contextmanager
+def profiler(state: str = "All", sorted_key: str = "total",
+             profile_path: str = "./profiler_log"):
+    """≙ fluid/profiler.py:133 context manager."""
+    start_profiler(profile_path, state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key)
+
+
+def summary(sorted_key: str = "total") -> str:
+    """Aggregated host-event table (≙ the reference's profiler summary)."""
+    rows = [(name, c, tot, tot / max(c, 1))
+            for name, (c, tot) in _events.items()]
+    key = {"total": 2, "calls": 1, "ave": 3}.get(sorted_key, 2)
+    rows.sort(key=lambda r: r[key], reverse=True)
+    lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"]
+    lines += [f"{n:<40}{c:>8}{t:>12.4f}{a:>12.6f}" for n, c, t, a in rows]
+    return "\n".join(lines)
+
+
+class Profiler:
+    """New-style API (reference python/paddle/profiler/profiler.py):
+    ``Profiler(on_trace_ready=...)`` with start/stop/step."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 log_dir: str = "./profiler_log", timer_only: bool = False):
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.on_trace_ready = on_trace_ready
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        if not self.timer_only:
+            start_profiler(self.log_dir)
+        self._last = time.perf_counter()
+        return self
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+
+    def stop(self):
+        if not self.timer_only:
+            stop_profiler()
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step_info(self) -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+        ts = np.asarray(self._step_times)
+        return (f"steps={len(ts)} avg={ts.mean()*1e3:.2f}ms "
+                f"p50={np.percentile(ts,50)*1e3:.2f}ms "
+                f"p99={np.percentile(ts,99)*1e3:.2f}ms")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

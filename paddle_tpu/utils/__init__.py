@@ -1,0 +1,13 @@
+"""``paddle_tpu.utils`` (reference: python/paddle/utils/)."""
+
+from .. import profiler  # noqa: F401  (paddle.utils.profiler parity)
+
+
+def try_import(name: str):
+    """Reference: paddle/utils/lazy_import.py."""
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"optional dependency {name!r} is not available "
+                          f"in this environment") from e

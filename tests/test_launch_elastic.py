@@ -1,0 +1,88 @@
+"""Launcher + elastic manager tests (reference: launch_utils watch loop and
+fleet/elastic/manager.py heartbeat/membership semantics)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  RESCALE_EXIT_CODE,
+                                                  ElasticManager)
+
+
+class TestElasticManager:
+    def test_heartbeat_and_membership(self, tmp_path):
+        m0 = ElasticManager(str(tmp_path), rank=0, heartbeat_interval=0.1,
+                            lease_ttl=1.0).register()
+        m1 = ElasticManager(str(tmp_path), rank=1, heartbeat_interval=0.1,
+                            lease_ttl=1.0).register()
+        assert m0.alive_ranks() == [0, 1]
+        assert m0.exit_code() is None  # steady state
+        m1.stop()
+        time.sleep(0.2)
+        assert m0.alive_ranks() == [0]
+        # fault-tolerance level: peer loss → restart code
+        assert m0.exit_code() == ELASTIC_EXIT_CODE
+        m0.stop()
+
+    def test_rescale_code_in_elastic_mode(self, tmp_path):
+        m0 = ElasticManager(str(tmp_path), rank=0, np_range="1:4",
+                            heartbeat_interval=0.1, lease_ttl=5.0).register()
+        assert m0.exit_code() is None
+        # a new host joins → world grew → rescale
+        m2 = ElasticManager(str(tmp_path), rank=2, np_range="1:4",
+                            heartbeat_interval=0.1, lease_ttl=5.0).register()
+        assert m0.exit_code() == RESCALE_EXIT_CODE
+        m0.stop(); m2.stop()
+
+    def test_lease_expiry(self, tmp_path):
+        m = ElasticManager(str(tmp_path), rank=0, heartbeat_interval=10,
+                           lease_ttl=0.2)
+        m._beat()
+        assert m.alive_ranks() == [0]
+        time.sleep(0.3)
+        assert m.alive_ranks() == []
+
+
+@pytest.mark.skipif(os.environ.get("PADDLE_TPU_SKIP_SUBPROC") == "1",
+                    reason="subprocess tests disabled")
+class TestLauncher:
+    def _run_launch(self, tmp_path, script_body, extra=(), timeout=120):
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent(script_body))
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"), *extra, str(script)],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd="/root/repo")
+
+    def test_single_proc_env_contract(self, tmp_path):
+        r = self._run_launch(tmp_path, """
+            import os
+            assert os.environ["PADDLE_TRAINER_ID"] == "0"
+            assert os.environ["PADDLE_TRAINERS_NUM"] == "1"
+            print("ENV_OK")
+        """)
+        assert r.returncode == 0 and "ENV_OK" in r.stdout, r.stderr
+
+    def test_failure_propagates(self, tmp_path):
+        r = self._run_launch(tmp_path, "import sys; sys.exit(7)")
+        assert r.returncode == 7
+
+    def test_elastic_restart_then_success(self, tmp_path):
+        # first run exits 101 (elastic restart), relaunch succeeds
+        r = self._run_launch(tmp_path, """
+            import os, sys
+            flag = os.path.join(os.path.dirname(__file__), "ran_once")
+            if not os.path.exists(flag):
+                open(flag, "w").close()
+                sys.exit(101)
+            print("RESUMED")
+        """, extra=["--max_restarts", "2"])
+        assert r.returncode == 0 and "RESUMED" in r.stdout, r.stderr
