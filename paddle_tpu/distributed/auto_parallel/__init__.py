@@ -144,7 +144,12 @@ def shard_op(op_fn, process_mesh: Optional[ProcessMesh] = None,
             for i, dm in enumerate(out_dims_mappings):
                 if dm is not None and i < len(outs):
                     outs[i] = shard_tensor(outs[i], mesh, dm)
-            out = type(out)(outs) if isinstance(out, (tuple, list)) else outs[0]
+            if isinstance(out, tuple) and hasattr(out, "_fields"):
+                out = type(out)(*outs)  # namedtuple ctor takes *fields
+            elif isinstance(out, (tuple, list)):
+                out = type(out)(outs)
+            else:
+                out = outs[0]
         return out
 
     return wrapped

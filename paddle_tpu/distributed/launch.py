@@ -25,7 +25,8 @@ import subprocess
 import sys
 import time
 
-ELASTIC_EXIT_CODE = 101  # reference fleet/elastic: restart-me protocol
+ELASTIC_EXIT_CODE = 101   # reference fleet/elastic: restart-me protocol
+RESCALE_EXIT_CODE = 102   # restart with a recomputed world size
 
 
 def _parse_args(argv=None):
@@ -48,14 +49,19 @@ def _parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="restarts allowed on ELASTIC_EXIT_CODE before giving up")
+    p.add_argument("--elastic_store", type=str,
+                   default=os.environ.get("PADDLE_ELASTIC_STORE", ""),
+                   help="ElasticManager store dir; enables RESCALE (102) "
+                        "handling: world is recomputed from alive membership "
+                        "on relaunch")
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
 
 
-def _child_env(args, local_rank: int, world: int) -> dict:
+def _child_env(args, local_rank: int, world: int, nproc: int) -> dict:
     env = dict(os.environ)
-    rank = args.node_rank * args.nproc_per_node + local_rank
+    rank = args.node_rank * nproc + local_rank
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(world)
     if args.master:
@@ -68,8 +74,50 @@ def _child_env(args, local_rank: int, world: int) -> dict:
         prev = env.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in prev:
             env["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count="
-                                + str(max(args.nproc_per_node, 1))).strip()
+                                + str(max(nproc, 1))).strip()
     return env
+
+
+def _rescaled_world(args, world: int, nproc: int):
+    """Recompute (world, nproc) from alive elastic-store membership.
+
+    ≙ fleet/elastic/manager.py: on RESCALE the new world is the set of hosts
+    with fresh heartbeat leases.  Without a store we can only restart with
+    the same world (and say so).
+    """
+    if not args.elastic_store or not os.path.isdir(args.elastic_store):
+        print("[launch] RESCALE requested but no --elastic_store; "
+              "relaunching with unchanged world", file=sys.stderr)
+        return world, nproc
+    import json
+    ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", "10"))
+    now, alive = time.time(), 0
+    for fn in os.listdir(args.elastic_store):
+        if fn.startswith("host-") and fn.endswith(".json"):
+            try:
+                with open(os.path.join(args.elastic_store, fn)) as f:
+                    if now - json.load(f)["ts"] <= ttl:
+                        alive += 1
+            except (OSError, ValueError, KeyError):
+                continue
+    lo, _, hi = str(args.nnodes).partition(":")
+    np_min = int(lo) if lo else 1
+    np_max = int(hi) if hi else np_min  # fixed --nnodes N means N is the cap
+    single_node = np_max <= 1
+    if args.devices == "cpu":
+        # the nnodes range counts nodes; the simulated world counts processes
+        np_min *= max(args.nproc_per_node, 1)
+        np_max *= max(args.nproc_per_node, 1)
+    new_world = max(np_min, min(alive or world, np_max))
+    if args.devices == "cpu":
+        if single_node:
+            # children are the simulated "hosts", so nproc tracks the world
+            return new_world, new_world
+        print("[launch] multi-node CPU-sim rescale keeps nproc_per_node "
+              "(per-node process counts cannot be re-split safely)",
+              file=sys.stderr)
+        return new_world, nproc
+    return new_world, nproc
 
 
 def launch(argv=None) -> int:
@@ -86,13 +134,13 @@ def launch(argv=None) -> int:
             log = open(os.path.join(args.log_dir, f"workerlog.{lr}"), "a")
             cmd = [sys.executable, args.training_script] + args.training_script_args
             procs.append((subprocess.Popen(
-                cmd, env=_child_env(args, lr, world),
+                cmd, env=_child_env(args, lr, world, nproc),
                 stdout=log if lr > 0 else None,
                 stderr=subprocess.STDOUT if lr > 0 else None), log))
 
         # watch loop (≙ launch_utils.py watch_local_trainers): abort the pod
-        # if any child fails; honor the elastic restart exit code
-        exit_code, restart = 0, False
+        # if any child fails; honor the elastic restart/rescale exit codes
+        exit_code, restart, rescale = 0, False, False
         try:
             alive = {p.pid: p for p, _ in procs}
             while alive:
@@ -103,6 +151,11 @@ def launch(argv=None) -> int:
                     del alive[pid]
                     if rc == ELASTIC_EXIT_CODE:
                         restart = True
+                    elif rc == RESCALE_EXIT_CODE:
+                        restart = rescale = True
+                        # all peers must re-form the world: stop them cleanly
+                        for q in alive.values():
+                            q.send_signal(signal.SIGTERM)
                     elif rc != 0:
                         exit_code = rc
                         for q in alive.values():
@@ -114,9 +167,16 @@ def launch(argv=None) -> int:
             for _, log in procs:
                 log.close()
 
-        if restart and restarts < args.max_restarts and exit_code == 0:
+        if restart and exit_code in (0, -signal.SIGTERM):
+            if restarts >= args.max_restarts:
+                # a crash-looping job must not report success (ADVICE r1)
+                print("[launch] restart budget exhausted", file=sys.stderr)
+                return ELASTIC_EXIT_CODE
             restarts += 1
-            print(f"[launch] elastic restart {restarts}/{args.max_restarts}",
+            if rescale:
+                world, nproc = _rescaled_world(args, world, nproc)
+            print(f"[launch] elastic {'rescale' if rescale else 'restart'} "
+                  f"{restarts}/{args.max_restarts} (world={world})",
                   file=sys.stderr)
             continue
         return exit_code

@@ -55,6 +55,7 @@ def topk_gating(logits, k: int = 2, capacity: Optional[int] = None,
     fill = jnp.zeros((E,), jnp.int32)
     masked = probs
     ce_acc = jnp.zeros((E,), jnp.float32)  # dispatched-token fractions
+    denom = jnp.zeros((T,), jnp.float32)   # Σ of the k selected gate probs
     for _ in range(k):
         idx = jnp.argmax(masked, axis=-1)                    # (T,)
         onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # (T, E)
@@ -69,7 +70,12 @@ def topk_gating(logits, k: int = 2, capacity: Optional[int] = None,
         dispatch = dispatch | (contrib > 0)
         fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
         ce_acc = ce_acc + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        denom = denom + gate
         masked = jnp.where(onehot.astype(bool), -jnp.inf, masked)
+    if k > 1:
+        # GShard renormalization: selected gates sum to 1 over the chosen k
+        # (k=1 keeps the raw prob — Switch convention)
+        combine = combine / jnp.maximum(denom, 1e-9)[:, None, None]
     me = jnp.mean(probs, axis=0)
     aux_loss = E * jnp.sum(me * ce_acc / k)
     return combine, dispatch, aux_loss
