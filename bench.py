@@ -16,7 +16,7 @@ Metrics: each config reports throughput (tokens/s or imgs/s), plus
 
 Configs mirror BASELINE.json: gpt2s (default flagship), resnet50, bert_base,
 ernie_moe, mnist_lenet.  ``python bench.py --config X`` for one;
-``--all`` for every config (one JSON line each).
+``--config all`` for every config (one JSON line each).
 """
 
 import argparse
@@ -282,6 +282,45 @@ def _child(names):
         print(json.dumps(CONFIGS[name](on_tpu)), flush=True)
 
 
+def _run_group(cmd, env, timeout):
+    """Run cmd in its own process group; on timeout SIGTERM the whole group
+    (a plain subprocess timeout would orphan the grandchild holding the TPU
+    claim, poisoning the backend for every later process)."""
+    import signal as _signal
+
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True,
+                            cwd=os.path.dirname(os.path.abspath(__file__)),
+                            start_new_session=True)
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        return proc.returncode, stdout, stderr
+    except subprocess.TimeoutExpired:
+        stdout = stderr = ""
+        try:
+            os.killpg(proc.pid, _signal.SIGTERM)
+            stdout, stderr = proc.communicate(timeout=15)
+        except subprocess.TimeoutExpired:
+            try:  # SIGTERM-resistant (wedged in tunnel I/O): escalate
+                os.killpg(proc.pid, _signal.SIGKILL)
+                stdout, stderr = proc.communicate(timeout=15)
+            except (subprocess.TimeoutExpired, ProcessLookupError, OSError):
+                pass
+        except (ProcessLookupError, OSError):
+            pass
+        return "timeout", stdout or "", stderr or ""
+
+
+def _probe_backend(timeout=180.0):
+    """Fast-fail when the device backend is unreachable (tunnel down): a
+    bare jax.devices() that hangs means every bench attempt would burn its
+    full timeout."""
+    rc, _, stderr = _run_group(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        dict(os.environ), timeout)
+    return (rc == 0), rc, stderr
+
+
 def _parent(names, attempts, timeout):
     """Run configs in a child with retry; keep partial successes.
 
@@ -290,24 +329,21 @@ def _parent(names, attempts, timeout):
     results = {}
     errors = []
     remaining = list(names)
+    probe_ok, probe_rc, probe_err = _probe_backend(
+        float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "180")))
+    if not probe_ok:
+        errors.append({"attempt": "probe", "rc": probe_rc,
+                       "tail": "backend unreachable (jax.devices() failed): "
+                               + (probe_err or "")[-400:]})
+        attempts = 0  # every attempt would hang; emit structured errors now
     for attempt in range(attempts):
         if not remaining:
             break
         env = dict(os.environ)
         env["_PADDLE_TPU_BENCH_CHILD"] = "1"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--config",
-                 ",".join(remaining)],
-                env=env, capture_output=True, text=True, timeout=timeout,
-                cwd=os.path.dirname(os.path.abspath(__file__)))
-            rc, stdout, stderr = proc.returncode, proc.stdout, proc.stderr or ""
-        except subprocess.TimeoutExpired as e:
-            rc = "timeout"
-            stdout = (e.stdout or b"").decode("utf-8", "replace") \
-                if isinstance(e.stdout, bytes) else (e.stdout or "")
-            stderr = (e.stderr or b"").decode("utf-8", "replace") \
-                if isinstance(e.stderr, bytes) else (e.stderr or "")
+        rc, stdout, stderr = _run_group(
+            [sys.executable, os.path.abspath(__file__), "--config",
+             ",".join(remaining)], env, timeout)
         lines = [ln for ln in stdout.splitlines() if ln.strip().startswith("{")]
         for name, ln in zip(remaining, lines):
             try:

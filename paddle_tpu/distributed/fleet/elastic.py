@@ -27,6 +27,29 @@ RESCALE_EXIT_CODE = 102      # relaunch with new world size
 ElasticLevel = type("ElasticLevel", (), {"FAULT_TOLERANCE": 1, "ELASTIC": 2})
 
 
+def read_alive_ranks(store_dir: str, ttl: float,
+                     now: Optional[float] = None) -> List[int]:
+    """Ranks with a fresh heartbeat lease in ``store_dir`` (shared between
+    ElasticManager and the launcher so membership logic cannot drift)."""
+    now = time.time() if now is None else now
+    out = []
+    try:
+        names = os.listdir(store_dir)
+    except OSError:
+        return out
+    for fn in names:
+        if not fn.startswith("host-") or not fn.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(store_dir, fn)) as f:
+                rec = json.load(f)
+            if now - rec["ts"] <= ttl:
+                out.append(int(rec["rank"]))
+        except (OSError, ValueError, KeyError):
+            continue
+    return sorted(out)
+
+
 class ElasticManager:
     """File-store membership + heartbeat; decides when the world changed."""
 
@@ -79,19 +102,7 @@ class ElasticManager:
             self._beat()
 
     def alive_ranks(self, now: Optional[float] = None) -> List[int]:
-        now = time.time() if now is None else now
-        out = []
-        for fn in os.listdir(self.store_dir):
-            if not fn.startswith("host-") or not fn.endswith(".json"):
-                continue
-            try:
-                with open(os.path.join(self.store_dir, fn)) as f:
-                    rec = json.load(f)
-                if now - rec["ts"] <= self.ttl:
-                    out.append(int(rec["rank"]))
-            except (OSError, ValueError, KeyError):
-                continue
-        return sorted(out)
+        return read_alive_ranks(self.store_dir, self.ttl, now)
 
     # ------------------------------------------------------------- decisions
     def world_changed(self) -> bool:

@@ -89,17 +89,9 @@ def _rescaled_world(args, world: int, nproc: int):
         print("[launch] RESCALE requested but no --elastic_store; "
               "relaunching with unchanged world", file=sys.stderr)
         return world, nproc
-    import json
+    from .fleet.elastic import read_alive_ranks
     ttl = float(os.environ.get("PADDLE_ELASTIC_TTL", "10"))
-    now, alive = time.time(), 0
-    for fn in os.listdir(args.elastic_store):
-        if fn.startswith("host-") and fn.endswith(".json"):
-            try:
-                with open(os.path.join(args.elastic_store, fn)) as f:
-                    if now - json.load(f)["ts"] <= ttl:
-                        alive += 1
-            except (OSError, ValueError, KeyError):
-                continue
+    alive = len(read_alive_ranks(args.elastic_store, ttl))
     lo, _, hi = str(args.nnodes).partition(":")
     np_min = int(lo) if lo else 1
     np_max = int(hi) if hi else np_min  # fixed --nnodes N means N is the cap
@@ -167,7 +159,9 @@ def launch(argv=None) -> int:
             for _, log in procs:
                 log.close()
 
-        if restart and exit_code in (0, -signal.SIGTERM):
+        # once a restart/rescale is requested, peer crash codes don't veto it
+        # (a 102-exiting trainer routinely breaks peers' live collectives)
+        if restart:
             if restarts >= args.max_restarts:
                 # a crash-looping job must not report success (ADVICE r1)
                 print("[launch] restart budget exhausted", file=sys.stderr)

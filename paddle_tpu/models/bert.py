@@ -145,10 +145,12 @@ class BertModel(Layer):
         qkv = h @ sl["blocks_qkv_w"].astype(dt) + sl["blocks_qkv_b"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q, k, v = (t.reshape(B, Lq, nh, hd) for t in (q, k, v))
-        if attn_mask is not None:
-            att = dense_attention(q, k, v, mask=attn_mask, causal=False)
+        if c.use_flash_attention:
+            # the (B,1,1,L) padding mask rides inside the Pallas kernel as a
+            # key mask — no dense fallback (ops/attention.py)
+            att = flash_attention(q, k, v, causal=False, key_mask=attn_mask)
         else:
-            att = flash_attention(q, k, v, causal=False)
+            att = dense_attention(q, k, v, mask=attn_mask, causal=False)
         att = att.reshape(B, Lq, H)
         h = self._ln(h + att @ sl["blocks_proj_w"].astype(dt)
                      + sl["blocks_proj_b"].astype(dt),
