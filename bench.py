@@ -303,11 +303,18 @@ def _run_group(cmd, env, timeout):
         except subprocess.TimeoutExpired:
             try:  # SIGTERM-resistant (wedged in tunnel I/O): escalate
                 os.killpg(proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+            try:
                 stdout, stderr = proc.communicate(timeout=15)
-            except (subprocess.TimeoutExpired, ProcessLookupError, OSError):
+            except subprocess.TimeoutExpired:
                 pass
         except (ProcessLookupError, OSError):
-            pass
+            # group already gone: still reap the child and drain its pipes
+            try:
+                stdout, stderr = proc.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                pass
         return "timeout", stdout or "", stderr or ""
 
 

@@ -79,7 +79,7 @@ def _slot_spec(param_spec: P, p, mesh: Mesh, zero_stage: int) -> P:
     ShardingOptimizerStage2 semantics, without the manual bucketing)."""
     entries = list(param_spec) + [None] * (len(p.shape) - len(param_spec))
     if zero_stage >= 1 and "sharding" in mesh.axis_names and \
-            mesh.shape["sharding"] > 1:
+            mesh.shape["sharding"] > 1 and "sharding" not in entries:
         for d in range(len(p.shape)):
             if entries[d] is None and p.shape[d] % mesh.shape["sharding"] == 0:
                 entries[d] = "sharding"
@@ -114,12 +114,21 @@ def build_state_shardings(state, params_specs: Dict[str, P], mesh: Mesh,
 
 
 # --------------------------------------------------------------------------
-# shard_map micro-batch pipeline (GPipe schedule; 1F1B memory behavior comes
-# from XLA scheduling the backward interleaved with ppermutes)
+# shard_map micro-batch pipeline as a lax.scan over ticks.
+#
+# Schedule: M+S-1 ticks, each tick runs one stage body per device and one
+# ppermute hop — the same tick count (and thus the same bubble fraction
+# (S-1)/(M+S-1)) as the reference's 1F1B (section_worker.cc:62-137).  The
+# scan body is constant-size, so the jaxpr does NOT grow with M (the round-1
+# unrolled reduce blew up compile time past M≈32).  1F1B's remaining benefit
+# over GPipe is activation scheduling; here per-tick jax.checkpoint bounds
+# stored residuals to the tick boundaries (one micro-batch activation per
+# tick) and interiors are recomputed in the backward scan — the TPU analog
+# of 1F1B's bounded in-flight window.
 # --------------------------------------------------------------------------
 
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
-                  axis: str = "pipe"):
+                  axis: str = "pipe", remat_ticks: bool = True):
     """Run inside shard_map over ``axis``.
 
     stage_fn(stage_params, x, microbatch_index) -> y ; stage_params is the
@@ -131,25 +140,20 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
     M = microbatches.shape[0]
     S = n_stages
     stage = jax.lax.axis_index(axis)
-    state = jnp.zeros_like(microbatches[0])
-    outputs = jnp.zeros_like(microbatches)
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
 
-    def tick(t, carry):
-        state, outputs = carry
+    def tick(state, t):
         mb_idx = jnp.minimum(t, M - 1)
         inp = jnp.where(stage == 0, microbatches[mb_idx], state)
         y = stage_fn(stage_params, inp, mb_idx)
-        out_idx = t - (S - 1)
-        write = (stage == S - 1) & (out_idx >= 0)
-        outputs = jax.lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(write, y, outputs[jnp.maximum(out_idx, 0)]),
-            jnp.maximum(out_idx, 0), 0)
-        state = jax.lax.ppermute(y, axis, fwd_perm)
-        return state, outputs
+        return jax.lax.ppermute(y, axis, fwd_perm), y
 
-    state, outputs = functools.reduce(lambda c, t: tick(t, c), range(M + S - 1),
-                                      (state, outputs))
+    if remat_ticks:
+        tick = jax.checkpoint(tick)
+    _, ys = jax.lax.scan(tick, jnp.zeros_like(microbatches[0]),
+                         jnp.arange(M + S - 1))
+    # ticks S-1 .. M+S-2 are the last stage's M finished micro-batches
+    outputs = ys[S - 1:]
     # broadcast final outputs from the last stage to every stage
     # (masked psum — ppermute can't scatter one source to many)
     outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))

@@ -262,11 +262,15 @@ def gpt_preset(name: str, **overrides) -> GPTConfig:
 
 
 def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1,
-                        remat: bool = True, donate: bool = True):
+                        remat: bool = True, donate: bool = True,
+                        zero_stage: int = 0, dynamic_loss_scale: bool = False):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
     the mesh has pipe>1.  step(state, key, lr, input_ids, labels) -> (state, loss).
+    zero_stage>0 routes through the contractual ZeRO step (distributed/zero.py:
+    grad reduce-scatter at stage 2, sharded params at stage 3, fp32 masters +
+    found_inf + dynamic loss scaling — ≙ sharding_optimizer.py:45 semantics).
     """
     from ..distributed.pipeline_engine import make_stacked_pipeline_step
     from ..distributed.spmd import make_gspmd_step_from_loss
@@ -279,6 +283,12 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     sp_mesh = mesh if (sp_mode and mesh.shape.get("sep", 1) > 1) else None
 
     if S > 1:
+        if zero_stage > 0 or dynamic_loss_scale:
+            raise NotImplementedError(
+                "zero_stage/dynamic_loss_scale with pp_degree>1 is not wired "
+                "yet: the stacked pipeline step manages its own state layout. "
+                "Use pp_degree=1 for ZeRO, or sharding via the pipeline's own "
+                "slot sharding (build_state_shardings).")
         if sp_mesh is not None:
             raise ValueError(
                 "sequence_parallel with pp_degree>1 is not supported yet: the "
@@ -303,8 +313,15 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         h = model.scan_blocks(params, h, key, remat=remat, sp_mesh=sp_mesh)
         return model.head_loss_fn(params, h, labels)
 
-    inner_step, state0 = make_gspmd_step_from_loss(
-        loss_of, params0, optimizer, mesh, layer=model, donate=donate)
+    if zero_stage > 0:
+        from ..distributed.zero import make_zero_train_step
+        inner_step, state0 = make_zero_train_step(
+            loss_of, params0, optimizer, mesh, layer=model,
+            zero_stage=zero_stage, dynamic_loss_scale=dynamic_loss_scale,
+            donate=donate)
+    else:
+        inner_step, state0 = make_gspmd_step_from_loss(
+            loss_of, params0, optimizer, mesh, layer=model, donate=donate)
 
     def step(state, key, lr, x, labels):
         return inner_step(state, lr, key, x, labels)

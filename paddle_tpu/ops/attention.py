@@ -422,6 +422,11 @@ def flash_attention(q, k, v, causal=False, scale=None, key_mask=None,
     """
     B, L, H, D = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if dropout_p > 0.0 and dropout_seed is None:
+        # a silent default seed would replay one fixed keep-mask every step
+        # (and the dense fallback would apply no dropout at all)
+        raise ValueError("dropout_p > 0 requires dropout_seed (vary it per "
+                         "step, e.g. jax.random.bits(key, (), jnp.uint32))")
     # choose the largest block size that tiles L exactly
     block = next((b for b in (512, 256, 128) if L % b == 0), None)
     if _use_pallas() and block is not None and q.shape == k.shape:

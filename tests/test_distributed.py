@@ -230,3 +230,35 @@ def test_graft_entry_runs():
 def test_graft_dryrun():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
+
+
+@needs8
+def test_pipeline_jaxpr_flat_in_microbatches():
+    """The scan-tick pipeline must have a constant-size jaxpr as M grows
+    (round-1 unrolled reduce grew linearly — compile blowup at M=32+)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
+    from paddle_tpu.optimizer import SGD
+
+    def jaxpr_len(M):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 2, "sharding_degree": 1}
+        fleet.fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                        num_attention_heads=2, max_position_embeddings=32,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        step, state = make_gpt_train_step(model, SGD(0.1), hcg,
+                                          n_microbatches=M, remat=False)
+        B = M * 2
+        x = jnp.zeros((B, 16), jnp.int32)
+        jaxpr = jax.make_jaxpr(
+            lambda s, k, lr, a, b: step(s, k, lr, a, b))(
+                state, jax.random.key(0), np.float32(0.1), x, x)
+        return len(str(jaxpr))
+
+    small, large = jaxpr_len(4), jaxpr_len(32)
+    assert large < small * 1.3, (small, large)
