@@ -1,0 +1,246 @@
+// Shared-memory ring buffer for DataLoader worker->main batch transport.
+//
+// TPU-native runtime analog of the reference's C++ data path:
+//   - pybind/reader_py.cc BlockingQueue (bounded, blocking push/pop)
+//   - memory/allocation/mmap_allocator.cc (shared-memory tensors between
+//     dataloader worker processes and the trainer)
+// Here both collapse into one native component: a process-shared ring of
+// length-prefixed messages with pthread mutex/condvar synchronization
+// (PTHREAD_PROCESS_SHARED), mapped via shm_open/mmap.  Workers serialize
+// collated numpy batches into the ring; the main process pops them without
+// pickling through a pipe (the multiprocessing.Queue bottleneck).
+//
+// Message framing: [u64 len][len bytes], contiguous, wrapping at the end of
+// the data region via a u64 sentinel len = WRAP_MARK.
+//
+// Built with: g++ -O2 -shared -fPIC shm_ring.cpp -o libshm_ring.so -lpthread -lrt
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t WRAP_MARK = ~0ull;
+
+struct RingHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // bytes in the data region
+  uint64_t head;       // read offset
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes currently stored (incl. framing)
+  uint32_t closed;
+  uint32_t magic;
+};
+
+constexpr uint32_t MAGIC = 0x52494e47;  // "RING"
+
+inline char* data_of(RingHeader* h) {
+  return reinterpret_cast<char*>(h) + sizeof(RingHeader);
+}
+
+void abstime_in(timespec* ts, long timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (owner=1) or attach (owner=0) a ring of `capacity` data bytes.
+// Returns the mapped header or nullptr.
+void* shm_ring_open(const char* name, uint64_t capacity, int owner) {
+  const uint64_t total = sizeof(RingHeader) + capacity;
+  int fd;
+  if (owner) {
+    shm_unlink(name);  // stale ring from a crashed run
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* h = static_cast<RingHeader*>(mem);
+  if (owner) {
+    std::memset(h, 0, sizeof(RingHeader));
+    h->capacity = capacity;
+    pthread_mutexattr_t ma;
+    pthread_mutexattr_init(&ma);
+    pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&h->mu, &ma);
+    pthread_condattr_t ca;
+    pthread_condattr_init(&ca);
+    pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+    pthread_cond_init(&h->not_empty, &ca);
+    pthread_cond_init(&h->not_full, &ca);
+    h->magic = MAGIC;
+  } else if (h->magic != MAGIC) {
+    munmap(mem, total);
+    return nullptr;
+  }
+  return mem;
+}
+
+static int lock_robust(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock
+    pthread_mutex_consistent(&h->mu);
+    rc = 0;
+  }
+  return rc;
+}
+
+// Placement decision under the lock.  The occupied region is
+// [head, head+used) mod capacity; tail == (head+used) % capacity.
+// rc: 1 = fits at tail, 2 = fits at offset 0 after wasting the end run,
+// 0 = does not fit yet.
+static int placement(RingHeader* h, uint64_t frame) {
+  if (h->used == 0) {
+    h->head = h->tail = 0;  // opportunistic reset: whole region contiguous
+    return frame <= h->capacity ? 1 : 0;
+  }
+  const bool split_free = (h->head + h->used) < h->capacity;  // tail >= head
+  if (split_free) {
+    if (h->capacity - h->tail >= frame) return 1;
+    if (h->head >= frame) return 2;
+    return 0;
+  }
+  // free region is the single run [tail, head)
+  return (h->head - h->tail >= frame) ? 1 : 0;
+}
+
+// rc: 0 ok, -1 timeout, -2 closed, -3 message larger than capacity, -4 error
+int shm_ring_push(void* ring, const void* buf, uint64_t len, long timeout_ms) {
+  auto* h = static_cast<RingHeader*>(ring);
+  const uint64_t frame = len + sizeof(uint64_t);
+  if (frame > h->capacity) return -3;
+  if (lock_robust(h) != 0) return -4;
+  timespec ts;
+  abstime_in(&ts, timeout_ms);
+  int place;
+  while ((place = placement(h, frame)) == 0 && !h->closed) {
+    if (timeout_ms < 0) {
+      pthread_cond_wait(&h->not_full, &h->mu);
+    } else if (pthread_cond_timedwait(&h->not_full, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  if (h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  char* d = data_of(h);
+  uint64_t tail = h->tail;
+  if (place == 2) {  // wrap: waste the run [tail, capacity)
+    if (h->capacity - tail >= sizeof(uint64_t)) {
+      std::memcpy(d + tail, &WRAP_MARK, sizeof(uint64_t));
+    }
+    h->used += h->capacity - tail;
+    tail = 0;
+  }
+  std::memcpy(d + tail, &len, sizeof(uint64_t));
+  std::memcpy(d + tail + sizeof(uint64_t), buf, len);
+  h->tail = (tail + frame) % h->capacity;
+  h->used += frame;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// Returns payload length (>=0); -1 timeout, -2 closed-and-empty,
+// -4 error, -5 caller buffer too small (length written to *out_len).
+int64_t shm_ring_pop(void* ring, void* buf, uint64_t buflen, long timeout_ms,
+                     uint64_t* out_len) {
+  auto* h = static_cast<RingHeader*>(ring);
+  if (lock_robust(h) != 0) return -4;
+  timespec ts;
+  abstime_in(&ts, timeout_ms);
+  while (h->used == 0 && !h->closed) {
+    if (timeout_ms < 0) {
+      pthread_cond_wait(&h->not_empty, &h->mu);
+    } else if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  if (h->used == 0 && h->closed) {
+    pthread_mutex_unlock(&h->mu);
+    return -2;
+  }
+  char* d = data_of(h);
+  uint64_t head = h->head;
+  uint64_t len;
+  if (h->capacity - head >= sizeof(uint64_t)) {
+    std::memcpy(&len, d + head, sizeof(uint64_t));
+    if (len == WRAP_MARK) {
+      h->used -= h->capacity - head;
+      head = 0;
+      std::memcpy(&len, d, sizeof(uint64_t));
+    }
+  } else {  // frame didn't fit at the end: writer wrapped without a marker
+    h->used -= h->capacity - head;
+    head = 0;
+    std::memcpy(&len, d, sizeof(uint64_t));
+  }
+  if (out_len) *out_len = len;
+  if (len > buflen) {
+    pthread_mutex_unlock(&h->mu);
+    return -5;
+  }
+  std::memcpy(buf, d + head + sizeof(uint64_t), len);
+  h->head = (head + sizeof(uint64_t) + len) % h->capacity;
+  h->used -= sizeof(uint64_t) + len;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return static_cast<int64_t>(len);
+}
+
+void shm_ring_close(void* ring) {
+  auto* h = static_cast<RingHeader*>(ring);
+  if (lock_robust(h) != 0) return;
+  h->closed = 1;
+  pthread_cond_broadcast(&h->not_empty);
+  pthread_cond_broadcast(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+}
+
+uint64_t shm_ring_used(void* ring) {
+  auto* h = static_cast<RingHeader*>(ring);
+  if (lock_robust(h) != 0) return 0;
+  uint64_t u = h->used;
+  pthread_mutex_unlock(&h->mu);
+  return u;
+}
+
+void shm_ring_detach(void* ring, uint64_t capacity) {
+  munmap(ring, sizeof(RingHeader) + capacity);
+}
+
+void shm_ring_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -100,11 +100,14 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                              for p in jax.tree_util.tree_leaves(params0))
 
     p_specs, s_specs = zero_state_specs(params0, mesh, layer, zero_stage)
-    master0 = {k: p.astype(jnp.float32) for k, p in params0.items()} \
-        if master_weights else {}
-    # slots track the update-precision copy (fp32 master when enabled):
-    # reference multi_precision optimizers keep fp32 moments for half params
-    opt_state0 = optimizer.init_state(master0 if master_weights else params0)
+    # fp32 masters ONLY for half-precision params (reference multi_precision
+    # semantics) — duplicating already-fp32 tensors would double their memory
+    half_keys = {k for k, p in params0.items() if p.dtype in _HALF_DTYPES} \
+        if master_weights else set()
+    master0 = {k: params0[k].astype(jnp.float32) for k in half_keys}
+    # slots track the update-precision copy (fp32 master where one exists)
+    upd_params0 = {k: master0.get(k, p) for k, p in params0.items()}
+    opt_state0 = optimizer.init_state(upd_params0)
     scaler0 = {
         "scale": jnp.asarray(init_loss_scale if dynamic_loss_scale else 1.0,
                              jnp.float32),
@@ -156,7 +159,8 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
             [jnp.any(~jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)],
             jnp.zeros([], jnp.bool_))
 
-        upd_params = state["master"] if master_weights else state["params"]
+        upd_params = {k: state["master"].get(k, p)
+                      for k, p in state["params"].items()}
         new_upd, new_opt = optimizer.update(grads, state["opt"], upd_params, lr=lr)
 
         def sel(new, old):
@@ -168,14 +172,11 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                                      new_opt["step"]),
                    "slots": sel(new_opt["slots"], state["opt"]["slots"])}
 
-        if master_weights:
-            new_master = {k: jax.lax.with_sharding_constraint(v, s_sh[k])
-                          for k, v in new_upd.items()}
-            new_params = {k: new_master[k].astype(params0[k].dtype)
-                          for k in new_master}
-        else:
-            new_master = {}
-            new_params = new_upd
+        new_master = {k: jax.lax.with_sharding_constraint(new_upd[k], s_sh[k])
+                      for k in half_keys}
+        new_params = {k: (new_master[k].astype(params0[k].dtype)
+                          if k in half_keys else new_upd[k])
+                      for k in new_upd}
         new_params = {k: jax.lax.with_sharding_constraint(v, p_sh[k])
                       for k, v in new_params.items()}
 

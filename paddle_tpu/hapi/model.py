@@ -55,7 +55,12 @@ class Model:
             self._eval_step = make_eval_step(self.network, self._loss)
 
     # ---------------------------------------------------------------- steps
-    def train_batch(self, inputs, labels=None, update=True):
+    def _train_batch_device(self, inputs, labels=None):
+        """One step WITHOUT host synchronization: returns the device loss.
+        Metrics (if configured) still update per batch — computing them on
+        host is their contract; with no metrics the step chain stays fully
+        async (the round-1 fit loop synced every batch, serializing device
+        and host — reference streams at log_freq via callbacks)."""
         self._ensure_train_step()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
@@ -68,7 +73,10 @@ class Model:
         for m in self._metrics:
             m.update(m.compute(Tensor(out), *[Tensor(l) for l in raw_lab]),
                      *[Tensor(l) for l in raw_lab])
-        return [float(np.asarray(loss))]
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        return [float(np.asarray(self._train_batch_device(inputs, labels)))]
 
     def eval_batch(self, inputs, labels=None):
         self._ensure_eval_step()
@@ -121,19 +129,35 @@ class Model:
             for m in self._metrics:
                 m.reset()
             logs = {}
-            for step, batch in enumerate(train_loader):
-                cbks.on_batch_begin("train", step)
-                inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)
-                logs = {"loss": loss[0]}
-                for m in self._metrics:
-                    logs[self._m_name(m)] = m.accumulate()
-                logs["lr"] = self._optimizer.get_lr()
-                cbks.on_batch_end("train", step, logs)
-                it += 1
-                if num_iters is not None and it >= num_iters:
-                    self.stop_training = True
-                    break
+            loss_dev, loss_val = None, None
+            train_iter = iter(train_loader)
+            try:
+                for step, batch in enumerate(train_iter):
+                    cbks.on_batch_begin("train", step)
+                    inputs, labels = self._split_batch(batch)
+                    loss_dev = self._train_batch_device(inputs, labels)
+                    # host sync only at log_freq cadence — between log points
+                    # the step chain stays async on device (loss in logs is
+                    # the value at the last sync point, like the reference's
+                    # streamed logs)
+                    if step % log_freq == 0 or (num_iters is not None and
+                                                it + 1 >= num_iters):
+                        loss_val = float(np.asarray(loss_dev))
+                    logs = {"loss": loss_val}
+                    for m in self._metrics:
+                        logs[self._m_name(m)] = m.accumulate()
+                    logs["lr"] = self._optimizer.get_lr()
+                    cbks.on_batch_end("train", step, logs)
+                    it += 1
+                    if num_iters is not None and it >= num_iters:
+                        self.stop_training = True
+                        break
+            finally:
+                close = getattr(train_iter, "close", None)
+                if close is not None:  # release mp workers on early break
+                    close()
+            if loss_dev is not None:  # epoch-end logs carry the true last loss
+                logs["loss"] = float(np.asarray(loss_dev))
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=verbose,

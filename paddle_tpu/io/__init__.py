@@ -1,18 +1,21 @@
 """``paddle_tpu.io`` — Dataset/DataLoader (reference: python/paddle/io/,
 fluid/reader.py:146 DataLoader, fluid/dataloader/).
 
-TPU-first notes: the loader collates numpy on host workers and does an async
-``jax.device_put`` prefetch of the next batch while the current step runs —
-the equivalent of the reference's C++ BlockingQueue + buffered reader
-(pybind/reader_py.cc) without a native queue, since XLA's async dispatch
-already overlaps host→HBM copies with compute.
+TPU-first notes: with ``num_workers>0`` decode/collate runs in forked worker
+processes (free of the parent GIL) and collated numpy batches travel through
+the native shared-memory ring (csrc/shm_ring.cpp ≙ pybind/reader_py.cc
+BlockingQueue + mmap_allocator.cc shared-mem tensors); a host-side pump
+thread restores sampler order and ``jax.device_put``s the next batch while
+the current step runs.  ``num_workers=0`` keeps the single prefetch thread.
 """
 
 from __future__ import annotations
 
 import itertools
+import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable, List, Optional, Sequence
 
 import jax
@@ -261,6 +264,273 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset, seed=None):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+        self.seed = seed
+
+
+def _worker_main(worker_id, num_workers, dataset, collate_fn, worker_init_fn,
+                 task_q, ring_name, ring_capacity, result_q, base_seed):
+    """Worker-process loop (≙ dataloader_iter.py _worker_loop): pull index
+    batches, decode/collate on this process's CPU, push the collated numpy
+    batch through the shared-memory ring (or mp.Queue fallback)."""
+    import numpy as _np
+    _np.random.seed((base_seed + worker_id) % (2 ** 31))
+    _worker_info.info = WorkerInfo(worker_id, num_workers, dataset, base_seed)
+    out = None
+    try:
+        if ring_name is not None:
+            from .shm_queue import ShmQueue
+            out = ShmQueue(ring_name, ring_capacity, owner=False)
+
+        def emit(tag, batch, error=False):
+            if out is not None:
+                from .shm_queue import encode_batch
+                t = tag | (1 << 31) if error else tag
+                out.put(encode_batch(t, batch))
+            else:
+                result_q.put((tag, batch, error))
+
+        try:
+            if worker_init_fn is not None:
+                worker_init_fn(worker_id)
+        except Exception as e:  # must reach the main process, not just stderr
+            import traceback
+            emit(0, {"error": f"worker_init_fn: {e}\n{traceback.format_exc()}"},
+                 error=True)
+            return
+        for task in iter(task_q.get, None):
+            tag, indices = task
+            try:
+                samples = [dataset[i] for i in indices]
+                emit(tag, collate_fn(samples))
+            except Exception as e:  # ship the failure to the main process
+                import traceback
+                emit(tag, {"error": f"{e}\n{traceback.format_exc()}"},
+                     error=True)
+    except (EOFError, KeyboardInterrupt):
+        pass
+
+
+class _MPResources:
+    """Everything the pump thread and shutdown need, deliberately separate
+    from the iterator object so the thread can hold it STRONGLY while holding
+    the iterator only weakly — an abandoned iterator is then garbage
+    collectable, the pump notices the dead weakref and releases the workers
+    and the shm ring instead of leaking them."""
+
+    def __init__(self, workers, tasks, ring, result_q, prefetch=2):
+        self.workers = workers
+        self.tasks = tasks
+        self.ring = ring
+        self.result_q = result_q
+        self.closed = threading.Event()
+        self.out_q: "queue.Queue" = queue.Queue(maxsize=max(2, prefetch))
+        self._down = False
+
+    def any_worker_dead(self):
+        return any(not w.is_alive() and w.exitcode != 0 for w in self.workers)
+
+    def shutdown(self):
+        if self._down:
+            return
+        self._down = True
+        self.closed.set()
+        for _ in self.workers:
+            try:
+                self.tasks.put_nowait(None)
+            except Exception:
+                pass
+        if self.ring is not None:
+            self.ring.close()
+        for w in self.workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+
+
+class _MultiprocessIterator:
+    """Process-worker loader (≙ dataloader_iter.py:336 _DataLoaderIterMultiProcess).
+
+    - fork workers decode/collate in parallel, free of the parent's GIL;
+    - batches travel through the native shared-memory ring
+      (csrc/shm_ring.cpp; mp.Queue fallback when the native build fails);
+    - a host thread reorders by batch index (determinism contract: output
+      order == sampler order regardless of worker timing) and device_puts
+      the next batch while the consumer steps (double buffering);
+    - ``loader.timeout`` bounds the wait for any single batch (0 = a
+      liveness-checked indefinite wait); close()/GC release all resources.
+    """
+
+    def __init__(self, loader, index_iter):
+        import multiprocessing as mp
+        import uuid
+        import weakref
+
+        self.loader = loader
+        # fork by default (workers inherit loaded modules — instant start and
+        # no pickling requirement; they only run numpy, never JAX).  Set
+        # PADDLE_TPU_WORKER_START=forkserver to trade startup time for
+        # immunity to fork-while-JAX-threads-hold-locks hazards.
+        method = os.environ.get("PADDLE_TPU_WORKER_START", "fork")
+        ctx = mp.get_context(method)
+        n = loader.num_workers
+        tasks = ctx.Queue()
+        ring, result_q, ring_name = None, None, None
+        ring_cap = 128 << 20
+        if loader.use_shared_memory:
+            try:
+                from .shm_queue import ShmQueue
+                ring_name = f"/pt_dl_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+                ring = ShmQueue(ring_name, ring_cap, owner=True)
+            except Exception:  # native build unavailable
+                ring_name = None
+        if ring_name is None:  # mp.Queue transport fallback
+            result_q = ctx.Queue()
+        base_seed = int(np.random.randint(0, 2 ** 31))
+        workers = [
+            ctx.Process(
+                target=_worker_main,
+                args=(i, n, loader.dataset, loader.collate_fn,
+                      loader.worker_init_fn, tasks, ring_name, ring_cap,
+                      result_q, base_seed),
+                daemon=True)
+            for i in range(n)]
+        for w in workers:
+            w.start()
+
+        self._res = _MPResources(workers, tasks, ring, result_q,
+                                 prefetch=loader.prefetch_factor)
+        window = max(2, loader.prefetch_factor) * n
+        timeout = float(loader.timeout) if loader.timeout else 0.0
+        pump = threading.Thread(
+            target=_mp_pump, daemon=True,
+            args=(weakref.ref(self), self._res, index_iter, window,
+                  loader._to_tensors, timeout))
+        pump.start()
+
+    # ------------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        res = self._res
+        while True:
+            try:
+                item = res.out_q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if res.closed.is_set():
+                    raise StopIteration
+        if item is _DONE:
+            raise StopIteration
+        if isinstance(item, _Err):
+            raise item.e
+        return item
+
+    def close(self):
+        self._res.shutdown()
+
+    def __del__(self):
+        try:
+            self._res.shutdown()
+        except Exception:
+            pass
+
+
+def _mp_pump(iter_ref, res, index_iter, window, to_tensors, timeout):
+    """Pump-thread body.  Holds the iterator only via ``iter_ref`` so an
+    abandoned iterator gets collected; on a dead ref (or close()) all
+    resources are released."""
+    next_tag = 0
+    next_yield = 0
+    reorder = {}
+    more = True
+
+    def dispatch():
+        nonlocal next_tag, more
+        while more and next_tag - next_yield < window:
+            try:
+                indices = next(index_iter)
+            except StopIteration:
+                more = False
+                return
+            res.tasks.put((next_tag, list(indices)))
+            next_tag += 1
+
+    def recv_one(deadline):
+        while True:
+            if res.closed.is_set() or iter_ref() is None:
+                raise _Abandoned
+            try:
+                if res.ring is not None:
+                    from .shm_queue import decode_batch
+                    tag, batch = decode_batch(res.ring.get(timeout=1.0))
+                    err = bool(tag & (1 << 31))
+                    return tag & ~(1 << 31), batch, err
+                return res.result_q.get(timeout=1.0)
+            except (TimeoutError, queue.Empty):
+                if res.any_worker_dead():
+                    raise RuntimeError(
+                        "DataLoader worker died without reporting an error "
+                        "(killed or crashed in native code)")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader batch wait exceeded timeout={timeout}s")
+
+    def put_out(item):
+        while True:
+            if res.closed.is_set() or iter_ref() is None:
+                raise _Abandoned
+            try:
+                res.out_q.put(item, timeout=1.0)
+                return
+            except queue.Full:
+                continue
+
+    try:
+        dispatch()
+        while next_yield < next_tag:
+            deadline = time.monotonic() + timeout if timeout else None
+            while next_yield not in reorder:
+                tag, batch, err = recv_one(deadline)
+                if err:
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {tag}: "
+                        f"{batch.get('error', batch)}")
+                reorder[tag] = batch
+            batch = reorder.pop(next_yield)
+            next_yield += 1
+            dispatch()
+            # device transfer off the consumer thread (double buffer)
+            put_out(to_tensors(batch))
+        put_out(_DONE)
+    except _Abandoned:
+        pass
+    except BaseException as e:
+        try:
+            put_out(_Err(e))
+        except _Abandoned:
+            pass
+    finally:
+        res.shutdown()
+
+
+class _Abandoned(BaseException):
+    pass
+
+
+_DONE = object()
+
+
+class _Err:
+    def __init__(self, e):
+        self.e = e
+
+
 class _PrefetchIterator:
     """Background-thread loader with bounded queue (≙ reader_py.cc
     BlockingQueue + dataloader_iter.py _DataLoaderIterMultiProcess)."""
@@ -307,6 +577,9 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.use_shared_memory = use_shared_memory
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -341,7 +614,9 @@ class DataLoader:
         if self._iterable_mode:
             return self._iter_iterable()
         index_iter = iter(self.batch_sampler)
-        if self.num_workers > 0 or self.use_buffer_reader:
+        if self.num_workers > 0:
+            return _MultiprocessIterator(self, index_iter)
+        if self.use_buffer_reader:
             return _PrefetchIterator(self, index_iter)
         return (self._fetch(indices) for indices in index_iter)
 

@@ -1,0 +1,182 @@
+"""Shared-memory batch queue over the native ring (csrc/shm_ring.cpp).
+
+Worker processes serialize collated numpy batches into a process-shared
+ring; the main process pops them.  ≙ reference dataloader_iter.py:336
+(worker processes + shared-memory mmap tensors) + pybind/reader_py.cc
+(C++ BlockingQueue) — one native component instead of two.
+
+Serialization is a minimal header + raw array bytes (no pickle on the hot
+path): [u32 tag][u32 n_arrays] then per array
+[u8 dtype_len][dtype bytes][u8 ndim][u64 shape...] [u64 nbytes][raw bytes].
+Nested list/dict batch structure is carried separately as a pickled
+template (tiny, once per batch shape).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import struct
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..csrc import load_library
+
+
+class _Lib:
+    _lib = None
+
+    @classmethod
+    def get(cls):
+        if cls._lib is None:
+            lib = load_library("shm_ring")
+            lib.shm_ring_open.restype = ctypes.c_void_p
+            lib.shm_ring_open.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                          ctypes.c_int]
+            lib.shm_ring_push.restype = ctypes.c_int
+            lib.shm_ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_uint64, ctypes.c_long]
+            lib.shm_ring_pop.restype = ctypes.c_int64
+            lib.shm_ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64, ctypes.c_long,
+                                         ctypes.POINTER(ctypes.c_uint64)]
+            lib.shm_ring_close.argtypes = [ctypes.c_void_p]
+            lib.shm_ring_used.restype = ctypes.c_uint64
+            lib.shm_ring_used.argtypes = [ctypes.c_void_p]
+            lib.shm_ring_detach.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+            lib.shm_ring_unlink.argtypes = [ctypes.c_char_p]
+            cls._lib = lib
+        return cls._lib
+
+
+class ShmQueue:
+    """Bounded blocking byte-message queue in POSIX shared memory."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20, owner: bool = True):
+        self._lib = _Lib.get()
+        self.name = name.encode()
+        self.capacity = capacity
+        self.owner = owner
+        self._ring = self._lib.shm_ring_open(self.name, capacity, 1 if owner else 0)
+        if not self._ring:
+            raise OSError(f"shm_ring_open({name!r}, owner={owner}) failed")
+        self._buf = ctypes.create_string_buffer(1 << 20)
+
+    def put(self, data: bytes, timeout: Optional[float] = None) -> None:
+        ms = -1 if timeout is None else int(timeout * 1000)
+        rc = self._lib.shm_ring_push(self._ring, data, len(data), ms)
+        if rc == -1:
+            raise TimeoutError("shm queue push timed out")
+        if rc == -2:
+            raise EOFError("shm queue closed")
+        if rc == -3:
+            raise ValueError(f"message of {len(data)} bytes exceeds ring "
+                             f"capacity {self.capacity}")
+        if rc != 0:
+            raise OSError(f"shm_ring_push rc={rc}")
+
+    def get(self, timeout: Optional[float] = None) -> bytes:
+        ms = -1 if timeout is None else int(timeout * 1000)
+        need = ctypes.c_uint64(0)
+        rc = self._lib.shm_ring_pop(self._ring, self._buf, len(self._buf), ms,
+                                    ctypes.byref(need))
+        if rc == -5:  # grow the receive buffer and retry (message intact)
+            self._buf = ctypes.create_string_buffer(int(need.value))
+            rc = self._lib.shm_ring_pop(self._ring, self._buf, len(self._buf),
+                                        ms, ctypes.byref(need))
+        if rc == -1:
+            raise TimeoutError("shm queue pop timed out")
+        if rc == -2:
+            raise EOFError("shm queue closed")
+        if rc < 0:
+            raise OSError(f"shm_ring_pop rc={rc}")
+        return self._buf.raw[:rc]
+
+    def close(self) -> None:
+        if self._ring:
+            self._lib.shm_ring_close(self._ring)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_ring", None):
+                self._lib.shm_ring_detach(self._ring, self.capacity)
+                if self.owner:
+                    self._lib.shm_ring_unlink(self.name)
+                self._ring = None
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------- codec
+
+def _flatten(batch) -> Tuple[Any, List[np.ndarray]]:
+    arrays: List[np.ndarray] = []
+
+    def rec(x):
+        if isinstance(x, np.ndarray):
+            arrays.append(np.ascontiguousarray(x))
+            return ("__a__", len(arrays) - 1)
+        if isinstance(x, (list, tuple)):
+            return [rec(v) for v in x] if isinstance(x, list) else \
+                tuple(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+
+    return rec(batch), arrays
+
+
+def _unflatten(template, arrays: List[np.ndarray]):
+    def rec(x):
+        if isinstance(x, tuple) and len(x) == 2 and x[0] == "__a__":
+            return arrays[x[1]]
+        if isinstance(x, list):
+            return [rec(v) for v in x]
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+
+    return rec(template)
+
+
+def encode_batch(tag: int, batch) -> bytes:
+    template, arrays = _flatten(batch)
+    tpl = pickle.dumps(template, protocol=pickle.HIGHEST_PROTOCOL)
+    parts = [struct.pack("<III", tag, len(arrays), len(tpl)), tpl]
+    for a in arrays:
+        dt = a.dtype.str.encode()
+        parts.append(struct.pack("<B", len(dt)))
+        parts.append(dt)
+        parts.append(struct.pack("<B", a.ndim))
+        parts.append(struct.pack(f"<{a.ndim}Q" if a.ndim else "<0Q", *a.shape))
+        raw = a.tobytes()
+        parts.append(struct.pack("<Q", len(raw)))
+        parts.append(raw)
+    return b"".join(parts)
+
+
+def decode_batch(data: bytes):
+    tag, n, tpl_len = struct.unpack_from("<III", data, 0)
+    off = 12
+    template = pickle.loads(data[off:off + tpl_len])
+    off += tpl_len
+    arrays = []
+    for _ in range(n):
+        (dl,) = struct.unpack_from("<B", data, off)
+        off += 1
+        dt = np.dtype(data[off:off + dl].decode())
+        off += dl
+        (nd,) = struct.unpack_from("<B", data, off)
+        off += 1
+        shape = struct.unpack_from(f"<{nd}Q", data, off) if nd else ()
+        off += 8 * nd
+        (nb,) = struct.unpack_from("<Q", data, off)
+        off += 8
+        arrays.append(np.frombuffer(data, dtype=dt, count=nb // dt.itemsize,
+                                    offset=off).reshape(shape))
+        off += nb
+    return tag, _unflatten(template, arrays)

@@ -1,0 +1,130 @@
+"""Multiprocess DataLoader + native shm ring tests.
+
+Reference parity: fluid/dataloader/dataloader_iter.py:336 (worker processes,
+shared-memory transport, order preservation) + pybind/reader_py.cc
+(BlockingQueue).  The determinism contract: output order equals sampler
+order regardless of worker count or timing.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.shm_queue import ShmQueue, decode_batch, encode_batch
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, decode_ms=0.0):
+        self.n = n
+        self.decode_ms = decode_ms
+
+    def __getitem__(self, i):
+        if self.decode_ms:
+            time.sleep(self.decode_ms / 1000.0)
+        return (np.full((4, 4), i, np.float32), np.int64(i))
+
+    def __len__(self):
+        return self.n
+
+
+class FailingDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 13:
+            raise ValueError("boom at 13")
+        return super().__getitem__(i)
+
+
+class TestShmQueue:
+    def test_roundtrip_and_wrap(self):
+        q = ShmQueue(f"/pt_ut_{os.getpid()}", capacity=1 << 14)
+        payloads = [bytes([i % 251]) * (i * 37 % 3000 + 1) for i in range(100)]
+        # interleave so the ring wraps many times but never overfills
+        pending = []
+        for p in payloads:
+            q.put(p, timeout=5)
+            pending.append(p)
+            if len(pending) >= 3:
+                assert q.get(timeout=5) == pending.pop(0)
+        while pending:
+            assert q.get(timeout=5) == pending.pop(0)
+
+    def test_batch_codec(self):
+        batch = {"x": np.arange(6, dtype=np.float32).reshape(2, 3),
+                 "meta": ["a", ("b", np.ones(2, np.int64))]}
+        tag, out = decode_batch(encode_batch(42, batch))
+        assert tag == 42
+        np.testing.assert_array_equal(out["x"], batch["x"])
+        assert out["meta"][0] == "a"
+        np.testing.assert_array_equal(out["meta"][1][1], batch["meta"][1][1])
+
+    def test_timeout(self):
+        q = ShmQueue(f"/pt_ut_to_{os.getpid()}", capacity=1 << 12)
+        with pytest.raises(TimeoutError):
+            q.get(timeout=0.2)
+
+
+class TestMultiprocessLoader:
+    @pytest.mark.parametrize("num_workers", [1, 3])
+    def test_order_matches_serial(self, num_workers):
+        ds = ArrayDataset(64)
+        serial = [(np.asarray(x._data), np.asarray(y._data))
+                  for x, y in DataLoader(ds, batch_size=8, num_workers=0)]
+        par = [(np.asarray(x._data), np.asarray(y._data))
+               for x, y in DataLoader(ds, batch_size=8,
+                                      num_workers=num_workers)]
+        assert len(serial) == len(par) == 8
+        for (sx, sy), (px, py) in zip(serial, par):
+            np.testing.assert_array_equal(sx, px)
+            np.testing.assert_array_equal(sy, py)
+
+    def test_mp_queue_fallback_order(self):
+        ds = ArrayDataset(32)
+        par = [np.asarray(y._data)
+               for _, y in DataLoader(ds, batch_size=8, num_workers=2,
+                                      use_shared_memory=False)]
+        np.testing.assert_array_equal(np.concatenate(par), np.arange(32))
+
+    def test_worker_error_propagates(self):
+        loader = DataLoader(FailingDataset(32), batch_size=8, num_workers=2)
+        with pytest.raises(RuntimeError, match="boom at 13"):
+            list(loader)
+
+    def test_worker_init_fn_runs(self):
+        calls = []
+
+        # worker_init_fn runs in the child; prove it via a side effect the
+        # child can ship back — mutate the dataset copy so sample 0 changes
+        class InitDataset(ArrayDataset):
+            offset = 0
+
+            def __getitem__(self, i):
+                return (np.full((2,), i + self.offset, np.float32),)
+
+        def init_fn(worker_id):
+            InitDataset.offset = 100
+
+        out = [np.asarray(x._data)
+               for (x,) in DataLoader(InitDataset(8), batch_size=4,
+                                      num_workers=1, worker_init_fn=init_fn)]
+        assert out[0][0, 0] == 100.0
+
+    def test_workers_scale_on_decode_heavy_dataset(self):
+        """The round-1 loader ignored num_workers: one GIL thread.  With
+        process workers a sleep-decode dataset must scale.  The decode work
+        (64 x 20ms = 1.28s serial) dominates fork/attach overhead, and the
+        bound is deliberately loose to stay robust on loaded CI hosts."""
+        ds = ArrayDataset(64, decode_ms=20.0)
+
+        def run(workers):
+            t0 = time.perf_counter()
+            n = sum(1 for _ in DataLoader(ds, batch_size=4,
+                                          num_workers=workers))
+            assert n == 16
+            return time.perf_counter() - t0
+
+        t1 = run(1)
+        t4 = run(4)
+        assert t4 < t1 / 1.5, (t1, t4)
