@@ -112,7 +112,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
         v = v_ref[0].astype(jnp.float32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
-        s = s + km_ref[0].astype(jnp.float32)[None, :]
+        s = s + km_ref[0, 0].astype(jnp.float32)[None, :]
         if causal:
             rows = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -146,7 +146,7 @@ def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = (m_ref[:] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd_pallas(q, k, v, kmask, seed, causal, scale, dropout_p,
@@ -161,7 +161,11 @@ def _flash_fwd_pallas(q, k, v, kmask, seed, causal, scale, dropout_p,
         _flash_fwd_kernel, causal=causal, scale=scale, dropout_p=dropout_p,
         block_q=block_q, block_k=block_k, n_k=L // block_k)
     H = n_heads
-    return pl.pallas_call(
+    # Row-stat operands (kmask, lse) ride a unit sublane dim: Mosaic requires
+    # the last-two block dims be (mult-of-8, mult-of-128) or equal the array
+    # dims, so (B, L) with block (1, block) is illegal while (B, 1, L) with
+    # block (1, 1, block) is fine.
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -169,15 +173,15 @@ def _flash_fwd_pallas(q, k, v, kmask, seed, causal, scale, dropout_p,
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H, j)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // H, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, L, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, L), jnp.float32),
+            jax.ShapeDtypeStruct((BH, 1, L), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -185,7 +189,8 @@ def _flash_fwd_pallas(q, k, v, kmask, seed, causal, scale, dropout_p,
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(seed, q, k, v, kmask)
+    )(seed, q, k, v, kmask.reshape(kmask.shape[0], 1, L))
+    return out, lse.reshape(BH, L)
 
 
 # ---------------------------------------------------------------------------
@@ -237,9 +242,9 @@ def _flash_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body():
         _, _, ds = _bwd_block(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
-            km_ref[0], (seed_ref[0], bh), causal, scale, dropout_p,
-            qi * block_q, ki * block_k)
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0, 0],
+            delta_ref[0, 0], km_ref[0, 0], (seed_ref[0], bh), causal, scale,
+            dropout_p, qi * block_q, ki * block_k)
         acc_ref[:] += scale * lax.dot_general(
             ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -270,9 +275,9 @@ def _flash_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body():
         _, pd, ds = _bwd_block(
-            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0], delta_ref[0],
-            km_ref[0], (seed_ref[0], bh), causal, scale, dropout_p,
-            qi * block_q, ki * block_k)
+            q_ref[0], k_ref[0], v_ref[0], do_ref[0], lse_ref[0, 0],
+            delta_ref[0, 0], km_ref[0, 0], (seed_ref[0], bh), causal, scale,
+            dropout_p, qi * block_q, ki * block_k)
         dv_acc[:] += lax.dot_general(
             pd, do_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -306,6 +311,10 @@ def _flash_bwd_pallas(q, k, v, kmask, seed, do, lse, delta, causal, scale,
     data_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),  # seed
     ]
+    # unit sublane dim for row stats — see _flash_fwd_pallas
+    kmask3 = kmask.reshape(kmask.shape[0], 1, L)
+    lse3 = lse.reshape(BH, 1, L)
+    delta3 = delta.reshape(BH, 1, L)
 
     def qspec(im):
         return pl.BlockSpec((1, block_q, D), im)
@@ -321,15 +330,15 @@ def _flash_bwd_pallas(q, k, v, kmask, seed, do, lse, delta, causal, scale,
             kspec(lambda b, i, j: (b, j, 0)),
             kspec(lambda b, i, j: (b, j, 0)),
             qspec(lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // H, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // H, 0, j)),
         ],
         out_specs=qspec(lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, L, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interpret,
-    )(seed, q, k, v, do, lse, delta, kmask)
+    )(seed, q, k, v, do, lse3, delta3, kmask3)
 
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, n_q=L // block_q, **common),
@@ -339,9 +348,9 @@ def _flash_bwd_pallas(q, k, v, kmask, seed, do, lse, delta, causal, scale,
             kspec(lambda b, j, i: (b, j, 0)),
             kspec(lambda b, j, i: (b, j, 0)),
             qspec(lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, j, i: (b // H, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b // H, 0, j)),
         ],
         out_specs=[kspec(lambda b, j, i: (b, j, 0)),
                    kspec(lambda b, j, i: (b, j, 0))],
@@ -350,7 +359,7 @@ def _flash_bwd_pallas(q, k, v, kmask, seed, do, lse, delta, causal, scale,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interpret,
-    )(seed, q, k, v, do, lse, delta, kmask)
+    )(seed, q, k, v, do, lse3, delta3, kmask3)
     return dq, dk, dv
 
 

@@ -16,6 +16,10 @@ if "host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The TPU PJRT plugin's sitecustomize imports jax at interpreter startup and
+# force-selects its own platform, so the env var above is latched too late —
+# override the live config (legal until the first backend initializes).
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
