@@ -1,0 +1,142 @@
+"""Autograd public API (reference: python/paddle/autograd/__init__.py).
+
+The eager engine itself lives in core/autograd.py (tape of Nodes replayed
+via jax.vjp).  This package is the user-facing surface: multi-root
+``backward``, ``PyLayer`` custom ops, and the functional transforms
+(jacobian/hessian/vjp/jvp) which map 1:1 onto jax transforms over
+functionalized callables — the reference builds these out of double-grad
+graphs (python/paddle/autograd/functional.py); on TPU the native transforms
+are both simpler and faster to compile.
+"""
+
+from __future__ import annotations
+
+from ..core.autograd import (enable_grad, is_grad_enabled, no_grad,  # noqa: F401
+                             set_grad_enabled)
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+__all__ = ["backward", "PyLayer", "PyLayerContext", "no_grad", "enable_grad",
+           "set_grad_enabled", "is_grad_enabled", "grad", "jacobian",
+           "hessian", "vjp", "jvp"]
+
+
+def grad(*args, **kwargs):
+    from .. import grad as _grad
+    return _grad(*args, **kwargs)
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Multi-root backward (reference: autograd/backward_mode.py:23).
+
+    Accumulates into leaf ``.grad`` for every root in ``tensors``.
+    """
+    from ..core import autograd as _engine
+    from ..core.tensor import Tensor
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    else:
+        grad_tensors = (grad_tensors if isinstance(grad_tensors, (list, tuple))
+                        else [grad_tensors])
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"grad_tensors length ({len(grad_tensors)}) must match tensors "
+            f"length ({len(tensors)})")
+    if len({id(t) for t in tensors}) != len(tensors):
+        raise RuntimeError("tensors in backward() must be unique")
+    for i, (t, g) in enumerate(zip(tensors, grad_tensors)):
+        # retain for all but the last root so shared subgraphs stay replayable
+        keep = retain_graph or (i < len(tensors) - 1)
+        _engine.backward(t, g, retain_graph=keep)
+
+
+# ---------------------------------------------------------------------------
+# Functional transforms over Tensor-callables
+# ---------------------------------------------------------------------------
+
+def _functionalize(func, n_in):
+    """Wrap a Tensor-callable as a raw-array callable."""
+    import jax
+    from ..core.tensor import Tensor
+
+    def raw(*datas):
+        outs = func(*[Tensor(d) for d in datas])
+        single = not isinstance(outs, (tuple, list))
+        outs = (outs,) if single else tuple(outs)
+        raws = tuple(getattr(o, "_data", o) for o in outs)
+        return raws[0] if single else raws
+
+    return raw
+
+
+def _split_inputs(xs):
+    xs = xs if isinstance(xs, (list, tuple)) else (xs,)
+    return tuple(getattr(x, "_data", x) for x in xs)
+
+
+def vjp(func, xs, v=None):
+    """(outputs, input-cotangents) of ``func`` at ``xs`` (functional.py:vjp)."""
+    import jax
+    from ..core.tensor import Tensor
+
+    datas = _split_inputs(xs)
+    raw = _functionalize(func, len(datas))
+    outs, vjp_fn = jax.vjp(raw, *datas)
+    if v is None:
+        import jax.numpy as jnp
+        v = jax.tree_util.tree_map(jnp.ones_like, outs)
+    else:
+        v = jax.tree_util.tree_map(
+            lambda t: getattr(t, "_data", t),
+            v, is_leaf=lambda t: hasattr(t, "_data"))
+    cots = vjp_fn(v)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    return wrap(outs), wrap(cots if len(datas) > 1 else cots[0])
+
+
+def jvp(func, xs, v=None):
+    """(outputs, output-tangents) of ``func`` at ``xs``."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+
+    datas = _split_inputs(xs)
+    raw = _functionalize(func, len(datas))
+    if v is None:
+        tangents = tuple(jnp.ones_like(d) for d in datas)
+    else:
+        vs = v if isinstance(v, (list, tuple)) else (v,)
+        tangents = tuple(getattr(t, "_data", t) for t in vs)
+    outs, tangents_out = jax.jvp(raw, datas, tangents)
+    wrap = lambda tree: jax.tree_util.tree_map(Tensor, tree)
+    return wrap(outs), wrap(tangents_out)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    """Jacobian of ``func`` at ``xs`` via ``jax.jacrev``."""
+    import jax
+    from ..core.tensor import Tensor
+
+    datas = _split_inputs(xs)
+    raw = _functionalize(func, len(datas))
+    jac = jax.jacrev(raw, argnums=tuple(range(len(datas))))(*datas)
+    wrapped = jax.tree_util.tree_map(Tensor, jac)
+    if len(datas) == 1 and isinstance(wrapped, tuple) and len(wrapped) == 1:
+        return wrapped[0]
+    return wrapped
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    """Hessian of a scalar-valued ``func`` at ``xs`` via ``jax.hessian``."""
+    import jax
+    from ..core.tensor import Tensor
+
+    datas = _split_inputs(xs)
+    raw = _functionalize(func, len(datas))
+    hes = jax.hessian(raw, argnums=tuple(range(len(datas))))(*datas)
+    wrapped = jax.tree_util.tree_map(Tensor, hes)
+    if len(datas) == 1 and isinstance(wrapped, tuple) and len(wrapped) == 1:
+        w = wrapped[0]
+        return w[0] if isinstance(w, tuple) and len(w) == 1 else w
+    return wrapped

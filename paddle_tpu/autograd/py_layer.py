@@ -1,0 +1,118 @@
+"""PyLayer: user-defined ops with custom backward
+(reference: python/paddle/autograd/py_layer.py:21,133).
+
+TPU-native wiring: the reference registers a C++ PyLayer grad node that
+calls back into Python during backward (pylayer_op.cc).  Here the user's
+``forward``/``backward`` pair becomes a ``jax.custom_vjp`` function that is
+dispatched through the standard eager ``apply`` — so the op records one
+tape Node like every built-in, replays correctly under ``jax.vjp``, works
+inside jit (where forward/backward trace instead of running eagerly), and
+composes with AMP/hooks for free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+
+class PyLayerContext:
+    """Carries state from forward to backward (reference py_layer.py:21)."""
+
+    def __init__(self):
+        self.container = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self.container = tensors
+
+    def saved_tensor(self):
+        return self.container
+
+
+class PyLayer:
+    """Subclass with ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    static methods; call via ``.apply(*args)``.
+
+    ``backward`` receives one cotangent per forward output and must return
+    one gradient per differentiable forward input (None → zero).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError(
+            "You must implement the forward function for PyLayer.")
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError(
+            "You must implement the backward function for PyLayer.")
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core.tensor import Tensor, apply as dispatch
+
+        tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+        if not tensor_pos:
+            ctx = PyLayerContext()
+            return cls.forward(ctx, *args, **kwargs)
+        # The eager call's ctx is kept for backward; re-traces (tape replay,
+        # jit) run forward again with a throwaway ctx — recompute-not-save,
+        # consistent with the tape's rebuild design.
+        state = {"ctx": None, "n_out": None}
+
+        def run_forward(ctx, datas):
+            full = list(args)
+            for p, d in zip(tensor_pos, datas):
+                full[p] = Tensor(d, stop_gradient=True)
+            outs = cls.forward(ctx, *full, **kwargs)
+            single = not isinstance(outs, (tuple, list))
+            outs = (outs,) if single else tuple(outs)
+            state["n_out"] = len(outs)
+            state["single"] = single
+            return tuple(getattr(o, "_data", o) for o in outs)
+
+        @jax.custom_vjp
+        def op(*datas):
+            ctx = PyLayerContext()
+            if state["ctx"] is None:
+                state["ctx"] = ctx
+            return run_forward(ctx, datas)
+
+        def op_fwd(*datas):
+            ctx = PyLayerContext()
+            if state["ctx"] is None:
+                state["ctx"] = ctx
+            return run_forward(ctx, datas), datas
+
+        def op_bwd(res, gs):
+            from ..core.autograd import no_grad
+            from ..core.tensor import Tensor as T
+            ctx = state["ctx"] if state["ctx"] is not None else PyLayerContext()
+            with no_grad():
+                grads = cls.backward(ctx, *[T(g, stop_gradient=True) for g in gs])
+            single = not isinstance(grads, (tuple, list))
+            grads = (grads,) if single else tuple(grads)
+            # align with differentiable inputs; None → zeros
+            raw: List[Any] = []
+            gi = iter(grads)
+            for d in res:
+                try:
+                    g = next(gi)
+                except StopIteration:
+                    g = None
+                raw.append(jnp.zeros_like(d) if g is None
+                           else getattr(g, "_data", g).astype(d.dtype).reshape(d.shape))
+            return tuple(raw)
+
+        op.defvjp(op_fwd, op_bwd)
+
+        out = dispatch(op, *[args[i] for i in tensor_pos],
+                       name=cls.__name__)
+        if isinstance(out, tuple) and state.get("single", False):
+            return out[0]
+        if isinstance(out, tuple) and len(out) == 1:
+            return out[0]
+        return out

@@ -1,0 +1,9 @@
+"""Training callbacks (reference: python/paddle/callbacks.py — a re-export
+of the hapi callback classes, mirrored here the same way)."""
+
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
+                             LRScheduler, ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, VisualDL)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL", "ReduceLROnPlateau"]

@@ -1,0 +1,52 @@
+"""Device management facade (reference: python/paddle/device/__init__.py).
+
+Re-exports the core Place/device machinery (core/device.py) under the
+public ``paddle.device`` namespace, plus the ``is_compiled_with_*`` probes
+— all False except TPU/XLA, which is what this framework is compiled with.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..core.device import (Place, device_count, get_device,  # noqa: F401
+                           is_compiled_with_cuda, is_compiled_with_tpu,
+                           local_devices, set_device, synchronize)
+from . import cuda  # noqa: F401
+
+__all__ = [
+    "get_cudnn_version", "set_device", "get_device", "XPUPlace",
+    "is_compiled_with_xpu", "is_compiled_with_cuda", "is_compiled_with_rocm",
+    "is_compiled_with_npu", "is_compiled_with_tpu", "device_count",
+    "synchronize", "get_all_device_type", "get_all_custom_device_type",
+]
+
+
+def get_cudnn_version():
+    """No cuDNN in an XLA/TPU build (reference returns None when absent)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def XPUPlace(dev_id=0):
+    raise RuntimeError(
+        "paddle_tpu is not compiled with XPU support; use set_device('tpu')")
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_all_custom_device_type():
+    return []

@@ -1,0 +1,55 @@
+"""``paddle.device.cuda`` API surface (reference: python/paddle/device/cuda).
+
+There is no CUDA in a TPU build; the count/probe entry points answer
+truthfully (0 devices) instead of raising, matching the reference's behavior
+on a CPU-only build, so device-agnostic user code keeps working.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Stream", "Event", "current_stream", "synchronize", "device_count",
+           "max_memory_allocated", "max_memory_reserved", "memory_allocated",
+           "memory_reserved", "empty_cache"]
+
+
+def device_count() -> int:
+    return 0
+
+
+def synchronize(device=None):
+    from ...core.device import synchronize as _sync
+    return _sync()
+
+
+def current_stream(device=None):
+    raise RuntimeError("CUDA streams are unavailable in a TPU/XLA build")
+
+
+class Stream:
+    def __init__(self, *a, **k):
+        raise RuntimeError("CUDA streams are unavailable in a TPU/XLA build")
+
+
+class Event:
+    def __init__(self, *a, **k):
+        raise RuntimeError("CUDA events are unavailable in a TPU/XLA build")
+
+
+def max_memory_allocated(device=None) -> int:
+    return 0
+
+
+def max_memory_reserved(device=None) -> int:
+    return 0
+
+
+def memory_allocated(device=None) -> int:
+    return 0
+
+
+def memory_reserved(device=None) -> int:
+    return 0
+
+
+def empty_cache():
+    return None

@@ -1,0 +1,244 @@
+"""Probability distributions (reference: python/paddle/distribution.py).
+
+The reference builds every distribution out of eager elementwise ops plus
+``uniform_random``/``gaussian_random`` kernels; here each distribution is a
+thin object whose methods are pure jnp functions drawing from the framework
+RNG streams (core/rng.py), so they trace cleanly under jit and run on the
+MXU-free VPU path.  API parity: Distribution / Uniform / Normal /
+Categorical (reference __all__, distribution.py:39) plus Bernoulli and a
+``kl_divergence`` registry (later reference versions ship both).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import rng
+from .core.tensor import Tensor, apply
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical", "Bernoulli",
+           "kl_divergence", "register_kl"]
+
+
+def _to_array(x, dtype=jnp.float32):
+    if isinstance(x, Tensor):
+        return x._data.astype(dtype)
+    return jnp.asarray(x, dtype)
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(s) for s in shape)
+    return (int(shape),)
+
+
+class Distribution:
+    """Abstract base: sample / entropy / log_prob / probs / kl_divergence."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Uniform(Distribution):
+    """U[low, high) with reparameterized sampling.
+
+    log_prob/probs follow the reference semantics (distribution.py:169):
+    density 1/(high-low) inside the support, 0 outside.
+    """
+
+    def __init__(self, low, high, name=None):
+        self.low = _to_array(low)
+        self.high = _to_array(high)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.low.shape, self.high.shape)
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self._batch
+        u = jax.random.uniform(rng.next_key(), shape, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            lp = -jnp.log(hi - lo)
+            return jnp.where(inside, lp, -jnp.inf)
+        return apply(f, value, Tensor(self.low), Tensor(self.high))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    """N(loc, scale^2) with reparameterized sampling."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_array(loc)
+        self.scale = _to_array(scale)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self._batch
+        eps = jax.random.normal(rng.next_key(), shape, jnp.float32)
+        return Tensor(self.loc + eps * self.scale)
+
+    def log_prob(self, value):
+        def f(v, mu, sigma):
+            var = sigma * sigma
+            return (-((v - mu) ** 2) / (2.0 * var)
+                    - jnp.log(sigma) - 0.5 * math.log(2.0 * math.pi))
+        return apply(f, value, Tensor(self.loc), Tensor(self.scale))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2.0 * math.pi)
+                      + jnp.log(self.scale * jnp.ones(self._batch)))
+
+
+class Categorical(Distribution):
+    """Categorical over the last axis of ``logits``."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _to_array(logits)
+
+    @property
+    def _log_pmf(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape)
+        out = jax.random.categorical(
+            rng.next_key(), self.logits, axis=-1,
+            shape=shape + self.logits.shape[:-1])
+        return Tensor(out)
+
+    def entropy(self):
+        lp = self._log_pmf
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, axis=-1))
+
+    def log_prob(self, value):
+        def f(v, logits):
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            v = v.astype(jnp.int32)
+            bshape = jnp.broadcast_shapes(v.shape, lp.shape[:-1])
+            lpb = jnp.broadcast_to(lp, bshape + lp.shape[-1:])
+            vb = jnp.broadcast_to(v, bshape)
+            return jnp.take_along_axis(lpb, vb[..., None], axis=-1)[..., 0]
+        return apply(f, value, Tensor(self.logits))
+
+    def probs(self, value):
+        return apply(jnp.exp, self.log_prob(value))
+
+    def kl_divergence(self, other):
+        def f(p_logits, q_logits):
+            p_lp = jax.nn.log_softmax(p_logits, axis=-1)
+            q_lp = jax.nn.log_softmax(q_logits, axis=-1)
+            return jnp.sum(jnp.exp(p_lp) * (p_lp - q_lp), axis=-1)
+        return apply(f, Tensor(self.logits), Tensor(other.logits))
+
+
+class Bernoulli(Distribution):
+    """Bernoulli(probs) over {0, 1}."""
+
+    def __init__(self, probs, name=None):
+        self.probs_ = _to_array(probs)
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape) + self.probs_.shape
+        out = jax.random.bernoulli(rng.next_key(), self.probs_, shape)
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, p):
+            p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+            return v * jnp.log(p) + (1.0 - v) * jnp.log1p(-p)
+        return apply(f, value, Tensor(self.probs_))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1.0 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1.0 - p) * jnp.log1p(-p)))
+
+
+# --------------------------------------------------------------------------
+# KL divergence registry (reference pattern: paddle.distribution.kl.register_kl)
+# --------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (tp, tq), cand in _KL_REGISTRY.items():
+            if isinstance(p, tp) and isinstance(q, tq):
+                fn = cand
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"KL divergence not registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    inside = (q.low <= p.low) & (p.high <= q.high)
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(inside, kl, jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.probs_, 1e-7, 1.0 - 1e-7)
+    qq = jnp.clip(q.probs_, 1e-7, 1.0 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))

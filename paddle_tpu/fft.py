@@ -1,0 +1,165 @@
+"""Discrete Fourier transforms (reference: python/paddle/fft.py).
+
+TPU-native design: every transform delegates to ``jnp.fft`` — XLA lowers
+FFTs to its native Fft HLO, which the TPU backend executes directly, so
+there is no custom kernel to write.  The reference dispatches per-backend
+C2C/R2C/C2R kernels (fft_c2c / fft_r2c / fft_c2r, python/paddle/fft.py:1357)
+selected by dtype; here a single jnp call covers all of them and the r2c /
+c2r distinction falls out of rfft/irfft.
+
+Norm convention matches the reference exactly: ``"backward"`` (scale 1/n on
+the inverse), ``"forward"`` (scale 1/n on the forward), ``"ortho"``
+(1/sqrt(n) both ways) — the same strings jnp.fft accepts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import apply
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be forward, backward or ortho")
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=norm), x)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=norm), x)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ihfft(a, n=n, axis=axis, norm=norm), x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.ifftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.rfftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    return apply(lambda a: jnp.fft.irfftn(a, s=s, axes=axes, norm=norm), x)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    # jnp has no hfftn; compose c2c over the leading axes FIRST, then the
+    # hermitian c2r over the last axis.  Order matters: hfft conjugates its
+    # input, which does not commute with FFTs over other axes.
+    def f(a):
+        axes_ = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        lead, last = axes_[:-1], axes_[-1]
+        if lead:
+            slead = None if s is None else s[:-1]
+            a = jnp.fft.fftn(a, s=slead, axes=lead, norm=norm)
+        nlast = None if s is None else s[-1]
+        return jnp.fft.hfft(a, n=nlast, axis=last, norm=norm)
+    return apply(f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    _check_norm(norm)
+    def f(a):
+        axes_ = tuple(range(a.ndim)) if axes is None else tuple(axes)
+        lead, last = axes_[:-1], axes_[-1]
+        nlast = None if s is None else s[-1]
+        out = jnp.fft.ihfft(a, n=nlast, axis=last, norm=norm)
+        if lead:
+            slead = None if s is None else s[:-1]
+            out = jnp.fft.ifftn(out, s=slead, axes=lead, norm=norm)
+        return out
+    return apply(f, x)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core.tensor import Tensor
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .core.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x)

@@ -1,0 +1,12 @@
+"""Incubating APIs (reference: python/paddle/incubate/__init__.py).
+
+Graduated-but-experimental surface: LookAhead / ModelAverage optimizers
+(reference incubate/optimizer/) and the auto-checkpoint machinery
+(reference incubate/checkpoint/auto_checkpoint.py) live here, mirroring the
+reference layout.
+"""
+
+from . import checkpoint, optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["optimizer", "checkpoint", "LookAhead", "ModelAverage"]

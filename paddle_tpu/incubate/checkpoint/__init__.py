@@ -1,0 +1,4 @@
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import train_epoch_range  # noqa: F401
+
+__all__ = ["auto_checkpoint", "train_epoch_range"]

@@ -1,0 +1,101 @@
+"""Timer-based auto-checkpoint (reference:
+python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71).
+
+The reference's TrainEpochRange wraps the epoch loop: it periodically
+snapshots registered state to a checkpoint dir (HDFS there, local/NFS here)
+and, on restart, resumes the loop from the last saved epoch.  Same contract
+here, driven by env vars of the same spirit:
+
+- ``PADDLE_TPU_CHECKPOINT_DIR``  — where snapshots go (required to enable)
+- ``PADDLE_TPU_CHECKPOINT_INTERVAL`` — min seconds between saves (default 60)
+
+Usage::
+
+    for epoch in acp.train_epoch_range(max_epoch, save_fn=..., load_fn=...):
+        train_one_epoch(...)
+
+``save_fn(path)`` persists user state; ``load_fn(path)`` restores it.  The
+epoch counter itself is managed by this module (saved atomically next to the
+user state), so a relaunched job continues where it stopped.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Iterator, Optional
+
+__all__ = ["train_epoch_range", "TrainEpochRange"]
+
+
+class TrainEpochRange:
+    def __init__(self, max_epoch_num: int, name: str = "acp",
+                 save_fn: Optional[Callable[[str], None]] = None,
+                 load_fn: Optional[Callable[[str], None]] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 save_checkpoint_inter: Optional[float] = None):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        self.save_fn = save_fn
+        self.load_fn = load_fn
+        self.dir = checkpoint_dir or os.environ.get("PADDLE_TPU_CHECKPOINT_DIR")
+        self.interval = float(
+            save_checkpoint_inter
+            if save_checkpoint_inter is not None
+            else os.environ.get("PADDLE_TPU_CHECKPOINT_INTERVAL", "60"))
+        self._last_save = 0.0
+        self.restored_epoch = -1
+
+    # -- paths -------------------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, f"{self.name}.meta.json")
+
+    def _state_path(self) -> str:
+        return os.path.join(self.dir, f"{self.name}.state")
+
+    # -- save/restore ------------------------------------------------------
+    def _restore(self):
+        if not self.dir or not os.path.exists(self._meta_path()):
+            return
+        with open(self._meta_path()) as f:
+            meta = json.load(f)
+        self.restored_epoch = int(meta.get("epoch", -1))
+        if self.load_fn is not None and os.path.exists(self._state_path()):
+            self.load_fn(self._state_path())
+
+    def _save(self, epoch: int, force: bool = False):
+        if not self.dir:
+            return
+        now = time.time()
+        if not force and now - self._last_save < self.interval:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        if self.save_fn is not None:
+            # write state to a tmp path and rename, so a crash mid-save never
+            # corrupts the state the committed meta points at
+            state_tmp = self._state_path() + ".tmp"
+            self.save_fn(state_tmp)
+            os.replace(state_tmp, self._state_path())
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"epoch": epoch, "ts": now, "name": self.name}, f)
+        os.replace(tmp, self._meta_path())  # atomic: meta commits the snapshot
+        self._last_save = now
+
+    # -- the range ---------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        self._restore()
+        start = self.restored_epoch + 1
+        for epoch in range(start, self.max_epoch_num):
+            yield epoch
+            self._save(epoch, force=(epoch == self.max_epoch_num - 1))
+
+
+def train_epoch_range(max_epoch_num: int, save_fn=None, load_fn=None,
+                      checkpoint_dir=None, save_checkpoint_inter=None,
+                      name: str = "acp") -> TrainEpochRange:
+    """Resumable epoch range (reference auto_checkpoint._get_train_epoch_range)."""
+    return TrainEpochRange(max_epoch_num, name=name, save_fn=save_fn,
+                           load_fn=load_fn, checkpoint_dir=checkpoint_dir,
+                           save_checkpoint_inter=save_checkpoint_inter)

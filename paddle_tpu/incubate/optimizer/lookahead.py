@@ -1,0 +1,121 @@
+"""LookAhead optimizer (reference: python/paddle/incubate/optimizer/lookahead.py).
+
+k fast steps with the inner optimizer, then the slow weights move
+alpha·(fast − slow) and the fast weights reset to the slow ones
+("Lookahead Optimizer: k steps forward, 1 step back", Zhang et al. 2019).
+
+Wraps any paddle_tpu Optimizer; works in both eager mode (``step()``) and
+the functional jit path (``init_state``/``update`` — the slow copies ride
+in the state pytree so the whole schedule stays inside one compiled step,
+with the k-boundary expressed as a ``jnp.where`` instead of host control
+flow).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not isinstance(inner_optimizer, Optimizer):
+            raise TypeError("inner optimizer must be a paddle_tpu Optimizer")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha should be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k should be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._k_count = 0
+        # Optimizer.__init__ is deliberately not called (everything delegates
+        # to the inner optimizer); satisfy the attributes that inherited
+        # entry points read so none of them AttributeError.
+        self._grad_clip = None
+        self._weight_decay = None
+        self._learning_rate = inner_optimizer._learning_rate
+        self._param_groups = None
+        self._accum = {}
+        self._step_count = 0
+
+    # ---------------------------------------------------------------- eager
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    @_parameter_list.setter
+    def _parameter_list(self, v):  # Optimizer.__init__ not called; ignore
+        pass
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+        self._learning_rate = self.inner_optimizer._learning_rate
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        if params is None:
+            raise ValueError("inner optimizer has no parameter list")
+        for p in params:
+            if id(p) not in self._slow:
+                self._slow[id(p)] = jnp.array(p._data)
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                p._data = slow
+                self._slow[id(p)] = slow
+
+    minimize_step = step  # re-point the class alias at the override
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        return None, None
+
+    # ----------------------------------------------------------- functional
+    def init_state(self, params):
+        return {
+            "inner": self.inner_optimizer.init_state(params),
+            "slow": jax.tree_util.tree_map(jnp.array, params),
+            "k_count": jnp.zeros([], jnp.int32),
+        }
+
+    def update(self, grads, state, params, lr=None):
+        new_params, inner_state = self.inner_optimizer.update(
+            grads, state["inner"], params, lr=lr)
+        k_count = state["k_count"] + 1
+        sync = (k_count % self.k) == 0
+
+        def merge(slow, fast):
+            merged = slow + self.alpha * (fast - slow)
+            return (jnp.where(sync, merged, slow),
+                    jnp.where(sync, merged.astype(fast.dtype), fast))
+
+        pairs = jax.tree_util.tree_map(merge, state["slow"], new_params)
+        new_slow = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
+                                          is_leaf=lambda x: isinstance(x, tuple))
+        out_params = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
+                                            is_leaf=lambda x: isinstance(x, tuple))
+        return out_params, {"inner": inner_state, "slow": new_slow,
+                            "k_count": k_count}
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_k_count"] = self._k_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._k_count = int(state_dict.pop("lookahead_k_count", 0))
+        self.inner_optimizer.set_state_dict(state_dict)

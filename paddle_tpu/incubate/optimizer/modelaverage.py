@@ -1,0 +1,80 @@
+"""ModelAverage (reference: python/paddle/incubate/optimizer/modelaverage.py,
+fluid/optimizer.py:3619).
+
+Maintains the reference's three-accumulator running sum of parameter values
+(sum_1 / sum_2 / sum_3 with window restarts controlled by
+``average_window_rate`` and min/max window) and swaps the averaged weights
+in for evaluation via ``apply()`` / ``restore()``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+
+class ModelAverage:
+    def __init__(self, average_window_rate, parameters=None, min_average_window=10000,
+                 max_average_window=10000000, name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = list(parameters) if parameters is not None else []
+        self._acc: Dict[int, Dict[str, Any]] = {}
+        self._backup: Dict[int, Any] = {}
+
+    def _state(self, p):
+        st = self._acc.get(id(p))
+        if st is None:
+            st = {"sum_1": jnp.zeros_like(p._data), "sum_2": jnp.zeros_like(p._data),
+                  "sum_3": jnp.zeros_like(p._data), "num_accumulates": 0,
+                  "old_num_accumulates": 0, "num_updates": 0}
+            self._acc[id(p)] = st
+        return st
+
+    def step(self):
+        """Accumulate current parameter values (call after optimizer.step())."""
+        for p in self._params:
+            st = self._state(p)
+            st["sum_1"] = st["sum_1"] + p._data
+            st["num_accumulates"] += 1
+            st["num_updates"] += 1
+            # window restart (reference average_accumulates_op.h:94): fold the
+            # live sums into sum_3 (discarding the previous old window) and
+            # carry the count over single-counted
+            if (st["num_accumulates"] >= self.min_w
+                    and st["num_accumulates"] >= min(
+                        self.max_w, st["num_updates"] * self.rate)):
+                st["sum_3"] = st["sum_1"] + st["sum_2"]
+                st["sum_2"] = jnp.zeros_like(st["sum_2"])
+                st["sum_1"] = jnp.zeros_like(st["sum_1"])
+                st["old_num_accumulates"] = st["num_accumulates"]
+                st["num_accumulates"] = 0
+
+    minimize_step = step
+
+    def _average(self, p):
+        st = self._state(p)
+        total = st["num_accumulates"] + st["old_num_accumulates"]
+        if total == 0:
+            return p._data
+        s = st["sum_1"] + st["sum_2"] + st["sum_3"]
+        return (s / total).astype(p._data.dtype)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._data = self._average(p)
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))

@@ -1,0 +1,3 @@
+from .export import export  # noqa: F401
+
+__all__ = ["export"]

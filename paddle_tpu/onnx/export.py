@@ -1,0 +1,37 @@
+"""ONNX export (reference: python/paddle/onnx/export.py).
+
+The reference delegates entirely to the external ``paddle2onnx`` package;
+the in-tree function is a thin dispatcher.  Same here: ONNX emission needs
+an external converter that this zero-dependency build does not ship, so
+the function raises with a pointer to the supported interchange format —
+the StableHLO artifact written by ``paddle.jit.save`` (loadable from any
+XLA frontend).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def export(layer, path: str, input_spec: Optional[Sequence] = None,
+           opset_version: int = 9, **configs):
+    """Export ``layer`` for external inference runtimes.
+
+    Mirrors the reference signature (onnx/export.py).  Requires the
+    ``onnx`` package for true ``.onnx`` output; otherwise raises with a
+    pointer to the StableHLO export path (``paddle.jit.save``), which is
+    the supported interchange format of this TPU build.
+    """
+    try:
+        import onnx  # noqa: F401
+    except ImportError:
+        raise ModuleNotFoundError(
+            "ONNX export needs the 'onnx' package, which is not available "
+            "in this build. Use paddle.jit.save(layer, path, input_spec=...) "
+            "to export a portable StableHLO artifact instead (loadable via "
+            "paddle.jit.load or any XLA-based runtime).") from None
+    # onnx available: lower through jax's ONNX-less route is not provided by
+    # jax itself; go via the saved StableHLO + onnx's converter when present.
+    raise NotImplementedError(
+        "Direct ONNX emission is not implemented; export StableHLO via "
+        "paddle.jit.save and convert externally.")

@@ -86,7 +86,7 @@ class Optimizer:
                 continue
             g = g.astype(p._data.dtype) if g.dtype != p._data.dtype else g
             if self._weight_decay is not None and self._use_coupled_wd(p):
-                g = g + jnp.asarray(self._weight_decay.coeff, g.dtype) * p._data
+                g = g + self._weight_decay.grad_term(p._data).astype(g.dtype)
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             slots = self._slots_for(p)
             new_p, new_slots = self._rule(p._data, g, slots, jnp.asarray(plr, jnp.float32),
@@ -137,7 +137,7 @@ class Optimizer:
                 return p, slots
             g = g.astype(p.dtype) if g.dtype != p.dtype else g
             if self._weight_decay is not None and self._use_coupled_wd(object()):
-                g = g + jnp.asarray(self._weight_decay.coeff, g.dtype) * p
+                g = g + self._weight_decay.grad_term(p).astype(g.dtype)
             return self._rule(p, g, slots, lr, step=step)
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)

@@ -1,6 +1,16 @@
-"""Regularizers (reference: python/paddle/regularizer.py)."""
+"""Regularizers (reference: python/paddle/regularizer.py).
+
+The reference appends a regularization op to each parameter's gradient
+(L1DecayRegularizer → coeff·sign(p), L2DecayRegularizer → coeff·p; see
+python/paddle/fluid/regularizer.py).  Here each regularizer contributes
+``grad_term(p)`` which the optimizer adds to the gradient before the update
+rule — the same coupled-decay semantics (decoupled AdamW-style decay
+bypasses this path).
+"""
 
 from __future__ import annotations
+
+import jax.numpy as jnp
 
 
 class WeightDecayRegularizer:
@@ -11,10 +21,19 @@ class WeightDecayRegularizer:
     def _regularization_coeff(self):
         return self.coeff
 
+    def grad_term(self, p):
+        raise NotImplementedError
+
 
 class L1Decay(WeightDecayRegularizer):
-    pass
+    """L1 decay: gradient contribution coeff * sign(p)."""
+
+    def grad_term(self, p):
+        return jnp.asarray(self.coeff, p.dtype) * jnp.sign(p)
 
 
 class L2Decay(WeightDecayRegularizer):
-    pass
+    """L2 decay: gradient contribution coeff * p."""
+
+    def grad_term(self, p):
+        return jnp.asarray(self.coeff, p.dtype) * p

@@ -1,0 +1,158 @@
+"""Short-time signal processing (reference: python/paddle/signal.py).
+
+frame / overlap_add / stft / istft.  TPU-native design: the reference routes
+frame and overlap_add through dedicated C++ kernels
+(operators/frame_op.cc, overlap_add_op.cc); here framing is a static gather
+(index matrix built from iota, one XLA gather per call) and overlap-add is a
+scatter-add — both shapes are static so XLA tiles them; the FFT stage rides
+the native Fft HLO via paddle_tpu.fft.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import apply
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame_raw(x, frame_length, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Attribute axis should be 0 or -1, but got {axis}")
+    n = x.shape[-1] if axis == -1 else x.shape[0]
+    if frame_length > n:
+        raise ValueError(
+            f"Attribute frame_length should be less equal than sequence length, "
+            f"but got ({frame_length}) > ({n})")
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])        # (F, L)
+    if axis == -1:
+        # (..., seq) -> (..., frame_length, num_frames)
+        out = jnp.take(x, idx, axis=-1)                # (..., F, L)
+        return jnp.swapaxes(out, -1, -2)
+    # (seq, ...) -> (num_frames, frame_length, ...)
+    return jnp.take(x, idx, axis=0)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slide a window over ``x`` producing overlapping frames."""
+    if hop_length <= 0:
+        raise ValueError(
+            f"Attribute hop_length should be greater than 0, but got {hop_length}")
+    return apply(lambda a: _frame_raw(a, frame_length, hop_length, axis), x)
+
+
+def _overlap_add_raw(x, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError(f"Attribute axis should be 0 or -1, but got {axis}")
+    if axis == -1:
+        frame_length, num_frames = x.shape[-2], x.shape[-1]
+        frames = jnp.swapaxes(x, -1, -2)               # (..., F, L)
+    else:
+        num_frames, frame_length = x.shape[0], x.shape[1]
+        frames = jnp.moveaxis(x, (0, 1), (-2, -1))     # (..., F, L)
+    seq_len = (num_frames - 1) * hop_length + frame_length
+    idx = (jnp.arange(num_frames)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :]).reshape(-1)
+    flat = frames.reshape(frames.shape[:-2] + (num_frames * frame_length,))
+    out = jnp.zeros(frames.shape[:-2] + (seq_len,), x.dtype)
+    out = out.at[..., idx].add(flat)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from overlapping frames (adjoint of ``frame``)."""
+    if hop_length <= 0:
+        raise ValueError(
+            f"Attribute hop_length should be greater than 0, but got {hop_length}")
+    return apply(lambda a: _overlap_add_raw(a, hop_length, axis), x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform.
+
+    x: (T,) or (B, T) real (or complex with onesided=False).
+    Returns (..., n_fft//2+1 if onesided else n_fft, num_frames), complex.
+    """
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if not 0 < win_length <= n_fft:
+        raise ValueError(
+            f"Expected 0 < win_length <= n_fft, but got win_length={win_length}")
+    wdata = None if window is None else getattr(window, "_data", window)
+
+    def f(a, w):
+        if a.ndim not in (1, 2):
+            raise ValueError(f"x should be a 1D or 2D tensor, but got {a.ndim}D")
+        if w is None:
+            w2 = jnp.ones((win_length,), a.real.dtype if jnp.iscomplexobj(a)
+                          else a.dtype)
+        else:
+            w2 = w
+        if win_length < n_fft:  # center-pad the window out to n_fft
+            lpad = (n_fft - win_length) // 2
+            w2 = jnp.pad(w2, (lpad, n_fft - win_length - lpad))
+        if onesided and jnp.iscomplexobj(a):
+            raise ValueError(
+                "stft with onesided=True requires real input; pass "
+                "onesided=False for complex signals")
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        fr = _frame_raw(a, n_fft, hop_length, -1)       # (..., n_fft, F)
+        fr = fr * w2[:, None]
+        if onesided:
+            spec = jnp.fft.rfft(fr, axis=-2)
+        else:
+            spec = jnp.fft.fft(fr, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return apply(f, x, wdata)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with least-squares window-envelope normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    wdata = None if window is None else getattr(window, "_data", window)
+
+    def f(spec, w):
+        if spec.ndim not in (2, 3):
+            raise ValueError(f"x should be 2D or 3D, but got {spec.ndim}D")
+        if w is None:
+            w2 = jnp.ones((win_length,), jnp.float32)
+        else:
+            w2 = w.astype(jnp.float32)
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            w2 = jnp.pad(w2, (lpad, n_fft - win_length - lpad))
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            fr = jnp.fft.irfft(spec, n=n_fft, axis=-2)   # (..., n_fft, F)
+        else:
+            fr = jnp.fft.ifft(spec, axis=-2)
+            if not return_complex:
+                fr = fr.real
+        fr = fr * w2[:, None]
+        sig = _overlap_add_raw(fr, hop_length, -1)
+        env = _overlap_add_raw(
+            jnp.broadcast_to((w2 ** 2)[:, None], (n_fft, spec.shape[-1])),
+            hop_length, -1)
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply(f, x, wdata)

@@ -1,0 +1,187 @@
+"""Sparse tensors (reference: python/paddle's sparse_coo/sparse_csr kernel
+family under paddle/phi/kernels/sparse in later snapshots).
+
+TPU-native design: COO storage is ``jax.experimental.sparse.BCOO`` — XLA's
+batched-COO format whose matmuls lower to gather/segment-sum HLO the TPU
+executes natively, instead of hand-written CUDA scatter kernels.  A thin
+``SparseCooTensor`` wrapper gives the paddle calling convention
+(indices (ndim, nnz) int64, values (nnz,)), and CSR input is converted on
+construction (the row-pointer form adds nothing on TPU where the matmul is
+a dense-indexed gather anyway).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from .core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "is_sparse", "add", "subtract", "multiply", "matmul", "masked_matmul",
+           "relu", "sin", "tanh", "sqrt", "coalesce"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor: paddle layout (indices (ndim, nnz), values (nnz, ...))."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- constructors ------------------------------------------------------
+    @staticmethod
+    def from_parts(indices, values, shape):
+        indices = jnp.asarray(getattr(indices, "_data", indices))
+        values = jnp.asarray(getattr(values, "_data", values))
+        if indices.ndim != 2:
+            raise ValueError(f"indices must be (ndim, nnz), got {indices.shape}")
+        bcoo = jsparse.BCOO((values, indices.T.astype(jnp.int32)),
+                            shape=tuple(int(s) for s in shape))
+        return SparseCooTensor(bcoo)
+
+    # -- paddle surface ----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def transpose(self, perm):
+        return SparseCooTensor(self._bcoo.transpose(tuple(perm)))
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- arithmetic --------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def _map_values(self, fn):
+        b = self._bcoo
+        return SparseCooTensor(
+            jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build a COO sparse tensor from (ndim, nnz) indices + (nnz,) values."""
+    ind = jnp.asarray(getattr(indices, "_data", indices))
+    val = jnp.asarray(getattr(values, "_data", values))
+    if dtype is not None:
+        from .core.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(ind.max(axis=1)))
+    return SparseCooTensor.from_parts(ind, val, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Build from CSR (crows (nrow+1,), cols (nnz,), values (nnz,)) — stored COO."""
+    crows = np.asarray(getattr(crows, "_data", crows))
+    cols = jnp.asarray(getattr(cols, "_data", cols))
+    values = jnp.asarray(getattr(values, "_data", values))
+    counts = np.diff(crows)
+    rows = jnp.asarray(np.repeat(np.arange(len(counts)), counts))
+    ind = jnp.stack([rows, cols])
+    return sparse_coo_tensor(ind, values, shape, dtype=dtype)
+
+
+def is_sparse(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def _binary(a, b, fn):
+    if is_sparse(a) and is_sparse(b):
+        out = fn(a._bcoo.todense(), b._bcoo.todense())
+        return SparseCooTensor(jsparse.BCOO.fromdense(out))
+    av = a._bcoo.todense() if is_sparse(a) else getattr(a, "_data", a)
+    bv = b._bcoo.todense() if is_sparse(b) else getattr(b, "_data", b)
+    return Tensor(fn(av, bv))
+
+
+def add(x, y, name=None):
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y, name=None):
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y, name=None):
+    if is_sparse(x) and not is_sparse(y) and jnp.ndim(getattr(y, "_data", y)) == 0:
+        return x._map_values(lambda v: v * jnp.asarray(getattr(y, "_data", y)))
+    return _binary(x, y, jnp.multiply)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (or dense @ sparse) → dense Tensor."""
+    if is_sparse(x):
+        yv = y._bcoo.todense() if is_sparse(y) else getattr(y, "_data", y)
+        return Tensor(x._bcoo @ jnp.asarray(yv))
+    if is_sparse(y):
+        return Tensor(jnp.asarray(getattr(x, "_data", x)) @ y._bcoo)
+    return Tensor(jnp.matmul(getattr(x, "_data", x), getattr(y, "_data", y)))
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at ``mask``'s sparsity pattern (SDDMM)."""
+    xv = jnp.asarray(getattr(x, "_data", x))
+    yv = jnp.asarray(getattr(y, "_data", y))
+    idx = mask._bcoo.indices                       # (nnz, 2)
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.sum(xv[rows, :] * yv[:, cols].T, axis=-1)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def _unary(name, fn):
+    def wrapper(x, name=None):
+        if is_sparse(x):
+            return x._map_values(fn)
+        return Tensor(fn(getattr(x, "_data", x)))
+    wrapper.__name__ = name
+    return wrapper
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()

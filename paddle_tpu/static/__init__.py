@@ -1,0 +1,355 @@
+"""Static-graph compatibility facade (reference: python/paddle/static/__init__.py).
+
+SURVEY §7 design: the reference's Program/Executor/ParallelExecutor stack
+collapses into jax tracing + XLA — a "static program" here IS a traced
+``StaticFunction`` (jit/__init__.py), and the Executor runs it.  This module
+keeps the reference's calling convention alive for code written against
+``paddle.static``:
+
+- ``InputSpec`` / ``data``          → symbolic input declarations (jit.InputSpec)
+- ``Program`` / ``program_guard``   → lightweight namespaces (random seed,
+  collected parameters); graph capture happens at trace time, not op-record time
+- ``Executor.run``                  → jit-compile + execute a traced callable
+- ``save/load_inference_model``     → StableHLO export round-trip via jit.save/load
+- ``ExponentialMovingAverage``      → real EMA with apply/restore context
+- ``accuracy``/``auc``              → metric wrappers
+
+Entry points that only make sense for a mutable op-by-op graph IR
+(``append_backward``, ``py_func``) raise with a pointer to the dynamic API.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..jit import InputSpec, StaticFunction, to_static
+from ..jit import load as _jit_load
+from ..jit import save as _jit_save
+
+__all__ = [
+    "InputSpec", "data", "Program", "program_guard", "default_main_program",
+    "default_startup_program", "Executor", "global_scope", "scope_guard",
+    "name_scope", "device_guard", "cpu_places", "cuda_places",
+    "save", "load", "save_inference_model", "load_inference_model",
+    "ExponentialMovingAverage", "accuracy", "auc", "create_global_var",
+    "create_parameter", "WeightNormParamAttr", "gradients", "append_backward",
+    "BuildStrategy", "ExecutionStrategy", "CompiledProgram", "ParallelExecutor",
+    "py_func", "Print", "nn",
+]
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Declare a graph input (reference static/input.py data())."""
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+class Program:
+    """Placeholder program object: seed + parameter scope (compat surface).
+
+    The actual computation graph is captured by tracing (to_static); this
+    object carries the attributes user code reads/writes on
+    ``default_main_program()``.
+    """
+
+    def __init__(self):
+        self.random_seed = 0
+        self._params: Dict[str, Tensor] = {}
+
+    def global_block(self):
+        return self
+
+    def parameters(self):
+        return list(self._params.values())
+
+    def clone(self, for_test: bool = False):
+        return self
+
+
+_main_program = Program()
+_startup_program = Program()
+_program_stack: List[Program] = []
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1] if _program_stack else _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    _program_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _program_stack.pop()
+
+
+class _Scope:
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+
+
+def global_scope() -> _Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+def cpu_places(device_count: Optional[int] = None):
+    from ..core.device import Place, local_devices
+    n = device_count or len(local_devices("cpu"))
+    return [Place(f"cpu:{i}") for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    raise RuntimeError("No CUDA places in a TPU build; use tpu devices "
+                      "(paddle.device.local_devices())")
+
+
+class Executor:
+    """Compile-and-run front door (reference static/Executor → here jit).
+
+    ``run(program_or_fn, feed=..., fetch_list=...)``: when given a
+    StaticFunction/callable it jit-executes it on the feed values; Program
+    objects (the compat placeholders) just return the fetches from feed.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        feed = feed or {}
+        if callable(program) or isinstance(program, StaticFunction):
+            vals = [v for v in feed.values()]
+            args = [jnp.asarray(getattr(v, "_data", v)) for v in vals]
+            out = program(*args)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return [np.asarray(getattr(o, "_data", o)) for o in outs]
+        # Program placeholder: nothing to execute (tracing captured the graph)
+        if fetch_list:
+            return [np.asarray(getattr(f, "_data", f)) for f in fetch_list]
+        return []
+
+
+def save(program, model_path: str, protocol=4):
+    from ..framework import io as _io
+    _io.save({n: p for n, p in getattr(program, "_params", {}).items()},
+             model_path if model_path.endswith(".pdparams")
+             else model_path + ".pdparams")
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    from ..framework import io as _io
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    return _io.load(path)
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Export a traced layer/function (StableHLO) for inference serving."""
+    layer = kwargs.get("program") or fetch_vars
+    specs = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    return _jit_save(layer, path_prefix, input_spec=list(specs))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    layer = _jit_load(path_prefix)
+    return layer
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy of softmax ``input`` vs int ``label`` (static.accuracy)."""
+    x = getattr(input, "_data", input)
+    y = getattr(label, "_data", label).reshape(-1)
+    topk = jnp.argsort(-x, axis=-1)[..., :k]
+    hit = jnp.any(topk == y[:, None], axis=-1)
+    return Tensor(jnp.mean(hit.astype(jnp.float32)))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, **kwargs):
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(getattr(input, "_data", input)),
+             np.asarray(getattr(label, "_data", label)))
+    return Tensor(jnp.asarray(m.accumulate(), jnp.float32))
+
+
+def create_global_var(shape, value, dtype, persistable=False, force_cpu=False,
+                      name=None):
+    from ..core.dtype import convert_dtype
+    return Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..core.tensor import Parameter
+    from ..nn.initializer import Constant, XavierNormal
+    init = default_initializer or (Constant(0.0) if is_bias else XavierNormal())
+    data = init(tuple(shape), dtype)
+    p = Parameter(data, trainable=True)
+    if name:
+        default_main_program()._params[name] = p
+    return p
+
+
+class WeightNormParamAttr:
+    def __init__(self, dim=None, **kwargs):
+        self.dim = dim
+        self.kwargs = kwargs
+
+
+class ExponentialMovingAverage:
+    """EMA of parameter values with apply/restore (static/ExponentialMovingAverage).
+
+    ``update()`` after each optimizer step; ``apply()`` context swaps EMA
+    values in for evaluation and restores on exit.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._ema: Dict[int, Any] = {}
+        self._backup: Dict[int, Any] = {}
+        self._params: List[Any] = []
+        self._step = 0
+
+    def _track(self, params):
+        for p in params:
+            if id(p) not in self._ema:
+                self._params.append(p)
+                self._ema[id(p)] = jnp.array(p._data)
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            self._track(parameters)
+        self._step += 1
+        # bias-corrected decay ramp, matching the reference's thres_steps form
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1.0 - d) * p._data
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for p in self._params:
+            self._backup[id(p)] = p._data
+            p._data = self._ema[id(p)]
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup.pop(id(p))
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Eager-compatible gradients (reference static.gradients)."""
+    from .. import grad as _grad
+    return _grad(targets, inputs, grad_outputs=target_gradients,
+                 allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None, callbacks=None):
+    raise RuntimeError(
+        "append_backward operates on a mutable op-graph IR; in paddle_tpu the "
+        "backward pass is derived by jax.grad at trace time — use "
+        "paddle.grad / loss.backward() or the jit train-step builders.")
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise RuntimeError(
+        "py_func embeds host callbacks in the static graph; use an eager "
+        "PyLayer (paddle.autograd.PyLayer) or jax.pure_callback instead.")
+
+
+def Print(input, **kwargs):
+    print(np.asarray(getattr(input, "_data", input)))
+    return input
+
+
+class BuildStrategy:
+    """Accepted-and-ignored knobs (XLA owns fusion/placement decisions)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_optimizer_ops = True
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+
+    def with_data_parallel(self, *a, **k):
+        return self
+
+
+ParallelExecutor = Executor
+
+
+# ``paddle.static.nn`` namespace: common layers aliased to the dynamic ops
+class _StaticNN:
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
+        import paddle_tpu.nn.functional as F
+        xv = getattr(x, "_data", x)
+        flat = xv.reshape(xv.shape[:num_flatten_dims] + (-1,))
+        w = create_parameter([flat.shape[-1], size], str(flat.dtype))
+        b = create_parameter([size], str(flat.dtype), is_bias=True)
+        out = Tensor(flat) @ w + b
+        if activation == "relu":
+            out = F.relu(out)
+        elif activation == "softmax":
+            out = F.softmax(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, **kwargs):
+        from ..nn import BatchNorm1D, BatchNorm2D
+        xv = getattr(x, "_data", x)
+        bn = (BatchNorm2D if xv.ndim == 4 else BatchNorm1D)(xv.shape[1])
+        return bn(x if isinstance(x, Tensor) else Tensor(xv))
+
+
+nn = _StaticNN()

@@ -1,0 +1,16 @@
+"""Text domain API (reference: python/paddle/text/__init__.py).
+
+Datasets + Viterbi CRF decoding.  The reference datasets auto-download from
+paddle's dataset mirror; this build runs zero-egress, so every dataset takes
+an explicit local ``data_file``/``data_dir`` and parses the same archive
+format the reference downloads (see each class).  ``viterbi_decode`` is a
+lax.scan forward/backtrace pair — static shapes, jit-safe, TPU-resident —
+replacing the reference's ViterbiDecodeOp C++ kernel (viterbi_decode_op.h).
+"""
+
+from .datasets import (Conll05st, Imdb, Imikolov, Movielens,  # noqa: F401
+                       UCIHousing, WMT14, WMT16)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16", "ViterbiDecoder", "viterbi_decode"]

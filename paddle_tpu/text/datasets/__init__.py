@@ -1,0 +1,302 @@
+"""Text datasets (reference: python/paddle/text/datasets/*).
+
+Each class parses the same archive format the reference downloads
+(imdb.py, imikolov.py, uci_housing.py, ...) but from an explicit local
+path — this build is zero-egress, so there is no download helper; pass
+``data_file=`` (the archive or extracted file the reference's downloader
+would have fetched).  All classes are map-style ``io.Dataset``s compatible
+with DataLoader.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+from typing import List, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Conll05st", "Movielens",
+           "WMT14", "WMT16"]
+
+
+def _require(data_file, cls):
+    if data_file is None or not os.path.exists(data_file):
+        raise ValueError(
+            f"{cls} requires a local data_file (zero-egress build has no "
+            f"downloader). Supply the same archive the reference downloads; "
+            f"got data_file={data_file!r}")
+    return data_file
+
+
+def _tokenize(line: str) -> List[str]:
+    return re.sub(r"[^a-z0-9\s]", "", line.lower()).split()
+
+
+class Imdb(Dataset):
+    """IMDB movie-review sentiment (reference text/datasets/imdb.py).
+
+    Parses the aclImdb tar (train/{pos,neg}/*.txt) into (word-id sequence,
+    label) pairs with a frequency-cutoff vocabulary, like the reference's
+    build_dict + tokenize pipeline.
+    """
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        _require(data_file, "Imdb")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        # vocabulary spans BOTH splits (reference imdb.py build_dict runs on
+        # train+test) so train/test word ids agree
+        vocab_pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        freq = {}
+        with tarfile.open(data_file) as tf:
+            members = [m for m in tf.getmembers() if vocab_pat.match(m.name)]
+            members.sort(key=lambda m: m.name)
+            for m in members:
+                text = tf.extractfile(m).read().decode("utf-8", "ignore")
+                toks = _tokenize(text)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+                if pat.match(m.name):
+                    docs.append(toks)
+                    labels.append(0 if "/pos/" in m.name else 1)
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.array([self.word_idx.get(t, unk) for t in d], np.int64)
+                     for d in docs]
+        self.labels = np.array(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-format n-gram language-model dataset (reference imikolov.py).
+
+    data_type="NGRAM" yields (w0..w{N-2}, w{N-1}) windows; "SEQ" yields
+    (input sequence, shifted target sequence) pairs.
+    """
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        _require(data_file, "Imikolov")
+        name = {"train": "ptb.train.txt", "valid": "ptb.valid.txt",
+                "test": "ptb.test.txt"}[mode]
+        lines = self._read(data_file, name)
+        train_lines = lines if mode == "train" else \
+            self._read(data_file, "ptb.train.txt")
+        freq = {}
+        for ln in train_lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                 if c >= min_word_freq and w != "<unk>"]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx.setdefault("<s>", len(self.word_idx))
+        self.word_idx.setdefault("<e>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        for ln in lines:
+            ids = ([self.word_idx["<s>"]]
+                   + [self.word_idx.get(w, unk) for w in ln.split()]
+                   + [self.word_idx["<e>"]])
+            if data_type.upper() == "NGRAM":
+                if len(ids) >= window_size:
+                    for i in range(window_size, len(ids) + 1):
+                        self.data.append(
+                            np.array(ids[i - window_size:i], np.int64))
+            else:
+                self.data.append((np.array(ids[:-1], np.int64),
+                                  np.array(ids[1:], np.int64)))
+
+    @staticmethod
+    def _read(data_file, name):
+        if tarfile.is_tarfile(data_file):
+            with tarfile.open(data_file) as tf:
+                member = next(m for m in tf.getmembers()
+                              if m.name.endswith(name))
+                return tf.extractfile(member).read().decode().splitlines()
+        return open(data_file).read().splitlines()
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression table (reference uci_housing.py):
+    13 normalized features → price."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train"):
+        _require(data_file, "UCIHousing")
+        opener = gzip.open if data_file.endswith(".gz") else open
+        with opener(data_file, "rt") as f:
+            rows = [[float(v) for v in ln.split()] for ln in f
+                    if ln.strip()]
+        data = np.array(rows, np.float32)
+        if data.shape[1] != self.FEATURE_NUM:
+            raise ValueError(f"expected {self.FEATURE_NUM} columns, "
+                             f"got {data.shape[1]}")
+        feats = data[:, :-1]
+        maxs, mins, avgs = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avgs) / np.maximum(maxs - mins, 1e-6)
+        split = int(data.shape[0] * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], data[:split, -1:]
+        else:
+            self.x, self.y = feats[split:], data[split:, -1:]
+
+    def __getitem__(self, idx):
+        return self.x[idx], self.y[idx]
+
+    def __len__(self):
+        return len(self.x)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M rating triples (reference movielens.py): parses
+    ratings.dat (`user::movie::rating::ts`) from the ml-1m zip/dir."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        _require(data_file, "Movielens")
+        lines = self._read(data_file, "ratings.dat")
+        triples = []
+        for ln in lines:
+            parts = ln.strip().split("::")
+            if len(parts) >= 3:
+                triples.append((int(parts[0]), int(parts[1]), float(parts[2])))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(triples)) < test_ratio
+        keep = mask if mode == "test" else ~mask
+        self.data = [t for t, k in zip(triples, keep) if k]
+
+    @staticmethod
+    def _read(data_file, name):
+        if os.path.isdir(data_file):
+            return open(os.path.join(data_file, name),
+                        encoding="latin1").read().splitlines()
+        import zipfile
+        if zipfile.is_zipfile(data_file):
+            with zipfile.ZipFile(data_file) as zf:
+                member = next(n for n in zf.namelist() if n.endswith(name))
+                return zf.read(member).decode("latin1").splitlines()
+        return open(data_file, encoding="latin1").read().splitlines()
+
+    def __getitem__(self, idx):
+        u, m, r = self.data[idx]
+        return (np.int64(u), np.int64(m), np.float32(r))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared machinery for WMT14/WMT16: tab- or ``|||``-separated parallel
+    lines → (src ids, trg ids, trg_next ids) with per-side vocabularies."""
+
+    def __init__(self, data_file, mode, src_dict_size, trg_dict_size, cls):
+        _require(data_file, cls)
+        pairs = []
+        opener = gzip.open if str(data_file).endswith(".gz") else open
+        with opener(data_file, "rt", encoding="utf-8", errors="ignore") as f:
+            for ln in f:
+                if "\t" in ln:
+                    s, t = ln.rstrip("\n").split("\t")[:2]
+                elif "|||" in ln:
+                    s, t = ln.rstrip("\n").split("|||")[:2]
+                else:
+                    continue
+                pairs.append((s.split(), t.split()))
+        self.src_dict = self._build_dict([p[0] for p in pairs], src_dict_size)
+        self.trg_dict = self._build_dict([p[1] for p in pairs], trg_dict_size)
+        s_unk, t_unk = self.src_dict["<unk>"], self.trg_dict["<unk>"]
+        st, en = self.trg_dict["<s>"], self.trg_dict["<e>"]
+        self.data = []
+        for s, t in pairs:
+            sid = np.array([self.src_dict.get(w, s_unk) for w in s], np.int64)
+            tid = [self.trg_dict.get(w, t_unk) for w in t]
+            self.data.append((sid, np.array([st] + tid, np.int64),
+                              np.array(tid + [en], np.int64)))
+
+    @staticmethod
+    def _build_dict(corpus, size):
+        freq = {}
+        for words in corpus:
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+        vocab = ["<s>", "<e>", "<unk>"] + \
+            [w for w, _ in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))]
+        vocab = vocab[:size]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_ParallelCorpus):
+    """WMT14 en-fr translation pairs (reference wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000):
+        super().__init__(data_file, mode, dict_size, dict_size, "WMT14")
+
+
+class WMT16(_ParallelCorpus):
+    """WMT16 en-de translation pairs (reference wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en"):
+        super().__init__(data_file, mode, src_dict_size, trg_dict_size, "WMT16")
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL dataset (reference conll05.py): parses the
+    column-format props/words files from a local directory or tar."""
+
+    def __init__(self, data_file=None, mode="train"):
+        _require(data_file, "Conll05st")
+        lines = Imikolov._read(data_file, "words.txt") \
+            if not os.path.isdir(data_file) else \
+            open(os.path.join(data_file, "words.txt")).read().splitlines()
+        sents, cur = [], []
+        for ln in lines:
+            if ln.strip():
+                cur.append(ln.split()[0])
+            elif cur:
+                sents.append(cur)
+                cur = []
+        if cur:
+            sents.append(cur)
+        freq = {}
+        for s in sents:
+            for w in s:
+                freq[w] = freq.get(w, 0) + 1
+        self.word_dict = {w: i for i, w in enumerate(
+            sorted(freq, key=lambda w: (-freq[w], w)))}
+        self.data = [np.array([self.word_dict[w] for w in s], np.int64)
+                     for s in sents]
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

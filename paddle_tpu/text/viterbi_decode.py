@@ -1,0 +1,108 @@
+"""Viterbi CRF decoding (reference: python/paddle/text/viterbi_decode.py:23,
+C++ kernel operators/viterbi_decode_op.h).
+
+TPU-native: the forward max-product recursion and the backtrace are both
+``lax.scan``s over the time axis with static shapes — no dynamic control
+flow, so the whole decode jit-compiles and stays device-resident.  Ragged
+``lengths`` are handled by masking: steps beyond a sequence's length carry
+state through unchanged, and the stop-tag transition is injected at each
+sequence's own final position via a one-hot mask.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply
+from ..nn import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_raw(pot, trans, lengths, include_bos_eos_tag):
+    B, L, N = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    pot = pot.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+
+    if include_bos_eos_tag:
+        # start tag = last row; stop tag = second-to-last column
+        # (reference semantics: viterbi_decode.py:60 docstring)
+        init = pot[:, 0, :] + trans[-1, :][None, :]
+        stop_at_end = (jnp.arange(L)[None, :] == (lengths - 1)[:, None])
+        pot = pot + stop_at_end[:, :, None] * trans[:, -2][None, None, :]
+        init = jnp.where((lengths == 1)[:, None],
+                         pot[:, 0, :] + trans[-1, :][None, :], init)
+    else:
+        init = pot[:, 0, :]
+
+    def fwd(carry, xs):
+        alpha = carry                     # (B, N)
+        pot_t, t = xs
+        scores = alpha[:, :, None] + trans[None, :, :]    # (B, from, to)
+        best = jnp.max(scores, axis=1) + pot_t            # (B, N)
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        active = (t < lengths)[:, None]
+        return jnp.where(active, best, alpha), bp
+
+    ts = jnp.arange(1, L)
+    alpha, bps = lax.scan(fwd, init, (jnp.swapaxes(pot[:, 1:, :], 0, 1), ts))
+    # bps: (L-1, B, N)
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # (B,)
+
+    def back(carry, xs):
+        tag = carry                      # (B,)
+        bp_t, t = xs                     # bp_t: (B, N); t = time of bp step
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        # only steps strictly inside the sequence update the running tag
+        inside = t < lengths
+        new_tag = jnp.where(inside, prev, tag)
+        return new_tag, new_tag
+
+    rev_ts = ts[::-1]
+    _, rev_tags = lax.scan(back, last_tag, (bps[::-1], rev_ts))
+    # rev_tags[k] is the tag at position rev_ts[k]-1; assemble full path
+    tags_01 = jnp.concatenate([rev_tags[::-1].T, last_tag[:, None]], axis=1)
+    # position t's tag: for t == length-1 it's last_tag only if length == L;
+    # in general position t carries the tag chosen when scanning — mask below.
+    pos = jnp.arange(L)[None, :]
+    path = jnp.where(pos < lengths[:, None], tags_01, 0)
+    return scores, path.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence per batch row.
+
+    potentials: (B, L, N) emissions; transition_params: (N, N);
+    lengths: (B,) int.  Returns (scores (B,), paths (B, max_len)).
+    """
+    out = apply(
+        lambda p, t, ln: _viterbi_raw(p, t, ln, include_bos_eos_tag),
+        potentials, transition_params, lengths)
+    scores, path = out
+    # eager parity with the reference: trim the path to the batch's max length
+    pdata = path._data if isinstance(path, Tensor) else path
+    if not isinstance(pdata, jax.core.Tracer):
+        ln = getattr(lengths, "_data", lengths)
+        if not isinstance(ln, jax.core.Tracer):
+            maxlen = int(jnp.max(ln))
+            path = Tensor(pdata[:, :maxlen])
+    return scores, path
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference viterbi_decode.py:87)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
