@@ -1,6 +1,6 @@
 """``paddle_tpu.distributed`` (reference: python/paddle/distributed/)."""
 
-from . import env, fleet, utils  # noqa: F401
+from . import checkpoint, env, fleet, utils  # noqa: F401
 from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa: F401
                          all_reduce, alltoall, alltoall_single, barrier, broadcast,
                          broadcast_object_list, destroy_process_group, get_group,

@@ -2,23 +2,40 @@
 
 Reference: python/paddle/distributed/utils.py:57 ``global_scatter`` / :179
 ``global_gather`` — ragged token exchange driven by per-expert counts
-(grouped ncclSend/Recv, operators/collective/global_scatter_op.cu.cc).
+(grouped ncclSend/Recv loops, operators/collective/global_scatter_op.cu.cc).
 
-TPU-native: XLA collectives are static-shape, so the exchange is expressed as
-a **uniform-capacity all_to_all** over the expert mesh axis.  Tokens are laid
-out as ``(world * n_expert * capacity, H)`` with per-slot validity carried in
-the dispatch mask (see ops/moe.topk_gating) instead of ragged counts.  These
-functions must run inside shard_map over the expert axis; for the
+TPU-native design, two tiers:
+
+1. **No counts** (the annotation-friendly path): a uniform-capacity
+   ``all_to_all`` moving fixed ``(world * n_expert * capacity, H)`` blocks,
+   with validity carried in the dispatch mask (ops/moe.topk_gating).
+
+2. **Counts given** (reference-faithful ragged semantics): XLA collectives
+   are static-shape, so the ragged exchange is expressed as *pad → all_to_all
+   → sort-compact*: rows are gathered into per-destination-rank blocks of a
+   static worst-case size, exchanged with one ``all_to_all``, then an
+   ``argsort`` on (expert, source-rank, index) keys compacts the valid rows
+   to the front in exactly the reference's expert-major receive order.  All
+   index math is traced (counts may be jit-time values); only the block size
+   (≤ the static local token count) is static.  Overall cost is one
+   all_to_all plus O(T log T) device-side sorting — no host sync, no ragged
+   sends.
+
+Both tiers must run inside shard_map over the expert axis; for the
 annotation-based path (GSPMD inserts the exchange automatically) use
 ``paddle_tpu.ops.moe.moe_ffn``.
 """
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-__all__ = ["global_scatter", "global_gather"]
+__all__ = ["global_scatter", "global_gather",
+           "ragged_global_scatter", "ragged_global_gather"]
 
 
 def _resolve_axis(group):
@@ -27,42 +44,188 @@ def _resolve_axis(group):
     return getattr(group, "axis_name", group)
 
 
-def global_scatter(x, local_count=None, global_count=None, group=None,
-                   use_calc_stream=True):
-    """Send each rank's per-destination token blocks to their experts.
+# --------------------------------------------------------------------------
+# tier 1: uniform capacity blocks
+# --------------------------------------------------------------------------
 
-    ``x``: local ``(world * n_expert * capacity, H)`` — row block ``w`` holds
-    the tokens this rank routes to rank ``w``'s experts (capacity-padded).
-    Returns ``(world * n_expert * capacity, H)``: the tokens this rank's
-    experts received from every rank.  ``local_count``/``global_count`` are
-    accepted for API parity; when given as concrete values they must be
-    uniform (the static-shape exchange always moves full capacity blocks) —
-    ragged counts raise.  Traced counts cannot be checked and are ignored.
-    """
-    axis = _resolve_axis(group)
+def _uniform_exchange(x, axis):
     world = lax.psum(1, axis)
     rows, H = x.shape
-    for name, counts in (("local_count", local_count),
-                         ("global_count", global_count)):
-        if counts is None:
-            continue
-        try:
-            cvals = np.unique(np.asarray(counts))
-        except Exception:  # traced inside jit — cannot validate
-            continue
-        if cvals.size > 1:
-            raise ValueError(
-                f"TPU global_scatter moves uniform capacity blocks; ragged "
-                f"{name}={cvals.tolist()} is not supported — pad each "
-                f"expert's tokens to a fixed capacity (see ops/moe.py)")
     if rows % world != 0:
         raise ValueError(f"global_scatter rows ({rows}) must be a multiple of "
                          f"the '{axis}' axis size ({world})")
     return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
 
 
+# --------------------------------------------------------------------------
+# tier 2: ragged counts (reference global_scatter semantics)
+# --------------------------------------------------------------------------
+
+def _rank_blocks_from_ragged(x, rank_count, rank_offset, W, B):
+    """(T, H) ragged-grouped rows → (W, B, H) per-destination blocks."""
+    T, H = x.shape
+    j = jnp.arange(B)[None, :]                       # (1, B)
+    src = rank_offset[:, None] + j                   # (W, B)
+    valid = j < rank_count[:, None]
+    src = jnp.clip(src, 0, T - 1)
+    blocks = x[src.reshape(-1)].reshape(W, B, H)
+    return jnp.where(valid[:, :, None], blocks, 0), valid
+
+
+def _ragged_from_rank_blocks(blocks, rank_count, rank_offset, T):
+    """(W, B, H) blocks → (T, H) ragged-grouped rows (inverse of above)."""
+    W, B, H = blocks.shape
+    r = jnp.arange(T)                                # (T,)
+    cum_incl = jnp.cumsum(rank_count)                # (W,)
+    w = jnp.sum(r[:, None] >= cum_incl[None, :], axis=1)      # (T,)
+    j = r - rank_offset[w]
+    flat = blocks.reshape(W * B, H)
+    idx = jnp.clip(w * B + j, 0, W * B - 1)
+    return flat[idx]
+
+
+def ragged_global_scatter(x, local_count, group=None, block: Optional[int] = None
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Reference-semantics ragged scatter under static shapes.
+
+    ``x``: (T, H) local tokens grouped by destination expert — rows
+    [offsets[d], offsets[d] + local_count[d]) go to global expert ``d``
+    (destination rank ``d // El``, its local expert ``d % El``), where
+    offsets = exclusive-cumsum(local_count) and El = n_experts per rank.
+
+    Returns ``(out, recv_counts, perm)``:
+    - ``out`` (W*B, H): received tokens compacted to the front in the
+      reference's receive order — grouped by (local expert, source rank),
+      expert-major; rows past ``recv_counts.sum()`` are zero padding.
+    - ``recv_counts`` (W, El): tokens received from each source rank for
+      each local expert (the reference's ``global_count``).
+    - ``perm``: opaque permutation to pass to :func:`ragged_global_gather`.
+    """
+    axis = _resolve_axis(group)
+    W = lax.psum(1, axis)
+    T, H = x.shape
+    El = jnp.shape(local_count)[0] // W
+    if block is not None and block < T:
+        # a too-small block silently drops tokens in the masked gather; only
+        # concrete counts can prove safety, so traced counts require the
+        # always-safe default (block = T, the worst case: all rows to one rank)
+        try:
+            rank_max = int(np.max(np.asarray(local_count)
+                                  .reshape(W, El).sum(axis=1)))
+        except Exception:
+            raise ValueError(
+                f"block={block} < local rows ({T}) cannot be verified against "
+                f"traced counts; omit block (worst-case T is always safe)")
+        if rank_max > block:
+            raise ValueError(
+                f"block={block} smaller than the largest per-rank send "
+                f"({rank_max}) — tokens would be dropped")
+    local_count = jnp.asarray(local_count, jnp.int32)
+    B = T if block is None else block
+
+    lc = local_count.reshape(W, El)
+    rank_count = jnp.sum(lc, axis=1)                          # (W,)
+    rank_offset = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(rank_count)[:-1]])
+    send, _ = _rank_blocks_from_ragged(x, rank_count, rank_offset, W, B)
+
+    # counts exchange: recv_counts[w, el] = tokens source rank w sent for my
+    # local expert el
+    recv_counts = lax.all_to_all(lc, axis, split_axis=0, concat_axis=0,
+                                 tiled=True).reshape(W, El)
+    recv = lax.all_to_all(send, axis, split_axis=0, concat_axis=0)
+    recv = recv.reshape(W, B, H)
+
+    # compact: row j of source-rank block w belongs to local expert
+    # el = #(inclusive-cumsum entries <= j); order key (el, w, i_within)
+    cum_incl = jnp.cumsum(recv_counts, axis=1)                # (W, El)
+    cum_excl = cum_incl - recv_counts
+    j = jnp.arange(B)[None, :]
+    el = jnp.sum(j[:, :, None] >= cum_incl[:, None, :], axis=2)  # (W, B)
+    el = jnp.minimum(el, El - 1)
+    i_within = j - jnp.take_along_axis(cum_excl, el, axis=1)
+    valid = j < jnp.sum(recv_counts, axis=1)[:, None]
+    WB = W * B
+    big = jnp.asarray(WB * (El + 1), jnp.int32)
+    key = jnp.where(
+        valid,
+        el * WB + jnp.arange(W)[:, None] * B + i_within,
+        big + jnp.arange(B)[None, :] + jnp.arange(W)[:, None] * B)
+    perm = jnp.argsort(key.reshape(-1))
+    out = recv.reshape(WB, H)[perm]
+    return out, recv_counts, perm
+
+
+def ragged_global_gather(y, local_count, perm, rows: int, group=None):
+    """Inverse of :func:`ragged_global_scatter`: route expert outputs back to
+    the ranks/rows that sent the tokens.
+
+    ``y`` (W*B, H) must be in the compacted receive order produced by the
+    matching scatter; ``rows`` is the scatter input's static row count
+    (``x.shape[0]``).  Returns (rows, H) in the original ragged layout.
+    """
+    axis = _resolve_axis(group)
+    W = lax.psum(1, axis)
+    local_count = jnp.asarray(local_count, jnp.int32)
+    El = local_count.shape[0] // W
+    WB, H = y.shape
+    B = WB // W
+
+    inv_perm = jnp.argsort(perm)
+    blocks = y[inv_perm].reshape(W, B, H)
+    back = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0)
+    back = back.reshape(W, B, H)
+
+    lc = local_count.reshape(W, El)
+    rank_count = jnp.sum(lc, axis=1)
+    rank_offset = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                   jnp.cumsum(rank_count)[:-1]])
+    return _ragged_from_rank_blocks(back, rank_count, rank_offset, int(rows))
+
+
+# --------------------------------------------------------------------------
+# public API (reference signatures)
+# --------------------------------------------------------------------------
+
+def _counts_uniform_or_none(counts):
+    """True if counts are absent or provably uniform; None if traced (cannot
+    tell)."""
+    if counts is None:
+        return True
+    try:
+        cvals = np.unique(np.asarray(counts))
+    except Exception:  # traced inside jit — cannot validate
+        return None
+    return cvals.size <= 1
+
+
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   use_calc_stream=True):
+    """Send each rank's token blocks to their experts.
+
+    Back-compat contract (unchanged from round 1): no counts, or provably
+    *uniform* counts, run the tier-1 capacity-block all_to_all on ``x``'s
+    layout as-is.  *Ragged* counts raise with a pointer to the
+    :func:`ragged_global_scatter`/:func:`ragged_global_gather` pair — the
+    ragged exchange returns extra metadata (receive counts + permutation)
+    that this reference-shaped signature cannot carry, and silently
+    reordering the output here would corrupt callers written against the
+    block layout.
+    """
+    axis = _resolve_axis(group)
+    for name, counts in (("local_count", local_count),
+                         ("global_count", global_count)):
+        if _counts_uniform_or_none(counts) is False:
+            raise ValueError(
+                f"ragged {name} passed to global_scatter/global_gather; use "
+                f"the ragged_global_scatter/ragged_global_gather pair, which "
+                f"returns the receive counts and permutation the gather-back "
+                f"needs")
+    return _uniform_exchange(x, axis)
+
+
 def global_gather(x, local_count=None, global_count=None, group=None,
                   use_calc_stream=True):
-    """Inverse of :func:`global_scatter` — return expert outputs to the ranks
-    that sent the tokens."""
+    """Inverse of :func:`global_scatter` (uniform tier); for the ragged tier
+    use :func:`ragged_global_gather` with the saved counts + permutation."""
     return global_scatter(x, local_count, global_count, group, use_calc_stream)

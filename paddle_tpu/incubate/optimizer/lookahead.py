@@ -98,16 +98,16 @@ class LookAhead(Optimizer):
         k_count = state["k_count"] + 1
         sync = (k_count % self.k) == 0
 
-        def merge(slow, fast):
-            merged = slow + self.alpha * (fast - slow)
-            return (jnp.where(sync, merged, slow),
-                    jnp.where(sync, merged.astype(fast.dtype), fast))
-
-        pairs = jax.tree_util.tree_map(merge, state["slow"], new_params)
-        new_slow = jax.tree_util.tree_map(lambda pr: pr[0], pairs,
-                                          is_leaf=lambda x: isinstance(x, tuple))
-        out_params = jax.tree_util.tree_map(lambda pr: pr[1], pairs,
-                                            is_leaf=lambda x: isinstance(x, tuple))
+        # two passes instead of one returning (slow, fast) pairs: a pair-typed
+        # tree_map result cannot be split again when the params pytree itself
+        # contains tuples (XLA CSEs the duplicated merge arithmetic anyway)
+        new_slow = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(sync, s + self.alpha * (f - s), s),
+            state["slow"], new_params)
+        out_params = jax.tree_util.tree_map(
+            lambda s, f: jnp.where(
+                sync, (s + self.alpha * (f - s)).astype(f.dtype), f),
+            state["slow"], new_params)
         return out_params, {"inner": inner_state, "slow": new_slow,
                             "k_count": k_count}
 

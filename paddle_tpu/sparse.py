@@ -125,24 +125,42 @@ def is_sparse(x):
     return isinstance(x, SparseCooTensor)
 
 
+def _sparse_linear_combine(a, b, beta):
+    """a + beta*b for two COO operands without densifying: concatenate the
+    index/value lists and merge duplicates with a static nse bound (jit-safe;
+    memory stays O(nnz_a + nnz_b))."""
+    ab, bb = a._bcoo, b._bcoo
+    if ab.shape != bb.shape:
+        raise ValueError(f"shape mismatch {ab.shape} vs {bb.shape}")
+    vals = jnp.concatenate([ab.data, beta * bb.data.astype(ab.data.dtype)])
+    idx = jnp.concatenate([ab.indices, bb.indices], axis=0)
+    merged = jsparse.BCOO((vals, idx), shape=ab.shape)
+    return SparseCooTensor(merged.sum_duplicates(nse=ab.nse + bb.nse))
+
+
 def _binary(a, b, fn):
-    if is_sparse(a) and is_sparse(b):
-        out = fn(a._bcoo.todense(), b._bcoo.todense())
-        return SparseCooTensor(jsparse.BCOO.fromdense(out))
+    # mixed sparse/dense: result is dense (reference convention)
     av = a._bcoo.todense() if is_sparse(a) else getattr(a, "_data", a)
     bv = b._bcoo.todense() if is_sparse(b) else getattr(b, "_data", b)
     return Tensor(fn(av, bv))
 
 
 def add(x, y, name=None):
+    if is_sparse(x) and is_sparse(y):
+        return _sparse_linear_combine(x, y, 1.0)
     return _binary(x, y, jnp.add)
 
 
 def subtract(x, y, name=None):
+    if is_sparse(x) and is_sparse(y):
+        return _sparse_linear_combine(x, y, -1.0)
     return _binary(x, y, jnp.subtract)
 
 
 def multiply(x, y, name=None):
+    """Elementwise multiply.  sparse × scalar stays sparse (value map);
+    sparse × sparse / sparse × dense densify — the intersection pattern of
+    two COO operands is data-dependent, which static shapes can't carry."""
     if is_sparse(x) and not is_sparse(y) and jnp.ndim(getattr(y, "_data", y)) == 0:
         return x._map_values(lambda v: v * jnp.asarray(getattr(y, "_data", y)))
     return _binary(x, y, jnp.multiply)

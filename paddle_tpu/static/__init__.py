@@ -151,7 +151,23 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
         feed = feed or {}
         if callable(program) or isinstance(program, StaticFunction):
-            vals = [v for v in feed.values()]
+            # match feed entries to the callable's parameters by NAME (the
+            # reference Executor's contract); fall back to insertion order
+            # only when the signature is unavailable or names don't line up
+            import inspect
+            vals = list(feed.values())
+            target = program
+            if isinstance(program, StaticFunction) and program._layer is not None:
+                target = program._layer.forward
+            try:
+                names = [p.name for p in
+                         inspect.signature(target).parameters.values()
+                         if p.kind in (p.POSITIONAL_ONLY,
+                                       p.POSITIONAL_OR_KEYWORD)]
+                if set(feed) <= set(names):
+                    vals = [feed[n] for n in names if n in feed]
+            except (TypeError, ValueError, AttributeError):
+                pass
             args = [jnp.asarray(getattr(v, "_data", v)) for v in vals]
             out = program(*args)
             outs = out if isinstance(out, (list, tuple)) else [out]

@@ -211,7 +211,8 @@ class _ParallelCorpus(Dataset):
     """Shared machinery for WMT14/WMT16: tab- or ``|||``-separated parallel
     lines → (src ids, trg ids, trg_next ids) with per-side vocabularies."""
 
-    def __init__(self, data_file, mode, src_dict_size, trg_dict_size, cls):
+    def __init__(self, data_file, mode, src_dict_size, trg_dict_size, cls,
+                 swap_sides=False):
         _require(data_file, cls)
         pairs = []
         opener = gzip.open if str(data_file).endswith(".gz") else open
@@ -223,6 +224,8 @@ class _ParallelCorpus(Dataset):
                     s, t = ln.rstrip("\n").split("|||")[:2]
                 else:
                     continue
+                if swap_sides:
+                    s, t = t, s
                 pairs.append((s.split(), t.split()))
         self.src_dict = self._build_dict([p[0] for p in pairs], src_dict_size)
         self.trg_dict = self._build_dict([p[1] for p in pairs], trg_dict_size)
@@ -261,16 +264,22 @@ class WMT14(_ParallelCorpus):
 
 
 class WMT16(_ParallelCorpus):
-    """WMT16 en-de translation pairs (reference wmt16.py)."""
+    """WMT16 en-de translation pairs (reference wmt16.py).  ``lang`` selects
+    the source side: "en" keeps the file's (en, de) order, "de" swaps so
+    German is the source (the reference's trg_lang knob, inverted)."""
 
     def __init__(self, data_file=None, mode="train", src_dict_size=30000,
                  trg_dict_size=30000, lang="en"):
-        super().__init__(data_file, mode, src_dict_size, trg_dict_size, "WMT16")
+        super().__init__(data_file, mode, src_dict_size, trg_dict_size, "WMT16",
+                         swap_sides=(lang != "en"))
 
 
 class Conll05st(Dataset):
     """CoNLL-2005 SRL dataset (reference conll05.py): parses the
-    column-format props/words files from a local directory or tar."""
+    column-format props/words files from a local directory or tar.  The
+    vocabulary spans all sentences; ``mode`` takes the leading 80% as train
+    and the rest as test (the reference ships separate files per split —
+    with one local file, split deterministically)."""
 
     def __init__(self, data_file=None, mode="train"):
         _require(data_file, "Conll05st")
@@ -292,6 +301,8 @@ class Conll05st(Dataset):
                 freq[w] = freq.get(w, 0) + 1
         self.word_dict = {w: i for i, w in enumerate(
             sorted(freq, key=lambda w: (-freq[w], w)))}
+        split = int(len(sents) * 0.8)
+        sents = sents[:split] if mode == "train" else sents[split:]
         self.data = [np.array([self.word_dict[w] for w in s], np.int64)
                      for s in sents]
 

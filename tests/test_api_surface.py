@@ -509,3 +509,36 @@ class TestL1Decay:
         opt.step()
         np.testing.assert_allclose(np.array(lin.weight._data),
                                    w0 - 0.1 * 0.5 * np.sign(w0), rtol=1e-5)
+
+
+class TestSparseNativeOps:
+    def test_sparse_add_stays_sparse(self):
+        import jax
+        ind1 = np.array([[0, 1], [0, 1]])
+        ind2 = np.array([[0, 2], [0, 2]])
+        a = paddle.sparse.sparse_coo_tensor(ind1, np.array([1., 2.], dtype=np.float32), [3, 3])
+        b = paddle.sparse.sparse_coo_tensor(ind2, np.array([10., 20.], dtype=np.float32), [3, 3])
+        out = paddle.sparse.add(a, b)
+        assert paddle.sparse.is_sparse(out)
+        ref = np.asarray(a.to_dense()._data) + np.asarray(b.to_dense()._data)
+        np.testing.assert_array_equal(np.asarray(out.to_dense()._data), ref)
+        # jit-safe: static nse bound
+        f = jax.jit(lambda: paddle.sparse.add(a, b).to_dense()._data)
+        np.testing.assert_array_equal(np.asarray(f()), ref)
+
+    def test_executor_feed_by_name(self):
+        exe = paddle.static.Executor()
+        out = exe.run(lambda x, y: x - y,
+                      feed={"y": np.ones(2, np.float32),
+                            "x": np.full(2, 3.0, np.float32)})
+        np.testing.assert_array_equal(out[0], np.full(2, 2.0, np.float32))
+
+    def test_conll_mode_split(self, tmp_path):
+        f = tmp_path / "words.txt"
+        blocks = []
+        for i in range(10):
+            blocks.append(f"word{i}\nother{i}\n")
+        f.write_text("\n".join(blocks) + "\n")
+        tr = paddle.text.Conll05st(data_file=str(tmp_path), mode="train")
+        te = paddle.text.Conll05st(data_file=str(tmp_path), mode="test")
+        assert len(tr) == 8 and len(te) == 2

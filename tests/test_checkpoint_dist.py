@@ -1,0 +1,176 @@
+"""Sharded checkpoint + elastic resume tests (VERDICT round-1 #8).
+
+The load-bearing scenario is the elastic rescale story promised by
+fleet/elastic.py: train on an 8-way mesh, checkpoint, resume on a 4-way
+mesh, and the loss trajectory must continue exactly as if the run had never
+stopped (reference counterpart: sharding_optimizer state save/load +
+auto_checkpoint.py:71 resume).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.zero import make_zero_train_step
+from paddle_tpu.optimizer import Adam
+
+needs8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+def _mlp_params(seed=0):
+    r = np.random.RandomState(seed)
+    mk = lambda *s: jnp.asarray(r.standard_normal(s).astype(np.float32) * 0.1)
+    return {"w1": mk(16, 32), "b1": mk(32), "w2": mk(32, 8), "b2": mk(8)}
+
+
+def _loss_of(params, x, y):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+def _batch(seed=1):
+    r = np.random.RandomState(seed)
+    return (jnp.asarray(r.standard_normal((16, 16)).astype(np.float32)),
+            jnp.asarray(r.randint(0, 8, 16)))
+
+
+def _sharding_mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("sharding",))
+
+
+def _shardings_of(state):
+    return jax.tree_util.tree_map(
+        lambda a: a.sharding if isinstance(a, jax.Array) else None, state)
+
+
+@needs8
+class TestShardedCheckpoint:
+    def test_roundtrip_same_mesh(self, tmp_path):
+        mesh = _sharding_mesh(8)
+        step, state = make_zero_train_step(_loss_of, _mlp_params(), Adam(1e-2),
+                                           mesh, zero_stage=2)
+        x, y = _batch()
+        state, _ = step(state, np.float32(1e-2), x, y)
+        ckpt.save(state, str(tmp_path / "c1"))
+        loaded = ckpt.load(str(tmp_path / "c1"), target=state,
+                           shardings=_shardings_of(state))
+        for (ka, a), (kb, b) in zip(
+                sorted(ckpt._flatten(state).items()),
+                sorted(ckpt._flatten(loaded).items())):
+            assert ka == kb
+            if isinstance(a, jax.Array):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                              err_msg=ka)
+
+    @pytest.mark.parametrize("stage", [2, 3])
+    def test_elastic_rescale_8_to_4(self, tmp_path, stage):
+        """save on sharding=8, resume on sharding=4: loss continuity."""
+        x, y = _batch()
+        lr = np.float32(1e-2)
+
+        # uninterrupted reference run on 8 devices
+        mesh8 = _sharding_mesh(8)
+        step8, state8 = make_zero_train_step(_loss_of, _mlp_params(),
+                                             Adam(1e-2), mesh8,
+                                             zero_stage=stage)
+        ref_losses = []
+        for _ in range(6):
+            state8, loss = step8(state8, lr, x, y)
+            ref_losses.append(float(loss))
+
+        # interrupted run: 3 steps on 8, checkpoint, resume 3 on 4
+        mesh8b = _sharding_mesh(8)
+        stepA, stateA = make_zero_train_step(_loss_of, _mlp_params(),
+                                             Adam(1e-2), mesh8b,
+                                             zero_stage=stage)
+        for _ in range(3):
+            stateA, _ = stepA(stateA, lr, x, y)
+        ckpt.save(stateA, str(tmp_path / "resc"))
+
+        mesh4 = _sharding_mesh(4)
+        stepB, stateB0 = make_zero_train_step(_loss_of, _mlp_params(),
+                                              Adam(1e-2), mesh4,
+                                              zero_stage=stage)
+        stateB = ckpt.load(str(tmp_path / "resc"), target=stateB0,
+                           shardings=_shardings_of(stateB0))
+        resumed = []
+        for _ in range(3):
+            stateB, loss = stepB(stateB, lr, x, y)
+            resumed.append(float(loss))
+        np.testing.assert_allclose(resumed, ref_losses[3:], rtol=2e-5,
+                                   atol=2e-6)
+
+    def test_chunked_large_leaf(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(ckpt, "_MAX_CHUNK_BYTES", 256)
+        arr = np.arange(1024, dtype=np.float32).reshape(32, 32)
+        state = {"big": jnp.asarray(arr), "s": jnp.asarray(3.0)}
+        ckpt.save(state, str(tmp_path / "chunked"))
+        files = [f for f in os.listdir(tmp_path / "chunked")
+                 if f.startswith("big") and f.endswith(".npy")]
+        assert len(files) > 1, "large leaf was not split into chunks"
+        loaded = ckpt.load(str(tmp_path / "chunked"), target=state)
+        np.testing.assert_array_equal(np.asarray(loaded["big"]), arr)
+        np.testing.assert_allclose(float(np.asarray(loaded["s"])), 3.0)
+
+    def test_async_save(self, tmp_path):
+        state = {"a": jnp.arange(16.0), "b": {"c": jnp.ones((4, 4))}}
+        h = ckpt.save(state, str(tmp_path / "async"), async_save=True)
+        h.wait()
+        assert h.done()
+        loaded = ckpt.load(str(tmp_path / "async"), target=state)
+        np.testing.assert_array_equal(np.asarray(loaded["b"]["c"]),
+                                      np.ones((4, 4)))
+
+    @needs8
+    def test_replicated_leaf_saved_once(self, tmp_path):
+        mesh = _sharding_mesh(8)
+        rep = jax.device_put(jnp.ones((8, 8)), NamedSharding(mesh, P()))
+        sharded = jax.device_put(jnp.arange(64.0).reshape(8, 8),
+                                 NamedSharding(mesh, P("sharding")))
+        ckpt.save({"rep": rep, "sh": sharded}, str(tmp_path / "dedup"))
+        files = os.listdir(tmp_path / "dedup")
+        rep_files = [f for f in files if f.startswith("rep")]
+        sh_files = [f for f in files if f.startswith("sh")]
+        assert len(rep_files) == 1, f"replicated leaf duplicated: {rep_files}"
+        assert len(sh_files) == 8, f"expected 8 shard files: {sh_files}"
+
+    def test_missing_leaf_raises(self, tmp_path):
+        ckpt.save({"a": jnp.ones(3)}, str(tmp_path / "m"))
+        with pytest.raises(KeyError):
+            ckpt.load(str(tmp_path / "m"), target={"a": jnp.ones(3),
+                                                   "b": jnp.ones(3)})
+
+
+@needs8
+def test_resave_smaller_world_ignores_stale_partials(tmp_path, monkeypatch):
+    """Re-saving to the same dir after a rescale must not resurrect stale
+    per-process manifests (round-2 review finding)."""
+    d = str(tmp_path / "resave")
+    state_old = {"w": jnp.zeros((8,))}
+    # simulate an old 8-process save: write a stale partial manifest claiming
+    # a chunk with old data
+    ckpt.save(state_old, d)
+    import json
+    old_chunk = "w.stale.p1.npy"
+    np.save(os.path.join(d, old_chunk[:-4] + ".npy"),
+            np.full((8,), 99.0, np.float32))
+    with open(os.path.join(d, "manifest.p1.json"), "w") as f:
+        json.dump({"leaves": {"w": {"kind": "array", "shape": [8],
+                                    "dtype": "float32",
+                                    "chunks": [{"file": "w.stale.p1.npy",
+                                                "box": [[0, 8]]}]}},
+                   "format": 1, "process_count": 8}, f)
+    # fresh single-process save of NEW data to the same directory
+    state_new = {"w": jnp.arange(8.0)}
+    ckpt.save(state_new, d)
+    loaded = ckpt.load(d, target=state_new)
+    np.testing.assert_array_equal(np.asarray(loaded["w"]), np.arange(8.0))

@@ -111,3 +111,141 @@ def test_global_scatter_gather_roundtrip():
     for rk in range(4):
         for w in range(4):
             np.testing.assert_allclose(out[rk, w], xin[w, rk])
+
+
+# ---------------------------------------------------------------------------
+# Ragged exchange (VERDICT round-1 #7): reference global_scatter semantics
+# with per-expert counts, via pad → all_to_all → sort-compact.
+# ---------------------------------------------------------------------------
+
+def _ragged_oracle(xs, counts, W, El):
+    """Numpy simulation of the reference's grouped send/recv loops
+    (operators/collective/global_scatter_op.cu.cc): returns per-rank
+    (received rows in expert-major order, recv_counts (W, El))."""
+    outs = []
+    for me in range(W):
+        rows, rc = [], np.zeros((W, El), np.int64)
+        for el in range(El):
+            for src in range(W):
+                d = me * El + el
+                c = int(counts[src][d])
+                off = int(np.sum(counts[src][:d]))
+                rows.append(xs[src][off:off + c])
+                rc[src, el] = c
+        outs.append((np.concatenate(rows, axis=0) if rows else
+                     np.zeros((0, xs[0].shape[1])), rc))
+    return outs
+
+
+@needs4
+def test_ragged_global_scatter_matches_oracle():
+    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.distributed.utils import ragged_global_scatter
+    W, El, T, H = 4, 2, 12, 5
+    mesh = Mesh(np.array(local_devices()[:W]), ("data",))
+    r = np.random.RandomState(7)
+    xs = [r.randn(T, H).astype(np.float32) for _ in range(W)]
+    # ragged counts: each rank splits its T rows over W*El destinations
+    counts = []
+    for _ in range(W):
+        c = r.multinomial(T, np.ones(W * El) / (W * El))
+        counts.append(c.astype(np.int32))
+    X = jnp.asarray(np.stack(xs)).reshape(W * T, H)
+    C = jnp.asarray(np.stack(counts)).reshape(W * W * El)
+
+    def f(xl, cl):
+        out, rc, _ = ragged_global_scatter(xl, cl, group="data")
+        return out, rc
+
+    g = shard_map(f, mesh=mesh, in_specs=(P("data"), P("data")),
+                  out_specs=(P("data"), P("data")))
+    out, rc = jax.jit(g)(X, C)
+    out = np.asarray(out).reshape(W, W * T, H)
+    rc = np.asarray(rc).reshape(W, W, El)
+    oracle = _ragged_oracle(xs, counts, W, El)
+    for me in range(W):
+        ref_rows, ref_rc = oracle[me]
+        n = ref_rc.sum()
+        np.testing.assert_array_equal(rc[me], ref_rc, err_msg=f"rank {me} counts")
+        np.testing.assert_allclose(out[me, :n], ref_rows, rtol=1e-6,
+                                   err_msg=f"rank {me} rows")
+        np.testing.assert_allclose(out[me, n:], 0.0)
+
+
+@needs4
+def test_ragged_scatter_gather_roundtrip_with_expert_transform():
+    """Tokens go out ragged, each expert scales its tokens, results come back
+    to the original rows — end-to-end EP compute with non-uniform routing."""
+    from jax.experimental.shard_map import shard_map
+    from paddle_tpu.distributed.utils import (ragged_global_gather,
+                                              ragged_global_scatter)
+    W, El, T, H = 4, 2, 10, 3
+    mesh = Mesh(np.array(local_devices()[:W]), ("data",))
+    r = np.random.RandomState(8)
+    xs = [r.randn(T, H).astype(np.float32) for _ in range(W)]
+    counts = [r.multinomial(T, np.ones(W * El) / (W * El)).astype(np.int32)
+              for _ in range(W)]
+    X = jnp.asarray(np.stack(xs)).reshape(W * T, H)
+    C = jnp.asarray(np.stack(counts)).reshape(W * W * El)
+
+    def f(xl, cl):
+        out, rc, perm = ragged_global_scatter(xl, cl, group="data")
+        # expert el on rank me scales by (me*El + el + 1); rows are
+        # expert-major so expert of each row follows from rc
+        me = jax.lax.axis_index("data")
+        per_expert = jnp.sum(rc, axis=0)              # (El,)
+        cum = jnp.cumsum(per_expert)
+        row = jnp.arange(out.shape[0])
+        el = jnp.sum(row[:, None] >= cum[None, :], axis=1)
+        el = jnp.minimum(el, El - 1)
+        scale = (me * El + el + 1).astype(out.dtype)
+        y = out * scale[:, None]
+        back = ragged_global_gather(y, cl, perm, rows=xl.shape[0],
+                                    group="data")
+        return back
+
+    g = shard_map(f, mesh=mesh, in_specs=(P("data"), P("data")),
+                  out_specs=P("data"))
+    back = np.asarray(jax.jit(g)(X, C)).reshape(W, T, H)
+    # oracle: row destined to global expert d gets scaled by (d+1)
+    for src in range(W):
+        off = 0
+        for d in range(W * El):
+            c = int(counts[src][d])
+            np.testing.assert_allclose(back[src, off:off + c],
+                                       xs[src][off:off + c] * (d + 1),
+                                       rtol=1e-6, err_msg=f"src {src} dest {d}")
+            off += c
+
+
+@needs4
+def test_global_scatter_ragged_counts_raise():
+    """Back-compat contract: the reference-shaped wrapper rejects ragged
+    counts with a pointer to the ragged pair (round-2 review finding)."""
+    from paddle_tpu.distributed.utils import global_scatter
+    import pytest as _pytest
+    x = jnp.ones((8, 4))
+    with _pytest.raises(ValueError, match="ragged_global_scatter"):
+        global_scatter(x, local_count=np.array([1, 3, 2, 2]), group="data")
+
+
+@needs4
+def test_ragged_scatter_small_block_raises():
+    from paddle_tpu.distributed.utils import ragged_global_scatter
+    from jax.experimental.shard_map import shard_map
+    import pytest as _pytest
+    W, T, H = 4, 8, 3
+    mesh = Mesh(np.array(local_devices()[:W]), ("data",))
+    X = jnp.ones((W * T, H))
+    counts = np.zeros((W, W), np.int32)
+    counts[:, 0] = T  # every rank sends all rows to rank 0
+    C = jnp.asarray(counts.reshape(-1))
+
+    def f(xl, cl):
+        out, rc, _ = ragged_global_scatter(xl, cl, group="data", block=4)
+        return out
+
+    g = shard_map(f, mesh=mesh, in_specs=(P("data"), P("data")),
+                  out_specs=P("data"))
+    with _pytest.raises(ValueError, match="block"):
+        jax.jit(g)(X, C)
