@@ -67,7 +67,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
 _LAZY = ("nn", "optimizer", "amp", "metric", "io", "vision", "distributed", "jit",
          "static", "hapi", "ops", "models", "distribution", "profiler", "text",
          "incubate", "utils", "autograd", "regularizer", "callbacks", "linalg", "fft",
-         "signal", "sparse", "onnx", "device", "framework")
+         "signal", "sparse", "onnx", "device", "framework", "inference",
+         "quantization")
 
 
 def __getattr__(name):

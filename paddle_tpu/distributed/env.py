@@ -24,14 +24,19 @@ def init_parallel_env(backend: Optional[str] = None):
     if _initialized:
         return
     world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if world > 1 and jax.process_count() == 1:
-        coord = os.environ.get("PADDLE_MASTER")
-        if coord is None:
-            eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
-            coord = eps.split(",")[0] if eps else None
-        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        jax.distributed.initialize(coordinator_address=coord, num_processes=world,
-                                   process_id=rank)
+    if world > 1:
+        # do NOT probe jax.process_count() here: it would initialize the XLA
+        # backend, after which jax.distributed.initialize refuses to run —
+        # gate on jax's own distributed-client state instead
+        from jax._src import distributed as _jdist
+        if _jdist.global_state.client is None:
+            coord = os.environ.get("PADDLE_MASTER")
+            if coord is None:
+                eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+                coord = eps.split(",")[0] if eps else None
+            rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=world, process_id=rank)
     _initialized = True
 
 

@@ -1,0 +1,103 @@
+"""LocalSGD (reference: fleet/meta_optimizers/localsgd_optimizer.py:26).
+
+The reference rewrites the program so each data-parallel worker trains on
+its own gradient for ``k_steps`` and then block-averages the parameters
+(c_allreduce on params, not grads).  TPU-native formulation: parameters and
+optimizer slots carry a leading replica dimension sharded over the data
+axis; the whole schedule — local grad, local update, every-k parameter
+average — runs inside ONE ``shard_map``-wrapped jitted step, with the sync
+point expressed as a ``lax.cond`` on the step counter so there is no host
+control flow and the collective is genuinely skipped at runtime on
+non-sync steps (the entire point of LocalSGD: ICI traffic drops by ~k×).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["make_localsgd_train_step"]
+
+
+def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
+                             optimizer, mesh: Mesh, k_steps: int = 4,
+                             axis: str = "data", donate: bool = True):
+    """Build a LocalSGD step over the ``axis`` mesh axis.
+
+    ``loss_of(params, *batch) -> scalar``; ``batch`` leading dim is the
+    global batch, split evenly over ``axis``.  Returns ``(step, state0)``
+    with ``step(state, lr, *batch) -> (state, loss)`` where loss is the
+    cross-replica mean of the local losses.  Parameters are kept per-replica
+    (leading dim R, sharded on ``axis``) and block-averaged every
+    ``k_steps``-th call; reading them out: ``state["params"]`` rows are
+    identical right after a sync step.
+    """
+    R = mesh.shape[axis]
+    if k_steps < 1:
+        raise ValueError(f"k_steps must be >= 1, got {k_steps}")
+
+    stack = lambda p: jnp.broadcast_to(p[None], (R,) + p.shape)
+    params_r = jax.tree_util.tree_map(stack, params0)
+    opt_r = jax.tree_util.tree_map(stack, optimizer.init_state(params0))
+    state0 = {"params": params_r, "opt": opt_r,
+              "count": jnp.zeros([], jnp.int32)}
+
+    rep_spec = lambda leaf: P(axis, *([None] * (np.ndim(leaf) - 1)))
+    state_specs = {
+        "params": jax.tree_util.tree_map(rep_spec, params_r),
+        "opt": jax.tree_util.tree_map(rep_spec, opt_r),
+        "count": P(),
+    }
+    state0 = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        state0, state_specs)
+
+    def body(state, lr, *batch):
+        # inside shard_map: params/opt leaves have leading dim 1 (this
+        # replica); batch rows are this replica's slice
+        params = jax.tree_util.tree_map(lambda a: a[0], state["params"])
+        opt = jax.tree_util.tree_map(lambda a: a[0], state["opt"])
+        count = state["count"] + 1
+
+        loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+        new_params, new_opt = optimizer.update(grads, opt, params, lr=lr)
+
+        # lax.cond, NOT jnp.where: where would execute the pmean every step
+        # and merely discard it — the collective must be skipped at runtime
+        # on non-sync steps or LocalSGD saves no ICI traffic at all
+        sync = (count % k_steps) == 0
+        new_params = lax.cond(
+            sync,
+            lambda ps: jax.tree_util.tree_map(
+                lambda p: lax.pmean(p, axis), ps),
+            lambda ps: ps,
+            new_params)
+
+        out = {"params": jax.tree_util.tree_map(lambda a: a[None], new_params),
+               "opt": jax.tree_util.tree_map(lambda a: a[None], new_opt),
+               "count": count}
+        return out, lax.pmean(loss, axis)
+
+    batch_spec = P(axis)
+
+    # shard_map specs are positional; rebuild per-call for variadic batches
+    @functools.lru_cache(maxsize=8)
+    def _compiled(n_batch):
+        w = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(state_specs, P()) + (batch_spec,) * n_batch,
+            out_specs=(state_specs, P()),
+            check_vma=False)
+        return jax.jit(w, donate_argnums=(0,) if donate else ())
+
+    def step(state, lr, *batch):
+        return _compiled(len(batch))(state, jnp.asarray(lr, jnp.float32),
+                                     *batch)
+
+    return step, state0

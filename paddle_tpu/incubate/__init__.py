@@ -6,7 +6,7 @@ Graduated-but-experimental surface: LookAhead / ModelAverage optimizers
 reference layout.
 """
 
-from . import checkpoint, optimizer  # noqa: F401
+from . import asp, checkpoint, optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["optimizer", "checkpoint", "LookAhead", "ModelAverage"]
+__all__ = ["optimizer", "checkpoint", "asp", "LookAhead", "ModelAverage"]

@@ -1,0 +1,108 @@
+"""ASP — automatic structured (n:m) sparsity
+(reference: fluid/contrib/sparsity/asp.py, utils.py — 2:4 mask generation,
+prune_model, optimizer decoration that re-masks after every step).
+
+TPU-native note: the reference's payoff is Ampere sparse tensor cores; XLA
+has no 2:4 MXU mode, so here ASP is a *training technique* (mask-and-keep
+pruning with optimizer re-masking) whose artifact — a model whose weights
+are exactly n:m sparse — can be served by any 2:4-capable backend.  Mask
+computation is pure jnp (top-n |magnitude| per m-block via one reshape +
+top_k), so pruning whole models jit-compiles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Parameter
+
+__all__ = ["create_mask", "check_sparsity", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers", "ASPHelper"]
+
+
+def create_mask(w, n: int = 2, m: int = 4):
+    """Boolean keep-mask with the top-``n`` |values| in every ``m`` block
+    along the last dim (reference sparsity/utils.py get_mask_1d)."""
+    arr = jnp.asarray(getattr(w, "_data", w))
+    if arr.shape[-1] % m != 0:
+        raise ValueError(f"last dim ({arr.shape[-1]}) must divide by m={m}")
+    blocks = arr.reshape(-1, m)
+    # threshold = n-th largest |value| per block; ties keep the earlier entry
+    mag = jnp.abs(blocks)
+    order = jnp.argsort(-mag, axis=-1, stable=True)
+    ranks = jnp.argsort(order, axis=-1, stable=True)   # rank of each entry
+    mask = (ranks < n).reshape(arr.shape)
+    return mask
+
+
+def check_sparsity(w, n: int = 2, m: int = 4) -> bool:
+    """True iff every m-block along the last dim has ≤ n nonzeros."""
+    arr = np.asarray(getattr(w, "_data", w))
+    if arr.shape[-1] % m != 0:
+        return False
+    blocks = (arr.reshape(-1, m) != 0).sum(axis=-1)
+    return bool((blocks <= n).all())
+
+
+class ASPHelper:
+    """Mask registry + optimizer hook (reference asp.py:245 ASPHelper)."""
+
+    _excluded: List[str] = []
+    _masks: Dict[int, jnp.ndarray] = {}
+
+    @classmethod
+    def prunable(cls, layer) -> List[Parameter]:
+        out = []
+        for name, p in layer.named_parameters():
+            if any(ex in name for ex in cls._excluded):
+                continue
+            if len(p.shape) >= 2 and p.shape[-1] % 4 == 0:
+                out.append(p)
+        return out
+
+    @classmethod
+    def prune(cls, layer, n: int, m: int):
+        for p in cls.prunable(layer):
+            mask = create_mask(p._data, n, m)
+            p._data = jnp.where(mask, p._data, 0)
+            cls._masks[id(p)] = mask
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune all prunable weights of ``model`` to n:m sparsity in place and
+    register their masks for optimizer re-masking (reference asp.py:149)."""
+    ASPHelper.prune(model, n, m)
+    return model
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` so updated weights are re-masked after every
+    step (reference asp.py:110 OptimizerWithSparsityGuarantee): gradient
+    steps may revive pruned entries; the mask zeroes them again."""
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        params = optimizer._parameter_list or []
+        for p in params:
+            mask = ASPHelper._masks.get(id(p))
+            if mask is not None and mask.shape == tuple(p.shape):
+                p._data = jnp.where(mask, p._data, 0)
+
+    optimizer.step = step
+    optimizer.minimize_step = step
+    return optimizer
+
+
+def set_excluded_layers(main_program=None, param_names: Optional[List[str]] = None):
+    """Exclude parameters whose name contains any given substring."""
+    if isinstance(main_program, (list, tuple)) and param_names is None:
+        param_names = list(main_program)
+    ASPHelper._excluded = list(param_names or [])
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded = []

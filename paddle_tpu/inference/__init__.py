@@ -1,0 +1,241 @@
+"""Inference API (reference: python/paddle/inference/__init__.py, C++
+AnalysisPredictor — api/analysis_predictor.cc:151).
+
+The reference's predictor loads a serialized program, runs IR optimization
+passes, and exposes named zero-copy input/output handles.  TPU-native
+equivalent: the artifact is the StableHLO export written by
+``paddle.jit.save`` (XLA *is* the optimizing compiler — the analysis passes
+collapse per SURVEY §7), and the handles hold device arrays directly, so
+``copy_from_cpu → run → copy_to_cpu`` round-trips through one compiled
+executable with no per-call retracing.
+
+Usage (reference calling convention)::
+
+    config = Config(path_prefix)          # the jit.save prefix
+    predictor = create_predictor(config)
+    names = predictor.get_input_names()
+    predictor.get_input_handle(names[0]).copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorPool",
+           "get_version"]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class Config:
+    """Predictor configuration (reference paddle_infer.Config).
+
+    Accepts the ``jit.save`` path prefix, or (prog_file, params_file) where
+    prog_file ends in ``.pdmodel``.  GPU/IR/memory knobs are accepted for
+    API parity and recorded; placement follows the JAX default device and
+    XLA owns optimization.
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        self._params_file = params_file
+        self._use_gpu = False
+        self._ir_optim = True
+        self._memory_optim = True
+        self._cpu_math_threads = 1
+
+    # -- model location ----------------------------------------------------
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        # only the model location changes — previously-set knobs survive
+        # (the reference Config.set_model does not reset options)
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            self._prefix = prog_file[:-len(".pdmodel")]
+        else:
+            self._prefix = prog_file
+        if params_file is not None:
+            self._params_file = params_file
+
+    def set_prog_file(self, path: str):
+        self.set_model(path, self._params_file)
+
+    def model_dir(self) -> str:
+        return os.path.dirname(self._prefix or "")
+
+    def prog_file(self) -> str:
+        return (self._prefix or "") + ".pdmodel"
+
+    def params_file(self) -> str:
+        return self._params_file or (self._prefix or "") + ".pdiparams"
+
+    # -- device / optimization knobs (parity; XLA decides) ------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def use_gpu(self) -> bool:
+        return self._use_gpu
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self):
+        self._memory_optim = True
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_math_threads = int(n)
+
+    def enable_mkldnn(self):  # no MKLDNN in an XLA build
+        pass
+
+    def disable_glog_info(self):
+        pass
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, backend="
+                f"{jax.default_backend()}, ir_optim={self._ir_optim})")
+
+
+class _IOHandle:
+    """Named input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+        self._value: Optional[jax.Array] = None
+
+    def reshape(self, shape):
+        self._shape = tuple(int(s) for s in shape)
+
+    def copy_from_cpu(self, data: np.ndarray):
+        arr = np.asarray(data)
+        if self._dtype is not None:
+            arr = arr.astype(self._dtype, copy=False)
+        self._value = jax.device_put(arr)
+
+    def share_external_data(self, data):
+        self._value = jax.device_put(getattr(data, "_data", data))
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"handle {self.name!r} holds no data")
+        return np.asarray(self._value)
+
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._shape or [])
+
+
+class Predictor:
+    """Compiled inference session over a ``jit.save`` artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if not os.path.exists(config.prog_file()):
+            raise ValueError(f"model file {config.prog_file()!r} not found")
+        self._layer = jit_load(config._prefix)
+        meta = getattr(self._layer, "_meta", {}) or {}
+        names = meta.get("input_names") or \
+            [f"x{i}" for i in range(meta.get("n_inputs", 1))]
+        shapes = meta.get("input_shapes") or [None] * len(names)
+        dtypes = meta.get("input_dtypes") or [None] * len(names)
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n, s, d) for n, s, d in zip(names, shapes, dtypes)}
+        self._input_order = names
+        self._outputs: Dict[str, _IOHandle] = {}
+        self._output_order: List[str] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_order)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        if name not in self._inputs:
+            raise KeyError(f"unknown input {name!r}; inputs: {self._input_order}")
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Execute the compiled program.  ``inputs`` (positional list) is the
+        convenience form; otherwise values come from the input handles."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_order):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs for {len(self._input_order)} "
+                    f"model inputs {self._input_order}")
+            for n, v in zip(self._input_order, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(v))
+        missing = [n for n in self._input_order
+                   if self._inputs[n]._value is None]
+        if missing:
+            raise RuntimeError(f"inputs not set: {missing}")
+        args = [self._inputs[n]._value for n in self._input_order]
+        out = self._layer._exported.call(self._layer._params,
+                                         self._layer._buffers, *args)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._output_order = [f"output_{i}" for i in range(len(leaves))]
+        self._outputs = {}
+        for nm, leaf in zip(self._output_order, leaves):
+            h = _IOHandle(nm)
+            h._value = leaf
+            self._outputs[nm] = h
+        if inputs is not None:
+            return [np.asarray(l) for l in leaves]
+        return None
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_order)
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        if name not in self._outputs:
+            raise KeyError(f"unknown output {name!r} (run() first); "
+                           f"outputs: {self._output_order}")
+        return self._outputs[name]
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def clone(self) -> "Predictor":
+        p = Predictor.__new__(Predictor)
+        p._layer = self._layer  # share the compiled executable + weights
+        p._inputs = {n: _IOHandle(h.name, h._shape, h._dtype)
+                     for n, h in self._inputs.items()}
+        p._input_order = list(self._input_order)
+        p._outputs = {}
+        p._output_order = []
+        return p
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: paddle_infer.create_predictor."""
+    return Predictor(config)
+
+
+class PredictorPool:
+    """Pool of cloned predictors (reference PredictorPool) — clones share
+    the compiled executable and weights, so the pool is cheap."""
+
+    def __init__(self, config: Config, size: int = 1):
+        base = create_predictor(config)
+        self._preds = [base] + [base.clone() for _ in range(size - 1)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]

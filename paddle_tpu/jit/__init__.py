@@ -134,8 +134,13 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs) -> 
         pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
                      "buffers": {k: np.asarray(v) for k, v in buffers.items()}}, f,
                     protocol=4)
+    names = [getattr(s, "name", None) or f"x{i}"
+             for i, s in enumerate(input_spec)]
     with open(path + ".pdmeta", "wb") as f:
-        pickle.dump({"n_inputs": len(shapes)}, f)
+        pickle.dump({"n_inputs": len(shapes),
+                     "input_names": names,
+                     "input_shapes": [tuple(s.shape) for s in shapes],
+                     "input_dtypes": [str(np.dtype(s.dtype)) for s in shapes]}, f)
 
 
 class TranslatedLayer:
@@ -167,7 +172,14 @@ def load(path: str, **configs) -> TranslatedLayer:
         exported = jax_export.deserialize(f.read())
     with open(path + ".pdiparams", "rb") as f:
         weights = pickle.load(f)
-    return TranslatedLayer(exported, weights["params"], weights["buffers"])
+    layer = TranslatedLayer(exported, weights["params"], weights["buffers"])
+    meta_path = path + ".pdmeta"
+    if os.path.exists(meta_path):
+        with open(meta_path, "rb") as f:
+            layer._meta = pickle.load(f)
+    else:
+        layer._meta = {}
+    return layer
 
 
 def not_to_static(fn):

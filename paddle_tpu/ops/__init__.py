@@ -4,3 +4,4 @@ TPU-native replacements for the reference's operators/fused/ corpus
 (fused_attention_op.cu, fused_feedforward_op.cu, fused_dropout_helper.h)."""
 
 from .attention import dense_attention, flash_attention, scaled_dot_product_attention  # noqa: F401
+from .custom import CustomOp, custom_op, get_op, list_ops, register_op  # noqa: F401

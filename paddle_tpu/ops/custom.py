@@ -1,0 +1,142 @@
+"""Custom-operator registration (reference: framework/custom_operator.cc,
+python/paddle/utils/cpp_extension — the user-facing "bring your own kernel"
+runtime).
+
+TPU-native design: a custom op is a pure jnp/Pallas function of raw arrays.
+Registration wires it into the framework exactly like a built-in:
+
+- dispatched through the eager ``apply`` (tape Node recorded, AMP lists,
+  nan/inf checks, hooks all apply);
+- traceable under ``jax.jit`` (the op IS jax-traceable code — the reference
+  needs a compiled .so per device; here Mosaic compiles Pallas kernels for
+  TPU at trace time);
+- optional custom backward installed as a ``jax.custom_vjp`` with the
+  reference grad-op convention: ``backward(grads, inputs, outputs)`` sees
+  dOut, X, Out and returns dX per differentiable input (≙ the GradOpMaker
+  contract: grad kernels take {X, Out, Out@GRAD} → X@GRAD).
+
+Example::
+
+    @custom_op(backward=lambda g, ins, outs: (g[0] * 2.0,))
+    def double(x):
+        return x * 2.0
+
+    y = double(paddle.to_tensor([1.0]))        # eager, taped
+    jax.jit(lambda a: double._raw(a))(...)      # inside jit
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+
+__all__ = ["register_op", "custom_op", "get_op", "list_ops", "CustomOp"]
+
+_REGISTRY: Dict[str, "CustomOp"] = {}
+
+
+class CustomOp:
+    """A registered custom operator.
+
+    ``forward``: pure function of raw arrays (jnp/Pallas), may return one
+    array or a tuple.  ``backward(grads, inputs, outputs)``: receives the
+    output cotangents tuple, the primal inputs tuple and the primal outputs
+    tuple; returns one gradient per input (None → zero).
+    """
+
+    def __init__(self, name: str, forward: Callable,
+                 backward: Optional[Callable] = None):
+        self.name = name
+        self.forward = forward
+        self.backward = backward
+        self._raw = self._build_raw()
+
+    def _build_raw(self):
+        fwd = self.forward
+        if self.backward is None:
+            return fwd  # native jax AD differentiates straight through
+
+        user_bwd = self.backward
+
+        @jax.custom_vjp
+        def op(*args):
+            return fwd(*args)
+
+        def op_fwd(*args):
+            outs = fwd(*args)
+            return outs, (args, outs)
+
+        def op_bwd(res, gs):
+            args, outs = res
+            outs_t = outs if isinstance(outs, tuple) else (outs,)
+            gs_t = gs if isinstance(gs, tuple) else (gs,)
+            grads = user_bwd(gs_t, args, outs_t)
+            grads = grads if isinstance(grads, (tuple, list)) else (grads,)
+            if len(grads) > len(args):
+                raise ValueError(
+                    f"custom op {self.name!r}: backward returned {len(grads)} "
+                    f"gradients for {len(args)} inputs")
+            import jax.numpy as jnp
+            filled = tuple(
+                jnp.zeros_like(a) if g is None else
+                jnp.asarray(g).astype(a.dtype).reshape(a.shape)
+                for g, a in zip(list(grads) + [None] * (len(args) - len(grads)),
+                                args))
+            return filled
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+
+    def __call__(self, *args, **kwargs):
+        from ..core.tensor import apply
+        if kwargs:
+            raw = functools.partial(self._raw, **kwargs) \
+                if self.backward is None else None
+            if raw is None:
+                raise ValueError(
+                    f"custom op {self.name!r} with a custom backward takes "
+                    f"positional tensor args only (close over statics when "
+                    f"registering)")
+            return apply(raw, *args, name=self.name)
+        return apply(self._raw, *args, name=self.name)
+
+    def __repr__(self):
+        return (f"CustomOp({self.name!r}, "
+                f"backward={'custom' if self.backward else 'autodiff'})")
+
+
+def register_op(name: str, forward: Callable,
+                backward: Optional[Callable] = None) -> CustomOp:
+    """Register (or replace) a custom op under ``name``.
+
+    Reference analog: RegisterOperatorWithMetaInfo (custom_operator.cc) —
+    but where the reference demands a compiled kernel per device type, any
+    jax-traceable function here runs on every XLA backend, and a Pallas
+    ``pallas_call`` inside ``forward`` becomes a real TPU kernel.
+    """
+    op = CustomOp(name, forward, backward)
+    _REGISTRY[name] = op
+    return op
+
+
+def custom_op(fn=None, *, name: Optional[str] = None,
+              backward: Optional[Callable] = None):
+    """Decorator form of :func:`register_op`."""
+    def deco(f):
+        return register_op(name or f.__name__, f, backward)
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_op(name: str) -> CustomOp:
+    if name not in _REGISTRY:
+        raise KeyError(f"no custom op registered under {name!r}; "
+                       f"known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_ops() -> Sequence[str]:
+    return sorted(_REGISTRY)

@@ -3,4 +3,4 @@
 from . import lr  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb,  # noqa: F401
-                         Momentum, RMSProp)
+                         Lars, LarsMomentum, Momentum, RMSProp)

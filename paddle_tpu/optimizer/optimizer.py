@@ -89,14 +89,21 @@ class Optimizer:
                 g = g + self._weight_decay.grad_term(p._data).astype(g.dtype)
             plr = lr * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             slots = self._slots_for(p)
+            extra = {"param_name": getattr(p, "name", None)} \
+                if self._wants_param_name else {}
             new_p, new_slots = self._rule(p._data, g, slots, jnp.asarray(plr, jnp.float32),
-                                          step=jnp.asarray(self._step_count, jnp.int32))
+                                          step=jnp.asarray(self._step_count, jnp.int32),
+                                          **extra)
             p._data = new_p
             self._accum[id(p)] = new_slots
 
     minimize_step = step
 
     _decoupled_wd = False  # AdamW-style decoupled decay overrides to True
+    # subclasses whose rule needs the parameter's identity (e.g. Lars
+    # exclude_from_weight_decay) set this; the rule then receives
+    # ``param_name`` (Parameter.name eagerly, the pytree key functionally)
+    _wants_param_name = False
 
     def _use_coupled_wd(self, p) -> bool:
         """L2Decay folds into the gradient (decoupled optimizers override)."""
@@ -132,20 +139,24 @@ class Optimizer:
             grads = self._grad_clip._clip_pytree(grads)
         step = state["step"] + 1
 
-        def upd(p, g, slots):
+        def upd(p, g, slots, pname):
             if g is None:
                 return p, slots
             g = g.astype(p.dtype) if g.dtype != p.dtype else g
             if self._weight_decay is not None and self._use_coupled_wd(object()):
                 g = g + self._weight_decay.grad_term(p).astype(g.dtype)
-            return self._rule(p, g, slots, lr, step=step)
+            extra = {"param_name": pname} if self._wants_param_name else {}
+            return self._rule(p, g, slots, lr, step=step, **extra)
 
-        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_kp, treedef = jax.tree_util.tree_flatten_with_path(params)
+        flat_p = [v for _, v in flat_kp]
+        names = ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                          for k in path) for path, _ in flat_kp]
         flat_g = jax.tree_util.tree_leaves(grads)
         flat_s = treedef.flatten_up_to(state["slots"])
         new_p, new_s = [], []
-        for p, g, s in zip(flat_p, flat_g, flat_s):
-            np_, ns_ = upd(p, g, s)
+        for p, g, s, nm in zip(flat_p, flat_g, flat_s, names):
+            np_, ns_ = upd(p, g, s, nm)
             new_p.append(np_)
             new_s.append(ns_)
         return (jax.tree_util.tree_unflatten(treedef, new_p),

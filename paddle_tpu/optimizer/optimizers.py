@@ -211,3 +211,54 @@ class Lamb(Optimizer):
         new_p = p32 - lr * trust * update
         return new_p.astype(p.dtype), {"moment1": m.astype(p.dtype),
                                        "moment2": v.astype(p.dtype)}
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference: operators/optimizers/lars_momentum_op.h,
+    fluid LarsMomentumOptimizer).
+
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)
+    v        = mu * v + local_lr * (g + wd * p);   p -= v
+    The trust-ratio guard (||p|| > 0 and ||g|| > 0) keeps fresh zero-init
+    tensors on the plain momentum path, as the CUDA kernel does.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 epsilon=1e-9, multi_precision=False, name=None,
+                 exclude_from_weight_decay=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._epsilon = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    _wants_param_name = True
+
+    def _init_slots(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def _rule(self, p, g, slots, lr, step=None, param_name=None):
+        mu = jnp.asarray(self._momentum, jnp.float32)
+        wd = self._lars_wd
+        if param_name is not None and any(
+                ex in str(param_name) for ex in self._exclude):
+            wd = 0.0  # reference: exclude_from_weight_decay name substrings
+        p32 = p.astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (p_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * p_norm
+            / (g_norm + wd * p_norm + self._epsilon),
+            lr)
+        v = mu * slots["velocity"].astype(jnp.float32) \
+            + local_lr * (g32 + wd * p32)
+        new_p = p32 - v
+        return new_p.astype(p.dtype), {"velocity": v.astype(p.dtype)}
+
+
+LarsMomentum = Lars

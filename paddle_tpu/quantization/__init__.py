@@ -1,0 +1,228 @@
+"""Quantization: QAT + PTQ (reference: fluid/contrib/slim/quantization —
+imperative/qat.py ImperativeQuantAware, post_training_quantization.py).
+
+TPU-native design: fake-quantization is a pure jnp simulate-quantize op
+with a straight-through-estimator custom_vjp (the reference's
+fake_quantize_dequantize_* CUDA kernels + the identity grad registered for
+them), so QAT graphs jit-compile like any other.  PTQ calibration runs the
+float model while abs-max observers record ranges; ``convert`` then bakes
+int8 weights + scales.  The quantized Linear matmul contracts int8×int8 →
+int32 via ``preferred_element_type`` — on TPU that lands on the MXU's
+native 8-bit path, which is the actual speedup story (the reference needs
+MKLDNN/TensorRT engines for the same).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, apply
+from ..nn import Layer
+from ..nn import functional as F
+
+__all__ = ["fake_quant_dequant", "AbsMaxObserver", "MovingAverageAbsMaxObserver",
+           "QuantedLinear", "ImperativeQuantAware", "PostTrainingQuantization",
+           "quant_linear_int8"]
+
+
+# --------------------------------------------------------------------------
+# fake quant with STE
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _fqdq(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fqdq_fwd(x, scale, bits):
+    return _fqdq(x, scale, bits), None
+
+
+def _fqdq_bwd(bits, res, g):
+    return g, jnp.zeros(())  # straight-through estimator
+
+
+_fqdq.defvjp(_fqdq_fwd, _fqdq_bwd)
+
+
+def fake_quant_dequant(x, scale, bits: int = 8):
+    """Simulated quantize→dequantize with STE gradient (reference
+    fake_quantize_dequantize_abs_max)."""
+    return _fqdq(x, jnp.asarray(scale, jnp.float32), bits)
+
+
+class AbsMaxObserver:
+    """Running abs-max range observer (weights / PTQ activations)."""
+
+    def __init__(self):
+        self.scale = 0.0
+
+    def observe(self, x) -> float:
+        self.scale = max(self.scale, float(jnp.max(jnp.abs(x))))
+        return self.scale
+
+
+class MovingAverageAbsMaxObserver:
+    """EMA abs-max observer (reference moving_average_abs_max, rate 0.9)."""
+
+    def __init__(self, moving_rate: float = 0.9):
+        self.rate = moving_rate
+        self.scale = None
+
+    def observe(self, x) -> float:
+        cur = float(jnp.max(jnp.abs(x)))
+        self.scale = cur if self.scale is None else \
+            self.rate * self.scale + (1.0 - self.rate) * cur
+        return self.scale
+
+
+# --------------------------------------------------------------------------
+# QAT layer wrappers
+# --------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight + activation (reference
+    imperative/quant_layers QuantizedLinear).
+
+    The activation scale is a *buffer* updated in-graph (the BatchNorm
+    running-stat idiom), so the EMA keeps calibrating under jitted train
+    steps — a Python-side observer would bake its initial value into the
+    compiled executable as a constant.
+    """
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__()
+        self.inner = inner
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self._rate = moving_rate if \
+            activation_quantize_type == "moving_average_abs_max" else 0.0
+        self.register_buffer("act_scale", Tensor(jnp.zeros([], jnp.float32)))
+
+    def forward(self, x):
+        w = self.inner.weight
+        w_scale = jnp.max(jnp.abs(w._data)).astype(jnp.float32)
+        xd = getattr(x, "_data", x)
+        prev = self.act_scale._data
+        cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xd)).astype(jnp.float32))
+        if self.training:
+            if self._rate > 0.0:
+                new = jnp.where(prev == 0, cur,
+                                self._rate * prev + (1 - self._rate) * cur)
+            else:
+                new = jnp.maximum(prev, cur)  # abs_max observer
+            self.act_scale._data = new
+            act_scale = new
+        else:
+            act_scale = jnp.where(prev == 0, cur, prev)
+        xq = apply(lambda a, s: _fqdq(a, s, self.activation_bits),
+                   x, Tensor(act_scale))
+        wq = apply(lambda a: _fqdq(a, w_scale, self.weight_bits), w)
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class ImperativeQuantAware:
+    """QAT entry (reference imperative/qat.py:40): walks the model and
+    swaps quantizable layers for fake-quant wrappers in place."""
+
+    def __init__(self, quantizable_layer_type=("Linear",),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        self.types = tuple(quantizable_layer_type)
+        self.w_type = weight_quantize_type
+        self.a_type = activation_quantize_type
+        self.w_bits = weight_bits
+        self.a_bits = activation_bits
+        self.rate = moving_rate
+
+    def quantize(self, model: Layer) -> Layer:
+        for name, sub in list(model._sub_layers.items()):
+            if type(sub).__name__ in self.types:
+                model._sub_layers[name] = QuantedLinear(
+                    sub, self.w_bits, self.a_bits, self.rate,
+                    self.w_type, self.a_type)
+            else:
+                self.quantize(sub)
+        return model
+
+
+# --------------------------------------------------------------------------
+# int8 inference path
+# --------------------------------------------------------------------------
+
+def quant_linear_int8(x, w_int8, w_scale, bias=None):
+    """int8 GEMM: quantize activations per-tensor, contract int8×int8→int32
+    on the MXU, dequantize.  ``w_int8`` int8 (in, out); ``w_scale`` scalar."""
+    qmax = 127.0
+    x_scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-9)
+    xq = jnp.clip(jnp.round(x / x_scale * qmax), -qmax, qmax).astype(jnp.int8)
+    acc = jax.lax.dot_general(xq, w_int8, (((x.ndim - 1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (x_scale / qmax) * (w_scale / qmax)
+    if bias is not None:
+        out = out + bias
+    return out.astype(x.dtype)
+
+
+class _Int8Linear(Layer):
+    def __init__(self, w_int8, w_scale, bias):
+        super().__init__()
+        self.w_int8 = Tensor(w_int8)
+        self.w_scale = float(w_scale)
+        self.bias = bias
+
+    def forward(self, x):
+        b = None if self.bias is None else self.bias._data
+        return apply(lambda a: quant_linear_int8(
+            a, self.w_int8._data, jnp.asarray(self.w_scale, jnp.float32), b), x)
+
+
+class PostTrainingQuantization:
+    """PTQ (reference post_training_quantization.py): calibrate on sample
+    batches, then convert Linear layers to int8 weights + scales."""
+
+    def __init__(self, model: Layer, algo: str = "abs_max",
+                 quantizable_layer_type=("Linear",)):
+        self.model = model
+        self.algo = algo
+        self.types = tuple(quantizable_layer_type)
+        self._observers: Dict[int, AbsMaxObserver] = {}
+
+    def calibrate(self, data_loader, max_batches: Optional[int] = None):
+        """Run the float model over calibration batches (observers are only
+        needed for activation quant of future ops; weight scales are static)."""
+        self.model.eval()
+        for i, batch in enumerate(data_loader):
+            if max_batches is not None and i >= max_batches:
+                break
+            xs = batch if isinstance(batch, (list, tuple)) else [batch]
+            x = xs[0]
+            self.model(x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)))
+        return self
+
+    def convert(self) -> Layer:
+        self._convert_layer(self.model)
+        return self.model
+
+    def _convert_layer(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if type(sub).__name__ in self.types:
+                w = np.asarray(sub.weight._data, np.float32)
+                scale = max(float(np.max(np.abs(w))), 1e-9)
+                w_int8 = np.clip(np.round(w / scale * 127.0), -127, 127) \
+                    .astype(np.int8)
+                layer._sub_layers[name] = _Int8Linear(
+                    jnp.asarray(w_int8), scale, sub.bias)
+            else:
+                self._convert_layer(sub)

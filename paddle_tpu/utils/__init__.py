@@ -1,6 +1,7 @@
 """``paddle_tpu.utils`` (reference: python/paddle/utils/)."""
 
 from .. import profiler  # noqa: F401  (paddle.utils.profiler parity)
+from . import cpp_extension  # noqa: F401
 
 
 def try_import(name: str):

@@ -1,0 +1,112 @@
+"""C++ extension loader (reference: python/paddle/utils/cpp_extension —
+``load(name, sources)`` JIT-compiles user C++ into ops; ``setup`` builds a
+wheel).
+
+TPU-native split of responsibilities:
+
+- **Device kernels** never come from user C++ here — TPU kernels are Pallas
+  (see ops/custom.register_op); there is no user-facing Mosaic C++ ABI.
+- **Host-side ops** (CPU pre/post-processing, custom IO, legacy numeric
+  code) DO get the reference treatment: ``load`` compiles the sources with
+  g++ into a shared library (no pybind11 in this image — the ABI is plain
+  ``extern "C"``), and ``wrap_elementwise`` adapts an exported symbol into
+  a jax-compatible op via ``jax.pure_callback``, so it composes with jit
+  (as a host callback) and with the eager dispatch.
+
+Exported-symbol ABI for ``wrap_elementwise``::
+
+    extern "C" void <name>(const float* x, float* out, int64_t n);
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "CppExtensionError", "ExtensionModule"]
+
+_LOCK = threading.Lock()
+
+
+class CppExtensionError(RuntimeError):
+    pass
+
+
+class ExtensionModule:
+    """A loaded extension: raw ctypes handle + op-wrapping helpers."""
+
+    def __init__(self, name: str, lib_path: str):
+        self.name = name
+        self.lib_path = lib_path
+        self.lib = ctypes.CDLL(lib_path)
+
+    def symbol(self, fn_name: str):
+        try:
+            return getattr(self.lib, fn_name)
+        except AttributeError:
+            raise CppExtensionError(
+                f"{self.lib_path} exports no symbol {fn_name!r} (declare it "
+                f'extern "C")') from None
+
+    def wrap_elementwise(self, fn_name: str, dtype=np.float32) -> Callable:
+        """Wrap ``void f(const T*, T*, int64)`` as a jax-compatible op.
+
+        Returns a function on raw arrays usable eagerly, through
+        ops.register_op, or inside jit (lowered as a host pure_callback —
+        XLA moves the data host-side for this op, which is the honest cost
+        of running foreign CPU code in a TPU program).
+        """
+        import jax
+        cfun = self.symbol(fn_name)
+        cfun.restype = None
+        ctype = np.ctypeslib.ndpointer(dtype=dtype, flags="C_CONTIGUOUS")
+        cfun.argtypes = [ctype, ctype, ctypes.c_int64]
+
+        def host_op(x):
+            x = np.ascontiguousarray(np.asarray(x, dtype=dtype))
+            out = np.empty_like(x)
+            cfun(x.reshape(-1), out.reshape(-1), x.size)
+            return out
+
+        def op(x):
+            return jax.pure_callback(
+                host_op, jax.ShapeDtypeStruct(x.shape, dtype), x,
+                vmap_method="sequential")
+
+        op.__name__ = fn_name
+        return op
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_flags: Optional[list] = None,
+         build_directory: Optional[str] = None, verbose: bool = False,
+         **kwargs) -> ExtensionModule:
+    """Compile ``sources`` into lib<name>.so and load it (reference
+    cpp_extension.load signature; CUDA-specific kwargs are ignored)."""
+    sources = [os.path.abspath(s) for s in sources]
+    for s in sources:
+        if not os.path.exists(s):
+            raise CppExtensionError(f"source file not found: {s}")
+    build_dir = build_directory or os.path.join(
+        os.path.dirname(sources[0]), "_paddle_tpu_ext")
+    out = os.path.join(build_dir, f"lib{name}.so")
+    with _LOCK:
+        stale = (not os.path.exists(out) or any(
+            os.path.getmtime(out) < os.path.getmtime(s) for s in sources))
+        if stale:
+            os.makedirs(build_dir, exist_ok=True)
+            cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                   + (extra_cxx_flags or []) + sources
+                   + ["-o", out + ".tmp", "-lpthread"])
+            if verbose:
+                print("[cpp_extension]", " ".join(cmd))
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise CppExtensionError(
+                    f"compiling {name}:\n{' '.join(cmd)}\n{proc.stderr[-2000:]}")
+            os.replace(out + ".tmp", out)
+    return ExtensionModule(name, out)

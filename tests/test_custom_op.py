@@ -1,0 +1,130 @@
+"""Custom-op registration + C++ extension tests (SURVEY row 8 —
+framework/custom_operator.cc + utils/cpp_extension analogs)."""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import custom_op, get_op, list_ops, register_op
+
+
+class TestCustomOp:
+    def test_autodiff_op_eager_and_tape(self):
+        op = register_op("square_plus", lambda x, y: x * x + y)
+        a = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.array([1.0, 1.0], np.float32),
+                             stop_gradient=False)
+        out = op(a, b)
+        np.testing.assert_allclose(np.asarray(out._data), [5.0, 10.0])
+        out.backward(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(a.grad._data), [4.0, 6.0])
+        np.testing.assert_allclose(np.asarray(b.grad._data), [1.0, 1.0])
+        assert "square_plus" in list_ops()
+        assert get_op("square_plus") is op
+
+    def test_custom_backward_overrides_autodiff(self):
+        # forward x^2, but backward deliberately returns 10*dOut (not 2x*dOut)
+        op = register_op("weird_sq", lambda x: x * x,
+                         backward=lambda g, ins, outs: (g[0] * 10.0,))
+        x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        out = op(x)
+        out.backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), [10.0])
+
+    def test_reference_grad_convention_sees_inputs_and_outputs(self):
+        """backward receives (dOut, X, Out) — the GradOpMaker contract."""
+        seen = {}
+
+        def bwd(g, ins, outs):
+            seen["in"] = np.asarray(ins[0])
+            seen["out"] = np.asarray(outs[0])
+            return (g[0] * outs[0],)  # d/dx exp(x) = exp(x) = Out
+
+        op = register_op("myexp", jnp.exp, backward=bwd)
+        x = paddle.to_tensor(np.array([0.5], np.float32), stop_gradient=False)
+        out = op(x)
+        out.backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data), np.exp([0.5]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(seen["in"], [0.5])
+        np.testing.assert_allclose(seen["out"], np.exp([0.5]), rtol=1e-6)
+
+    def test_works_under_jit(self):
+        op = register_op("triple", lambda x: 3.0 * x,
+                         backward=lambda g, ins, outs: (3.0 * g[0],))
+        f = jax.jit(jax.grad(lambda x: jnp.sum(op._raw(x) ** 2)))
+        g = f(jnp.array([1.0, 2.0]))
+        np.testing.assert_allclose(np.asarray(g), [18.0, 36.0])
+
+    def test_decorator_and_pallas_kernel(self):
+        """A Pallas kernel registered as a custom op (the reference's
+        'compiled kernel' path, TPU-style)."""
+        from jax.experimental import pallas as pl
+
+        def scale_kernel(x_ref, o_ref, *, factor):
+            o_ref[:] = x_ref[:] * factor
+
+        @custom_op(name="pallas_scale")
+        def pallas_scale(x):
+            import functools
+            return pl.pallas_call(
+                functools.partial(scale_kernel, factor=2.5),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                interpret=jax.default_backend() != "tpu",
+            )(x)
+
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8))
+        out = pallas_scale(x)
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.arange(8, dtype=np.float32)[None] * 2.5)
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(KeyError, match="no custom op"):
+            get_op("nope_never_registered")
+
+
+class TestCppExtension:
+    def test_load_compile_and_run(self, tmp_path):
+        src = tmp_path / "myops.cpp"
+        src.write_text(textwrap.dedent("""
+            #include <cstdint>
+            extern "C" void twice_plus_one(const float* x, float* out,
+                                           int64_t n) {
+                for (int64_t i = 0; i < n; ++i) out[i] = x[i] * 2.0f + 1.0f;
+            }
+        """))
+        from paddle_tpu.utils import cpp_extension
+        ext = cpp_extension.load("myops", [str(src)],
+                                 build_directory=str(tmp_path / "build"))
+        op = ext.wrap_elementwise("twice_plus_one")
+        x = jnp.asarray(np.arange(6, dtype=np.float32))
+        out = op(x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.arange(6, dtype=np.float32) * 2 + 1)
+        # inside jit: lowered as a host callback
+        jout = jax.jit(op)(x)
+        np.testing.assert_allclose(np.asarray(jout), np.asarray(out))
+        # and through the custom-op registry on Tensors
+        reg = register_op("twice_plus_one", op)
+        t = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(np.asarray(reg(t)._data), [3.0, 3.0, 3.0])
+
+    def test_missing_symbol_raises(self, tmp_path):
+        src = tmp_path / "empty.cpp"
+        src.write_text('extern "C" void something(const float* a, float* b, '
+                       'long long n) {}')
+        from paddle_tpu.utils import cpp_extension
+        ext = cpp_extension.load("empty", [str(src)],
+                                 build_directory=str(tmp_path / "build"))
+        with pytest.raises(cpp_extension.CppExtensionError, match="symbol"):
+            ext.wrap_elementwise("not_there")
+
+    def test_missing_source_raises(self):
+        from paddle_tpu.utils import cpp_extension
+        with pytest.raises(cpp_extension.CppExtensionError, match="not found"):
+            cpp_extension.load("x", ["/does/not/exist.cpp"])
