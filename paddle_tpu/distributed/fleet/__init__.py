@@ -15,27 +15,28 @@ from ..topology import (CommunicateTopology, HybridCommunicateGroup,
                         get_hybrid_communicate_group,
                         set_hybrid_communicate_group)
 from .base.distributed_strategy import DistributedStrategy
+from .base.role_maker import (PaddleCloudRoleMaker, Role, RoleMakerBase,  # noqa: F401
+                              UserDefinedRoleMaker)
 from . import meta_parallel  # noqa: F401
 from .meta_parallel import (DataParallel, PipelineParallel, ShardingParallel,  # noqa: F401
                             TensorParallel)
 
 
-class _RoleMaker:
-    """Reference: fleet/base/role_maker.py PaddleCloudRoleMaker:515."""
+class _RoleMaker(PaddleCloudRoleMaker):
+    """Default role maker: PaddleCloud env contract, with jax process info
+    as the fallback when no scheduler env is present (collective/worker
+    path only — PS-mode server identity from super() is kept as computed)."""
 
-    def __init__(self, is_collective=True):
-        self._is_collective = is_collective
-
-    def _worker_num(self):
-        from .. import env
-        return env.get_world_size()
-
-    def _worker_index(self):
-        from .. import env
-        return env.get_rank()
-
-    def _is_worker(self):
-        return True
+    def _generate_role(self):
+        super()._generate_role()
+        import os
+        if self._role == Role.WORKER and \
+                "PADDLE_TRAINER_ENDPOINTS" not in os.environ and \
+                "PADDLE_TRAINERS_NUM" not in os.environ:
+            from .. import env
+            self._worker_endpoints = [f"process:{i}"
+                                      for i in range(env.get_world_size())]
+            self._current_id = env.get_rank()
 
 
 class Fleet:
@@ -132,5 +133,3 @@ get_hybrid_communicate_group = lambda: fleet._hcg or get_hybrid_communicate_grou
 worker_num = fleet.worker_num
 worker_index = fleet.worker_index
 
-PaddleCloudRoleMaker = _RoleMaker
-UserDefinedRoleMaker = _RoleMaker

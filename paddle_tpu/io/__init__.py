@@ -641,3 +641,6 @@ class DataLoader:
 
     def __call__(self):
         return self.__iter__()
+
+
+from .dataset import DatasetBase, InMemoryDataset, QueueDataset  # noqa: F401,E402

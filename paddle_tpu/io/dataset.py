@@ -1,0 +1,201 @@
+"""File-fed datasets + the dataset trainer loop (reference: the
+Trainer/DeviceWorker stack — fluid/dataset.py InMemoryDataset/QueueDataset,
+trainer_desc.py, device_worker.py; driven by
+``Executor.train_from_dataset`` (fluid/executor.py:1629)).
+
+The reference pumps example files through pipe commands into per-thread
+DeviceWorkers that each run the program on their feed slice.  TPU-native
+shape of the same capability: files are parsed on background threads into
+host batches, double-buffered onto the device, and ONE jitted train step
+consumes them — thread-parallel *IO*, SPMD *compute* (the reference's
+N device-worker threads collapse into the XLA program per SURVEY §7).
+
+File format: one example per line.  The default parser reads
+whitespace-separated floats with the LAST column as an int label; pass
+``parse_fn(line) -> tuple(np.ndarray, ...)`` for anything else (the
+reference's pipe_command equivalent — a parsing hook, minus the subprocess).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+
+def _default_parse(line: str):
+    vals = line.split()
+    if not vals:
+        return None
+    feats = np.asarray([float(v) for v in vals[:-1]], np.float32)
+    label = np.int64(int(float(vals[-1])))
+    return feats, label
+
+
+class DatasetBase:
+    """Common config surface (reference fluid/dataset.py DatasetBase)."""
+
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._parse_fn: Callable = _default_parse
+        self._use_var_names: List[str] = []
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = max(1, int(thread_num))
+
+    def set_use_var(self, var_list):
+        self._use_var_names = [getattr(v, "name", str(v)) for v in var_list]
+
+    def set_pipe_command(self, pipe_command):
+        """The reference shells out to ``pipe_command`` per file; here the
+        parsing hook is a python callable — pass it via ``set_parse_fn``."""
+        raise NotImplementedError(
+            "pipe subprocess commands are not supported; use "
+            "set_parse_fn(callable) for custom line parsing")
+
+    def set_parse_fn(self, fn: Callable):
+        self._parse_fn = fn
+
+    # -- iteration ---------------------------------------------------------
+    def _example_stream(self):
+        for path in self._filelist:
+            with open(path) as f:
+                for line in f:
+                    ex = self._parse_fn(line.rstrip("\n"))
+                    if ex is not None:
+                        yield ex
+
+    def _batches_from(self, examples):
+        buf = []
+        for ex in examples:
+            buf.append(ex)
+            if len(buf) == self._batch_size:
+                yield self._collate(buf)
+                buf = []
+        if buf:
+            yield self._collate(buf)
+
+    @staticmethod
+    def _collate(buf):
+        # reuse the DataLoader's collate (handles ndarray/Tensor/tuple/dict
+        # recursively, numpy output); tuple-ify the top level for unpacking
+        # into the trainer-loop program(*batch)
+        from . import default_collate_fn
+        out = default_collate_fn(buf)
+        return tuple(out) if isinstance(out, list) else out
+
+
+class InMemoryDataset(DatasetBase):
+    """Load → (shuffle) → iterate from memory (reference InMemoryDataset:
+    load_into_memory / local_shuffle / global_shuffle / release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._examples: Optional[list] = None
+
+    def load_into_memory(self):
+        # thread-parallel file parsing (the reference's per-thread channels)
+        if len(self._filelist) <= 1 or self._thread_num == 1:
+            self._examples = list(self._example_stream())
+            return
+        out_lock = threading.Lock()
+        examples: List = []
+        errors: List[BaseException] = []
+        files = queue.Queue()
+        for p in self._filelist:
+            files.put(p)
+
+        def worker():
+            while True:
+                try:
+                    path = files.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    local = []
+                    with open(path) as f:
+                        for line in f:
+                            ex = self._parse_fn(line.rstrip("\n"))
+                            if ex is not None:
+                                local.append(ex)
+                    with out_lock:
+                        examples.extend(local)
+                except BaseException as e:  # propagate to the caller
+                    with out_lock:
+                        errors.append(e)
+                    return
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._thread_num)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        self._examples = examples
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        if self._examples is None:
+            raise RuntimeError("call load_into_memory() before local_shuffle()")
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._examples)
+
+    def global_shuffle(self, fleet=None, thread_num=None):
+        """Single-host build: global == local shuffle (the reference ships
+        examples between trainers; with SPMD data sharding each host draws
+        from the same shuffled order)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._examples = None
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._examples or [])
+
+    def __iter__(self):
+        if self._examples is None:
+            raise RuntimeError("call load_into_memory() first")
+        return self._batches_from(iter(self._examples))
+
+
+class QueueDataset(DatasetBase):
+    """Streaming dataset: batches come straight off the file readers with a
+    bounded prefetch queue (reference QueueDataset's channel semantics)."""
+
+    def __init__(self, capacity: int = 64):
+        super().__init__()
+        self._capacity = capacity
+
+    def __iter__(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        DONE = object()
+
+        def producer():
+            try:
+                for b in self._batches_from(self._example_stream()):
+                    q.put(b)
+                q.put(DONE)
+            except BaseException as e:  # surface reader errors, don't EOF
+                q.put(e)
+
+        threading.Thread(target=producer, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item

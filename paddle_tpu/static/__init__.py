@@ -177,6 +177,44 @@ class Executor:
             return [np.asarray(getattr(f, "_data", f)) for f in fetch_list]
         return []
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Dataset trainer loop (reference fluid/executor.py:1629 — the
+        Trainer/DeviceWorker entry).  ``program`` is the per-batch train
+        callable ``step(*batch) -> loss`` (the jitted train step built by
+        jit.make_train_step or any callable); ``dataset`` an
+        io.InMemoryDataset/QueueDataset.  Parsing threads come from the
+        dataset's ``set_thread``; compute is the single SPMD program.
+        Returns the list of per-batch losses.
+        """
+        if dataset is None or program is None:
+            raise ValueError("train_from_dataset needs program= and dataset=")
+        if thread:
+            dataset.set_thread(thread)
+        losses = []
+        for i, batch in enumerate(dataset):
+            out = program(*batch)
+            loss = out[0] if isinstance(out, (list, tuple)) else out
+            val = float(np.asarray(getattr(loss, "_data", loss)))
+            losses.append(val)
+            if debug and print_period and i % print_period == 0:
+                print(f"[train_from_dataset] batch {i} loss {val:.6f}")
+        return losses
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset: collects program outputs."""
+        if dataset is None or program is None:
+            raise ValueError("infer_from_dataset needs program= and dataset=")
+        outs = []
+        for batch in dataset:
+            out = program(*batch)
+            first = out[0] if isinstance(out, (list, tuple)) else out
+            outs.append(np.asarray(getattr(first, "_data", first)))
+        return outs
+
 
 def save(program, model_path: str, protocol=4):
     from ..framework import io as _io

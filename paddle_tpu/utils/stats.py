@@ -1,0 +1,121 @@
+"""Runtime stats registry (reference: paddle/fluid/platform/monitor.h
+StatRegistry + the STAT_ADD/STAT_INT_ADD macros, surfaced in python via
+paddle.fluid.core.get_int_stats).
+
+Named monotonic/gauge counters that any subsystem can bump cheaply, plus an
+op-summary view joining the profiler's RecordEvent timings.  TPU-native
+notes: device-side numbers (memory in use, per-op time) come from XLA/JAX
+introspection rather than a CUDA allocator hook — ``device_memory_stats``
+reads ``jax.local_devices()[i].memory_stats()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["StatRegistry", "stat_registry", "stat_add", "stat_sub",
+           "get_stat", "get_all_stats", "device_memory_stats", "op_summary"]
+
+
+class _Stat:
+    __slots__ = ("name", "value", "lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self.lock = threading.Lock()
+
+    def increase(self, v):
+        with self.lock:
+            self.value += v
+
+    def decrease(self, v):
+        with self.lock:
+            self.value -= v
+
+    def reset(self):
+        with self.lock:
+            self.value = 0
+
+
+class StatRegistry:
+    """Process-wide named counters (reference monitor.h:77)."""
+
+    def __init__(self):
+        self._stats: Dict[str, _Stat] = {}
+        self._lock = threading.Lock()
+
+    def get(self, name: str) -> _Stat:
+        with self._lock:
+            if name not in self._stats:
+                self._stats[name] = _Stat(name)
+            return self._stats[name]
+
+    def add(self, name: str, value=1):
+        self.get(name).increase(value)
+
+    def sub(self, name: str, value=1):
+        self.get(name).decrease(value)
+
+    def value(self, name: str):
+        return self.get(name).value
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {n: s.value for n, s in sorted(self._stats.items())}
+
+    def reset(self, name: Optional[str] = None):
+        if name is not None:
+            self.get(name).reset()  # auto-create like get()/value()
+            return
+        with self._lock:
+            targets = list(self._stats.values())
+        for s in targets:
+            s.reset()
+
+
+_registry = StatRegistry()
+
+
+def stat_registry() -> StatRegistry:
+    return _registry
+
+
+def stat_add(name: str, value=1):
+    """STAT_ADD macro analog."""
+    _registry.add(name, value)
+
+
+def stat_sub(name: str, value=1):
+    _registry.sub(name, value)
+
+
+def get_stat(name: str):
+    return _registry.value(name)
+
+
+def get_all_stats() -> Dict[str, float]:
+    return _registry.snapshot()
+
+
+def device_memory_stats(device_index: int = 0) -> Dict[str, int]:
+    """Per-device allocator stats from the PJRT client (≙ the reference's
+    STAT_gpu0_mem_size family fed by the CUDA allocator)."""
+    import jax
+    devs = jax.local_devices()
+    if device_index >= len(devs):
+        return {}
+    stats = devs[device_index].memory_stats() or {}
+    return {k: int(v) for k, v in stats.items()}
+
+
+def op_summary(top: int = 20) -> List[Tuple[str, int, float]]:
+    """(name, calls, total seconds) rows from the profiler's RecordEvent
+    aggregation (profiler._events), sorted by total time — the op-summary
+    table view of the reference's profiler output."""
+    from .. import profiler
+    rows = [(n, int(c), float(t)) for n, (c, t) in profiler._events.items()]
+    rows.sort(key=lambda r: -r[2])
+    return rows[:top]
