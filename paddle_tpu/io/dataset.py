@@ -110,17 +110,19 @@ class InMemoryDataset(DatasetBase):
         if len(self._filelist) <= 1 or self._thread_num == 1:
             self._examples = list(self._example_stream())
             return
-        out_lock = threading.Lock()
-        examples: List = []
+        # per-file result slots keep example order == filelist order no
+        # matter which thread finishes first (deterministic seeded shuffles)
+        slots: List = [None] * len(self._filelist)
         errors: List[BaseException] = []
+        err_lock = threading.Lock()
         files = queue.Queue()
-        for p in self._filelist:
-            files.put(p)
+        for i, p in enumerate(self._filelist):
+            files.put((i, p))
 
         def worker():
             while True:
                 try:
-                    path = files.get_nowait()
+                    i, path = files.get_nowait()
                 except queue.Empty:
                     return
                 try:
@@ -130,10 +132,9 @@ class InMemoryDataset(DatasetBase):
                             ex = self._parse_fn(line.rstrip("\n"))
                             if ex is not None:
                                 local.append(ex)
-                    with out_lock:
-                        examples.extend(local)
+                    slots[i] = local
                 except BaseException as e:  # propagate to the caller
-                    with out_lock:
+                    with err_lock:
                         errors.append(e)
                     return
 
@@ -145,7 +146,7 @@ class InMemoryDataset(DatasetBase):
             t.join()
         if errors:
             raise errors[0]
-        self._examples = examples
+        self._examples = [ex for local in slots for ex in (local or [])]
 
     def local_shuffle(self, seed: Optional[int] = None):
         if self._examples is None:
@@ -182,20 +183,37 @@ class QueueDataset(DatasetBase):
     def __iter__(self):
         q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
         DONE = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer abandoned the
+            # iterator (early break) — otherwise the thread + open file
+            # handle would leak, blocked on a full queue forever
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for b in self._batches_from(self._example_stream()):
-                    q.put(b)
-                q.put(DONE)
+                    if not put(b):
+                        return
+                put(DONE)
             except BaseException as e:  # surface reader errors, don't EOF
-                q.put(e)
+                put(e)
 
         threading.Thread(target=producer, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is DONE:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()

@@ -130,6 +130,15 @@ class Recall(Metric):
 
 
 class Auc(Metric):
+    """ROC-AUC via threshold-bucket histograms (reference metric: auc_op.h).
+
+    Note: like the reference kernel, this is the *histogram approximation* —
+    scores are bucketed into ``num_thresholds`` bins and the trapezoid rule
+    runs over bin boundaries, so ties within a bin are averaged.  With the
+    default 4095 thresholds the deviation from exact rank-based AUC is
+    < 1/4095; raise ``num_thresholds`` for more resolution.
+    """
+
     def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
         super().__init__()
         self.num_thresholds = num_thresholds

@@ -192,15 +192,18 @@ class Executor:
             raise ValueError("train_from_dataset needs program= and dataset=")
         if thread:
             dataset.set_thread(thread)
-        losses = []
+        device_losses = []
         for i, batch in enumerate(dataset):
             out = program(*batch)
             loss = out[0] if isinstance(out, (list, tuple)) else out
-            val = float(np.asarray(getattr(loss, "_data", loss)))
-            losses.append(val)
+            # keep the DEVICE scalar: a per-batch float() would sync every
+            # step and serialize host IO with device compute; only the
+            # debug print (at print_period cadence) pays a sync
+            device_losses.append(getattr(loss, "_data", loss))
             if debug and print_period and i % print_period == 0:
-                print(f"[train_from_dataset] batch {i} loss {val:.6f}")
-        return losses
+                print(f"[train_from_dataset] batch {i} loss "
+                      f"{float(np.asarray(device_losses[-1])):.6f}")
+        return [float(np.asarray(l)) for l in device_losses]
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,

@@ -210,3 +210,52 @@ class TestReviewRegressions:
         outs = exe.infer_from_dataset(
             program=lambda x, y: (x * 2.0, y), dataset=ds)
         assert len(outs) == 2 and outs[0].shape == (4, 4)
+
+
+class TestSecondReviewRegressions:
+    def test_threaded_load_is_deterministic(self, tmp_path):
+        files = _write_files(tmp_path, n_files=4, rows=20)
+        def load():
+            ds = paddle.io.InMemoryDataset()
+            ds.set_filelist(files)
+            ds.set_batch_size(8)
+            ds.set_thread(3)
+            ds.load_into_memory()
+            ds.local_shuffle(seed=7)
+            return [b[0] for b in ds]
+        a, b = load(), load()
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def test_queue_dataset_early_break_does_not_leak(self, tmp_path):
+        import threading
+        files = _write_files(tmp_path, n_files=1, rows=200)
+        before = threading.active_count()
+        for _ in range(5):
+            ds = paddle.io.QueueDataset(capacity=2)
+            ds.set_filelist(files)
+            ds.set_batch_size(4)
+            for batch in ds:
+                break  # abandon with the producer mid-stream
+        import time
+        time.sleep(0.5)  # let producers notice the stop flag
+        assert threading.active_count() <= before + 1
+
+    def test_ps_trainer_world_size(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.base.role_maker import \
+            PaddleCloudRoleMaker
+        monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.delenv("PADDLE_TRAINER_ENDPOINTS", raising=False)
+        rm = PaddleCloudRoleMaker(is_collective=False)
+        assert rm.worker_num() == 4
+
+    def test_ps_server_unmatched_endpoint_raises(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.base.role_maker import \
+            PaddleCloudRoleMaker
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", "10.0.0.9:8000")
+        monkeypatch.setenv("POD_IP", "10.9.9.9")
+        monkeypatch.setenv("PADDLE_PORT", "8000")
+        with pytest.raises(ValueError, match="not in"):
+            PaddleCloudRoleMaker(is_collective=False).is_server()
