@@ -1,0 +1,20 @@
+"""Shared building blocks for the vision model zoo."""
+
+from __future__ import annotations
+
+from ... import nn
+
+
+class ConvBNReLU(nn.Layer):
+    """conv → batch-norm → relu, the stem block every inception-family model
+    repeats (single definition so BN hyperparams stay in sync)."""
+
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride, padding=padding,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))

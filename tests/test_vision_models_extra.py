@@ -1,0 +1,78 @@
+"""New vision model families (reference vision/models parity: densenet,
+googlenet, inceptionv3, shufflenetv2, squeezenet)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _x(size, B=2):
+    return paddle.to_tensor(
+        np.random.RandomState(0).standard_normal((B, 3, size, size))
+        .astype(np.float32))
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: models.densenet121(num_classes=10), 64),
+    (lambda: models.squeezenet1_1(num_classes=10), 64),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
+    (lambda: models.googlenet(num_classes=10), 64),
+    (lambda: models.inception_v3(num_classes=10), 80),
+])
+def test_forward_shapes(ctor, size):
+    paddle.seed(0)
+    m = ctor()
+    m.eval()
+    out = m(_x(size))
+    assert tuple(out.shape) == (2, 10), out.shape
+
+
+def test_googlenet_train_mode_aux_heads():
+    paddle.seed(0)
+    m = models.googlenet(num_classes=10)
+    m.train()
+    main, a1, a2 = m(_x(64))
+    assert tuple(main.shape) == (2, 10)
+    assert tuple(a1.shape) == (2, 10) and tuple(a2.shape) == (2, 10)
+
+
+def test_shufflenet_trains():
+    import paddle_tpu.nn as nn
+    paddle.seed(0)
+    m = models.shufflenet_v2_x0_25(num_classes=4)
+    opt = paddle.optimizer.SGD(0.02, parameters=m.parameters())
+    x = _x(32, B=4)
+    y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    losses = []
+    for _ in range(10):
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert min(losses[3:]) < losses[0], losses
+
+
+def test_pretrained_raises():
+    with pytest.raises(ValueError, match="pretrained"):
+        models.densenet121(pretrained=True)
+
+
+def test_squeezenet_feature_extractor_modes():
+    paddle.seed(0)
+    feats = models.SqueezeNet("1.1", num_classes=0, with_pool=False)
+    out = feats(_x(64))
+    assert len(out.shape) == 4 and out.shape[1] == 512  # raw feature map
+    pooled = models.SqueezeNet("1.1", num_classes=0, with_pool=True)
+    out2 = pooled(_x(64))
+    assert tuple(out2.shape)[:2] == (2, 512)
+
+
+def test_shufflenet_int_scale_and_bad_scale():
+    m = models.ShuffleNetV2(scale=1, num_classes=0)  # int normalizes to "1.0"
+    assert m is not None
+    with pytest.raises(ValueError, match="unsupported scale"):
+        models.ShuffleNetV2(scale=0.7)
