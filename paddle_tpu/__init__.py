@@ -68,7 +68,7 @@ _LAZY = ("nn", "optimizer", "amp", "metric", "io", "vision", "distributed", "jit
          "static", "hapi", "ops", "models", "distribution", "profiler", "text",
          "incubate", "utils", "autograd", "regularizer", "callbacks", "linalg", "fft",
          "signal", "sparse", "onnx", "device", "framework", "inference",
-         "quantization")
+         "quantization", "compat", "sysconfig", "hub", "reader", "dataset")
 
 
 def __getattr__(name):
@@ -77,6 +77,12 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    alias = _TOP_ALIASES.get(name)
+    if alias is not None:
+        import importlib
+        obj = getattr(importlib.import_module(alias[0], __name__), alias[1])
+        globals()[name] = obj
+        return obj
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 
@@ -113,3 +119,118 @@ def disable_static():
 def in_dynamic_mode():
     from . import static as _static
     return not _static._enabled()
+
+
+def in_dygraph_mode():
+    """Legacy alias (reference fluid.framework.in_dygraph_mode)."""
+    return in_dynamic_mode()
+
+
+enable_dygraph = disable_static
+disable_dygraph = enable_static
+
+
+# ------------------------------------------------------------------ places
+def CPUPlace():
+    from .core.device import Place
+    return Place("cpu")
+
+
+def TPUPlace(dev_id: int = 0):
+    from .core.device import Place
+    return Place(f"tpu:{dev_id}")
+
+
+def CUDAPlace(dev_id: int = 0):
+    raise RuntimeError(
+        "paddle_tpu has no CUDA devices; use paddle.TPUPlace()/CPUPlace() or "
+        "paddle.set_device('tpu')")
+
+
+def CUDAPinnedPlace():
+    raise RuntimeError("paddle_tpu has no CUDA pinned memory; host numpy "
+                       "arrays transfer via device_put")
+
+
+def NPUPlace(dev_id: int = 0):
+    raise RuntimeError("paddle_tpu is not compiled with NPU support")
+
+
+def XPUPlace(dev_id: int = 0):
+    raise RuntimeError("paddle_tpu is not compiled with XPU support")
+
+
+# ------------------------------------------------- legacy/top-level aliases
+def get_cudnn_version():
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def get_cuda_rng_state():
+    """No CUDA generators in this build; returns [] (shape-compatible with
+    the reference's per-device state list)."""
+    return []
+
+
+def set_cuda_rng_state(state_list):
+    if state_list:
+        raise ValueError("no CUDA generators exist in a TPU build")
+
+
+def disable_signal_handler():
+    """The reference unhooks its C++ signal handlers; none are installed
+    here, so this is a documented no-op."""
+
+
+def monkey_patch_math_varbase():
+    """Tensor operator methods are installed at class definition in this
+    framework; retained as a no-op for API parity."""
+
+
+def monkey_patch_variable():
+    """See monkey_patch_math_varbase."""
+
+
+def tolist(x):
+    return x.tolist() if hasattr(x, "tolist") else list(x)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    from .tensor import crop
+    return crop(x, shape=shape, offsets=offsets)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from .static import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# binds the NAME to the function after the submodule import, so
+# ``paddle.batch`` is the callable (the submodule stays importable as
+# ``paddle_tpu.batch`` via sys.modules)
+from .batch import batch  # noqa: E402,F401
+
+# name → (module, attr) resolved on first access through __getattr__
+import numpy as _np  # noqa: E402
+
+dtype = _np.dtype  # paddle.dtype: dtype objects are numpy/jnp dtypes here
+
+_TOP_ALIASES = {
+    "Model": (".hapi", "Model"),
+    "DataParallel": (".distributed", "DataParallel"),
+    "ParamAttr": (".framework.param_attr", "ParamAttr"),
+    "VarBase": (".core.tensor", "Tensor"),   # legacy dygraph tensor name
+}

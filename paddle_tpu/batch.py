@@ -1,0 +1,24 @@
+"""Reader batching decorator (reference: python/paddle/batch.py)."""
+
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap an example-reader callable into a batch-reader callable."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size should be a positive integer, "
+                         f"got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,52 @@
+"""Py2/3 compatibility shims (reference: python/paddle/compat.py).  Python 3
+only here, so these are thin canonicalizers kept for API parity."""
+
+from __future__ import annotations
+
+__all__ = ["to_text", "to_bytes", "long_type", "floor_division",
+           "get_exception_message", "round"]
+
+long_type = int
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    if obj is None:
+        return None
+    if isinstance(obj, (bytes, bytearray)):
+        return bytes(obj).decode(encoding)
+    if isinstance(obj, list):
+        return [to_text(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_text(o, encoding) for o in obj}
+    if isinstance(obj, dict):
+        return {to_text(k, encoding): to_text(v, encoding)
+                for k, v in obj.items()}
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    if obj is None:
+        return None
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, list):
+        return [to_bytes(o, encoding) for o in obj]
+    if isinstance(obj, set):
+        return {to_bytes(o, encoding) for o in obj}
+    if isinstance(obj, dict):
+        return {to_bytes(k, encoding): to_bytes(v, encoding)
+                for k, v in obj.items()}
+    return bytes(obj)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)
+
+
+def round(x, d=0):
+    import builtins
+    return builtins.round(x, d)

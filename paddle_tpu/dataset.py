@@ -1,0 +1,11 @@
+"""Legacy ``paddle.dataset`` namespace (reference: python/paddle/dataset/ —
+mnist/cifar/imdb/uci_housing/... reader-creator modules).  The modern
+map-style classes live in vision.datasets and text; this module re-exports
+them under the legacy names so ``paddle.dataset.<name>`` code resolves."""
+
+from .text import (Conll05st, Imdb, Imikolov, Movielens, UCIHousing,  # noqa: F401
+                   WMT14, WMT16)
+from .vision.datasets import MNIST, Cifar10, Cifar100  # noqa: F401
+
+__all__ = ["MNIST", "Cifar10", "Cifar100", "Imdb", "Imikolov", "UCIHousing",
+           "Conll05st", "Movielens", "WMT14", "WMT16"]

@@ -1,0 +1,62 @@
+"""Model hub (reference: python/paddle/hub.py — list/help/load over a
+hubconf.py).  Zero-egress build: only ``source="local"`` works; github/gitee
+sources raise with a pointer to a local checkout.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found under {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str, repo_dir: str) -> str:
+    if source != "local":
+        raise ValueError(
+            f"source={source!r} needs network access, which this zero-egress "
+            f"build does not have; clone the repo and use source='local'")
+    return repo_dir
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:
+    """Entrypoint names exported by the repo's hubconf (reference hub.list)."""
+    mod = _load_hubconf(_check_source(source, repo_dir))
+    return sorted(n for n in dir(mod)
+                  if callable(getattr(mod, n)) and not n.startswith("_"))
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:
+    mod = _load_hubconf(_check_source(source, repo_dir))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}; "
+                         f"available: {list(repo_dir)}")
+    return fn.__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    mod = _load_hubconf(_check_source(source, repo_dir))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"hubconf has no entrypoint {model!r}; "
+                         f"available: {list(repo_dir)}")
+    return fn(**kwargs)

@@ -1,0 +1,156 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+map_readers/shuffle/chain/compose/buffered/cache...).  These operate on
+"reader creators": zero-arg callables returning iterators."""
+
+from __future__ import annotations
+
+import queue
+import random as _random
+import threading
+from typing import Callable
+
+__all__ = ["map_readers", "shuffle", "chain", "compose", "buffered",
+           "cache", "firstn", "xmap_readers"]
+
+
+def map_readers(func: Callable, *readers):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def chained():
+        for r in readers:
+            yield from r()
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    def composed():
+        its = [r() for r in readers]
+        while True:
+            outs = []
+            stopped = 0
+            for it in its:
+                try:
+                    outs.append(next(it))
+                except StopIteration:
+                    stopped += 1
+            if stopped:
+                if check_alignment and 0 < stopped < len(its):
+                    raise RuntimeError("readers have different lengths")
+                return
+            yield tuple(o if isinstance(o, tuple) else (o,) for o in outs) \
+                if len(outs) > 1 else outs[0]
+    return composed
+
+
+def buffered(reader, size: int):
+    """Prefetch up to ``size`` items on a background thread."""
+    END = object()
+
+    def buffered_reader():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for e in reader():
+                    q.put(e)
+            finally:
+                q.put(END)
+
+        threading.Thread(target=fill, daemon=True).start()
+        while True:
+            e = q.get()
+            if e is END:
+                return
+            yield e
+
+    return buffered_reader
+
+
+def cache(reader):
+    data = []
+    filled = [False]
+
+    def cached():
+        if not filled[0]:
+            data.extend(reader())
+            filled[0] = True
+        yield from data
+    return cached
+
+
+def firstn(reader, n: int):
+    def firstn_reader():
+        for i, e in enumerate(reader()):
+            if i >= n:
+                return
+            yield e
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Thread-parallel map over a reader (reference xmap_readers)."""
+    END = object()
+
+    def xreader():
+        in_q: "queue.Queue" = queue.Queue(buffer_size)
+        out_q: "queue.Queue" = queue.Queue(buffer_size)
+
+        def feed():
+            for i, e in enumerate(reader()):
+                in_q.put((i, e))
+            for _ in range(process_num):
+                in_q.put(END)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is END:
+                    out_q.put(END)
+                    return
+                i, e = item
+                out_q.put((i, mapper(e)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+        done = 0
+        pending = {}
+        next_i = 0
+        while done < process_num:
+            item = out_q.get()
+            if item is END:
+                done += 1
+                continue
+            i, e = item
+            if not order:
+                yield e
+            else:
+                pending[i] = e
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+        if order:
+            for i in sorted(pending):
+                yield pending[i]
+
+    return xreader

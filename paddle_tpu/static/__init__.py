@@ -74,6 +74,21 @@ class Program:
 _main_program = Program()
 _startup_program = Program()
 _program_stack: List[Program] = []
+_static_mode = False
+
+
+def _enable():
+    global _static_mode
+    _static_mode = True
+
+
+def _disable():
+    global _static_mode
+    _static_mode = False
+
+
+def _enabled() -> bool:
+    return _static_mode
 
 
 def default_main_program() -> Program:

@@ -1,0 +1,20 @@
+"""Build/config introspection (reference: python/paddle/sysconfig.py)."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+
+def get_include() -> str:
+    """Directory of C headers (the reference returns its bundled
+    paddle/include; this build's native surface is the csrc tree)."""
+    import paddle_tpu
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "csrc")
+
+
+def get_lib() -> str:
+    """Directory of compiled shared libraries."""
+    import paddle_tpu
+    return os.path.join(os.path.dirname(paddle_tpu.__file__), "csrc", "_build")

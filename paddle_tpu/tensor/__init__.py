@@ -17,7 +17,7 @@ from .einsum import einsum  # noqa: F401
 from .linalg import (bincount, cholesky, cholesky_solve, cond, corrcoef, cov, cross, det,  # noqa: F401
                      eig, eigh, eigvals, eigvalsh, histogram, inv, lstsq, lu, matrix_power,
                      matrix_rank, matrix_transpose, multi_dot, norm, pinv, qr, slogdet,
-                     solve, svd, triangular_solve)
+                     solve, svd, triangular_solve, diagonal, inverse)
 from .logic import (bitwise_and, bitwise_left_shift, bitwise_not, bitwise_or,  # noqa: F401
                     bitwise_right_shift, bitwise_xor, equal, greater_equal, greater_than,
                     is_empty, is_tensor, less_equal, less_than, logical_and, logical_not,
@@ -31,7 +31,8 @@ from .manipulation import (as_complex, as_real, atleast_1d, atleast_2d, atleast_
                            scatter_, scatter_nd, scatter_nd_add, shard_index, slice,
                            split, squeeze, stack, strided_slice, swapaxes,
                            take_along_axis, tensordot, tile, transpose, unique,
-                           unique_consecutive, unsqueeze, unstack, view, view_as)
+                           unique_consecutive, unsqueeze, unsqueeze_, unstack,
+                           squeeze_, t, view, view_as)
 from .math import *  # noqa: F401,F403
 from .math import _mod as _math_mod  # noqa: F401
 from .random import (bernoulli, bernoulli_, binomial, exponential_, gaussian,  # noqa: F401
@@ -146,3 +147,32 @@ def _patch():
 
 
 _patch()
+from .random import check_shape  # noqa: F401,E402
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Printing options for Tensor repr (reference tensor/to_string.py:34) —
+    forwarded to numpy, which renders our reprs.  Note this adjusts numpy's
+    process-global print state (our Tensor repr IS numpy's)."""
+    import numpy as _np
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = int(precision)
+    if threshold is not None:
+        kwargs["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kwargs["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kwargs["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        if sci_mode:
+            # numpy's suppress=False merely ALLOWS scientific notation; a
+            # formatter is needed to force it (the reference's sci_mode=True)
+            prec = int(precision) if precision is not None else 8
+            kwargs["formatter"] = {
+                "float_kind": lambda v, p=prec: f"{v:.{p}e}"}
+        else:
+            kwargs["suppress"] = True
+            kwargs["formatter"] = None
+    _np.set_printoptions(**kwargs)

@@ -161,3 +161,12 @@ def corrcoef(x, rowvar=True, name=None):
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
     return apply(lambda a, fw, aw: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
                                            fweights=fw, aweights=aw), x, fweights, aweights)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2), x)
+
+
+def inverse(x, name=None):
+    return inv(x)

@@ -381,3 +381,26 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         inside = (i >= lo) & (i < hi)
         return jnp.where(inside, i - lo, ignore_value)
     return apply(f, input)
+
+
+def squeeze_(x, axis=None, name=None):
+    """Inplace squeeze (reference *_ inplace convention)."""
+    x._adopt(squeeze(x, axis))
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    x._adopt(unsqueeze(x, axis))
+    return x
+
+
+def t(x, name=None):
+    """Transpose a 0/1/2-D tensor (reference tensor/linalg.py t())."""
+    nd = len(x.shape)
+    if nd > 2:
+        raise ValueError(
+            f"paddle.t only accepts tensors of rank <= 2, got rank {nd}; "
+            f"use paddle.transpose for higher ranks")
+    if nd < 2:
+        return x
+    return transpose(x, [1, 0])

@@ -236,3 +236,15 @@ def take(x, index, mode="raise", name=None):
 def broadcast_shape(x_shape, y_shape):
     import numpy as np
     return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference math.py add_n / sum_op)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    return apply(lambda *xs: functools.reduce(jnp.add, xs), *inputs)
+
+
+def tanh_(x, name=None):
+    x._adopt(apply(jnp.tanh, x))
+    return x

@@ -124,3 +124,12 @@ def exponential_(x, lam=1.0, name=None):
                 Tensor(key), x)
     x._adopt(out)
     return x
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference tensor/random.py check_shape)."""
+    if isinstance(shape, (list, tuple)):
+        for s in shape:
+            if not isinstance(s, (int,)) and not hasattr(s, "dtype"):
+                raise TypeError(f"shape entries must be ints/Tensors, got {type(s)}")
+    return shape

@@ -542,3 +542,82 @@ class TestSparseNativeOps:
         tr = paddle.text.Conll05st(data_file=str(tmp_path), mode="train")
         te = paddle.text.Conll05st(data_file=str(tmp_path), mode="test")
         assert len(tr) == 8 and len(te) == 2
+
+
+class TestTopLevelParity:
+    def test_new_namespace_modules(self, tmp_path):
+        assert paddle.compat.to_text(b"abc") == "abc"
+        assert paddle.compat.to_bytes("abc") == b"abc"
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        # hub over a local hubconf
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny(width=4):\n"
+            "    'a tiny model'\n"
+            "    import paddle_tpu.nn as nn\n"
+            "    return nn.Linear(width, 2)\n")
+        assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+        assert "tiny model" in paddle.hub.help(str(tmp_path), "tiny")
+        layer = paddle.hub.load(str(tmp_path), "tiny", width=3)
+        assert layer.weight.shape[0] == 3
+        with pytest.raises(ValueError, match="zero-egress"):
+            paddle.hub.list("whatever", source="github")
+
+    def test_batch_and_reader_decorators(self):
+        r = lambda: iter(range(10))
+        batches = list(paddle.batch(r, 3)())
+        assert batches[0] == [0, 1, 2] and len(batches) == 4
+        batches = list(paddle.batch(r, 3, drop_last=True)())
+        assert len(batches) == 3
+        buf = list(paddle.reader.buffered(r, 2)())
+        assert buf == list(range(10))
+        comp = list(paddle.reader.chain(r, r)())
+        assert len(comp) == 20
+        mapped = list(paddle.reader.xmap_readers(lambda x: x * 2, r, 2, 4,
+                                                 order=True)())
+        assert mapped == [2 * i for i in range(10)]
+
+    def test_places_and_legacy_aliases(self):
+        assert paddle.CPUPlace().is_cpu_place()
+        with pytest.raises(RuntimeError):
+            paddle.CUDAPlace(0)
+        assert paddle.Model is not None
+        assert paddle.ParamAttr is not None
+        assert paddle.VarBase is paddle.Tensor
+        assert paddle.in_dygraph_mode() is True
+        assert paddle.get_cuda_rng_state() == []
+        paddle.disable_signal_handler()
+        t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        assert paddle.tolist(t) == [1.0, 2.0]
+        assert tuple(paddle.t(paddle.to_tensor(
+            np.zeros((2, 3), np.float32))).shape) == (3, 2)
+        assert float(np.asarray(paddle.add_n(
+            [t, t])._data)[0]) == 2.0
+
+
+class TestInplaceOpsAutograd:
+    def test_inplace_ops_keep_gradients(self):
+        """round-2 review: *_ ops must _adopt so the tape's out_refs follow
+        the mutated tensor (direct _data/_node assignment orphaned them)."""
+        import paddle_tpu.tensor as T
+        w = paddle.to_tensor(np.array([[2.0, 3.0]], np.float32),
+                             stop_gradient=False)
+        y = w * 4.0                       # recorded node
+        T.squeeze_(y)                     # in-place on a non-leaf
+        y.sum().backward()
+        np.testing.assert_allclose(np.asarray(w.grad._data), [[4.0, 4.0]])
+
+        x = paddle.to_tensor(np.array([0.5], np.float32), stop_gradient=False)
+        z = x * 2.0
+        T.tanh_(z)
+        z.backward()
+        np.testing.assert_allclose(np.asarray(x.grad._data),
+                                   2.0 * (1 - np.tanh(1.0) ** 2), rtol=1e-6)
+
+    def test_sci_mode_forces_scientific(self):
+        import paddle_tpu.tensor as T
+        T.set_printoptions(sci_mode=True, precision=2)
+        try:
+            s = repr(np.array([1.5, 20.0]))
+            assert "e+" in s or "e-" in s, s
+        finally:
+            np.set_printoptions(suppress=False, formatter=None, precision=8)
