@@ -4,3 +4,7 @@ from . import functional, initializer  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer import Layer  # noqa: F401
 from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters  # noqa: F401
+from . import quant  # noqa: F401,E402
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401,E402
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401,E402
+from .utils import spectral_norm  # noqa: F401,E402

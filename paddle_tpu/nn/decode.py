@@ -1,0 +1,175 @@
+"""Seq2seq decoding: BeamSearchDecoder + dynamic_decode
+(reference: python/paddle/nn/decode.py — Decoder/BeamSearchDecoder:110,
+dynamic_decode; C++ twin gather_tree_op).
+
+TPU-native shape: beams are a static axis folded into the batch
+(B*K rows through the cell — one big MXU matmul instead of K small ones);
+the step loop is host-side Python with device-resident state (eager mode —
+the decode length is data-dependent via early-exit, which the reference
+also runs host-side), and the final backtrace is the device-side
+``gather_tree`` scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .functional.extras import gather_tree
+from .layer.base import Layer
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract step-decoder interface (the contract ``dynamic_decode``
+    drives; reference decode.py Decoder, with the parent-pointer addition
+    the beam decoder needs for the device-side backtrace):
+
+    - ``initialize(inits) -> (first_inputs, states)``
+    - ``step(time, inputs, states, **kwargs) -> (outputs, states, parents)``
+      (``parents`` may be None for non-beam decoders; ``kwargs`` are the
+      extra arguments passed through ``dynamic_decode``)
+    - ``finalize(step_outputs, step_parents, final_states)
+      -> (outputs, final_states)``
+    """
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, step_outputs, step_parents, final_states):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN cell (reference decode.py:110).
+
+    cell: an RNNCell-like layer ``cell(inputs, states) -> (out, new_states)``;
+    ``output_fn`` maps cell output to vocab logits; ``embedding_fn`` maps
+    token ids to embeddings.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int, beam_size: int,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # -- helpers (reference static methods) ---------------------------------
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*K, ...) by repeating each row K times."""
+        raw = getattr(x, "_data", x)
+        tiled = jnp.repeat(raw, beam_size, axis=0)
+        return Tensor(tiled) if isinstance(x, Tensor) else tiled
+
+    def _merge(self, x):   # (B, K, ...) -> (B*K, ...)
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x, B):  # (B*K, ...) -> (B, K, ...)
+        return x.reshape((B, self.beam_size) + x.shape[1:])
+
+    # -- Decoder interface --------------------------------------------------
+    def initialize(self, initial_cell_states):
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(getattr(s, "_data", s), self.beam_size,
+                                 axis=0),
+            initial_cell_states)
+        some = jax.tree_util.tree_leaves(states)[0]
+        B = some.shape[0] // self.beam_size
+        ids = jnp.full((B, self.beam_size), self.start_token, jnp.int32)
+        # beam 0 live, others -inf so the first top-k doesn't pick clones
+        log_probs = jnp.tile(
+            jnp.array([[0.0] + [-1e9] * (self.beam_size - 1)], jnp.float32),
+            (B, 1))
+        finished = jnp.zeros((B, self.beam_size), bool)
+        return ids, {"cell": states, "log_probs": log_probs,
+                     "finished": finished}
+
+    def step(self, time, inputs, states, **kwargs):
+        B = states["log_probs"].shape[0]
+        K, V = self.beam_size, None
+        emb = self.embedding_fn(Tensor(self._merge(inputs))) \
+            if self.embedding_fn is not None else Tensor(self._merge(inputs))
+        cell_out, new_cell = self.cell(emb, jax.tree_util.tree_map(
+            Tensor, states["cell"]))
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        logits = getattr(logits, "_data", logits)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = self._split(logp, B)                              # (B, K, V)
+        # finished beams only extend with end_token at no cost
+        fin = states["finished"][:, :, None]
+        onehot_end = jax.nn.one_hot(self.end_token, V, dtype=jnp.float32)
+        masked = jnp.where(fin, jnp.log(onehot_end + 1e-38)[None, None, :],
+                           logp)
+        total = states["log_probs"][:, :, None] + masked          # (B, K, V)
+        flat = total.reshape(B, K * V)
+        top_val, top_idx = jax.lax.top_k(flat, K)                 # (B, K)
+        parent = (top_idx // V).astype(jnp.int32)
+        token = (top_idx % V).astype(jnp.int32)
+
+        binx = jnp.arange(B)[:, None]
+        new_states = jax.tree_util.tree_map(
+            lambda s: self._merge(self._split(getattr(s, "_data", s), B)
+                                  [binx, parent]),
+            new_cell)
+        finished = states["finished"][binx, parent] | (token == self.end_token)
+        return token, {"cell": jax.tree_util.tree_map(
+            lambda s: getattr(s, "_data", s), new_states),
+            "log_probs": top_val, "finished": finished}, parent
+
+    def finalize(self, step_ids, step_parents, final_states):
+        ids = jnp.stack(step_ids)            # (T, B, K)
+        parents = jnp.stack(step_parents)
+        full = gather_tree(Tensor(ids), Tensor(parents))
+        return full, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Run ``decoder`` until every beam finishes or max_step_num
+    (reference decode.py dynamic_decode).
+
+    Returns (ids, final_log_probs) with ids (B, K, T) (time-major when
+    requested), plus per-beam lengths when ``return_length``.
+    """
+    ids, states = decoder.initialize(inits)
+    step_ids, step_parents = [], []
+    tokens = ids[:, :]  # (B, K) current input tokens
+    for t in range(max_step_num):
+        tokens, states, parents = decoder.step(t, tokens, states, **kwargs)
+        step_ids.append(tokens)
+        step_parents.append(parents)
+        if bool(np.asarray(states["finished"]).all()):
+            break
+    full, final_states = decoder.finalize(step_ids, step_parents, states)
+    seq = getattr(full, "_data", full)                 # (T, B, K)
+    if not output_time_major:
+        seq = jnp.transpose(seq, (1, 2, 0))            # (B, K, T)
+    out = Tensor(seq)
+    if return_length:
+        # length = first end_token position + 1 (or T)
+        tdim = 0 if output_time_major else -1
+        is_end = (seq == decoder.end_token)
+        T = seq.shape[tdim]
+        pos = jnp.argmax(is_end.astype(jnp.int32), axis=tdim)
+        any_end = jnp.any(is_end, axis=tdim)
+        length = jnp.where(any_end, pos + 1, T)
+        return out, Tensor(final_states["log_probs"]), Tensor(length)
+    return out, Tensor(final_states["log_probs"])

@@ -105,3 +105,6 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     """Flash-attention entry point (BSHD layout like paddle's incubate API)."""
     from ...ops.attention import scaled_dot_product_attention as sdpa
     return sdpa(query, key, value, attn_mask, dropout_p, is_causal, training)
+
+from .extras import (class_center_sample, elu_, gather_tree, hsigmoid_loss,  # noqa: F401,E402
+                     margin_cross_entropy, max_unpool2d, tanh_, zeropad2d)

@@ -1,0 +1,256 @@
+"""Remaining functional ops for reference nn.functional parity:
+max_unpool2d, zeropad2d, inplace activations, hierarchical-sigmoid loss,
+margin (ArcFace) cross entropy, class-center sampling, beam-search
+gather_tree.
+
+TPU-native notes are per function; everything is static-shape and
+jit-safe (class_center_sample fixes the sample count; hsigmoid precomputes
+the tree tables host-side per num_classes).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...core.tensor import Tensor, apply
+
+__all__ = ["max_unpool2d", "zeropad2d", "elu_", "tanh_", "hsigmoid_loss",
+           "margin_cross_entropy", "class_center_sample", "gather_tree"]
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True): scatter each pooled value
+    back to its argmax position (indices are flattened INPUT-spatial ids,
+    the contract our _pool_indices emits)."""
+    if data_format != "NCHW":
+        raise ValueError("max_unpool2d supports NCHW only")
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride, stride) if isinstance(stride, int) else tuple(stride))
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+
+    def f(a, idx):
+        B, C, H, W = a.shape
+        if output_size is not None:
+            Ho, Wo = output_size[-2:]
+        else:
+            Ho = (H - 1) * st[0] - 2 * pd[0] + ks[0]
+            Wo = (W - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((B, C, Ho * Wo), a.dtype)
+        bi = jnp.arange(B)[:, None, None]
+        ci = jnp.arange(C)[None, :, None]
+        ids = idx.reshape(B, C, H * W).astype(jnp.int32)
+        flat = flat.at[bi, ci, ids].set(a.reshape(B, C, H * W))
+        return flat.reshape(B, C, Ho, Wo)
+
+    return apply(f, x, indices)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (reference nn/functional/common.py zeropad2d);
+    padding = [left, right, top, bottom]."""
+    l, r, t, b = padding
+
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(a, cfg)
+
+    return apply(f, x)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from . import elu
+    x._adopt(elu(x, alpha))
+    return x
+
+
+def tanh_(x, name=None):
+    x._adopt(apply(jnp.tanh, x))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _hsig_tree(num_classes: int):
+    """(paths, codes, mask) int arrays (C, depth) for the heap-layout
+    complete binary tree the reference's default path uses: internal nodes
+    0..C-2, leaf for class c sits at heap id c + C - 1."""
+    depth = max(1, math.ceil(math.log2(max(num_classes, 2))))
+    paths = np.zeros((num_classes, depth), np.int32)
+    codes = np.zeros((num_classes, depth), np.float32)
+    mask = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes - 1
+        chain = []
+        while node > 0:
+            parent = (node - 1) // 2
+            chain.append((parent, float(node == 2 * parent + 2)))
+            node = parent
+        chain.reverse()
+        for d, (p, bit) in enumerate(chain):
+            paths[c, d] = p
+            codes[c, d] = bit
+            mask[c, d] = 1.0
+    return jnp.asarray(paths), jnp.asarray(codes), jnp.asarray(mask)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid loss (reference nn/functional/loss.py
+    hsigmoid_loss, operators/hierarchical_sigmoid_op.h).
+
+    Default tree: complete binary heap over num_classes leaves; custom
+    trees via (path_table, path_code) exactly like the reference.  weight:
+    (num_classes - 1, D); returns (N, 1) loss (sum over the path of BCE
+    with the path code).
+    """
+    def f(x, lbl, w, b, ptab, pcode):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        if ptab is None:
+            paths, codes, mask = _hsig_tree(int(num_classes))
+            p = paths[lbl]          # (N, depth)
+            c = codes[lbl]
+            m = mask[lbl]
+        else:
+            # reference contract: custom tables are PER-SAMPLE (N, depth)
+            # rows already gathered by the caller — never re-indexed here
+            # (shape-based guessing would misread a batch of size
+            # num_classes); entries < 0 pad ragged paths
+            if ptab.shape[0] != lbl.shape[0]:
+                raise ValueError(
+                    f"path_table must have one row per sample "
+                    f"({lbl.shape[0]}), got {ptab.shape}")
+            p = ptab.astype(jnp.int32)
+            c = pcode.astype(jnp.float32)
+            m = (p >= 0).astype(jnp.float32)
+            p = jnp.maximum(p, 0)
+        wn = w[p]                    # (N, depth, D)
+        logits = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
+                            wn.astype(jnp.float32))
+        if b is not None:
+            logits = logits + b.reshape(-1)[p]
+        # BCE against the code bit, masked to the true path length
+        losses = m * (jnp.logaddexp(0.0, logits) - c * logits)
+        return jnp.sum(losses, axis=1, keepdims=True)
+
+    return apply(f, input, label, weight, bias, path_table, path_code)
+
+
+# ---------------------------------------------------------------------------
+# margin softmax (ArcFace family) + PartialFC sampling
+# ---------------------------------------------------------------------------
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """CosFace/ArcFace margin softmax CE (reference
+    nn/functional/margin_cross_entropy; the class-parallel ``group`` path is
+    subsumed by GSPMD sharding of the class dim — pass group=None and shard
+    the logits instead).
+
+    logits are cosines; the target class logit cosθ becomes
+    cos(margin1·θ + margin2) − margin3 before scaling.
+    """
+    if group is not None:
+        raise ValueError(
+            "explicit process groups are not used on TPU; shard the class "
+            "dim of logits with a NamedSharding and GSPMD handles the rest")
+
+    def f(cos, lbl):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        # keep strictly inside (-1, 1): arccos' blows up at the endpoints and
+        # a saturated target cosine would send NaN through backward
+        lim = 1.0 - 1e-6
+        cosf = jnp.clip(cos.astype(jnp.float32), -lim, lim)
+        theta = jnp.arccos(jnp.take_along_axis(cosf, lbl[:, None], axis=1))[:, 0]
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lbl, cos.shape[-1], dtype=jnp.float32)
+        adjusted = cosf * (1 - onehot) + target[:, None] * onehot
+        z = adjusted * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -jnp.take_along_axis(logp, lbl[:, None], axis=1)
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return apply(f, logits, label)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC negative-class sampling (reference
+    nn/functional/class_center_sample).  Keeps every positive class plus
+    uniformly sampled negatives up to ``num_samples`` (static shape), and
+    remaps labels into the sampled index space (-1 style semantics: labels
+    keep their position since positives always survive).
+
+    Returns (remapped_label, sampled_class_center) — sampled ids sorted,
+    positives first in sorted order like the reference.
+    """
+    if group is not None:
+        raise ValueError("explicit process groups are not used on TPU")
+    from ...core import rng
+
+    def f(lbl, key):
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        pos = jnp.zeros((num_classes,), bool).at[lbl].set(True)
+        n_pos = jnp.sum(pos)
+        # rank classes: positives (random order) first, then shuffled
+        # negatives; take num_samples — positives always make the cut
+        # as long as num_samples >= #positives (reference contract)
+        noise = jax.random.uniform(key, (num_classes,))
+        rank = jnp.where(pos, noise - 1.0, noise)   # positives sort first
+        order = jnp.argsort(rank)
+        sampled = jnp.sort(order[:num_samples])
+        # remap: position of each label inside `sampled`
+        inv = jnp.full((num_classes,), -1, jnp.int32)
+        inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
+        return inv[lbl], sampled.astype(jnp.int64)
+
+    return apply(f, label, Tensor(rng.next_key()))
+
+
+# ---------------------------------------------------------------------------
+# beam search backtrace
+# ---------------------------------------------------------------------------
+
+def gather_tree(ids, parents):
+    """Reconstruct full beam paths from per-step ids and parent pointers
+    (reference nn/functional gather_tree, gather_tree_op.cc).
+
+    ids, parents: (T, B, beam) int.  Walks from the last step backwards —
+    a ``lax.scan`` over time, fully on device.
+    """
+    def f(idv, par):
+        idv = idv.astype(jnp.int32)
+        par = par.astype(jnp.int32)
+        T, B, K = idv.shape
+        binx = jnp.arange(B)[:, None]
+
+        def back(beam_at_t, xs):
+            ids_t, par_t = xs
+            out = ids_t[binx, beam_at_t]            # (B, K)
+            prev = par_t[binx, beam_at_t]
+            return prev, out
+
+        init = jnp.broadcast_to(jnp.arange(K)[None, :], (B, K))
+        _, outs = lax.scan(back, init, (idv[::-1], par[::-1]))
+        return outs[::-1]
+
+    return apply(f, ids, parents)

@@ -205,5 +205,13 @@ class SpectralNorm(Layer):
                 u = w_m @ v
                 u = u / (jnp.linalg.norm(u) + eps)
             sigma = u @ w_m @ v
-            return w / sigma
-        return apply(f, weight, self.weight_u, self.weight_v)
+            return w / sigma, u, v
+
+        out, u_new, v_new = apply(f, weight, self.weight_u, self.weight_v)
+        # persist the power-iteration state (reference SpectralNormOp writes
+        # U/V back every forward) so iters=1 converges across training steps
+        self.weight_u._data = jax.lax.stop_gradient(
+            getattr(u_new, "_data", u_new))
+        self.weight_v._data = jax.lax.stop_gradient(
+            getattr(v_new, "_data", v_new))
+        return out

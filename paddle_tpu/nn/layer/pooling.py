@@ -95,3 +95,18 @@ class AdaptiveMaxPool3D(_Pool):
     def __init__(self, output_size, return_mask=False, name=None):
         super().__init__("adaptive_max_pool3d", output_size=output_size,
                          return_mask=return_mask)
+
+
+class MaxUnPool2D(Layer):
+    """Inverse max-pool (reference nn/layer/pooling.py MaxUnPool2D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW",
+                 output_size=None, name=None):
+        super().__init__()
+        self._args = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        from ..functional.extras import max_unpool2d
+        k, s, p, df, osz = self._args
+        return max_unpool2d(x, indices, k, stride=s, padding=p,
+                            data_format=df, output_size=osz)

@@ -1,0 +1,12 @@
+"""nn.quant namespace (reference: python/paddle/nn/quant/) — re-exports the
+quantization building blocks under their nn-side names."""
+
+from ...quantization import (AbsMaxObserver, ImperativeQuantAware,  # noqa: F401
+                             MovingAverageAbsMaxObserver, QuantedLinear,
+                             fake_quant_dequant)
+
+FakeQuantAbsMax = AbsMaxObserver  # reference class name for the observer
+
+__all__ = ["QuantedLinear", "fake_quant_dequant", "AbsMaxObserver",
+           "FakeQuantAbsMax", "MovingAverageAbsMaxObserver",
+           "ImperativeQuantAware"]

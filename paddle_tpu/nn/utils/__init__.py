@@ -89,5 +89,33 @@ def remove_weight_norm(layer, name="weight"):
     return layer
 
 
-def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Attach spectral normalization to ``layer.<name>`` (reference
+    nn/utils/spectral_norm_hook.py): a forward pre-hook renormalizes the
+    weight by its largest singular value (power iteration) before every
+    call.  Returns the layer."""
+    from ..layer.norm import SpectralNorm
+
+    w = getattr(layer, name)
+    if dim is None:
+        # conv-transpose weights store (in, out, ...) — normalize along 1
+        dim = 1 if type(layer).__name__ in (
+            "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+            "Linear") else 0
+    sn = SpectralNorm(list(w.shape), dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer.add_sublayer(f"{name}_spectral_norm", sn)
+    # reparametrize: the trainable param moves to <name>_orig; <name>
+    # becomes a plain attribute recomputed from it before every forward
+    # (so optimizers update the raw weight, never the normalized view)
+    del layer._parameters[name]
+    setattr(layer, name + "_orig", w)
+
+    def pre_hook(lyr, inputs):
+        object.__setattr__(lyr, name, sn(getattr(lyr, name + "_orig")))
+        return None
+
+    layer.register_forward_pre_hook(pre_hook)
+    pre_hook(layer, None)  # valid immediately, not just after first call
     return layer

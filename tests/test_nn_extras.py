@@ -1,0 +1,257 @@
+"""nn parity extras: unpool, zeropad, hsigmoid, margin CE, class-center
+sampling, gather_tree, beam search decode, spectral_norm."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestUnpoolPad:
+    def test_max_unpool2d_inverts_pool(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 8, 8))
+                             .astype(np.float32))
+        pooled, idx = F.max_pool2d(x, kernel_size=2, stride=2,
+                                   return_mask=True)
+        up = F.max_unpool2d(pooled, idx, kernel_size=2, stride=2)
+        # every pooled max lands back at its original coordinate
+        orig = np.asarray(x._data)
+        rec = np.asarray(up._data)
+        assert rec.shape == orig.shape
+        nz = rec != 0
+        np.testing.assert_allclose(rec[nz], orig[nz])
+        assert nz.sum() == 2 * 3 * 4 * 4
+        layer = nn.MaxUnPool2D(kernel_size=2, stride=2)
+        rec2 = layer(pooled, idx)
+        np.testing.assert_allclose(np.asarray(rec2._data), rec)
+
+    def test_zeropad2d(self):
+        x = paddle.to_tensor(np.ones((1, 1, 2, 2), np.float32))
+        out = np.asarray(F.zeropad2d(x, [1, 2, 3, 4])._data)
+        assert out.shape == (1, 1, 2 + 3 + 4, 2 + 1 + 2)
+        assert out.sum() == 4.0 and out[0, 0, 3, 1] == 1.0
+
+
+class TestHSigmoid:
+    def test_matches_bruteforce(self):
+        """Oracle: explicitly walk the heap tree and sum BCE terms."""
+        rng = np.random.RandomState(1)
+        N, D, C = 5, 6, 7
+        x = rng.standard_normal((N, D)).astype(np.float32)
+        w = rng.standard_normal((C - 1, D)).astype(np.float32)
+        b = rng.standard_normal((C - 1,)).astype(np.float32)
+        lbl = rng.randint(0, C, N)
+        out = F.hsigmoid_loss(paddle.to_tensor(x), paddle.to_tensor(lbl), C,
+                              paddle.to_tensor(w), paddle.to_tensor(b))
+        got = np.asarray(out._data).ravel()
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        for n in range(N):
+            node = lbl[n] + C - 1
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, 1.0 if node == 2 * parent + 2 else 0.0))
+                node = parent
+            loss = 0.0
+            for p, code in path:
+                z = x[n] @ w[p] + b[p]
+                pr = sigmoid(z)
+                loss += -(code * np.log(pr) + (1 - code) * np.log(1 - pr))
+            np.testing.assert_allclose(got[n], loss, rtol=1e-4)
+
+    def test_layer_trains(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(2)
+        xv = rng.standard_normal((64, 8)).astype(np.float32)
+        x = paddle.to_tensor(xv)
+        y = paddle.to_tensor(np.argmax(xv[:, :6], axis=1))  # learnable labels
+        layer = nn.HSigmoidLoss(8, 6)
+        opt = paddle.optimizer.Adam(0.1, parameters=layer.parameters())
+        first = None
+        for _ in range(60):
+            loss = layer(x, y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first / 2, (first, float(loss))
+
+
+class TestMarginCE:
+    def test_matches_manual_formula(self):
+        rng = np.random.RandomState(3)
+        N, C = 4, 5
+        cos = np.clip(rng.uniform(-0.9, 0.9, (N, C)), -1, 1).astype(np.float32)
+        lbl = rng.randint(0, C, N)
+        loss, soft = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lbl), margin1=1.0,
+            margin2=0.3, margin3=0.1, scale=10.0, return_softmax=True,
+            reduction=None)
+        theta = np.arccos(cos[np.arange(N), lbl])
+        tgt = np.cos(theta + 0.3) - 0.1
+        adj = cos.copy()
+        adj[np.arange(N), lbl] = tgt
+        z = adj * 10.0
+        logp = z - np.log(np.exp(z).sum(1, keepdims=True))
+        ref = -logp[np.arange(N), lbl]
+        np.testing.assert_allclose(np.asarray(loss._data).ravel(), ref,
+                                   rtol=1e-4)
+        np.testing.assert_allclose(np.asarray(soft._data), np.exp(logp),
+                                   rtol=1e-4)
+
+    def test_group_raises(self):
+        with pytest.raises(ValueError, match="GSPMD|shard"):
+            F.margin_cross_entropy(paddle.to_tensor(np.zeros((2, 3), np.float32)),
+                                   paddle.to_tensor(np.array([0, 1])),
+                                   group="data")
+
+
+class TestClassCenterSample:
+    def test_contract(self):
+        paddle.seed(11)
+        lbl = np.array([2, 9, 2, 31, 9], np.int64)
+        remapped, sampled = F.class_center_sample(
+            paddle.to_tensor(lbl), num_classes=40, num_samples=8)
+        s = np.asarray(sampled._data)
+        r = np.asarray(remapped._data)
+        assert len(s) == 8 and len(set(s.tolist())) == 8
+        assert np.all(np.diff(s) > 0)           # sorted
+        for pos in {2, 9, 31}:
+            assert pos in s                      # positives survive
+        # remapped labels point at their class's position in `sampled`
+        np.testing.assert_array_equal(s[r], lbl)
+
+
+class TestGatherTreeAndBeam:
+    def test_gather_tree_oracle(self):
+        ids = np.array([[[2, 5]], [[6, 1]], [[3, 8]]], np.int64)   # (T=3,B=1,K=2)
+        parents = np.array([[[0, 0]], [[1, 0]], [[0, 1]]], np.int64)
+        out = np.asarray(F.gather_tree(paddle.to_tensor(ids),
+                                       paddle.to_tensor(parents))._data)
+        # beam 0 at t=2 came from parent 0 (t=1 beam 0 ← parent 1 at t=0)
+        np.testing.assert_array_equal(out[:, 0, 0], [5, 6, 3])
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 1, 8])
+
+    def test_beam_search_finds_argmax_sequence(self):
+        """Cell with state-independent fixed logits: beam search must return
+        the top-probability token at every step, better than greedy ties."""
+        V, K = 6, 3
+
+        class FixedCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                logits = np.full((V,), -5.0, np.float32)
+                logits[4] = 2.0
+                logits[1] = 1.0
+                self.logits = logits
+
+            def forward(self, inputs, states):
+                B = inputs.shape[0]
+                out = paddle.to_tensor(np.tile(self.logits, (B, 1)))
+                return out, states
+
+        cell = FixedCell()
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=5,
+                                   beam_size=K)
+        init = {"h": jnp.zeros((2, 4), jnp.float32)}  # batch of 2
+        ids, logp = nn.dynamic_decode(dec, inits=init, max_step_num=4)
+        arr = np.asarray(ids._data)                   # (B, K, T)
+        assert arr.shape[0] == 2 and arr.shape[1] == K
+        # the best beam repeats token 4 (highest prob, never the end token)
+        np.testing.assert_array_equal(arr[0, 0], [4] * arr.shape[2])
+        # scores sorted across beams
+        lp = np.asarray(logp._data)
+        assert np.all(np.diff(lp, axis=1) <= 1e-6)
+
+
+class TestSpectralNormHook:
+    def test_weight_normalized(self):
+        paddle.seed(0)
+        lin = nn.Linear(6, 4)
+        lin.weight._data = lin.weight._data * 10.0   # big spectral norm
+        nn.spectral_norm(lin, n_power_iterations=20)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .standard_normal((2, 6)).astype(np.float32))
+        lin(x)  # runs the pre-hook
+        w = np.asarray(lin.weight._data)
+        s = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=1e-2)
+        # trainable param is the raw weight, not the normalized view
+        names = [n for n, _ in lin.named_parameters()]
+        assert any("weight_orig" in n for n in names)
+        assert not any(n == "weight" for n in names)
+
+
+class TestNNExtrasReviewRegressions:
+    def test_hsigmoid_bias_false(self):
+        layer = nn.HSigmoidLoss(8, 6, bias_attr=False)
+        assert layer.bias is None
+        out = layer(paddle.to_tensor(np.ones((2, 8), np.float32)),
+                    paddle.to_tensor(np.array([1, 3])))
+        assert np.isfinite(np.asarray(out._data)).all()
+
+    def test_spectral_norm_persists_power_iteration(self):
+        """iters=1 must converge ACROSS calls (u/v written back), not stay
+        at the random-init estimate forever."""
+        paddle.seed(3)
+        lin = nn.Linear(6, 4)
+        lin.weight._data = lin.weight._data * 10.0
+        nn.spectral_norm(lin, n_power_iterations=1)
+        x = paddle.to_tensor(np.zeros((1, 6), np.float32))
+        for _ in range(30):   # each forward advances the power iteration
+            lin(x)
+        s = np.linalg.svd(np.asarray(lin.weight._data), compute_uv=False)[0]
+        np.testing.assert_allclose(s, 1.0, rtol=2e-2)
+
+    def test_margin_ce_saturated_cosine_grad_finite(self):
+        cos = paddle.to_tensor(np.array([[1.0, -0.2], [-1.0, 0.3]],
+                                        np.float32), stop_gradient=False)
+        lbl = paddle.to_tensor(np.array([0, 0]))
+        loss = F.margin_cross_entropy(cos, lbl, margin2=0.3, scale=4.0)
+        loss.backward()
+        assert np.isfinite(np.asarray(cos.grad._data)).all()
+
+    def test_hsigmoid_per_sample_path_table(self):
+        x = paddle.to_tensor(np.ones((3, 4), np.float32))
+        lbl = paddle.to_tensor(np.array([0, 1, 2]))
+        w = paddle.to_tensor(np.zeros((5, 4), np.float32))
+        ptab = paddle.to_tensor(np.array([[0, -1], [1, -1], [2, 3]], np.int64))
+        pcode = paddle.to_tensor(np.array([[1, 0], [0, 0], [1, 0]], np.float32))
+        out = np.asarray(F.hsigmoid_loss(x, lbl, 6, w, None, path_table=ptab,
+                                         path_code=pcode)._data).ravel()
+        # zero weights → every BCE term is log(2); row path lengths 1,1,2
+        np.testing.assert_allclose(out, np.log(2) * np.array([1, 1, 2]),
+                                   rtol=1e-5)
+        with pytest.raises(ValueError, match="per sample"):
+            F.hsigmoid_loss(x, lbl, 6, w, None,
+                            path_table=paddle.to_tensor(
+                                np.zeros((6, 2), np.int64)),
+                            path_code=paddle.to_tensor(
+                                np.zeros((6, 2), np.float32)))
+
+    def test_dynamic_decode_forwards_kwargs(self):
+        seen = {}
+
+        class KwCell(nn.Layer):
+            def forward(self, inputs, states):
+                return paddle.to_tensor(
+                    np.zeros((inputs.shape[0], 4), np.float32)), states
+
+        class KwDecoder(nn.BeamSearchDecoder):
+            def step(self, time, inputs, states, **kw):
+                seen.update(kw)
+                return super().step(time, inputs, states)
+
+        dec = KwDecoder(KwCell(), start_token=0, end_token=3, beam_size=2)
+        nn.dynamic_decode(dec, inits=jnp.zeros((1, 4), jnp.float32),
+                          max_step_num=1, encoder_output="ctx")
+        assert seen.get("encoder_output") == "ctx"
