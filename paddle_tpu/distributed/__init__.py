@@ -30,3 +30,80 @@ def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
 
 def get_backend():
     return "xla"
+
+
+# -- namespace parity tail (reference distributed/__init__.py) --------------
+
+from . import launch as launch  # noqa: F401,E402  (python -m ... entry too)
+from .auto_parallel import shard_op, shard_tensor  # noqa: F401,E402
+from ..io.dataset import InMemoryDataset, QueueDataset  # noqa: F401,E402
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference parallel_with_gloo.py — CPU rendezvous.  jax.distributed's
+    coordination service fills this role; the explicit arguments are
+    authoritative (they overwrite any launcher-provisioned PADDLE_* env)."""
+    import os
+    os.environ["PADDLE_TRAINER_ID"] = str(rank_id)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(rank_num)
+    os.environ["PADDLE_MASTER"] = server_endpoint
+    env.init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    """The gloo context is the jax coordination service here; released at
+    process exit (documented no-op)."""
+
+
+class _EntryAttr:
+    """PS sparse-table entry configs (reference entry_attr.py) — data
+    holders kept for API parity; the PS runtime itself is a declared
+    non-goal (SURVEY §7), so these only carry their repr contract."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class ProbabilityEntry(_EntryAttr):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class CountFilterEntry(_EntryAttr):
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ShowClickEntry(_EntryAttr):
+    def __init__(self, show_name, click_name):
+        self._show = show_name
+        self._click = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class BoxPSDataset:
+    """Heterogeneous BoxPS dataset (reference fleet/dataset) — GPU-PS
+    specific; unavailable by design on TPU."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError("BoxPS is a GPU parameter-server feature; the "
+                           "TPU build's dataset path is io.InMemoryDataset")
+
+
+from . import cloud_utils  # noqa: F401,E402

@@ -1,0 +1,25 @@
+"""Cloud cluster helpers (reference: distributed/cloud_utils.py — derives
+the trainer cluster layout from PaddleCloud env vars)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+
+def get_cluster_and_pod(args=None):
+    """(endpoints list, my rank) from the PADDLE_* env contract."""
+    eps = [e for e in os.getenv("PADDLE_TRAINER_ENDPOINTS", "").split(",") if e]
+    rank = int(os.getenv("PADDLE_TRAINER_ID", "0"))
+    if not eps:
+        n = int(os.getenv("PADDLE_TRAINERS_NUM", "1"))
+        eps = [f"127.0.0.1:{6170 + i}" for i in range(n)]
+    return eps, rank
+
+
+def get_cloud_cluster(args_node_ips: Optional[str] = None,
+                      args_node_ip: Optional[str] = None,
+                      args_port: int = 6170,
+                      selected_devices: Optional[List[int]] = None):
+    ips = (args_node_ips or os.getenv("PADDLE_TRAINERS", "127.0.0.1")).split(",")
+    return [f"{ip}:{args_port}" for ip in ips]

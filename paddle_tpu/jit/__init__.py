@@ -45,6 +45,7 @@ class StaticFunction:
     def __init__(self, fn_or_layer, input_spec: Optional[Sequence[InputSpec]] = None):
         from ..nn import Layer
         self._input_spec = list(input_spec) if input_spec else None
+        self._orig = fn_or_layer
         if isinstance(fn_or_layer, Layer):
             self._layer = fn_or_layer
             self._apply_fn, _, _ = functionalize(fn_or_layer)
@@ -70,6 +71,8 @@ class StaticFunction:
                                                                 **unwrap_tree(k)))
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_state["enabled"]:
+            return self._orig(*args, **kwargs)  # ProgramTranslator.enable(False)
         return self._call(*args, **kwargs)
 
     @property
@@ -190,5 +193,77 @@ def ignore_module(modules):
     pass
 
 
+_to_static_state = {"enabled": True}
+
+
 def enable_to_static(flag: bool):
-    pass
+    """Globally toggle to_static wrappers: when off, wrapped callables run
+    their original eager code (reference ProgramTranslator.enable)."""
+    _to_static_state["enabled"] = bool(flag)
+
+
+# -- legacy dy2static surface (reference jit/__init__.py re-exports) --------
+
+declarative = to_static  # pre-2.0 name for @to_static
+
+_verbosity = {"level": 0}
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static transpile logging knob (reference logging_utils.py:182).
+    This build traces instead of AST-transpiling, so the knob only gates the
+    (rare) trace diagnostics."""
+    _verbosity["level"] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference logging_utils.py:221 — shows transformed code; tracing has
+    no transformed source, so this records the knob for API parity."""
+    _verbosity["code_level"] = int(level)
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static (reference program_translator.py).
+    ``enable(False)`` makes to_static-wrapped callables run eagerly."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    @property
+    def enable_to_static(self):
+        return _to_static_state["enabled"]
+
+    def enable(self, flag: bool):
+        enable_to_static(bool(flag))
+
+
+class TracedLayer:
+    """Legacy trace-and-serve wrapper (reference dygraph/jit.py TracedLayer).
+    ``trace`` jits the layer on example inputs; ``save_inference_model``
+    writes the same StableHLO artifact as jit.save."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._static = StaticFunction(layer)
+        self._example = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        return out, TracedLayer(layer, inputs)
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None, **kwargs):
+        save(self._layer, path, input_spec=list(self._example))
+
+
+def dy2static_unsupported(*a, **k):
+    raise RuntimeError("AST transpilation is replaced by tracing in this "
+                       "framework; decorate with @paddle.jit.to_static")

@@ -205,6 +205,10 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     """
     if group is not None:
         raise ValueError("explicit process groups are not used on TPU")
+    if num_samples > num_classes:
+        raise ValueError(
+            f"num_samples ({num_samples}) cannot exceed num_classes "
+            f"({num_classes})")
     from ...core import rng
 
     def f(lbl, key):
@@ -221,7 +225,7 @@ def class_center_sample(label, num_classes, num_samples, group=None):
         # remap: position of each label inside `sampled`
         inv = jnp.full((num_classes,), -1, jnp.int32)
         inv = inv.at[sampled].set(jnp.arange(num_samples, dtype=jnp.int32))
-        return inv[lbl], sampled.astype(jnp.int64)
+        return inv[lbl], sampled.astype(jnp.int32)
 
     return apply(f, label, Tensor(rng.next_key()))
 

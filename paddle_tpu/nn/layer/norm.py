@@ -187,10 +187,11 @@ class SpectralNorm(Layer):
         h = weight_shape[dim]
         w = int(np.prod(weight_shape)) // h
         from ..initializer import Normal
-        self.weight_u = self.create_parameter([h], default_initializer=Normal(0, 1))
-        self.weight_u.stop_gradient = True
-        self.weight_v = self.create_parameter([w], default_initializer=Normal(0, 1))
-        self.weight_v.stop_gradient = True
+        # buffers, not parameters: the power-iteration state must persist
+        # through jitted steps (functionalize writes buffers back; params
+        # would be restored on exit and u/v would never advance under jit)
+        self.register_buffer("weight_u", Tensor(Normal(0, 1)([h], "float32")))
+        self.register_buffer("weight_v", Tensor(Normal(0, 1)([w], "float32")))
 
     def forward(self, weight):
         from ...core.tensor import apply

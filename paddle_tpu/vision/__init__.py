@@ -1,3 +1,31 @@
+"""``paddle_tpu.vision`` (reference: python/paddle/vision/__init__.py — which
+re-exports the datasets, models and transforms at this level too)."""
+
 from . import datasets, models, transforms  # noqa: F401
 from . import ops  # noqa: F401
+from .datasets import *  # noqa: F401,F403
 from .models import *  # noqa: F401,F403
+from .transforms import *  # noqa: F401,F403
+
+
+def set_image_backend(backend: str):
+    """Reference image.py set_image_backend — numpy-only build ('cv2'/'pil'
+    decode backends are a host-side concern; arrays in, arrays out)."""
+    if backend not in ("pil", "cv2", "numpy"):
+        raise ValueError(f"unknown image backend {backend!r}")
+
+
+def get_image_backend() -> str:
+    return "numpy"
+
+
+def image_load(path, backend=None):
+    """Load an image file to an ndarray (reference image.py image_load)."""
+    import numpy as np
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path))
+    except ImportError:
+        raise ModuleNotFoundError(
+            "image decoding needs PIL, which is not in this build; decode "
+            "host-side and feed arrays") from None

@@ -95,3 +95,175 @@ class Cifar10(Dataset):
 
 class Cifar100(Cifar10):
     pass
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference datasets/folder.py): root/
+    class_x/sample.ext → (loaded sample, class index).  ``loader`` defaults
+    to vision.image_load; ``extensions`` filters files."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self._custom_loader = loader is not None
+        if loader is None:
+            from .. import image_load
+            loader = image_load
+        self.loader = loader
+        exts = tuple(e.lower() for e in (extensions or
+                     (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders found under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = is_valid_file(path) if is_valid_file is not None \
+                        else f.lower().endswith(exts)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root!r}")
+
+    def __getitem__(self, idx):
+        import numpy as np
+        path, target = self.samples[idx]
+        # a user-supplied loader always wins; the np.load shortcut only
+        # covers the default-loader case (case-insensitive like the filter)
+        if not self._custom_loader and path.lower().endswith(".npy"):
+            sample = np.load(path)
+        else:
+            sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat image-folder dataset, samples only (reference ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        import os
+        self.root = root
+        self.transform = transform
+        self._custom_loader = loader is not None
+        if loader is None:
+            from .. import image_load
+            loader = image_load
+        self.loader = loader
+        exts = tuple(e.lower() for e in (extensions or
+                     (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                path = os.path.join(dirpath, f)
+                ok = is_valid_file(path) if is_valid_file is not None \
+                    else f.lower().endswith(exts)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no valid files found under {root!r}")
+
+    def __getitem__(self, idx):
+        import numpy as np
+        path = self.samples[idx]
+        if not self._custom_loader and path.lower().endswith(".npy"):
+            sample = np.load(path)
+        else:
+            sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 (reference datasets/flowers.py): local 102flowers image
+    archive/dir + imagelabels.mat + setid.mat (zero-egress build: all three
+    paths are required; the reference downloads the same files)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        import os
+        import tarfile
+        for nm, f in (("data_file", data_file), ("label_file", label_file),
+                      ("setid_file", setid_file)):
+            if f is None or not os.path.exists(f):
+                raise ValueError(
+                    f"Flowers requires a local {nm} (no downloader in this "
+                    f"zero-egress build); got {f!r}")
+        from scipy.io import loadmat
+        labels = loadmat(label_file)["labels"].ravel().astype("int64") - 1
+        setid = loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self._ids = setid[key].ravel().astype("int64")
+        self._labels = labels
+        self.transform = transform
+        self._tar = None
+        self._dir = None
+        if os.path.isdir(data_file):
+            self._dir = data_file
+        else:
+            self._tar = tarfile.open(data_file)
+
+    def _read(self, image_id: int):
+        import io
+        import numpy as np
+        name = f"image_{image_id:05d}.jpg"
+        if self._dir is not None:
+            import os
+            path = os.path.join(self._dir, "jpg", name)
+            if not os.path.exists(path):
+                path = os.path.join(self._dir, name)
+            data = open(path, "rb").read()
+        else:
+            member = next((m for m in (f"jpg/{name}", name)
+                           if self._member(m) is not None), None)
+            if member is None:
+                # a bare StopIteration from __getitem__ would silently end
+                # sequence-protocol for-loops mid-epoch
+                raise FileNotFoundError(
+                    f"{name} not found in the 102flowers archive")
+            data = self._tar.extractfile(self._member(member)).read()
+        try:
+            from PIL import Image
+            return np.asarray(Image.open(io.BytesIO(data)))
+        except ImportError:
+            raise ModuleNotFoundError(
+                "JPEG decode needs PIL; extract to .npy arrays or install "
+                "a decoder host-side") from None
+
+    def _member(self, name):
+        try:
+            return self._tar.getmember(name)
+        except KeyError:
+            return None
+
+    def __getitem__(self, idx):
+        image_id = int(self._ids[idx])
+        img = self._read(image_id)
+        label = self._labels[image_id - 1]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._ids)
+
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "Flowers"]

@@ -318,3 +318,10 @@ def pad(img, padding, fill=0, padding_mode="constant"):
 
 def to_grayscale(img, num_output_channels=1):
     return Grayscale(num_output_channels)(img)
+
+__all__ = ["Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+           "RandomVerticalFlip", "RandomResizedCrop", "Pad", "Grayscale",
+           "BrightnessTransform", "ContrastTransform", "ColorJitter",
+           "Transpose", "to_tensor", "normalize", "resize", "hflip", "vflip",
+           "center_crop", "crop", "pad", "to_grayscale"]

@@ -621,3 +621,66 @@ class TestInplaceOpsAutograd:
             assert "e+" in s or "e-" in s, s
         finally:
             np.set_printoptions(suppress=False, formatter=None, precision=8)
+
+
+class TestNamespaceParityTail:
+    def test_vision_top_level_exports(self):
+        import paddle_tpu.vision as v
+        for n in ("MNIST", "Cifar10", "Compose", "Normalize", "Flowers",
+                  "DatasetFolder", "ImageFolder", "resnet50"):
+            assert hasattr(v, n), n
+        assert v.get_image_backend() == "numpy"
+
+    def test_dataset_folder(self, tmp_path):
+        import paddle_tpu.vision as v
+        for cls_name, val in [("cat", 1.0), ("dog", 2.0)]:
+            d = tmp_path / cls_name
+            d.mkdir()
+            for i in range(3):
+                np.save(d / f"s{i}.npy", np.full((4, 4), val, np.float32))
+        ds = v.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6 and ds.classes == ["cat", "dog"]
+        sample, target = ds[0]
+        assert target == 0 and sample[0, 0] == 1.0
+        flat = v.ImageFolder(str(tmp_path))
+        assert len(flat) == 6
+
+    def test_jit_legacy_surface(self):
+        import paddle_tpu.jit as jit
+        import paddle_tpu.nn as nn
+        jit.set_verbosity(3)
+        jit.set_code_level(5)
+        calls = {"eager": 0}
+
+        @jit.declarative
+        def f(x):
+            calls["eager"] += 1
+            return x * 2
+
+        t = paddle.to_tensor(np.ones(2, np.float32))
+        f(t)
+        jit.ProgramTranslator.get_instance().enable(False)
+        try:
+            f(t)  # runs the original callable eagerly
+        finally:
+            jit.ProgramTranslator.get_instance().enable(True)
+        assert calls["eager"] >= 2  # traced once + eager once
+
+        lin = nn.Linear(2, 2)
+        out, traced = jit.TracedLayer.trace(lin, [t.reshape([1, 2])])
+        out2 = traced(t.reshape([1, 2]))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(out2._data), rtol=1e-6)
+
+    def test_distributed_namespace_tail(self):
+        import paddle_tpu.distributed as dist
+        assert dist.InMemoryDataset is paddle.io.InMemoryDataset
+        e = dist.ProbabilityEntry(0.5)
+        assert "0.5" in e._to_attr()
+        with pytest.raises(ValueError):
+            dist.ProbabilityEntry(1.5)
+        with pytest.raises(RuntimeError, match="BoxPS"):
+            dist.BoxPSDataset()
+        eps, rank = dist.cloud_utils.get_cluster_and_pod()
+        assert isinstance(eps, list) and rank >= 0
+        assert callable(dist.shard_tensor) and callable(dist.gloo_barrier)
