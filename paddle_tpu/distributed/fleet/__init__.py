@@ -76,9 +76,150 @@ class Fleet:
     def is_first_worker(self):
         return self.worker_index() == 0
 
+    def is_worker(self):
+        return self._role_maker._is_worker()
+
+    def is_server(self):
+        return self._role_maker._is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker._get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker._get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return self._role_maker._server_num()
+
+    def server_index(self):
+        return self._role_maker._server_index()
+
+    # reference aliases: rank/nranks/world_size over the worker axis
+    def rank(self):
+        return self.worker_index()
+
+    def nranks(self):
+        return self.worker_num()
+
+    def world_size(self):
+        return self.worker_num()
+
+    def local_rank(self):
+        """Rank within this node (workers are laid out node-major)."""
+        per_node = max(1, self.worker_num() // max(1, self.node_num()))
+        return self.worker_index() % per_node
+
+    def local_device_ids(self):
+        import jax
+        return list(range(jax.local_device_count()))
+
+    def world_device_ids(self):
+        import jax
+        return list(range(jax.device_count()))
+
+    def node_num(self):
+        import jax
+        return jax.process_count()
+
     def barrier_worker(self):
         from ..collective import barrier
         barrier()
+
+    @property
+    def util(self):
+        """Reference fleet.util surface (util_factory.py)."""
+        if getattr(self, "_util", None) is None:
+            from .utils import UtilBase
+            self._util = UtilBase()
+        return self._util
+
+    # -------------------------------------------- PS lifecycle (non-goal)
+    def init_worker(self):
+        """PS worker bootstrap — collective-only build (SURVEY §7 declares
+        the parameter-server runtime a non-goal); nothing to start."""
+
+    def init_server(self, *args, **kwargs):
+        raise RuntimeError(
+            "the parameter-server runtime is a declared non-goal of this "
+            "TPU build (SURVEY §7); use collective mode")
+
+    run_server = init_server
+
+    def stop_worker(self):
+        pass
+
+    def shrink(self, threshold=None):
+        raise RuntimeError("PS sparse-table shrink is a parameter-server "
+                           "feature; not available in the collective build")
+
+    # -------------------------------- optimizer passthroughs (fleet_base)
+    def _opt(self):
+        if self._user_defined_optimizer is None:
+            raise RuntimeError("call fleet.distributed_optimizer(...) first")
+        return self._user_defined_optimizer
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._opt().minimize(loss)
+
+    def step(self):
+        return self._opt().step()
+
+    def clear_grad(self):
+        return self._opt().clear_grad()
+
+    def get_lr(self):
+        return self._opt().get_lr()
+
+    def set_lr(self, value):
+        return self._opt().set_lr(value)
+
+    def state_dict(self):
+        return self._opt().state_dict()
+
+    def set_state_dict(self, state_dict):
+        return self._opt().set_state_dict(state_dict)
+
+    # ------------------------------------------------------------ model io
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None, **kwargs):
+        """First-worker-only export through the jit/StableHLO path.  The
+        exportable object (a Layer / traced program) comes from
+        ``main_program`` (or a ``program=`` kwarg)."""
+        if not self.is_first_worker():
+            return
+        program = main_program or kwargs.pop("program", None)
+        if program is None:
+            raise ValueError(
+                "save_inference_model needs the layer/program to export: "
+                "pass main_program= (a Layer or StaticFunction)")
+        from ... import static as _static
+        return _static.save_inference_model(dirname, feeded_var_names,
+                                            target_vars, executor,
+                                            program=program, **kwargs)
+
+    def save_persistables(self, executor, dirname, main_program=None, mode=0):
+        if not self.is_first_worker():
+            return
+        from ...framework import io as _io
+        from ... import static as _static
+        prog = main_program or _static.default_main_program()
+        params = dict(getattr(prog, "_params", {}) or {})
+        if not params and hasattr(prog, "named_parameters"):
+            params = {n: p for n, p in prog.named_parameters()}
+        if not params:
+            raise ValueError(
+                "no parameters found to persist: pass main_program= (a "
+                "Layer, or a Program populated via static.create_parameter)")
+        _io.save(params, dirname if dirname.endswith(".pdparams")
+                 else dirname + "/persistables.pdparams")
+
+    def load_model(self, path, mode=0):
+        from ...framework import io as _io
+        return _io.load(path if path.endswith(".pdparams")
+                        else path + "/persistables.pdparams")
 
     # ------------------------------------------------------------ wrapping
     def distributed_model(self, model):
@@ -132,4 +273,54 @@ distributed_train_step = fleet.distributed_train_step
 get_hybrid_communicate_group = lambda: fleet._hcg or get_hybrid_communicate_group()  # noqa: E731
 worker_num = fleet.worker_num
 worker_index = fleet.worker_index
+is_first_worker = fleet.is_first_worker
+is_worker = fleet.is_worker
+is_server = fleet.is_server
+worker_endpoints = fleet.worker_endpoints
+server_endpoints = fleet.server_endpoints
+server_num = fleet.server_num
+server_index = fleet.server_index
+rank = fleet.rank
+nranks = fleet.nranks
+world_size = fleet.world_size
+local_rank = fleet.local_rank
+local_device_ids = fleet.local_device_ids
+world_device_ids = fleet.world_device_ids
+node_num = fleet.node_num
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+shrink = fleet.shrink
+minimize = fleet.minimize
+step = fleet.step
+clear_grad = fleet.clear_grad
+get_lr = fleet.get_lr
+set_lr = fleet.set_lr
+state_dict = fleet.state_dict
+set_state_dict = fleet.set_state_dict
+save_inference_model = fleet.save_inference_model
+save_persistables = fleet.save_persistables
+load_model = fleet.load_model
+
+# dataset + util namespace parity
+from ...io.dataset import (DatasetBase, InMemoryDataset,  # noqa: E402,F401
+                           QueueDataset)
+from .utils import UtilBase  # noqa: E402,F401
+from .data_generator import (MultiSlotDataGenerator,  # noqa: E402,F401
+                             MultiSlotStringDataGenerator)
+from . import metrics  # noqa: E402,F401
+util = fleet.util
+
+
+class FileInstantDataset(QueueDataset):
+    """Streaming per-file dataset (reference FileInstantDataset — the
+    QueueDataset streaming semantics already match)."""
+
+
+class BoxPSDataset:
+    def __init__(self, *a, **k):
+        raise RuntimeError("BoxPS is a GPU parameter-server feature; use "
+                           "io.InMemoryDataset on TPU")
 

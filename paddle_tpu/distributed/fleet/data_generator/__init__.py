@@ -1,0 +1,5 @@
+from .data_generator import (DataGenerator, MultiSlotDataGenerator,  # noqa: F401
+                             MultiSlotStringDataGenerator)
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]

@@ -1,0 +1,68 @@
+"""Slot-format data generators (reference: fleet/data_generator/
+data_generator.py — user subclasses generate_sample; run_from_stdin emits
+the MultiSlot text protocol consumed by the dataset pipeline)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable
+
+
+class DataGenerator:
+    def __init__(self):
+        self._line_limit = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        """Override: return a zero-arg generator yielding
+        [(slot_name, values), ...] per sample."""
+        raise NotImplementedError(
+            "Please rewrite this function to return a list or tuple: " +
+            "[(name, [feasign, ...]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _format(self, slots) -> str:
+        raise NotImplementedError
+
+    def run_from_stdin(self):
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for slots in gen():
+                sys.stdout.write(self._format(slots))
+
+    def run_from_memory(self, lines: Iterable[str]):
+        out = []
+        for line in lines:
+            gen = self.generate_sample(line)
+            for slots in gen():
+                out.append(self._format(slots))
+        return out
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """numeric slots: `<n> v1 ... vn` per slot (reference MultiSlot text
+    protocol)."""
+
+    def _format(self, slots) -> str:
+        parts = []
+        for _name, values in slots:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    def _format(self, slots) -> str:
+        parts = []
+        for _name, values in slots:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

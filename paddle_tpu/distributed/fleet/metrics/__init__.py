@@ -1,0 +1,5 @@
+from .metric import acc, auc, max, mean, min, rmse, sum  # noqa: F401
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "mse", "acc", "mean"]
+
+from .metric import mae, mse  # noqa: F401

@@ -1,0 +1,79 @@
+"""Distributed metric reductions (reference: fleet/metrics/metric.py —
+all-reduced global metrics over the worker group).
+
+TPU-native: the all-reduce is the eager collective (identity in a single
+process, psum across the mesh inside shard_map/multi-process runs).
+"""
+
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+
+
+def _np(x):
+    return np.asarray(getattr(x, "_data", x), dtype=np.float64)
+
+
+def _allreduce(value, op="sum"):
+    from ...collective import all_reduce, ReduceOp
+    from ....core.tensor import Tensor
+    import jax.numpy as jnp
+    t = Tensor(jnp.asarray(value))
+    ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN}
+    out = all_reduce(t, op=ops[op])
+    return np.asarray(getattr(out, "_data", out))
+
+
+def sum(input, scope=None, util=None):
+    return float(_allreduce(_np(input).sum(), "sum"))
+
+
+def max(input, scope=None, util=None):
+    return float(_allreduce(_np(input).max(), "max"))
+
+
+def min(input, scope=None, util=None):
+    return float(_allreduce(_np(input).min(), "min"))
+
+
+def mean(input, scope=None, util=None):
+    total = _allreduce(np.array([_np(input).sum(), _np(input).size]), "sum")
+    return float(total[0] / builtins.max(total[1], 1))
+
+
+def acc(correct, total, scope=None, util=None):
+    agg = _allreduce(np.array([_np(correct).sum(), _np(total).sum()]), "sum")
+    return float(agg[0] / builtins.max(agg[1], 1e-12))
+
+
+def mae(abserr, total_ins_num, scope=None, util=None):
+    agg = _allreduce(np.array([_np(abserr).sum(), float(total_ins_num)]), "sum")
+    return float(agg[0] / builtins.max(agg[1], 1e-12))
+
+
+def mse(sqrerr, total_ins_num, scope=None, util=None):
+    agg = _allreduce(np.array([_np(sqrerr).sum(), float(total_ins_num)]), "sum")
+    return float(agg[0] / builtins.max(agg[1], 1e-12))
+
+
+def rmse(sqrerr, total_ins_num, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total_ins_num)))
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from the threshold-bucket stats (reference metric.py:144)."""
+    pos = _allreduce(_np(stat_pos), "sum")
+    neg = _allreduce(_np(stat_neg), "sum")
+    # walk buckets high→low accumulating the trapezoid area
+    tot_pos = tot_neg = 0.0
+    area = 0.0
+    for b in range(len(pos) - 1, -1, -1):
+        new_pos = tot_pos + pos[b]
+        new_neg = tot_neg + neg[b]
+        area += (new_neg - tot_neg) * (tot_pos + new_pos) / 2.0
+        tot_pos, tot_neg = new_pos, new_neg
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))

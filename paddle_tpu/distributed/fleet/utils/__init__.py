@@ -47,3 +47,40 @@ def broadcast_dp_parameters(model, hcg):
 
 def broadcast_sharding_parameters(model, hcg):
     return None
+
+
+class UtilBase:
+    """Fleet util surface (reference fleet/base/util_factory.py UtilBase):
+    collective helpers + filesystem passthroughs."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        from ..metrics.metric import _allreduce
+        import numpy as np
+        return _allreduce(np.asarray(input), mode)
+
+    def barrier(self, comm_world="worker"):
+        from ...collective import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ...collective import all_gather_object
+        out = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Split a file list over workers with the remainder spread one file
+        at a time (util_factory.py: shard sizes differ by at most 1 — a
+        ceil-sized contiguous split would hand trailing workers ZERO files
+        and deadlock them at the first collective)."""
+        from .. import fleet
+        n = fleet.worker_num()
+        i = fleet.worker_index()
+        base, rem = divmod(len(files), n)
+        start = i * base + min(i, rem)
+        return files[start:start + base + (1 if i < rem else 0)]
+
+    def print_on_rank(self, message, rank_id=0):
+        from .. import fleet
+        if fleet.worker_index() == rank_id:
+            print(message)

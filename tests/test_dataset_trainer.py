@@ -259,3 +259,90 @@ class TestSecondReviewRegressions:
         monkeypatch.setenv("PADDLE_PORT", "8000")
         with pytest.raises(ValueError, match="not in"):
             PaddleCloudRoleMaker(is_collective=False).is_server()
+
+
+class TestFleetNamespace:
+    def test_fleet_passthroughs(self):
+        from paddle_tpu.distributed import fleet
+        st = fleet.DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=st)
+        assert fleet.rank() == fleet.worker_index()
+        assert fleet.nranks() == fleet.worker_num() == fleet.world_size()
+        assert fleet.is_worker() and not fleet.is_server()
+        assert isinstance(fleet.worker_endpoints(), list)
+        assert isinstance(fleet.worker_endpoints(to_string=True), str)
+        assert fleet.node_num() >= 1
+        assert len(fleet.local_device_ids()) >= 1
+        fleet.init_worker(); fleet.stop_worker()  # no-ops in collective mode
+        with pytest.raises(RuntimeError, match="non-goal"):
+            fleet.init_server()
+        import paddle_tpu.nn as nn
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.SGD(0.1, parameters=nn.Linear(2, 2).parameters()))
+        assert fleet.get_lr() == pytest.approx(0.1)
+
+    def test_fleet_metrics_and_util(self):
+        from paddle_tpu.distributed import fleet
+        assert fleet.metrics.sum(np.array([1.0, 2.0])) == 3.0
+        assert fleet.metrics.acc(np.array(8.0), np.array(10.0)) == \
+            pytest.approx(0.8)
+        assert fleet.metrics.rmse(np.array([8.0]), 2) == pytest.approx(2.0)
+        # auc on a clean separation: all positives above all negatives
+        pos = np.zeros(10); pos[9] = 5
+        neg = np.zeros(10); neg[0] = 5
+        assert fleet.metrics.auc(pos, neg) == pytest.approx(1.0)
+        util = fleet.UtilBase()
+        assert util.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+
+    def test_data_generator_protocol(self):
+        from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+        class G(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def gen():
+                    vals = [float(v) for v in line.split()]
+                    yield [("feat", vals), ("label", [int(vals[0] > 0)])]
+                return gen
+
+        g = G()
+        lines = g.run_from_memory(["1.0 2.0", "-1.0 0.5"])
+        assert lines[0] == "2 1.0 2.0 1 1\n"
+        assert lines[1] == "2 -1.0 0.5 1 0\n"
+
+
+class TestFleetReviewRegressions:
+    def test_util_wired_and_all_gather(self):
+        from paddle_tpu.distributed import fleet
+        st = fleet.DistributedStrategy()
+        st.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=st)
+        assert fleet.fleet.util is fleet.fleet.util  # cached instance
+        assert fleet.util.all_gather(7) == [7]
+
+    def test_file_shard_remainder_spread(self, monkeypatch):
+        from paddle_tpu.distributed import fleet
+        monkeypatch.setattr(fleet.fleet, "worker_num", lambda: 4)
+        shards = []
+        for i in range(4):
+            monkeypatch.setattr(fleet.fleet, "worker_index", lambda i=i: i)
+            shards.append(fleet.util.get_file_shard(list("abcde")))
+        assert [len(s) for s in shards] == [2, 1, 1, 1]
+        assert sum(shards, []) == list("abcde")
+
+    def test_save_persistables_layer_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed import fleet
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(3, 2)
+        fleet.save_persistables(None, str(tmp_path), main_program=lin)
+        loaded = fleet.load_model(str(tmp_path))
+        np.testing.assert_allclose(np.asarray(loaded["weight"]._data),
+                                   np.asarray(lin.weight._data))
+        with pytest.raises(ValueError, match="no parameters"):
+            fleet.save_persistables(None, str(tmp_path / "x"))
+
+    def test_save_inference_model_requires_program(self, tmp_path):
+        from paddle_tpu.distributed import fleet
+        with pytest.raises(ValueError, match="main_program"):
+            fleet.save_inference_model(None, str(tmp_path / "m"))
