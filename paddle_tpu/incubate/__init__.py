@@ -6,7 +6,29 @@ Graduated-but-experimental surface: LookAhead / ModelAverage optimizers
 reference layout.
 """
 
-from . import asp, checkpoint, optimizer  # noqa: F401
+from . import asp, checkpoint, nn, optimizer  # noqa: F401
+from .checkpoint import auto_checkpoint  # noqa: F401
+from .ops import (graph_send_recv, segment_max, segment_mean,  # noqa: F401
+                  segment_min, segment_sum, softmax_mask_fuse,
+                  softmax_mask_fuse_upper_triangle)
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 
-__all__ = ["optimizer", "checkpoint", "asp", "LookAhead", "ModelAverage"]
+__all__ = ["optimizer", "checkpoint", "asp", "nn", "LookAhead", "ModelAverage",
+           "auto_checkpoint", "segment_sum", "segment_mean", "segment_max",
+           "segment_min", "graph_send_recv", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+class LayerHelper:
+    """fluid-internal layer builder (reference fluid/layer_helper.py),
+    surfaced in incubate for legacy imports; the dynamic Layer system
+    replaces it — constructing one points to nn.Layer."""
+
+    def __init__(self, *a, **k):
+        raise RuntimeError("LayerHelper builds static-graph ops; subclass "
+                          "paddle.nn.Layer instead")
+
+
+def fuse_resnet_unit_pass(*a, **k):
+    """cudnn resnet_unit fusion pass (reference fuse_resnet_unit_pass) —
+    XLA performs conv+BN+activation fusion automatically; no-op."""

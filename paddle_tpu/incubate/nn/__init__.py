@@ -1,0 +1,138 @@
+"""incubate.nn fused layers (reference: incubate/nn/layer/fused_transformer.py
+— FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer).
+
+TPU-native: "fused" here means the flash-attention Pallas kernel plus XLA's
+automatic elementwise fusion — the layers share weights-and-math semantics
+with the reference's fused CUDA ops while the fusion itself is compiled.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...ops.attention import scaled_dot_product_attention
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Pre/post-LN attention block with residual (reference
+    fused_attention_op semantics: LN → QKV → FMHA → out-proj → dropout →
+    residual [→ LN])."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim % num_heads != 0:
+            raise ValueError(f"num_heads ({num_heads}) must divide embed_dim "
+                             f"({embed_dim})")
+        if kdim not in (None, embed_dim) or vdim not in (None, embed_dim):
+            raise NotImplementedError(
+                "FusedMultiHeadAttention is self-attention (the reference's "
+                "fused_attention op has the same restriction); kdim/vdim "
+                "must equal embed_dim")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim,
+                             weight_attr=qkv_weight_attr,
+                             bias_attr=qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr,
+                                  bias_attr=linear_bias_attr)
+        # only the LayerNorm the chosen mode uses (dead params would bloat
+        # optimizer state and state_dicts)
+        self.ln = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query) or cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention computes self-attention only "
+                "(cross-attention key/value and incremental cache are not "
+                "fused; use nn.MultiHeadAttention)")
+        residual = query
+        x = self.ln(query) if self.normalize_before else query
+        B, L, _ = x.shape
+        qkv = self.qkv(x)
+        H, D = self.num_heads, self.embed_dim // self.num_heads
+        q, k, v = [t.reshape([B, L, H, D]) for t in qkv.chunk(3, axis=-1)]
+        out = scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training)
+        out = self.out_proj(out.reshape([B, L, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """LN → linear → act → dropout → linear → dropout → residual
+    (reference fused_feedforward_op)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.ln = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.drop_act = nn.Dropout(act_dropout_rate if act_dropout_rate
+                                   is not None else dropout_rate)
+        self.drop_out = nn.Dropout(dropout_rate)
+
+    def forward(self, x):
+        residual = x
+        if self.normalize_before:
+            x = self.ln(x)
+        x = self.drop_act(getattr(F, self.activation)(self.linear1(x)))
+        x = self.drop_out(self.linear2(x))
+        out = residual + x
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """Attention + FFN block built from the two fused sublayers (reference
+    FusedTransformerEncoderLayer)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate
+            is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -1,0 +1,110 @@
+"""Incubate tensor ops (reference: python/paddle/incubate/__init__.py —
+segment_{sum,mean,max,min} (incubate/tensor/math.py), graph_send_recv
+(incubate/operators/), softmax_mask_fuse / softmax_mask_fuse_upper_triangle
+(fused_softmax_mask ops).
+
+TPU-native: segment reductions are ``jax.ops.segment_*`` (native scatter
+HLO); graph message passing is gather + segment-reduce; the fused-softmax
+ops are plain fp32 compositions — XLA fuses the mask add into the softmax,
+which is the entire content of the reference's CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import apply
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "graph_send_recv", "softmax_mask_fuse",
+           "softmax_mask_fuse_upper_triangle"]
+
+
+def _num_segments(ids, op_name):
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            f"{op_name} needs concrete segment ids under jit; pad ids to a "
+            f"static num_segments and call the jax.ops primitive directly")
+    return int(jax.device_get(jnp.max(ids))) + 1
+
+
+def _segment_reduce(op_name, x, ids, n):
+    """Shared reduction core: zero untouched segments like the reference
+    segment_pool kernel (jax fills them with ±inf identities for max/min)."""
+    if op_name == "mean":
+        s = jax.ops.segment_sum(x, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                num_segments=n)
+        return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (x.ndim - 1))
+    fn = getattr(jax.ops, f"segment_{op_name}")
+    out = fn(x, ids, num_segments=n)
+    if op_name in ("max", "min"):
+        touched = jax.ops.segment_sum(jnp.ones((x.shape[0],), x.dtype), ids,
+                                      num_segments=n)
+        out = jnp.where((touched > 0).reshape((-1,) + (1,) * (x.ndim - 1)),
+                        out, 0)
+    return out
+
+
+def _segment(op_name, data, segment_ids):
+    def f(x, ids):
+        ids = ids.astype(jnp.int32)
+        n = _num_segments(ids, f"segment_{op_name}")
+        return _segment_reduce(op_name, x, ids, n)
+    return apply(f, data, segment_ids)
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment("sum", data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment("mean", data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment("max", data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment("min", data, segment_ids)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """GNN message passing (reference incubate/operators/graph_send_recv):
+    gather rows at ``src_index``, reduce them at ``dst_index``."""
+    pool = pool_type.lower()
+    if pool not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"pool_type must be sum/mean/max/min, got {pool_type}")
+
+    def f(xv, src, dst):
+        src = src.astype(jnp.int32)
+        dst = dst.astype(jnp.int32)
+        n = int(out_size) if out_size else xv.shape[0]
+        return _segment_reduce(pool, xv[src], dst, n)
+
+    return apply(f, x, src_index, dst_index)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in fp32 (reference fused_softmax_mask_op.cu —
+    the fusion is XLA's job here)."""
+    def f(a, m):
+        return jax.nn.softmax(a.astype(jnp.float32) + m.astype(jnp.float32),
+                              axis=-1).astype(a.dtype)
+    return apply(f, x, mask)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """Causal softmax: mask out the upper triangle (reference
+    fused_softmax_mask_upper_triangle_op.cu)."""
+    def f(a):
+        L, M = a.shape[-2], a.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (L, M), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (L, M), 1)
+        allowed = col <= row
+        z = jnp.where(allowed, a.astype(jnp.float32), -1e30)
+        return jax.nn.softmax(z, axis=-1).astype(a.dtype)
+    return apply(f, x)

@@ -2,6 +2,13 @@
 
 from .. import profiler  # noqa: F401  (paddle.utils.profiler parity)
 from . import cpp_extension  # noqa: F401
+from . import dlpack, download  # noqa: F401
+from ..profiler import Profiler, profiler as get_profiler  # noqa: F401
+
+
+class ProfilerOptions(dict):
+    """Legacy fluid profiler options holder (utils/profiler.py)."""
+
 
 
 def try_import(name: str):
@@ -12,3 +19,121 @@ def try_import(name: str):
     except ImportError as e:
         raise ImportError(f"optional dependency {name!r} is not available "
                           f"in this environment") from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Deprecation decorator (reference utils/deprecated.py): warns once per
+    call site and keeps the wrapped signature."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version (reference utils/__init__.py
+    require_version)."""
+    from .. import __version__
+
+    def parse(v):
+        parts = [int(p) for p in str(v).split(".")[:3] if p.isdigit()]
+        return tuple(parts + [0] * (3 - len(parts)))  # "0.1" == "0.1.0"
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {__version__} < required min {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {__version__} > required max {max_version}")
+
+
+def run_check():
+    """Install sanity check (reference utils/install_check.py run_check):
+    a tiny matmul + grad on every local device, then a sharded matmul over
+    all of them."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    devs = jax.local_devices()
+    x = jnp.ones((4, 4))
+    for d in devs:
+        y = jax.device_put(x, d)
+        out = jax.jit(lambda a: (a @ a).sum())(y)
+        assert np.isfinite(float(out))
+    g = jax.grad(lambda a: (a @ a).sum())(x)
+    assert np.isfinite(np.asarray(g)).all()
+    if len(devs) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("x",))
+        xs = jax.device_put(jnp.ones((len(devs) * 2, 4)),
+                            NamedSharding(mesh, P("x")))
+        out = jax.jit(lambda a: (a @ a.T).sum(),
+                      out_shardings=NamedSharding(mesh, P()))(xs)
+        assert np.isfinite(float(out))
+    print(f"PaddleTPU works well on {len(devs)} {jax.default_backend()} "
+          f"device(s).")
+    print("PaddleTPU is installed successfully!")
+
+
+class _UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+        self.prefix = ""
+
+    def __call__(self, key):
+        tmp = self.ids.get(key, 0)
+        self.ids[key] = tmp + 1
+        return f"{self.prefix}{key}_{tmp}"
+
+
+class _UniqueNameModule:
+    """paddle.utils.unique_name (reference fluid/unique_name.py)."""
+
+    def __init__(self):
+        self._gen = _UniqueNameGenerator()
+
+    def generate(self, key):
+        return self._gen(key)
+
+    def switch(self, new_generator=None):
+        old = self._gen
+        if isinstance(new_generator, (str, bytes)):
+            # reference contract: a string is a namespace PREFIX
+            gen = _UniqueNameGenerator()
+            gen.prefix = new_generator.decode() \
+                if isinstance(new_generator, bytes) else new_generator
+            self._gen = gen
+        else:
+            self._gen = new_generator or _UniqueNameGenerator()
+        return old
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            old = self.switch(new_generator)
+            try:
+                yield
+            finally:
+                self._gen = old
+        return ctx()
+
+
+unique_name = _UniqueNameModule()

@@ -1,0 +1,29 @@
+"""DLPack interop (reference: utils/dlpack.py to_dlpack/from_dlpack) —
+zero-copy exchange with other frameworks via the array-object DLPack
+protocol (``__dlpack__``/``__dlpack_device__``; the legacy PyCapsule form
+was retired by the ecosystem and by jax 0.9)."""
+
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Return a DLPack-capable array object for ``x`` (consumable by
+    torch/np/cupy ``from_dlpack``)."""
+    return getattr(x, "_data", x)
+
+
+def from_dlpack(ext):
+    """Wrap an external DLPack-capable array (torch/np/cupy tensor, or the
+    object returned by :func:`to_dlpack`) as a Tensor, zero-copy where the
+    producer allows it."""
+    import jax.dlpack
+    if not hasattr(ext, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack expects an array object implementing __dlpack__ "
+            "(the legacy PyCapsule protocol is no longer supported — pass "
+            "the tensor itself)")
+    return Tensor(jax.dlpack.from_dlpack(ext))

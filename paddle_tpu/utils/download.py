@@ -1,0 +1,28 @@
+"""Download helpers (reference: utils/download.py get_weights_path_from_url).
+This build runs with zero network egress, so remote fetches raise with a
+pointer to the local-path alternatives every dataset/model accepts."""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url"]
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    # honor an already-downloaded local file (the reference's cache-hit path)
+    if root_dir:
+        cand = os.path.join(root_dir, os.path.basename(url))
+        if os.path.exists(cand):
+            return cand
+    if os.path.exists(url):
+        return url
+    raise RuntimeError(
+        f"cannot download {url!r}: this environment has no network egress. "
+        f"Place the file locally and pass its path (datasets take "
+        f"data_file=, models load local state_dicts)")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, root_dir=os.path.expanduser(
+        "~/.cache/paddle_tpu/weights"))
