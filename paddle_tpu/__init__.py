@@ -28,6 +28,7 @@ from .tensor import cast, is_tensor, rank, shape  # noqa: F401
 __version__ = "0.1.0"
 
 bool = bool_  # noqa: A001
+reverse = flip  # noqa: F405 — fluid-era alias (reference fluid/layers reverse)
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,

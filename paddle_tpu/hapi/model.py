@@ -102,6 +102,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
         from ..io import DataLoader, Dataset
+        # Dataset-only wrapping (reference model.py:1708 contract: a plain
+        # list is iterated as a loader of already-collated batches)
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,

@@ -49,7 +49,7 @@ class Accuracy(Metric):
 
     def compute(self, pred, label, *args):
         pred = _np(pred)
-        label = _np(label)
+        label = np.atleast_1d(_np(label))
         order = np.argsort(-pred, axis=-1)[..., :self.maxk]
         if label.ndim == pred.ndim and label.shape[-1] != 1:
             label = label.argmax(-1)

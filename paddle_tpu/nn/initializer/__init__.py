@@ -187,3 +187,38 @@ UniformInitializer = Uniform
 XavierInitializer = XavierUniform
 MSRAInitializer = KaimingNormal
 NumpyArrayInitializer = Assign
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference:
+    fluid/initializer.py BilinearInitializer — nn/initializer/__init__.py
+    exports it as Bilinear).  Weight shape (C_out, C_in, kH, kW)."""
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(f"Bilinear expects a 4-D conv weight, got {shape}")
+        # every (out, in) channel pair gets the bilinear kernel, exactly as
+        # the reference writes weight[i] = filt for all flat indices; like the
+        # reference, f derives from shape[3] and serves both axes
+        f = int(np.ceil(shape[3] / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:shape[2], :shape[3]]
+        filt = (1 - abs(og[0] / f - c)) * (1 - abs(og[1] / f - c))
+        out = np.broadcast_to(filt.astype(np.float32), shape)
+        return jnp.asarray(np.ascontiguousarray(out), convert_dtype(dtype))
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Set process-wide default initializers (reference: fluid/initializer.py
+    set_global_initializer).  Layers consult this when no explicit
+    weight_attr/bias_attr initializer is given; pass None to reset."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)
+
+
+def get_global_initializer():
+    return _global_initializer

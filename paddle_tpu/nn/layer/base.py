@@ -123,10 +123,18 @@ class Layer:
         init = None
         if attr is not None and attr.initializer is not None:
             init = attr.initializer
-        elif default_initializer is not None:
-            init = default_initializer
         else:
-            init = Constant(0.0) if is_bias else XavierUniform()
+            # an explicit ParamAttr initializer wins; otherwise the
+            # process-wide global outranks the layer's own default
+            # (reference: fluid/initializer.py set_global_initializer)
+            from ..initializer import get_global_initializer
+            glob = get_global_initializer()
+            if glob is not None:
+                init = glob[1] if is_bias else glob[0]
+            if init is None:
+                init = default_initializer
+            if init is None:
+                init = Constant(0.0) if is_bias else XavierUniform()
         data = init(shape, dtype)
         p = Parameter(data, name=(attr.name if attr is not None else None),
                       trainable=(attr.trainable if attr is not None else True))

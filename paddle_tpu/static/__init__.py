@@ -425,3 +425,101 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+
+# --------------------------------------------------------------------------
+# Program serialization surface (reference: static/io.py — serialize_program
+# :414, serialize_persistables :447, save_to_file :514, deserialize_program
+# :584, deserialize_persistables :615, load_from_file :693, normalize_program
+# :358; fluid/io.py load_program_state :2191, set_program_state :2305).
+# The TPU program IR is the traced jaxpr/StableHLO (jit.save); what a static
+# Program carries here is its parameter scope, so (de)serialization is over
+# that state — the graph itself serializes through ``jit.save``.
+# --------------------------------------------------------------------------
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None, **kw):
+    import pickle
+    prog = program or default_main_program()
+    meta = {"random_seed": getattr(prog, "random_seed", 0),
+            "params": sorted(getattr(prog, "_params", {}))}
+    return pickle.dumps(meta, protocol=4)
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None, **kw):
+    import pickle
+    prog = program or default_main_program()
+    state = {n: np.asarray(getattr(p, "_data", p))
+             for n, p in getattr(prog, "_params", {}).items()}
+    return pickle.dumps(state, protocol=4)
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def deserialize_program(data: bytes):
+    import pickle
+    meta = pickle.loads(data)
+    prog = Program()
+    prog.random_seed = meta.get("random_seed", 0)
+    return prog
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    state = pickle.loads(data)
+    params = getattr(program, "_params", None)
+    if params is not None:
+        for n, v in state.items():
+            params[n] = Tensor(jnp.asarray(v))
+    return state
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None):
+    """Reference normalize_program prunes feed/fetch ops for inference export;
+    traced jaxprs are already feed/fetch-free, so this is the identity."""
+    return program
+
+
+def load_program_state(model_path: str, var_list=None):
+    from ..framework import io as _io
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = _io.load(path)
+    if var_list is not None:
+        names = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def set_program_state(program, state_dict):
+    params = getattr(program, "_params", None)
+    if params is not None:
+        for n, v in state_dict.items():
+            params[n] = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+
+
+def xpu_places(device_ids=None):
+    raise RuntimeError("Not compiled with XPU — this build targets TPU "
+                      "(reference static xpu_places has the same gate)")
+
+
+def npu_places(device_ids=None):
+    raise RuntimeError("Not compiled with NPU — this build targets TPU "
+                      "(reference static npu_places has the same gate)")
+
+
+Variable = Tensor  # static Variable ≙ traced Tensor (framework.py:915)
+
+__all__ += [
+    "serialize_program", "serialize_persistables", "save_to_file",
+    "deserialize_program", "deserialize_persistables", "load_from_file",
+    "normalize_program", "load_program_state", "set_program_state",
+    "xpu_places", "npu_places", "Variable",
+]

@@ -138,3 +138,385 @@ class RoIAlign:
 
     def __call__(self, x, boxes, boxes_num):
         return roi_align(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def _roi_batch_index(boxes_num, n_rois):
+    """Map each RoI row to its source image (eager: boxes_num is a small
+    host-side count vector, ≙ the reference's RoisNum input)."""
+    if boxes_num is None:
+        return jnp.zeros((n_rois,), jnp.int32)
+    bn = np.asarray(getattr(boxes_num, "_data", boxes_num)).astype(np.int64)
+    if int(bn.sum()) != n_rois:
+        raise ValueError(f"sum(boxes_num)={int(bn.sum())} must equal the "
+                         f"number of RoIs {n_rois}")
+    return jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Quantized max RoI pooling (reference: roi_pool_op, vision/ops.py:1022).
+
+    Bin edges follow the reference kernel: rounded roi corners, width/height
+    floored at 1, per-bin max over the integer grid; empty bins yield 0."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs, batch_idx):
+        N, C, H, W = feat.shape
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+
+        def one_roi(box, bi):
+            x1 = jnp.round(box[0] * spatial_scale)
+            y1 = jnp.round(box[1] * spatial_scale)
+            x2 = jnp.round(box[2] * spatial_scale)
+            y2 = jnp.round(box[3] * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bh, bw = rh / oh, rw / ow
+            img = feat[bi]  # (C, H, W)
+
+            def one_bin(i, j):
+                hs = jnp.floor(i * bh) + y1
+                he = jnp.ceil((i + 1) * bh) + y1
+                ws = jnp.floor(j * bw) + x1
+                we = jnp.ceil((j + 1) * bw) + x1
+                rmask = (rows >= jnp.clip(hs, 0, H)) & (rows < jnp.clip(he, 0, H))
+                cmask = (cols >= jnp.clip(ws, 0, W)) & (cols < jnp.clip(we, 0, W))
+                m = rmask[:, None] & cmask[None, :]
+                vals = jnp.where(m[None], img, -jnp.inf)
+                mx = jnp.max(vals, axis=(1, 2))
+                return jnp.where(jnp.any(m), mx, 0.0)
+
+            ii, jj = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                                  jnp.arange(ow, dtype=jnp.float32), indexing="ij")
+            out = jax.vmap(jax.vmap(one_bin))(ii, jj)  # (oh, ow, C)
+            return jnp.transpose(out, (2, 0, 1))
+        return jax.vmap(one_roi)(bxs, batch_idx)
+
+    n_rois = getattr(boxes, "shape", np.shape(boxes))[0]
+    bi = _roi_batch_index(boxes_num, n_rois)
+    return apply(lambda fe, bx: f(fe, bx, bi), x, boxes)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI average pooling (reference: psroi_pool_op,
+    vision/ops.py:911).  Input channels C must equal out_ch * oh * ow; output
+    channel c at bin (i, j) averages input channel (c*oh + i)*ow + j."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs, batch_idx):
+        N, C, H, W = feat.shape
+        assert C % (oh * ow) == 0, \
+            f"psroi_pool needs channels divisible by {oh * ow}, got {C}"
+        out_ch = C // (oh * ow)
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+
+        def one_roi(box, bi):
+            x1 = jnp.round(box[0]) * spatial_scale
+            y1 = jnp.round(box[1]) * spatial_scale
+            x2 = jnp.round(box[2] + 1.0) * spatial_scale
+            y2 = jnp.round(box[3] + 1.0) * spatial_scale
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bh, bw = rh / oh, rw / ow
+            img = feat[bi].reshape(out_ch, oh, ow, H, W)
+
+            def one_bin(i, j):
+                hs = jnp.floor(y1 + i * bh)
+                he = jnp.ceil(y1 + (i + 1) * bh)
+                ws = jnp.floor(x1 + j * bw)
+                we = jnp.ceil(x1 + (j + 1) * bw)
+                rmask = (rows >= jnp.clip(hs, 0, H)) & (rows < jnp.clip(he, 0, H))
+                cmask = (cols >= jnp.clip(ws, 0, W)) & (cols < jnp.clip(we, 0, W))
+                m = (rmask[:, None] & cmask[None, :]).astype(feat.dtype)
+                ii = i.astype(jnp.int32)
+                jj = j.astype(jnp.int32)
+                chans = img[:, ii, jj]  # (out_ch, H, W)
+                s = jnp.sum(chans * m[None], axis=(1, 2))
+                cnt = jnp.sum(m)
+                return jnp.where(cnt > 0, s / jnp.maximum(cnt, 1.0), 0.0)
+
+            ii, jj = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                                  jnp.arange(ow, dtype=jnp.float32), indexing="ij")
+            out = jax.vmap(jax.vmap(one_bin))(ii, jj)  # (oh, ow, out_ch)
+            return jnp.transpose(out, (2, 0, 1))
+        return jax.vmap(one_roi)(bxs, batch_idx)
+
+    n_rois = getattr(boxes, "shape", np.shape(boxes))[0]
+    bi = _roi_batch_index(boxes_num, n_rois)
+    return apply(lambda fe, bx: f(fe, bx, bi), x, boxes)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: deformable_conv_op,
+    vision/ops.py:423).  ``mask=None`` → v1; with mask → modulated (v2).
+
+    offset: (N, 2*dg*kh*kw, Hout, Wout), (dy, dx) pairs per kernel point;
+    mask: (N, dg*kh*kw, Hout, Wout).  Implemented as bilinear gather of the
+    kh*kw deformed patches followed by a grouped einsum — the sampling is
+    bandwidth-bound gather (no MXU), the contraction runs on the MXU."""
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def f(xx, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        N, C, H, W = xx.shape
+        Cout, Cg, kh, kw = w.shape
+        K = kh * kw
+        dg = deformable_groups
+        Hout = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        Wout = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        off = off.reshape(N, dg, K, 2, Hout, Wout)
+
+        # per kernel point k = i*kw + j: sample position
+        ky = jnp.repeat(jnp.arange(kh), kw)                  # (K,)
+        kx = jnp.tile(jnp.arange(kw), kh)
+
+        def sample_image(img, off_i, mask_i):
+            # img: (C, H, W); off_i: (dg, K, 2, Hout, Wout); mask_i: (dg,K,Hout,Wout)
+            py = (jnp.arange(Hout) * sh - ph)[None, None, :, None] \
+                + (ky * dh)[None, :, None, None] + off_i[:, :, 0]   # (dg,K,Hout,Wout)
+            px = (jnp.arange(Wout) * sw - pw)[None, None, None, :] \
+                + (kx * dw)[None, :, None, None] + off_i[:, :, 1]
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+
+            def g(yy, xx_):
+                yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+                xi = jnp.clip(xx_.astype(jnp.int32), 0, W - 1)
+                inb = ((yy >= 0) & (yy <= H - 1) & (xx_ >= 0) & (xx_ <= W - 1))
+                cpg = C // dg  # channels per deformable group
+                imgg = img.reshape(dg, cpg, H, W)
+                # gather per deformable group: (dg, cpg, K, Hout, Wout)
+                vals = jax.vmap(lambda im, yg, xg: im[:, yg, xg])(imgg, yi, xi)
+                return vals * inb[:, None].astype(img.dtype)
+
+            patches = (g(y0, x0) * ((1 - wy) * (1 - wx))[:, None]
+                       + g(y0, x0 + 1) * ((1 - wy) * wx)[:, None]
+                       + g(y0 + 1, x0) * (wy * (1 - wx))[:, None]
+                       + g(y0 + 1, x0 + 1) * (wy * wx)[:, None])
+            if mask_i is not None:
+                patches = patches * mask_i[:, None]
+            return patches.reshape(C, K, Hout, Wout)
+
+        if m is not None:
+            patches = jax.vmap(sample_image)(xx, off, m.reshape(N, dg, K, Hout, Wout))
+        else:
+            patches = jax.vmap(lambda im, of: sample_image(im, of, None))(xx, off)
+        # grouped contraction on the MXU
+        wk = w.reshape(groups, Cout // groups, Cg, K)
+        pat = patches.reshape(N, groups, Cg, K, Hout, Wout)
+        out = jnp.einsum("ngckhw,gock->ngohw", pat, wk).reshape(N, Cout, Hout, Wout)
+        return out
+
+    args = [x, offset, weight] + ([mask] if mask is not None else [])
+    out = apply(f, *args)
+    if bias is not None:
+        out = apply(lambda o, b: o + jnp.asarray(b)[None, :, None, None], out, bias)
+    return out
+
+
+from ..nn.layer.base import Layer as _Layer  # noqa: E402
+
+
+class DeformConv2D(_Layer):
+    """Deformable conv layer (reference: vision/ops.py:626 DeformConv2D).
+    Trainable weight/bias created through the Layer parameter machinery so
+    weight_attr/bias_attr initializers and state_dict work as usual."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        from ..nn.initializer import Uniform
+        bound = 1.0 / float(np.sqrt(in_channels * kh * kw))
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw), attr=weight_attr,
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference: yolo_loss_op / vision/ops.py:42).
+
+    x: (N, S*(5+cls), H, W); gt_box: (N, B, 4) center-form, normalized to the
+    input image; gt_label: (N, B) int; returns (N,) per-image loss.  Matches
+    the reference formulation: BCE on x/y, L1 on w/h (scaled by 2-w*h), BCE
+    objectness with ignore region (pred/gt IoU > ignore_thresh), BCE class
+    with optional label smoothing.  Each gt picks its best anchor over ALL
+    anchors; only gts whose best anchor is in ``anchor_mask`` are assigned."""
+    an_all = np.asarray(anchors, "float32").reshape(-1, 2)
+    an_mask = list(anchor_mask)
+    S = len(an_mask)
+
+    def f(feat, gtb, gtl, *rest):
+        gts = rest[0] if gt_score is not None else None
+        N, C, H, W = feat.shape
+        B = gtb.shape[1]
+        assert C == S * (5 + class_num), (C, S, class_num)
+        img_w = W * downsample_ratio
+        img_h = H * downsample_ratio
+        p = feat.reshape(N, S, 5 + class_num, H, W)
+        anc = jnp.asarray(an_all)                    # (A, 2) in input-image px
+        anc_sel = jnp.asarray(an_all[an_mask])       # (S, 2)
+
+        valid = (gtb[:, :, 2] > 1e-8) & (gtb[:, :, 3] > 1e-8)  # (N, B)
+        # best anchor per gt via shape-only IoU (both centered at origin)
+        gw = gtb[:, :, 2] * img_w
+        gh = gtb[:, :, 3] * img_h
+        inter = jnp.minimum(gw[..., None], anc[None, None, :, 0]) * \
+            jnp.minimum(gh[..., None], anc[None, None, :, 1])
+        union = gw[..., None] * gh[..., None] + \
+            anc[None, None, :, 0] * anc[None, None, :, 1] - inter
+        best = jnp.argmax(inter / (union + 1e-10), axis=-1)     # (N, B)
+        in_mask = jnp.zeros_like(best, dtype=bool)
+        s_of_best = jnp.zeros_like(best)
+        for si, ai in enumerate(an_mask):
+            hit = best == ai
+            in_mask = in_mask | hit
+            s_of_best = jnp.where(hit, si, s_of_best)
+        pos = valid & in_mask                                   # (N, B)
+
+        gi = jnp.clip((gtb[:, :, 0] * W).astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip((gtb[:, :, 1] * H).astype(jnp.int32), 0, H - 1)
+        tx = gtb[:, :, 0] * W - gi
+        ty = gtb[:, :, 1] * H - gj
+        tw = jnp.log(jnp.maximum(gw / jnp.maximum(anc_sel[s_of_best, 0], 1e-8), 1e-8))
+        th = jnp.log(jnp.maximum(gh / jnp.maximum(anc_sel[s_of_best, 1], 1e-8), 1e-8))
+        box_w = 2.0 - gtb[:, :, 2] * gtb[:, :, 3]
+        score_w = gts if gts is not None else jnp.ones_like(tx)
+
+        # scatter gt targets into a flat (N, S*H*W + 1) table; invalid gts go
+        # to the trailing trash slot so static shapes survive ragged gt counts
+        flat_idx = jnp.where(pos, (s_of_best * H + gj) * W + gi, S * H * W)
+
+        def scat(vals):
+            z = jnp.zeros((N, S * H * W + 1), vals.dtype)
+            return jax.vmap(lambda zz, ii, vv: zz.at[ii].set(vv))(
+                z, flat_idx, vals)[:, :-1].reshape(N, S, H, W)
+
+        t_pos = scat(jnp.where(pos, 1.0, 0.0))
+        # objectness target IS the gt score (mixup-style soft labels), and the
+        # class loss is score-weighted — reference yolov3_loss semantics
+        t_obj = scat(jnp.where(pos, score_w, 0.0))
+        t_x = scat(tx)
+        t_y = scat(ty)
+        t_w = scat(tw)
+        t_h = scat(th)
+        t_bw = scat(jnp.where(pos, box_w * score_w, 0.0))
+        t_cls = scat(jnp.where(pos, gtl.astype(jnp.float32), 0.0)).astype(jnp.int32)
+
+        # ignore region: decoded pred boxes with IoU > thresh vs any valid gt
+        grid_x = jnp.arange(W).reshape(1, 1, 1, W)
+        grid_y = jnp.arange(H).reshape(1, 1, H, 1)
+        sig = jax.nn.sigmoid
+        bx = (sig(p[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_x) / W
+        by = (sig(p[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1) + grid_y) / H
+        bw = jnp.exp(jnp.clip(p[:, :, 2], -10, 10)) * anc_sel[None, :, 0, None, None] / img_w
+        bh = jnp.exp(jnp.clip(p[:, :, 3], -10, 10)) * anc_sel[None, :, 1, None, None] / img_h
+
+        def iou_vs_gts(bx_, by_, bw_, bh_, gtb_, valid_):
+            # (S,H,W) pred vs (B,) gts → max IoU (S,H,W)
+            px1 = bx_ - bw_ / 2; px2 = bx_ + bw_ / 2
+            py1 = by_ - bh_ / 2; py2 = by_ + bh_ / 2
+            gx1 = gtb_[:, 0] - gtb_[:, 2] / 2; gx2 = gtb_[:, 0] + gtb_[:, 2] / 2
+            gy1 = gtb_[:, 1] - gtb_[:, 3] / 2; gy2 = gtb_[:, 1] + gtb_[:, 3] / 2
+            ix = jnp.clip(jnp.minimum(px2[..., None], gx2) -
+                          jnp.maximum(px1[..., None], gx1), 0)
+            iy = jnp.clip(jnp.minimum(py2[..., None], gy2) -
+                          jnp.maximum(py1[..., None], gy1), 0)
+            inter_ = ix * iy
+            uni = (bw_ * bh_)[..., None] + gtb_[:, 2] * gtb_[:, 3] - inter_
+            iou = jnp.where(valid_, inter_ / (uni + 1e-10), 0.0)
+            return jnp.max(iou, axis=-1)
+
+        best_iou = jax.vmap(iou_vs_gts)(bx, by, bw, bh, gtb, valid)
+        ignore = (best_iou > ignore_thresh) & (t_pos < 0.5)
+
+        def bce(logit, target):
+            return jnp.maximum(logit, 0) - logit * target + \
+                jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+        l_xy = (bce(p[:, :, 0], t_x) + bce(p[:, :, 1], t_y)) * t_bw
+        l_wh = (jnp.abs(p[:, :, 2] - t_w) + jnp.abs(p[:, :, 3] - t_h)) * t_bw
+        obj_w = jnp.where(ignore, 0.0, 1.0)
+        l_obj = bce(p[:, :, 4], t_obj) * jnp.where(t_pos > 0.5, 1.0, obj_w)
+        smooth_pos = 1.0 - 1.0 / class_num if use_label_smooth else 1.0
+        smooth_neg = 1.0 / class_num if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(t_cls, class_num, axis=2)  # (N,S,cls,H,W)
+        cls_target = onehot * smooth_pos + (1 - onehot) * smooth_neg
+        l_cls = jnp.sum(bce(p[:, :, 5:5 + class_num], cls_target), axis=2) \
+            * t_obj
+        per_img = jnp.sum(l_xy + l_wh + l_obj + l_cls, axis=(1, 2, 3))
+        return per_img
+
+    args = [x, gt_box, gt_label] + ([gt_score] if gt_score is not None else [])
+    return apply(f, *args)
+
+
+def read_file(filename, name=None):
+    """Read raw file bytes as a uint8 1-D tensor (reference: vision/ops.py:819)."""
+    with open(filename, "rb") as fh:
+        data = fh.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, dtype=np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to (C, H, W) uint8 (reference:
+    vision/ops.py:864; the CUDA build uses nvjpeg — here PIL on host, since
+    decode is an input-pipeline op that belongs off-device anyway)."""
+    import io as _io
+    from PIL import Image
+
+    raw = bytes(np.asarray(getattr(x, "_data", x)).astype(np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode != "unchanged":
+        img = img.convert({"gray": "L", "rgb": "RGB"}.get(mode, mode.upper()))
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))

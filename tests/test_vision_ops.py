@@ -1,0 +1,225 @@
+"""Numpy-oracle tests for the detection op corpus (reference:
+python/paddle/vision/ops.py — roi_pool, psroi_pool, deform_conv2d, yolo_loss,
+read_file/decode_jpeg; operators/detection/)."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+class TestRoiPool:
+    def test_matches_naive_numpy(self):
+        rng = np.random.RandomState(0)
+        feat = rng.standard_normal((1, 3, 8, 8)).astype("float32")
+        boxes = np.array([[0.0, 0.0, 7.0, 7.0], [2.0, 2.0, 5.0, 6.0]], "float32")
+        out = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         np.array([2]), output_size=2, spatial_scale=1.0)
+        out = np.asarray(out._data)
+        assert out.shape == (2, 3, 2, 2)
+
+        # naive oracle (reference roi_pool_op kernel semantics)
+        def oracle(img, box, oh, ow):
+            x1, y1, x2, y2 = [int(round(v)) for v in box]
+            rw = max(x2 - x1 + 1, 1)
+            rh = max(y2 - y1 + 1, 1)
+            res = np.zeros((img.shape[0], oh, ow), "float32")
+            for i in range(oh):
+                for j in range(ow):
+                    hs = int(np.floor(i * rh / oh)) + y1
+                    he = int(np.ceil((i + 1) * rh / oh)) + y1
+                    ws = int(np.floor(j * rw / ow)) + x1
+                    we = int(np.ceil((j + 1) * rw / ow)) + x1
+                    hs, he = max(hs, 0), min(he, img.shape[1])
+                    ws, we = max(ws, 0), min(we, img.shape[2])
+                    if he > hs and we > ws:
+                        res[:, i, j] = img[:, hs:he, ws:we].max(axis=(1, 2))
+            return res
+
+        for r, box in enumerate(boxes):
+            np.testing.assert_allclose(out[r], oracle(feat[0], box, 2, 2),
+                                       rtol=1e-5)
+
+    def test_batch_routing_via_boxes_num(self):
+        rng = np.random.RandomState(1)
+        feat = rng.standard_normal((2, 2, 6, 6)).astype("float32")
+        boxes = np.array([[0, 0, 5, 5], [0, 0, 5, 5]], "float32")
+        out = V.roi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                         np.array([1, 1]), output_size=1)
+        out = np.asarray(out._data)
+        # one roi per image: each output must equal that image's global max
+        np.testing.assert_allclose(out[0, :, 0, 0], feat[0].max(axis=(1, 2)), rtol=1e-5)
+        np.testing.assert_allclose(out[1, :, 0, 0], feat[1].max(axis=(1, 2)), rtol=1e-5)
+
+
+class TestPSRoiPool:
+    def test_constant_input(self):
+        # constant feature map → every bin averages to the constant
+        oh = ow = 2
+        out_ch = 3
+        feat = np.full((1, out_ch * oh * ow, 8, 8), 2.5, "float32")
+        boxes = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+        out = V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                           np.array([1]), output_size=(oh, ow))
+        out = np.asarray(out._data)
+        assert out.shape == (1, out_ch, oh, ow)
+        np.testing.assert_allclose(out, 2.5, rtol=1e-6)
+
+    def test_position_sensitivity(self):
+        # channel k responds only in its own bin: make channel groups distinct
+        oh = ow = 2
+        feat = np.zeros((1, oh * ow, 4, 4), "float32")
+        for k in range(oh * ow):
+            feat[0, k] = k + 1.0
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+        out = V.psroi_pool(paddle.to_tensor(feat), paddle.to_tensor(boxes),
+                           np.array([1]), output_size=(oh, ow))
+        out = np.asarray(out._data)[0, 0]  # (oh, ow), out_ch=1
+        np.testing.assert_allclose(out, [[1.0, 2.0], [3.0, 4.0]], rtol=1e-6)
+
+
+class TestDeformConv2D:
+    def test_zero_offset_equals_conv(self):
+        """deform_conv2d with zero offsets reduces to a standard conv."""
+        import jax
+        from jax import lax
+
+        rng = np.random.RandomState(0)
+        x = rng.standard_normal((2, 4, 9, 9)).astype("float32")
+        w = (rng.standard_normal((6, 4, 3, 3)) * 0.1).astype("float32")
+        off = np.zeros((2, 2 * 9, 7, 7), "float32")
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), stride=1, padding=0)
+        ref = lax.conv_general_dilated(x, w, (1, 1), "VALID",
+                                       dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_mask_scales_output(self):
+        rng = np.random.RandomState(1)
+        x = rng.standard_normal((1, 2, 6, 6)).astype("float32")
+        w = (rng.standard_normal((2, 2, 3, 3)) * 0.1).astype("float32")
+        off = np.zeros((1, 2 * 9, 4, 4), "float32")
+        half = np.full((1, 9, 4, 4), 0.5, "float32")
+        full = np.ones((1, 9, 4, 4), "float32")
+        o_half = np.asarray(V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            padding=0, mask=paddle.to_tensor(half))._data)
+        o_full = np.asarray(V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            padding=0, mask=paddle.to_tensor(full))._data)
+        np.testing.assert_allclose(o_half, 0.5 * o_full, rtol=1e-5, atol=1e-6)
+
+    def test_integer_offset_shifts_sampling(self):
+        # shifting every sample by exactly one pixel right == conv on shifted input
+        rng = np.random.RandomState(2)
+        x = rng.standard_normal((1, 1, 8, 8)).astype("float32")
+        w = np.ones((1, 1, 1, 1), "float32")
+        # K=1 kernel: offset (dy=0, dx=1) at every output position
+        off = np.zeros((1, 2, 8, 8), "float32")
+        off[:, 1] = 1.0
+        out = np.asarray(V.deform_conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(w),
+            stride=1, padding=0)._data)
+        np.testing.assert_allclose(out[0, 0, :, :-1], x[0, 0, :, 1:],
+                                   rtol=1e-5, atol=1e-6)
+        # out-of-bounds rightmost column samples zero
+        np.testing.assert_allclose(out[0, 0, :, -1], 0.0, atol=1e-6)
+
+
+class TestYoloLoss:
+    def _inputs(self, N=2, H=4, W=4, cls=3, B=2, seed=0):
+        rng = np.random.RandomState(seed)
+        S = 3
+        x = (rng.standard_normal((N, S * (5 + cls), H, W)) * 0.1).astype("float32")
+        gt_box = np.zeros((N, B, 4), "float32")
+        gt_box[:, 0] = [0.5, 0.5, 0.3, 0.4]  # one valid gt per image
+        gt_label = np.zeros((N, B), "int32")
+        anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+        anchor_mask = [0, 1, 2]
+        return x, gt_box, gt_label, anchors, anchor_mask, cls
+
+    def test_finite_and_positive(self):
+        x, gtb, gtl, anchors, mask, cls = self._inputs()
+        loss = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gtb),
+                           paddle.to_tensor(gtl), anchors, mask, cls,
+                           ignore_thresh=0.7, downsample_ratio=32)
+        lv = np.asarray(loss._data)
+        assert lv.shape == (2,)
+        assert np.all(np.isfinite(lv)) and np.all(lv > 0)
+
+    def test_no_gt_only_objectness(self):
+        x, gtb, gtl, anchors, mask, cls = self._inputs()
+        gtb[:] = 0.0  # no valid gts
+        loss = np.asarray(V.yolo_loss(
+            paddle.to_tensor(x), paddle.to_tensor(gtb), paddle.to_tensor(gtl),
+            anchors, mask, cls, ignore_thresh=0.7, downsample_ratio=32)._data)
+        # pure-negative objectness BCE of small logits ≈ S*H*W*log(2) each
+        approx = 3 * 4 * 4 * np.log(2.0)
+        assert np.all(np.abs(loss - approx) < 0.2 * approx)
+
+    def test_gradient_flows(self):
+        import jax
+        import jax.numpy as jnp
+        x, gtb, gtl, anchors, mask, cls = self._inputs()
+
+        def f(xx):
+            out = V.yolo_loss(xx, jnp.asarray(gtb), jnp.asarray(gtl),
+                              anchors, mask, cls, 0.7, 32)
+            return jnp.sum(out)
+
+        g = jax.grad(f)(jnp.asarray(x))
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.abs(g).sum()) > 0
+
+
+class TestReadDecode:
+    def test_jpeg_roundtrip(self):
+        from PIL import Image
+
+        # smooth gradient — random noise is exactly what JPEG throws away
+        gy, gx = np.mgrid[0:16, 0:16]
+        img = np.stack([gy * 16, gx * 16, (gy + gx) * 8], -1).astype("uint8")
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "x.jpg")
+            Image.fromarray(img).save(path, quality=95)
+            raw = V.read_file(path)
+            assert np.asarray(raw._data).dtype == np.uint8
+            dec = V.decode_jpeg(raw)
+        arr = np.asarray(dec._data)
+        assert arr.shape == (3, 16, 16)
+        # lossy codec: just require rough agreement
+        assert np.mean(np.abs(arr.astype("int32").transpose(1, 2, 0)
+                              - img.astype("int32"))) < 20
+
+
+class TestYoloLossGtScore:
+    def test_soft_score_changes_objectness_target(self):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(3)
+        S, cls, H, W = 3, 2, 4, 4
+        x = (rng.standard_normal((1, S * (5 + cls), H, W)) * 0.1).astype("float32")
+        gtb = np.zeros((1, 1, 4), "float32")
+        gtb[0, 0] = [0.5, 0.5, 0.3, 0.4]
+        gtl = np.zeros((1, 1), "int32")
+        anchors = [10, 13, 16, 30, 33, 23]
+        kw = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=cls,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        full = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gtb),
+                           paddle.to_tensor(gtl),
+                           gt_score=paddle.to_tensor(np.ones((1, 1), "float32")),
+                           **kw)
+        soft = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gtb),
+                           paddle.to_tensor(gtl),
+                           gt_score=paddle.to_tensor(np.full((1, 1), 0.5, "float32")),
+                           **kw)
+        none = V.yolo_loss(paddle.to_tensor(x), paddle.to_tensor(gtb),
+                           paddle.to_tensor(gtl), **kw)
+        # score=1 must equal the no-score path; score=0.5 must differ
+        np.testing.assert_allclose(np.asarray(full._data), np.asarray(none._data),
+                                   rtol=1e-6)
+        assert not np.allclose(np.asarray(soft._data), np.asarray(full._data))
