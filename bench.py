@@ -129,10 +129,12 @@ def bench_gpt2s(on_tpu):
 
     paddle.seed(0)
     if on_tpu:
+        # B=16 + fully-unrolled layer scan measured best on v5e (see
+        # BENCH_NOTES.md sweep: 113.5k tok/s vs 91.9k at the round-1 config)
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_attention_heads=12, max_position_embeddings=1024,
-                        compute_dtype="bfloat16")
-        B, L, iters = 8, 1024, 30
+                        compute_dtype="bfloat16", scan_unroll=12)
+        B, L, iters = 16, 1024, 30
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_attention_heads=4, max_position_embeddings=128,
@@ -163,7 +165,7 @@ def bench_bert_base(on_tpu):
     if on_tpu:
         cfg = BertConfig(vocab_size=30528, hidden_size=768, num_hidden_layers=12,
                          num_attention_heads=12, max_position_embeddings=512,
-                         compute_dtype="bfloat16")
+                         compute_dtype="bfloat16", scan_unroll=12)
         B, L, iters = 16, 512, 20
     else:
         cfg = BertConfig(vocab_size=512, hidden_size=128, num_hidden_layers=2,
@@ -198,7 +200,7 @@ def bench_ernie_moe(on_tpu):
         cfg = ErnieMoeConfig(vocab_size=30528, hidden_size=768, num_layers=6,
                              num_attention_heads=12, num_experts=8,
                              max_position_embeddings=512,
-                             compute_dtype="bfloat16")
+                             compute_dtype="bfloat16", scan_unroll=6)
         B, L, iters = 8, 512, 20
     else:
         cfg = ErnieMoeConfig(vocab_size=512, hidden_size=128, num_layers=2,

@@ -116,11 +116,15 @@ def _in_trace(x) -> bool:
 
 
 def _axis_in_scope(axis_name) -> bool:
-    try:
-        jax.lax.axis_index(axis_name)
-        return True
-    except BaseException:
-        return False
+    try:  # proper introspection when available (jax>=0.4.31)
+        from jax._src.core import get_axis_env
+        return bool(get_axis_env().axis_exists(axis_name))
+    except (ImportError, AttributeError):  # private API — degrade gracefully
+        try:
+            jax.lax.axis_index(axis_name)
+            return True
+        except NameError:  # axis_index's documented unbound-name error
+            return False
 
 
 def _identity_if_solo(group: Group) -> bool:

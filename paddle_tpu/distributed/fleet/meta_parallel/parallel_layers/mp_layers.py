@@ -34,12 +34,7 @@ def _mp_info():
     return hcg.get_model_parallel_world_size(), hcg.axis_name("mp")
 
 
-def _axis_in_scope(name) -> bool:
-    try:
-        jax.lax.axis_index(name)
-        return True
-    except BaseException:
-        return False
+from ....collective import _axis_in_scope  # noqa: E402 — single shared impl
 
 
 class VocabParallelEmbedding(Layer):

@@ -28,7 +28,8 @@ class BertConfig:
                  max_position_embeddings=512, type_vocab_size=2,
                  hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
                  initializer_range=0.02, layer_norm_eps=1e-12,
-                 compute_dtype="bfloat16", use_flash_attention=True):
+                 compute_dtype="bfloat16", use_flash_attention=True,
+                 scan_unroll=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -42,6 +43,7 @@ class BertConfig:
         self.layer_norm_eps = layer_norm_eps
         self.compute_dtype = compute_dtype
         self.use_flash_attention = use_flash_attention
+        self.scan_unroll = scan_unroll
 
 
 BERT_CONFIGS = {
@@ -164,7 +166,9 @@ class BertModel(Layer):
         stacked = {k: params[k] for k in self.stacked_param_names()}
         fn = (jax.checkpoint(lambda sl, hh: self.block_fn(sl, hh, attn_mask))
               if remat else (lambda sl, hh: self.block_fn(sl, hh, attn_mask)))
-        out, _ = jax.lax.scan(lambda carry, sl: (fn(sl, carry), None), h, stacked)
+        from ._scan import resolve_scan_unroll
+        out, _ = jax.lax.scan(lambda carry, sl: (fn(sl, carry), None), h, stacked,
+                              unroll=resolve_scan_unroll(self.config))
         return out
 
     def encode(self, params, input_ids, token_type_ids=None, attn_mask=None,
@@ -182,8 +186,9 @@ class BertModel(Layer):
         x = jax.nn.gelu(h @ params["mlm_dense_w"].astype(dt)
                         + params["mlm_dense_b"].astype(dt), approximate=True)
         x = self._ln(x, params["mlm_ln_w"], params["mlm_ln_b"]).astype(dt)
-        return (x @ params["word_emb"].astype(dt).T).astype(jnp.float32) \
-            + params["mlm_bias"]
+        # stays in the compute dtype: the fused CE (ops/loss.py) reduces in
+        # fp32 internally, so fp32 logits would only add HBM traffic
+        return x @ params["word_emb"].astype(dt).T + params["mlm_bias"].astype(dt)
 
     @staticmethod
     def _additive_mask(attention_mask):
@@ -199,11 +204,11 @@ class BertModel(Layer):
                         attn_mask=self._additive_mask(attention_mask),
                         remat=remat)
         logits = self.mlm_logits(params, h)
-        logp = jax.nn.log_softmax(logits, axis=-1)
         valid = mlm_labels >= 0
         safe = jnp.where(valid, mlm_labels, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-        mlm_loss = jnp.sum(nll * valid) / jnp.maximum(jnp.sum(valid), 1)
+        # fused masked CE — no fp32 (B, L, V) log-prob tensor (ops/loss.py)
+        from ..ops.loss import softmax_cross_entropy_weighted_mean
+        mlm_loss = softmax_cross_entropy_weighted_mean(logits, safe, valid)
         if nsp_labels is None:
             return mlm_loss
         pooled = self.pool_fn(params, h).astype(jnp.float32)

@@ -31,7 +31,7 @@ class ErnieMoeConfig:
                  expert_hidden_size=None, capacity_factor=1.25,
                  max_position_embeddings=1024, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
-                 aux_loss_weight=0.01, expert_axis="data"):
+                 aux_loss_weight=0.01, expert_axis="data", scan_unroll=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -46,6 +46,7 @@ class ErnieMoeConfig:
         self.compute_dtype = compute_dtype
         self.aux_loss_weight = aux_loss_weight
         self.expert_axis = expert_axis
+        self.scan_unroll = scan_unroll
 
 
 class ErnieMoeModel(Layer):
@@ -154,8 +155,10 @@ class ErnieMoeModel(Layer):
             hh, aux = fn(sl, hh)
             return (hh, aux_sum + aux), None
 
+        from ._scan import resolve_scan_unroll
         (out, aux_sum), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                                         stacked)
+                                         stacked,
+                                         unroll=resolve_scan_unroll(self.config))
         return out, aux_sum
 
     def head_loss_fn(self, params, h, labels, aux_sum=0.0):
@@ -166,9 +169,10 @@ class ErnieMoeModel(Layer):
         hn = (x32 - m) * jax.lax.rsqrt(v + c.layer_norm_epsilon) * params["lnf_w"] \
             + params["lnf_b"]
         dt = jnp.dtype(c.compute_dtype)
-        logits = (hn.astype(dt) @ params["wte"].astype(dt).T).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1).mean()
+        logits = hn.astype(dt) @ params["wte"].astype(dt).T
+        # fused CE — no fp32 (B, L, V) log-prob tensor (ops/loss.py)
+        from ..ops.loss import softmax_cross_entropy_mean
+        nll = softmax_cross_entropy_mean(logits, labels)
         return nll + c.aux_loss_weight * aux_sum
 
     # ------------------------------------------------------------- nn.Layer

@@ -40,7 +40,7 @@ class GPTConfig:
                  attention_probs_dropout_prob=0.0, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
                  use_flash_attention=True, tie_word_embeddings=True,
-                 sequence_parallel=None):
+                 sequence_parallel=None, scan_unroll=1):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -54,6 +54,7 @@ class GPTConfig:
         self.compute_dtype = compute_dtype
         self.use_flash_attention = use_flash_attention
         self.tie_word_embeddings = tie_word_embeddings
+        self.scan_unroll = scan_unroll  # layers per scan step (see scan_blocks)
         # None → GSPMD decides (sequence gathered for attention);
         # "ring"/"ulysses" → explicit context parallelism over the "sep" axis
         if sequence_parallel not in (None, "ring", "ulysses"):
@@ -197,7 +198,7 @@ class GPTModel(Layer):
         h = h + ff @ sl["blocks_fc2_w"].astype(dt) + sl["blocks_fc2_b"].astype(dt)
         return h
 
-    def head_fn(self, params: Dict[str, Any], h):
+    def _head_logits(self, params: Dict[str, Any], h):
         c = self.config
         x32 = h.astype(jnp.float32)
         m = x32.mean(-1, keepdims=True)
@@ -207,27 +208,42 @@ class GPTModel(Layer):
         w = params.get("lm_head")
         if w is None:
             w = params["wte"].T
-        return (h.astype(jnp.dtype(c.compute_dtype)) @ w.astype(
-            jnp.dtype(c.compute_dtype))).astype(jnp.float32)
+        dt = jnp.dtype(c.compute_dtype)
+        return h.astype(dt) @ w.astype(dt)
+
+    def head_fn(self, params: Dict[str, Any], h):
+        return self._head_logits(params, h).astype(jnp.float32)
 
     def head_loss_fn(self, params: Dict[str, Any], h, labels):
-        logits = self.head_fn(params, h)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-        return -picked.mean()
+        # fused CE on compute-dtype logits: never materializes the fp32
+        # (B, L, V) log-prob tensor (ops/loss.py — ≙ the reference's fused
+        # softmax_with_cross_entropy, operators/math/cross_entropy.cu)
+        from ..ops.loss import softmax_cross_entropy_mean
+        return softmax_cross_entropy_mean(self._head_logits(params, h), labels)
 
     def scan_blocks(self, params, h, key=None, remat=True, sp_mesh=None):
+        """``remat``: False = save all activations; True = full per-block
+        recompute (≙ RecomputeOptimizer, fluid/optimizer.py:5930); "dots" =
+        selective policy that saves MXU (matmul) outputs and recomputes only
+        elementwise interiors — near-full-speed backward at a fraction of the
+        activation memory (the TPU-idiomatic default for large batches)."""
         stacked = {k: params[k] for k in self.stacked_param_names()}
         if remat:
+            policy = None
+            if remat == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
             fn = jax.checkpoint(
-                lambda sl, hh: self.block_fn(sl, hh, key, sp_mesh=sp_mesh))
+                lambda sl, hh: self.block_fn(sl, hh, key, sp_mesh=sp_mesh),
+                policy=policy)
 
             def body(carry, sl):
                 return fn(sl, carry), None
         else:
             def body(carry, sl):
                 return self.block_fn(sl, carry, key, sp_mesh=sp_mesh), None
-        out, _ = jax.lax.scan(body, h, stacked)
+        from ._scan import resolve_scan_unroll
+        out, _ = jax.lax.scan(body, h, stacked,
+                              unroll=resolve_scan_unroll(self.config))
         return out
 
     # ------------------------------------------------------------- nn.Layer

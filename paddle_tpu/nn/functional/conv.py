@@ -67,6 +67,13 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
     def f(a, w, b):
         from ...amp import cast_if_amp
         a, w = cast_if_amp(a, w)
+        if a.dtype != w.dtype and jnp.issubdtype(a.dtype, jnp.floating) \
+                and jnp.issubdtype(w.dtype, jnp.floating):
+            # fp32-params / low-precision-compute convention: conv runs in the
+            # narrower dtype (bf16 activations × fp32 master weights → bf16
+            # MXU conv, matching the transformer stack's weight.astype(dt))
+            dt = min(a.dtype, w.dtype, key=lambda d: jnp.dtype(d).itemsize)
+            a, w = a.astype(dt), w.astype(dt)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
             dimension_numbers=dn, feature_group_count=groups,

@@ -1,0 +1,80 @@
+"""Fused softmax cross-entropy (reference: operators/math/cross_entropy.cu +
+c_softmax_with_cross_entropy_op.cu — the fused softmax+CE the reference uses
+for LM heads).
+
+TPU motivation: the naive ``log_softmax → take_along_axis → mean`` chain over
+a (B, L, V) logits tensor materializes the full-precision log-probability
+tensor (V=50k ⇒ 1.6GB fp32 at GPT-2 bench shapes) and its gradient pass
+re-reads it several times — profiled at ~10ms/step of pure HBM traffic on a
+v5e.  These kernels keep the logits in their compute dtype (bf16), reduce in
+fp32, and reconstruct ``softmax - onehot`` in one fused pass in the backward
+instead of saving log-probs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _lse_and_picked(logits, labels):
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    picked = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return lse, picked.astype(jnp.float32)
+
+
+@jax.custom_vjp
+def softmax_cross_entropy_mean(logits, labels):
+    """Mean CE over all leading dims.  logits (..., V) any float dtype;
+    labels (...) int.  Returns a float32 scalar."""
+    lse, picked = _lse_and_picked(logits, labels)
+    return jnp.mean(lse - picked)
+
+
+def _ce_fwd(logits, labels):
+    lse, picked = _lse_and_picked(logits, labels)
+    return jnp.mean(lse - picked), (logits, labels, lse)
+
+
+def _ce_bwd(res, g):
+    logits, labels, lse = res
+    n = lse.size
+    # exp(l - lse) - onehot fused into one pass over the logits; the one-hot
+    # lowers to an iota comparison, never a materialized (…, V) table
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    dlogits = ((p - onehot) * (g / n)).astype(logits.dtype)
+    return dlogits, None
+
+
+softmax_cross_entropy_mean.defvjp(_ce_fwd, _ce_bwd)
+
+
+@jax.custom_vjp
+def softmax_cross_entropy_weighted_mean(logits, labels, weights):
+    """Weighted-mean CE: sum(w·ce) / max(sum(w), 1) — the MLM contract
+    (ignore-index positions get weight 0; ≙ reference's masked
+    softmax_with_cross_entropy + divide in bert pretraining heads)."""
+    lse, picked = _lse_and_picked(logits, labels)
+    w = weights.astype(jnp.float32)
+    return jnp.sum((lse - picked) * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
+def _cew_fwd(logits, labels, weights):
+    lse, picked = _lse_and_picked(logits, labels)
+    w = weights.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(w), 1.0)
+    loss = jnp.sum((lse - picked) * w) / denom
+    return loss, (logits, labels, lse, w, denom)
+
+
+def _cew_bwd(res, g):
+    logits, labels, lse, w, denom = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    scale = (g / denom) * w
+    dlogits = ((p - onehot) * scale[..., None]).astype(logits.dtype)
+    return dlogits, None, None
+
+
+softmax_cross_entropy_weighted_mean.defvjp(_cew_fwd, _cew_bwd)

@@ -125,6 +125,11 @@ class TestMultiprocessLoader:
             assert n == 16
             return time.perf_counter() - t0
 
-        t1 = run(1)
-        t4 = run(4)
+        # Timing-based: retry a couple of times so a loaded CI host (e.g.
+        # another pytest worker stealing cores) doesn't flake the suite.
+        for attempt in range(3):
+            t1 = run(1)
+            t4 = run(4)
+            if t4 < t1 / 1.5:
+                return
         assert t4 < t1 / 1.5, (t1, t4)
