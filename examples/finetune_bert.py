@@ -1,0 +1,43 @@
+"""BERT masked-LM training on synthetic data (fused flash-attention path).
+
+CPU: JAX_PLATFORMS=cpu python examples/finetune_bert.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models.bert import BertConfig, BertModel, make_bert_train_step
+from paddle_tpu.optimizer import AdamW
+
+
+def main():
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+
+    cfg = BertConfig(vocab_size=2048, hidden_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, max_position_embeddings=128,
+                     compute_dtype="float32")
+    model = BertModel(cfg)
+    step, state = make_bert_train_step(model, AdamW(1e-4, weight_decay=0.01), hcg)
+
+    rng = np.random.RandomState(0)
+    B, L = 8, 64
+    for i in range(10):
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+        mlm = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+        nsp = jnp.asarray(rng.randint(0, 2, (B,)))
+        state, loss = step(state, np.float32(1e-4), ids, mlm, nsp)
+        print(f"step {i}: loss {float(np.asarray(loss)):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""MNIST LeNet with the hapi Model API (≙ reference quick-start).
+
+Run (CPU):  JAX_PLATFORMS=cpu python examples/train_mnist.py
+Run (TPU):  python examples/train_mnist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.datasets import MNIST
+
+
+class Synth(paddle.io.Dataset):
+    """Synthetic digits with MNIST shapes (this image has no network egress).
+    Pass --images/--labels to train on a local IDX copy instead."""
+
+    def __init__(self, n):
+        rng = np.random.RandomState(0)
+        self.x = rng.standard_normal((n, 1, 28, 28)).astype("float32")
+        self.y = rng.randint(0, 10, (n,)).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def main():
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", help="path to a local train-images IDX file")
+    ap.add_argument("--labels", help="path to a local train-labels IDX file")
+    args = ap.parse_args()
+
+    paddle.seed(0)
+    if args.images and args.labels:
+        train_ds = MNIST(image_path=args.images, label_path=args.labels,
+                         mode="train")
+        test_ds = train_ds
+    else:
+        train_ds, test_ds = Synth(2048), Synth(512)
+
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(paddle.optimizer.Adam(1e-3, parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), Accuracy())
+    model.fit(train_ds, epochs=2, batch_size=64, verbose=1)
+    print(model.evaluate(test_ds, batch_size=64, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

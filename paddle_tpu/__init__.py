@@ -12,6 +12,21 @@ Two execution modes mirror the reference's dygraph/static split:
   (``paddle_tpu.jit`` / hapi ``Model`` / fleet use this path for speed).
 """
 
+# Honor JAX_PLATFORMS=cpu even when a TPU PJRT plugin's sitecustomize
+# imported jax at interpreter startup and force-selected its own platform
+# (the env var is latched too late in that case; jax.config is not — legal
+# until the first backend initializes).  Without this, `JAX_PLATFORMS=cpu
+# python train.py` hangs dialing the TPU tunnel on plugin machines.
+import os as _os  # isort: skip
+
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    import jax as _jax  # isort: skip
+    try:
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:  # backend already initialized — nothing to fix
+        pass
+    _os.environ.setdefault("PADDLE_TPU_PLATFORM", "cpu")
+
 from . import core  # isort: skip  (must init flags first)
 from . import tensor as tensor_api
 from .core import (Parameter, Tensor, get_default_dtype, get_device, get_flags,  # noqa: F401
