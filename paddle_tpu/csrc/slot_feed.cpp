@@ -1,0 +1,220 @@
+// Native dense-slot file parser — the data-feed hot loop.
+//
+// ≙ reference framework/data_feed.cc MultiSlotDataFeed/InMemoryDataFeed:
+// the reference parses example files in C++ worker threads because a Python
+// float() per value starves the trainer; the TPU build keeps that division
+// of labor (parse natively, batch in Python, compute in XLA).
+//
+// Format handled here: one example per line, whitespace-separated numbers,
+// last column = integer label (paddle_tpu.io.dataset's default).  The file
+// is mmap'd and split at line boundaries into one chunk per thread.
+//
+// Exposed C ABI (ctypes, no pybind11 in the image):
+//   slot_feed_dims(path, *rows, *cols)         -> 0 ok / -errno
+//   slot_feed_parse(path, feats, labels, rows, cols, threads) -> rows parsed
+// feats must hold rows*(cols-1) floats, labels rows int64.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Mapped {
+  const char* data = nullptr;
+  size_t size = 0;
+  int fd = -1;
+
+  bool open_file(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size == 0) return false;
+    size = static_cast<size_t>(st.st_size);
+    void* p = mmap(nullptr, size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (p == MAP_FAILED) return false;
+    data = static_cast<const char*>(p);
+    return true;
+  }
+
+  ~Mapped() {
+    if (data) munmap(const_cast<char*>(data), size);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+inline const char* skip_ws(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+  return p;
+}
+
+// fast float: sign, integer, fraction; tokens with an exponent (rare in
+// slot files) re-parse via strtod on a bounded stack copy
+inline const char* parse_num(const char* p, const char* end, double* out) {
+  p = skip_ws(p, end);
+  if (p >= end || *p == '\n') return nullptr;
+  const char* tok = p;
+  bool neg = false;
+  if (*p == '-' || *p == '+') { neg = (*p == '-'); ++p; }
+  double v = 0.0;
+  bool saw_digit = false;
+  while (p < end && *p >= '0' && *p <= '9') {
+    v = v * 10.0 + (*p - '0'); ++p; saw_digit = true;
+  }
+  if (p < end && *p == '.') {
+    ++p;
+    double scale = 0.1;
+    while (p < end && *p >= '0' && *p <= '9') {
+      v += (*p - '0') * scale; scale *= 0.1; ++p; saw_digit = true;
+    }
+  }
+  if (!saw_digit) return nullptr;  // rejects '+', '-', '.', '' like float()
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    ++p;
+    if (p < end && (*p == '-' || *p == '+')) ++p;
+    const char* exp_digits = p;
+    while (p < end && *p >= '0' && *p <= '9') ++p;
+    if (p == exp_digits) return nullptr;  // '1e', '1e+' — float() rejects
+    char buf[64];
+    size_t n = static_cast<size_t>(p - tok);
+    if (n >= sizeof(buf)) return nullptr;
+    memcpy(buf, tok, n);
+    buf[n] = '\0';
+    char* q = nullptr;
+    *out = strtod(buf, &q);
+    if (q != buf + n) return nullptr;
+    return p;
+  }
+  *out = neg ? -v : v;
+  return p;
+}
+
+// count columns on the first line
+int64_t count_cols(const char* p, const char* end) {
+  int64_t cols = 0;
+  while (p < end && *p != '\n') {
+    p = skip_ws(p, end);
+    if (p >= end || *p == '\n') break;
+    ++cols;
+    while (p < end && *p != ' ' && *p != '\t' && *p != '\r' && *p != '\n') ++p;
+  }
+  return cols;
+}
+
+int64_t count_rows(const char* p, const char* end) {
+  int64_t rows = 0;
+  const char* q = p;
+  while (q < end) {
+    const char* nl = static_cast<const char*>(memchr(q, '\n', end - q));
+    const char* line_end = nl ? nl : end;
+    const char* s = skip_ws(q, line_end);
+    if (s < line_end) ++rows;  // non-blank line
+    if (!nl) break;
+    q = nl + 1;
+  }
+  return rows;
+}
+
+struct ChunkResult {
+  int64_t rows = 0;
+  int bad = 0;
+};
+
+void parse_chunk(const char* p, const char* end, int64_t cols, float* feats,
+                 long long* labels, ChunkResult* res) {
+  int64_t row = 0;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* line_end = nl ? nl : end;
+    const char* s = skip_ws(p, line_end);
+    if (s < line_end) {
+      double v = 0.0;
+      for (int64_t c = 0; c < cols; ++c) {
+        const char* next = parse_num(s, line_end, &v);
+        if (next == nullptr) { res->bad = 1; return; }
+        if (c < cols - 1) {
+          feats[row * (cols - 1) + c] = static_cast<float>(v);
+        } else {
+          labels[row] = static_cast<long long>(v);
+        }
+        s = next;
+      }
+      // ragged lines (extra columns) are data corruption, not padding
+      if (skip_ws(s, line_end) < line_end) { res->bad = 1; return; }
+      ++row;
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+  res->rows = row;
+}
+
+}  // namespace
+
+extern "C" {
+
+int slot_feed_dims(const char* path, int64_t* rows, int64_t* cols) {
+  Mapped m;
+  if (!m.open_file(path)) return -(errno ? errno : 1);
+  *cols = count_cols(m.data, m.data + m.size);
+  *rows = count_rows(m.data, m.data + m.size);
+  return 0;
+}
+
+int64_t slot_feed_parse(const char* path, float* feats, long long* labels,
+                        int64_t rows, int64_t cols, int threads) {
+  Mapped m;
+  if (!m.open_file(path)) return -(errno ? errno : 1);
+  const char* base = m.data;
+  const char* end = m.data + m.size;
+  if (threads < 1) threads = 1;
+  if (threads > 64) threads = 64;
+
+  // split at line boundaries
+  std::vector<const char*> starts{base};
+  for (int t = 1; t < threads; ++t) {
+    const char* guess = base + (m.size * t) / threads;
+    const char* nl = static_cast<const char*>(memchr(guess, '\n', end - guess));
+    starts.push_back(nl ? nl + 1 : end);
+  }
+  starts.push_back(end);
+
+  // each chunk first counts its rows (cheap memchr scan) so outputs land at
+  // the right offset without a serial pass
+  std::vector<int64_t> chunk_rows(threads);
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < threads; ++t)
+      ts.emplace_back([&, t] { chunk_rows[t] = count_rows(starts[t], starts[t + 1]); });
+    for (auto& th : ts) th.join();
+  }
+  std::vector<int64_t> offs(threads + 1, 0);
+  for (int t = 0; t < threads; ++t) offs[t + 1] = offs[t] + chunk_rows[t];
+  if (offs[threads] > rows) return -E2BIG;
+
+  std::vector<ChunkResult> res(threads);
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < threads; ++t)
+      ts.emplace_back([&, t] {
+        parse_chunk(starts[t], starts[t + 1], cols,
+                    feats + offs[t] * (cols - 1), labels + offs[t], &res[t]);
+      });
+    for (auto& th : ts) th.join();
+  }
+  int64_t total = 0;
+  for (int t = 0; t < threads; ++t) {
+    if (res[t].bad) return -EINVAL;
+    total += res[t].rows;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -69,13 +69,37 @@ class DatasetBase:
         self._parse_fn = fn
 
     # -- iteration ---------------------------------------------------------
+    def _parse_file(self, path, native_threads=None):
+        """All examples of one file, as a list — shared by the streaming
+        iterator and the threaded bulk loader.
+
+        Hot path: the C++ mmap parser (csrc/slot_feed.cpp ≙
+        MultiSlotDataFeed) when the default dense format applies; anything it
+        can't take (custom parse_fn, non-numeric content, empty/unreadable
+        file, no toolchain) falls back to the Python line loop, which keeps
+        the old error semantics (FileNotFoundError for missing paths, zero
+        examples for empty files)."""
+        if self._parse_fn is _default_parse:
+            from .slot_feed import parse_dense_file
+            try:
+                parsed = parse_dense_file(
+                    path, threads=native_threads or self._thread_num)
+            except (ValueError, OSError):
+                parsed = None
+            if parsed is not None:
+                feats, labels = parsed
+                return [(feats[i], labels[i]) for i in range(feats.shape[0])]
+        out = []
+        with open(path) as f:
+            for line in f:
+                ex = self._parse_fn(line.rstrip("\n"))
+                if ex is not None:
+                    out.append(ex)
+        return out
+
     def _example_stream(self):
         for path in self._filelist:
-            with open(path) as f:
-                for line in f:
-                    ex = self._parse_fn(line.rstrip("\n"))
-                    if ex is not None:
-                        yield ex
+            yield from self._parse_file(path)
 
     def _batches_from(self, examples):
         buf = []
@@ -126,13 +150,8 @@ class InMemoryDataset(DatasetBase):
                 except queue.Empty:
                     return
                 try:
-                    local = []
-                    with open(path) as f:
-                        for line in f:
-                            ex = self._parse_fn(line.rstrip("\n"))
-                            if ex is not None:
-                                local.append(ex)
-                    slots[i] = local
+                    # each worker parses one file: 1 native thread apiece
+                    slots[i] = self._parse_file(path, native_threads=1)
                 except BaseException as e:  # propagate to the caller
                     with err_lock:
                         errors.append(e)

@@ -1,0 +1,75 @@
+"""Python face of the native dense-slot parser (csrc/slot_feed.cpp).
+
+≙ reference framework/data_feed.cc MultiSlotDataFeed — C++ parses the
+example files (a Python float() per value starves the device), Python
+batches, XLA computes.  Used automatically by io.dataset.DatasetBase when
+the default parser and format apply; importable directly for custom feeds.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..csrc import NativeBuildError, load_library
+
+
+class _Lib:
+    _lib = None
+    _failed = False
+
+    @classmethod
+    def get(cls):
+        if cls._lib is None and not cls._failed:
+            try:
+                lib = load_library("slot_feed")
+                lib.slot_feed_dims.restype = ctypes.c_int
+                lib.slot_feed_dims.argtypes = [
+                    ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64)]
+                lib.slot_feed_parse.restype = ctypes.c_int64
+                lib.slot_feed_parse.argtypes = [
+                    ctypes.c_char_p, ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_longlong), ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int]
+                cls._lib = lib
+            except NativeBuildError:
+                cls._failed = True  # no toolchain: callers fall back to python
+        return cls._lib
+
+
+def native_available() -> bool:
+    return _Lib.get() is not None
+
+
+def parse_dense_file(path: str, threads: int = 4
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse a whitespace-separated numeric file whose last column is an int
+    label.  Returns (feats float32 (N, C-1), labels int64 (N,)), or None if
+    the native library is unavailable (caller falls back to Python parsing).
+    Raises ValueError on malformed content (non-numeric tokens, short rows).
+    """
+    lib = _Lib.get()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    rc = lib.slot_feed_dims(path.encode(), ctypes.byref(rows), ctypes.byref(cols))
+    if rc != 0:
+        raise OSError(f"slot_feed_dims({path!r}) failed: errno {-rc}")
+    n, c = rows.value, cols.value
+    if n == 0 or c < 2:
+        raise ValueError(f"{path}: need >=1 row and >=2 columns, got {n}x{c}")
+    feats = np.empty((n, c - 1), np.float32)
+    labels = np.empty((n,), np.int64)
+    got = lib.slot_feed_parse(
+        path.encode(), feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        n, c, int(threads))
+    if got < 0:
+        raise ValueError(f"{path}: malformed slot file (code {got})")
+    if got != n:
+        raise ValueError(f"{path}: parsed {got} rows, expected {n}")
+    return feats, labels
