@@ -25,6 +25,45 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _resolve_capacity(T, E, k, capacity, capacity_factor):
+    if capacity is None:
+        capacity = int(math.ceil(k * T / E * capacity_factor))
+        capacity = max(4, -(-capacity // 4) * 4)  # multiple of 4 for tiling
+    return capacity
+
+
+def _gating_rounds(logits, k, C, jitter_key):
+    """The shared top-k selection loop (single source of truth for gating
+    semantics — both the mask-building and index-building wrappers consume
+    it).  Yields per-round (idx, pos, keep, gate) plus final (probs, ce_acc,
+    denom): idx (T,) chosen expert, pos (T,) slot in that expert's buffer,
+    keep (T,) within-capacity, gate (T,) raw selected prob."""
+    T, E = logits.shape
+    if jitter_key is not None:
+        logits = logits + jax.random.uniform(jitter_key, logits.shape,
+                                             logits.dtype, -1e-2, 1e-2)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
+    fill = jnp.zeros((E,), jnp.int32)
+    masked = probs
+    ce_acc = jnp.zeros((E,), jnp.float32)  # dispatched-token fractions
+    denom = jnp.zeros((T,), jnp.float32)   # Σ of the k selected gate probs
+    rounds = []
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                    # (T,)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # (T, E)
+        # position of each token within its chosen expert's buffer
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot       # (T, E)
+        pos = jnp.sum(pos_in_e * onehot, axis=-1) + fill[idx]  # (T,)
+        keep = pos < C
+        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+        rounds.append((idx, pos, keep, gate))
+        fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
+        ce_acc = ce_acc + jnp.mean(onehot.astype(jnp.float32), axis=0)
+        denom = denom + gate
+        masked = jnp.where(onehot.astype(bool), -jnp.inf, masked)
+    return rounds, probs, ce_acc, denom
+
+
 def topk_gating(logits, k: int = 2, capacity: Optional[int] = None,
                 capacity_factor: float = 1.25, jitter_key=None):
     """Top-k gating with static per-expert capacity.
@@ -40,38 +79,16 @@ def topk_gating(logits, k: int = 2, capacity: Optional[int] = None,
       aux_loss: scalar load-balancing loss (Switch §2.2: E * Σ_e m_e · c_e).
     """
     T, E = logits.shape
-    if capacity is None:
-        capacity = int(math.ceil(k * T / E * capacity_factor))
-        capacity = max(4, -(-capacity // 4) * 4)
-    C = capacity
-    if jitter_key is not None:
-        logits = logits + jax.random.uniform(jitter_key, logits.shape,
-                                             logits.dtype, -1e-2, 1e-2)
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # (T, E)
-
+    C = _resolve_capacity(T, E, k, capacity, capacity_factor)
+    rounds, probs, ce_acc, denom = _gating_rounds(logits, k, C, jitter_key)
     combine = jnp.zeros((T, E, C), jnp.float32)
     dispatch = jnp.zeros((T, E, C), bool)
-    # running number of tokens already assigned to each expert
-    fill = jnp.zeros((E,), jnp.int32)
-    masked = probs
-    ce_acc = jnp.zeros((E,), jnp.float32)  # dispatched-token fractions
-    denom = jnp.zeros((T,), jnp.float32)   # Σ of the k selected gate probs
-    for _ in range(k):
-        idx = jnp.argmax(masked, axis=-1)                    # (T,)
-        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)     # (T, E)
-        # position of each token within its chosen expert's buffer
-        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot       # (T, E)
-        pos = jnp.sum(pos_in_e * onehot, axis=-1) + fill[idx]  # (T,)
-        keep = pos < C
-        gate = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    for idx, pos, keep, gate in rounds:
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
         slot = jax.nn.one_hot(pos, C, dtype=jnp.float32) * keep[:, None]
-        contrib = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :]
+        contrib = onehot[:, :, None] * slot[:, None, :]
         combine = combine + gate[:, None, None] * contrib
         dispatch = dispatch | (contrib > 0)
-        fill = fill + jnp.sum(onehot * keep[:, None].astype(jnp.int32), axis=0)
-        ce_acc = ce_acc + jnp.mean(onehot.astype(jnp.float32), axis=0)
-        denom = denom + gate
-        masked = jnp.where(onehot.astype(bool), -jnp.inf, masked)
     if k > 1:
         # GShard renormalization: selected gates sum to 1 over the chosen k
         # (k=1 keeps the raw prob — Switch convention)
@@ -131,4 +148,79 @@ def moe_ffn(x, gate_w, w1, b1, w2, b2, k: int = 2,
         expert_out = lax.with_sharding_constraint(
             expert_out, NamedSharding(mesh, P(expert_axis, None, None)))
     out = moe_combine(expert_out, combine, dtype=x.dtype)
+    return out, aux
+
+
+# ---------------------------------------------------------------------------
+# Index-based dispatch (gather/scatter) — O(T·H) data movement instead of the
+# GShard einsum's O(T·E·C·H) matmul.  At ERNIE bench shapes (T=4096, E=8,
+# C≈1280, H=768) the einsum dispatch+combine costs ~2x the expert FFN's own
+# FLOPs and materializes (T, E, C) fp32 masks (~170MB); the index path moves
+# each token once.  ≙ the reference's global_scatter/global_gather, which is
+# likewise an index exchange, not a matmul
+# (operators/collective/global_scatter_op.cu.cc).
+# ---------------------------------------------------------------------------
+
+def topk_gating_indices(logits, k: int = 2, capacity: Optional[int] = None,
+                        capacity_factor: float = 1.25, jitter_key=None):
+    """Top-k gating that returns slot indices instead of (T, E, C) masks.
+
+    Returns:
+      expert_idx (T, k) int32 — chosen expert per token/choice
+      slot_idx   (T, k) int32 — position in that expert's buffer; == C when
+        the token overflowed capacity (dropped)
+      gates      (T, k) f32  — combine weights (GShard-renormalized over the
+        selected k when k > 1; zero for dropped slots)
+      aux_loss   scalar load-balance loss (same formula as topk_gating)
+      capacity   the static per-expert capacity C used
+    """
+    T, E = logits.shape
+    C = _resolve_capacity(T, E, k, capacity, capacity_factor)
+    rounds, probs, ce_acc, denom = _gating_rounds(logits, k, C, jitter_key)
+    expert_idx = jnp.stack([r[0].astype(jnp.int32) for r in rounds], axis=1)
+    slot_idx = jnp.stack([jnp.where(r[2], r[1], C).astype(jnp.int32)
+                          for r in rounds], axis=1)
+    gate_k = jnp.stack([jnp.where(r[2], r[3], 0.0) for r in rounds], axis=1)
+    if k > 1:
+        # same denominator as topk_gating: all selected probs incl. dropped
+        gate_k = gate_k / jnp.maximum(denom, 1e-9)[:, None]
+    me = jnp.mean(probs, axis=0)
+    aux_loss = E * jnp.sum(me * ce_acc / k)
+    return expert_idx, slot_idx, gate_k, aux_loss, C
+
+
+def moe_ffn_indices(x, gate_w, w1, b1, w2, b2, k: int = 2,
+                    capacity_factor: float = 1.25, mesh=None,
+                    expert_axis: str = "data", jitter_key=None,
+                    activation=jax.nn.gelu):
+    """moe_ffn with gather/scatter dispatch — numerically equivalent to the
+    einsum path (see tests), O(T·H) data movement."""
+    T, H = x.shape
+    E = gate_w.shape[-1]
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    expert_idx, slot_idx, gates, aux, C = topk_gating_indices(
+        logits, k=k, capacity_factor=capacity_factor, jitter_key=jitter_key)
+
+    # flat slot id e*C + c; dropped slots land in a trash row at E*C
+    flat = jnp.where(slot_idx < C, expert_idx * C + slot_idx, E * C)  # (T, k)
+    buf = jnp.zeros((E * C + 1, H), x.dtype)
+    # slots are unique by construction (cumsum positions), so .set is exact;
+    # only the trash row sees duplicate writes (value irrelevant)
+    buf = buf.at[flat.reshape(-1)].set(
+        jnp.repeat(x, k, axis=0), unique_indices=False)
+    expert_in = buf[:E * C].reshape(E, C, H)
+    if mesh is not None and mesh.shape.get(expert_axis, 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        expert_in = lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P(expert_axis, None, None)))
+    expert_out = expert_ffn(expert_in, w1, b1, w2, b2, activation)
+    if mesh is not None and mesh.shape.get(expert_axis, 1) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        expert_out = lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P(expert_axis, None, None)))
+    out_flat = jnp.concatenate(
+        [expert_out.reshape(E * C, H), jnp.zeros((1, H), expert_out.dtype)])
+    picked = out_flat[flat]                                   # (T, k, H)
+    out = jnp.sum(picked.astype(jnp.float32)
+                  * gates[..., None], axis=1).astype(x.dtype)
     return out, aux

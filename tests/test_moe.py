@@ -249,3 +249,53 @@ def test_ragged_scatter_small_block_raises():
                   out_specs=P("data"))
     with _pytest.raises(ValueError, match="block"):
         jax.jit(g)(X, C)
+
+
+class TestIndexDispatchParity:
+    """moe_ffn_indices must match the einsum moe_ffn bit-for-tolerance."""
+
+    def _inputs(self, T=64, H=16, E=4, I=32, seed=0):
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.standard_normal((T, H)).astype("float32"))
+        gw = jnp.asarray(rng.standard_normal((H, E)).astype("float32") * 0.1)
+        w1 = jnp.asarray(rng.standard_normal((E, H, I)).astype("float32") * 0.1)
+        b1 = jnp.zeros((E, I))
+        w2 = jnp.asarray(rng.standard_normal((E, I, H)).astype("float32") * 0.1)
+        b2 = jnp.zeros((E, H))
+        return x, gw, w1, b1, w2, b2
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_forward_parity(self, k):
+        import numpy as np
+        from paddle_tpu.ops.moe import moe_ffn, moe_ffn_indices
+        x, gw, w1, b1, w2, b2 = self._inputs()
+        o1, a1 = moe_ffn(x, gw, w1, b1, w2, b2, k=k, capacity_factor=1.25)
+        o2, a2 = moe_ffn_indices(x, gw, w1, b1, w2, b2, k=k, capacity_factor=1.25)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(a1), float(a2), rtol=1e-6)
+
+    def test_grad_parity(self):
+        import numpy as np
+        from paddle_tpu.ops.moe import moe_ffn, moe_ffn_indices
+        x, gw, w1, b1, w2, b2 = self._inputs(T=32, H=8, E=2, I=16, seed=1)
+
+        def loss(fn, xx):
+            out, aux = fn(xx, gw, w1, b1, w2, b2, k=2)
+            return jnp.sum(out ** 2) + aux
+
+        g1 = jax.grad(lambda xx: loss(moe_ffn, xx))(x)
+        g2 = jax.grad(lambda xx: loss(moe_ffn_indices, xx))(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_overflow_drops_match(self):
+        # tiny capacity forces drops on both paths identically
+        import numpy as np
+        from paddle_tpu.ops.moe import moe_ffn, moe_ffn_indices
+        x, gw, w1, b1, w2, b2 = self._inputs(T=64, H=16, E=4, seed=2)
+        o1, _ = moe_ffn(x, gw, w1, b1, w2, b2, k=2, capacity_factor=0.3)
+        o2, _ = moe_ffn_indices(x, gw, w1, b1, w2, b2, k=2, capacity_factor=0.3)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   rtol=1e-5, atol=1e-6)
