@@ -119,7 +119,8 @@ def _fleet_hcg(**degrees):
     return fleet.get_hybrid_communicate_group()
 
 
-def bench_gpt2s(on_tpu):
+def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
+    """Shared GPT bench harness: build config + hybrid step, time, report."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -128,19 +129,8 @@ def bench_gpt2s(on_tpu):
     from paddle_tpu.optimizer import AdamW
 
     paddle.seed(0)
-    if on_tpu:
-        # B=16 + fully-unrolled layer scan measured best on v5e (see
-        # BENCH_NOTES.md sweep: 113.5k tok/s vs 91.9k at the round-1 config)
-        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
-                        num_attention_heads=12, max_position_embeddings=1024,
-                        compute_dtype="bfloat16", scan_unroll=12)
-        B, L, iters = 16, 1024, 30
-    else:
-        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
-                        num_attention_heads=4, max_position_embeddings=128,
-                        compute_dtype="float32")
-        B, L, iters = 2, 128, 3
-
+    cfg = GPTConfig(**(cfg_tpu if on_tpu else cfg_cpu))
+    B, L, iters = geom_tpu if on_tpu else geom_cpu
     hcg = _fleet_hcg()
     model = GPTModel(cfg)
     step, state = make_gpt_train_step(model, AdamW(3e-4, weight_decay=0.01),
@@ -150,8 +140,35 @@ def bench_gpt2s(on_tpu):
     y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
     args = (state, jax.random.key(0), np.float32(3e-4), x, y)
     dt, loss, flops = _run_timed(step, args, iters)
-    return _result("gpt2s_train_tokens_per_sec", "tokens/s/chip",
-                   B * L, iters, dt, flops, on_tpu, loss)
+    return _result(metric, "tokens/s/chip", B * L, iters, dt, flops, on_tpu, loss)
+
+
+def bench_gpt2s(on_tpu):
+    # B=16 + fully-unrolled layer scan measured best on v5e (see
+    # BENCH_NOTES.md sweep: 113.5k tok/s vs 91.9k at the round-1 config)
+    return _bench_gpt(
+        "gpt2s_train_tokens_per_sec",
+        dict(vocab_size=50304, hidden_size=768, num_layers=12,
+             num_attention_heads=12, max_position_embeddings=1024,
+             compute_dtype="bfloat16", scan_unroll=12), (16, 1024, 30),
+        dict(vocab_size=512, hidden_size=128, num_layers=2,
+             num_attention_heads=4, max_position_embeddings=128,
+             compute_dtype="float32"), (2, 128, 3),
+        on_tpu)
+
+
+def bench_gpt_long(on_tpu):
+    """Long-context: L=8192 via the Pallas flash kernel (O(L) memory —
+    the dense path would need a 64M-entry score matrix per head)."""
+    return _bench_gpt(
+        "gpt_long8k_train_tokens_per_sec",
+        dict(vocab_size=50304, hidden_size=768, num_layers=12,
+             num_attention_heads=12, max_position_embeddings=8192,
+             compute_dtype="bfloat16", scan_unroll=12), (1, 8192, 20),
+        dict(vocab_size=512, hidden_size=128, num_layers=2,
+             num_attention_heads=4, max_position_embeddings=512,
+             compute_dtype="float32"), (1, 512, 3),
+        on_tpu)
 
 
 def bench_bert_base(on_tpu):
@@ -270,6 +287,7 @@ def bench_mnist_lenet(on_tpu):
 
 CONFIGS = {
     "gpt2s": bench_gpt2s,
+    "gpt_long": bench_gpt_long,
     "bert_base": bench_bert_base,
     "ernie_moe": bench_ernie_moe,
     "resnet50": bench_resnet50,

@@ -47,8 +47,14 @@ class Model:
 
     def _ensure_train_step(self):
         if self._train_step is None:
-            self._train_step, self._state = make_train_step(
-                self.network, self._loss, self._optimizer)
+            accum = getattr(self, "_accum_batches", 1)
+            if accum > 1:
+                from ..jit.functional import make_accum_train_step
+                self._train_step, self._state = make_accum_train_step(
+                    self.network, self._loss, self._optimizer, accum)
+            else:
+                self._train_step, self._state = make_train_step(
+                    self.network, self._loss, self._optimizer)
 
     def _ensure_eval_step(self):
         if self._eval_step is None:
@@ -119,6 +125,20 @@ class Model:
             steps = len(train_loader)
         except TypeError:
             steps = None
+        accumulate_grad_batches = max(1, int(accumulate_grad_batches))
+        if self._train_step is not None \
+                and getattr(self, "_accum_batches", 1) != accumulate_grad_batches:
+            # rebuild on ANY window change (incl. back to 1): sync trained
+            # params to the layer first and carry the optimizer state, else
+            # the rebuild would silently reset Adam moments / trained weights
+            self._sync_back()
+            old_opt = self._state["opt"] if self._state is not None else None
+            self._train_step = None
+            self._accum_batches = accumulate_grad_batches
+            self._ensure_train_step()
+            if old_opt is not None:
+                self._state["opt"] = old_opt
+        self._accum_batches = accumulate_grad_batches
         cbks = config_callbacks(callbacks, model=self, batch_size=batch_size,
                                 epochs=epochs, steps=steps, log_freq=log_freq,
                                 verbose=verbose, save_freq=save_freq,

@@ -98,6 +98,49 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True):
     return step, state0
 
 
+def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
+                          donate: bool = True):
+    """Gradient-accumulating train step (≙ GradientMergeOptimizer,
+    fluid/optimizer.py:6783): grads from ``accum_steps`` consecutive calls
+    are summed in the TrainState; the optimizer applies their mean on every
+    ``accum_steps``-th call (lax.cond — one compiled program, no Python
+    branching).  Same signature as make_train_step."""
+    apply_fn, params0, buffers0 = functionalize(layer)
+    opt_state0 = optimizer.init_state(params0)
+    acc0 = jax.tree.map(jnp.zeros_like, params0)
+    state0 = {"params": params0, "opt": opt_state0, "buffers": buffers0,
+              "acc": acc0, "acc_count": jnp.zeros((), jnp.int32)}
+
+    def loss_of(p, b, key, inputs, labels):
+        out, new_b = apply_fn(p, b, *inputs, rng_key=key, training=True)
+        main_out = out[0] if isinstance(out, (list, tuple)) else out
+        loss_t = loss_fn(_wrap(main_out), *wrap_tree(labels))
+        return _unwrap(loss_t), (new_b, main_out)
+
+    @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+    def step(state, key, lr, inputs, labels):
+        (loss, (new_b, out)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state["params"], state["buffers"], key, inputs, labels)
+        acc = jax.tree.map(lambda a, g: a + g, state["acc"], grads)
+        cnt = state["acc_count"] + 1
+
+        def apply(_):
+            mean = jax.tree.map(lambda a: a / accum_steps, acc)
+            p, o = optimizer.update(mean, state["opt"], state["params"], lr=lr)
+            return p, o, jax.tree.map(jnp.zeros_like, acc), jnp.zeros((), jnp.int32)
+
+        def hold(_):
+            return state["params"], state["opt"], acc, cnt
+
+        params, opt, acc_out, cnt_out = jax.lax.cond(
+            cnt >= accum_steps, apply, hold, None)
+        new_state = {"params": params, "opt": opt, "buffers": new_b,
+                     "acc": acc_out, "acc_count": cnt_out}
+        return new_state, (loss, out)
+
+    return step, state0
+
+
 def make_eval_step(layer, loss_fn=None):
     apply_fn, _, _ = functionalize(layer)
 

@@ -159,3 +159,75 @@ def test_lr_schedulers():
     p.step(1.0)
     p.step(1.0)
     assert p() < 0.1 + 1e-12
+
+
+class TestGradAccumulation:
+    def test_accum_equals_large_batch(self):
+        """N micro-batches with accumulation == one N-times-larger batch
+        (SGD makes the equivalence exact up to float assoc)."""
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.functional import (make_accum_train_step,
+                                               make_train_step)
+
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((32, 16)).astype("float32")
+        y = (X[:, 0] > 0).astype("int64")
+
+        def build():
+            paddle.seed(7)
+            net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 2))
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=net.parameters())
+            return net, opt
+
+        key = jax.random.key(0)
+        lr = np.float32(0.1)
+
+        net_a, opt_a = build()
+        step_a, state_a = make_accum_train_step(
+            net_a, paddle.nn.CrossEntropyLoss(), opt_a, accum_steps=4)
+        for i in range(4):
+            state_a, _ = step_a(state_a, key, lr,
+                                [X[i * 8:(i + 1) * 8]], [y[i * 8:(i + 1) * 8]])
+
+        net_b, opt_b = build()
+        step_b, state_b = make_train_step(net_b, paddle.nn.CrossEntropyLoss(),
+                                          opt_b)
+        state_b, _ = step_b(state_b, key, lr, [X], [y])
+
+        for name in state_a["params"]:
+            np.testing.assert_allclose(np.asarray(state_a["params"][name]),
+                                       np.asarray(state_b["params"][name]),
+                                       rtol=1e-5, atol=1e-6)
+        # counter reset after the apply step
+        assert int(state_a["acc_count"]) == 0
+
+    def test_fit_accepts_accumulate_grad_batches(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(0.2, parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss())
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((64, 8)).astype("float32")
+        y = (X[:, 0] > 0).astype("int64")
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return 64
+
+        model.fit(DS(), batch_size=8, epochs=2, verbose=0,
+                  accumulate_grad_batches=4)
+        res = model.evaluate(DataLoader(DS(), batch_size=8), verbose=0)
+        assert np.isfinite(res["loss"])
