@@ -33,7 +33,8 @@ class MoELayer(Layer):
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  top_k: int = 2, capacity_factor: float = 1.25,
                  expert_axis: str = "data", gate_jitter: bool = False,
-                 activation=jax.nn.gelu, name=None):
+                 activation=jax.nn.gelu, index_dispatch: bool = True,
+                 name=None):
         super().__init__()
         self.d_model, self.d_hidden = d_model, d_hidden
         self.num_experts, self.top_k = num_experts, top_k
@@ -41,6 +42,7 @@ class MoELayer(Layer):
         self.expert_axis = expert_axis
         self.gate_jitter = gate_jitter
         self.activation = activation
+        self.index_dispatch = index_dispatch  # gather/scatter vs einsum masks
         self._mesh = None
         E, H, I = num_experts, d_model, d_hidden
         init = Normal(0.0, 0.02)
@@ -59,13 +61,14 @@ class MoELayer(Layer):
         return self
 
     def forward(self, x):
-        from ...ops.moe import moe_ffn
+        from ...ops.moe import moe_ffn, moe_ffn_indices
+        ffn = moe_ffn_indices if self.index_dispatch else moe_ffn
         jitter_key = rng.next_key() if (self.gate_jitter and self.training) else None
 
         def f(x_, gw, w1, b1, w2, b2):
             shape = x_.shape
             tokens = x_.reshape(-1, self.d_model)
-            out, aux = moe_ffn(tokens, gw, w1, b1, w2, b2, k=self.top_k,
+            out, aux = ffn(tokens, gw, w1, b1, w2, b2, k=self.top_k,
                                capacity_factor=self.capacity_factor,
                                mesh=self._mesh, expert_axis=self.expert_axis,
                                jitter_key=jitter_key, activation=self.activation)
