@@ -436,8 +436,14 @@ def flash_attention(q, k, v, causal=False, scale=None, key_mask=None,
         # (and the dense fallback would apply no dropout at all)
         raise ValueError("dropout_p > 0 requires dropout_seed (vary it per "
                          "step, e.g. jax.random.bits(key, (), jnp.uint32))")
-    # choose the largest block size that tiles L exactly
-    block = next((b for b in (512, 256, 128) if L % b == 0), None)
+    # choose the largest block size that tiles L exactly; overridable for
+    # per-chip tuning (PADDLE_TPU_FLASH_BLOCK=256 etc.)
+    import os as _os
+    override = int(_os.environ.get("PADDLE_TPU_FLASH_BLOCK", "0"))
+    if override and L % override == 0:
+        block = override
+    else:
+        block = next((b for b in (512, 256, 128) if L % b == 0), None)
     if _use_pallas() and block is not None and q.shape == k.shape:
         kmask = (jnp.zeros((B, L), jnp.float32) if key_mask is None
                  else key_mask.reshape(B, L).astype(jnp.float32))
