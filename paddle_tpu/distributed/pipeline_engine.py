@@ -116,11 +116,13 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
             def pipelined(blocks, mbs):
                 return spmd_pipeline(stage_fn, blocks, mbs, S, axis="pipe")
 
+            # check_vma left ON: spmd_pipeline marks its carry varying via
+            # pvary, so the varying-manual-axes checker passes and catches
+            # real replication bugs
             out_mb = jax.shard_map(
                 pipelined, mesh=mesh,
                 in_specs=({k: P("pipe") for k in stacked_keys}, P()),
-                out_specs=P(), axis_names={"pipe"},
-                check_vma=False)(block_params, mb)
+                out_specs=P(), axis_names={"pipe"})(block_params, mb)
         else:
             def body(carry, sl):
                 fn = jax.checkpoint(block_fn) if remat else block_fn

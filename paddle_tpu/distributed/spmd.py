@@ -150,8 +150,15 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
 
     if remat_ticks:
         tick = jax.checkpoint(tick)
-    _, ys = jax.lax.scan(tick, jnp.zeros_like(microbatches[0]),
-                         jnp.arange(M + S - 1))
+    carry0 = jnp.zeros_like(microbatches[0])
+    # shard_map varying-manual-axes check (jax>=0.7): the carry becomes
+    # device-varying after the first ppermute, so the init must be too.
+    # pcast is the current API; pvary its deprecated spelling.
+    if hasattr(jax.lax, "pcast"):
+        carry0 = jax.lax.pcast(carry0, (axis,), to="varying")
+    elif hasattr(jax.lax, "pvary"):
+        carry0 = jax.lax.pvary(carry0, (axis,))
+    _, ys = jax.lax.scan(tick, carry0, jnp.arange(M + S - 1))
     # ticks S-1 .. M+S-2 are the last stage's M finished micro-batches
     outputs = ys[S - 1:]
     # broadcast final outputs from the last stage to every stage
