@@ -109,8 +109,12 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
 
             def warm_branch(args):
                 gf_, u_, v_ = args
-                return (lax.pmean(gf_, axis), jnp.zeros_like(u_),
-                        jnp.zeros_like(v_))
+                from .spmd import ensure_varying
+                # replicated warm-up outputs vs varying DGC-branch residuals:
+                # unify variance for the cond type check
+                return tuple(ensure_varying(o, axis) for o in
+                             (lax.pmean(gf_, axis), jnp.zeros_like(u_),
+                              jnp.zeros_like(v_)))
 
             # lax.cond so the non-taken branch's collective is skipped at
             # runtime (jnp.where would run the dense pmean every step)
@@ -136,6 +140,10 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
 
     @functools.lru_cache(maxsize=8)
     def _compiled(n_batch):
+        # check_vma stays off here: the aggregated gradient is built by
+        # scattering all_gather'd (vals, idx) pairs — value-identical on
+        # every replica, but the VMA checker cannot statically prove
+        # replication through a scatter, so P() out_specs would be rejected
         w = jax.shard_map(
             body, mesh=mesh,
             in_specs=(specs, P()) + (P(axis),) * n_batch,

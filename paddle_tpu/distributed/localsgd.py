@@ -72,10 +72,19 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
         # and merely discard it — the collective must be skipped at runtime
         # on non-sync steps or LocalSGD saves no ICI traffic at all
         sync = (count % k_steps) == 0
+
+        from .spmd import ensure_varying
+
+        def _revary(p):
+            # pmean output is replicated; the skip branch stays varying —
+            # re-mark so both lax.cond branches type-check under the VMA
+            # checker (the values ARE equal across replicas post-pmean)
+            return ensure_varying(p, axis)
+
         new_params = lax.cond(
             sync,
             lambda ps: jax.tree_util.tree_map(
-                lambda p: lax.pmean(p, axis), ps),
+                lambda p: _revary(lax.pmean(p, axis)), ps),
             lambda ps: ps,
             new_params)
 
@@ -92,8 +101,7 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
         w = jax.shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, P()) + (batch_spec,) * n_batch,
-            out_specs=(state_specs, P()),
-            check_vma=False)
+            out_specs=(state_specs, P()))
         return jax.jit(w, donate_argnums=(0,) if donate else ())
 
     def step(state, lr, *batch):

@@ -127,6 +127,19 @@ def build_state_shardings(state, params_specs: Dict[str, P], mesh: Mesh,
 # of 1F1B's bounded in-flight window.
 # --------------------------------------------------------------------------
 
+def ensure_varying(x, axis):
+    """Mark ``x`` device-varying over ``axis`` for shard_map's VMA checker,
+    as a no-op when it already is (pcast rejects varying→varying)."""
+    vma = getattr(jax.core.get_aval(x), "vma", None)
+    if vma is None or axis in vma:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, (axis,), to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis,))
+    return x
+
+
 def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
                   axis: str = "pipe", remat_ticks: bool = True):
     """Run inside shard_map over ``axis``.
@@ -150,14 +163,9 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
 
     if remat_ticks:
         tick = jax.checkpoint(tick)
-    carry0 = jnp.zeros_like(microbatches[0])
     # shard_map varying-manual-axes check (jax>=0.7): the carry becomes
-    # device-varying after the first ppermute, so the init must be too.
-    # pcast is the current API; pvary its deprecated spelling.
-    if hasattr(jax.lax, "pcast"):
-        carry0 = jax.lax.pcast(carry0, (axis,), to="varying")
-    elif hasattr(jax.lax, "pvary"):
-        carry0 = jax.lax.pvary(carry0, (axis,))
+    # device-varying after the first ppermute, so the init must be too
+    carry0 = ensure_varying(jnp.zeros_like(microbatches[0]), axis)
     _, ys = jax.lax.scan(tick, carry0, jnp.arange(M + S - 1))
     # ticks S-1 .. M+S-2 are the last stage's M finished micro-batches
     outputs = ys[S - 1:]
