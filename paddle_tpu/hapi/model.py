@@ -45,16 +45,67 @@ class Model:
         self._amp_configs = amp_configs
         return self
 
+    _SCALER_KEYS = ("init_loss_scaling", "incr_ratio", "decr_ratio",
+                    "incr_every_n_steps", "decr_every_n_nan_or_inf")
+
+    def _amp_cfg(self):
+        cfg = self._amp_configs
+        if not cfg:
+            return None
+        if isinstance(cfg, str):
+            cfg = {"level": cfg}
+        if cfg.get("level", "O1") == "O0":
+            return None  # O0 = pure fp32, AMP off (reference semantics)
+        return cfg
+
+    def _amp_trace_ctx(self):
+        """Context factory for tracing under AMP — jax.jit traces lazily at
+        the first step call, so the auto_cast must wrap the traced body, not
+        the step construction (reference hapi amp integration; bf16-first)."""
+        cfg = self._amp_cfg()
+        if cfg is None:
+            return None
+        def ctx():
+            from .. import amp as _amp
+            return _amp.auto_cast(
+                enable=True, level=cfg.get("level", "O1"),
+                dtype=cfg.get("dtype", "bfloat16"),
+                custom_white_list=cfg.get("custom_white_list"),
+                custom_black_list=cfg.get("custom_black_list"))
+        return ctx
+
     def _ensure_train_step(self):
         if self._train_step is None:
+            cfg = self._amp_cfg()
+            if (cfg is not None and cfg.get("level") == "O2"
+                    and not getattr(self, "_amp_decorated", False)):
+                # O2 = whole-model low-precision params (norms stay fp32);
+                # the optimizer keeps fp32 masters via multi_precision
+                from .. import amp as _amp
+                _amp.decorate(self.network, level="O2",
+                              dtype=cfg.get("dtype", "bfloat16"))
+                if cfg.get("master_weight", True):
+                    self._optimizer._multi_precision = True
+                self._amp_decorated = True
+            scaler_cfg = None
+            if cfg is not None and (cfg.get("dtype") == "float16" or
+                                    any(k in cfg for k in self._SCALER_KEYS)):
+                scaler_cfg = {k: cfg[k] for k in self._SCALER_KEYS if k in cfg}
+                scaler_cfg.setdefault("init_loss_scaling", 2.0 ** 15)
             accum = getattr(self, "_accum_batches", 1)
             if accum > 1:
+                if scaler_cfg:
+                    raise NotImplementedError(
+                        "loss scaling with accumulate_grad_batches>1 is not "
+                        "wired yet; use bf16 AMP (no scaler) or accumulation=1")
                 from ..jit.functional import make_accum_train_step
                 self._train_step, self._state = make_accum_train_step(
-                    self.network, self._loss, self._optimizer, accum)
+                    self.network, self._loss, self._optimizer, accum,
+                    trace_ctx=self._amp_trace_ctx())
             else:
                 self._train_step, self._state = make_train_step(
-                    self.network, self._loss, self._optimizer)
+                    self.network, self._loss, self._optimizer,
+                    trace_ctx=self._amp_trace_ctx(), scaler_cfg=scaler_cfg)
 
     def _ensure_eval_step(self):
         if self._eval_step is None:

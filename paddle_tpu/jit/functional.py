@@ -67,7 +67,70 @@ def functionalize(layer) -> Tuple[Callable, Dict[str, Any], Dict[str, Any]]:
     return apply_fn, params, buffers
 
 
-def make_train_step(layer, loss_fn, optimizer, donate: bool = True):
+def _make_loss_of(apply_fn, loss_fn, trace_ctx):
+    """Shared traced loss body for the step builders (single copy so
+    trace-time behavior — AMP casts etc. — cannot diverge between them)."""
+    def loss_of(p, b, key, inputs, labels):
+        with (trace_ctx() if trace_ctx is not None else contextlib.nullcontext()):
+            out, new_b = apply_fn(p, b, *inputs, rng_key=key, training=True)
+            main_out = out[0] if isinstance(out, (list, tuple)) else out
+            loss_t = loss_fn(_wrap(main_out), *wrap_tree(labels))
+        return _unwrap(loss_t), (new_b, main_out)
+    return loss_of
+
+
+def _make_scaler(scaler_cfg):
+    if not scaler_cfg:
+        return None
+    from ..amp import GradScaler
+    return GradScaler(
+        init_loss_scaling=float(scaler_cfg.get("init_loss_scaling", 2.0 ** 15)),
+        incr_ratio=float(scaler_cfg.get("incr_ratio", 2.0)),
+        decr_ratio=float(scaler_cfg.get("decr_ratio", 0.5)),
+        incr_every_n_steps=int(scaler_cfg.get("incr_every_n_steps", 1000)),
+        decr_every_n_nan_or_inf=int(
+            scaler_cfg.get("decr_every_n_nan_or_inf", 1)))
+
+
+def _scaled_grads(loss_of, state, key, inputs, labels, scaler):
+    """Grad computation, optionally under dynamic loss scaling.  All scaler
+    math lives in GradScaler.functional_update (≙ check_finite_and_unscale +
+    update_loss_scaling ops) — one implementation, shared with eager mode."""
+    if scaler is None:
+        (loss, (new_b, out)), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state["params"], state["buffers"], key, inputs, labels)
+        return loss, new_b, out, grads, {}, None
+
+    scale = state["scaler"]["scale"]
+
+    def scaled(p, b, key, inputs, labels):
+        loss, aux = loss_of(p, b, key, inputs, labels)
+        return loss * scale.astype(loss.dtype), (loss, aux)
+
+    (_, (loss, (new_b, out))), sgrads = jax.value_and_grad(
+        scaled, has_aux=True)(state["params"], state["buffers"], key, inputs,
+                              labels)
+    unscaled, found_inf, scaler_state = scaler.functional_update(
+        state["scaler"], sgrads)
+    return loss, new_b, out, unscaled, {"scaler": scaler_state}, found_inf
+
+
+def _maybe_skip_update(optimizer, grads, state, lr, found_inf):
+    """Apply the optimizer unless found_inf (reference found_inf contract)."""
+    if found_inf is None:
+        return optimizer.update(grads, state["opt"], state["params"], lr=lr)
+
+    def apply(_):
+        return optimizer.update(grads, state["opt"], state["params"], lr=lr)
+
+    def skip(_):
+        return state["params"], state["opt"]
+
+    return jax.lax.cond(found_inf, skip, apply, None)
+
+
+def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
+                    trace_ctx=None, scaler_cfg=None):
     """Build a jit-compiled train step closure over (layer, loss, optimizer).
 
     Returns ``(step, state0)`` where
@@ -76,30 +139,34 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True):
     The whole update (fwd+bwd+optimizer) compiles to ONE XLA program —
     the analog of the reference's static-graph train program (§3.1) without
     any ProgramDesc.
+
+    ``trace_ctx``: optional context factory entered at TRACE time (jax.jit
+    traces lazily at the first call) — e.g. amp.auto_cast.
+    ``scaler_cfg``: optional dict of GradScaler knobs enabling in-step
+    dynamic loss scaling (fp16 AMP; bf16 does not need one).
     """
     apply_fn, params0, buffers0 = functionalize(layer)
     opt_state0 = optimizer.init_state(params0)
+    scaler = _make_scaler(scaler_cfg)
     state0 = {"params": params0, "opt": opt_state0, "buffers": buffers0}
-
-    def loss_of(p, b, key, inputs, labels):
-        out, new_b = apply_fn(p, b, *inputs, rng_key=key, training=True)
-        main_out = out[0] if isinstance(out, (list, tuple)) else out
-        loss_t = loss_fn(_wrap(main_out), *wrap_tree(labels))
-        return _unwrap(loss_t), (new_b, main_out)
+    if scaler is not None:
+        state0["scaler"] = scaler.init_state()
+    loss_of = _make_loss_of(apply_fn, loss_fn, trace_ctx)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, key, lr, inputs, labels):
-        (loss, (new_b, out)), grads = jax.value_and_grad(loss_of, has_aux=True)(
-            state["params"], state["buffers"], key, inputs, labels)
-        new_params, new_opt = optimizer.update(grads, state["opt"], state["params"],
-                                               lr=lr)
-        return {"params": new_params, "opt": new_opt, "buffers": new_b}, (loss, out)
+        loss, new_b, out, grads, scaler_state, found_inf = _scaled_grads(
+            loss_of, state, key, inputs, labels, scaler)
+        new_params, new_opt = _maybe_skip_update(optimizer, grads, state, lr,
+                                                 found_inf)
+        return {"params": new_params, "opt": new_opt, "buffers": new_b,
+                **scaler_state}, (loss, out)
 
     return step, state0
 
 
 def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
-                          donate: bool = True):
+                          donate: bool = True, trace_ctx=None):
     """Gradient-accumulating train step (≙ GradientMergeOptimizer,
     fluid/optimizer.py:6783): grads from ``accum_steps`` consecutive calls
     are summed in the TrainState; the optimizer applies their mean on every
@@ -110,12 +177,7 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
     acc0 = jax.tree.map(jnp.zeros_like, params0)
     state0 = {"params": params0, "opt": opt_state0, "buffers": buffers0,
               "acc": acc0, "acc_count": jnp.zeros((), jnp.int32)}
-
-    def loss_of(p, b, key, inputs, labels):
-        out, new_b = apply_fn(p, b, *inputs, rng_key=key, training=True)
-        main_out = out[0] if isinstance(out, (list, tuple)) else out
-        loss_t = loss_fn(_wrap(main_out), *wrap_tree(labels))
-        return _unwrap(loss_t), (new_b, main_out)
+    loss_of = _make_loss_of(apply_fn, loss_fn, trace_ctx)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, key, lr, inputs, labels):

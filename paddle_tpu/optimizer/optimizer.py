@@ -122,11 +122,26 @@ class Optimizer:
         return None, None
 
     # ---------------------------------------------------------- functional
+    def _mp_applies(self, p) -> bool:
+        return bool(self._multi_precision) and \
+            jnp.issubdtype(p.dtype, jnp.floating) and p.dtype.itemsize == 2
+
+    def _make_slots(self, p):
+        """Slots for one param; multi_precision adds an fp32 master copy and
+        keeps the moment buffers fp32 (reference master-weight contract —
+        the low-precision param is a cast of the fp32 master)."""
+        if self._mp_applies(p):
+            m = p.astype(jnp.float32)
+            slots = self._init_slots(m)
+            slots["master"] = m
+            return slots
+        return self._init_slots(p)
+
     def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Build the optimizer-state pytree for a named param pytree."""
         state = {
             "step": jnp.zeros([], jnp.int32),
-            "slots": jax.tree_util.tree_map(lambda p: self._init_slots(p), params,
+            "slots": jax.tree_util.tree_map(lambda p: self._make_slots(p), params,
                                             is_leaf=lambda x: hasattr(x, "shape")),
         }
         return state
@@ -142,10 +157,18 @@ class Optimizer:
         def upd(p, g, slots, pname):
             if g is None:
                 return p, slots
-            g = g.astype(p.dtype) if g.dtype != p.dtype else g
+            master = slots.get("master") if isinstance(slots, dict) else None
+            tgt = master if master is not None else p
+            g = g.astype(tgt.dtype) if g.dtype != tgt.dtype else g
             if self._weight_decay is not None and self._use_coupled_wd(object()):
-                g = g + self._weight_decay.grad_term(p).astype(g.dtype)
+                g = g + self._weight_decay.grad_term(tgt).astype(g.dtype)
             extra = {"param_name": pname} if self._wants_param_name else {}
+            if master is not None:
+                inner = {k: v for k, v in slots.items() if k != "master"}
+                new_master, new_inner = self._rule(master, g, inner, lr,
+                                                   step=step, **extra)
+                new_inner["master"] = new_master
+                return new_master.astype(p.dtype), new_inner
             return self._rule(p, g, slots, lr, step=step, **extra)
 
         flat_kp, treedef = jax.tree_util.tree_flatten_with_path(params)

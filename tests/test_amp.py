@@ -95,3 +95,129 @@ def test_decorate_o2():
     amp.decorate(net, level="O2", dtype="bfloat16")
     assert net[0].weight.dtype == jnp.bfloat16
     assert net[1].weight.dtype == jnp.float32  # norms stay fp32
+
+
+class TestHapiAmpConfigs:
+    def test_prepare_amp_configs_bakes_bf16(self):
+        """prepare(amp_configs='O1') must bake bf16 casts into the compiled
+        step (jax.jit traces lazily — regression for the wrap-construction
+        bug where the context closed before tracing)."""
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss(), amp_configs="O1")
+        model._ensure_train_step()
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((8, 16)).astype("float32")
+        y = (X[:, 0] > 0).astype("int64")
+        hlo = model._train_step.lower(
+            model._state, jax.random.key(0), np.float32(1e-2),
+            [X], [y]).as_text()
+        assert "bf16" in hlo
+
+        plain = paddle.Model(nn.Sequential(nn.Linear(16, 2)))
+        plain.prepare(paddle.optimizer.Adam(1e-2,
+                                            parameters=plain.network.parameters()),
+                      paddle.nn.CrossEntropyLoss())
+        plain._ensure_train_step()
+        hlo2 = plain._train_step.lower(
+            plain._state, jax.random.key(0), np.float32(1e-2),
+            [X], [y]).as_text()
+        assert "bf16" not in hlo2  # no amp → no bf16
+
+    def test_amp_configs_O0_disables(self):
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(1e-2, parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss(), amp_configs="O0")
+        model._ensure_train_step()
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((8, 16)).astype("float32")
+        y = (X[:, 0] > 0).astype("int64")
+        hlo = model._train_step.lower(model._state, jax.random.key(0),
+                                      np.float32(1e-2), [X], [y]).as_text()
+        assert "bf16" not in hlo  # O0 = pure fp32, AMP must stay off
+
+    def test_amp_configs_O2_casts_params(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(1e-2, parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss(), amp_configs={"level": "O2"})
+        model._ensure_train_step()
+        import jax.numpy as jnp
+        # linear weights cast to bf16; LayerNorm stays fp32 (reference O2)
+        assert model._state["params"]["0.weight"].dtype == jnp.bfloat16
+        assert model._state["params"]["1.weight"].dtype == jnp.float32
+        # fp32 master weights + fp32 moments ride the optimizer slots
+        slots = model._state["opt"]["slots"]["0.weight"]
+        assert slots["master"].dtype == jnp.float32
+        assert slots["moment1"].dtype == jnp.float32
+
+    def test_amp_configs_accum_path_bakes_bf16(self):
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(16, 2))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(1e-2, parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss(), amp_configs="O1")
+        model._accum_batches = 2
+        model._ensure_train_step()
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((8, 16)).astype("float32")
+        y = (X[:, 0] > 0).astype("int64")
+        hlo = model._train_step.lower(model._state, jax.random.key(0),
+                                      np.float32(1e-2), [X], [y]).as_text()
+        assert "bf16" in hlo
+
+    def test_fp16_scaler_skips_on_inf_and_decays(self):
+        """In-step dynamic loss scaling: a non-finite grad skips the update
+        and halves the scale (check_finite_and_unscale + update_loss_scaling
+        semantics)."""
+        import numpy as np
+        import jax
+        import jax.numpy as jnp
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        from paddle_tpu.jit.functional import make_train_step
+
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 2))
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+        step, state = make_train_step(
+            net, paddle.nn.CrossEntropyLoss(), opt,
+            scaler_cfg={"init_loss_scaling": 8.0})
+        X = np.random.RandomState(0).standard_normal((4, 4)).astype("float32")
+        y = np.array([0, 1, 0, 1])
+        key = jax.random.key(0)
+        s1, _ = step(state, key, np.float32(0.1), [X], [y])
+        w_after_1 = np.asarray(s1["params"]["0.weight"])
+        assert float(s1["scaler"]["scale"]) == 8.0
+        X_inf = X.copy()
+        X_inf[0, 0] = np.inf  # data-driven non-finite grads
+        s2, _ = step(s1, key, np.float32(0.1), [X_inf], [y])
+        # inf loss → grads non-finite → update skipped, scale halved
+        np.testing.assert_array_equal(np.asarray(s2["params"]["0.weight"]),
+                                      w_after_1)
+        assert float(s2["scaler"]["scale"]) == 4.0
