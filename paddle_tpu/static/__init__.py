@@ -400,31 +400,9 @@ class CompiledProgram:
 ParallelExecutor = Executor
 
 
-# ``paddle.static.nn`` namespace: common layers aliased to the dynamic ops
-class _StaticNN:
-    @staticmethod
-    def fc(x, size, num_flatten_dims=1, activation=None, name=None):
-        import paddle_tpu.nn.functional as F
-        xv = getattr(x, "_data", x)
-        flat = xv.reshape(xv.shape[:num_flatten_dims] + (-1,))
-        w = create_parameter([flat.shape[-1], size], str(flat.dtype))
-        b = create_parameter([size], str(flat.dtype), is_bias=True)
-        out = Tensor(flat) @ w + b
-        if activation == "relu":
-            out = F.relu(out)
-        elif activation == "softmax":
-            out = F.softmax(out)
-        return out
-
-    @staticmethod
-    def batch_norm(x, **kwargs):
-        from ..nn import BatchNorm1D, BatchNorm2D
-        xv = getattr(x, "_data", x)
-        bn = (BatchNorm2D if xv.ndim == 4 else BatchNorm1D)(xv.shape[1])
-        return bn(x if isinstance(x, Tensor) else Tensor(xv))
-
-
-nn = _StaticNN()
+# ``paddle.static.nn`` namespace (static/nn.py — parameter-creating layer
+# functions + lax-native control flow)
+from . import nn  # noqa: E402,F401
 
 
 # --------------------------------------------------------------------------

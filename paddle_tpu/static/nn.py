@@ -1,0 +1,474 @@
+"""``paddle.static.nn`` — parameter-creating layer functions + control flow
+(reference: python/paddle/static/nn/__init__.py; common.py fc:15, the
+fluid.layers conv/norm family, and lax-native control flow instead of
+conditional_block_op/while_op sub-block execution).
+
+Sequence_* LoD ops are a declared non-goal (SURVEY §7 — ragged/segment ops
+replace LoD); everything else on the reference's dense list is here.  The
+"static" flavor means the function CREATES its parameters (reference
+behavior under a program guard); under jit tracing the created parameters
+become constants of the traced program unless bound through a Layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _raw(x):
+    return getattr(x, "_data", x)
+
+
+def _param(shape, dtype="float32", is_bias=False, attr=None):
+    from . import create_parameter
+    return create_parameter(list(shape), dtype, attr=attr, is_bias=is_bias)
+
+
+def _tw(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+# ----------------------------------------------------------------- dense
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    import paddle_tpu.nn.functional as F
+    xv = _raw(x)
+    flat = xv.reshape(xv.shape[:num_flatten_dims] + (-1,))
+    w = _param([flat.shape[-1], size], str(flat.dtype), attr=weight_attr)
+    b = _param([size], str(flat.dtype), is_bias=True, attr=bias_attr)
+    out = Tensor(flat) @ w + b
+    if activation == "relu":
+        out = F.relu(out)
+    elif activation == "softmax":
+        out = F.softmax(out)
+    elif activation == "tanh":
+        out = F.tanh(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None, dtype="float32",
+              param_attr=None):
+    import paddle_tpu.nn.functional as F
+    w = _param(size, dtype, attr=param_attr)
+    return F.embedding(_tw(input), w, padding_idx=padding_idx)
+
+
+# dense fallback: sparse PS tables are a non-goal; the dense embedding has
+# identical math (reference sparse_embedding is a storage-side optimization)
+sparse_embedding = embedding
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    xv, yv = _raw(x), _raw(y)
+    w = _param([size, xv.shape[-1], yv.shape[-1]], str(xv.dtype),
+               attr=param_attr)
+    b = _param([size], str(xv.dtype), is_bias=True, attr=bias_attr)
+    out = jnp.einsum("bi,kij,bj->bk", xv, _raw(w), yv) + _raw(b)
+    if act == "tanh":
+        out = jnp.tanh(out)
+    elif act == "relu":
+        out = jax.nn.relu(out)
+    return Tensor(out)
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+    xv = _raw(x)
+    if mode == "element":
+        # per-element alpha broadcasts directly (reference shape (1, *rest))
+        alpha = _param((1,) + tuple(xv.shape[1:]), str(xv.dtype),
+                       attr=param_attr)
+        av = _raw(alpha)
+        out = jnp.where(xv > 0, xv, xv * av)
+        return Tensor(out)
+    n = 1 if mode == "all" else xv.shape[1 if data_format[1] == "C" else -1]
+    alpha = _param([n], str(xv.dtype), attr=param_attr)
+    return F.prelu(_tw(x), alpha, data_format=data_format)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (≙ row_conv_op): out[t] = Σ_{i=0..k}
+    w[i] ⊙ x[t+i] over a per-channel weight window into the future."""
+    xv = _raw(input)  # (B, T, D)
+    k = int(future_context_size)
+    w = _param([k + 1, xv.shape[-1]], str(xv.dtype), attr=param_attr)
+    wv = _raw(w)
+    pad = jnp.pad(xv, ((0, 0), (0, k), (0, 0)))
+    out = sum(pad[:, i:i + xv.shape[1], :] * wv[i] for i in range(k + 1))
+    if act == "tanh":
+        out = jnp.tanh(out)
+    return Tensor(out)
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (≙ nce_op): logistic regression of
+    the true class against ``num_neg_samples`` uniformly drawn noise classes.
+    Returns per-example loss (B, 1)."""
+    xv = _raw(input)                      # (B, D)
+    lv = _raw(label).reshape(-1)          # (B,)
+    B, D = xv.shape
+    w = _param([num_total_classes, D], str(xv.dtype), attr=param_attr)
+    b = _param([num_total_classes], str(xv.dtype), is_bias=True,
+               attr=bias_attr)
+    wv, bv = _raw(w), _raw(b)
+    neg = jax.random.randint(jax.random.key(seed), (B, num_neg_samples), 0,
+                             num_total_classes)
+    pos_logit = jnp.sum(xv * wv[lv], -1) + bv[lv]                    # (B,)
+    neg_logit = jnp.einsum("bd,bnd->bn", xv, wv[neg]) + bv[neg]      # (B, n)
+    logsig = jax.nn.log_sigmoid
+    loss = -(logsig(pos_logit) + jnp.sum(logsig(-neg_logit), -1))
+    return Tensor(loss[:, None])
+
+
+# ----------------------------------------------------------------- convs
+def _conv_nd(fn, input, num_filters, filter_size, stride, padding, dilation,
+             groups, param_attr, bias_attr, data_format, ndim, transpose=False,
+             output_size=None):
+    xv = _raw(input)
+    cin = xv.shape[1 if data_format[1] == "C" else -1]
+    fs = (filter_size,) * ndim if isinstance(filter_size, int) \
+        else tuple(filter_size)
+    if transpose:
+        wshape = (cin, num_filters // (groups or 1)) + fs
+    else:
+        wshape = (num_filters, cin // (groups or 1)) + fs
+    w = _param(wshape, str(xv.dtype), attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters], str(xv.dtype),
+                                               is_bias=True, attr=bias_attr)
+    kw = {"output_size": output_size} if transpose and output_size is not None \
+        else {}
+    return fn(_tw(input), w, b, stride=stride, padding=padding,
+              dilation=dilation, groups=groups or 1, data_format=data_format,
+              **kw)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    import paddle_tpu.nn.functional as F
+    out = _conv_nd(F.conv2d, input, num_filters, filter_size, stride, padding,
+                   dilation, groups, param_attr, bias_attr, data_format, 2)
+    return F.relu(out) if act == "relu" else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    import paddle_tpu.nn.functional as F
+    out = _conv_nd(F.conv3d, input, num_filters, filter_size, stride, padding,
+                   dilation, groups, param_attr, bias_attr, data_format, 3)
+    return F.relu(out) if act == "relu" else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None, data_format="NCHW"):
+    import paddle_tpu.nn.functional as F
+    out = _conv_nd(F.conv2d_transpose, input, num_filters, filter_size, stride,
+                   padding, dilation, groups, param_attr, bias_attr,
+                   data_format, 2, transpose=True, output_size=output_size)
+    return F.relu(out) if act == "relu" else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None, data_format="NCDHW"):
+    import paddle_tpu.nn.functional as F
+    out = _conv_nd(F.conv3d_transpose, input, num_filters, filter_size, stride,
+                   padding, dilation, groups, param_attr, bias_attr,
+                   data_format, 3, transpose=True, output_size=output_size)
+    return F.relu(out) if act == "relu" else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    xv = _raw(x)
+    fs = (filter_size,) * 2 if isinstance(filter_size, int) else tuple(filter_size)
+    w = _param((num_filters, xv.shape[1] // groups) + fs, str(xv.dtype),
+               attr=param_attr)
+    b = None if bias_attr is False else _param([num_filters], str(xv.dtype),
+                                               is_bias=True, attr=bias_attr)
+    return _dc(_tw(x), _tw(offset), w, b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=None if mask is None else _tw(mask))
+
+
+# ----------------------------------------------------------------- norms
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW", **kwargs):
+    from ..nn import BatchNorm1D, BatchNorm2D, BatchNorm3D
+    xv = _raw(input)
+    cls = {2: BatchNorm1D, 3: BatchNorm1D, 4: BatchNorm2D, 5: BatchNorm3D}[xv.ndim]
+    bn = cls(xv.shape[1], momentum=momentum, epsilon=epsilon)
+    if is_test:
+        bn.eval()
+    out = bn(_tw(input))
+    import paddle_tpu.nn.functional as F
+    return F.relu(out) if act == "relu" else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    import paddle_tpu.nn.functional as F
+    xv = _raw(input)
+    shape = xv.shape[begin_norm_axis:]
+    w = _param(shape, str(xv.dtype), attr=param_attr) if scale else None
+    b = _param(shape, str(xv.dtype), is_bias=True, attr=bias_attr) if shift else None
+    return F.layer_norm(_tw(input), shape, weight=w, bias=b, epsilon=epsilon)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    import paddle_tpu.nn.functional as F
+    xv = _raw(input)
+    C = xv.shape[1]
+    w = _param([C], str(xv.dtype), attr=param_attr)
+    b = _param([C], str(xv.dtype), is_bias=True, attr=bias_attr)
+    return F.instance_norm(_tw(input), weight=w, bias=b, eps=epsilon)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    import paddle_tpu.nn.functional as F
+    xv = _raw(input)
+    C = xv.shape[1 if data_layout[1] == "C" else -1]
+    w = _param([C], str(xv.dtype), attr=param_attr)
+    b = _param([C], str(xv.dtype), is_bias=True, attr=bias_attr)
+    out = F.group_norm(_tw(input), groups, weight=w, bias=b, epsilon=epsilon,
+                       data_format=data_layout)
+    return F.relu(out) if act == "relu" else out
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, data_layout=None,
+              in_place=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Feature-wise normalization from accumulated batch statistics
+    (≙ data_norm_op — CTR-style scale-free normalization)."""
+    xv = _raw(input).astype(jnp.float32)
+    mean = jnp.mean(xv, axis=0, keepdims=True)
+    var = jnp.var(xv, axis=0, keepdims=True)
+    out = (xv - mean) * jax.lax.rsqrt(var + epsilon)
+    if enable_scale_and_shift:
+        w = _param([xv.shape[-1]], "float32", attr=param_attr)
+        b = _param([xv.shape[-1]], "float32", is_bias=True)
+        out = out * _raw(w) + _raw(b)
+    return Tensor(out)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight tensor (≙ spectral_norm_op)."""
+    wv = _raw(weight)
+    wmat = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+    u = jnp.ones((wmat.shape[0],), wmat.dtype)
+    for _ in range(max(1, power_iters)):
+        v = wmat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = wmat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    sigma = u @ wmat @ v
+    return Tensor(wv / (sigma + eps))
+
+
+# ----------------------------------------------------------- control flow
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """``lax.cond`` (≙ conditional_block_op sub-block execution).  Either
+    branch fn may be None (reference contract) — a None branch is a no-op
+    returning None, which requires the other branch to return None too."""
+    t_fn = true_fn if true_fn is not None else (lambda: None)
+    f_fn = false_fn if false_fn is not None else (lambda: None)
+    p = _raw(pred)
+    t_struct = jax.tree_util.tree_structure(t_fn())
+    f_struct = jax.tree_util.tree_structure(f_fn())
+    if t_struct != f_struct:
+        raise ValueError(
+            f"cond branches must return the same structure, got {t_struct} "
+            f"vs {f_struct} (a None branch returns None)")
+    if t_struct == jax.tree_util.tree_structure(None):
+        return None  # both branches are no-ops
+    out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
+                       lambda _: jax.tree_util.tree_map(_raw, t_fn()),
+                       lambda _: jax.tree_util.tree_map(_raw, f_fn()),
+                       None)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins chain of (pred, fn) (≙ case in control_flow.py)."""
+    if default is None:
+        *pred_fn_pairs, last = pred_fn_pairs
+        default = last[1]
+    result = default
+    for pred, fn in reversed(list(pred_fn_pairs)):
+        prev = result
+        result = (lambda pr, f, pv: lambda: cond(pr, f, pv))(pred, fn, prev)
+    return result() if callable(result) else result
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """``lax.switch`` (≙ switch_case in control_flow.py)."""
+    if isinstance(branch_fns, dict):
+        keys = sorted(branch_fns)
+        fns = [branch_fns[k] for k in keys]
+        # map branch_index → dense position
+        idx = _raw(branch_index)
+        dense = sum(jnp.where(idx == k, i, 0) for i, k in enumerate(keys))
+        hit = sum((idx == k).astype(jnp.int32) for k in keys)
+        if default is not None:
+            fns = fns + [default]
+            dense = jnp.where(hit > 0, dense, len(keys))
+        else:  # reference: unmatched index falls back to the LARGEST key
+            dense = jnp.where(hit > 0, dense, len(keys) - 1)
+    else:
+        fns = list(branch_fns)
+        idx = _raw(branch_index)
+        in_range = (idx >= 0) & (idx < len(fns))
+        if default is not None:
+            fns = fns + [default]
+            dense = jnp.where(in_range, jnp.clip(idx, 0, len(fns) - 2),
+                              len(fns) - 1)
+        else:  # reference: out-of-range runs the LAST branch
+            dense = jnp.where(in_range, jnp.clip(idx, 0, len(fns) - 1),
+                              len(fns) - 1)
+    out = jax.lax.switch(jnp.reshape(dense, ()),
+                         [lambda _, f=f: jax.tree_util.tree_map(_raw, f())
+                          for f in fns], None)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """``lax.while_loop`` (≙ while_op sub-block execution)."""
+    raw_vars = jax.tree_util.tree_map(_raw, loop_vars)
+
+    def c(vs):
+        return jnp.reshape(_raw(cond(*jax.tree_util.tree_map(Tensor, vs))),
+                           ()).astype(bool)
+
+    def b(vs):
+        return jax.tree_util.tree_map(
+            _raw, body(*jax.tree_util.tree_map(Tensor, vs)))
+
+    out = jax.lax.while_loop(c, b, raw_vars)
+    return jax.tree_util.tree_map(Tensor, out)
+
+
+# ---------------------------------------------------------------- decode
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode over emission scores (≙ crf_decoding_op); transition
+    defaults to a created parameter like the reference's CRF weight."""
+    from ..text import viterbi_decode
+    xv = _raw(input)
+    n_tags = xv.shape[-1]
+    if transition is None:
+        transition = _param([n_tags + 2, n_tags], str(xv.dtype),
+                            attr=param_attr)
+    tv = _raw(transition)
+    # reference layout carries start/stop rows first; viterbi takes (T, T)
+    trans = tv[-n_tags:] if tv.shape[0] != n_tags else tv
+    if xv.ndim == 2:
+        xv = xv[None]
+    lens = _raw(length) if length is not None else \
+        jnp.full((xv.shape[0],), xv.shape[1], jnp.int32)
+    scores, path = viterbi_decode(Tensor(xv), Tensor(trans),
+                                  Tensor(jnp.asarray(lens)))
+    if label is not None:
+        # reference: with a gold label the op returns per-position 0/1
+        # correctness, not the path
+        lv = _raw(label)
+        if lv.ndim == path._data.ndim + 1 and lv.shape[-1] == 1:
+            lv = lv[..., 0]
+        return Tensor((_raw(path) == lv).astype(jnp.int32))
+    return path
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, offset=0.5, flip=True,
+                   kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (≙ multi_box_head in detection.py): per feature
+    map, prior boxes + conv loc/conf predictions, concatenated."""
+    import paddle_tpu.nn.functional as F
+    n_in = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule: evenly spaced between min/max ratio
+        min_ratio, max_ratio = float(min_ratio), float(max_ratio)
+        step = (max_ratio - min_ratio) / max(n_in - 2, 1)
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        r = min_ratio
+        for _ in range(n_in - 1):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+            r += step
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    img_h, img_w = _raw(image).shape[2:]
+    for i, feat in enumerate(inputs):
+        fv = _raw(feat)
+        N, C, H, W = fv.shape
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        # priors per cell: 1 (min) + 1 (sqrt(min*max)) + len(ar)*(2 if flip)
+        mn = float(min_sizes[i])
+        mx = float(max_sizes[i]) if max_sizes is not None else None
+        sizes = [(mn, mn)]
+        if mx is not None:  # the sqrt(min*max) prior needs a max size
+            sizes.append((np.sqrt(mn * mx), np.sqrt(mn * mx)))
+        for a in ar:
+            sizes.append((mn * np.sqrt(a), mn / np.sqrt(a)))
+            if flip:
+                sizes.append((mn / np.sqrt(a), mn * np.sqrt(a)))
+        n_prior = len(sizes)
+        step_w = steps[i] if steps else img_w / W
+        step_h = steps[i] if steps else img_h / H
+        cx = (jnp.arange(W) + offset) * step_w / img_w
+        cy = (jnp.arange(H) + offset) * step_h / img_h
+        cyx = jnp.stack(jnp.meshgrid(cy, cx, indexing="ij"), -1)  # (H, W, 2)
+        pb = []
+        for (sw, sh) in sizes:
+            half = jnp.asarray([sh / img_h / 2, sw / img_w / 2])
+            mins = cyx - half
+            maxs = cyx + half
+            pb.append(jnp.concatenate([mins[..., ::-1], maxs[..., ::-1]], -1))
+        prior = jnp.clip(jnp.stack(pb, 2).reshape(H * W * len(sizes), 4), 0, 1)
+        boxes_all.append(prior)
+        vars_all.append(jnp.broadcast_to(jnp.asarray([0.1, 0.1, 0.2, 0.2]),
+                                         prior.shape))
+        loc = conv2d(feat, n_prior * 4, kernel_size, stride=stride, padding=pad)
+        conf = conv2d(feat, n_prior * num_classes, kernel_size, stride=stride,
+                      padding=pad)
+        locs.append(_raw(loc).transpose(0, 2, 3, 1).reshape(N, -1, 4))
+        confs.append(_raw(conf).transpose(0, 2, 3, 1).reshape(N, -1, num_classes))
+    return (Tensor(jnp.concatenate(locs, 1)),
+            Tensor(jnp.concatenate(confs, 1)),
+            Tensor(jnp.concatenate(boxes_all, 0)),
+            Tensor(jnp.concatenate(vars_all, 0)))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from . import py_func as _pf
+    return _pf(func, x, out, backward_func, skip_vars_in_backward_input)
+
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "case",
+    "cond", "conv2d", "conv2d_transpose", "conv3d", "conv3d_transpose",
+    "crf_decoding", "data_norm", "deform_conv2d", "group_norm",
+    "instance_norm", "layer_norm", "multi_box_head", "nce", "prelu",
+    "py_func", "row_conv", "spectral_norm", "switch_case", "while_loop",
+    "sparse_embedding",
+]
