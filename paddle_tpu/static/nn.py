@@ -35,6 +35,20 @@ def _tw(x):
 
 
 # ----------------------------------------------------------------- dense
+def _activate(out, name):
+    """Apply a named activation or raise — silent passthrough would drop a
+    ported model's nonlinearity."""
+    if name is None:
+        return out
+    import paddle_tpu.nn.functional as F
+    fns = {"relu": F.relu, "softmax": F.softmax, "tanh": F.tanh,
+           "sigmoid": F.sigmoid, "gelu": F.gelu, "leaky_relu": F.leaky_relu}
+    if name not in fns:
+        raise ValueError(f"unsupported activation {name!r}; "
+                         f"one of {sorted(fns)}")
+    return fns[name](out)
+
+
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     import paddle_tpu.nn.functional as F
@@ -43,13 +57,7 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     w = _param([flat.shape[-1], size], str(flat.dtype), attr=weight_attr)
     b = _param([size], str(flat.dtype), is_bias=True, attr=bias_attr)
     out = Tensor(flat) @ w + b
-    if activation == "relu":
-        out = F.relu(out)
-    elif activation == "softmax":
-        out = F.softmax(out)
-    elif activation == "tanh":
-        out = F.tanh(out)
-    return out
+    return _activate(out, activation)
 
 
 def embedding(input, size, is_sparse=False, padding_idx=None, dtype="float32",
@@ -157,7 +165,7 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     import paddle_tpu.nn.functional as F
     out = _conv_nd(F.conv2d, input, num_filters, filter_size, stride, padding,
                    dilation, groups, param_attr, bias_attr, data_format, 2)
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -166,7 +174,7 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     import paddle_tpu.nn.functional as F
     out = _conv_nd(F.conv3d, input, num_filters, filter_size, stride, padding,
                    dilation, groups, param_attr, bias_attr, data_format, 3)
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
@@ -177,7 +185,7 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     out = _conv_nd(F.conv2d_transpose, input, num_filters, filter_size, stride,
                    padding, dilation, groups, param_attr, bias_attr,
                    data_format, 2, transpose=True, output_size=output_size)
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
@@ -188,7 +196,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     out = _conv_nd(F.conv3d_transpose, input, num_filters, filter_size, stride,
                    padding, dilation, groups, param_attr, bias_attr,
                    data_format, 3, transpose=True, output_size=output_size)
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
@@ -216,8 +224,7 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
     if is_test:
         bn.eval()
     out = bn(_tw(input))
-    import paddle_tpu.nn.functional as F
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
@@ -249,7 +256,7 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     b = _param([C], str(xv.dtype), is_bias=True, attr=bias_attr)
     out = F.group_norm(_tw(input), groups, weight=w, bias=b, epsilon=epsilon,
                        data_format=data_layout)
-    return F.relu(out) if act == "relu" else out
+    return _activate(out, act)
 
 
 def data_norm(input, act=None, epsilon=1e-5, param_attr=None, data_layout=None,
@@ -292,8 +299,13 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     t_fn = true_fn if true_fn is not None else (lambda: None)
     f_fn = false_fn if false_fn is not None else (lambda: None)
     p = _raw(pred)
-    t_struct = jax.tree_util.tree_structure(t_fn())
-    f_struct = jax.tree_util.tree_structure(f_fn())
+    # evaluate each branch exactly ONCE (lax.cond traces both branches
+    # anyway; re-calling the fns would double side effects like parameter
+    # creation), then select between the pre-evaluated pytrees
+    t_out = jax.tree_util.tree_map(_raw, t_fn())
+    f_out = jax.tree_util.tree_map(_raw, f_fn())
+    t_struct = jax.tree_util.tree_structure(t_out)
+    f_struct = jax.tree_util.tree_structure(f_out)
     if t_struct != f_struct:
         raise ValueError(
             f"cond branches must return the same structure, got {t_struct} "
@@ -301,9 +313,8 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
     if t_struct == jax.tree_util.tree_structure(None):
         return None  # both branches are no-ops
     out = jax.lax.cond(jnp.reshape(p, ()).astype(bool),
-                       lambda _: jax.tree_util.tree_map(_raw, t_fn()),
-                       lambda _: jax.tree_util.tree_map(_raw, f_fn()),
-                       None)
+                       lambda ops: ops[0], lambda ops: ops[1],
+                       (t_out, f_out))
     return jax.tree_util.tree_map(Tensor, out)
 
 
@@ -378,14 +389,18 @@ def crf_decoding(input, param_attr=None, label=None, length=None,
         transition = _param([n_tags + 2, n_tags], str(xv.dtype),
                             attr=param_attr)
     tv = _raw(transition)
-    # reference layout carries start/stop rows first; viterbi takes (T, T)
-    trans = tv[-n_tags:] if tv.shape[0] != n_tags else tv
+    # reference layout carries start/stop rows first; after stripping them
+    # the matrix holds ordinary transitions only, so the decoder must not
+    # reinterpret rows as BOS/EOS bonuses
+    has_bos_eos = tv.shape[0] != n_tags
+    trans = tv[-n_tags:] if has_bos_eos else tv
     if xv.ndim == 2:
         xv = xv[None]
     lens = _raw(length) if length is not None else \
         jnp.full((xv.shape[0],), xv.shape[1], jnp.int32)
     scores, path = viterbi_decode(Tensor(xv), Tensor(trans),
-                                  Tensor(jnp.asarray(lens)))
+                                  Tensor(jnp.asarray(lens)),
+                                  include_bos_eos_tag=False)
     if label is not None:
         # reference: with a gold label the op returns per-position 0/1
         # correctness, not the path

@@ -253,6 +253,119 @@ class ContrastTransform(BaseTransform):
         return np.clip(mean + (arr - mean) * f, 0, 255 if arr.max() > 1.5 else 1.0)
 
 
+class SaturationTransform(BaseTransform):
+    """Random saturation jitter (reference transforms.py SaturationTransform):
+    blend between the grayscale image and the original."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        gray = (arr[..., :3] @ np.asarray([0.299, 0.587, 0.114],
+                                          "float32"))[..., None]
+        out = arr.copy()
+        out[..., :3] = gray + (arr[..., :3] - gray) * f
+        return np.clip(out, 0, 255 if arr.max() > 1.5 else 1.0)
+
+
+class HueTransform(BaseTransform):
+    """Random hue rotation (reference transforms.py HueTransform): shift the
+    hue channel in HSV space by a uniform offset in [-value, value]·0.5."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = _hwc(img).astype("float32")
+        scale = 255.0 if arr.max() > 1.5 else 1.0
+        rgb = arr[..., :3] / scale
+        mx = rgb.max(-1)
+        mn = rgb.min(-1)
+        diff = mx - mn + 1e-12
+        r, g, b = rgb[..., 0], rgb[..., 1], rgb[..., 2]
+        h = np.where(mx == r, (g - b) / diff % 6,
+                     np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4)) / 6.0
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+        v = mx
+        h = (h + random.uniform(-self.value, self.value)) % 1.0
+        i = np.floor(h * 6).astype("int32")
+        f = h * 6 - i
+        p = v * (1 - s)
+        q = v * (1 - f * s)
+        t = v * (1 - (1 - f) * s)
+        i = i % 6
+        choices = [np.stack(c, -1) for c in
+                   ((v, t, p), (q, v, p), (p, v, t), (p, q, v), (t, p, v),
+                    (v, p, q))]
+        out_rgb = np.select([(i == k)[..., None] for k in range(6)], choices) * scale
+        out = arr.copy()
+        out[..., :3] = out_rgb
+        return np.clip(out, 0, scale)
+
+
+class RandomRotation(BaseTransform):
+    """Random rotation by a degree in [-degrees, degrees] (reference
+    transforms.py RandomRotation; nearest resampling, center pivot)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _hwc(img)
+        angle = np.deg2rad(random.uniform(*self.degrees))
+        H, W = arr.shape[:2]
+        ca, sa = np.cos(angle), np.sin(angle)
+        if self.expand:
+            # enlarged canvas holding the whole rotated image
+            Ho = int(np.ceil(abs(H * ca) + abs(W * sa)))
+            Wo = int(np.ceil(abs(W * ca) + abs(H * sa)))
+        else:
+            Ho, Wo = H, W
+        cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if self.center is None \
+            else (self.center[1], self.center[0])
+        oy, ox = (Ho - 1) / 2.0, (Wo - 1) / 2.0
+        yy, xx = np.mgrid[0:Ho, 0:Wo]
+        # inverse map: output pixel ← source pixel
+        sx = ca * (xx - ox) + sa * (yy - oy) + cx
+        sy = -sa * (xx - ox) + ca * (yy - oy) + cy
+        if self.interpolation == "bilinear":
+            x0 = np.floor(sx).astype("int64")
+            y0 = np.floor(sy).astype("int64")
+            wx = (sx - x0)[..., None] if arr.ndim == 3 else (sx - x0)
+            wy = (sy - y0)[..., None] if arr.ndim == 3 else (sy - y0)
+
+            def g(yi, xi):
+                ok = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+                v = arr[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)].astype(
+                    "float32")
+                m = ok[..., None] if arr.ndim == 3 else ok
+                return np.where(m, v, float(self.fill))
+
+            out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y0, x0 + 1) * (1 - wy) * wx
+                   + g(y0 + 1, x0) * wy * (1 - wx) + g(y0 + 1, x0 + 1) * wy * wx)
+            return out.astype(arr.dtype)
+        sxi = np.round(sx).astype("int64")
+        syi = np.round(sy).astype("int64")
+        inb = (sxi >= 0) & (sxi < W) & (syi >= 0) & (syi < H)
+        out = np.full((Ho, Wo) + arr.shape[2:], self.fill, arr.dtype)
+        out[inb] = arr[np.clip(syi, 0, H - 1), np.clip(sxi, 0, W - 1)][inb]
+        return out
+
+
 class ColorJitter(BaseTransform):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
         super().__init__(keys)
@@ -261,6 +374,10 @@ class ColorJitter(BaseTransform):
             self.ts.append(BrightnessTransform(brightness))
         if contrast:
             self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
 
     def _apply_image(self, img):
         arr = img

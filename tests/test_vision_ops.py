@@ -223,3 +223,32 @@ class TestYoloLossGtScore:
         np.testing.assert_allclose(np.asarray(full._data), np.asarray(none._data),
                                    rtol=1e-6)
         assert not np.allclose(np.asarray(soft._data), np.asarray(full._data))
+
+
+class TestNewTransforms:
+    def test_saturation_hue_rotation(self):
+        import paddle_tpu.vision.transforms as T
+        rng = np.random.RandomState(0)
+        img = (rng.rand(16, 16, 3) * 255).astype("uint8")
+        assert T.SaturationTransform(0.4)(img).shape == (16, 16, 3)
+        assert T.HueTransform(0.2)(img).shape == (16, 16, 3)
+        assert T.RandomRotation(30)(img).shape == (16, 16, 3)
+        # zero-strength transforms are identity (within fp rounding)
+        f32 = img.astype("float32")
+        np.testing.assert_allclose(T.HueTransform(0.0)(f32), f32, atol=1e-3)
+        np.testing.assert_allclose(T.SaturationTransform(0.0)(f32), f32,
+                                   atol=1e-3)
+        np.testing.assert_allclose(T.RandomRotation(0)(f32), f32, atol=1e-3)
+
+    def test_grayscale_saturation_zero_matches_grayscale(self):
+        import paddle_tpu.vision.transforms as T
+        rng = np.random.RandomState(1)
+        img = rng.rand(8, 8, 3).astype("float32")
+
+        class Fixed(T.SaturationTransform):
+            def _apply_image(self, im):
+                gray = (im[..., :3] @ np.asarray([0.299, 0.587, 0.114],
+                                                 "float32"))[..., None]
+                return np.broadcast_to(gray, im.shape)
+        out = Fixed(0.0)(img)
+        assert np.allclose(out[..., 0], out[..., 1])
