@@ -29,6 +29,18 @@ ELASTIC_EXIT_CODE = 101   # reference fleet/elastic: restart-me protocol
 RESCALE_EXIT_CODE = 102   # restart with a recomputed world size
 
 
+def _drain(procs, grace: float = 10.0):
+    """Wait for SIGTERM'd children to exit; escalate to SIGKILL after the
+    grace period so a relaunch never overlaps stale trainers."""
+    deadline = time.time() + grace
+    for p in procs.values():
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+
+
 def _parse_args(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m paddle_tpu.distributed.launch",
@@ -49,6 +61,10 @@ def _parse_args(argv=None):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--max_restarts", type=int, default=3,
                    help="restarts allowed on ELASTIC_EXIT_CODE before giving up")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: restart only on exit code 101/102; 1 "
+                        "(fault-tolerant, ≙ reference manager.py:178): also "
+                        "restart the pod when a trainer crashes abnormally")
     p.add_argument("--elastic_store", type=str,
                    default=os.environ.get("PADDLE_ELASTIC_STORE", ""),
                    help="ElasticManager store dir; enables RESCALE (102) "
@@ -133,6 +149,7 @@ def launch(argv=None) -> int:
         # watch loop (≙ launch_utils.py watch_local_trainers): abort the pod
         # if any child fails; honor the elastic restart/rescale exit codes
         exit_code, restart, rescale = 0, False, False
+        crash_rc = 0  # real failure code behind a level-1 crash restart
         try:
             alive = {p.pid: p for p, _ in procs}
             while alive:
@@ -149,9 +166,19 @@ def launch(argv=None) -> int:
                         for q in alive.values():
                             q.send_signal(signal.SIGTERM)
                     elif rc != 0:
-                        exit_code = rc
+                        if args.elastic_level >= 1:
+                            # fault-tolerant: a crashed trainer (incl. signal
+                            # deaths, rc<0) restarts the pod like a 101
+                            restart = True
+                            crash_rc = rc
+                        else:
+                            exit_code = rc
                         for q in alive.values():
                             q.send_signal(signal.SIGTERM)
+                        # reap the peers before relaunching: stale trainers
+                        # hold the coordinator port / device claims and the
+                        # log files of the next pod
+                        _drain(alive)
                         alive = {}
                         break
                 time.sleep(0.5)
@@ -163,9 +190,11 @@ def launch(argv=None) -> int:
         # (a 102-exiting trainer routinely breaks peers' live collectives)
         if restart:
             if restarts >= args.max_restarts:
-                # a crash-looping job must not report success (ADVICE r1)
+                # a crash-looping job must not report success (ADVICE r1);
+                # a level-1 crash loop reports the REAL failure code, not
+                # "please restart me" (101 would loop outer supervisors)
                 print("[launch] restart budget exhausted", file=sys.stderr)
-                return ELASTIC_EXIT_CODE
+                return crash_rc if crash_rc else ELASTIC_EXIT_CODE
             restarts += 1
             if rescale:
                 world, nproc = _rescaled_world(args, world, nproc)

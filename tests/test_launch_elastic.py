@@ -86,3 +86,30 @@ class TestLauncher:
             print("RESUMED")
         """, extra=["--max_restarts", "2"])
         assert r.returncode == 0 and "RESUMED" in r.stdout, r.stderr
+
+    def test_fault_injection_sigkill_restarts_at_level1(self, tmp_path):
+        """Fault-tolerant level 1 (reference elastic manager.py:178): a
+        trainer killed with SIGKILL (rc=-9, no exit-code protocol possible)
+        restarts the pod; the relaunched run succeeds."""
+        r = self._run_launch(tmp_path, """
+            import os, signal
+            flag = os.path.join(os.path.dirname(__file__), "killed_once")
+            if not os.path.exists(flag):
+                open(flag, "w").close()
+                os.kill(os.getpid(), signal.SIGKILL)
+            print("SURVIVED")
+        """, extra=["--elastic_level", "1", "--max_restarts", "2"])
+        assert r.returncode == 0 and "SURVIVED" in r.stdout, (r.stdout, r.stderr)
+
+    def test_sigkill_without_level1_fails(self, tmp_path):
+        r = self._run_launch(tmp_path, """
+            import os, signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        """)
+        assert r.returncode != 0
+
+    def test_level1_crash_loop_propagates_real_code(self, tmp_path):
+        r = self._run_launch(tmp_path, "import sys; sys.exit(7)",
+                             extra=["--elastic_level", "1",
+                                    "--max_restarts", "2"])
+        assert r.returncode == 7, r.returncode  # not 101
