@@ -1,0 +1,125 @@
+"""Per-op micro-benchmark harness (≙ reference operators/benchmark/
+op_tester.cc + tools/ci_op_benchmark.sh: config-driven op latency with a
+relative regression gate).
+
+Usage:
+  python tools/op_bench.py                     # built-in op set, one JSON line per op
+  python tools/op_bench.py --ops matmul,softmax
+  python tools/op_bench.py --baseline prev.jsonl --gate 1.3   # CI regression gate
+
+Each line: {"op": name, "shape": ..., "ms": median, "backend": ...}.
+With --baseline, ops slower than gate x their baseline fail the run (exit 1)
+— the reference's PR-vs-develop relative gate, no absolute numbers stored.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+
+def _cases():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    B, L, H, D = 8, 1024, 12, 64
+    a2 = jnp.asarray(r.standard_normal((4096, 4096)), jnp.bfloat16)
+    act = jnp.asarray(r.standard_normal((B * L, 3072)), jnp.bfloat16)
+    img = jnp.asarray(r.standard_normal((32, 224, 224, 3)), jnp.bfloat16)
+    kern = jnp.asarray(r.standard_normal((3, 3, 3, 64)) * 0.1, jnp.bfloat16)
+    qkv = jnp.asarray(r.standard_normal((B, L, H, D)), jnp.bfloat16)
+    logits = jnp.asarray(r.standard_normal((B * L, 50304)), jnp.bfloat16)
+    labels = jnp.asarray(r.randint(0, 50304, (B * L,)))
+
+    def flash(q):
+        from paddle_tpu.ops.attention import flash_attention
+        return flash_attention(q, q, q, causal=True)
+
+    def fused_ce(lg):
+        from paddle_tpu.ops.loss import softmax_cross_entropy_mean
+        return softmax_cross_entropy_mean(lg, labels)
+
+    return {
+        "matmul_4096": (lambda x: x @ x, a2),
+        "softmax_50k": (lambda x: __import__("jax").nn.softmax(
+            x.astype(jnp.float32), -1), logits),
+        "gelu_bias": (lambda x: __import__("jax").nn.gelu(x + 1.0), act),
+        "layer_norm": (lambda x: (x - x.mean(-1, keepdims=True))
+                       * __import__("jax").lax.rsqrt(
+                           x.astype(jnp.float32).var(-1, keepdims=True) + 1e-5),
+                       act),
+        "conv2d_nhwc": (lambda x: __import__("jax").lax.conv_general_dilated(
+            x, kern, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), img),
+        "flash_attention": (flash, qkv),
+        "fused_softmax_ce": (fused_ce, logits),
+        "reduce_sum": (lambda x: x.astype(jnp.float32).sum(), act),
+    }
+
+
+def bench_op(name, fn, arg, iters=20):
+    import jax
+    import numpy as np
+
+    jfn = jax.jit(fn)
+    out = jfn(arg)
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]  # sync
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = jfn(arg)
+        np.asarray(jax.tree_util.tree_leaves(out)[0]).ravel()[:1]
+        times.append(time.perf_counter() - t0)
+    return {
+        "op": name,
+        "shape": list(np.shape(arg)),
+        "ms": round(float(np.median(times)) * 1e3, 4),
+        "backend": jax.default_backend(),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", default="all")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--baseline", help="prior run's JSONL for the regression gate")
+    ap.add_argument("--gate", type=float, default=1.3,
+                    help="fail ops slower than gate x baseline")
+    args = ap.parse_args()
+
+    cases = _cases()
+    names = list(cases) if args.ops == "all" else args.ops.split(",")
+    baseline = {}
+    if args.baseline:
+        with open(args.baseline) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    baseline[rec["op"]] = rec["ms"]
+
+    failed = []
+    for name in names:
+        if name not in cases:
+            print(json.dumps({"op": name, "error": "unknown op"}))
+            continue
+        fn, arg = cases[name]
+        rec = bench_op(name, fn, arg, args.iters)
+        if name in baseline:
+            rec["vs_baseline"] = round(rec["ms"] / baseline[name], 3)
+            if rec["ms"] > baseline[name] * args.gate:
+                rec["regressed"] = True
+                failed.append(name)
+        print(json.dumps(rec), flush=True)
+    if failed:
+        print(f"[op_bench] regression gate failed: {failed}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
