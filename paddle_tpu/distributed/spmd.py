@@ -247,21 +247,9 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
     return step, place(state0), state_sh
 
 
-def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
-                              zero_stage: int = 0, donate: bool = True):
-    """Shared GSPMD train-step builder for functional models (gpt/bert/ernie).
-
-    ``loss_of(params, *batch) -> scalar loss``.  Returns (step, state0) where
-    ``step(state, lr, *batch) -> (state, loss)``; params/opt-state sharded by
-    build_param_specs, params re-constrained each step so shardings stay
-    stable under donation.
-    """
-    p_specs = build_param_specs(params0, mesh, layer, zero_stage)
-    opt_state0 = optimizer.init_state(params0)
-    state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
-    state_sh = build_state_shardings(state0, p_specs, mesh,
-                                     max(zero_stage, 1), params0)
-
+def _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate):
+    """The shared jitted step kernel: fwd+bwd+update with params
+    re-constrained each step so shardings stay stable under donation."""
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, lr, *batch):
         loss, grads = jax.value_and_grad(loss_of)(state["params"], *batch)
@@ -270,7 +258,23 @@ def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
         new_params = jax.lax.with_sharding_constraint(
             new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
         return {"params": new_params, "opt": new_opt, "buffers": {}}, loss
+    return step
 
+
+def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
+                              zero_stage: int = 0, donate: bool = True):
+    """Shared GSPMD train-step builder for functional models (gpt/bert/ernie).
+
+    ``loss_of(params, *batch) -> scalar loss``.  Returns (step, state0) where
+    ``step(state, lr, *batch) -> (state, loss)``; params/opt-state sharded by
+    build_param_specs.
+    """
+    p_specs = build_param_specs(params0, mesh, layer, zero_stage)
+    opt_state0 = optimizer.init_state(params0)
+    state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
+    state_sh = build_state_shardings(state0, p_specs, mesh,
+                                     max(zero_stage, 1), params0)
+    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate)
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
         is_leaf=lambda x: hasattr(x, "shape"))
@@ -283,3 +287,31 @@ def shard_batch(batch, hcg):
     sh = NamedSharding(mesh, spec)
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(getattr(x, "_data", x), sh), batch)
+
+
+def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
+                                 meta_layer=None, zero_stage: int = 0,
+                                 donate: bool = True, seed: int = 0):
+    """Like make_gspmd_step_from_loss, but the TrainState is *initialized
+    directly sharded on the mesh*: ``build_params(key)`` runs under jit with
+    per-leaf out_shardings, so each device materializes only its shard and
+    the host never holds a full-size copy (the 6.7B fp32 params alone are
+    ~27GB host-side otherwise).  ≙ the reference's per-rank startup programs
+    after sharding_optimizer pruning; the scaling-book "init on the mesh".
+    """
+    key0 = jax.random.key(seed)
+
+    def init_state(key):
+        params = build_params(key)
+        return {"params": params, "opt": optimizer.init_state(params),
+                "buffers": {}}
+
+    # one abstract trace serves both the param specs and the state layout
+    state_abs = jax.eval_shape(init_state, key0)
+    abs_params = state_abs["params"]
+    p_specs = build_param_specs(abs_params, mesh, meta_layer, zero_stage)
+    state_sh = build_state_shardings(state_abs, p_specs, mesh,
+                                     max(zero_stage, 1), abs_params)
+    state0 = jax.jit(init_state, out_shardings=state_sh)(key0)
+    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate)
+    return step, state0

@@ -343,3 +343,51 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         return inner_step(state, lr, key, x, labels)
 
     return step, state0
+
+
+def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
+                                zero_stage: int = 0, seed: int = 0,
+                                remat=True, donate: bool = True):
+    """GPT train step whose parameters are initialized DIRECTLY sharded on
+    the mesh — no host-side full-size materialization (GPT-3 6.7B fp32
+    params are ~27GB on host with eager init).  Non-pipeline meshes only;
+    use make_gpt_train_step for pp_degree > 1.
+
+    ``zero_stage`` here means sharding SPECS only (params/slots partitioned
+    over the "sharding" axis); the contractual ZeRO extras — fp32 masters,
+    found_inf, dynamic loss scaling — live in make_gpt_train_step's
+    make_zero_train_step route and are NOT applied on this path.
+
+    Returns ``(step, state0)`` with ``step(state, lr, key, x, labels)``.
+    """
+    from ..core import rng as _rng
+    from ..distributed.spmd import make_gspmd_sharded_init_step
+
+    mesh = hcg.mesh
+    if mesh.shape.get("pipe", 1) > 1:
+        raise NotImplementedError("sharded init with pp_degree>1: use "
+                                  "make_gpt_train_step")
+    if cfg.sequence_parallel is not None:
+        raise NotImplementedError(
+            "sharded init does not wire sequence_parallel yet — ring/Ulysses "
+            "attention would silently fall back to gathered sequences; use "
+            "make_gpt_train_step for sep meshes")
+    holder = {}
+
+    def build(key):
+        with _rng.rng_scope(key):
+            m = GPTModel(cfg)
+        holder.setdefault("model", m)
+        return {n: p._data for n, p in m.named_parameters()}
+
+    jax.eval_shape(build, jax.random.key(seed))  # captures metadata model
+    meta_model = holder["model"]  # params hold dead tracers; metadata + pure fns only
+
+    def loss_of(params, key, x, labels):
+        h = meta_model.embed_fn(params, x, key)
+        h = meta_model.scan_blocks(params, h, key, remat=remat)
+        return meta_model.head_loss_fn(params, h, labels)
+
+    return make_gspmd_sharded_init_step(
+        loss_of, build, optimizer, mesh, meta_model, zero_stage=zero_stage,
+        donate=donate, seed=seed)

@@ -185,3 +185,43 @@ class TestMasterWeights:
                 np.asarray(state["params"][k]),
                 np.asarray(m.astype(jnp.bfloat16)))
         assert np.isfinite(float(loss))
+
+
+@needs8
+class TestShardedInit:
+    """make_sharded_gpt_train_step: params initialize DIRECTLY sharded on
+    the mesh (no host-side full-size copy — the 6.7B enabler)."""
+
+    def test_shards_and_trains(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.gpt import (GPTConfig,
+                                           make_sharded_gpt_train_step)
+        from paddle_tpu.optimizer import AdamW
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 8}
+        fleet.fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.fleet.get_hybrid_communicate_group()
+
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                        num_attention_heads=4, max_position_embeddings=64,
+                        compute_dtype="float32")
+        step, state = make_sharded_gpt_train_step(cfg, AdamW(1e-3), hcg,
+                                                  zero_stage=3)
+        w = state["params"]["blocks_fc1_w"]
+        full = int(np.prod(w.shape))
+        assert int(np.prod(w.addressable_shards[0].data.shape)) == full // 8
+        m1 = state["opt"]["slots"]["blocks_fc1_w"]["moment1"]
+        assert int(np.prod(m1.addressable_shards[0].data.shape)) == full // 8
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(0, 512, (8, 32)))
+        losses = []
+        for i in range(5):
+            state, loss = step(state, np.float32(1e-3), jax.random.key(i),
+                               x, x)
+            losses.append(float(np.asarray(loss)))
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
