@@ -1,0 +1,31 @@
+"""The driver's gating artifact: every bench config's child path must run
+and emit valid JSON on the CPU backend (rc=1 here was the round-1 red
+BENCH)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_all_configs_cpu_child():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_PADDLE_TPU_BENCH_CHILD"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), "--config", "all"],
+        capture_output=True, text=True, env=env, timeout=900, cwd=ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip().startswith("{")]
+    recs = [json.loads(l) for l in lines]
+    names = {r["metric"] for r in recs}
+    assert len(recs) >= 6, names  # gpt2s, gpt_long, bert, ernie, resnet, lenet
+    for r in recs:
+        assert r["value"] is not None and r["value"] > 0, r
+        assert r["backend"] == "cpu"
