@@ -244,3 +244,32 @@ def make_bert_train_step(model: BertModel, optimizer, hcg, remat: bool = True,
 
     return make_gspmd_step_from_loss(loss_of, params0, optimizer, hcg.mesh,
                                      layer=model, donate=donate)
+
+
+def make_sharded_bert_train_step(cfg: BertConfig, optimizer, hcg,
+                                 zero_stage: int = 0, seed: int = 0,
+                                 remat: bool = True, donate: bool = True):
+    """BERT pretraining step with mesh-direct sharded init (see
+    models/gpt.py make_sharded_gpt_train_step — same contract: sharding
+    SPECS only; contractual-ZeRO extras ride make_bert_train_step)."""
+    from ..core import rng as _rng
+    from ..distributed.spmd import make_gspmd_sharded_init_step
+
+    holder = {}
+
+    def build(key):
+        with _rng.rng_scope(key):
+            m = BertModel(cfg)
+        holder.setdefault("model", m)
+        return {n: p._data for n, p in m.named_parameters()}
+
+    jax.eval_shape(build, jax.random.key(seed))
+    meta = holder["model"]
+
+    def loss_of(params, input_ids, mlm_labels, nsp_labels):
+        return meta.pretrain_loss_fn(params, input_ids, mlm_labels,
+                                     nsp_labels, remat=remat)
+
+    return make_gspmd_sharded_init_step(loss_of, build, optimizer, hcg.mesh,
+                                        meta, zero_stage=zero_stage,
+                                        donate=donate, seed=seed)

@@ -215,3 +215,33 @@ def make_ernie_moe_train_step(model: ErnieMoeModel, optimizer, hcg,
 
     return make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh,
                                      layer=model, donate=donate)
+
+
+def make_sharded_ernie_moe_train_step(cfg: ErnieMoeConfig, optimizer, hcg,
+                                      zero_stage: int = 0, seed: int = 0,
+                                      remat: bool = True, donate: bool = True):
+    """ERNIE-MoE step with mesh-direct sharded init (see models/gpt.py
+    make_sharded_gpt_train_step — sharding SPECS only)."""
+    from ..core import rng as _rng
+    from ..distributed.spmd import make_gspmd_sharded_init_step
+
+    mesh = hcg.mesh
+    holder = {}
+
+    def build(key):
+        with _rng.rng_scope(key):
+            m = ErnieMoeModel(cfg)
+        holder.setdefault("model", m)
+        return {n: p._data for n, p in m.named_parameters()}
+
+    jax.eval_shape(build, jax.random.key(seed))
+    meta = holder["model"]
+
+    def loss_of(params, input_ids, labels):
+        h = meta.embed_fn(params, input_ids)
+        h, aux = meta.scan_blocks(params, h, mesh=mesh, remat=remat)
+        return meta.head_loss_fn(params, h, labels, aux)
+
+    return make_gspmd_sharded_init_step(loss_of, build, optimizer, mesh,
+                                        meta, zero_stage=zero_stage,
+                                        donate=donate, seed=seed)

@@ -225,3 +225,42 @@ class TestShardedInit:
                                x, x)
             losses.append(float(np.asarray(loss)))
         assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+    def test_bert_and_ernie_sharded_init(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models.bert import (BertConfig,
+                                            make_sharded_bert_train_step)
+        from paddle_tpu.models.ernie_moe import (
+            ErnieMoeConfig, make_sharded_ernie_moe_train_step)
+        from paddle_tpu.optimizer import AdamW
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 8}
+        fleet.fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.fleet.get_hybrid_communicate_group()
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 512, (8, 32)))
+
+        cfg = BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, max_position_embeddings=64,
+                         compute_dtype="float32")
+        step, state = make_sharded_bert_train_step(cfg, AdamW(1e-3), hcg,
+                                                   zero_stage=3)
+        w = state["params"]["blocks_fc1_w"]
+        assert int(np.prod(w.addressable_shards[0].data.shape)) \
+            == int(np.prod(w.shape)) // 8
+        nsp = jnp.asarray(rng.randint(0, 2, (8,)))
+        state, loss = step(state, np.float32(1e-3), ids, ids, nsp)
+        assert np.isfinite(float(np.asarray(loss)))
+
+        ecfg = ErnieMoeConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                              num_attention_heads=4, num_experts=4,
+                              max_position_embeddings=64,
+                              compute_dtype="float32")
+        estep, estate = make_sharded_ernie_moe_train_step(
+            ecfg, AdamW(1e-3), hcg, zero_stage=3)
+        estate, eloss = estep(estate, np.float32(1e-3), ids, ids)
+        assert np.isfinite(float(np.asarray(eloss)))
