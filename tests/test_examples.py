@@ -41,3 +41,10 @@ class TestExamples:
                    env_extra={"XLA_FLAGS":
                               "--xla_force_host_platform_device_count=8"})
         assert "step 1" in out
+
+    def test_gpt_hybrid_zero2(self):
+        out = _run("train_gpt_hybrid.py", "--dp", "4", "--zero", "2",
+                   "--steps", "2",
+                   env_extra={"XLA_FLAGS":
+                              "--xla_force_host_platform_device_count=8"})
+        assert "step 1" in out
