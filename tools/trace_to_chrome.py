@@ -1,0 +1,43 @@
+"""Convert a captured profile to Chrome tracing JSON (≙ reference
+tools/timeline.py, which converts profiler protos for chrome://tracing).
+
+Usage:
+  python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json
+
+The input is a directory written by ``paddle_tpu.profiler`` /
+``jax.profiler.trace`` (contains ``**/*.xplane.pb``). Open the output in
+chrome://tracing or https://ui.perfetto.dev.
+"""
+
+import argparse
+import glob
+import os
+import sys
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir", help="profiler output dir (contains *.xplane.pb)")
+    ap.add_argument("-o", "--output", default="trace.json")
+    args = ap.parse_args()
+
+    paths = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        print(f"no *.xplane.pb under {args.logdir}", file=sys.stderr)
+        return 1
+    os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _mime = rtd.xspace_to_tool_data(paths, "trace_viewer", {})
+    payload = data if isinstance(data, (str, bytes)) else str(data)
+    mode = "wb" if isinstance(payload, bytes) else "w"
+    with open(args.output, mode) as f:
+        f.write(payload)
+    print(f"wrote {args.output} ({len(payload)} bytes) — open in "
+          f"chrome://tracing or perfetto")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
