@@ -7,6 +7,7 @@ TPU-first: bfloat16 is a first-class citizen.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -44,16 +45,26 @@ FLOATING = (float16, bfloat16, float32, float64)
 INTEGER = (int8, int16, int32, int64, uint8, uint16, uint32, uint64)
 
 
+_X64_NARROW = {"int64": "int32", "uint64": "uint32", "float64": "float32",
+               "complex128": "complex64"}
+
+
 def convert_dtype(dtype) -> jnp.dtype:
-    """Normalize str/np/jnp dtype specs to a jnp dtype."""
+    """Normalize str/np/jnp dtype specs to a jnp dtype.
+
+    With x64 disabled (the TPU default), 64-bit specs are mapped to their
+    32-bit siblings explicitly — identical to JAX's silent truncation but
+    without the per-call UserWarning, and visible here as policy."""
     if dtype is None:
         return None
     if isinstance(dtype, str):
         key = dtype.lower().replace("paddle.", "")
-        if key in _ALIASES:
-            return jnp.dtype(_ALIASES[key])
-        return jnp.dtype(key)
-    return jnp.dtype(dtype)
+        dt = jnp.dtype(_ALIASES[key] if key in _ALIASES else key)
+    else:
+        dt = jnp.dtype(dtype)
+    if not jax.config.jax_enable_x64 and dt.name in _X64_NARROW:
+        dt = jnp.dtype(_X64_NARROW[dt.name])
+    return dt
 
 
 def is_floating_point(dtype) -> bool:

@@ -123,7 +123,7 @@ def _axis_in_scope(axis_name) -> bool:
         try:
             jax.lax.axis_index(axis_name)
             return True
-        except NameError:  # axis_index's documented unbound-name error
+        except Exception:  # unbound-name error type varies across jax versions
             return False
 
 

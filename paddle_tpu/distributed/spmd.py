@@ -130,7 +130,14 @@ def build_state_shardings(state, params_specs: Dict[str, P], mesh: Mesh,
 def ensure_varying(x, axis):
     """Mark ``x`` device-varying over ``axis`` for shard_map's VMA checker,
     as a no-op when it already is (pcast rejects varying→varying)."""
-    vma = getattr(jax.core.get_aval(x), "vma", None)
+    try:  # jax.core.get_aval warns/moves across versions; prefer _src home
+        from jax._src.core import get_aval
+    except ImportError:
+        get_aval = jax.core.get_aval
+    try:
+        vma = getattr(get_aval(x), "vma", None)
+    except Exception:
+        vma = None
     if vma is None or axis in vma:
         return x
     if hasattr(jax.lax, "pcast"):

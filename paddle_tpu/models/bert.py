@@ -181,7 +181,7 @@ class BertModel(Layer):
         return jnp.tanh(h[:, 0] @ params["pooler_w"].astype(dt)
                         + params["pooler_b"].astype(dt))
 
-    def mlm_logits(self, params, h):
+    def _mlm_logits(self, params, h):
         dt = h.dtype
         x = jax.nn.gelu(h @ params["mlm_dense_w"].astype(dt)
                         + params["mlm_dense_b"].astype(dt), approximate=True)
@@ -189,6 +189,12 @@ class BertModel(Layer):
         # stays in the compute dtype: the fused CE (ops/loss.py) reduces in
         # fp32 internally, so fp32 logits would only add HBM traffic
         return x @ params["word_emb"].astype(dt).T + params["mlm_bias"].astype(dt)
+
+    def mlm_logits(self, params, h):
+        """fp32 MLM head for external use (eval perplexity, logit inspection),
+        mirroring GPT's head_fn/_head_logits split; the loss path uses the
+        compute-dtype variant since fused CE reduces in fp32 anyway."""
+        return self._mlm_logits(params, h).astype(jnp.float32)
 
     @staticmethod
     def _additive_mask(attention_mask):
@@ -203,7 +209,7 @@ class BertModel(Layer):
         h = self.encode(params, input_ids, token_type_ids,
                         attn_mask=self._additive_mask(attention_mask),
                         remat=remat)
-        logits = self.mlm_logits(params, h)
+        logits = self._mlm_logits(params, h)
         valid = mlm_labels >= 0
         safe = jnp.where(valid, mlm_labels, 0)
         # fused masked CE — no fp32 (B, L, V) log-prob tensor (ops/loss.py)

@@ -71,8 +71,15 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n):
                 and jnp.issubdtype(w.dtype, jnp.floating):
             # fp32-params / low-precision-compute convention: conv runs in the
             # narrower dtype (bf16 activations × fp32 master weights → bf16
-            # MXU conv, matching the transformer stack's weight.astype(dt))
-            dt = min(a.dtype, w.dtype, key=lambda d: jnp.dtype(d).itemsize)
+            # MXU conv, matching the transformer stack's weight.astype(dt)).
+            # Only applies when the narrower side is a 2-byte compute dtype;
+            # other float mismatches promote (never silently lose precision —
+            # the reference errors on dtype mismatch, conv_op.cc).
+            sizes = (jnp.dtype(a.dtype).itemsize, jnp.dtype(w.dtype).itemsize)
+            if min(sizes) == 2 and sizes[0] != sizes[1]:
+                dt = a.dtype if sizes[0] < sizes[1] else w.dtype
+            else:  # incl. fp16 x bf16: promote, never cast across formats
+                dt = jnp.promote_types(a.dtype, w.dtype)
             a, w = a.astype(dt), w.astype(dt)
         out = jax.lax.conv_general_dilated(
             a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,

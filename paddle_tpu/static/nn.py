@@ -332,6 +332,11 @@ def case(pred_fn_pairs, default=None, name=None):
 
 def switch_case(branch_index, branch_fns, default=None, name=None):
     """``lax.switch`` (≙ switch_case in control_flow.py)."""
+    if (isinstance(branch_fns, (list, tuple)) and branch_fns
+            and all(isinstance(b, (list, tuple)) and len(b) == 2
+                    for b in branch_fns)):
+        # reference also canonicalizes [(index, fn), ...] (control_flow.py:3688)
+        branch_fns = dict(branch_fns)
     if isinstance(branch_fns, dict):
         keys = sorted(branch_fns)
         fns = [branch_fns[k] for k in keys]

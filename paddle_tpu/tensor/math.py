@@ -127,9 +127,8 @@ def cummax(x, axis=None, dtype="int64", name=None):
         ax = 0 if axis is None else axis
         flat = a.reshape(-1) if axis is None else a
         vals = jax.lax.associative_scan(jnp.maximum, flat, axis=ax)
-        n = flat.shape[ax]
-        iota = jax.lax.broadcasted_iota(jnp.int64, flat.shape, ax)
-        # index of first occurrence of running max
+        iota = jax.lax.broadcasted_iota(convert_dtype("int64"), flat.shape, ax)
+        # last occurrence of the running max (reference/torch tie-break)
         eq = flat == vals
         idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=ax)
         return vals, idx.astype(convert_dtype(dtype))
@@ -141,7 +140,8 @@ def cummin(x, axis=None, dtype="int64", name=None):
         ax = 0 if axis is None else axis
         flat = a.reshape(-1) if axis is None else a
         vals = jax.lax.associative_scan(jnp.minimum, flat, axis=ax)
-        iota = jax.lax.broadcasted_iota(jnp.int64, flat.shape, ax)
+        iota = jax.lax.broadcasted_iota(convert_dtype("int64"), flat.shape, ax)
+        # last occurrence of the running min (reference/torch tie-break)
         eq = flat == vals
         idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=ax)
         return vals, idx.astype(convert_dtype(dtype))
