@@ -26,8 +26,9 @@ from ..nn import Layer
 from ..nn import functional as F
 
 __all__ = ["fake_quant_dequant", "AbsMaxObserver", "MovingAverageAbsMaxObserver",
-           "QuantedLinear", "ImperativeQuantAware", "PostTrainingQuantization",
-           "quant_linear_int8"]
+           "QuantedLinear", "QuantedConv2D", "ImperativeQuantAware",
+           "PostTrainingQuantization", "quant_linear_int8",
+           "quant_conv2d_int8"]
 
 
 # --------------------------------------------------------------------------
@@ -43,11 +44,11 @@ def _fqdq(x, scale, bits):
 
 
 def _fqdq_fwd(x, scale, bits):
-    return _fqdq(x, scale, bits), None
+    return _fqdq(x, scale, bits), scale
 
 
-def _fqdq_bwd(bits, res, g):
-    return g, jnp.zeros(())  # straight-through estimator
+def _fqdq_bwd(bits, scale, g):
+    return g, jnp.zeros_like(scale)  # straight-through estimator
 
 
 _fqdq.defvjp(_fqdq_fwd, _fqdq_bwd)
@@ -55,8 +56,19 @@ _fqdq.defvjp(_fqdq_fwd, _fqdq_bwd)
 
 def fake_quant_dequant(x, scale, bits: int = 8):
     """Simulated quantize→dequantize with STE gradient (reference
-    fake_quantize_dequantize_abs_max)."""
+    fake_quantize_dequantize_abs_max).  ``scale`` may be a scalar
+    (per-tensor) or broadcastable to ``x`` (per-channel, ≙ the reference's
+    channel_wise_abs_max kernels)."""
     return _fqdq(x, jnp.asarray(scale, jnp.float32), bits)
+
+
+def _weight_scale(w, quantize_type: str, channel_axis: int = 0):
+    """abs-max scale: scalar for per-tensor, per-channel keepdims otherwise
+    (reference channel-wise quant keeps one scale per output channel)."""
+    if quantize_type == "channel_wise_abs_max":
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        return jnp.max(jnp.abs(w), axis=axes, keepdims=True).astype(jnp.float32)
+    return jnp.max(jnp.abs(w)).astype(jnp.float32)
 
 
 class AbsMaxObserver:
@@ -88,9 +100,9 @@ class MovingAverageAbsMaxObserver:
 # QAT layer wrappers
 # --------------------------------------------------------------------------
 
-class QuantedLinear(Layer):
-    """Linear with fake-quantized weight + activation (reference
-    imperative/quant_layers QuantizedLinear).
+class _QuantedBase(Layer):
+    """Shared fake-quant wrapper state: weight/activation bits and the
+    in-graph activation-scale buffer.
 
     The activation scale is a *buffer* updated in-graph (the BatchNorm
     running-stat idiom), so the EMA keeps calibrating under jitted train
@@ -98,20 +110,23 @@ class QuantedLinear(Layer):
     compiled executable as a constant.
     """
 
-    def __init__(self, inner, weight_bits=8, activation_bits=8,
-                 moving_rate=0.9, weight_quantize_type="abs_max",
-                 activation_quantize_type="moving_average_abs_max"):
+    def __init__(self, inner, weight_bits, activation_bits, moving_rate,
+                 weight_quantize_type, activation_quantize_type):
         super().__init__()
         self.inner = inner
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
+        self.w_type = weight_quantize_type
         self._rate = moving_rate if \
             activation_quantize_type == "moving_average_abs_max" else 0.0
         self.register_buffer("act_scale", Tensor(jnp.zeros([], jnp.float32)))
 
-    def forward(self, x):
+    def _quant_inputs(self, x):
+        """Observe + fake-quant the activation; fake-quant the weight.
+        Returns (xq, wq) Tensors ready for the wrapped op."""
         w = self.inner.weight
-        w_scale = jnp.max(jnp.abs(w._data)).astype(jnp.float32)
+        w_scale = _weight_scale(w._data, self.w_type,
+                                channel_axis=self._channel_axis(w._data))
         xd = getattr(x, "_data", x)
         prev = self.act_scale._data
         cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xd)).astype(jnp.float32))
@@ -128,17 +143,64 @@ class QuantedLinear(Layer):
         xq = apply(lambda a, s: _fqdq(a, s, self.activation_bits),
                    x, Tensor(act_scale))
         wq = apply(lambda a: _fqdq(a, w_scale, self.weight_bits), w)
+        return xq, wq
+
+
+class QuantedLinear(_QuantedBase):
+    """Linear with fake-quantized weight + activation (reference
+    imperative/quant_layers QuantizedLinear)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__(inner, weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type, activation_quantize_type)
+
+    @staticmethod
+    def _channel_axis(w):
+        return w.ndim - 1  # Linear weight is (in, out): channel = out dim
+
+    def forward(self, x):
+        xq, wq = self._quant_inputs(x)
         return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """Conv2D with fake-quantized weight + activation (reference
+    imperative/quant_layers QuantizedConv2D).  Weight scales are
+    per-output-channel when ``weight_quantize_type='channel_wise_abs_max'``
+    (the reference's conv default)."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        super().__init__(inner, weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type, activation_quantize_type)
+
+    @staticmethod
+    def _channel_axis(w):
+        return 0  # conv weight is (out_c, in_c, kh, kw)
+
+    def forward(self, x):
+        xq, wq = self._quant_inputs(x)
+        inner = self.inner
+        return F.conv2d(xq, wq, inner.bias, inner._stride, inner._padding,
+                        inner._dilation, inner._groups, inner._data_format)
 
 
 class ImperativeQuantAware:
     """QAT entry (reference imperative/qat.py:40): walks the model and
     swaps quantizable layers for fake-quant wrappers in place."""
 
-    def __init__(self, quantizable_layer_type=("Linear",),
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
                  weight_quantize_type="abs_max",
                  activation_quantize_type="moving_average_abs_max",
                  weight_bits=8, activation_bits=8, moving_rate=0.9, **kwargs):
+        unsupported = set(quantizable_layer_type) - {"Linear", "Conv2D"}
+        if unsupported:
+            raise ValueError(
+                f"quantizable_layer_type {sorted(unsupported)} not supported; "
+                "only Linear and Conv2D have quant wrappers")
         self.types = tuple(quantizable_layer_type)
         self.w_type = weight_quantize_type
         self.a_type = activation_quantize_type
@@ -148,8 +210,13 @@ class ImperativeQuantAware:
 
     def quantize(self, model: Layer) -> Layer:
         for name, sub in list(model._sub_layers.items()):
-            if type(sub).__name__ in self.types:
+            kind = type(sub).__name__
+            if kind in self.types and kind == "Linear":
                 model._sub_layers[name] = QuantedLinear(
+                    sub, self.w_bits, self.a_bits, self.rate,
+                    self.w_type, self.a_type)
+            elif kind in self.types and kind == "Conv2D":
+                model._sub_layers[name] = QuantedConv2D(
                     sub, self.w_bits, self.a_bits, self.rate,
                     self.w_type, self.a_type)
             else:
@@ -175,6 +242,47 @@ def quant_linear_int8(x, w_int8, w_scale, bias=None):
     return out.astype(x.dtype)
 
 
+def quant_conv2d_int8(x, w_int8, w_scale, bias, stride, padding, dilation,
+                      groups, data_format):
+    """int8 conv: per-tensor activation quant, int8×int8→int32 conv (TPU MXU
+    8-bit path), per-output-channel dequant (≙ the reference's
+    conv2d+channel-wise dequantize MKLDNN/TRT pass)."""
+    qmax = 127.0
+    x_scale = jnp.maximum(jnp.max(jnp.abs(x)), 1e-9)
+    xq = jnp.clip(jnp.round(x / x_scale * qmax), -qmax, qmax).astype(jnp.int8)
+    from ..nn.functional.conv import _dimnums, _padding as _pad_of, _tuplize
+    dn = _dimnums(2, data_format)
+    acc = jax.lax.conv_general_dilated(
+        xq, w_int8, window_strides=_tuplize(stride, 2),
+        padding=_pad_of(padding, 2, data_format),
+        rhs_dilation=_tuplize(dilation, 2), dimension_numbers=dn,
+        feature_group_count=groups, preferred_element_type=jnp.int32)
+    # w_scale is (out_c,) — broadcast along the output-channel dim
+    c_axis = 1 if data_format[1] == "C" else acc.ndim - 1
+    shape = [1] * acc.ndim
+    shape[c_axis] = w_scale.shape[0]
+    out = acc.astype(jnp.float32) * (x_scale / qmax) \
+        * (w_scale.reshape(shape) / qmax)
+    if bias is not None:
+        out = out + bias.reshape(shape).astype(out.dtype)
+    return out.astype(x.dtype)
+
+
+class _Int8Conv2D(Layer):
+    def __init__(self, w_int8, w_scale, bias, stride, padding, dilation,
+                 groups, data_format):
+        super().__init__()
+        self.w_int8 = Tensor(w_int8)
+        self.w_scale = Tensor(jnp.asarray(w_scale, jnp.float32))
+        self.bias = bias
+        self._conv_args = (stride, padding, dilation, groups, data_format)
+
+    def forward(self, x):
+        b = None if self.bias is None else self.bias._data
+        return apply(lambda a: quant_conv2d_int8(
+            a, self.w_int8._data, self.w_scale._data, b, *self._conv_args), x)
+
+
 class _Int8Linear(Layer):
     def __init__(self, w_int8, w_scale, bias):
         super().__init__()
@@ -193,7 +301,12 @@ class PostTrainingQuantization:
     batches, then convert Linear layers to int8 weights + scales."""
 
     def __init__(self, model: Layer, algo: str = "abs_max",
-                 quantizable_layer_type=("Linear",)):
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        unsupported = set(quantizable_layer_type) - {"Linear", "Conv2D"}
+        if unsupported:
+            raise ValueError(
+                f"quantizable_layer_type {sorted(unsupported)} not supported; "
+                "only Linear and Conv2D have int8 conversions")
         self.model = model
         self.algo = algo
         self.types = tuple(quantizable_layer_type)
@@ -217,12 +330,23 @@ class PostTrainingQuantization:
 
     def _convert_layer(self, layer: Layer):
         for name, sub in list(layer._sub_layers.items()):
-            if type(sub).__name__ in self.types:
+            kind = type(sub).__name__
+            if kind in self.types and kind == "Linear":
                 w = np.asarray(sub.weight._data, np.float32)
                 scale = max(float(np.max(np.abs(w))), 1e-9)
                 w_int8 = np.clip(np.round(w / scale * 127.0), -127, 127) \
                     .astype(np.int8)
                 layer._sub_layers[name] = _Int8Linear(
                     jnp.asarray(w_int8), scale, sub.bias)
+            elif kind in self.types and kind == "Conv2D":
+                w = np.asarray(sub.weight._data, np.float32)  # (O, I, kh, kw)
+                scale = np.maximum(np.max(np.abs(w), axis=(1, 2, 3)), 1e-9)
+                w_int8 = np.clip(np.round(
+                    w / scale[:, None, None, None] * 127.0), -127, 127) \
+                    .astype(np.int8)
+                layer._sub_layers[name] = _Int8Conv2D(
+                    jnp.asarray(w_int8), scale, sub.bias, sub._stride,
+                    sub._padding, sub._dilation, sub._groups,
+                    sub._data_format)
             else:
                 self._convert_layer(sub)

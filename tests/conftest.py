@@ -7,20 +7,32 @@
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the virtual CPU mesh
-os.environ["PADDLE_TPU_PLATFORM"] = "cpu"  # force CPU even if a PJRT plugin hijacks the default
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+# TPU mode needs BOTH the env var and an explicit `-m tpu` selection; a plain
+# `pytest` run with the env var exported must still get the CPU forcing (the
+# tunnel-dial hang is the round-1 failure mode this guards against).
+_TPU_RUN = (os.environ.get("PADDLE_TPU_TEST_TPU") == "1"
+            and any(a.strip() == "tpu"
+                    for i, a in enumerate(sys.argv)
+                    if i > 0 and sys.argv[i - 1] == "-m"))
+
+if not _TPU_RUN:
+    os.environ["JAX_PLATFORMS"] = "cpu"  # tests run on the virtual CPU mesh
+    os.environ["PADDLE_TPU_PLATFORM"] = "cpu"  # force CPU even if a PJRT plugin hijacks the default
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
 
-# The TPU PJRT plugin's sitecustomize imports jax at interpreter startup and
-# force-selects its own platform, so the env var above is latched too late —
-# override the live config (legal until the first backend initializes).
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_default_matmul_precision", "highest")
+if not _TPU_RUN:
+    # The TPU PJRT plugin's sitecustomize imports jax at interpreter startup
+    # and force-selects its own platform, so the env var above is latched too
+    # late — override the live config (legal until the first backend
+    # initializes).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_default_matmul_precision", "highest")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
