@@ -31,6 +31,12 @@ class _GradState(threading.local):
 
 _state = _GradState()
 
+# one-shot perf nudge after many un-jitted train steps (≙ the reference's
+# dygraph->static guidance); tests stay well under the threshold
+_EAGER_STEPS = 0
+_EAGER_WARN_AT = 500
+_EAGER_WARNED = False
+
 
 def is_grad_enabled() -> bool:
     return _state.enabled
@@ -122,6 +128,20 @@ def backward(tensor, grad=None, retain_graph: bool = False, capture=None,
     other leaf's ``.grad`` (so ``paddle.grad`` doesn't corrupt pending
     parameter gradients).
     """
+    global _EAGER_STEPS, _EAGER_WARNED
+    _EAGER_STEPS += 1
+    # >= with a sticky flag, not ==: concurrent increments may skip the
+    # exact trigger value (worst case under a race is a duplicate warning,
+    # never a lost one)
+    if _EAGER_STEPS >= _EAGER_WARN_AT and not _EAGER_WARNED:
+        _EAGER_WARNED = True
+        import warnings
+        warnings.warn(
+            f"{_EAGER_WARN_AT} eager backward() passes in this process: "
+            "per-op Python dispatch dominates un-jitted training loops on "
+            "TPU. Wrap the train step with paddle.jit.to_static / "
+            "jit_train_step (the dygraph->static nudge, reference "
+            "dygraph/base.py).", stacklevel=2)
     if grad is None:
         if tensor.size != 1:
             raise RuntimeError(

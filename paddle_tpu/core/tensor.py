@@ -105,6 +105,12 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self._data.shape[0]
 
+    def __iter__(self):
+        # Without this, iteration falls back to __getitem__ with unbounded
+        # indices, which never raises (XLA gather clamps out-of-range) and
+        # spins forever.  Paddle iterates over the leading dim.
+        return (self[i] for i in range(len(self)))
+
     def __bool__(self):
         return bool(self._data)
 

@@ -6,7 +6,10 @@ registration, heartbeat lease (:250), node-change watch (:234), two levels
 (101 restart, 102 rescale).
 
 TPU-native: membership lives in a shared-filesystem store (GCS/NFS path —
-etcd is not a TPU-pod given) with per-host heartbeat files; a scale event
+etcd is not a TPU-pod given) with per-host heartbeat files.  NOTE: with a
+plain local-disk ``store_dir`` this spans a single host only; multi-host
+elasticity requires pointing it at a genuinely shared mount (GCS fuse/NFS),
+which is the TPU-pod deployment shape.  A scale event
 maps to *checkpoint → exit(101) → relaunch → re-compile with the new mesh*,
 because XLA programs are specialized on mesh shape (re-compile ≙ the
 reference's program re-build after env rewrite).  The launcher

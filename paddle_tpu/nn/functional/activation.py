@@ -99,11 +99,14 @@ def log_sigmoid(x, name=None):
 
 
 def maxout(x, groups, axis=1, name=None):
+    """Max over ``groups`` consecutive channels: channel block i is
+    [i*groups, (i+1)*groups) (reference maxout_op semantics,
+    test_maxout_op.py:29 — (C//groups, groups) with max over the last)."""
     def f(a):
         ax = axis % a.ndim
         c = a.shape[ax]
-        newshape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
-        return jnp.max(a.reshape(newshape), axis=ax)
+        newshape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(newshape), axis=ax + 1)
     return apply(f, x)
 
 

@@ -4,6 +4,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import builtins
+
 import numpy as np
 
 from ..core.dtype import convert_dtype
@@ -260,10 +262,10 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
     else:
         ax = axis
     take = np.ones(a.shape[ax], dtype=bool)
-    sl = [slice(None)] * a.ndim
-    sl[ax] = slice(1, None)
-    sl2 = [slice(None)] * a.ndim
-    sl2[ax] = slice(None, -1)
+    sl = [builtins.slice(None)] * a.ndim
+    sl[ax] = builtins.slice(1, None)
+    sl2 = [builtins.slice(None)] * a.ndim
+    sl2[ax] = builtins.slice(None, -1)
     neq = (a[tuple(sl)] != a[tuple(sl2)])
     while neq.ndim > 1:
         neq = neq.any(axis=-1 if ax == 0 else 0)
@@ -315,9 +317,9 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
     def f(a):
-        sl = [slice(None)] * a.ndim
+        sl = [builtins.slice(None)] * a.ndim
         for ax, s, e, st in zip(axes, starts, ends, strides):
-            sl[ax] = slice(s, e, st)
+            sl[ax] = builtins.slice(s, e, st)
         return a[tuple(sl)]
     return apply(f, x)
 

@@ -10,15 +10,18 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, data_format=df)
+        self.bn1 = norm_layer(planes, data_format=df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=df)
+        self.bn2 = norm_layer(planes, data_format=df)
         self.downsample = downsample
         self.stride = stride
 
@@ -35,17 +38,22 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=df)
+        self.bn1 = norm_layer(width, data_format=df)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
-                               groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               groups=groups, dilation=dilation, bias_attr=False,
+                               data_format=df)
+        self.bn2 = norm_layer(width, data_format=df)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
+                               bias_attr=False, data_format=df)
+        self.bn3 = norm_layer(planes * self.expansion, data_format=df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -61,7 +69,11 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
-                 groups=1):
+                 groups=1, data_format="NCHW"):
+        """``data_format="NHWC"`` runs the whole stack channels-last — the
+        TPU-preferred conv layout (XLA tiles the C-minor dim onto the MXU
+        without the per-conv transposes NCHW needs; ≙ the reference's
+        layout-tuned conv paths, operators/conv_cudnn_op.cc)."""
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -70,37 +82,42 @@ class ResNet(nn.Layer):
         self.base_width = width
         self.num_classes = num_classes
         self.with_pool = with_pool
+        self.data_format = data_format
         self._norm_layer = nn.BatchNorm2D
         self.inplanes = 64
         self.dilation = 1
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               bias_attr=False, data_format=data_format)
+        self.bn1 = self._norm_layer(self.inplanes, data_format=data_format)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
         downsample = None
+        df = self.data_format
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
-                          bias_attr=False),
-                norm_layer(planes * block.expansion))
+                          bias_attr=False, data_format=df),
+                norm_layer(planes * block.expansion, data_format=df))
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, norm_layer=norm_layer)]
+                        self.base_width, norm_layer=norm_layer, data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width, norm_layer=norm_layer))
+                                base_width=self.base_width,
+                                norm_layer=norm_layer, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):

@@ -12,10 +12,19 @@ import sys
 # TPU mode needs BOTH the env var and an explicit `-m tpu` selection; a plain
 # `pytest` run with the env var exported must still get the CPU forcing (the
 # tunnel-dial hang is the round-1 failure mode this guards against).
+def _tpu_selected(argv):
+    """True when a -m marker expression selects tpu tests (``-m tpu``,
+    ``-m=tpu``, ``-m "tpu and ..."`` — but not ``-m "not tpu"``)."""
+    exprs = [a.split("=", 1)[1] for a in argv if a.startswith("-m=")]
+    exprs += [a for i, a in enumerate(argv)
+              if i > 0 and argv[i - 1] == "-m"]
+    import re
+    return any(re.search(r"(^|[ (])tpu([ )]|$)", e)
+               and not re.search(r"not\s+tpu", e) for e in exprs)
+
+
 _TPU_RUN = (os.environ.get("PADDLE_TPU_TEST_TPU") == "1"
-            and any(a.strip() == "tpu"
-                    for i, a in enumerate(sys.argv)
-                    if i > 0 and sys.argv[i - 1] == "-m"))
+            and _tpu_selected(sys.argv))
 
 if not _TPU_RUN:
     os.environ["JAX_PLATFORMS"] = "cpu"  # tests run on the virtual CPU mesh

@@ -76,3 +76,21 @@ def test_shufflenet_int_scale_and_bad_scale():
     assert m is not None
     with pytest.raises(ValueError, match="unsupported scale"):
         models.ShuffleNetV2(scale=0.7)
+
+
+def test_resnet_nhwc_matches_nchw():
+    """NHWC (TPU-preferred layout, bench path) is numerically identical to
+    NCHW — same seed, transposed input (VERDICT r2 #3)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18
+    paddle.seed(0)
+    m_nchw = resnet18(num_classes=10)
+    paddle.seed(0)
+    m_nhwc = resnet18(num_classes=10, data_format="NHWC")
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+    m_nchw.eval(); m_nhwc.eval()
+    o1 = np.asarray(m_nchw(paddle.to_tensor(x))._data)
+    o2 = np.asarray(m_nhwc(paddle.to_tensor(
+        np.transpose(x, (0, 2, 3, 1))))._data)
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)

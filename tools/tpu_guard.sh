@@ -1,0 +1,32 @@
+#!/bin/bash
+# Claim-hygiene wrapper for TPU-touching commands (VERDICT r2: a
+# SIGTERM-killed profiler wedged the single-chip tunnel claim for ~8h).
+#
+# Only ONE process may hold the TPU claim; a claim-holder that dies to a
+# signal leaves the claim poisoned until expiry.  This wrapper:
+#   * ignores SIGTERM/SIGINT/SIGHUP itself, and
+#   * runs the command in its own session (setsid), so group-targeted
+#     signals (timeouts, Ctrl-C, driver cleanup) never reach the child —
+#     the claim-holder always exits on its own and releases cleanly.
+#
+# Usage: tools/tpu_guard.sh python bench.py --config all
+#        TPU_GUARD_LOG=/tmp/bench.log tools/tpu_guard.sh python bench.py
+#
+# There is deliberately NO timeout here: a hung claim-holder must be left
+# to finish or error out on its own (killing it costs hours, waiting
+# costs minutes).  Bound the *work*, not the process.
+
+trap '' TERM INT HUP
+
+if [ -n "$TPU_GUARD_LOG" ]; then
+    setsid "$@" >"$TPU_GUARD_LOG" 2>&1 &
+else
+    setsid "$@" &
+fi
+child=$!
+# wait is restartable; loop in case a non-fatal signal interrupts it
+while kill -0 "$child" 2>/dev/null; do
+    wait "$child"
+    rc=$?
+done
+exit "${rc:-1}"

@@ -1,0 +1,26 @@
+#!/bin/bash
+# One-shot TPU evidence session (run the moment the chip is healthy).
+# Everything runs under tpu_guard.sh (claim hygiene: no signal ever reaches
+# a claim-holder) and writes committed artifacts:
+#   BENCH_pre.json       - bench.py --config all (the driver artifact's dry run)
+#   TPU_SMOKE_r03.log    - Mosaic smoke suite (pytest -m tpu)
+#   FUSED_PROBE_r03.json - XLA-fusion roofline numbers for the kernel decision
+#
+# Usage: from /root/repo:  bash tools/tpu_session.sh
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="/root/repo:/root/.axon_site"
+G=tools/tpu_guard.sh
+
+echo "=== 1/3 bench (all configs)"
+TPU_GUARD_LOG=/tmp/bench_all.log $G python bench.py --config all
+grep "^{" /tmp/bench_all.log | tee BENCH_pre.json
+
+echo "=== 2/3 Mosaic smoke suite"
+TPU_GUARD_LOG=TPU_SMOKE_r03.log PADDLE_TPU_TEST_TPU=1 \
+    $G python -m pytest -m tpu tests/test_tpu_smoke.py -q -v
+tail -5 TPU_SMOKE_r03.log
+
+echo "=== 3/3 fusion roofline probe"
+TPU_GUARD_LOG=/tmp/fused_probe.log $G python tools/fused_probe.py
+grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_r03.json
