@@ -281,9 +281,15 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
 
 
 def masked_select(x, mask, name=None):
-    a = np.asarray(getattr(x, "_data", x))
+    """Eager-only (output shape is data-dependent, so it cannot trace — the
+    reference's dygraph op has the same shape dynamism).  The mask is
+    concretized to host indices and the select runs as a differentiable
+    gather, so ``backward()`` scatters grads to the selected positions
+    (reference masked_select_grad_kernel)."""
     m = np.asarray(getattr(mask, "_data", mask))
-    return Tensor(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+    data = getattr(x, "_data", x)
+    flat_idx = np.nonzero(np.broadcast_to(m, data.shape).reshape(-1))[0]
+    return apply(lambda a: a.reshape(-1)[flat_idx], x)
 
 
 def masked_fill(x, mask, value, name=None):

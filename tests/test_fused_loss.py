@@ -64,3 +64,24 @@ class TestFusedCE:
         assert float(loss) == 0.0
         g = jax.grad(lambda x: softmax_cross_entropy_weighted_mean(x, labels, w))(logits)
         assert np.all(np.asarray(g) == 0.0)
+
+
+def test_fused_ce_residuals_stay_compute_dtype():
+    """The fused CE must never SAVE an fp32 (..., V) tensor between fwd and
+    bwd (the whole point vs log_softmax: 1.6GB of HBM at bench shapes).
+    eval_shape proves the residual pytree holds only the bf16 logits plus
+    O(B*L) fp32 reductions."""
+    from paddle_tpu.ops.loss import _ce_fwd, _cew_fwd
+
+    B, L, V = 4, 128, 50304
+    logits = jax.ShapeDtypeStruct((B, L, V), jnp.bfloat16)
+    labels = jax.ShapeDtypeStruct((B, L), jnp.int32)
+    weights = jax.ShapeDtypeStruct((B, L), jnp.float32)
+
+    for fwd, args in ((_ce_fwd, (logits, labels)),
+                      (_cew_fwd, (logits, labels, weights))):
+        _, res = jax.eval_shape(fwd, *args)
+        for leaf in jax.tree_util.tree_leaves(res):
+            big = leaf.shape and leaf.shape[-1] >= V
+            assert not (big and leaf.dtype == jnp.float32), (
+                f"fp32 (...,V) residual {leaf.shape} saved by {fwd.__name__}")

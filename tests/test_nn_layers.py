@@ -229,3 +229,24 @@ def test_initializers():
     np.testing.assert_allclose(np.asarray(o) @ np.asarray(o).T, np.eye(16), atol=1e-4)
     c = I.Constant(3.0)([4], "float32")
     np.testing.assert_allclose(np.asarray(c), 3.0)
+
+
+def test_lstm_matches_torch():
+    """LSTM numeric parity vs torch (same gate layout/state contract as the
+    reference's cudnn LSTM)."""
+    import torch
+    lstm = nn.LSTM(4, 6)
+    tl = torch.nn.LSTM(4, 6, batch_first=True)
+    sd = lstm.state_dict()
+    with torch.no_grad():
+        tl.weight_ih_l0.copy_(torch.tensor(sd["weight_ih_l0"].numpy()))
+        tl.weight_hh_l0.copy_(torch.tensor(sd["weight_hh_l0"].numpy()))
+        tl.bias_ih_l0.copy_(torch.tensor(sd["bias_ih_l0"].numpy()))
+        tl.bias_hh_l0.copy_(torch.tensor(sd["bias_hh_l0"].numpy()))
+    x = np.random.randn(2, 5, 4).astype("float32")
+    out, (h, c) = lstm(paddle.to_tensor(x))
+    tout, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-4)

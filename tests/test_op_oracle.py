@@ -488,7 +488,7 @@ CASES = [
          grad=[0], key="masked_fill"),
     Case("paddle.masked_select", [A((3, 4)),
                                   A((3, 4), dtype="bool")],
-         lambda x, m: x[m], grad=[], key="masked_select"),
+         lambda x, m: x[m], grad=[0], key="masked_select"),
     Case("paddle.moveaxis", [A((2, 3, 4))],
          lambda x: np.moveaxis(x, 0, 2),
          kwargs={"source": 0, "destination": 2}, key="moveaxis"),
@@ -500,7 +500,7 @@ CASES = [
          [A((3, 5)), A((3, 1), lambda x: np.array([[1], [2], [0]]),
                        dtype="int32"), A((3, 1))],
          lambda x, i, v: _np_put_along_axis(x, i, v),
-         kwargs={"axis": 1}, grad=[], key="put_along_axis"),
+         kwargs={"axis": 1}, grad=[0, 2], key="put_along_axis"),
     Case("paddle.repeat_interleave", [A((3, 4))],
          lambda x: np.repeat(x, 2, axis=1),
          kwargs={"repeats": 2, "axis": 1}, key="repeat_interleave"),
@@ -513,7 +513,7 @@ CASES = [
     Case("paddle.scatter",
          [A((5, 3)), A((2,), lambda x: np.array([1, 3]), dtype="int32"),
           A((2, 3))],
-         lambda x, i, u: _np_scatter_overwrite(x, i, u), grad=[],
+         lambda x, i, u: _np_scatter_overwrite(x, i, u), grad=[0, 2],
          key="scatter"),
     Case("paddle.scatter_nd",
          [A((3, 1), lambda x: np.array([[1], [3], [1]]), dtype="int32"),
