@@ -29,3 +29,22 @@ def test_bench_all_configs_cpu_child():
     for r in recs:
         assert r["value"] is not None and r["value"] > 0, r
         assert r["backend"] == "cpu"
+
+
+def test_analytic_flops_matches_6n_approximation():
+    """_transformer_train_flops ≈ 6·N·tokens + attention term for gpt2s
+    (Megatron/PaLM convention); guards the MFU denominator's honesty
+    (VERDICT r2: XLA cost analysis undercounted scan models)."""
+    import bench
+    B, L = 16, 1024
+    H, I, V, n = 768, 3072, 50304, 12
+    got = bench._transformer_train_flops(B, L, n, H, I, V)
+    # parameter count of the matmul path (QKVO 4H^2 + MLP 2HI per layer,
+    # plus the tied head HV)
+    N = n * (4 * H * H + 2 * H * I) + H * V
+    attn = 3 * B * L * n * 4 * L * H          # train (3x) QK^T+PV term
+    approx = 6 * N * B * L + attn
+    assert abs(got - approx) / approx < 0.01, (got, approx)
+    # MoE top-2 doubles only the expert-MLP term
+    moe = bench._transformer_train_flops(B, L, n, H, I, V, moe_topk=2)
+    assert moe - got == 3 * B * L * n * 4 * H * I

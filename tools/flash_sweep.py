@@ -1,0 +1,62 @@
+"""Flash-attention block-size sweep on the gpt2s bench config (VERDICT r2 #2:
+the measured flash-bwd residual is ~11ms/step; block size is the main lever).
+
+Each block size runs in a FRESH child process because
+``PADDLE_TPU_FLASH_BLOCK`` is read at trace time and jit caches the kernel.
+
+Run on TPU:  PYTHONPATH=/root/repo:/root/.axon_site \
+             tools/tpu_guard.sh python tools/flash_sweep.py
+Prints one JSON line per block size and a final "best" line; paste the table
+into BENCH_NOTES.md and set the winning block in bench.py's environment.
+"""
+import json
+import os
+import subprocess
+import sys
+
+BLOCKS = [None, 128, 256, 512]   # None = auto (largest divisor)
+
+
+def child(block):
+    env = dict(os.environ)
+    if block:
+        env["PADDLE_TPU_FLASH_BLOCK"] = str(block)
+    env["_FLASH_SWEEP_CHILD"] = "1"
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # run under the claim guard with NO timeout: a claim-holder must exit on
+    # its own (tpu_guard.sh: bound the work, not the process)
+    proc = subprocess.run(
+        [os.path.join(root, "tools", "tpu_guard.sh"), sys.executable,
+         os.path.abspath(__file__)], env=env,
+        capture_output=True, text=True, cwd=root)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    return json.loads(lines[-1]) if lines else {"error": proc.stderr[-300:]}
+
+
+def measure():
+    import bench
+    out = bench.bench_gpt2s(on_tpu=True)
+    out["flash_block"] = os.environ.get("PADDLE_TPU_FLASH_BLOCK", "auto")
+    print(json.dumps(out), flush=True)
+
+
+def main():
+    if os.environ.get("_FLASH_SWEEP_CHILD") == "1":
+        measure()
+        return
+    results = []
+    for b in BLOCKS:
+        r = child(b)
+        r.setdefault("flash_block", b if b else "auto")
+        print(json.dumps(r), flush=True)
+        if "value" in r and r.get("value"):
+            results.append(r)
+    if results:
+        best = max(results, key=lambda r: r["value"])
+        print(json.dumps({"best_block": best["flash_block"],
+                          "tokens_per_sec": best["value"],
+                          "mfu": best.get("mfu")}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -5,6 +5,7 @@
 #   BENCH_pre.json       - bench.py --config all (the driver artifact's dry run)
 #   TPU_SMOKE_r03.log    - Mosaic smoke suite (pytest -m tpu)
 #   FUSED_PROBE_r03.json - XLA-fusion roofline numbers for the kernel decision
+#   FLASH_SWEEP_r03.json - flash block-size sweep on gpt2s (pick the winner)
 #
 # Usage: from /root/repo:  bash tools/tpu_session.sh
 set -u
@@ -12,15 +13,19 @@ cd "$(dirname "$0")/.."
 export PYTHONPATH="/root/repo:/root/.axon_site"
 G=tools/tpu_guard.sh
 
-echo "=== 1/3 bench (all configs)"
+echo "=== 1/4 bench (all configs)"
 TPU_GUARD_LOG=/tmp/bench_all.log $G python bench.py --config all
 grep "^{" /tmp/bench_all.log | tee BENCH_pre.json
 
-echo "=== 2/3 Mosaic smoke suite"
+echo "=== 2/4 Mosaic smoke suite"
 TPU_GUARD_LOG=TPU_SMOKE_r03.log PADDLE_TPU_TEST_TPU=1 \
     $G python -m pytest -m tpu tests/test_tpu_smoke.py -q -v
 tail -5 TPU_SMOKE_r03.log
 
-echo "=== 3/3 fusion roofline probe"
+echo "=== 3/4 fusion roofline probe"
 TPU_GUARD_LOG=/tmp/fused_probe.log $G python tools/fused_probe.py
 grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_r03.json
+
+echo "=== 4/4 flash block sweep (gpt2s)"
+TPU_GUARD_LOG=/tmp/flash_sweep.log $G python tools/flash_sweep.py
+grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_r03.json
