@@ -270,10 +270,12 @@ def npair_loss(anchor, positive, labels, l2_reg=0.002):
 
 
 def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2·|A∩B| / (|A|+|B|+eps), mean over batch (reference dice_loss:
+    epsilon in the denominator only, so an empty mask scores loss 1)."""
     def f(p, y):
         y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
         reduce_dims = tuple(range(1, p.ndim))
         inter = 2 * jnp.sum(p * y1, axis=reduce_dims)
         union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
-        return jnp.mean(1 - (inter + epsilon) / (union + epsilon))
+        return jnp.mean(1 - inter / (union + epsilon))
     return apply(f, input, label)

@@ -138,13 +138,17 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format=
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW",
                         name=None):
     def f(a):
+        # 2.x semantics (reference nn/functional/norm.py:502-538): window
+        # MEAN of x^2 (pad size//2 low, (size-1)//2 high, then avg_pool),
+        # denom = (k + alpha*mean)^beta — torch-compatible, NOT the legacy
+        # fluid lrn op's alpha*sum
         ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
         sq = jnp.square(a)
         sq_m = jnp.moveaxis(sq, ch_axis, -1)
-        pad_lo = (size - 1) // 2
-        pad_hi = size - 1 - pad_lo
+        pad_lo = size // 2
+        pad_hi = (size - 1) // 2
         padded = jnp.pad(sq_m, [(0, 0)] * (sq_m.ndim - 1) + [(pad_lo, pad_hi)])
-        win = sum(padded[..., i:i + sq_m.shape[-1]] for i in range(size))
+        win = sum(padded[..., i:i + sq_m.shape[-1]] for i in range(size)) / size
         win = jnp.moveaxis(win, -1, ch_axis)
         return a / jnp.power(k + alpha * win, beta)
     return apply(f, x)

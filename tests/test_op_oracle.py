@@ -717,6 +717,40 @@ CASES = [
 ]
 
 
+def _np_temporal_shift(x, seg_num, ratio):
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * ratio)
+    out = np.zeros_like(v)
+    # reference temporal_shift_op.h:57-60 — first fold from the PAST
+    # (src_it = it-1), second fold from the future (src_it = it+1)
+    out[:, 1:, :fold] = v[:, :-1, :fold]
+    out[:, :-1, fold:2 * fold] = v[:, 1:, fold:2 * fold]
+    out[:, :, 2 * fold:] = v[:, :, 2 * fold:]
+    return out.reshape(NT, C, H, W)
+
+
+def _np_dice_loss(p, y, eps=1e-5):
+    C = p.shape[-1]
+    y1 = np.eye(C, dtype=p.dtype)[y.squeeze(-1)]
+    axes = tuple(range(1, p.ndim))
+    inter = 2 * (p * y1).sum(axis=axes)
+    union = p.sum(axis=axes) + y1.sum(axis=axes)
+    return np.asarray((1 - inter / (union + eps)).mean())
+
+
+def _np_npair_loss(a, p, y, l2_reg=0.002):
+    sim = a @ p.T
+    y = y.reshape(-1, 1)
+    tgt = (y == y.T).astype(a.dtype)
+    tgt = tgt / tgt.sum(axis=1, keepdims=True)
+    logp = sim - np_logsumexp(sim, axis=1, keepdims=True)
+    xent = (-tgt * logp).sum(axis=1).mean()
+    reg = l2_reg * ((a * a).sum(1).mean() + (p * p).sum(1).mean()) * 0.25
+    return np.asarray(xent + reg, dtype="float32")
+
+
 def _np_put_along_axis(x, i, v):
     out = x.copy()
     np.put_along_axis(out, i, v, axis=1)
@@ -1059,6 +1093,61 @@ CONV_CASES = [
     Case("F.embedding", [A((2, 3), lambda x: np.array([[0, 2, 1], [4, 3, 0]]),
                            dtype="int32"), A((6, 4))],
          lambda i, w: w[i], grad=[1], key="embedding-2d"),
+    Case("F.bilinear", [A((4, 3)), A((4, 5)), A((2, 3, 5)), A((2,))],
+         lambda a, b, w, bi: torch.nn.functional.bilinear(
+             _t(a), _t(b), _t(w), _t(bi)).numpy(),
+         grad=[0, 1], key="bilinear"),
+    Case("F.local_response_norm", [A((2, 6, 4, 4))],
+         # 2.x semantics = torch's: denom (k + alpha*mean(x^2 window))^beta
+         lambda x: torch.nn.functional.local_response_norm(
+             _t(x), 3, alpha=1e-4, beta=0.75, k=1.0).numpy(),
+         kwargs={"size": 3}, grad=[0], key="local_response_norm"),
+    Case("F.grid_sample",
+         [A((1, 2, 4, 4)), A((1, 3, 3, 2), lambda x: np.tanh(x) * 0.9)],
+         lambda x, g: torch.nn.functional.grid_sample(
+             _t(x), _t(g), mode="bilinear", padding_mode="zeros",
+             align_corners=True).numpy(),
+         grad=[0], key="grid_sample"),
+    Case("F.affine_grid",
+         [A((2, 2, 3), lambda x: 0.2 * x + np.array([[1., 0., 0.],
+                                                     [0., 1., 0.]]))],
+         lambda th: torch.nn.functional.affine_grid(
+             _t(th), (2, 1, 4, 5), align_corners=True).numpy(),
+         kwargs={"out_shape": (2, 1, 4, 5), "align_corners": True},
+         grad=[0], key="affine_grid"),
+    Case("F.channel_shuffle", [A((2, 6, 3, 3))],
+         lambda x: x.reshape(2, 2, 3, 3, 3).transpose(
+             0, 2, 1, 3, 4).reshape(2, 6, 3, 3),
+         kwargs={"groups": 2}, grad=[0], key="channel_shuffle"),
+    Case("F.temporal_shift", [A((4, 4, 3, 3))],
+         lambda x: _np_temporal_shift(x, seg_num=2, ratio=0.25),
+         kwargs={"seg_num": 2, "shift_ratio": 0.25}, grad=[0],
+         key="temporal_shift"),
+    Case("F.ctc_loss",
+         [A((6, 2, 5)),
+          A((2, 3), lambda x: np.array([[1, 2, 1], [3, 4, 0]]),
+            dtype="int32"),
+          A((2,), lambda x: np.array([6, 5]), dtype="int32"),
+          A((2,), lambda x: np.array([3, 2]), dtype="int32")],
+         lambda lp, lab, il, ll: torch.nn.functional.ctc_loss(
+             torch.log_softmax(_t(lp), -1),
+             torch.from_numpy(lab.astype("int64")),
+             torch.from_numpy(il.astype("int64")),
+             torch.from_numpy(ll.astype("int64")), blank=0,
+             reduction="mean").numpy(),
+         grad=[0], gtol=8e-2, key="ctc_loss"),
+    Case("F.dice_loss",
+         [A((3, 4, 5), lambda x: np_softmax(x, -1)),
+          A((3, 4, 1), lambda x: np.array(
+              [[[0], [2], [1], [4]], [[3], [0], [2], [1]],
+               [[4], [4], [0], [3]]]), dtype="int32")],
+         lambda p, y: _np_dice_loss(p, y, eps=1e-2),
+         kwargs={"epsilon": 1e-2}, grad=[0], key="dice_loss"),
+    Case("F.npair_loss",
+         [A((4, 6)), A((4, 6)),
+          A((4,), lambda x: np.array([0, 1, 0, 2]), dtype="int32")],
+         lambda a, pz, y: _np_npair_loss(a, pz, y), grad=[0, 1],
+         key="npair_loss"),
 ]
 
 CASES.extend(CONV_CASES)
@@ -1255,26 +1344,21 @@ F_WAIVERS = {
     "adaptive_max_pool2d": "test_nn_layers", "adaptive_max_pool3d": "test_nn_layers",
     "max_unpool2d": "test_nn_extras", "batch_norm": "test_nn_layers norm suite",
     "layer_norm": "test_nn_layers", "instance_norm": "test_nn_layers",
-    "group_norm": "test_nn_layers", "local_response_norm": "test_nn_extras",
+    "group_norm": "test_nn_layers",
     "scaled_dot_product_attention": "test_attention parity suite",
     "sparse_attention": "test_attention (masked path)",
     "interpolate": "test_nn_extras", "upsample": "test_nn_extras",
-    "grid_sample": "test_vision_ops", "affine_grid": "test_vision_ops",
     "fold": "test_nn_extras", "unfold": "test_nn_extras",
     "pixel_unshuffle": "inverse of pixel_shuffle (tested together)",
-    "channel_shuffle": "test_nn_extras", "temporal_shift": "test_nn_extras",
-    "ctc_loss": "test_nn_extras (alignment-dp oracle)",
     "margin_cross_entropy": "test_distributed (class-parallel path)",
     "class_center_sample": "test_distributed",
-    "hsigmoid_loss": "test_nn_extras", "npair_loss": "test_nn_extras",
-    "dice_loss": "test_nn_extras",
+    "hsigmoid_loss": "test_nn_extras",
     "softmax_with_cross_entropy": "alias of cross_entropy (covered)",
     "gather_tree": "test_incubate_utils beam-search suite",
     "gumbel_softmax": "statistical (random)",
     "dropout": "p>0 statistical; p=0 identity covered above",
     "dropout2d": "statistical (random)", "dropout3d": "statistical (random)",
     "alpha_dropout": "statistical (random)", "rrelu": "statistical (random)",
-    "bilinear": "test_nn_extras (Bilinear layer semantics)",
     "embedding": "covered as F.embedding case",
     "zeropad2d": "thin wrapper over pad (covered)",
     "npu_identity": "compat no-op shim",
