@@ -811,13 +811,13 @@ WAIVERS = {
     "unsqueeze_": "in-place alias", "tanh_": "in-place alias of tanh",
     "masked_fill_": "in-place alias", "where_": "in-place alias",
     # decomposition ops verified by reconstruction in test_tensor_ops
-    "eig": "non-unique eigvectors; reconstruction-tested in test_tensor_ops",
-    "eigvals": "complex order unspecified; reconstruction-tested",
-    "eigh": "sign-ambiguous vectors; eigvalsh covers values",
-    "qr": "sign-ambiguous; reconstruction-tested in test_tensor_ops",
-    "svd": "sign-ambiguous; reconstruction-tested in test_tensor_ops",
-    "lu": "pivot layout; reconstruction-tested in test_tensor_ops",
-    "lstsq": "multi-output contract; covered in test_tensor_ops",
+    "eig": "non-unique eigvectors; property-tested in TestDecompositionProperties",
+    "eigvals": "complex order; property-tested in TestDecompositionProperties",
+    "eigh": "sign-ambiguous; property-tested in TestDecompositionProperties",
+    "qr": "sign-ambiguous; property-tested in TestDecompositionProperties",
+    "svd": "sign-ambiguous; reconstruction-tested in test_tensor_ops (test_decompositions)",
+    "lu": "pivot layout; property-tested in TestDecompositionProperties",
+    "lstsq": "multi-output; property-tested in TestDecompositionProperties",
     "as_real": "inverse of as_complex (complex dtype input)",
     "conj": "real passthrough covered; complex in test_tensor_ops",
 }
@@ -1386,3 +1386,64 @@ def test_every_functional_op_has_a_case_or_waiver():
         "functional ops without an oracle case or waiver: " + str(missing))
 
 
+
+
+# --------------------------------------------------------------------------
+# decomposition reconstruction properties (sign/pivot-ambiguous ops the
+# direct-compare harness waives; ≙ reference test_qr_op/test_eig_op checks)
+# --------------------------------------------------------------------------
+
+class TestDecompositionProperties:
+    def _a(self, n=5, m=4, seed=7):
+        return np.random.RandomState(seed).randn(n, m).astype("float32")
+
+    def test_qr_reconstructs_and_orthonormal(self):
+        a = self._a()
+        q, r = _to_np(paddle.linalg.qr(paddle.to_tensor(a)))
+        np.testing.assert_allclose(q @ r, a, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(q.T @ q, np.eye(q.shape[1]),
+                                   rtol=1e-4, atol=1e-4)
+        assert np.allclose(r, np.triu(r))
+
+    def test_eigh_reconstructs(self):
+        a = self._a(4, 4)
+        sym = (a + a.T) / 2
+        w, v = _to_np(paddle.linalg.eigh(paddle.to_tensor(sym)))
+        np.testing.assert_allclose(sym @ v, v @ np.diag(w),
+                                   rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(np.sort(w), np.linalg.eigvalsh(sym),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_eig_eigvals_match_numpy_sorted(self):
+        a = self._a(4, 4)
+        w, v = _to_np(paddle.linalg.eig(paddle.to_tensor(a)))
+        wv, = _to_np(paddle.linalg.eigvals(paddle.to_tensor(a)))
+        ref = np.linalg.eigvals(a)
+        key = lambda z: np.sort_complex(z)
+        np.testing.assert_allclose(key(w), key(ref), rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(key(wv), key(ref), rtol=1e-3, atol=1e-3)
+        # right-eigenvector property A v = w v
+        np.testing.assert_allclose(a.astype(v.dtype) @ v, v * w[None, :],
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_lu_reconstructs(self):
+        a = self._a(4, 4)
+        lu_packed, piv = _to_np(paddle.linalg.lu(paddle.to_tensor(a)))
+        piv = piv - 1  # paddle pivots are 1-based
+        L = np.tril(lu_packed, -1) + np.eye(4, dtype=lu_packed.dtype)
+        U = np.triu(lu_packed)
+        # apply recorded row swaps to a copy of A (LAPACK ipiv convention)
+        pa = a.copy()
+        for i, p in enumerate(piv):
+            pa[[i, p]] = pa[[p, i]]
+        np.testing.assert_allclose(L @ U, pa, rtol=1e-4, atol=1e-4)
+
+    def test_lstsq_solution_is_optimal(self):
+        a, b = self._a(6, 3), self._a(6, 2, seed=8)
+        sol = _to_np(paddle.linalg.lstsq(paddle.to_tensor(a),
+                                         paddle.to_tensor(b)))[0]
+        # normal equations: A^T (A x - b) = 0 at the least-squares optimum
+        np.testing.assert_allclose(a.T @ (a @ sol - b),
+                                   np.zeros((3, 2)), atol=1e-3)
+        np.testing.assert_allclose(sol, np.linalg.lstsq(a, b, rcond=None)[0],
+                                   rtol=1e-3, atol=1e-3)
