@@ -105,6 +105,14 @@ class Normal(Distribution):
     def _batch(self):
         return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
 
+    @property
+    def mean(self):
+        return Tensor(self.loc * jnp.ones(self._batch))
+
+    @property
+    def variance(self):
+        return Tensor(self.scale * self.scale * jnp.ones(self._batch))
+
     def sample(self, shape=(), seed=0):
         return self.rsample(shape)
 
@@ -172,6 +180,14 @@ class Bernoulli(Distribution):
 
     def __init__(self, probs, name=None):
         self.probs_ = _to_array(probs)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs_ * (1.0 - self.probs_))
 
     def sample(self, shape=()):
         shape = _shape_tuple(shape) + self.probs_.shape

@@ -1,0 +1,77 @@
+"""Distribution numerics vs torch.distributions (previously surface-tested
+only; ≙ reference test_distribution.py log_prob/entropy/kl checks)."""
+import numpy as np
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Bernoulli, Categorical, Normal, Uniform,
+                                     kl_divergence)
+
+
+def _np(t):
+    return np.asarray(t._data)
+
+
+def test_normal_log_prob_entropy_kl():
+    loc, scale = np.float32(0.5), np.float32(1.7)
+    d = Normal(loc, scale)
+    td = torch.distributions.Normal(torch.tensor(loc), torch.tensor(scale))
+    x = np.linspace(-3, 3, 7).astype("float32")
+    np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                               td.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               float(td.entropy()), rtol=1e-5)
+    d2 = Normal(np.float32(-1.0), np.float32(0.6))
+    td2 = torch.distributions.Normal(torch.tensor(-1.0), torch.tensor(0.6))
+    np.testing.assert_allclose(
+        float(_np(kl_divergence(d, d2))),
+        float(torch.distributions.kl_divergence(td, td2)), rtol=1e-4)
+
+
+def test_uniform_log_prob_entropy():
+    d = Uniform(np.float32(-1.0), np.float32(3.0))
+    td = torch.distributions.Uniform(torch.tensor(-1.0), torch.tensor(3.0))
+    x = np.array([-0.5, 0.0, 2.9], "float32")
+    np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                               td.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.entropy())),
+                               float(td.entropy()), rtol=1e-5)
+
+
+def test_categorical_log_prob_and_kl():
+    logits = np.array([0.1, 1.2, -0.7, 0.4], "float32")
+    d = Categorical(paddle.to_tensor(logits))
+    td = torch.distributions.Categorical(logits=torch.tensor(logits))
+    x = np.array([0, 2, 3], "int64")
+    got = _np(d.log_prob(paddle.to_tensor(x)))
+    np.testing.assert_allclose(got, td.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    logits2 = np.array([1.0, 0.0, 0.0, -1.0], "float32")
+    d2 = Categorical(paddle.to_tensor(logits2))
+    td2 = torch.distributions.Categorical(logits=torch.tensor(logits2))
+    np.testing.assert_allclose(
+        float(np.asarray(getattr(kl_divergence(d, d2), "_data",
+                                 kl_divergence(d, d2)))),
+        float(torch.distributions.kl_divergence(td, td2)), rtol=1e-4)
+
+
+def test_bernoulli_log_prob_mean_variance():
+    p = np.float32(0.3)
+    d = Bernoulli(p)
+    td = torch.distributions.Bernoulli(torch.tensor(p))
+    x = np.array([0., 1., 1.], "float32")
+    np.testing.assert_allclose(_np(d.log_prob(paddle.to_tensor(x))),
+                               td.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(_np(d.mean)), 0.3, rtol=1e-6)
+    np.testing.assert_allclose(float(_np(d.variance)), 0.21, rtol=1e-5)
+
+
+def test_normal_sampling_moments():
+    paddle.seed(7)
+    d = Normal(np.float32(2.0), np.float32(0.5))
+    s = _np(d.sample([20000]))
+    assert abs(s.mean() - 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
