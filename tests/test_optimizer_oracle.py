@@ -1,0 +1,208 @@
+"""Optimizer trajectory parity vs torch.optim / reference kernel formulas
+(≙ reference unittests/test_{sgd,momentum,adam,adamw,...}_op.py — the update
+rules cite operators/optimizers/*_op.h)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+
+STEPS = 5
+
+
+def _run_paddle(opt_cls, kwargs, grads):
+    paddle.seed(0)
+    p = paddle.to_tensor(P0.copy(), stop_gradient=False)
+    opt = opt_cls(parameters=[p], **kwargs)
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(p._data)
+
+
+def _run_torch(opt_cls, kwargs, grads):
+    tp = torch.tensor(P0.copy(), requires_grad=True)
+    opt = opt_cls([tp], **kwargs)
+    for g in grads:
+        opt.zero_grad()
+        tp.grad = torch.tensor(g)
+        opt.step()
+    return tp.detach().numpy()
+
+
+RNG = np.random.RandomState(3)
+P0 = RNG.randn(6, 4).astype("float32")
+GRADS = [RNG.randn(6, 4).astype("float32") for _ in range(STEPS)]
+
+
+TORCH_PAIRS = [
+    ("sgd", paddle.optimizer.SGD, {"learning_rate": 0.1},
+     torch.optim.SGD, {"lr": 0.1}, 1e-6),
+    ("momentum", paddle.optimizer.Momentum,
+     {"learning_rate": 0.1, "momentum": 0.9},
+     torch.optim.SGD, {"lr": 0.1, "momentum": 0.9}, 1e-6),
+    ("nesterov", paddle.optimizer.Momentum,
+     {"learning_rate": 0.1, "momentum": 0.9, "use_nesterov": True},
+     torch.optim.SGD, {"lr": 0.1, "momentum": 0.9, "nesterov": True}, 1e-6),
+    ("adam", paddle.optimizer.Adam,
+     {"learning_rate": 0.01, "beta1": 0.9, "beta2": 0.99, "epsilon": 1e-8},
+     torch.optim.Adam, {"lr": 0.01, "betas": (0.9, 0.99), "eps": 1e-8}, 1e-5),
+    ("adamw", paddle.optimizer.AdamW,
+     {"learning_rate": 0.01, "weight_decay": 0.05},
+     torch.optim.AdamW, {"lr": 0.01, "weight_decay": 0.05}, 1e-5),
+    ("adamax", paddle.optimizer.Adamax,
+     {"learning_rate": 0.01, "beta1": 0.9, "beta2": 0.999},
+     torch.optim.Adamax, {"lr": 0.01, "betas": (0.9, 0.999)}, 1e-5),
+    ("adagrad", paddle.optimizer.Adagrad,
+     {"learning_rate": 0.05, "initial_accumulator_value": 0.1},
+     torch.optim.Adagrad, {"lr": 0.05, "initial_accumulator_value": 0.1},
+     1e-5),
+    ("adadelta", paddle.optimizer.Adadelta,
+     {"learning_rate": 1.0, "rho": 0.9, "epsilon": 1e-6},
+     torch.optim.Adadelta, {"lr": 1.0, "rho": 0.9, "eps": 1e-6}, 1e-5),
+]
+
+
+@pytest.mark.parametrize("name,pcls,pkw,tcls,tkw,tol", TORCH_PAIRS,
+                         ids=[c[0] for c in TORCH_PAIRS])
+def test_trajectory_matches_torch(name, pcls, pkw, tcls, tkw, tol):
+    got = _run_paddle(pcls, pkw, GRADS)
+    want = _run_torch(tcls, tkw, GRADS)
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol, err_msg=name)
+
+
+def test_rmsprop_matches_reference_formula():
+    """rmsprop_op.h:72: ms = rho*ms + (1-rho)g^2;
+    p -= lr * g / sqrt(ms + eps) — eps INSIDE the sqrt, unlike torch."""
+    lr, rho, eps = 0.01, 0.95, 1e-6
+    got = _run_paddle(paddle.optimizer.RMSProp,
+                      {"learning_rate": lr, "rho": rho, "epsilon": eps}, GRADS)
+    p = P0.copy()
+    ms = np.zeros_like(p)
+    for g in GRADS:
+        ms = rho * ms + (1 - rho) * g * g
+        p = p - lr * g / np.sqrt(ms + eps)
+    np.testing.assert_allclose(got, p, rtol=1e-5, atol=1e-5)
+
+
+def test_lars_matches_reference_formula():
+    """lars_momentum_op: local_lr = lr * eta * ||p|| /
+    (||g|| + lambda*||p||); v = mu*v + local_lr*(g + lambda*p); p -= v."""
+    lr, mu, eta, lam = 0.1, 0.9, 0.001, 0.0005
+    got = _run_paddle(paddle.optimizer.Lars,
+                      {"learning_rate": lr, "momentum": mu,
+                       "lars_coeff": eta, "lars_weight_decay": lam}, GRADS)
+    p = P0.copy()
+    v = np.zeros_like(p)
+    for g in GRADS:
+        pn, gn = np.linalg.norm(p), np.linalg.norm(g)
+        local = lr * eta * pn / (gn + lam * pn)
+        v = mu * v + local * (g + lam * p)
+        p = p - v
+    np.testing.assert_allclose(got, p, rtol=1e-4, atol=1e-5)
+
+
+def test_lamb_matches_reference_formula():
+    """lamb_op.h: adam moment update + trust ratio ||p||/||update||."""
+    lr, b1, b2, eps, wd = 0.01, 0.9, 0.999, 1e-6, 0.01
+    got = _run_paddle(paddle.optimizer.Lamb,
+                      {"learning_rate": lr, "beta1": b1, "beta2": b2,
+                       "epsilon": eps, "lamb_weight_decay": wd}, GRADS)
+    p = P0.copy()
+    m = np.zeros_like(p)
+    vv = np.zeros_like(p)
+    for t, g in enumerate(GRADS, start=1):
+        m = b1 * m + (1 - b1) * g
+        vv = b2 * vv + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        v_hat = vv / (1 - b2 ** t)
+        r = m_hat / (np.sqrt(v_hat) + eps) + wd * p
+        pn, rn = np.linalg.norm(p), np.linalg.norm(r)
+        trust = np.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+        p = p - lr * trust * r
+    np.testing.assert_allclose(got, p, rtol=1e-4, atol=1e-5)
+
+
+class TestLRScheduleOracles:
+    """Schedule-value parity vs torch.optim.lr_scheduler / paddle formulas
+    (≙ reference test_lr_scheduler.py)."""
+
+    def _paddle_lrs(self, sched, n=8):
+        out = []
+        for _ in range(n):
+            out.append(float(sched()))
+            sched.step()
+        return out
+
+    def _torch_lrs(self, cls, n=8, **kw):
+        p = torch.nn.Parameter(torch.zeros(1))
+        opt = torch.optim.SGD([p], lr=kw.pop("base_lr"))
+        s = cls(opt, **kw)
+        out = []
+        for _ in range(n):
+            out.append(opt.param_groups[0]["lr"])
+            opt.step()
+            s.step()
+        return out
+
+    def test_exponential(self):
+        from paddle_tpu.optimizer.lr import ExponentialDecay
+        got = self._paddle_lrs(ExponentialDecay(0.1, gamma=0.8))
+        want = self._torch_lrs(torch.optim.lr_scheduler.ExponentialLR,
+                               base_lr=0.1, gamma=0.8)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_multistep(self):
+        from paddle_tpu.optimizer.lr import MultiStepDecay
+        got = self._paddle_lrs(MultiStepDecay(0.1, milestones=[2, 5],
+                                              gamma=0.1))
+        want = self._torch_lrs(torch.optim.lr_scheduler.MultiStepLR,
+                               base_lr=0.1, milestones=[2, 5], gamma=0.1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_cosine_annealing(self):
+        from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+        got = self._paddle_lrs(CosineAnnealingDecay(0.1, T_max=6))
+        want = self._torch_lrs(torch.optim.lr_scheduler.CosineAnnealingLR,
+                               base_lr=0.1, T_max=6)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_lambda_and_multiplicative(self):
+        from paddle_tpu.optimizer.lr import LambdaDecay, MultiplicativeDecay
+        got = self._paddle_lrs(LambdaDecay(0.1, lr_lambda=lambda e: 0.9 ** e))
+        want = self._torch_lrs(torch.optim.lr_scheduler.LambdaLR,
+                               base_lr=0.1, lr_lambda=lambda e: 0.9 ** e)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        gm = self._paddle_lrs(MultiplicativeDecay(0.1,
+                                                  lr_lambda=lambda e: 0.95))
+        wm = self._torch_lrs(torch.optim.lr_scheduler.MultiplicativeLR,
+                             base_lr=0.1, lr_lambda=lambda e: 0.95)
+        np.testing.assert_allclose(gm, wm, rtol=1e-6)
+
+    def test_formula_schedules(self):
+        from paddle_tpu.optimizer.lr import (InverseTimeDecay, NaturalExpDecay,
+                                             NoamDecay, PiecewiseDecay,
+                                             PolynomialDecay)
+        base, gamma = 0.1, 0.5
+        got = self._paddle_lrs(NaturalExpDecay(base, gamma=gamma), n=4)
+        np.testing.assert_allclose(
+            got, [base * np.exp(-gamma * e) for e in range(4)], rtol=1e-6)
+        got = self._paddle_lrs(InverseTimeDecay(base, gamma=gamma), n=4)
+        np.testing.assert_allclose(
+            got, [base / (1 + gamma * e) for e in range(4)], rtol=1e-6)
+        d2 = 64
+        got = self._paddle_lrs(NoamDecay(d_model=d2, warmup_steps=3,
+                                         learning_rate=1.0), n=5)
+        want = [d2 ** -0.5 * min((e or 1) ** -0.5, (e or 1) * 3 ** -1.5)
+                for e in range(5)]
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        got = self._paddle_lrs(PiecewiseDecay(boundaries=[2, 4],
+                                              values=[1.0, 0.5, 0.1]), n=6)
+        np.testing.assert_allclose(got, [1.0, 1.0, 0.5, 0.5, 0.1, 0.1],
+                                   rtol=1e-6)
+        got = self._paddle_lrs(PolynomialDecay(base, decay_steps=4,
+                                               end_lr=0.01, power=2.0), n=6)
+        want = [(base - 0.01) * (1 - min(e, 4) / 4) ** 2 + 0.01
+                for e in range(6)]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
