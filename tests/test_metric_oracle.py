@@ -1,0 +1,72 @@
+"""Metric numerics vs hand-computed confusion-matrix / rank statistics
+(≙ reference test_metrics.py, test_auc_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    preds = paddle.to_tensor(np.array([[0.1, 0.7, 0.2],
+                                       [0.5, 0.3, 0.2],
+                                       [0.2, 0.3, 0.5],
+                                       [0.6, 0.3, 0.1]], "float32"))
+    labels = paddle.to_tensor(np.array([1, 1, 2, 2], "int64"))
+    m.update(m.compute(preds, labels))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 4) < 1e-6       # rows 0,2 correct at top-1
+    assert abs(top2 - 3 / 4) < 1e-6       # row 1 recovered at top-2
+
+def test_precision_recall_against_confusion_matrix():
+    preds = np.array([1, 1, 0, 1, 0, 1, 0, 0], "float32")
+    labels = np.array([1, 0, 0, 1, 1, 1, 0, 1], "int64")
+    tp = int(((preds == 1) & (labels == 1)).sum())   # 3
+    fp = int(((preds == 1) & (labels == 0)).sum())   # 1
+    fn = int(((preds == 0) & (labels == 1)).sum())   # 2
+    p = Precision(); p.update(paddle.to_tensor(preds),
+                              paddle.to_tensor(labels))
+    r = Recall(); r.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+    assert abs(p.accumulate() - tp / (tp + fp)) < 1e-9
+    assert abs(r.accumulate() - tp / (tp + fn)) < 1e-9
+
+
+def test_precision_incremental_accumulation():
+    p = Precision()
+    for s in range(3):
+        pr = np.array([1, 0, 1, 1], "float32")
+        la = np.array([1, 1, 0, s % 2], "int64")
+        p.update(paddle.to_tensor(pr), paddle.to_tensor(la))
+    # per batch (preds 1 at idx 0,2,3): s=0 labels[0,2,3]=1,0,0 -> tp1 fp2;
+    # s=1 labels=1,0,1 -> tp2 fp1; s=2 = s=0 -> tp1 fp2.  Totals tp4 fp5.
+    assert abs(p.accumulate() - 4 / 9) < 1e-9
+
+
+def test_auc_matches_exact_rank_auc():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(4000).astype("float32")
+    labels = (rng.rand(4000) < scores).astype("int64")  # informative scores
+    m = Auc()
+    m.update(paddle.to_tensor(np.stack([1 - scores, scores], 1)),
+             paddle.to_tensor(labels))
+    got = m.accumulate()
+    # exact rank-based AUC (Mann-Whitney U)
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores)); ranks[order] = np.arange(1, len(scores) + 1)
+    pos = labels == 1
+    n1, n0 = pos.sum(), (~pos).sum()
+    exact = (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+    assert abs(got - exact) < 2e-3, (got, exact)
+
+
+def test_auc_incremental_equals_single_shot():
+    rng = np.random.RandomState(1)
+    scores = rng.rand(1000).astype("float32")
+    labels = (rng.rand(1000) < 0.4).astype("int64")
+    a1 = Auc()
+    a1.update(paddle.to_tensor(scores), paddle.to_tensor(labels))
+    a2 = Auc()
+    for i in range(0, 1000, 100):
+        a2.update(paddle.to_tensor(scores[i:i + 100]),
+                  paddle.to_tensor(labels[i:i + 100]))
+    assert abs(a1.accumulate() - a2.accumulate()) < 1e-12
