@@ -1,0 +1,84 @@
+"""Sparse COO/CSR numerics vs dense equivalents (previously surface-tested
+only; ≙ reference test_sparse_utils_op.py / test_sparse_matmul_op.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo(shape=(4, 5), density=0.4, seed=5):
+    rng = np.random.RandomState(seed)
+    dense = rng.randn(*shape).astype("float32")
+    mask = rng.rand(*shape) < density
+    dense = np.where(mask, dense, 0.0).astype("float32")
+    idx = np.argwhere(dense != 0).T
+    vals = dense[tuple(idx)]
+    t = sparse.sparse_coo_tensor(paddle.to_tensor(idx.astype("int64")),
+                                 paddle.to_tensor(vals), shape)
+    return t, dense
+
+
+def test_coo_roundtrip_to_dense():
+    t, dense = _coo()
+    np.testing.assert_allclose(np.asarray(t.to_dense()._data), dense)
+    assert sparse.is_sparse(t)
+
+
+def test_csr_roundtrip_to_dense():
+    dense = np.array([[1., 0., 2.], [0., 0., 3.], [4., 5., 0.]], "float32")
+    crows = np.array([0, 2, 3, 5], "int64")
+    cols = np.array([0, 2, 2, 0, 1], "int64")
+    vals = np.array([1., 2., 3., 4., 5.], "float32")
+    t = sparse.sparse_csr_tensor(paddle.to_tensor(crows),
+                                 paddle.to_tensor(cols),
+                                 paddle.to_tensor(vals), (3, 3))
+    np.testing.assert_allclose(np.asarray(t.to_dense()._data), dense)
+
+
+def test_elementwise_ops_match_dense():
+    a, da = _coo(seed=11)
+    b, db = _coo(seed=12)
+    np.testing.assert_allclose(np.asarray(sparse.add(a, b).to_dense()._data),
+                               da + db, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sparse.subtract(a, b).to_dense()._data), da - db,
+        rtol=1e-6)
+    # unary ops act on stored values only (reference sparse semantics)
+    np.testing.assert_allclose(np.asarray(sparse.relu(a).to_dense()._data),
+                               np.maximum(da, 0), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(sparse.sin(a).to_dense()._data),
+                               np.where(da != 0, np.sin(da), 0.0),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_matmul_matches_dense():
+    a, da = _coo(seed=13)
+    dense_rhs = np.random.RandomState(14).randn(5, 3).astype("float32")
+    out = sparse.matmul(a, paddle.to_tensor(dense_rhs))
+    got = np.asarray(getattr(out, "_data", out))
+    np.testing.assert_allclose(got, da @ dense_rhs, rtol=1e-5, atol=1e-5)
+
+
+def test_masked_matmul():
+    """masked_matmul(x, y, mask): dense@dense evaluated only at mask's
+    sparsity pattern (reference sparse.masked_matmul contract)."""
+    r = np.random.RandomState(15)
+    x = r.randn(4, 6).astype("float32")
+    y = r.randn(6, 5).astype("float32")
+    m, dm = _coo((4, 5), density=0.3, seed=16)
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), m)
+    got = np.asarray(out.to_dense()._data)
+    want = np.where(dm != 0, x @ y, 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_coalesce_merges_duplicates():
+    idx = np.array([[0, 0, 1], [1, 1, 2]], "int64")
+    vals = np.array([1.0, 2.0, 5.0], "float32")
+    t = sparse.sparse_coo_tensor(paddle.to_tensor(idx),
+                                 paddle.to_tensor(vals), (2, 3))
+    c = sparse.coalesce(t)
+    dense = np.zeros((2, 3), "float32")
+    dense[0, 1] = 3.0
+    dense[1, 2] = 5.0
+    np.testing.assert_allclose(np.asarray(c.to_dense()._data), dense)
