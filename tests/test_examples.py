@@ -31,6 +31,10 @@ class TestExamples:
         out = _run("train_mnist.py")
         assert "loss" in out
 
+    def test_quantize_ptq(self):
+        out = _run("quantize_ptq.py")
+        assert "int8 accuracy" in out
+
     def test_bert(self):
         out = _run("finetune_bert.py")
         assert "step 9" in out

@@ -792,18 +792,18 @@ WAIVERS = {
     "get_default_dtype": "config accessor",
     "check_shape": "arg validator",
     "tolist": "python-side accessor (tested via Tensor methods)",
-    "empty": "value-unspecified by contract; shape/dtype asserted in test_tensor_ops",
+    "empty": "value-unspecified; shape/dtype in test_tensor_ops TestRandomMoments",
     "empty_like": "value-unspecified by contract",
     "is_tensor": "type predicate, tested in test_api_surface",
     # random: statistical, seeded-draw determinism tested in test_tensor_ops
-    "bernoulli": "statistical (random)", "bernoulli_": "statistical (random)",
-    "binomial": "statistical (random)", "exponential_": "statistical (random)",
-    "gaussian": "statistical (random)", "multinomial": "statistical (random)",
-    "normal": "statistical (random)", "normal_": "statistical (random)",
-    "poisson": "statistical (random)", "rand": "statistical (random)",
+    "bernoulli": "moment-tested in test_tensor_ops TestRandomMoments", "bernoulli_": "statistical (random)",
+    "binomial": "moment-tested in TestRandomMoments", "exponential_": "moment-tested in TestRandomMoments",
+    "gaussian": "moment-tested in TestRandomMoments", "multinomial": "frequency-tested in TestRandomMoments",
+    "normal": "moment-tested in TestRandomMoments", "normal_": "statistical (random)",
+    "poisson": "moment-tested in TestRandomMoments", "rand": "statistical (random)",
     "randint": "statistical (random)", "randint_like": "statistical (random)",
     "randn": "statistical (random)", "randperm": "statistical (random)",
-    "standard_normal": "statistical (random)",
+    "standard_normal": "moment-tested in TestRandomMoments",
     "uniform": "statistical (random)", "uniform_": "statistical (random)",
     # in-place aliases of covered ops
     "reshape_": "in-place alias of reshape", "scatter_": "in-place alias",

@@ -165,3 +165,55 @@ class TestRandom:
         assert r.numpy().min() >= 0 and r.numpy().max() < 10
         p = paddle.randperm(10)
         assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+class TestRandomMoments:
+    """Moment/support checks for the remaining random ops (the oracle
+    harness waives them as statistical; this is their numeric backstop)."""
+
+    def test_empty_contract(self):
+        e = paddle.empty([3, 4], dtype="float32")
+        assert list(e.shape) == [3, 4] and e.dtype == "float32"
+        el = paddle.empty_like(e)
+        assert list(el.shape) == [3, 4]
+
+    def test_bernoulli_poisson_binomial(self):
+        paddle.seed(3)
+        p = paddle.to_tensor(np.full((20000,), 0.3, "float32"))
+        b = paddle.bernoulli(p).numpy()
+        assert set(np.unique(b)) <= {0.0, 1.0}
+        assert abs(b.mean() - 0.3) < 0.02
+        lam = paddle.to_tensor(np.full((20000,), 4.0, "float32"))
+        po = paddle.poisson(lam).numpy()
+        assert abs(po.mean() - 4.0) < 0.1
+        assert abs(po.var() - 4.0) < 0.3
+        n = paddle.to_tensor(np.full((20000,), 10, "int32"))
+        pr = paddle.to_tensor(np.full((20000,), 0.25, "float32"))
+        bi = paddle.binomial(n, pr).numpy()
+        assert abs(bi.mean() - 2.5) < 0.05
+        assert bi.min() >= 0 and bi.max() <= 10
+
+    def test_gaussian_normal_standard(self):
+        paddle.seed(4)
+        g = paddle.gaussian([20000], mean=1.0, std=2.0).numpy()
+        assert abs(g.mean() - 1.0) < 0.06 and abs(g.std() - 2.0) < 0.06
+        s = paddle.standard_normal([20000]).numpy()
+        assert abs(s.mean()) < 0.04 and abs(s.std() - 1.0) < 0.04
+        n = paddle.normal(mean=-2.0, std=0.5, shape=[20000]).numpy()
+        assert abs(n.mean() + 2.0) < 0.02 and abs(n.std() - 0.5) < 0.02
+
+    def test_multinomial_distribution(self):
+        paddle.seed(6)
+        probs = paddle.to_tensor(np.array([0.1, 0.2, 0.7], "float32"))
+        draws = paddle.multinomial(probs, num_samples=10000,
+                                   replacement=True).numpy()
+        freq = np.bincount(draws, minlength=3) / 10000
+        np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.03)
+
+    def test_exponential_(self):
+        paddle.seed(8)
+        x = paddle.to_tensor(np.zeros(20000, "float32"))
+        x.exponential_(lam=2.0)
+        v = x.numpy()
+        assert v.min() >= 0
+        assert abs(v.mean() - 0.5) < 0.02
