@@ -18,8 +18,12 @@ long-context from first principles for TPU:
   when heads divide the sep degree and L is moderate.
 
 Both are pure SPMD functions meant to run inside ``shard_map`` over the
-hybrid mesh, and are differentiable via JAX AD (the ring scan's backward
-re-runs the ring in reverse — the L×L score matrix is never materialized).
+hybrid mesh.  Ring attention differentiates through a HAND-WRITTEN
+custom_vjp (flash-bwd identities; dk/dv travel with their k/v block around
+the ring) — plain JAX AD of the forward scan would stack every received
+k/v block as a residual, i.e. the full global K/V on every device, which is
+the exact memory blow-up ring attention exists to avoid.  Per-device memory
+is O(L/sp) in both directions and the L×L score matrix never exists.
 """
 
 from __future__ import annotations
@@ -40,24 +44,24 @@ def _local_scores(q, k, scale):
                       k.astype(jnp.float32)) * scale
 
 
-def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
-                   scale=None):
-    """Ring flash attention over sequence shards.
+def _varying(x, axis_name):
+    # new-style shard_map typing: scan carries must keep the same
+    # varying-axes set each iteration
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, TypeError):
+        return x
 
-    Args:
-      q, k, v: local shards ``(B, L_local, H, D)`` — the global sequence is
-        the concatenation of shards along the ``axis_name`` mesh axis.
-      axis_name: mesh axis the sequence is sharded over (the hybrid "sep"
-        axis). Must be called inside shard_map/pjit over that axis.
-      causal: apply a causal mask in *global* sequence coordinates.
-    Returns:
-      local output shard (B, L_local, H, D), same dtype as q.
-    """
+
+def _ring_perm(sp):
+    return [(i, (i + 1) % sp) for i in range(sp)]
+
+
+def _ring_fwd_pass(q, k, v, axis_name, causal, scale):
+    """One full ring: returns (out fp32 (B,H,Lc,D), lse (B,H,Lc,1))."""
     B, Lc, H, D = q.shape
-    scale = scale if scale is not None else 1.0 / math.sqrt(D)
     sp = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
-
     row_g = idx * Lc + lax.broadcasted_iota(jnp.int32, (Lc, Lc), 0)
 
     def accumulate(k_blk, v_blk, blk_idx, m, l, acc):
@@ -74,38 +78,30 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
             "bhlm,bmhd->bhld", p, v_blk.astype(jnp.float32))
         return m_new, l_new, acc_new
 
-    def step(carry, t):
-        k_blk, v_blk, m, l, acc = carry
-        # which global chunk this k/v block came from after t rotations
-        blk_idx = (idx - t) % sp
+    def maybe(k_blk, v_blk, blk_idx, m, l, acc):
         if causal:
             # skip blocks entirely in the masked future (blk_idx > idx):
             # on average (sp-1)/2 of sp blocks — halves the wasted FLOPs.
-            # (Load stays imbalanced across ranks within a step; a zigzag
-            # block order would fix that too — future work.)
-            m, l, acc = lax.cond(
+            return lax.cond(
                 blk_idx <= idx,
                 lambda a, b, c_, d, e: accumulate(a, b, blk_idx, c_, d, e),
                 lambda a, b, c_, d, e: (c_, d, e),
                 k_blk, v_blk, m, l, acc)
-        else:
-            m, l, acc = accumulate(k_blk, v_blk, blk_idx, m, l, acc)
+        return accumulate(k_blk, v_blk, blk_idx, m, l, acc)
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, acc = carry
+        blk_idx = (idx - t) % sp       # home rank of this block after t hops
+        m, l, acc = maybe(k_blk, v_blk, blk_idx, m, l, acc)
         # rotate k/v to the next rank (ring over ICI neighbors)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        perm = _ring_perm(sp)
         k_nxt = lax.ppermute(k_blk, axis_name, perm)
         v_nxt = lax.ppermute(v_blk, axis_name, perm)
         return (k_nxt, v_nxt, m, l, acc), None
 
-    # accumulators start device-varying over the ring axis (new-style shard_map
-    # typing: scan carries must keep the same varying-axes set each iteration)
-    def _varying(x):
-        try:
-            return lax.pcast(x, (axis_name,), to="varying")
-        except (AttributeError, TypeError):
-            return x
-    m0 = _varying(jnp.full((B, H, Lc, 1), _NEG_INF, jnp.float32))
-    l0 = _varying(jnp.zeros((B, H, Lc, 1), jnp.float32))
-    acc0 = _varying(jnp.zeros((B, H, Lc, D), jnp.float32))
+    m0 = _varying(jnp.full((B, H, Lc, 1), _NEG_INF, jnp.float32), axis_name)
+    l0 = _varying(jnp.zeros((B, H, Lc, 1), jnp.float32), axis_name)
+    acc0 = _varying(jnp.zeros((B, H, Lc, D), jnp.float32), axis_name)
     # sp-1 (compute + rotate) steps, then a final compute with no rotation —
     # the last ppermute's payload would otherwise be exchanged and discarded
     if sp > 1:
@@ -114,16 +110,136 @@ def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
     else:
         k_last, v_last, m, l, acc = k, v, m0, l0, acc0
     last_idx = (idx - (sp - 1)) % sp
-    if causal and sp > 1:
-        m, l, acc = lax.cond(
-            last_idx <= idx,
-            lambda a, b, c_, d, e: accumulate(a, b, last_idx, c_, d, e),
-            lambda a, b, c_, d, e: (c_, d, e),
-            k_last, v_last, m, l, acc)
-    else:
-        m, l, acc = accumulate(k_last, v_last, last_idx, m, l, acc)
-    out = acc / jnp.maximum(l, 1e-30)
+    m, l, acc = maybe(k_last, v_last, last_idx, m, l, acc)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention(q, k, v, axis_name, causal, scale):
+    out, _ = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)  # (B,Lc,H,D)
+
+
+def _ring_attention_fwd(q, k, v, axis_name, causal, scale):
+    out, lse = _ring_fwd_pass(q, k, v, axis_name, causal, scale)
+    # residuals are O(L/sp) per device: inputs + output + softmax stats.
+    # JAX AD of the fwd scan would instead stack every RECEIVED k/v block
+    # ((sp-1) x shard = the full global K/V on every device) — the exact
+    # memory blow-up ring attention exists to avoid.
+    return (out.transpose(0, 2, 1, 3).astype(q.dtype),
+            (q, k, v, out, lse))
+
+
+def _ring_attention_bwd(axis_name, causal, scale, res, g):
+    """Backward re-runs the ring: k/v blocks rotate again, each device
+    accumulates its local dq, while dk/dv partials travel WITH their block
+    and come home after a final hop.  Per-device memory stays O(L/sp)."""
+    q, k, v, out, lse = res
+    B, Lc, H, D = q.shape
+    sp = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    row_g = idx * Lc + lax.broadcasted_iota(jnp.int32, (Lc, Lc), 0)
+
+    do = g.transpose(0, 2, 1, 3).astype(jnp.float32)        # (B,H,Lc,D)
+    # delta_i = sum_d dO_i * O_i  (flash bwd identity)
+    delta = jnp.sum(do * out, axis=-1, keepdims=True)       # (B,H,Lc,1)
+    qf = q.astype(jnp.float32)
+
+    def block_grads(k_blk, v_blk, blk_idx):
+        s = _local_scores(q, k_blk, scale)                  # (B,H,Lc,Lc)
+        if causal:
+            col_g = blk_idx * Lc + lax.broadcasted_iota(jnp.int32, (Lc, Lc), 1)
+            s = jnp.where(col_g <= row_g, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                # (B,H,Lc,Lc)
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        dv_b = jnp.einsum("bhlm,bhld->bmhd", p, do)         # (B,Lc,H,D)
+        dp = jnp.einsum("bhld,bmhd->bhlm", do, vf)
+        ds = p * (dp - delta) * scale
+        dq_b = jnp.einsum("bhlm,bmhd->blhd", ds, kf)        # (B,Lc,H,D)
+        dk_b = jnp.einsum("bhlm,blhd->bmhd", ds, qf)        # (B,Lc,H,D)
+        return dq_b, dk_b, dv_b
+
+    def maybe_grads(k_blk, v_blk, blk_idx):
+        if causal:
+            zero = _varying(jnp.zeros((B, Lc, H, D), jnp.float32), axis_name)
+            return lax.cond(
+                blk_idx <= idx,
+                lambda a, b: block_grads(a, b, blk_idx),
+                lambda a, b: (zero, zero, zero),
+                k_blk, v_blk)
+        return block_grads(k_blk, v_blk, blk_idx)
+
+    def step(carry, t):
+        k_blk, v_blk, dk_blk, dv_blk, dq = carry
+        blk_idx = (idx - t) % sp
+        dq_b, dk_b, dv_b = maybe_grads(k_blk, v_blk, blk_idx)
+        dq = dq + dq_b
+        dk_blk = dk_blk + dk_b
+        dv_blk = dv_blk + dv_b
+        perm = _ring_perm(sp)
+        return (lax.ppermute(k_blk, axis_name, perm),
+                lax.ppermute(v_blk, axis_name, perm),
+                lax.ppermute(dk_blk, axis_name, perm),
+                lax.ppermute(dv_blk, axis_name, perm), dq), None
+
+    zero = jnp.zeros((B, Lc, H, D), jnp.float32)
+    dq0 = _varying(zero, axis_name)
+    dk0 = _varying(zero, axis_name)
+    dv0 = _varying(zero, axis_name)
+    if sp > 1:
+        (k_last, v_last, dk_t, dv_t, dq), _ = lax.scan(
+            step, (k, v, dk0, dv0, dq0), jnp.arange(sp - 1))
+    else:
+        k_last, v_last, dk_t, dv_t, dq = k, v, dk0, dv0, dq0
+    last_idx = (idx - (sp - 1)) % sp
+    dq_b, dk_b, dv_b = maybe_grads(k_last, v_last, last_idx)
+    dq = dq + dq_b
+    dk_t = dk_t + dk_b
+    dv_t = dv_t + dv_b
+    if sp > 1:
+        # the block at step sp-1 sits one hop short of home: block j rests on
+        # rank j-1, so one more rotation returns every dk/dv to its owner
+        perm = _ring_perm(sp)
+        dk_t = lax.ppermute(dk_t, axis_name, perm)
+        dv_t = lax.ppermute(dv_t, axis_name, perm)
+    return (dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype))
+
+
+_ring_attention.defvjp(_ring_attention_fwd, _ring_attention_bwd)
+
+
+def ring_attention(q, k, v, axis_name: str = "sep", causal: bool = False,
+                   scale=None):
+    """Ring flash attention over sequence shards.
+
+    Args:
+      q, k, v: local shards ``(B, L_local, H, D)`` — the global sequence is
+        the concatenation of shards along the ``axis_name`` mesh axis.
+      axis_name: mesh axis the sequence is sharded over (the hybrid "sep"
+        axis). Must be called inside shard_map/pjit over that axis.
+      causal: apply a causal mask in *global* sequence coordinates.
+    Returns:
+      local output shard (B, L_local, H, D), same dtype as q.
+
+    Differentiable via a custom ring backward (flash bwd identities with
+    dk/dv traveling alongside their k/v block) — per-device memory is
+    O(L/sp) in BOTH directions; plain JAX AD of the forward scan would stack
+    every received k/v shard (O(L) per device).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    try:
+        scale = float(scale)  # static: goes through nondiff_argnums
+    except TypeError:
+        # traced/learned scale (e.g. temperature): absorb into q so the
+        # gradient flows through the product instead of nondiff_argnums
+        q = q * jnp.asarray(scale, q.dtype)
+        scale = 1.0
+    return _ring_attention(q, k, v, axis_name, causal, scale)
 
 
 def ulysses_attention(q, k, v, axis_name: str = "sep", causal: bool = False,

@@ -129,3 +129,67 @@ def test_gpt_train_step_with_sep_ring_loss_parity():
             state, loss = step(state, jax.random.key(i), np.float32(1e-3), x, y)
         losses[sep] = float(np.asarray(loss))
     assert abs(losses[1] - losses[4]) < 1e-4, losses
+
+
+needs8 = pytest.mark.skipif(len(local_devices()) < 8,
+                            reason="needs 8 devices")
+
+
+@needs8
+def test_ring_memory_stays_per_shard_linear():
+    """Long-context CPU-side proof (VERDICT r3 #8): under sep=8 ring
+    attention, the grad jaxpr — INCLUDING the shard_map body and cond
+    branches — holds nothing bigger than a few per-device panels/shards.
+    Plain JAX AD of the fwd scan stacks (sp-1) received k/v shards
+    ((sp-1)*Lc*H*D per device = the full global K/V), which this bound
+    rejects; dims are chosen so that blow-up exceeds the limit while the
+    legitimate (B,H,Lc,Lc) score panel and (Lc,H,D) shards fit."""
+    L, H, D, sep = 2048, 4, 256, 8
+    Lc = L // sep
+    mesh = Mesh(np.array(jax.devices()[:sep]), ("sep",))
+    q = jax.ShapeDtypeStruct((1, L, H, D), jnp.float32)
+
+    def loss(q, k, v):
+        f = shard_map(lambda a, b, c: ring_attention(a, b, c, "sep",
+                                                     causal=True),
+                      mesh=mesh, in_specs=(P(None, "sep"),) * 3,
+                      out_specs=P(None, "sep"))
+        return jnp.sum(f(q, k, v))
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+    outer_limit = 2 * L * H * D          # global shards/grads
+    panel = Lc * Lc * H                  # per-device score panel (B=1)
+    shard = Lc * H * D
+    inner_limit = 4 * max(panel, shard)  # << (sep-1)*shard = 7*shard
+    assert (sep - 1) * shard > inner_limit  # the guarded blow-up must trip
+
+    visited = {"inner": 0}
+
+    def sub_jaxprs(eqn):
+        for val in eqn.params.values():
+            for cand in (val if isinstance(val, (tuple, list)) else [val]):
+                if hasattr(cand, "jaxpr"):      # ClosedJaxpr
+                    yield cand.jaxpr
+                elif hasattr(cand, "eqns"):     # plain Jaxpr (shard_map)
+                    yield cand
+
+    def walk(jx, inner):
+        for eqn in jx.eqns:
+            is_manual = inner or eqn.primitive.name == "shard_map"
+            for var in eqn.outvars:
+                sz = int(np.prod(var.aval.shape)) if var.aval.shape else 1
+                if inner:
+                    visited["inner"] += 1
+                    assert sz <= inner_limit, (
+                        f"per-device buffer {var.aval.shape} "
+                        f"({eqn.primitive}) exceeds O(L/sp) bound")
+                else:
+                    assert sz <= outer_limit, (
+                        f"global buffer {var.aval.shape} ({eqn.primitive})")
+            for sub in sub_jaxprs(eqn):
+                walk(sub, is_manual)
+
+    walk(jaxpr.jaxpr, False)
+    # the walker must actually have seen the ring internals — a vacuous
+    # walk (e.g. shard_map body not entered) would pass every assert
+    assert visited["inner"] > 20, visited
