@@ -193,3 +193,40 @@ def test_ring_memory_stays_per_shard_linear():
     # the walker must actually have seen the ring internals — a vacuous
     # walk (e.g. shard_map body not entered) would pass every assert
     assert visited["inner"] > 20, visited
+
+
+@needs8
+def test_gpt_dp_x_sep_x_sharding_parity():
+    """3-axis hybrid no other test covers: dp2 x sep2(ring) x sharding2
+    (ZeRO-3) on one mesh matches the serial run (loss-parity oracle,
+    ≙ reference test_dist_base.py:1457)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
+    from paddle_tpu.optimizer import AdamW
+
+    losses = {}
+    for tag, cfgs, zs in [("serial", {"dp_degree": 1}, 0),
+                          ("hybrid", {"dp_degree": 2, "sep_degree": 2,
+                                      "sharding_degree": 2}, 3)]:
+        paddle.seed(0)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"mp_degree": 1, "pp_degree": 1, **cfgs}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=32,
+                        compute_dtype="float32",
+                        sequence_parallel="ring" if "sep_degree" in cfgs
+                        else None)
+        model = GPTModel(cfg)
+        step, state = make_gpt_train_step(model, AdamW(1e-3), hcg,
+                                          remat=False, zero_stage=zs)
+        r = np.random.RandomState(0)
+        x = jnp.asarray(r.randint(0, 128, (4, 32)))
+        y = jnp.asarray(r.randint(0, 128, (4, 32)))
+        for i in range(3):
+            state, loss = step(state, jax.random.key(i), np.float32(1e-3),
+                               x, y)
+        losses[tag] = float(np.asarray(loss))
+    assert abs(losses["serial"] - losses["hybrid"]) < 1e-4, losses
