@@ -94,3 +94,48 @@ def test_resnet_nhwc_matches_nchw():
     o2 = np.asarray(m_nhwc(paddle.to_tensor(
         np.transpose(x, (0, 2, 3, 1))))._data)
     np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_nhwc_train_step_parity():
+    """Train-mode NHWC vs NCHW: full backward compared in float64, where
+    layout equivalence is exact (worst observed diff ~2e-12).
+
+    fp32 comparison is useless here: layouts change only the reduction
+    order, but 4-sample BatchNorm amplifies that noise up to ~5% on deep
+    conv grads, and a 1e-6 single-weight perturbation flips a 3-step loss
+    trajectory entirely (both verified) — any fp32 tolerance either masks
+    real bugs or fails on noise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet18
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        out = {}
+        for df in ("NCHW", "NHWC"):
+            paddle.seed(0)
+            m = resnet18(num_classes=10, data_format=df)
+            for _, p in m.named_parameters():
+                p._data = p._data.astype(jnp.float64)
+            for _, b in m.named_buffers():
+                if jnp.issubdtype(b._data.dtype, jnp.floating):
+                    b._data = b._data.astype(jnp.float64)
+            r = np.random.RandomState(0)
+            x = r.randn(4, 3, 32, 32).astype("float64")
+            y = paddle.to_tensor(np.array([0, 1, 2, 3], "int64"))
+            xin = x if df == "NCHW" else np.transpose(x, (0, 2, 3, 1))
+            m.train()
+            loss = nn.functional.cross_entropy(m(paddle.to_tensor(xin)), y)
+            loss.backward()
+            out[df] = {n: np.asarray(p.grad._data)
+                       for n, p in m.named_parameters()
+                       if p.grad is not None}
+        assert set(out["NCHW"]) == set(out["NHWC"])
+        for n in out["NCHW"]:
+            np.testing.assert_allclose(out["NCHW"][n], out["NHWC"][n],
+                                       atol=1e-9, err_msg=n)
+    finally:
+        jax.config.update("jax_enable_x64", False)
