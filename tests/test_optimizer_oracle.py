@@ -206,3 +206,54 @@ class TestLRScheduleOracles:
         want = [(base - 0.01) * (1 - min(e, 4) / 4) ** 2 + 0.01
                 for e in range(6)]
         np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+class TestGradClipOracles:
+    """Grad-clip numerics vs torch equivalents (≙ reference
+    test_gradient_clip.py)."""
+
+    def _grads(self):
+        r = np.random.RandomState(9)
+        return [r.randn(4, 3).astype("float32") * 3,
+                r.randn(7).astype("float32") * 0.1]
+
+    def _clipped(self, clip, gs):
+        ps = [paddle.to_tensor(np.zeros_like(g), stop_gradient=False)
+              for g in gs]
+        for p, g in zip(ps, gs):
+            p.grad = paddle.to_tensor(g)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=ps,
+                                   grad_clip=clip)
+        opt.step()
+        # with lr=1 and zero init, new param = -clipped_grad
+        return [-np.asarray(p._data) for p in ps]
+
+    def test_global_norm_matches_torch(self):
+        import torch
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+        gs = self._grads()
+        got = self._clipped(ClipGradByGlobalNorm(1.0), gs)
+        tps = [torch.nn.Parameter(torch.zeros(g.shape)) for g in gs]
+        for tp, g in zip(tps, gs):
+            tp.grad = torch.tensor(g)
+        torch.nn.utils.clip_grad_norm_(tps, 1.0)
+        for a, tp in zip(got, tps):
+            np.testing.assert_allclose(a, tp.grad.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_by_norm_per_tensor(self):
+        from paddle_tpu.nn.clip import ClipGradByNorm
+        gs = self._grads()
+        got = self._clipped(ClipGradByNorm(1.0), gs)
+        for a, g in zip(got, gs):
+            n = np.linalg.norm(g)
+            want = g / max(n, 1.0)
+            np.testing.assert_allclose(a, want, rtol=1e-5, atol=1e-6)
+
+    def test_by_value(self):
+        from paddle_tpu.nn.clip import ClipGradByValue
+        gs = self._grads()
+        got = self._clipped(ClipGradByValue(max=0.5, min=-0.25), gs)
+        for a, g in zip(got, gs):
+            np.testing.assert_allclose(a, np.clip(g, -0.25, 0.5),
+                                       rtol=1e-6, atol=1e-7)
