@@ -250,3 +250,34 @@ def test_lstm_matches_torch():
                                rtol=1e-4, atol=1e-4)
     np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_initializer_statistics_and_properties():
+    """Kaiming/TruncatedNormal/Dirac/calculate_gain oracles (≙ reference
+    test_initializer.py)."""
+    import torch
+    from paddle_tpu.nn import initializer as I
+
+    paddle.seed(0)
+    # KaimingNormal for fan_in f: std = gain/sqrt(fan_in), relu gain sqrt(2)
+    w = np.asarray(I.KaimingNormal()([400, 100], "float32"))
+    assert abs(w.std() - np.sqrt(2.0 / 400)) < 0.005
+    # TruncatedNormal: |x| <= 2*std and std shrinks vs plain normal
+    t = np.asarray(I.TruncatedNormal(mean=0.0, std=1.0)([20000], "float32"))
+    assert np.abs(t).max() <= 2.0 + 1e-5
+    assert 0.7 < t.std() < 0.95
+    # Dirac: conv with dirac weights is identity on matching channels
+    d = np.asarray(I.Dirac()([4, 4, 3, 3], "float32"))
+    x = np.random.RandomState(0).randn(1, 4, 8, 8).astype("float32")
+    import paddle_tpu.nn.functional as F
+    out = np.asarray(F.conv2d(paddle.to_tensor(x),
+                              paddle.to_tensor(d), padding=1)._data)
+    np.testing.assert_allclose(out, x, rtol=1e-5, atol=1e-6)
+    # calculate_gain parity vs torch
+    for nl, arg in [("relu", None), ("tanh", None), ("leaky_relu", 0.1),
+                    ("sigmoid", None), ("linear", None)]:
+        got = I.calculate_gain(nl, arg) if arg is not None else \
+            I.calculate_gain(nl)
+        want = torch.nn.init.calculate_gain(nl, arg) if arg is not None \
+            else torch.nn.init.calculate_gain(nl)
+        assert abs(got - want) < 1e-6, nl
