@@ -8,6 +8,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...core.dtype import convert_dtype
+
 from ...core.tensor import apply
 from .conv import _padding, _tuplize
 
@@ -133,7 +135,7 @@ def _pool_indices(x, kernel, stride, padding, n, data_format, ceil_mode):
         vals, idxs = jax.lax.reduce_window(
             (a, iota_b), (jnp.array(-jnp.inf, a.dtype), jnp.array(-1.0, jnp.float32)),
             red, win, st, pd)
-        return idxs.astype(jnp.int64)
+        return idxs.astype(convert_dtype("int64"))
     return apply(f, x)
 
 
@@ -212,7 +214,7 @@ def _adaptive_max_mask(x, output_size, n, data_format):
                 i_sl.append(jnp.take_along_axis(ii, am, axis=d).squeeze(d))
             vals = jnp.stack(v_sl, axis=d)
             idxs = jnp.stack(i_sl, axis=d)
-        return idxs.astype(jnp.int64)
+        return idxs.astype(convert_dtype("int64"))
     return apply(f, x)
 
 

@@ -30,8 +30,6 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
     else:
         total = jnp.power(sum(jnp.sum(jnp.power(jnp.abs(p._grad), norm_type))
                               for p in params), 1.0 / norm_type)
-    clip_coef = jnp.clip(max_norm / (total + 1e-6), a_max=1.0) \
-        if hasattr(jnp, "clip") else max_norm / (total + 1e-6)
     clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
     for p in params:
         p._grad = p._grad * clip_coef.astype(p._grad.dtype)

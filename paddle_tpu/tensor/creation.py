@@ -13,7 +13,7 @@ from ..core.tensor import Tensor, apply, to_tensor  # noqa: F401
 
 def _dt(dtype, default_float=True):
     if dtype is None:
-        return get_default_dtype() if default_float else jnp.int64
+        return get_default_dtype() if default_float else convert_dtype("int64")
     return convert_dtype(dtype)
 
 
@@ -60,7 +60,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
         start, end = 0, start
     if dtype is None:
         py = (start, end, step)
-        dtype = jnp.int64 if all(isinstance(v, (int, np.integer)) for v in py) else get_default_dtype()
+        dtype = convert_dtype("int64") if all(isinstance(v, (int, np.integer)) for v in py) else get_default_dtype()
     return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
 
 
@@ -121,7 +121,7 @@ def tolist(x):
 
 
 def numel(x, name=None):
-    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+    return Tensor(jnp.asarray(x.size, dtype=convert_dtype("int64")))
 
 
 def _u(v):

@@ -21,7 +21,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
     def f(a):
         idx = jnp.argsort(a, axis=axis, stable=True, descending=descending)
-        return idx.astype(jnp.int64)
+        return idx.astype(convert_dtype("int64"))
     return apply(f, x)
 
 
@@ -40,7 +40,7 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
         else:
             vals, idx = jax.lax.top_k(-a_m, k)
             vals = -vals
-        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(convert_dtype("int64")), -1, ax)
     return apply(f, x)
 
 
@@ -80,7 +80,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
             out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
                 s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
             out = out.reshape(v.shape)
-        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+        return out.astype(jnp.int32 if out_int32 else convert_dtype("int64"))
     return apply(f, sorted_sequence, values)
 
 
@@ -94,7 +94,7 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
         srt = jnp.sort(a, axis=ax)
         idx = jnp.argsort(a, axis=ax, stable=True)
         vals = jnp.take(srt, k - 1, axis=ax)
-        inds = jnp.take(idx, k - 1, axis=ax).astype(jnp.int64)
+        inds = jnp.take(idx, k - 1, axis=ax).astype(convert_dtype("int64"))
         if keepdim:
             vals, inds = jnp.expand_dims(vals, ax), jnp.expand_dims(inds, ax)
         return vals, inds
@@ -114,7 +114,7 @@ def mode(x, axis=-1, keepdim=False, name=None):
             best_grp = jnp.argmax(counts)
             val = srt[jnp.argmax(grp == best_grp)]
             idx = row.shape[0] - 1 - jnp.argmax(jnp.flip(row == val))
-            return val, idx.astype(jnp.int64)
+            return val, idx.astype(convert_dtype("int64"))
         flat = a_m.reshape(-1, a_m.shape[-1])
         vals, idxs = jax.vmap(one)(flat)
         vals = vals.reshape(a_m.shape[:-1])

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
 from jax import lax
 
 from ..core.tensor import Tensor, apply
@@ -71,7 +73,7 @@ def _viterbi_raw(pot, trans, lengths, include_bos_eos_tag):
     # in general position t carries the tag chosen when scanning — mask below.
     pos = jnp.arange(L)[None, :]
     path = jnp.where(pos < lengths[:, None], tags_01, 0)
-    return scores, path.astype(jnp.int64)
+    return scores, path.astype(convert_dtype("int64"))
 
 
 def viterbi_decode(potentials, transition_params, lengths,

@@ -14,6 +14,11 @@ import paddle_tpu.nn as nn
 from paddle_tpu.core.device import local_devices
 from paddle_tpu.ops.moe import topk_gating, moe_dispatch, moe_combine, moe_ffn
 
+try:
+    from jax import shard_map  # jax>=0.8
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
 needs4 = pytest.mark.skipif(len(local_devices()) < 4, reason="needs 4 devices")
 
 
@@ -88,7 +93,6 @@ def test_expert_parallel_matches_single_device():
 
 @needs4
 def test_global_scatter_gather_roundtrip():
-    from jax.experimental.shard_map import shard_map
     from paddle_tpu.distributed.utils import global_scatter, global_gather
     mesh = Mesh(np.array(local_devices()[:4]), ("data",))
     r = np.random.RandomState(3)
@@ -139,7 +143,6 @@ def _ragged_oracle(xs, counts, W, El):
 
 @needs4
 def test_ragged_global_scatter_matches_oracle():
-    from jax.experimental.shard_map import shard_map
     from paddle_tpu.distributed.utils import ragged_global_scatter
     W, El, T, H = 4, 2, 12, 5
     mesh = Mesh(np.array(local_devices()[:W]), ("data",))
@@ -176,7 +179,6 @@ def test_ragged_global_scatter_matches_oracle():
 def test_ragged_scatter_gather_roundtrip_with_expert_transform():
     """Tokens go out ragged, each expert scales its tokens, results come back
     to the original rows — end-to-end EP compute with non-uniform routing."""
-    from jax.experimental.shard_map import shard_map
     from paddle_tpu.distributed.utils import (ragged_global_gather,
                                               ragged_global_scatter)
     W, El, T, H = 4, 2, 10, 3
@@ -232,7 +234,6 @@ def test_global_scatter_ragged_counts_raise():
 @needs4
 def test_ragged_scatter_small_block_raises():
     from paddle_tpu.distributed.utils import ragged_global_scatter
-    from jax.experimental.shard_map import shard_map
     import pytest as _pytest
     W, T, H = 4, 8, 3
     mesh = Mesh(np.array(local_devices()[:W]), ("data",))

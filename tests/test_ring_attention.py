@@ -10,7 +10,10 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+try:
+    from jax import shard_map  # jax>=0.8
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 
 from paddle_tpu.core.device import local_devices
 from paddle_tpu.ops.attention import dense_attention
