@@ -1447,3 +1447,32 @@ class TestDecompositionProperties:
                                    np.zeros((3, 2)), atol=1e-3)
         np.testing.assert_allclose(sol, np.linalg.lstsq(a, b, rcond=None)[0],
                                    rtol=1e-3, atol=1e-3)
+
+
+def test_einsum_equation_battery():
+    """Einsum over the reference test_einsum_op.py equation families."""
+    r = np.random.RandomState(11)
+    a2 = r.randn(3, 4).astype("float32")
+    b2 = r.randn(4, 5).astype("float32")
+    a3 = r.randn(2, 3, 4).astype("float32")
+    b3 = r.randn(2, 4, 5).astype("float32")
+    v = r.randn(4).astype("float32")
+    sq = r.randn(4, 4).astype("float32")
+    cases = [
+        ("ij,jk->ik", (a2, b2)),
+        ("bij,bjk->bik", (a3, b3)),
+        ("ij->ji", (a2,)),
+        ("ii->", (sq,)),            # trace
+        ("ii->i", (sq,)),           # diagonal
+        ("ij->", (a2,)),            # total sum
+        ("ij->j", (a2,)),           # column sum
+        ("i,i->", (v, v)),          # dot
+        ("i,j->ij", (v, v)),        # outer
+        ("ij,j->i", (a2, v)),       # matvec
+        ("bij,bik->bjk", (a3, r.randn(2, 3, 6).astype("float32"))),
+    ]
+    for eq, args in cases:
+        got = _to_np(paddle.einsum(eq, *[paddle.to_tensor(x) for x in args]))[0]
+        want = np.einsum(eq, *args)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5,
+                                   err_msg=eq)
