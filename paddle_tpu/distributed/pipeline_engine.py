@@ -35,7 +35,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import rng
-from .spmd import build_param_specs, build_state_shardings, spmd_pipeline
+from .spmd import (build_param_specs, build_state_shardings, spmd_pipeline,
+                   spmd_pipeline_interleaved)
 
 
 def make_pipeline_train_step(pipeline_layer, loss_fn, optimizer, hcg,
@@ -51,7 +52,7 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
                                head_loss_fn: Callable, params0, optimizer, hcg,
                                n_layers: int, n_microbatches: int,
                                stacked_keys, layer=None, donate: bool = True,
-                               remat: bool = True):
+                               remat: bool = True, virtual_pp_degree: int = 1):
     """Build the stacked-stage pipelined train step (tier 1).
 
     - embed_fn(params, x, key)        -> h            (replicated compute)
@@ -59,14 +60,23 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
     - head_loss_fn(params, h, labels) -> scalar loss  (replicated compute)
     - ``stacked_keys``: param names whose leading dim is n_layers (split
       over "pipe").
+    - ``virtual_pp_degree`` V > 1 selects the interleaved schedule (≙ the
+      reference's pp_configs virtual_pipeline_degree): the layer stack is
+      split into S*V chunks, device d holds chunks {v*S+d}, and the
+      fill/drain bubble shrinks by V (spmd.spmd_pipeline_interleaved).
 
     Returns (step, state0) with step(state, key, lr, x, labels) -> (state, loss).
     """
     mesh = hcg.mesh
     S = mesh.shape.get("pipe", 1)
-    assert n_layers % max(S, 1) == 0, "n_layers must divide pp degree"
+    V = max(int(virtual_pp_degree), 1) if S > 1 else 1  # serial path ignores V
+    assert n_layers % max(S * V, 1) == 0, \
+        "n_layers must divide pp degree * virtual_pp_degree"
     layers_per_stage = n_layers // max(S, 1)
     M = n_microbatches
+    if V > 1 and M % S:
+        raise ValueError(f"n_microbatches ({M}) must be a multiple of the "
+                         f"pp degree ({S}) when virtual_pp_degree > 1")
 
     # mark stacked params so build_param_specs shards dim0 over pipe
     if layer is not None:
@@ -98,20 +108,46 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
         # micro-batch the sequence of activations
         mb = h.reshape((M, h.shape[0] // M) + h.shape[1:])
 
-        if S > 1:
+        def run_blocks(hmb, blocks):
+            """Scan a stack of transformer blocks over the activations."""
+            def body(carry, sl):
+                fn = jax.checkpoint(block_fn) if remat else block_fn
+                return fn(sl, carry, key), None
+            out, _ = jax.lax.scan(body, hmb, blocks)
+            return out
+
+        if S > 1 and V > 1:
+            # interleaved: reshape the layer stack [L, ...] into per-device
+            # chunk-major [S, V, lpc, ...].  NOTE: params are stored
+            # stage-contiguous, so GSPMD inserts this re-layout all-to-all
+            # EVERY step (fwd gather + grad scatter).  Storing the state
+            # chunk-interleaved at init (permuted layer order + inverse on
+            # state_dict) would make it free; follow-up if pp profiling
+            # shows the traffic matters.
+            lpc = n_layers // (S * V)
+            block_params = {
+                k: params[k].reshape((V, S, lpc) + params[k].shape[1:])
+                            .swapaxes(0, 1)
+                for k in stacked_keys}
+
+            def chunk_fn(chunk_blocks, hmb, mb_idx, v):
+                return run_blocks(hmb, chunk_blocks)
+
+            def pipelined(blocks, mbs):
+                local = jax.tree_util.tree_map(
+                    lambda a: a.reshape(a.shape[1:]), blocks)  # [1,V,lpc]→[V,lpc]
+                return spmd_pipeline_interleaved(chunk_fn, local, mbs, S, V,
+                                                 axis="pipe")
+
+            out_mb = jax.shard_map(
+                pipelined, mesh=mesh,
+                in_specs=({k: P("pipe") for k in stacked_keys}, P()),
+                out_specs=P(), axis_names={"pipe"})(block_params, mb)
+        elif S > 1:
             block_params = {k: params[k] for k in stacked_keys}
-            other = {k: v for k, v in params.items() if k not in stacked_keys}
 
             def stage_fn(local_blocks, hmb, mb_idx):
-                def body(carry, sl):
-                    fn = block_fn
-                    if remat:
-                        fn = jax.checkpoint(block_fn)
-                    return fn(sl, carry, key), None
-                out, _ = jax.lax.scan(body, hmb,
-                                      jax.tree_util.tree_map(lambda v: v,
-                                                             local_blocks))
-                return out
+                return run_blocks(hmb, local_blocks)
 
             def pipelined(blocks, mbs):
                 return spmd_pipeline(stage_fn, blocks, mbs, S, axis="pipe")
@@ -124,12 +160,8 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
                 in_specs=({k: P("pipe") for k in stacked_keys}, P()),
                 out_specs=P(), axis_names={"pipe"})(block_params, mb)
         else:
-            def body(carry, sl):
-                fn = jax.checkpoint(block_fn) if remat else block_fn
-                return fn(sl, carry, key), None
-            out_mb, _ = jax.lax.scan(
-                body, mb.reshape((-1,) + mb.shape[2:]),
-                {k: params[k] for k in stacked_keys})
+            out_mb = run_blocks(mb.reshape((-1,) + mb.shape[2:]),
+                                {k: params[k] for k in stacked_keys})
             out_mb = out_mb.reshape(mb.shape[:2] + out_mb.shape[1:])
 
         h_out = out_mb.reshape((-1,) + out_mb.shape[2:])

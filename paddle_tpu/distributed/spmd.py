@@ -183,6 +183,70 @@ def spmd_pipeline(stage_fn: Callable, stage_params, microbatches, n_stages: int,
     return outputs
 
 
+def spmd_pipeline_interleaved(stage_fn: Callable, chunk_params, microbatches,
+                              n_stages: int, n_chunks: int, axis: str = "pipe",
+                              remat_ticks: bool = True):
+    """Megatron-style interleaved (virtual-pipeline) schedule as a lax.scan.
+
+    ≙ the reference's virtual_pipeline_degree path (pipeline_parallel.py
+    _forward_backward_pipeline interleaved branch; pp_layers.py
+    get_stage_from_index maps layer→(stage, chunk)).  Device ``d`` holds
+    ``V = n_chunks`` model chunks; chunk ``v`` on device ``d`` is global
+    stage ``g = v*S + d``.  Each scan tick executes ONE chunk (cost ≈ 1/V of
+    a non-interleaved stage) and one ring ``ppermute`` hop:
+
+    - slot count is ``M*V + S - 1`` chunk-slots, so fill+drain cost is
+      ``(S-1)/V`` stage-times instead of ``S-1`` — the bubble shrinks by the
+      virtual degree, same as the reference's interleaved 1F1B;
+    - the schedule is conflict-free: device-local clock ``w = u - d`` decodes
+      uniquely to ``(microbatch, chunk) = (q//V*S + w%S, q%V)``, ``q = w//S``
+      (requires ``M % S == 0``, the same constraint Megatron imposes);
+    - AD reverses the scan, so the backward sweep gets the same reduced
+      bubble; ``jax.checkpoint`` on the tick bounds live activations to one
+      micro-batch per slot.
+
+    ``stage_fn(chunk_local_params, x, mb_index, chunk_index) -> y``;
+    ``chunk_params``: device-local pytree with leading dim ``V``;
+    ``microbatches``: (M, mb, ...) meaningful on stage 0.  Returns
+    (M, mb, ...) finished outputs broadcast from the last stage.
+    """
+    M = microbatches.shape[0]
+    S, V = n_stages, n_chunks
+    if M % S:
+        raise ValueError(
+            f"n_microbatches ({M}) must be a multiple of the pipeline "
+            f"degree ({S}) for the interleaved schedule")
+    stage = jax.lax.axis_index(axis)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def tick(carry, u):
+        # device-local chunk clock; clipped decode is safe because inactive
+        # slots' outputs are never selected by an active receiver
+        w = jnp.clip(u - stage, 0, M * V - 1)
+        j = w % S
+        q = w // S
+        v = q % V
+        m = (q // V) * S + j
+        chp = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+            chunk_params)
+        inp = jnp.where((stage == 0) & (v == 0), microbatches[m], carry)
+        y = stage_fn(chp, inp, m, v)
+        return jax.lax.ppermute(y, axis, fwd_perm), y
+
+    if remat_ticks:
+        tick = jax.checkpoint(tick)
+    carry0 = ensure_varying(jnp.zeros_like(microbatches[0]), axis)
+    _, ys = jax.lax.scan(tick, carry0, jnp.arange(M * V + S - 1))
+    # micro-batch m = r*S + j leaves chunk V-1 on the last stage at slot
+    # u = S*V*(r+1) + j - 1  (w_out = j + S*(V-1) + S*V*r, u = w_out + S-1)
+    m_idx = jnp.arange(M)
+    out_slots = S * V * (m_idx // S + 1) + (m_idx % S) - 1
+    outputs = ys[out_slots]
+    outputs = jnp.where(stage == S - 1, outputs, jnp.zeros_like(outputs))
+    return jax.lax.psum(outputs, axis)
+
+
 # --------------------------------------------------------------------------
 # distributed train step builder
 # --------------------------------------------------------------------------

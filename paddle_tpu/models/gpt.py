@@ -279,7 +279,8 @@ def gpt_preset(name: str, **overrides) -> GPTConfig:
 
 def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1,
                         remat: bool = True, donate: bool = True,
-                        zero_stage: int = 0, dynamic_loss_scale: bool = False):
+                        zero_stage: int = 0, dynamic_loss_scale: bool = False,
+                        virtual_pp_degree: int = 1):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
@@ -314,7 +315,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
             model.embed_fn, model.block_fn, model.head_loss_fn, params0,
             optimizer, hcg, model.config.num_layers,
             max(n_microbatches, S), model.stacked_param_names(), layer=model,
-            donate=donate, remat=remat)
+            donate=donate, remat=remat, virtual_pp_degree=virtual_pp_degree)
 
     seq_spec = None
     if "sep" in mesh.shape and mesh.shape["sep"] > 1:
