@@ -75,3 +75,14 @@ define_flag("FLAGS_default_dtype", "float32", "Default floating point dtype.")
 define_flag("FLAGS_seed", 0, "Global random seed.")
 define_flag("FLAGS_eager_log_ops", False, "Log every eagerly dispatched op (debug tracing).")
 define_flag("FLAGS_benchmark", False, "Block on every eager op result (perf debugging).")
+define_flag("FLAGS_use_fused_ln", False,
+            "Route LN+residual+dropout through the Pallas kernel (ops/fused.py); "
+            "off by default — flip only where tools/fused_probe.py shows XLA "
+            "leaving step time on the table.")
+define_flag("FLAGS_fused_ln_interpret", False,
+            "Allow the fused-LN Pallas kernel in interpret mode off-TPU (tests).")
+define_flag("FLAGS_use_fused_adamw", False,
+            "Reserved for the flat fused AdamW sweep (ops/fused.py:"
+            "fused_adamw_flat — kernel shipped + tested; tree-level wiring "
+            "lands only if tools/fused_probe.py shows XLA's own fusion of the "
+            "update chain leaving >5% step time).")

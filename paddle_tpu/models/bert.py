@@ -154,13 +154,27 @@ class BertModel(Layer):
         else:
             att = dense_attention(q, k, v, mask=attn_mask, causal=False)
         att = att.reshape(B, Lq, H)
-        h = self._ln(h + att @ sl["blocks_proj_w"].astype(dt)
-                     + sl["blocks_proj_b"].astype(dt),
-                     sl["blocks_ln1_w"], sl["blocks_ln1_b"]).astype(dt)
+        from ..core.flags import flag as _flag
+
+        def epilogue(x, residual, ln_w, ln_b, bias):
+            """LN(residual + x + bias): Pallas fused epilogue (ops/fused.py ≙
+            fused_layernorm_residual_dropout_bias.h) when FLAGS_use_fused_ln,
+            else the plain _ln path — identical math up to fp32 rounding."""
+            if _flag("FLAGS_use_fused_ln"):
+                from ..ops.fused import fused_ln_residual_dropout
+                return fused_ln_residual_dropout(
+                    x, residual, ln_w, ln_b, bias=bias,
+                    eps=c.layer_norm_eps)[0].astype(dt)
+            return self._ln(residual + x + bias.astype(dt), ln_w, ln_b).astype(dt)
+
+        h = epilogue(att @ sl["blocks_proj_w"].astype(dt), h,
+                     sl["blocks_ln1_w"], sl["blocks_ln1_b"],
+                     sl["blocks_proj_b"])
         ff = jax.nn.gelu(h @ sl["blocks_fc1_w"].astype(dt)
                          + sl["blocks_fc1_b"].astype(dt), approximate=True)
-        ff = ff @ sl["blocks_fc2_w"].astype(dt) + sl["blocks_fc2_b"].astype(dt)
-        return self._ln(h + ff, sl["blocks_ln2_w"], sl["blocks_ln2_b"]).astype(dt)
+        return epilogue(ff @ sl["blocks_fc2_w"].astype(dt), h,
+                        sl["blocks_ln2_w"], sl["blocks_ln2_b"],
+                        sl["blocks_fc2_b"])
 
     def scan_blocks(self, params, h, attn_mask=None, remat=True):
         stacked = {k: params[k] for k in self.stacked_param_names()}

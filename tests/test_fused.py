@@ -1,0 +1,166 @@
+"""Fused LN+residual+dropout and fused AdamW kernels (ops/fused.py) vs their
+plain-XLA oracles.  ≙ reference unittests/test_fused_layernorm_residual_
+dropout_bias.py (oracle = unfused composition) and multi_tensor_adam checks.
+Pallas runs in interpret mode on CPU (FLAGS_fused_ln_interpret)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.ops.fused import (_dense_ln_residual_dropout,
+                                  fused_adamw_flat,
+                                  fused_ln_residual_dropout)
+
+
+@pytest.fixture
+def kernel_on():
+    set_flags({"FLAGS_use_fused_ln": True, "FLAGS_fused_ln_interpret": True})
+    yield
+    set_flags({"FLAGS_use_fused_ln": False, "FLAGS_fused_ln_interpret": False})
+
+
+def _inputs(dtype, B=2, L=32, H=64, seed=0):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(B, L, H), dtype)
+    res = jnp.asarray(rs.randn(B, L, H), dtype)
+    w = jnp.asarray(rs.rand(H) + 0.5, jnp.float32)
+    b = jnp.asarray(rs.randn(H) * 0.1, jnp.float32)
+    bias = jnp.asarray(rs.randn(H) * 0.1, jnp.float32)
+    return x, res, w, b, bias
+
+
+class TestFusedLN:
+    def test_fwd_matches_dense_fp32(self, kernel_on):
+        x, res, w, b, bias = _inputs(jnp.float32)
+        out, rout = fused_ln_residual_dropout(x, res, w, b, bias=bias)
+        oute, route = _dense_ln_residual_dropout(x, res, w, b, bias, 0, 0.0,
+                                                 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(oute),
+                                   rtol=2e-6, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(rout), np.asarray(route),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_fwd_matches_dense_bf16(self, kernel_on):
+        x, res, w, b, bias = _inputs(jnp.bfloat16)
+        out, rout = fused_ln_residual_dropout(x, res, w, b, bias=bias)
+        oute, _ = _dense_ln_residual_dropout(x, res, w, b, bias, 0, 0.0, 1e-5)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(oute, np.float32),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_grads_match_dense(self, kernel_on):
+        x, res, w, b, bias = _inputs(jnp.float32)
+
+        def loss_pallas(x, res, w, b, bias):
+            out, rout = fused_ln_residual_dropout(x, res, w, b, bias=bias)
+            return jnp.sum(out * out) + jnp.sum(jnp.sin(rout))
+
+        def loss_dense(x, res, w, b, bias):
+            out, rout = _dense_ln_residual_dropout(x, res, w, b, bias, 0, 0.0,
+                                                   1e-5)
+            return jnp.sum(out * out) + jnp.sum(jnp.sin(rout))
+
+        gp = jax.grad(loss_pallas, argnums=(0, 1, 2, 3, 4))(x, res, w, b, bias)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2, 3, 4))(x, res, w, b, bias)
+        for a, e, name in zip(gp, gd, ("dx", "dres", "dw", "db", "dbias")):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                       rtol=2e-4, atol=2e-5, err_msg=name)
+
+    def test_dropout_parity_and_grad_mask(self, kernel_on):
+        """Same seed ⇒ Pallas and dense paths drop the same positions, and
+        dropped positions get exactly zero dx."""
+        x, res, w, b, _ = _inputs(jnp.float32)
+        p, seed = 0.4, jnp.uint32(7)
+        out, rout = fused_ln_residual_dropout(x, res, w, b, dropout_p=p,
+                                              dropout_seed=seed)
+        oute, route = _dense_ln_residual_dropout(x, res, w, b, None, seed, p,
+                                                 1e-5)
+        np.testing.assert_allclose(np.asarray(rout), np.asarray(route),
+                                   rtol=2e-6, atol=2e-6)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(oute),
+                                   rtol=2e-5, atol=2e-5)
+        # dropped positions: residual_out == residual exactly
+        dropped = np.asarray(rout) == np.asarray(res)
+        assert 0.2 < dropped.mean() < 0.6  # ~p of positions
+
+        dx = jax.grad(lambda xx: jnp.sum(
+            fused_ln_residual_dropout(xx, res, w, b, dropout_p=p,
+                                      dropout_seed=seed)[0] ** 2))(x)
+        assert np.all(np.asarray(dx)[dropped] == 0.0)
+        assert np.any(np.asarray(dx)[~dropped] != 0.0)
+
+    def test_dropout_requires_seed(self):
+        x, res, w, b, _ = _inputs(jnp.float32)
+        with pytest.raises(ValueError, match="dropout_seed"):
+            fused_ln_residual_dropout(x, res, w, b, dropout_p=0.1)
+
+    def test_fallback_without_flag(self):
+        """Flag off ⇒ dense path (still correct, no pallas tracing)."""
+        x, res, w, b, bias = _inputs(jnp.float32)
+        out, _ = fused_ln_residual_dropout(x, res, w, b, bias=bias)
+        oute, _ = _dense_ln_residual_dropout(x, res, w, b, bias, 0, 0.0, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(oute),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestFusedAdamW:
+    def test_matches_reference_formula(self):
+        rs = np.random.RandomState(1)
+        n = 10000  # not a multiple of the block: exercises padding
+        p = jnp.asarray(rs.randn(n), jnp.float32)
+        g = jnp.asarray(rs.randn(n), jnp.float32)
+        m = jnp.asarray(rs.randn(n) * 0.1, jnp.float32)
+        v = jnp.asarray(rs.rand(n) * 0.01, jnp.float32)
+        lr, b1, b2, eps, wd, step = 1e-3, 0.9, 0.999, 1e-8, 0.01, 3
+
+        po, mo, vo = fused_adamw_flat(p, g, m, v, step, lr, b1, b2, eps, wd,
+                                      block=4096)
+
+        me = b1 * np.asarray(m) + (1 - b1) * np.asarray(g)
+        ve = b2 * np.asarray(v) + (1 - b2) * np.asarray(g) ** 2
+        mhat = me / (1 - b1 ** step)
+        vhat = ve / (1 - b2 ** step)
+        pe = np.asarray(p) - lr * (mhat / (np.sqrt(vhat) + eps)
+                                   + wd * np.asarray(p))
+        # fp32 rounding-order differences only (kernel keeps fp32 throughout)
+        np.testing.assert_allclose(np.asarray(po), pe, rtol=3e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(mo), me, rtol=3e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vo), ve, rtol=3e-5, atol=1e-6)
+
+    def test_jit_and_odd_sizes(self):
+        for n in (1, 127, 4096):
+            p = jnp.ones((n,), jnp.float32)
+            g = jnp.full((n,), 0.5, jnp.float32)
+            m = jnp.zeros((n,), jnp.float32)
+            v = jnp.zeros((n,), jnp.float32)
+            po, mo, vo = jax.jit(
+                lambda p, g, m, v: fused_adamw_flat(p, g, m, v, 1, 0.1))(
+                    p, g, m, v)
+            assert po.shape == (n,)
+            assert np.all(np.asarray(po) < 1.0)
+
+
+class TestModelWiring:
+    def test_bert_block_fused_matches_plain(self, kernel_on):
+        """BERT encode with FLAGS_use_fused_ln on (interpret Pallas) matches
+        the plain _ln path — the wiring is numerics-preserving."""
+        from paddle_tpu.models.bert import BertConfig, BertModel
+
+        paddle.seed(11)
+        cfg = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=2, intermediate_size=64,
+                         max_position_embeddings=32, compute_dtype="float32",
+                         use_flash_attention=False)
+        model = BertModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        ids = jnp.asarray(np.random.RandomState(0).randint(0, 64, (2, 16)))
+
+        fused = model.encode(params, ids)
+        set_flags({"FLAGS_use_fused_ln": False})
+        plain = model.encode(params, ids)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(plain),
+                                   rtol=2e-5, atol=2e-5)
