@@ -1,0 +1,266 @@
+// TCP key-value store for multi-host rendezvous/elastic membership.
+//
+// ≙ the reference's etcd dependency (fleet/elastic/manager.py:130 uses an
+// etcd client for host registration + heartbeat leases; gen_comm_id_helper.cc
+// bootstraps NCCL ids over raw TCP).  TPU pods have no ambient etcd, so the
+// framework carries its own single-binary store: the launcher's rank-0 hosts
+// it in-process, every worker dials it.  Original poll()-based design — one
+// thread, no dependencies.
+//
+// Wire protocol (little-endian, persistent connections, pipelined):
+//   request : u8 op | u32 klen | u32 vlen | key bytes | val bytes
+//   response: u8 status (0 ok, 1 missing) | u32 vlen | val bytes
+//   ops: 0 SET (resp empty)            1 GET (resp value or missing)
+//        2 ADD (val = i64 delta; stored value is i64; resp new i64)
+//        3 WAIT (no resp until key exists; resp value once set)
+//        4 DEL (resp empty)            5 LIST (key = prefix; resp value is
+//              a packed sequence of u32 klen|key|u32 vlen|val entries)
+//
+// Exported C API (ctypes):
+//   void* kv_server_start(int port)   // port 0 = auto-assign
+//   int   kv_server_port(void*)
+//   void  kv_server_stop(void*)
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <pthread.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Conn {
+  int fd;
+  std::string rbuf;   // bytes read, not yet parsed
+  std::string wbuf;   // bytes to write
+  bool closing = false;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  pthread_t thread{};
+  std::map<int, Conn> conns;
+  std::map<std::string, std::string> kv;
+  std::map<std::string, std::vector<int>> waiters;  // key -> fds parked in WAIT
+};
+
+void put_u32(std::string* s, uint32_t v) { s->append((const char*)&v, 4); }
+
+void respond(Conn* c, uint8_t status, const std::string& val) {
+  c->wbuf.push_back((char)status);
+  put_u32(&c->wbuf, (uint32_t)val.size());
+  c->wbuf += val;
+}
+
+// Parse and execute every complete request in c->rbuf.  Returns false on a
+// malformed frame (connection is then closed).
+bool handle_requests(Server* srv, Conn* c) {
+  for (;;) {
+    if (c->rbuf.size() < 9) return true;
+    const char* p = c->rbuf.data();
+    uint8_t op = (uint8_t)p[0];
+    uint32_t klen, vlen;
+    memcpy(&klen, p + 1, 4);
+    memcpy(&vlen, p + 5, 4);
+    if (klen > (1u << 20) || vlen > (1u << 26)) return false;  // sanity caps
+    size_t need = 9 + (size_t)klen + vlen;
+    if (c->rbuf.size() < need) return true;
+    std::string key(p + 9, klen);
+    std::string val(p + 9 + klen, vlen);
+    c->rbuf.erase(0, need);
+
+    switch (op) {
+      case 0: {  // SET
+        srv->kv[key] = val;
+        respond(c, 0, "");
+        auto w = srv->waiters.find(key);
+        if (w != srv->waiters.end()) {
+          for (int wfd : w->second) {
+            auto it = srv->conns.find(wfd);
+            if (it != srv->conns.end()) respond(&it->second, 0, val);
+          }
+          srv->waiters.erase(w);
+        }
+        break;
+      }
+      case 1: {  // GET
+        auto it = srv->kv.find(key);
+        if (it == srv->kv.end()) respond(c, 1, "");
+        else respond(c, 0, it->second);
+        break;
+      }
+      case 2: {  // ADD
+        if (val.size() != 8) return false;
+        int64_t delta;
+        memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        auto it = srv->kv.find(key);
+        if (it != srv->kv.end() && it->second.size() == 8)
+          memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::string stored((const char*)&cur, 8);
+        srv->kv[key] = stored;
+        respond(c, 0, stored);
+        auto w = srv->waiters.find(key);  // ADD also satisfies waiters
+        if (w != srv->waiters.end()) {
+          for (int wfd : w->second) {
+            auto it2 = srv->conns.find(wfd);
+            if (it2 != srv->conns.end()) respond(&it2->second, 0, stored);
+          }
+          srv->waiters.erase(w);
+        }
+        break;
+      }
+      case 3: {  // WAIT
+        auto it = srv->kv.find(key);
+        if (it != srv->kv.end()) respond(c, 0, it->second);
+        else srv->waiters[key].push_back(c->fd);
+        break;
+      }
+      case 4: {  // DEL
+        srv->kv.erase(key);
+        respond(c, 0, "");
+        break;
+      }
+      case 5: {  // LIST by prefix
+        std::string out;
+        for (auto it = srv->kv.lower_bound(key); it != srv->kv.end(); ++it) {
+          if (it->first.compare(0, key.size(), key) != 0) break;
+          put_u32(&out, (uint32_t)it->first.size());
+          out += it->first;
+          put_u32(&out, (uint32_t)it->second.size());
+          out += it->second;
+        }
+        respond(c, 0, out);
+        break;
+      }
+      default:
+        return false;
+    }
+  }
+}
+
+void drop_conn(Server* srv, int fd) {
+  for (auto& kvp : srv->waiters) {
+    auto& v = kvp.second;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+  }
+  close(fd);
+  srv->conns.erase(fd);
+}
+
+void* serve_loop(void* arg) {
+  Server* srv = (Server*)arg;
+  std::vector<pollfd> pfds;
+  char buf[65536];
+  while (!srv->stop.load()) {
+    pfds.clear();
+    pfds.push_back({srv->listen_fd, POLLIN, 0});
+    for (auto& kvp : srv->conns) {
+      short ev = POLLIN;
+      if (!kvp.second.wbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({kvp.first, ev, 0});
+    }
+    int rc = poll(pfds.data(), pfds.size(), 200 /*ms: bounded stop latency*/);
+    if (rc < 0) continue;
+    if (pfds[0].revents & POLLIN) {
+      int fd = accept(srv->listen_fd, nullptr, nullptr);
+      if (fd >= 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        // non-blocking: a slow client that stops reading must get EAGAIN,
+        // not stall the single server thread pod-wide
+        fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+        srv->conns[fd] = Conn{fd};
+      }
+    }
+    std::vector<int> dead;
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      int fd = pfds[i].fd;
+      auto it = srv->conns.find(fd);
+      if (it == srv->conns.end()) continue;
+      Conn* c = &it->second;
+      if (pfds[i].revents & (POLLERR | POLLHUP)) { dead.push_back(fd); continue; }
+      if (pfds[i].revents & POLLIN) {
+        ssize_t n = recv(fd, buf, sizeof(buf), 0);
+        if (n == 0 ||
+            (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+          dead.push_back(fd);
+          continue;
+        }
+        if (n > 0) {
+          c->rbuf.append(buf, n);
+          if (!handle_requests(srv, c)) { dead.push_back(fd); continue; }
+        }
+      }
+      if (!c->wbuf.empty()) {
+        ssize_t n = send(fd, c->wbuf.data(), c->wbuf.size(), MSG_NOSIGNAL);
+        if (n > 0) c->wbuf.erase(0, n);
+        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+          dead.push_back(fd);
+      }
+    }
+    for (int fd : dead) drop_conn(srv, fd);
+  }
+  for (auto& kvp : srv->conns) close(kvp.first);
+  srv->conns.clear();
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_server_start(int port) {
+  Server* srv = new Server();
+  srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) { delete srv; return nullptr; }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(srv->listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(srv->listen_fd, 128) != 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(srv->listen_fd, (sockaddr*)&addr, &alen);
+  srv->port = ntohs(addr.sin_port);
+  if (pthread_create(&srv->thread, nullptr, serve_loop, srv) != 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  return srv;
+}
+
+int kv_server_port(void* h) { return h ? ((Server*)h)->port : -1; }
+
+void kv_server_stop(void* h) {
+  if (!h) return;
+  Server* srv = (Server*)h;
+  srv->stop.store(true);
+  pthread_join(srv->thread, nullptr);
+  close(srv->listen_fd);
+  delete srv;
+}
+
+}  // extern "C"

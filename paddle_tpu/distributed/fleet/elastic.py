@@ -5,11 +5,10 @@ registration, heartbeat lease (:250), node-change watch (:234), two levels
 (fault-tolerant restart vs true scale-in/out :178), exit-code protocol
 (101 restart, 102 rescale).
 
-TPU-native: membership lives in a shared-filesystem store (GCS/NFS path —
-etcd is not a TPU-pod given) with per-host heartbeat files.  NOTE: with a
-plain local-disk ``store_dir`` this spans a single host only; multi-host
-elasticity requires pointing it at a genuinely shared mount (GCS fuse/NFS),
-which is the TPU-pod deployment shape.  A scale event
+TPU-native: membership lives in a pluggable store (distributed/store.py) —
+either a shared-filesystem directory (GCS fuse / NFS, the TPU-pod deployment
+shape) or the native TCP store (csrc/kv_store.cpp) at ``tcp://master:port``,
+which spans hosts with no shared mount or etcd.  A scale event
 maps to *checkpoint → exit(101) → relaunch → re-compile with the new mesh*,
 because XLA programs are specialized on mesh shape (re-compile ≙ the
 reference's program re-build after env rewrite).  The launcher
@@ -19,10 +18,11 @@ reference's program re-build after env rewrite).  The launcher
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 from typing import Callable, List, Optional
+
+from ..store import make_store
 
 ELASTIC_EXIT_CODE = 101      # relaunch with same world
 RESCALE_EXIT_CODE = 102      # relaunch with new world size
@@ -30,25 +30,30 @@ RESCALE_EXIT_CODE = 102      # relaunch with new world size
 ElasticLevel = type("ElasticLevel", (), {"FAULT_TOLERANCE": 1, "ELASTIC": 2})
 
 
-def read_alive_ranks(store_dir: str, ttl: float,
+def read_alive_ranks(store_target, ttl: float,
                      now: Optional[float] = None) -> List[int]:
-    """Ranks with a fresh heartbeat lease in ``store_dir`` (shared between
-    ElasticManager and the launcher so membership logic cannot drift)."""
+    """Ranks with a fresh heartbeat lease (shared between ElasticManager and
+    the launcher so membership logic cannot drift).  ``store_target``: a
+    directory, a ``tcp://`` URL, or an already-constructed store object."""
+    store = make_store(store_target) if isinstance(store_target, str) \
+        else store_target
     now = time.time() if now is None else now
     out = []
     try:
-        names = os.listdir(store_dir)
-    except OSError:
+        entries = store.list_prefix("host-")
+    except Exception:
+        # transient store outage degrades to "nobody visible" — the caller's
+        # too-few-alive path then checkpoints and exits 101 (same behavior the
+        # file backend had when the dir was unreadable)
         return out
-    for fn in names:
-        if not fn.startswith("host-") or not fn.endswith(".json"):
+    for key, raw in entries.items():
+        if not key.endswith(".json"):
             continue
         try:
-            with open(os.path.join(store_dir, fn)) as f:
-                rec = json.load(f)
+            rec = json.loads(raw.decode())
             if now - rec["ts"] <= ttl:
                 out.append(int(rec["rank"]))
-        except (OSError, ValueError, KeyError):
+        except (ValueError, KeyError):
             continue
     return sorted(out)
 
@@ -61,6 +66,7 @@ class ElasticManager:
                  lease_ttl: float = 10.0):
         from .. import env
         self.store_dir = store_dir
+        self.store = make_store(store_dir)  # dir or tcp://host:port
         self.rank = env.get_rank() if rank is None else rank
         self.interval = heartbeat_interval
         self.ttl = lease_ttl
@@ -72,11 +78,10 @@ class ElasticManager:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._world_at_start: Optional[List[int]] = None
-        os.makedirs(store_dir, exist_ok=True)
 
     # ------------------------------------------------------------ membership
-    def _hb_path(self, rank: int) -> str:
-        return os.path.join(self.store_dir, f"host-{rank}.json")
+    def _hb_key(self, rank: int) -> str:
+        return f"host-{rank}.json"
 
     def register(self):
         """Write this host's heartbeat file and start the lease thread
@@ -95,17 +100,18 @@ class ElasticManager:
         return self._world_at_start
 
     def _beat(self):
-        tmp = self._hb_path(self.rank) + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"rank": self.rank, "ts": time.time()}, f)
-        os.replace(tmp, self._hb_path(self.rank))
+        rec = json.dumps({"rank": self.rank, "ts": time.time()})
+        self.store.set(self._hb_key(self.rank), rec.encode())
 
     def _loop(self):
         while not self._stop.wait(self.interval):
-            self._beat()
+            try:
+                self._beat()  # transient store outage must not kill the lease
+            except Exception:
+                continue      # TCPStore redials on the next attempt
 
     def alive_ranks(self, now: Optional[float] = None) -> List[int]:
-        return read_alive_ranks(self.store_dir, self.ttl, now)
+        return read_alive_ranks(self.store, self.ttl, now)
 
     # ------------------------------------------------------------- decisions
     def world_changed(self) -> bool:
@@ -153,6 +159,6 @@ class ElasticManager:
         if self._thread is not None:
             self._thread.join(timeout=self.interval * 2)
         try:
-            os.remove(self._hb_path(self.rank))
+            self.store.delete(self._hb_key(self.rank))
         except OSError:
             pass

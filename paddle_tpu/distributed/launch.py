@@ -101,7 +101,9 @@ def _rescaled_world(args, world: int, nproc: int):
     with fresh heartbeat leases.  Without a store we can only restart with
     the same world (and say so).
     """
-    if not args.elastic_store or not os.path.isdir(args.elastic_store):
+    is_tcp = str(args.elastic_store or "").startswith("tcp://")
+    if not args.elastic_store or (not is_tcp and
+                                  not os.path.isdir(args.elastic_store)):
         print("[launch] RESCALE requested but no --elastic_store; "
               "relaunching with unchanged world", file=sys.stderr)
         return world, nproc

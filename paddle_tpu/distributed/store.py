@@ -1,0 +1,248 @@
+"""Rendezvous / membership stores.
+
+Reference: fleet/elastic/manager.py:130 (etcd client: host registration,
+heartbeat leases, watches) and the raw-TCP NCCL-id bootstrap
+(gen_comm_id_helper.cc).  Two backends behind one interface:
+
+- ``FileStore`` — a directory on a shared mount (GCS fuse / NFS); the
+  original single-host/shared-fs path.
+- ``TCPStore`` — client for the native store server (csrc/kv_store.cpp), a
+  single C++ poll-loop the launcher's rank-0 hosts in-process.  This is the
+  multi-host path: workers dial ``tcp://master:port`` — no etcd, no shared
+  filesystem needed.
+
+``make_store("tcp://host:port" | "/some/dir")`` picks the backend; the
+elastic manager and launcher accept either form.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+_OPS = {"SET": 0, "GET": 1, "ADD": 2, "WAIT": 3, "DEL": 4, "LIST": 5}
+
+
+class StoreServer:
+    """In-process native TCP store server (rank-0 side).  port=0 auto-picks;
+    read the bound port from ``.port``."""
+
+    def __init__(self, port: int = 0):
+        from ..csrc import load_library
+        self._lib = load_library("kv_store")
+        self._lib.kv_server_start.restype = ctypes.c_void_p
+        self._lib.kv_server_start.argtypes = [ctypes.c_int]
+        self._lib.kv_server_port.restype = ctypes.c_int
+        self._lib.kv_server_port.argtypes = [ctypes.c_void_p]
+        self._lib.kv_server_stop.argtypes = [ctypes.c_void_p]
+        self._handle = self._lib.kv_server_start(port)
+        if not self._handle:
+            raise OSError(f"kv_store server failed to bind port {port}")
+        self.port = self._lib.kv_server_port(self._handle)
+
+    def stop(self):
+        if self._handle:
+            self._lib.kv_server_stop(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC ordering
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+_UNSET = object()  # wait(timeout=None) must mean "block forever"
+
+
+class TCPStore:
+    """Client for the native store.  Thread-safe (one lock per connection);
+    WAIT blocks server-side, so no polling traffic.  A request that dies
+    mid-flight (timeout / connection error) poisons the framing of the
+    persistent connection, so the socket is dropped and redialed on the next
+    request — the server unparks any WAIT this fd held when it sees the
+    close."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self.addr = (host, int(port))
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        with self._lock:
+            self._dial(timeout)
+
+    def _dial(self, timeout: float):
+        deadline = time.time() + timeout
+        last_err: Optional[Exception] = None
+        while True:  # the server may still be coming up on rank 0
+            try:
+                self._sock = socket.create_connection(self.addr, timeout=5.0)
+                break
+            except OSError as e:
+                last_err = e
+                if time.time() >= deadline:
+                    raise TimeoutError(
+                        f"store at {self.addr[0]}:{self.addr[1]} "
+                        f"unreachable: {last_err}")
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    # ------------------------------------------------------------- wire I/O
+    def _recv_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("store connection closed")
+            out += chunk
+        return out
+
+    def _request(self, op: str, key: bytes, val: bytes = b"",
+                 timeout=_UNSET) -> Tuple[int, bytes]:
+        with self._lock:
+            if self._sock is None:
+                self._dial(self.timeout)
+            try:
+                self._sock.settimeout(
+                    self.timeout if timeout is _UNSET else timeout)
+                self._sock.sendall(
+                    struct.pack("<BII", _OPS[op], len(key), len(val))
+                    + key + val)
+                status = self._recv_exact(1)[0]
+                (vlen,) = struct.unpack("<I", self._recv_exact(4))
+                return status, self._recv_exact(vlen)
+            except (OSError, ConnectionError):
+                # mid-request failure ⇒ unknown framing state: drop the conn
+                try:
+                    self._sock.close()
+                finally:
+                    self._sock = None
+                raise
+
+    # ------------------------------------------------------------ store API
+    def set(self, key: str, value: bytes):
+        self._request("SET", key.encode(), value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        status, val = self._request("GET", key.encode())
+        return None if status else val
+
+    def add(self, key: str, delta: int = 1) -> int:
+        _, val = self._request("ADD", key.encode(), struct.pack("<q", delta))
+        return struct.unpack("<q", val)[0]
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        _, val = self._request("WAIT", key.encode(), timeout=timeout)
+        return val
+
+    def delete(self, key: str):
+        self._request("DEL", key.encode())
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        _, buf = self._request("LIST", prefix.encode())
+        out, off = {}, 0
+        while off < len(buf):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            key = buf[off + 4:off + 4 + klen].decode()
+            off += 4 + klen
+            (vlen,) = struct.unpack_from("<I", buf, off)
+            out[key] = buf[off + 4:off + 4 + vlen]
+            off += 4 + vlen
+        return out
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class FileStore:
+    """Directory-backed store with the same API (single host / shared mount)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.path, key)
+
+    def set(self, key: str, value: bytes):
+        tmp = self._p(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.replace(tmp, self._p(key))
+
+    def get(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._p(key), "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def add(self, key: str, delta: int = 1) -> int:
+        # advisory-locked read-modify-write (single host: O_EXCL lock file)
+        lock = self._p(key) + ".lock"
+        deadline = time.time() + 10.0
+        while True:
+            try:
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                break
+            except FileExistsError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"store lock stuck: {lock}")
+                time.sleep(0.01)
+        try:
+            cur = self.get(key)
+            new = (struct.unpack("<q", cur)[0] if cur else 0) + delta
+            self.set(key, struct.pack("<q", new))
+            return new
+        finally:
+            os.unlink(lock)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            val = self.get(key)
+            if val is not None:
+                return val
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError(f"wait({key!r}) timed out")
+            time.sleep(0.05)
+
+    def delete(self, key: str):
+        try:
+            os.unlink(self._p(key))
+        except OSError:
+            pass
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        out = {}
+        try:
+            names = os.listdir(self.path)
+        except OSError:
+            return out
+        for fn in names:
+            if fn.startswith(prefix) and not fn.endswith((".tmp", ".lock")):
+                val = self.get(fn)
+                if val is not None:
+                    out[fn] = val
+        return out
+
+    def close(self):
+        pass
+
+
+def make_store(target: str, timeout: float = 60.0):
+    """``tcp://host:port`` → TCPStore; anything else → FileStore(dir)."""
+    if target.startswith("tcp://"):
+        hostport = target[len("tcp://"):]
+        host, _, port = hostport.rpartition(":")
+        return TCPStore(host or "127.0.0.1", int(port), timeout=timeout)
+    return FileStore(target)

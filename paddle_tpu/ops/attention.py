@@ -73,11 +73,15 @@ def dense_attention(q, k, v, mask=None, causal=False, scale=None, dropout_p=0.0,
 # no CPU lowering.
 # ---------------------------------------------------------------------------
 
-def _dropout_keep(seed, bh, q0, k0, shape, dropout_p):
-    rows = jnp.uint32(q0) + lax.broadcasted_iota(jnp.uint32, shape, 0)
-    cols = jnp.uint32(k0) + lax.broadcasted_iota(jnp.uint32, shape, 1)
+def position_hash_keep(mixed_seed, row0, col0, shape, dropout_p):
+    """Shared keep-mask core: murmur3-finalize hash((row, col) ⊕ mixed_seed)
+    ≥ p·2³².  ``mixed_seed`` is a uint32 scalar the caller pre-mixes with any
+    extra coordinates (head index etc.); both the attention and fused-LN
+    kernels use this one pipeline so the RNG cannot diverge between them."""
+    rows = jnp.uint32(row0) + lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = jnp.uint32(col0) + lax.broadcasted_iota(jnp.uint32, shape, 1)
     x = (rows * jnp.uint32(0x9E3779B1)) ^ (cols * jnp.uint32(0x85EBCA77))
-    x = x ^ (seed.astype(jnp.uint32) + jnp.uint32(bh) * jnp.uint32(0xC2B2AE3D))
+    x = x ^ mixed_seed
     x = x ^ (x >> 16)
     x = x * jnp.uint32(0x85EBCA6B)
     x = x ^ (x >> 13)
@@ -85,6 +89,11 @@ def _dropout_keep(seed, bh, q0, k0, shape, dropout_p):
     x = x ^ (x >> 16)
     thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
     return x >= thresh
+
+
+def _dropout_keep(seed, bh, q0, k0, shape, dropout_p):
+    mixed = seed.astype(jnp.uint32) + jnp.uint32(bh) * jnp.uint32(0xC2B2AE3D)
+    return position_hash_keep(mixed, q0, k0, shape, dropout_p)
 
 
 # ---------------------------------------------------------------------------

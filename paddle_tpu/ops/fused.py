@@ -38,19 +38,11 @@ def _use_pallas() -> bool:
 
 def _keep_mask(seed, row0, shape, dropout_p):
     """Position-hash keep mask (rows are global row ids, cols feature ids) —
-    identical bits in forward and backward by construction (same scheme as
-    ops/attention.py:_dropout_keep)."""
-    rows = jnp.uint32(row0) + lax.broadcasted_iota(jnp.uint32, shape, 0)
-    cols = lax.broadcasted_iota(jnp.uint32, shape, 1)
-    x = (rows * jnp.uint32(0x9E3779B1)) ^ (cols * jnp.uint32(0x85EBCA77))
-    x = x ^ (seed.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x85EBCA6B)
-    x = x ^ (x >> 13)
-    x = x * jnp.uint32(0xC2B2AE35)
-    x = x ^ (x >> 16)
-    thresh = jnp.uint32(min(int(dropout_p * 4294967296.0), 4294967295))
-    return x >= thresh
+    identical bits in forward and backward by construction; one shared hash
+    pipeline with the attention kernels (ops/attention.py)."""
+    from .attention import position_hash_keep
+    mixed = seed.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)
+    return position_hash_keep(mixed, row0, 0, shape, dropout_p)
 
 
 # ---------------------------------------------------------------------------

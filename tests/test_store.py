@@ -1,0 +1,179 @@
+"""Native TCP store (csrc/kv_store.cpp + distributed/store.py) and the
+elastic manager over it.  ≙ reference fleet/elastic/manager.py etcd flows
+(registration, heartbeat lease, membership watch) and gen_comm_id_helper.cc's
+TCP rendezvous — here against the framework's own single-binary store."""
+
+import json
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import (FileStore, StoreServer, TCPStore,
+                                          make_store)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = StoreServer(port=0)
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def store(server):
+    st = TCPStore("127.0.0.1", server.port, timeout=10.0)
+    yield st
+    st.close()
+
+
+class TestTCPStore:
+    def test_set_get_delete(self, store):
+        assert store.get("missing") is None
+        store.set("k1", b"hello")
+        assert store.get("k1") == b"hello"
+        store.set("k1", b"world")          # overwrite
+        assert store.get("k1") == b"world"
+        store.delete("k1")
+        assert store.get("k1") is None
+
+    def test_add_atomic_counter(self, server):
+        stores = [TCPStore("127.0.0.1", server.port) for _ in range(4)]
+        results = []
+
+        def bump(st):
+            for _ in range(25):
+                results.append(st.add("ctr"))
+
+        threads = [threading.Thread(target=bump, args=(s,)) for s in stores]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # 100 increments, every value unique, final == 100
+        assert sorted(results) == list(range(1, 101))
+        assert stores[0].add("ctr", 0) == 100
+        for s in stores:
+            s.close()
+
+    def test_wait_blocks_until_set(self, server):
+        waiter = TCPStore("127.0.0.1", server.port)
+        setter = TCPStore("127.0.0.1", server.port)
+        got = {}
+
+        def wait():
+            got["val"] = waiter.wait("gate", timeout=10.0)
+
+        t = threading.Thread(target=wait)
+        t.start()
+        time.sleep(0.3)
+        assert t.is_alive()                 # parked server-side, no value yet
+        setter.set("gate", b"open")
+        t.join(timeout=5.0)
+        assert got["val"] == b"open"
+        waiter.close()
+        setter.close()
+
+    def test_wait_existing_returns_immediately(self, store):
+        store.set("ready", b"1")
+        t0 = time.time()
+        assert store.wait("ready", timeout=5.0) == b"1"
+        assert time.time() - t0 < 1.0
+
+    def test_list_prefix(self, store):
+        for i in range(3):
+            store.set(f"pfx-{i}", str(i).encode())
+        store.set("other", b"x")
+        got = store.list_prefix("pfx-")
+        assert got == {"pfx-0": b"0", "pfx-1": b"1", "pfx-2": b"2"}
+
+    def test_large_value_roundtrip(self, store):
+        blob = np.random.RandomState(0).bytes(1 << 20)  # 1 MiB
+        store.set("blob", blob)
+        assert store.get("blob") == blob
+
+    def test_make_store_url(self, server):
+        st = make_store(f"tcp://127.0.0.1:{server.port}")
+        assert isinstance(st, TCPStore)
+        st.set("via-url", b"y")
+        assert st.get("via-url") == b"y"
+        st.close()
+
+
+class TestFileStoreParity:
+    """FileStore implements the same contract (dir backend)."""
+
+    def test_same_contract(self, tmp_path):
+        st = FileStore(str(tmp_path))
+        assert st.get("nope") is None
+        st.set("a", b"1")
+        assert st.get("a") == b"1"
+        assert st.add("n", 5) == 5
+        assert st.add("n", -2) == 3
+        assert st.list_prefix("a") == {"a": b"1"}
+        st.delete("a")
+        assert st.get("a") is None
+        assert st.wait("n", timeout=1.0) == struct.pack("<q", 3)
+        with pytest.raises(TimeoutError):
+            st.wait("never", timeout=0.2)
+
+
+class TestElasticOverTCP:
+    def test_membership_and_restart_decision(self, server):
+        """Two ranks register via tcp://; one dies (lease expires) ⇒ the
+        survivor's exit_code is the restart protocol code (101)."""
+        from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                          ElasticManager)
+
+        url = f"tcp://127.0.0.1:{server.port}"
+        m0 = ElasticManager(url, rank=0, heartbeat_interval=0.1, lease_ttl=0.8)
+        m1 = ElasticManager(url, rank=1, heartbeat_interval=0.1, lease_ttl=0.8)
+        m0.register()
+        m1.register()
+        time.sleep(0.3)
+        assert m0.alive_ranks() == [0, 1]
+        assert m0.exit_code() is None       # baseline snapshot, stable world
+
+        m1.stop()                           # rank 1 leaves (deletes its lease)
+        deadline = time.time() + 5.0
+        while m0.alive_ranks() != [0] and time.time() < deadline:
+            time.sleep(0.1)
+        assert m0.alive_ranks() == [0]
+        assert m0.exit_code() == ELASTIC_EXIT_CODE
+        m0.stop()
+
+
+class TestConnectionRecovery:
+    def test_wait_timeout_then_reuse(self, server):
+        """A timed-out WAIT poisons the wire framing; the client must drop
+        and redial so the next request still gets a correct reply."""
+        st = TCPStore("127.0.0.1", server.port)
+        with pytest.raises(OSError):
+            st.wait("never-set-key", timeout=0.3)
+        st.set("after-timeout", b"ok")          # redialed transparently
+        assert st.get("after-timeout") == b"ok"
+        # and the counter protocol still frames correctly
+        assert st.add("recover-ctr") == 1
+        st.close()
+
+    def test_wait_none_blocks_past_default(self, server):
+        """wait(timeout=None) must block indefinitely (not the 60s default);
+        proven at small scale with a 1s-timeout client waiting 2s."""
+        st = TCPStore("127.0.0.1", server.port, timeout=1.0)
+        setter = TCPStore("127.0.0.1", server.port)
+        got = {}
+
+        def wait():
+            got["val"] = st.wait("slow-gate", timeout=None)
+
+        t = threading.Thread(target=wait)
+        t.start()
+        time.sleep(2.0)                          # > client default timeout
+        assert t.is_alive()
+        setter.set("slow-gate", b"v")
+        t.join(timeout=5.0)
+        assert got["val"] == b"v"
+        st.close()
+        setter.close()
