@@ -7,7 +7,10 @@
 // it in-process, every worker dials it.  Original poll()-based design — one
 // thread, no dependencies.
 //
-// Wire protocol (little-endian, persistent connections, pipelined):
+// Wire protocol (little-endian, persistent connections; requests on one
+// connection must be serialized — a parked WAIT defers its response, so
+// pipelining another request behind a WAIT would desequence replies.  The
+// Python client enforces this with a per-connection lock):
 //   request : u8 op | u32 klen | u32 vlen | key bytes | val bytes
 //   response: u8 status (0 ok, 1 missing) | u32 vlen | val bytes
 //   ops: 0 SET (resp empty)            1 GET (resp value or missing)
@@ -66,6 +69,18 @@ void respond(Conn* c, uint8_t status, const std::string& val) {
   c->wbuf += val;
 }
 
+// Reply to every connection parked in WAIT(key) with val, then clear them.
+void notify_waiters(Server* srv, const std::string& key,
+                    const std::string& val) {
+  auto w = srv->waiters.find(key);
+  if (w == srv->waiters.end()) return;
+  for (int wfd : w->second) {
+    auto it = srv->conns.find(wfd);
+    if (it != srv->conns.end()) respond(&it->second, 0, val);
+  }
+  srv->waiters.erase(w);
+}
+
 // Parse and execute every complete request in c->rbuf.  Returns false on a
 // malformed frame (connection is then closed).
 bool handle_requests(Server* srv, Conn* c) {
@@ -87,14 +102,7 @@ bool handle_requests(Server* srv, Conn* c) {
       case 0: {  // SET
         srv->kv[key] = val;
         respond(c, 0, "");
-        auto w = srv->waiters.find(key);
-        if (w != srv->waiters.end()) {
-          for (int wfd : w->second) {
-            auto it = srv->conns.find(wfd);
-            if (it != srv->conns.end()) respond(&it->second, 0, val);
-          }
-          srv->waiters.erase(w);
-        }
+        notify_waiters(srv, key, val);
         break;
       }
       case 1: {  // GET
@@ -115,14 +123,7 @@ bool handle_requests(Server* srv, Conn* c) {
         std::string stored((const char*)&cur, 8);
         srv->kv[key] = stored;
         respond(c, 0, stored);
-        auto w = srv->waiters.find(key);  // ADD also satisfies waiters
-        if (w != srv->waiters.end()) {
-          for (int wfd : w->second) {
-            auto it2 = srv->conns.find(wfd);
-            if (it2 != srv->conns.end()) respond(&it2->second, 0, stored);
-          }
-          srv->waiters.erase(w);
-        }
+        notify_waiters(srv, key, stored);  // ADD also satisfies waiters
         break;
       }
       case 3: {  // WAIT

@@ -58,7 +58,9 @@ class Fleet:
             dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
             pp_degree=hc.get("pp_degree", 1),
             sharding_degree=hc.get("sharding_degree", 1),
-            sep_degree=hc.get("sep_degree", 1))
+            sep_degree=hc.get("sep_degree", 1),
+            virtual_pp_degree=hc.get("pp_configs", {})
+                                .get("virtual_pipeline_degree", 1))
         set_hybrid_communicate_group(self._hcg)
         self._is_initialized = True
         return self

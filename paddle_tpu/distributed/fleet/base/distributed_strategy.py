@@ -32,7 +32,10 @@ _DEFAULTS: Dict[str, Any] = {
     "tensor_parallel": False,
     "tensor_parallel_configs": {"tensor_parallel_degree": 1, "tensor_init_seed": -1},
     "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-                       "sharding_degree": 1, "sep_degree": 1},
+                       "sharding_degree": 1, "sep_degree": 1,
+                       # ≙ reference pp_configs (virtual pipeline = the
+                       # interleaved 1F1B schedule; spmd_pipeline_interleaved)
+                       "pp_configs": {"virtual_pipeline_degree": 1}},
     "gradient_merge": False,
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
     "lamb": False,

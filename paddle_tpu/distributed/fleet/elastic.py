@@ -35,10 +35,14 @@ def read_alive_ranks(store_target, ttl: float,
     """Ranks with a fresh heartbeat lease (shared between ElasticManager and
     the launcher so membership logic cannot drift).  ``store_target``: a
     directory, a ``tcp://`` URL, or an already-constructed store object."""
-    store = make_store(store_target) if isinstance(store_target, str) \
-        else store_target
     now = time.time() if now is None else now
     out = []
+    own_store = isinstance(store_target, str)
+    try:
+        store = make_store(store_target, timeout=5.0) if own_store \
+            else store_target
+    except Exception:
+        return out  # store unreachable ⇒ "nobody visible" (degrade, not die)
     try:
         entries = store.list_prefix("host-")
     except Exception:
@@ -46,6 +50,9 @@ def read_alive_ranks(store_target, ttl: float,
         # too-few-alive path then checkpoints and exits 101 (same behavior the
         # file backend had when the dir was unreadable)
         return out
+    finally:
+        if own_store:
+            store.close()
     for key, raw in entries.items():
         if not key.endswith(".json"):
             continue

@@ -130,12 +130,34 @@ def _rescaled_world(args, world: int, nproc: int):
     return new_world, nproc
 
 
+def _maybe_host_store(args):
+    """Host the native TCP store in-process when this launcher is the store's
+    home (≙ fleet/elastic/manager.py assuming an ambient etcd — here the
+    framework carries its own): for ``--elastic_store tcp://host:port``, the
+    node whose rank is 0 (or a loopback host) binds the port; peers dial it.
+    Returns the StoreServer handle (kept alive for the launcher's lifetime)
+    or None."""
+    target = str(args.elastic_store or "")
+    if not target.startswith("tcp://"):
+        return None
+    host, _, port = target[len("tcp://"):].rpartition(":")
+    local = host in ("127.0.0.1", "localhost", "0.0.0.0", "")
+    if not (local or args.node_rank == 0):
+        return None
+    try:
+        from .store import StoreServer
+        return StoreServer(port=int(port or 0))
+    except OSError:
+        return None  # already bound (another launcher on this host owns it)
+
+
 def launch(argv=None) -> int:
     args = _parse_args(argv)
     nnodes = int(str(args.nnodes).split(":")[0])
     world = nnodes * args.nproc_per_node if args.devices == "cpu" else nnodes
     nproc = args.nproc_per_node if args.devices == "cpu" else 1
     os.makedirs(args.log_dir, exist_ok=True)
+    _store_server = _maybe_host_store(args)  # noqa: F841 (lifetime anchor)
 
     restarts = 0
     while True:

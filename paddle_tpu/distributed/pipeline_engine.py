@@ -39,6 +39,33 @@ from .spmd import (build_param_specs, build_state_shardings, spmd_pipeline,
                    spmd_pipeline_interleaved)
 
 
+def interleave_layers(x, n_stages: int, n_chunks: int):
+    """Permute a [L, ...] layer stack into chunk-interleaved storage order:
+    position d*(V*lpc) + v*lpc + i holds original layer (v*S + d)*lpc + i.
+    A 'pipe'-sharded dim0 then gives device d exactly its V schedule chunks
+    contiguously — the interleaved pipeline needs no per-step re-layout
+    collective.  Inverse: ``deinterleave_layers``."""
+    S, V = n_stages, n_chunks
+    L = x.shape[0]
+    lpc = L // (S * V)
+    perm = np.array([(v * S + d) * lpc + i
+                     for d in range(S) for v in range(V) for i in range(lpc)])
+    return x[perm]
+
+
+def deinterleave_layers(x, n_stages: int, n_chunks: int):
+    """Inverse of interleave_layers (use when exporting a checkpoint trained
+    with virtual_pp_degree > 1 to the plain layer order)."""
+    S, V = n_stages, n_chunks
+    L = x.shape[0]
+    lpc = L // (S * V)
+    perm = np.array([(v * S + d) * lpc + i
+                     for d in range(S) for v in range(V) for i in range(lpc)])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(L)
+    return x[inv]
+
+
 def make_pipeline_train_step(pipeline_layer, loss_fn, optimizer, hcg,
                              accumulate_steps: int = 1):
     """Generic fallback: GSPMD step over the hybrid mesh with stage-placed
@@ -72,11 +99,18 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
     V = max(int(virtual_pp_degree), 1) if S > 1 else 1  # serial path ignores V
     assert n_layers % max(S * V, 1) == 0, \
         "n_layers must divide pp degree * virtual_pp_degree"
-    layers_per_stage = n_layers // max(S, 1)
     M = n_microbatches
     if V > 1 and M % S:
         raise ValueError(f"n_microbatches ({M}) must be a multiple of the "
                          f"pp degree ({S}) when virtual_pp_degree > 1")
+    if V > 1:
+        # store stacked params chunk-interleaved from init: the contiguous
+        # 'pipe' shard of each device IS its V schedule chunks, so the hot
+        # path has no re-layout collective.  TrainState (and checkpoints of
+        # it) hold this order; deinterleave_layers() converts back.
+        params0 = dict(params0)
+        for k in stacked_keys:
+            params0[k] = interleave_layers(params0[k], S, V)
 
     # mark stacked params so build_param_specs shards dim0 over pipe
     if layer is not None:
@@ -117,25 +151,18 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
             return out
 
         if S > 1 and V > 1:
-            # interleaved: reshape the layer stack [L, ...] into per-device
-            # chunk-major [S, V, lpc, ...].  NOTE: params are stored
-            # stage-contiguous, so GSPMD inserts this re-layout all-to-all
-            # EVERY step (fwd gather + grad scatter).  Storing the state
-            # chunk-interleaved at init (permuted layer order + inverse on
-            # state_dict) would make it free; follow-up if pp profiling
-            # shows the traffic matters.
+            # params are stored chunk-interleaved (see init above): the local
+            # 'pipe' shard [V*lpc, ...] reshapes to this device's V chunks
+            # with zero collective traffic
             lpc = n_layers // (S * V)
-            block_params = {
-                k: params[k].reshape((V, S, lpc) + params[k].shape[1:])
-                            .swapaxes(0, 1)
-                for k in stacked_keys}
+            block_params = {k: params[k] for k in stacked_keys}
 
             def chunk_fn(chunk_blocks, hmb, mb_idx, v):
                 return run_blocks(hmb, chunk_blocks)
 
             def pipelined(blocks, mbs):
                 local = jax.tree_util.tree_map(
-                    lambda a: a.reshape(a.shape[1:]), blocks)  # [1,V,lpc]→[V,lpc]
+                    lambda a: a.reshape((V, lpc) + a.shape[1:]), blocks)
                 return spmd_pipeline_interleaved(chunk_fn, local, mbs, S, V,
                                                  axis="pipe")
 

@@ -156,10 +156,12 @@ class TCPStore:
         return out
 
     def close(self):
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 class FileStore:
@@ -186,15 +188,24 @@ class FileStore:
             return None
 
     def add(self, key: str, delta: int = 1) -> int:
-        # advisory-locked read-modify-write (single host: O_EXCL lock file)
+        # advisory-locked read-modify-write (single host: O_EXCL lock file).
+        # A holder that dies mid-section (SIGKILL — the exact fault elastic
+        # exists for) leaves the lock behind; steal it once it goes stale.
         lock = self._p(key) + ".lock"
         deadline = time.time() + 10.0
+        stale_after = 5.0
         while True:
             try:
                 fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
                 os.close(fd)
                 break
             except FileExistsError:
+                try:
+                    if time.time() - os.path.getmtime(lock) > stale_after:
+                        os.unlink(lock)  # dead holder: break the lock
+                        continue
+                except OSError:
+                    continue  # raced with the holder's own unlink
                 if time.time() > deadline:
                     raise TimeoutError(f"store lock stuck: {lock}")
                 time.sleep(0.01)

@@ -94,7 +94,8 @@ class HybridCommunicateGroup:
     def __init__(self, topology: Optional[CommunicateTopology] = None,
                  dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
                  sharding_degree: int = 1, sep_degree: int = 1,
-                 devices: Optional[Sequence] = None, order: Sequence[str] = None):
+                 devices: Optional[Sequence] = None, order: Sequence[str] = None,
+                 virtual_pp_degree: int = 1):
         if topology is not None:
             self._topo = topology
             dims = {n: topology.get_dim(n) for n in topology.get_hybrid_group_names()}
@@ -108,6 +109,10 @@ class HybridCommunicateGroup:
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
+        # interleaved-schedule chunk count per pipe device (not a mesh axis:
+        # chunks live on the stacked-layer dim, ≙ reference
+        # num_virtual_pipeline_stages on PipelineLayer)
+        self._virtual_pp_degree = max(int(virtual_pp_degree), 1)
         names = list(order) if order else list(HYBRID_AXES)
         degrees = {"data": dp_degree, "pipe": pp_degree, "sharding": sharding_degree,
                    "sep": sep_degree, "model": mp_degree}
@@ -181,6 +186,9 @@ class HybridCommunicateGroup:
 
     def get_pipe_parallel_world_size(self) -> int:
         return self._pp_degree
+
+    def get_virtual_pipeline_degree(self) -> int:
+        return self._virtual_pp_degree
 
     def get_sharding_parallel_world_size(self) -> int:
         return self._sharding_degree

@@ -280,7 +280,7 @@ def gpt_preset(name: str, **overrides) -> GPTConfig:
 def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1,
                         remat: bool = True, donate: bool = True,
                         zero_stage: int = 0, dynamic_loss_scale: bool = False,
-                        virtual_pp_degree: int = 1):
+                        virtual_pp_degree: Optional[int] = None):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
@@ -311,6 +311,9 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
                 "sequence_parallel with pp_degree>1 is not supported yet: the "
                 "pipeline engine's shard_map over 'pipe' cannot nest the "
                 "'sep' shard_map region; set sep_degree=1 or pp_degree=1")
+        if virtual_pp_degree is None:  # strategy pp_configs default
+            getter = getattr(hcg, "get_virtual_pipeline_degree", None)
+            virtual_pp_degree = getter() if getter else 1
         return make_stacked_pipeline_step(
             model.embed_fn, model.block_fn, model.head_loss_fn, params0,
             optimizer, hcg, model.config.num_layers,

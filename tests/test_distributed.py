@@ -384,3 +384,35 @@ def test_pipeline_interleaved_train_matches_serial_gpt():
     serial = run(1, 1)
     vpp2 = run(2, 2)
     np.testing.assert_allclose(serial, vpp2, rtol=1e-4, atol=1e-5)
+
+
+def test_virtual_pp_degree_flows_from_strategy():
+    """hybrid_configs["pp_configs"]["virtual_pipeline_degree"] reaches the
+    HCG (≙ reference pp_configs / num_virtual_pipeline_stages plumbing)."""
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": 1,
+                               "pp_configs": {"virtual_pipeline_degree": 2}}
+    fleet.fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.fleet.get_hybrid_communicate_group()
+    assert hcg.get_virtual_pipeline_degree() == 2
+
+
+def test_interleave_layers_roundtrip():
+    """Chunk-interleaved storage permutation and its inverse; position
+    d*(V*lpc)+v*lpc+i must hold original layer (v*S+d)*lpc+i."""
+    from paddle_tpu.distributed.pipeline_engine import (deinterleave_layers,
+                                                        interleave_layers)
+    S, V, lpc = 2, 3, 2
+    L = S * V * lpc
+    x = jnp.arange(L * 4.0).reshape(L, 4)
+    y = interleave_layers(x, S, V)
+    for d in range(S):
+        for v in range(V):
+            for i in range(lpc):
+                np.testing.assert_array_equal(
+                    np.asarray(y[d * V * lpc + v * lpc + i]),
+                    np.asarray(x[(v * S + d) * lpc + i]))
+    np.testing.assert_array_equal(np.asarray(deinterleave_layers(y, S, V)),
+                                  np.asarray(x))

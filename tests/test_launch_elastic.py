@@ -113,3 +113,41 @@ class TestLauncher:
                              extra=["--elastic_level", "1",
                                     "--max_restarts", "2"])
         assert r.returncode == 7, r.returncode  # not 101
+
+
+class TestTCPStoreLaunch:
+    def test_launcher_hosts_tcp_store_end_to_end(self, tmp_path):
+        """--elastic_store tcp://127.0.0.1:PORT: the launcher binds the
+        native store server in-process and the trainer registers + reads
+        membership through it (the no-etcd multi-host path, ≙ reference
+        manager.py etcd flows)."""
+        import socket
+        import subprocess
+        import sys
+        import textwrap
+
+        with socket.socket() as s:  # reserve a free port number
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+
+        script = tmp_path / "train.py"
+        script.write_text(textwrap.dedent(f"""
+            from paddle_tpu.distributed.fleet.elastic import ElasticManager
+            m = ElasticManager("tcp://127.0.0.1:{port}", rank=0,
+                               heartbeat_interval=0.1, lease_ttl=5.0)
+            m.register()
+            assert m.alive_ranks() == [0], m.alive_ranks()
+            m.stop()
+            print("TCP_STORE_OK")
+        """))
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--log_dir", str(tmp_path / "log"),
+             "--elastic_store", f"tcp://127.0.0.1:{port}", str(script)],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/root/repo")
+        assert r.returncode == 0 and "TCP_STORE_OK" in r.stdout, \
+            (r.stdout, r.stderr)
