@@ -440,6 +440,8 @@ def main():
         if n not in CONFIGS:
             ap.error(f"unknown config {n!r}; choose from {list(CONFIGS)}")
     if os.environ.get("_PADDLE_TPU_BENCH_CHILD") == "1":
+        # kernel A/B sweeps: export FLAGS_use_fused_ln=1 (the flag registry
+        # env-seeds every FLAGS_* at import; the parent forwards the env)
         _child(names)
         return 0
     return _parent(names, args.attempts, args.timeout)
