@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .spmd import build_param_specs, _slot_spec
@@ -87,14 +88,29 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                          growth_interval: int = 1000,
                          backoff_factor: float = 0.5,
                          growth_factor: float = 2.0,
-                         donate: bool = True):
+                         donate: bool = True,
+                         offload: bool = False):
     """Build the sharded train step.
 
     ``loss_of(params, *batch) -> scalar``.  Returns ``(step, state0)`` with
     ``step(state, lr, *batch) -> (state, loss)``.  state = {params, opt,
     master, scaler}; scaler = {scale, good_steps, found_inf} (found_inf from
     the LAST step, for GradScaler-style inspection).
+
+    ``offload=True`` (≙ sharding_configs offload) routes through
+    ``make_zero_offload_train_step``: optimizer slots + masters in host
+    memory, update on the host CPU backend (no dynamic loss scaling there —
+    offload targets memory-bound fp32/bf16 runs).
     """
+    if offload:
+        if dynamic_loss_scale:
+            raise NotImplementedError(
+                "offload=True with dynamic_loss_scale is not supported; "
+                "use static scaling (the offload path keeps found_inf "
+                "skip-update semantics)")
+        return make_zero_offload_train_step(
+            loss_of, params0, optimizer, mesh, layer=layer,
+            zero_stage=zero_stage, master_weights=master_weights)
     if master_weights is None:
         master_weights = any(p.dtype in _HALF_DTYPES
                              for p in jax.tree_util.tree_leaves(params0))
@@ -198,6 +214,98 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
         is_leaf=lambda x: hasattr(x, "shape"))
+    return step, state0
+
+
+def make_zero_offload_train_step(loss_of: Callable, params0: Dict[str, Any],
+                                 optimizer, mesh: Mesh, layer=None,
+                                 zero_stage: int = 1,
+                                 master_weights: Optional[bool] = None):
+    """CPU-offload variant (≙ reference sharding_configs ``offload=True`` /
+    DygraphShardingOptimizer offload): optimizer slots + fp32 masters live in
+    HOST memory; each step ships fp32 grads host-ward, runs the update on the
+    host CPU backend, and ships the compute-dtype params back.  Device HBM
+    then holds only params + activations — the optimizer states (2× fp32 for
+    Adam, + masters) move off-chip at the price of PCIe/host traffic per
+    step.
+
+    Two jitted phases orchestrated in Python (one jit cannot span backends):
+      device: grads = ∇(loss·scale), found_inf, loss
+      host:   (new_master/new_upd, new_opt) = optimizer.update(...)
+    Returns (step, state0); state = {params(dev), opt(host), master(host),
+    scaler(host)}.  step(state, lr, *batch) -> (state, loss).
+    """
+    del master_weights  # the offload path is always master-weighted: the
+    # host keeps THE authoritative fp32 copy of every param ("master" for
+    # half params, same role for fp32 params) so no step ever fetches params
+    # from device — per-step traffic is exactly grads down + params up
+    cpu0 = jax.devices("cpu")[0]
+    p_specs, s_specs = zero_state_specs(params0, mesh, layer, zero_stage)
+    p_sh = {k: NamedSharding(mesh, p_specs[k]) for k in params0}
+    s_sh = {k: NamedSharding(mesh, s_specs[k]) for k in params0}
+
+    master0 = {k: np.asarray(p, np.float32) for k, p in params0.items()}
+    opt_state0 = optimizer.init_state(master0)
+
+    host = functools.partial(jax.device_put, device=cpu0)
+    state0 = {
+        "params": {k: jax.device_put(v, p_sh[k]) for k, v in params0.items()},
+        "opt": jax.tree_util.tree_map(host, opt_state0),
+        "master": {k: host(v) for k, v in master0.items()},
+        "scaler": {"scale": host(jnp.ones([], jnp.float32)),
+                   "good_steps": host(jnp.zeros([], jnp.int32)),
+                   "found_inf": host(jnp.zeros([], jnp.bool_))},
+    }
+
+    @jax.jit
+    def grad_phase(params, *batch):
+        loss, grads = jax.value_and_grad(lambda p: loss_of(p, *batch))(params)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        if zero_stage >= 2:
+            # stage-2 contract holds on the offload path too: grads land
+            # reduce-scattered so peak HBM never sees the replicated tree
+            grads = {k: jax.lax.with_sharding_constraint(g, s_sh[k])
+                     for k, g in grads.items()}
+        found_inf = functools.reduce(
+            jnp.logical_or,
+            [jnp.any(~jnp.isfinite(g))
+             for g in jax.tree_util.tree_leaves(grads)],
+            jnp.zeros([], jnp.bool_))
+        return loss, grads, found_inf
+
+    @jax.jit
+    def host_phase(grads, opt, master, lr, found_inf):
+        new_upd, new_opt = optimizer.update(grads, opt, master, lr=lr)
+
+        def sel(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+        new_master = sel(new_upd, master)
+        new_opt = {"step": jnp.where(found_inf, opt["step"], new_opt["step"]),
+                   "slots": sel(new_opt["slots"], opt["slots"])}
+        new_params = {k: new_master[k].astype(params0[k].dtype)
+                      for k in new_master}
+        return new_params, new_opt, new_master
+
+    def step(state, lr, *batch):
+        loss, grads, found_inf = grad_phase(state["params"], *batch)
+        g_host = jax.tree_util.tree_map(host, grads)
+        fi_host = host(found_inf)
+        new_params, new_opt, new_master = host_phase(
+            g_host, state["opt"], state["master"],
+            host(jnp.asarray(lr, jnp.float32)), fi_host)
+        new_state = {
+            "params": {k: jax.device_put(v, p_sh[k])
+                       for k, v in new_params.items()},
+            "opt": new_opt,
+            "master": new_master,
+            "scaler": {"scale": state["scaler"]["scale"],
+                       "good_steps": state["scaler"]["good_steps"],
+                       "found_inf": fi_host},
+        }
+        return new_state, loss
+
     return step, state0
 
 

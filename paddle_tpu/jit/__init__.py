@@ -20,6 +20,7 @@ from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from .functional import (functionalize, make_eval_step, make_train_step,  # noqa: F401
                          sync_state_to_layer, unwrap_tree, wrap_tree)
+from .bucketing import bucketize, length_mask, pad_to_bucket  # noqa: F401
 
 
 class InputSpec:

@@ -1,0 +1,92 @@
+"""Shape bucketing for variable-length inputs.
+
+The reference handles ragged/variable-length batches with LoD tensors and
+dynamic shapes (python/paddle/fluid/lod_tensor.py; declared a non-goal in
+SURVEY §7 because XLA requires static shapes).  The TPU-native answer is
+*bucketing*: pad every dynamic axis up to the smallest admissible bucket so
+a workload with arbitrary lengths compiles at most ``len(buckets)`` XLA
+programs — the standard serving/training recipe on TPU.
+
+    step = paddle.jit.bucketize(fn, buckets=(128, 256, 512), axis=1,
+                                length_arg="length")
+    out = step(ids)              # ids (B, 137) -> padded to (B, 256), one
+                                 # compile per bucket ever
+
+``fn`` receives padded arrays (and, when ``length_arg`` is set, the true
+length as a traced int32 scalar so it can mask — lengths vary per call
+WITHOUT recompiling).  Outputs whose ``axis`` dim equals the bucket are
+sliced back to the true length.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def pad_to_bucket(x, bucket: int, axis: int, pad_value=0):
+    """Pad ``x`` along ``axis`` up to ``bucket`` with ``pad_value``."""
+    cur = x.shape[axis]
+    if cur == bucket:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, bucket - cur)
+    return jnp.pad(x, pads, constant_values=pad_value)
+
+
+def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
+              pad_value=0, length_arg: Optional[str] = None,
+              unpad_outputs: bool = True) -> Callable:
+    """Wrap ``fn`` so calls with any length ≤ max(buckets) reuse a bounded
+    set of compiled programs.  Array positional args whose ``axis`` size
+    matches the leading arg's are padded together; scalars/mismatched args
+    pass through untouched.
+    """
+    bkts = sorted(set(int(b) for b in buckets))
+    if not bkts:
+        raise ValueError("buckets must be non-empty")
+    jfn = jax.jit(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        arrs = [a for a in args if hasattr(a, "shape") and a.ndim > axis]
+        if not arrs:
+            raise ValueError(f"no array argument with ndim > {axis}")
+        L = arrs[0].shape[axis]
+        bucket = next((b for b in bkts if b >= L), None)
+        if bucket is None:
+            raise ValueError(
+                f"length {L} exceeds the largest bucket {bkts[-1]}; add a "
+                f"bucket or truncate the input")
+        padded = tuple(
+            pad_to_bucket(a, bucket, axis, pad_value)
+            if hasattr(a, "shape") and a.ndim > axis and a.shape[axis] == L
+            else a
+            for a in args)
+        if length_arg is not None:
+            kwargs = dict(kwargs)
+            kwargs[length_arg] = jnp.asarray(L, jnp.int32)
+        out = jfn(*padded, **kwargs)
+
+        if not unpad_outputs:
+            return out
+
+        def unpad(o):
+            if hasattr(o, "shape") and o.ndim > axis and o.shape[axis] == bucket:
+                return jax.lax.slice_in_dim(o, 0, L, axis=axis)
+            return o
+
+        return jax.tree_util.tree_map(unpad, out)
+
+    wrapper.buckets = tuple(bkts)
+    return wrapper
+
+
+def length_mask(length, bucket: int, dtype=jnp.float32):
+    """(bucket,) mask: 1 for positions < length, 0 for padding — the masking
+    companion for ``length_arg`` consumers (e.g. mean-pool over real tokens
+    only)."""
+    return (jnp.arange(bucket) < length).astype(dtype)

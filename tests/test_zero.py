@@ -264,3 +264,59 @@ class TestShardedInit:
             ecfg, AdamW(1e-3), hcg, zero_stage=3)
         estate, eloss = estep(estate, np.float32(1e-3), ids, ids)
         assert np.isfinite(float(np.asarray(eloss)))
+
+
+class TestOffload:
+    """sharding_configs offload=True: optimizer state in host memory, update
+    on the host backend (≙ reference DygraphShardingOptimizer offload)."""
+
+    @needs8
+    def test_loss_and_param_parity_vs_on_device(self):
+        x, y = _batch()
+        mesh = _mesh(4)
+        step_d, state_d = make_zero_train_step(
+            _loss_of, _mlp_params(), Adam(1e-2), mesh, zero_stage=1)
+        step_h, state_h = make_zero_train_step(
+            _loss_of, _mlp_params(), Adam(1e-2), mesh, zero_stage=1,
+            offload=True)
+        for i in range(3):
+            state_d, loss_d = step_d(state_d, np.float32(1e-2), x, y)
+            state_h, loss_h = step_h(state_h, np.float32(1e-2), x, y)
+            np.testing.assert_allclose(float(loss_d), float(loss_h),
+                                       rtol=1e-5, atol=1e-6, err_msg=f"step {i}")
+        for k in state_d["params"]:
+            np.testing.assert_allclose(np.asarray(state_d["params"][k]),
+                                       np.asarray(state_h["params"][k]),
+                                       rtol=2e-5, atol=2e-6, err_msg=k)
+
+    @needs8
+    def test_optimizer_state_lives_on_host(self):
+        mesh = _mesh(4)
+        step, state = make_zero_train_step(
+            _loss_of, _mlp_params(dtype=jnp.bfloat16), Adam(1e-2), mesh,
+            zero_stage=1, offload=True)
+        cpu0 = jax.devices("cpu")[0]
+        for leaf in jax.tree_util.tree_leaves(state["opt"]["slots"]):
+            assert leaf.devices() == {cpu0}, leaf.devices()
+        for leaf in jax.tree_util.tree_leaves(state["master"]):
+            assert leaf.devices() == {cpu0}
+        # params stay on the mesh (half dtype → fp32 masters exist)
+        assert state["master"], "bf16 params must have host masters"
+        x, y = _batch()
+        state, loss = step(state, np.float32(1e-2), x, y)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree_util.tree_leaves(state["opt"]["slots"]):
+            assert leaf.devices() == {cpu0}  # stays host-resident post-step
+
+    @needs8
+    def test_found_inf_skips_update(self):
+        mesh = _mesh(2)
+        step, state = make_zero_train_step(
+            _loss_of, _mlp_params(), Adam(1e-2), mesh, zero_stage=1,
+            offload=True)
+        before = {k: np.asarray(v) for k, v in state["params"].items()}
+        x, y = _batch()
+        bad = x.at[0, 0].set(jnp.inf)      # inf input -> non-finite grads
+        state, _ = step(state, np.float32(1e-2), bad, y)
+        for k, v in state["params"].items():
+            np.testing.assert_array_equal(np.asarray(v), before[k], err_msg=k)
