@@ -142,6 +142,37 @@ class GPTModel(Layer):
         h = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][pos]
         return h.astype(dt)
 
+    def _block_ln(self, x, w, b, dt):
+        x32 = x.astype(jnp.float32)
+        m = x32.mean(-1, keepdims=True)
+        v = x32.var(-1, keepdims=True)
+        return ((x32 - m) * jax.lax.rsqrt(v + self.config.layer_norm_epsilon)
+                * w + b).astype(dt)
+
+    def _block_qkv(self, sl, h):
+        """pre-LN + QKV projection; returns q, k, v as (B, L, nh, hd)."""
+        c = self.config
+        dt = h.dtype
+        B, Lq, H = h.shape
+        nh = c.num_attention_heads
+        hd = H // nh
+        a_in = self._block_ln(h, sl["blocks_ln1_w"], sl["blocks_ln1_b"], dt)
+        qkv = a_in @ sl["blocks_qkv_w"].astype(dt) + sl["blocks_qkv_b"].astype(dt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        return (q.reshape(B, Lq, nh, hd), k.reshape(B, Lq, nh, hd),
+                v.reshape(B, Lq, nh, hd))
+
+    def _block_post_attn(self, sl, h, att):
+        """attention output projection + residual + MLP half of the block."""
+        dt = h.dtype
+        B, Lq, H = h.shape
+        att = att.reshape(B, Lq, H)
+        h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
+        m_in = self._block_ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"], dt)
+        ff = jax.nn.gelu(m_in @ sl["blocks_fc1_w"].astype(dt)
+                         + sl["blocks_fc1_b"].astype(dt), approximate=True)
+        return h + ff @ sl["blocks_fc2_w"].astype(dt) + sl["blocks_fc2_b"].astype(dt)
+
     def block_fn(self, sl: Dict[str, Any], h, key=None, sp_mesh=None):
         """One transformer block given this layer's parameter slice.
 
@@ -149,24 +180,8 @@ class GPTModel(Layer):
         attention runs as explicit ring/Ulysses context parallelism over the
         "sep" axis instead of letting GSPMD gather the sequence."""
         c = self.config
-        dt = h.dtype
-        eps = c.layer_norm_epsilon
         B, Lq, H = h.shape
-        nh = c.num_attention_heads
-        hd = H // nh
-
-        def ln(x, w, b):
-            x32 = x.astype(jnp.float32)
-            m = x32.mean(-1, keepdims=True)
-            v = x32.var(-1, keepdims=True)
-            return ((x32 - m) * jax.lax.rsqrt(v + eps) * w + b).astype(dt)
-
-        a_in = ln(h, sl["blocks_ln1_w"], sl["blocks_ln1_b"])
-        qkv = a_in @ sl["blocks_qkv_w"].astype(dt) + sl["blocks_qkv_b"].astype(dt)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, Lq, nh, hd)
-        k = k.reshape(B, Lq, nh, hd)
-        v = v.reshape(B, Lq, nh, hd)
+        q, k, v = self._block_qkv(sl, h)
         sp_mode = getattr(c, "sequence_parallel", None)
         mesh = sp_mesh
         if sp_mode and mesh is not None and mesh.shape.get("sep", 1) > 1:
@@ -190,13 +205,7 @@ class GPTModel(Layer):
             )(q, k, v)
         else:
             att = flash_attention(q, k, v, causal=True)
-        att = att.reshape(B, Lq, H)
-        h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
-        m_in = ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"])
-        ff = jax.nn.gelu(m_in @ sl["blocks_fc1_w"].astype(dt)
-                         + sl["blocks_fc1_b"].astype(dt), approximate=True)
-        h = h + ff @ sl["blocks_fc2_w"].astype(dt) + sl["blocks_fc2_b"].astype(dt)
-        return h
+        return self._block_post_attn(sl, h, att)
 
     def _head_logits(self, params: Dict[str, Any], h):
         c = self.config
@@ -255,6 +264,147 @@ class GPTModel(Layer):
         h = self.scan_blocks(params, h, remat=False)
         logits = self.head_fn(params, h)
         return Tensor(logits) if isinstance(input_ids, Tensor) else logits
+
+    # ------------------------------------------------- KV-cache generation
+    # ≙ the reference ecosystem's generation stack (paddlenlp generation_
+    # utils; fused_multi_transformer_op's CacheKV).  TPU-native shape: the
+    # cache is a STATIC (num_layers, B, max_len, nh, hd) buffer written with
+    # dynamic_update_slice, the decode loop is one lax.scan — a single XLA
+    # program regardless of how many tokens are generated.
+
+    def _block_decode(self, sl, h, ck, cv, t):
+        """One block for ONE new token at position ``t``.
+
+        h (B, 1, H); ck/cv (B, max_len, nh, hd) are this layer's caches.
+        Returns (h_out, ck, cv) with the new k/v written at index t and
+        attention taken over cache positions ≤ t (later slots hold zeros or
+        stale values and are masked)."""
+        q, k, v = self._block_qkv(sl, h)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
+        hd = q.shape[-1]
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
+            jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+        mask = jnp.arange(ck.shape[1]) <= t
+        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+        att = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        return self._block_post_attn(sl, h, att), ck, cv
+
+    def init_cache(self, batch_size: int, max_len: int):
+        c = self.config
+        dt = jnp.dtype(c.compute_dtype)
+        nh = c.num_attention_heads
+        hd = c.hidden_size // nh
+        shape = (c.num_layers, batch_size, max_len, nh, hd)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def prefill(self, params, input_ids, max_len: int):
+        """Run the prompt through all blocks, returning the final hidden
+        states (B, P, H) and caches filled at positions [0, P)."""
+        c = self.config
+        B, P = input_ids.shape
+        h = self.embed_fn(params, input_ids)
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+
+        def body(carry, sl):
+            q, k, v = self._block_qkv(sl, carry)
+            att = flash_attention(q, k, v, causal=True)
+            return self._block_post_attn(sl, carry, att), (k, v)
+
+        h, (ks, vs) = jax.lax.scan(body, h, stacked)
+        pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
+        dt = jnp.dtype(c.compute_dtype)
+        return h, (jnp.pad(ks.astype(dt), pad), jnp.pad(vs.astype(dt), pad))
+
+    def decode_step(self, params, h, caches, t):
+        """All blocks for one token: h (B,1,H), caches = (ck, cv) stacked
+        over layers.  Returns (h_out, caches)."""
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+
+        def body(carry, xs):
+            sl, ck, cv = xs
+            out, ck, cv = self._block_decode(sl, carry, ck, cv, t)
+            return out, (ck, cv)
+
+        h, (cks, cvs) = jax.lax.scan(body, h, (stacked, caches[0], caches[1]))
+        return h, (cks, cvs)
+
+    def generate(self, params, input_ids, max_new_tokens: int,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 greedy: bool = True, key=None):
+        """Autoregressive generation with a static KV cache.
+
+        input_ids (B, P) int32; returns (B, max_new_tokens) generated ids.
+        greedy=True → argmax decoding; else temperature (+ optional top-k)
+        sampling with ``key``.  The whole decode loop is ONE compiled
+        program per (P, max_new_tokens) pair — bucket P via
+        paddle.jit.bucketize for serving.
+        """
+        c = self.config
+        B, P = input_ids.shape
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        max_len = P + max_new_tokens
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
+                             f"max_position_embeddings ({c.max_position_embeddings})")
+        if not greedy and key is None:
+            raise ValueError("sampling (greedy=False) requires key")
+        key = jax.random.key(0) if key is None else key
+        run = self._gen_program(P, max_new_tokens, float(temperature),
+                                None if top_k is None else int(top_k), greedy)
+        return run(params, jnp.asarray(input_ids), key)
+
+    def _gen_program(self, P, max_new_tokens, temperature, top_k, greedy):
+        """Build (and memoize) the jitted prefill+decode program for one
+        (P, max_new_tokens, temperature, top_k, greedy) signature — repeated
+        generate() calls with the same shapes hit the jit cache instead of
+        recompiling the whole model."""
+        cache_key = (P, max_new_tokens, temperature, top_k, greedy)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        if cache_key in progs:
+            return progs[cache_key]
+        c = self.config
+        max_len = P + max_new_tokens
+        dt = jnp.dtype(c.compute_dtype)
+
+        def sample(logits32, k):
+            logits32 = logits32[:, -1, :] / jnp.asarray(
+                max(temperature, 1e-6), jnp.float32)
+            if top_k is not None:
+                vals, _ = jax.lax.top_k(logits32, top_k)
+                logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf,
+                                     logits32)
+            if greedy:
+                return jnp.argmax(logits32, -1).astype(jnp.int32)
+            return jax.random.categorical(k, logits32, -1).astype(jnp.int32)
+
+        def embed_one(params, tok, t):
+            return (jnp.take(params["wte"], tok[:, None], axis=0)
+                    + params["wpe"][t][None, None, :]).astype(dt)
+
+        @jax.jit
+        def run(params, input_ids, key):
+            h, caches = self.prefill(params, input_ids, max_len)
+            key, k0 = jax.random.split(key)
+            tok0 = sample(self.head_fn(params, h[:, -1:]), k0)
+
+            def body(carry, i):
+                tok, caches, key = carry
+                t = P + i  # this token's position in the cache
+                h = embed_one(params, tok, t)
+                h, caches = self.decode_step(params, h, caches, t)
+                key, sub = jax.random.split(key)
+                ntok = sample(self.head_fn(params, h), sub)
+                return (ntok, caches, key), ntok
+
+            (last, _, _), toks = jax.lax.scan(
+                body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        progs[cache_key] = run
+        return run
 
 
 class GPTForPretraining(GPTModel):

@@ -1,0 +1,114 @@
+"""KV-cache autoregressive generation (models/gpt.py generate/prefill/
+decode_step) vs the no-cache oracle: re-running the full forward on the
+growing sequence.  ≙ the reference ecosystem's generation_utils greedy/
+sampling contracts + fused_multi_transformer CacheKV semantics."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(7)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=3,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+def _oracle_greedy(model, params, prompt, n):
+    """No-cache decoding: full forward over the growing sequence."""
+    ids = np.asarray(prompt)
+    out = []
+    for _ in range(n):
+        h = model.embed_fn(params, jnp.asarray(ids))
+        h = model.scan_blocks(params, h, remat=False)
+        logits = model.head_fn(params, h)
+        nxt = np.asarray(jnp.argmax(logits[:, -1, :], -1)).astype(np.int64)
+        out.append(nxt)
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    return np.stack(out, axis=1)
+
+
+class TestGenerate:
+    def test_greedy_matches_no_cache_oracle(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.random.RandomState(0).randint(0, 97, (2, 5))
+        want = _oracle_greedy(model, params, prompt, 8)
+        got = model.generate(params, prompt, max_new_tokens=8)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_decode_logits_match_full_forward(self, model_and_params):
+        """Cache-path hidden state at position t equals the full-forward
+        hidden state at t (the cache IS the attention state, not an
+        approximation)."""
+        model, params = model_and_params
+        ids = np.random.RandomState(1).randint(0, 97, (2, 6))
+        max_len = 8
+
+        h_pre, caches = model.prefill(params, jnp.asarray(ids), max_len)
+        # feed the true next token (from data, not sampling) through decode
+        tok = jnp.asarray(np.random.RandomState(2).randint(0, 97, (2,)))
+        dt = jnp.dtype(model.config.compute_dtype)
+        h1 = (jnp.take(params["wte"], tok[:, None], axis=0)
+              + params["wpe"][6][None, None, :]).astype(dt)
+        h1, _ = model.decode_step(params, h1, caches, jnp.asarray(6))
+
+        full = jnp.concatenate([jnp.asarray(ids), tok[:, None]], axis=1)
+        hf = model.scan_blocks(params, model.embed_fn(params, full),
+                               remat=False)
+        np.testing.assert_allclose(np.asarray(h1[:, 0]), np.asarray(hf[:, -1]),
+                                   rtol=2e-4, atol=2e-5)
+        # prefill hidden states equal full-forward prefix states too
+        np.testing.assert_allclose(np.asarray(h_pre), np.asarray(hf[:, :6]),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_single_token_and_cap(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.zeros((1, 3), np.int64)
+        out = model.generate(params, prompt, max_new_tokens=1)
+        assert out.shape == (1, 1)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            model.generate(params, prompt, max_new_tokens=62)
+
+    def test_sampling_deterministic_under_key(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.random.RandomState(3).randint(0, 97, (2, 4))
+        k = jax.random.key(42)
+        a = model.generate(params, prompt, max_new_tokens=6, greedy=False,
+                           temperature=0.8, top_k=10, key=k)
+        b = model.generate(params, prompt, max_new_tokens=6, greedy=False,
+                           temperature=0.8, top_k=10, key=k)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape == (2, 6)
+        assert np.all((np.asarray(a) >= 0) & (np.asarray(a) < 97))
+
+    def test_sampling_requires_key(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="requires key"):
+            model.generate(params, np.zeros((1, 2), np.int64), 2, greedy=False)
+
+
+class TestProgramCache:
+    def test_repeat_calls_reuse_compiled_program(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.zeros((1, 4), np.int64)
+        a = model.generate(params, prompt, max_new_tokens=3)
+        r1 = model._gen_program(4, 3, 1.0, None, True)
+        b = model.generate(params, prompt, max_new_tokens=3)
+        r2 = model._gen_program(4, 3, 1.0, None, True)
+        assert r1 is r2                       # same memoized jitted program
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_zero_tokens_returns_empty(self, model_and_params):
+        model, params = model_and_params
+        out = model.generate(params, np.zeros((2, 3), np.int64), 0)
+        assert out.shape == (2, 0)
