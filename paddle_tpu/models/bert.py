@@ -29,7 +29,7 @@ class BertConfig:
                  hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
                  initializer_range=0.02, layer_norm_eps=1e-12,
                  compute_dtype="bfloat16", use_flash_attention=True,
-                 scan_unroll=1):
+                 scan_unroll=1, hidden_act="gelu"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -43,6 +43,13 @@ class BertConfig:
         self.layer_norm_eps = layer_norm_eps
         self.compute_dtype = compute_dtype
         self.use_flash_attention = use_flash_attention
+        # "gelu" = exact erf form (paddle F.gelu / HF BERT default);
+        # "gelu_approx" = tanh form.  Round-2 shipped the tanh approx
+        # unconditionally — a measurable deviation from the reference.
+        if hidden_act not in ("gelu", "gelu_approx"):
+            raise ValueError(f"hidden_act must be 'gelu' or 'gelu_approx', "
+                             f"got {hidden_act!r}")
+        self.hidden_act = hidden_act
         self.scan_unroll = scan_unroll
 
 
@@ -171,7 +178,8 @@ class BertModel(Layer):
                      sl["blocks_ln1_w"], sl["blocks_ln1_b"],
                      sl["blocks_proj_b"])
         ff = jax.nn.gelu(h @ sl["blocks_fc1_w"].astype(dt)
-                         + sl["blocks_fc1_b"].astype(dt), approximate=True)
+                         + sl["blocks_fc1_b"].astype(dt),
+                         approximate=c.hidden_act == "gelu_approx")
         return epilogue(ff @ sl["blocks_fc2_w"].astype(dt), h,
                         sl["blocks_ln2_w"], sl["blocks_ln2_b"],
                         sl["blocks_fc2_b"])
@@ -198,7 +206,8 @@ class BertModel(Layer):
     def _mlm_logits(self, params, h):
         dt = h.dtype
         x = jax.nn.gelu(h @ params["mlm_dense_w"].astype(dt)
-                        + params["mlm_dense_b"].astype(dt), approximate=True)
+                        + params["mlm_dense_b"].astype(dt),
+                        approximate=self.config.hidden_act == "gelu_approx")
         x = self._ln(x, params["mlm_ln_w"], params["mlm_ln_b"]).astype(dt)
         # stays in the compute dtype: the fused CE (ops/loss.py) reduces in
         # fp32 internally, so fp32 logits would only add HBM traffic

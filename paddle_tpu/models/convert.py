@@ -1,0 +1,176 @@
+"""Checkpoint conversion from torch/HuggingFace models.
+
+≙ the reference ecosystem's weight converters (paddlenlp's
+convert_*_checkpoint utilities; reference hapi models load torchvision-layout
+state dicts the same way).  The converter doubles as the framework's
+strongest correctness oracle: a torch GPT-2 and this GPT must produce the
+same logits from the same weights (tests/test_convert.py).
+
+Layout notes (HF GPT-2 → models/gpt.py):
+- HF ``Conv1D`` stores (in, out) — the same orientation as our ``h @ W``
+  matmuls, so attention/MLP weights transfer WITHOUT transposition.
+- Per-layer tensors stack into the scan layout: ``blocks_*`` with a leading
+  num_layers dim.
+- ``lm_head`` is tied to ``wte`` in both (tie_word_embeddings).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+def gpt2_params_from_torch(hf_model) -> Dict[str, Any]:
+    """Convert a ``transformers.GPT2LMHeadModel`` (or GPT2Model) state dict
+    into this framework's GPT param dict (stacked-scan layout, numpy fp32).
+
+    Returns a dict loadable as ``params`` by ``GPTModel``'s pure functions;
+    build the matching ``GPTConfig`` from ``hf_model.config`` via
+    ``gpt2_config_from_torch``.
+    """
+    sd = {k: v.detach().cpu().numpy().astype(np.float32)
+          for k, v in hf_model.state_dict().items()}
+    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    L = max(int(k.split(".")[1 + (1 if pre else 0)])
+            for k in sd if f"{pre}h." in k) + 1
+
+    def layer(i, name):
+        return sd[f"{pre}h.{i}.{name}"]
+
+    def stack(name):
+        return np.stack([layer(i, name) for i in range(L)])
+
+    params = {
+        "wte": sd[f"{pre}wte.weight"],
+        "wpe": sd[f"{pre}wpe.weight"],
+        "lnf_w": sd[f"{pre}ln_f.weight"],
+        "lnf_b": sd[f"{pre}ln_f.bias"],
+        "blocks_ln1_w": stack("ln_1.weight"),
+        "blocks_ln1_b": stack("ln_1.bias"),
+        "blocks_qkv_w": stack("attn.c_attn.weight"),   # Conv1D: (H, 3H) as-is
+        "blocks_qkv_b": stack("attn.c_attn.bias"),
+        "blocks_proj_w": stack("attn.c_proj.weight"),
+        "blocks_proj_b": stack("attn.c_proj.bias"),
+        "blocks_ln2_w": stack("ln_2.weight"),
+        "blocks_ln2_b": stack("ln_2.bias"),
+        "blocks_fc1_w": stack("mlp.c_fc.weight"),
+        "blocks_fc1_b": stack("mlp.c_fc.bias"),
+        "blocks_fc2_w": stack("mlp.c_proj.weight"),
+        "blocks_fc2_b": stack("mlp.c_proj.bias"),
+    }
+    return params
+
+
+def bert_params_from_torch(hf_model) -> Dict[str, Any]:
+    """Convert a ``transformers.BertModel`` state dict into this framework's
+    BERT param dict.  torch ``nn.Linear`` stores (out, in) — every dense
+    weight transposes into our ``h @ W`` orientation; Q/K/V concatenate into
+    the fused qkv projection."""
+    sd = {k: v.detach().cpu().numpy().astype(np.float32)
+          for k, v in hf_model.state_dict().items()}
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    L = max(int(k.split(".")[2 + (1 if pre else 0)])
+            for k in sd if f"{pre}encoder.layer." in k) + 1
+
+    def lw(i, name):  # layer tensor
+        return sd[f"{pre}encoder.layer.{i}.{name}"]
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    def qkv_w(i):
+        return np.concatenate(
+            [lw(i, f"attention.self.{n}.weight").T for n in ("query", "key",
+                                                             "value")], axis=1)
+
+    def qkv_b(i):
+        return np.concatenate(
+            [lw(i, f"attention.self.{n}.bias") for n in ("query", "key",
+                                                         "value")])
+
+    emb = f"{pre}embeddings."
+    params = {
+        "word_emb": sd[emb + "word_embeddings.weight"],
+        "pos_emb": sd[emb + "position_embeddings.weight"],
+        "type_emb": sd[emb + "token_type_embeddings.weight"],
+        "emb_ln_w": sd[emb + "LayerNorm.weight"],
+        "emb_ln_b": sd[emb + "LayerNorm.bias"],
+        "blocks_qkv_w": stack(qkv_w),
+        "blocks_qkv_b": stack(qkv_b),
+        "blocks_proj_w": stack(lambda i: lw(i, "attention.output.dense.weight").T),
+        "blocks_proj_b": stack(lambda i: lw(i, "attention.output.dense.bias")),
+        "blocks_ln1_w": stack(lambda i: lw(i, "attention.output.LayerNorm.weight")),
+        "blocks_ln1_b": stack(lambda i: lw(i, "attention.output.LayerNorm.bias")),
+        "blocks_fc1_w": stack(lambda i: lw(i, "intermediate.dense.weight").T),
+        "blocks_fc1_b": stack(lambda i: lw(i, "intermediate.dense.bias")),
+        "blocks_fc2_w": stack(lambda i: lw(i, "output.dense.weight").T),
+        "blocks_fc2_b": stack(lambda i: lw(i, "output.dense.bias")),
+        "blocks_ln2_w": stack(lambda i: lw(i, "output.LayerNorm.weight")),
+        "blocks_ln2_b": stack(lambda i: lw(i, "output.LayerNorm.bias")),
+    }
+    # pooler is absent on add_pooling_layer=False backbones (BertForMaskedLM)
+    if f"{pre}pooler.dense.weight" in sd:
+        params["pooler_w"] = sd[f"{pre}pooler.dense.weight"].T
+        params["pooler_b"] = sd[f"{pre}pooler.dense.bias"]
+    # MLM head (BertForMaskedLM / BertForPreTraining: cls.predictions.*)
+    if "cls.predictions.transform.dense.weight" in sd:
+        params["mlm_dense_w"] = sd["cls.predictions.transform.dense.weight"].T
+        params["mlm_dense_b"] = sd["cls.predictions.transform.dense.bias"]
+        params["mlm_ln_w"] = sd["cls.predictions.transform.LayerNorm.weight"]
+        params["mlm_ln_b"] = sd["cls.predictions.transform.LayerNorm.bias"]
+        params["mlm_bias"] = sd["cls.predictions.bias"]
+    # NSP head (BertForPreTraining: cls.seq_relationship)
+    if "cls.seq_relationship.weight" in sd:
+        params["nsp_w"] = sd["cls.seq_relationship.weight"].T
+        params["nsp_b"] = sd["cls.seq_relationship.bias"]
+    return params
+
+
+def bert_config_from_torch(hf_config, **overrides):
+    """Build the matching BertConfig from a ``transformers.BertConfig``."""
+    from .bert import BertConfig
+
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        num_attention_heads=hf_config.num_attention_heads,
+        intermediate_size=hf_config.intermediate_size,
+        max_position_embeddings=hf_config.max_position_embeddings,
+        type_vocab_size=hf_config.type_vocab_size,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        hidden_act=_map_act(hf_config.hidden_act),
+    )
+    kw.update(overrides)
+    return BertConfig(**kw)
+
+
+def gpt2_config_from_torch(hf_config, **overrides):
+    """Build the matching GPTConfig from a ``transformers.GPT2Config``."""
+    from .gpt import GPTConfig
+
+    kw = dict(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.n_embd,
+        num_layers=hf_config.n_layer,
+        num_attention_heads=hf_config.n_head,
+        intermediate_size=getattr(hf_config, "n_inner", None) or
+        4 * hf_config.n_embd,
+        max_position_embeddings=hf_config.n_positions,
+        layer_norm_epsilon=hf_config.layer_norm_epsilon,
+        tie_word_embeddings=True,
+        hidden_act=_map_act(hf_config.activation_function),
+    )
+    kw.update(overrides)
+    return GPTConfig(**kw)
+
+
+def _map_act(name: str) -> str:
+    """HF activation names → this framework's knob (exact vs tanh gelu)."""
+    mapping = {"gelu": "gelu", "gelu_new": "gelu_approx",
+               "gelu_pytorch_tanh": "gelu_approx", "gelu_approx": "gelu_approx"}
+    if name not in mapping:
+        raise ValueError(f"unsupported activation {name!r}; supported: "
+                         f"{sorted(mapping)}")
+    return mapping[name]

@@ -40,7 +40,8 @@ class GPTConfig:
                  attention_probs_dropout_prob=0.0, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
                  use_flash_attention=True, tie_word_embeddings=True,
-                 sequence_parallel=None, scan_unroll=1):
+                 sequence_parallel=None, scan_unroll=1,
+                 hidden_act="gelu_approx"):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -55,6 +56,12 @@ class GPTConfig:
         self.use_flash_attention = use_flash_attention
         self.tie_word_embeddings = tie_word_embeddings
         self.scan_unroll = scan_unroll  # layers per scan step (see scan_blocks)
+        # GPT-2's canonical activation is the tanh approximation ("gelu_new")
+        # — hence the approx default; "gelu" selects the exact erf form
+        if hidden_act not in ("gelu", "gelu_approx"):
+            raise ValueError(f"hidden_act must be 'gelu' or 'gelu_approx', "
+                             f"got {hidden_act!r}")
+        self.hidden_act = hidden_act
         # None → GSPMD decides (sequence gathered for attention);
         # "ring"/"ulysses" → explicit context parallelism over the "sep" axis
         if sequence_parallel not in (None, "ring", "ulysses"):
@@ -170,7 +177,8 @@ class GPTModel(Layer):
         h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
         m_in = self._block_ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"], dt)
         ff = jax.nn.gelu(m_in @ sl["blocks_fc1_w"].astype(dt)
-                         + sl["blocks_fc1_b"].astype(dt), approximate=True)
+                         + sl["blocks_fc1_b"].astype(dt),
+                         approximate=self.config.hidden_act == "gelu_approx")
         return h + ff @ sl["blocks_fc2_w"].astype(dt) + sl["blocks_fc2_b"].astype(dt)
 
     def block_fn(self, sl: Dict[str, Any], h, key=None, sp_mesh=None):
