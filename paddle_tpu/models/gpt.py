@@ -299,6 +299,12 @@ class GPTModel(Layer):
         att = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
         return self._block_post_attn(sl, h, att), ck, cv
 
+    def _embed_one(self, params, tok, t):
+        """Embed one token per row at position ``t``: (B,) -> (B, 1, H)."""
+        dt = jnp.dtype(self.config.compute_dtype)
+        return (jnp.take(params["wte"], tok[:, None], axis=0)
+                + params["wpe"][t][None, None, :]).astype(dt)
+
     def init_cache(self, batch_size: int, max_len: int):
         c = self.config
         dt = jnp.dtype(c.compute_dtype)
@@ -388,10 +394,6 @@ class GPTModel(Layer):
                 return jnp.argmax(logits32, -1).astype(jnp.int32)
             return jax.random.categorical(k, logits32, -1).astype(jnp.int32)
 
-        def embed_one(params, tok, t):
-            return (jnp.take(params["wte"], tok[:, None], axis=0)
-                    + params["wpe"][t][None, None, :]).astype(dt)
-
         @jax.jit
         def run(params, input_ids, key):
             h, caches = self.prefill(params, input_ids, max_len)
@@ -401,7 +403,7 @@ class GPTModel(Layer):
             def body(carry, i):
                 tok, caches, key = carry
                 t = P + i  # this token's position in the cache
-                h = embed_one(params, tok, t)
+                h = self._embed_one(params, tok, t)
                 h, caches = self.decode_step(params, h, caches, t)
                 key, sub = jax.random.split(key)
                 ntok = sample(self.head_fn(params, h), sub)
@@ -410,6 +412,132 @@ class GPTModel(Layer):
             (last, _, _), toks = jax.lax.scan(
                 body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        progs[cache_key] = run
+        return run
+
+    def generate_beam(self, params, input_ids, max_new_tokens: int,
+                      num_beams: int = 4, length_penalty: float = 1.0,
+                      eos_token_id: Optional[int] = None):
+        """Beam-search decoding on the KV cache (≙ generation_utils
+        BeamSearchScorer semantics, fixed length budget).
+
+        Returns (sequences (B, max_new_tokens), scores (B,)) for the best
+        beam per batch row; ``scores`` are summed log-probs divided by
+        length**length_penalty.  ``eos_token_id``: beams that emit EOS are
+        frozen (EOS repeats, log-prob stops accumulating) so shorter
+        hypotheses compete under the penalty.
+
+        TPU shape: beams fold into the batch dim (B*K), the cache reorder is
+        one take_along_axis per step, and the whole search is a single
+        lax.scan — no dynamic shapes, no host sync inside the loop.
+        """
+        c = self.config
+        B, P = input_ids.shape
+        K = int(num_beams)
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32), jnp.zeros((B,), jnp.float32)
+        max_len = P + max_new_tokens
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
+                             f"max_position_embeddings ({c.max_position_embeddings})")
+        run = self._beam_program(P, max_new_tokens, K, float(length_penalty),
+                                 eos_token_id)
+        return run(params, jnp.asarray(input_ids))
+
+    def _beam_program(self, P, max_new_tokens, K, length_penalty,
+                      eos_token_id):
+        cache_key = ("beam", P, max_new_tokens, K, length_penalty,
+                     eos_token_id)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        if cache_key in progs:
+            return progs[cache_key]
+        c = self.config
+        max_len = P + max_new_tokens
+        dt = jnp.dtype(c.compute_dtype)
+        V = c.vocab_size
+        NEG = jnp.float32(-1e30)
+
+        def logprobs_last(params, h):
+            return jax.nn.log_softmax(
+                self.head_fn(params, h)[:, -1, :].astype(jnp.float32), -1)
+
+        @jax.jit
+        def run(params, input_ids):
+            B = input_ids.shape[0]
+            h, caches = self.prefill(params, input_ids, max_len)
+            lp0 = logprobs_last(params, h)                      # (B, V)
+            # beams start identical: only beam 0 is live at step 0
+            top_lp, top_tok = jax.lax.top_k(lp0, K)             # (B, K)
+            cum = top_lp
+            if eos_token_id is not None:
+                finished0 = top_tok == eos_token_id
+            else:
+                finished0 = jnp.zeros((B, K), bool)
+            # per-beam hypothesis length (tokens incl. EOS): finished beams
+            # keep the length at which they finished so the length penalty
+            # ranks short hypotheses correctly (BeamSearchScorer semantics)
+            lengths0 = jnp.where(finished0, 1.0,
+                                 float(max_new_tokens)).astype(jnp.float32)
+            # tile caches per beam: (nl, B, ...) -> (nl, B*K, ...)
+            caches = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, K, axis=1), caches)
+
+            def body(carry, i):
+                tok, caches, cum, finished, lengths = carry
+                t = P + i
+                hh = self._embed_one(params, tok, t)
+                hh, caches = self.decode_step(params, hh, caches, t)
+                lp = logprobs_last(params, hh).reshape(B, K, V)
+                if eos_token_id is not None:
+                    # frozen beams: only EOS continues, at zero cost
+                    eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                    lp = jnp.where(finished[..., None], eos_only[None, None],
+                                   lp)
+                total = cum[..., None] + lp                      # (B, K, V)
+                flat = total.reshape(B, K * V)
+                cum, idx = jax.lax.top_k(flat, K)                # (B, K)
+                parent = idx // V
+                ntok = (idx % V).astype(jnp.int32)
+                if eos_token_id is not None:
+                    was = jnp.take_along_axis(finished, parent, axis=1)
+                    lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                    newly = ~was & (ntok == eos_token_id)
+                    # token emitted at body step i is hypothesis token i+2
+                    lengths = jnp.where(newly, (i + 2).astype(jnp.float32),
+                                        lengths)
+                    finished = was | newly
+                # reorder caches to the surviving beams
+                def reorder(a):
+                    nl = a.shape[0]
+                    ab = a.reshape((nl, B, K) + a.shape[2:])
+                    pidx = parent.reshape((1, B, K) + (1,) * (ab.ndim - 3))
+                    return jnp.take_along_axis(ab, pidx, axis=2).reshape(a.shape)
+                caches = jax.tree_util.tree_map(reorder, caches)
+                tok = ntok.reshape(B * K)
+                return (tok, caches, cum, finished, lengths), (ntok, parent)
+
+            (tok, _, cum, finished, lengths), (toks, parents) = jax.lax.scan(
+                body, (top_tok.reshape(B * K), caches, cum, finished0,
+                       lengths0),
+                jnp.arange(max_new_tokens - 1))
+
+            # backtrace: walk parents from the best final beam to step 0
+            scores = cum / jnp.power(lengths, length_penalty)
+            best = jnp.argmax(scores, axis=1)                    # (B,)
+
+            def back(k, step):
+                st, sp = step                                    # (B,K) each
+                tok_t = jnp.take_along_axis(st, k[:, None], 1)[:, 0]
+                k = jnp.take_along_axis(sp, k[:, None], 1)[:, 0]
+                return k, tok_t
+
+            k_last, toks_rev = jax.lax.scan(
+                back, best, (toks[::-1], parents[::-1]))
+            first = jnp.take_along_axis(top_tok, k_last[:, None], 1)[:, 0]
+            seq = jnp.concatenate([first[:, None], toks_rev[::-1].T], axis=1)
+            best_score = jnp.take_along_axis(scores, best[:, None], 1)[:, 0]
+            return seq, best_score
 
         progs[cache_key] = run
         return run

@@ -112,3 +112,88 @@ class TestProgramCache:
         model, params = model_and_params
         out = model.generate(params, np.zeros((2, 3), np.int64), 0)
         assert out.shape == (2, 0)
+
+
+def _beam_oracle(model, params, prompt, n, K):
+    """Brute-force beam search via full re-forward (no cache), numpy."""
+    B = prompt.shape[0]
+    assert B == 1
+    seqs = [list()]
+    h = model.scan_blocks(params, model.embed_fn(params, jnp.asarray(prompt)),
+                          remat=False)
+    lp0 = np.asarray(jax.nn.log_softmax(
+        model.head_fn(params, h)[:, -1].astype(jnp.float32), -1))[0]
+    order = np.argsort(-lp0)[:K]
+    beams = [([int(t)], float(lp0[t])) for t in order]
+    for _ in range(n - 1):
+        cand = []
+        for toks, score in beams:
+            ids = np.concatenate([prompt[0], np.asarray(toks)])[None]
+            h = model.scan_blocks(params,
+                                  model.embed_fn(params, jnp.asarray(ids)),
+                                  remat=False)
+            lp = np.asarray(jax.nn.log_softmax(
+                model.head_fn(params, h)[:, -1].astype(jnp.float32), -1))[0]
+            for t in np.argsort(-lp)[:K]:
+                cand.append((toks + [int(t)], score + float(lp[t])))
+        cand.sort(key=lambda x: -x[1])
+        beams = cand[:K]
+    return beams[0]
+
+
+class TestBeamSearch:
+    def test_matches_bruteforce_oracle(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.random.RandomState(8).randint(0, 97, (1, 5))
+        want_toks, want_score = _beam_oracle(model, params, prompt, 4, 3)
+        seq, score = model.generate_beam(params, prompt, max_new_tokens=4,
+                                         num_beams=3)
+        np.testing.assert_array_equal(np.asarray(seq)[0], want_toks)
+        np.testing.assert_allclose(float(score[0]), want_score / 4.0,
+                                   rtol=1e-4)
+
+    def test_single_beam_equals_greedy(self, model_and_params):
+        model, params = model_and_params
+        prompt = np.random.RandomState(9).randint(0, 97, (2, 4))
+        greedy = model.generate(params, prompt, max_new_tokens=5)
+        beam, _ = model.generate_beam(params, prompt, max_new_tokens=5,
+                                      num_beams=1)
+        np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+
+    def test_eos_freezes_beam(self, model_and_params):
+        """length_penalty=0 ⇒ raw cum log-prob scores: a beam that finishes
+        at step 0 (EOS = the argmax token) strictly beats any beam that keeps
+        accumulating negative log-probs, so the winner MUST be the frozen
+        all-EOS sequence — non-vacuous by construction."""
+        model, params = model_and_params
+        prompt = np.random.RandomState(10).randint(0, 97, (1, 4))
+        first = int(np.asarray(model.generate(params, prompt, 1))[0, 0])
+        seq, score = model.generate_beam(params, prompt, max_new_tokens=6,
+                                         num_beams=2, eos_token_id=first,
+                                         length_penalty=0.0)
+        s = np.asarray(seq)[0]
+        np.testing.assert_array_equal(s, np.full(6, first))
+        assert float(score[0]) < 0.0  # exactly the one-token log-prob
+
+    def test_length_penalty_uses_finish_length(self, model_and_params):
+        """Scores divide by each beam's TRUE hypothesis length (1 for a
+        step-0 EOS finish), not by max_new_tokens.  Under penalty=1.0 the
+        length-6 beam's mean log-prob beats the single-token beam's full
+        log-prob here, so the ranking flips vs penalty=0 — under the old
+        fixed-length bug the EOS beam's score would be cum/6 and it would
+        (wrongly) win both times."""
+        model, params = model_and_params
+        prompt = np.random.RandomState(11).randint(0, 97, (1, 4))
+        first = int(np.asarray(model.generate(params, prompt, 1))[0, 0])
+        seq0, s0 = model.generate_beam(params, prompt, max_new_tokens=6,
+                                       num_beams=2, eos_token_id=first,
+                                       length_penalty=0.0)
+        seq1, s1 = model.generate_beam(params, prompt, max_new_tokens=6,
+                                       num_beams=2, eos_token_id=first,
+                                       length_penalty=1.0)
+        # penalty=0 winner: the frozen all-EOS beam (raw cum favors short)
+        np.testing.assert_array_equal(np.asarray(seq0)[0], np.full(6, first))
+        # penalty=1 winner: a real length-6 continuation, scored as cum/6 —
+        # its score must beat the EOS beam's cum/1 (= s0, since 1**p == 1)
+        assert not np.all(np.asarray(seq1)[0] == first)
+        assert float(s1[0]) > float(s0[0])
