@@ -346,14 +346,16 @@ class GPTModel(Layer):
 
     def generate(self, params, input_ids, max_new_tokens: int,
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 greedy: bool = True, key=None):
+                 top_p: Optional[float] = None, greedy: bool = True, key=None):
         """Autoregressive generation with a static KV cache.
 
         input_ids (B, P) int32; returns (B, max_new_tokens) generated ids.
-        greedy=True → argmax decoding; else temperature (+ optional top-k)
-        sampling with ``key``.  The whole decode loop is ONE compiled
-        program per (P, max_new_tokens) pair — bucket P via
-        paddle.jit.bucketize for serving.
+        greedy=True → argmax decoding; else temperature (+ optional top-k
+        and/or nucleus top-p) sampling with ``key``.  The whole decode loop
+        is ONE compiled program per (P, max_new_tokens, temperature, top_k,
+        top_p, greedy) signature, memoized on the model — vary only the
+        prompt content (and bucket P via paddle.jit.bucketize) for serving
+        cache hits.
         """
         c = self.config
         B, P = input_ids.shape
@@ -365,17 +367,22 @@ class GPTModel(Layer):
                              f"max_position_embeddings ({c.max_position_embeddings})")
         if not greedy and key is None:
             raise ValueError("sampling (greedy=False) requires key")
+        if top_p is not None and not (0.0 < top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         key = jax.random.key(0) if key is None else key
         run = self._gen_program(P, max_new_tokens, float(temperature),
-                                None if top_k is None else int(top_k), greedy)
+                                None if top_k is None else int(top_k),
+                                None if top_p is None else float(top_p),
+                                greedy)
         return run(params, jnp.asarray(input_ids), key)
 
-    def _gen_program(self, P, max_new_tokens, temperature, top_k, greedy):
+    def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
+                     greedy):
         """Build (and memoize) the jitted prefill+decode program for one
-        (P, max_new_tokens, temperature, top_k, greedy) signature — repeated
-        generate() calls with the same shapes hit the jit cache instead of
-        recompiling the whole model."""
-        cache_key = (P, max_new_tokens, temperature, top_k, greedy)
+        (P, max_new_tokens, temperature, top_k, top_p, greedy) signature —
+        repeated generate() calls with the same signature hit the jit cache
+        instead of recompiling the whole model."""
+        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy)
         progs = self.__dict__.setdefault("_gen_programs", {})
         if cache_key in progs:
             return progs[cache_key]
@@ -390,6 +397,14 @@ class GPTModel(Layer):
                 vals, _ = jax.lax.top_k(logits32, top_k)
                 logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf,
                                      logits32)
+            if top_p is not None:
+                # nucleus: keep the smallest prefix of the sorted vocab with
+                # cumulative probability ≥ top_p (the boundary token stays)
+                srt = jnp.sort(logits32, -1)[:, ::-1]
+                cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
+                n_keep = jnp.sum(cdf < top_p, -1) + 1            # (B,)
+                kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None], 1)
+                logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
             if greedy:
                 return jnp.argmax(logits32, -1).astype(jnp.int32)
             return jax.random.categorical(k, logits32, -1).astype(jnp.int32)
@@ -435,6 +450,13 @@ class GPTModel(Layer):
         c = self.config
         B, P = input_ids.shape
         K = int(num_beams)
+        if not 1 <= K <= c.vocab_size:
+            raise ValueError(f"num_beams must be in [1, vocab_size="
+                             f"{c.vocab_size}], got {num_beams}")
+        if eos_token_id is not None and not 0 <= eos_token_id < c.vocab_size:
+            raise ValueError(f"eos_token_id {eos_token_id} outside the vocab "
+                             f"[0, {c.vocab_size}) — EOS freezing would "
+                             f"silently never trigger")
         if max_new_tokens <= 0:
             return jnp.zeros((B, 0), jnp.int32), jnp.zeros((B,), jnp.float32)
         max_len = P + max_new_tokens
@@ -454,7 +476,6 @@ class GPTModel(Layer):
             return progs[cache_key]
         c = self.config
         max_len = P + max_new_tokens
-        dt = jnp.dtype(c.compute_dtype)
         V = c.vocab_size
         NEG = jnp.float32(-1e30)
 
@@ -517,7 +538,7 @@ class GPTModel(Layer):
                 tok = ntok.reshape(B * K)
                 return (tok, caches, cum, finished, lengths), (ntok, parent)
 
-            (tok, _, cum, finished, lengths), (toks, parents) = jax.lax.scan(
+            (_, _, cum, _, lengths), (toks, parents) = jax.lax.scan(
                 body, (top_tok.reshape(B * K), caches, cum, finished0,
                        lengths0),
                 jnp.arange(max_new_tokens - 1))

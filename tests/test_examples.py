@@ -52,3 +52,8 @@ class TestExamples:
                    env_extra={"XLA_FLAGS":
                               "--xla_force_host_platform_device_count=8"})
         assert "step 1" in out
+
+    def test_generate_gpt(self):
+        out = _run("generate_gpt.py", "--max_new_tokens", "6",
+                   "--num_beams", "2")
+        assert "GENERATION_OK" in out

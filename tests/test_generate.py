@@ -96,15 +96,31 @@ class TestGenerate:
         with pytest.raises(ValueError, match="requires key"):
             model.generate(params, np.zeros((1, 2), np.int64), 2, greedy=False)
 
+    def test_top_p_tiny_nucleus_equals_greedy(self, model_and_params):
+        """top_p small enough that only the argmax token survives the
+        nucleus ⇒ sampling must reproduce the greedy sequence exactly."""
+        model, params = model_and_params
+        prompt = np.random.RandomState(12).randint(0, 97, (2, 4))
+        greedy = model.generate(params, prompt, max_new_tokens=5)
+        nucl = model.generate(params, prompt, max_new_tokens=5, greedy=False,
+                              top_p=1e-6, key=jax.random.key(0))
+        np.testing.assert_array_equal(np.asarray(nucl), np.asarray(greedy))
+
+    def test_top_p_validation(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(params, np.zeros((1, 2), np.int64), 2,
+                           greedy=False, top_p=1.5, key=jax.random.key(0))
+
 
 class TestProgramCache:
     def test_repeat_calls_reuse_compiled_program(self, model_and_params):
         model, params = model_and_params
         prompt = np.zeros((1, 4), np.int64)
         a = model.generate(params, prompt, max_new_tokens=3)
-        r1 = model._gen_program(4, 3, 1.0, None, True)
+        r1 = model._gen_program(4, 3, 1.0, None, None, True)
         b = model.generate(params, prompt, max_new_tokens=3)
-        r2 = model._gen_program(4, 3, 1.0, None, True)
+        r2 = model._gen_program(4, 3, 1.0, None, None, True)
         assert r1 is r2                       # same memoized jitted program
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
