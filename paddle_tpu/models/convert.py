@@ -16,9 +16,18 @@ Layout notes (HF GPT-2 → models/gpt.py):
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import numpy as np
+
+
+def _numpy_sd(hf_model, prefix: str) -> Tuple[Dict[str, Any], str]:
+    """state_dict → numpy fp32, plus the detected submodule prefix (HF task
+    heads wrap the backbone under e.g. 'transformer.'/'bert.'/'resnet.')."""
+    sd = {k: v.detach().cpu().numpy().astype(np.float32)
+          for k, v in hf_model.state_dict().items()}
+    pre = prefix if any(k.startswith(prefix) for k in sd) else ""
+    return sd, pre
 
 
 def gpt2_params_from_torch(hf_model) -> Dict[str, Any]:
@@ -29,9 +38,7 @@ def gpt2_params_from_torch(hf_model) -> Dict[str, Any]:
     build the matching ``GPTConfig`` from ``hf_model.config`` via
     ``gpt2_config_from_torch``.
     """
-    sd = {k: v.detach().cpu().numpy().astype(np.float32)
-          for k, v in hf_model.state_dict().items()}
-    pre = "transformer." if any(k.startswith("transformer.") for k in sd) else ""
+    sd, pre = _numpy_sd(hf_model, "transformer.")
     L = max(int(k.split(".")[1 + (1 if pre else 0)])
             for k in sd if f"{pre}h." in k) + 1
 
@@ -67,9 +74,7 @@ def bert_params_from_torch(hf_model) -> Dict[str, Any]:
     BERT param dict.  torch ``nn.Linear`` stores (out, in) — every dense
     weight transposes into our ``h @ W`` orientation; Q/K/V concatenate into
     the fused qkv projection."""
-    sd = {k: v.detach().cpu().numpy().astype(np.float32)
-          for k, v in hf_model.state_dict().items()}
-    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    sd, pre = _numpy_sd(hf_model, "bert.")
     L = max(int(k.split(".")[2 + (1 if pre else 0)])
             for k in sd if f"{pre}encoder.layer." in k) + 1
 
@@ -164,6 +169,65 @@ def gpt2_config_from_torch(hf_config, **overrides):
     )
     kw.update(overrides)
     return GPTConfig(**kw)
+
+
+def resnet_state_dict_from_torch(hf_model) -> Dict[str, Any]:
+    """Convert a ``transformers.ResNetModel`` / ``ResNetForImageClassification``
+    state dict into this framework's torchvision-layout ResNet state dict
+    (vision/models/resnet.py) — conv weights stay OIHW; BN running stats map
+    to the paddle ``_mean``/``_variance`` slots; the classifier Linear
+    transposes to our (in, out) orientation.
+
+    Requires ``downsample_in_bottleneck=False`` on the HF config (the
+    torchvision v1.5 stride placement this framework implements).
+    """
+    cfg = hf_model.config
+    if getattr(cfg, "downsample_in_bottleneck", False):
+        raise ValueError("downsample_in_bottleneck=True puts the stride in "
+                         "the 1x1 conv; this framework implements the "
+                         "torchvision v1.5 layout (stride in the 3x3)")
+    if getattr(cfg, "downsample_in_first_stage", False):
+        raise ValueError("downsample_in_first_stage=True strides stage 0; "
+                         "this framework's layer1 is stride 1 (torchvision "
+                         "layout) — the weights would load but compute "
+                         "wrong logits")
+    if getattr(cfg, "hidden_act", "relu") != "relu":
+        raise ValueError(f"hidden_act={cfg.hidden_act!r} unsupported: the "
+                         f"framework's ResNet blocks use ReLU (torchvision "
+                         f"semantics)")
+    sd, pre = _numpy_sd(hf_model, "resnet.")
+
+    def bn(dst, src):
+        return {f"{dst}.weight": sd[f"{src}.weight"],
+                f"{dst}.bias": sd[f"{src}.bias"],
+                f"{dst}._mean": sd[f"{src}.running_mean"],
+                f"{dst}._variance": sd[f"{src}.running_var"]}
+
+    out: Dict[str, Any] = {
+        "conv1.weight": sd[f"{pre}embedder.embedder.convolution.weight"]}
+    out.update(bn("bn1", f"{pre}embedder.embedder.normalization"))
+
+    n_stages = len(hf_model.config.depths)
+    for s in range(n_stages):
+        for j in range(hf_model.config.depths[s]):
+            hfp = f"{pre}encoder.stages.{s}.layers.{j}"
+            ours = f"layer{s + 1}.{j}"
+            i = 0
+            while f"{hfp}.layer.{i}.convolution.weight" in sd:
+                out[f"{ours}.conv{i + 1}.weight"] = \
+                    sd[f"{hfp}.layer.{i}.convolution.weight"]
+                out.update(bn(f"{ours}.bn{i + 1}",
+                              f"{hfp}.layer.{i}.normalization"))
+                i += 1
+            if f"{hfp}.shortcut.convolution.weight" in sd:
+                out[f"{ours}.downsample.0.weight"] = \
+                    sd[f"{hfp}.shortcut.convolution.weight"]
+                out.update(bn(f"{ours}.downsample.1",
+                              f"{hfp}.shortcut.normalization"))
+    if "classifier.1.weight" in sd:
+        out["fc.weight"] = sd["classifier.1.weight"].T
+        out["fc.bias"] = sd["classifier.1.bias"]
+    return out
 
 
 def _map_act(name: str) -> str:

@@ -173,3 +173,43 @@ class TestBertMLMParity:
         h = model.encode(params, jnp.asarray(ids))
         got = np.asarray(model._mlm_logits(params, h))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+class TestResNetParity:
+    @pytest.mark.parametrize("data_format", ["NCHW", "NHWC"])
+    def test_resnet18_logits_match_torch(self, data_format):
+        """transformers ResNetForImageClassification (basic blocks, resnet18
+        geometry) vs our torchvision-layout ResNet in BOTH layouts — an
+        external oracle over the conv/BN/pool stack including running-stats
+        eval semantics."""
+        from paddle_tpu.models.convert import resnet_state_dict_from_torch
+        from paddle_tpu.vision.models import resnet18
+
+        hf_cfg = transformers.ResNetConfig(
+            num_channels=3, embedding_size=64,
+            hidden_sizes=[64, 128, 256, 512], depths=[2, 2, 2, 2],
+            layer_type="basic", downsample_in_first_stage=False,
+            num_labels=7)
+        torch.manual_seed(3)
+        hf = transformers.ResNetForImageClassification(hf_cfg).eval()
+        # random-but-nontrivial BN stats (fresh init has mean 0 / var 1)
+        with torch.no_grad():
+            hf(torch.randn(4, 3, 64, 64))  # train-mode pass would update...
+        hf.train()
+        with torch.no_grad():
+            for _ in range(2):
+                hf(torch.randn(4, 3, 64, 64))
+        hf.eval()
+
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        model = resnet18(num_classes=7, data_format=data_format)
+        model.set_state_dict(resnet_state_dict_from_torch(hf))
+        model.eval()
+
+        x = np.random.RandomState(7).randn(2, 3, 64, 64).astype(np.float32)
+        with torch.no_grad():
+            want = hf(torch.tensor(x)).logits.numpy()
+        xin = x.transpose(0, 2, 3, 1) if data_format == "NHWC" else x
+        got = np.asarray(model(paddle.to_tensor(xin))._data)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
