@@ -1,0 +1,65 @@
+"""Bucketized BERT serving: ragged request lengths, a BOUNDED set of
+compiled programs, exact results (padding is masked out of attention and
+pooling).
+
+Run (CPU):  JAX_PLATFORMS=cpu python examples/serve_bucketed.py
+
+This is the TPU-native replacement for the reference's LoD/variable-length
+handling (fluid/lod_tensor.py): XLA needs static shapes, so a serving loop
+pads every request up to the smallest admissible bucket
+(paddle.jit.bucketize) and passes the true length in as a traced scalar —
+lengths vary freely per request with zero recompiles within a bucket.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def main():
+    import jax.numpy as jnp
+    from paddle_tpu.jit import bucketize, length_mask
+    from paddle_tpu.models.bert import BertConfig, BertModel
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=500, hidden_size=64, num_hidden_layers=2,
+                     num_attention_heads=4, intermediate_size=128,
+                     max_position_embeddings=64, compute_dtype="float32",
+                     use_flash_attention=False)
+    model = BertModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+
+    def embed(ids, length=None):
+        """Mean-pooled sentence embedding over REAL tokens only; padding is
+        masked out of both attention and the pool."""
+        B, L = ids.shape
+        if length is None:
+            length = jnp.asarray(L, jnp.int32)
+        m = length_mask(length, L)                        # (L,) 1=real 0=pad
+        attn = jnp.broadcast_to((m - 1.0) * 1e30, (B, 1, 1, L))
+        h = model.encode(params, ids, attn_mask=attn)
+        pooled = jnp.sum(h * m[None, :, None], axis=1) / length
+        return pooled
+
+    serve = bucketize(embed, buckets=(16, 32), axis=1, length_arg="length")
+
+    rng = np.random.RandomState(0)
+    requests = [rng.randint(0, 500, (1, L)) for L in (5, 11, 16, 23, 9, 32)]
+    outs = [np.asarray(serve(jnp.asarray(ids))) for ids in requests]
+
+    # exactness: bucketed result == direct unpadded run, per request
+    for ids, out in zip(requests, outs):
+        direct = np.asarray(embed(jnp.asarray(ids)))
+        np.testing.assert_allclose(out, direct, rtol=2e-5, atol=2e-5)
+
+    print("served", len(requests), "ragged requests over buckets",
+          serve.buckets, "- results exact vs unpadded runs")
+    print("SERVE_OK")
+
+
+if __name__ == "__main__":
+    main()

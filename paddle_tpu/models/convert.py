@@ -16,6 +16,7 @@ Layout notes (HF GPT-2 → models/gpt.py):
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -227,6 +228,12 @@ def resnet_state_dict_from_torch(hf_model) -> Dict[str, Any]:
     if "classifier.1.weight" in sd:
         out["fc.weight"] = sd["classifier.1.weight"].T
         out["fc.bias"] = sd["classifier.1.bias"]
+    else:
+        warnings.warn(
+            "converted a headless ResNetModel backbone: no classifier in the "
+            "checkpoint, so fc.weight/fc.bias are NOT in the returned dict — "
+            "the target model's head keeps its current (random) init",
+            stacklevel=2)
     return out
 
 

@@ -191,10 +191,9 @@ class TestResNetParity:
             layer_type="basic", downsample_in_first_stage=False,
             num_labels=7)
         torch.manual_seed(3)
-        hf = transformers.ResNetForImageClassification(hf_cfg).eval()
-        # random-but-nontrivial BN stats (fresh init has mean 0 / var 1)
-        with torch.no_grad():
-            hf(torch.randn(4, 3, 64, 64))  # train-mode pass would update...
+        hf = transformers.ResNetForImageClassification(hf_cfg)
+        # random-but-nontrivial BN running stats (fresh init is mean 0/var 1,
+        # which would mask running-stat mapping bugs): two train-mode passes
         hf.train()
         with torch.no_grad():
             for _ in range(2):

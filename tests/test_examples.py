@@ -57,3 +57,7 @@ class TestExamples:
         out = _run("generate_gpt.py", "--max_new_tokens", "6",
                    "--num_beams", "2")
         assert "GENERATION_OK" in out
+
+    def test_serve_bucketed(self):
+        out = _run("serve_bucketed.py")
+        assert "SERVE_OK" in out
