@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.base import Layer
 from ..ops.attention import flash_attention
-from ..ops.moe import moe_ffn, moe_ffn_indices
+from ..ops.moe import moe_ffn, moe_ffn_gather, moe_ffn_indices
 
 
 class ErnieMoeConfig:
@@ -114,28 +114,40 @@ class ErnieMoeModel(Layer):
         h = jnp.take(params["wte"], input_ids, axis=0) + params["wpe"][pos]
         return h.astype(jnp.dtype(c.compute_dtype))
 
-    def block_fn(self, sl: Dict[str, Any], h, mesh=None):
-        """One block; returns (h, aux_loss)."""
+    def _block_ln(self, x, w, b, dt):
+        x32 = x.astype(jnp.float32)
+        m = x32.mean(-1, keepdims=True)
+        v = x32.var(-1, keepdims=True)
+        return ((x32 - m) * jax.lax.rsqrt(v + self.config.layer_norm_epsilon)
+                * w + b).astype(dt)
+
+    def _block_qkv(self, sl, h):
+        """pre-LN + fused QKV; returns q, k, v as (B, L, nh, hd)."""
         c = self.config
         dt = h.dtype
-        eps = c.layer_norm_epsilon
         B, Lq, H = h.shape
         nh = c.num_attention_heads
         hd = H // nh
-
-        def ln(x, w, b):
-            x32 = x.astype(jnp.float32)
-            m = x32.mean(-1, keepdims=True)
-            v = x32.var(-1, keepdims=True)
-            return ((x32 - m) * jax.lax.rsqrt(v + eps) * w + b).astype(dt)
-
-        a_in = ln(h, sl["blocks_ln1_w"], sl["blocks_ln1_b"])
+        a_in = self._block_ln(h, sl["blocks_ln1_w"], sl["blocks_ln1_b"], dt)
         qkv = a_in @ sl["blocks_qkv_w"].astype(dt) + sl["blocks_qkv_b"].astype(dt)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q, k, v = (t.reshape(B, Lq, nh, hd) for t in (q, k, v))
-        att = flash_attention(q, k, v, causal=True).reshape(B, Lq, H)
-        h = h + att @ sl["blocks_proj_w"].astype(dt) + sl["blocks_proj_b"].astype(dt)
-        m_in = ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"])
+        return (q.reshape(B, Lq, nh, hd), k.reshape(B, Lq, nh, hd),
+                v.reshape(B, Lq, nh, hd))
+
+    def _attn_residual(self, sl, h, att):
+        dt = h.dtype
+        B, Lq, H = h.shape
+        att = att.reshape(B, Lq, H)
+        return h + att @ sl["blocks_proj_w"].astype(dt) \
+            + sl["blocks_proj_b"].astype(dt)
+
+    def _moe_residual(self, sl, h, mesh=None, capacity_factor=None):
+        """ln2 + routed FFN + residual.  capacity_factor=None → training
+        config; a float overrides (generation passes the no-drop value)."""
+        c = self.config
+        dt = h.dtype
+        B, Lq, H = h.shape
+        m_in = self._block_ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"], dt)
         tokens = m_in.reshape(B * Lq, H)
         # index (gather/scatter) dispatch by default — the einsum dispatch's
         # (T, E, C) masks cost ~2x the expert FLOPs at bench shapes
@@ -143,9 +155,18 @@ class ErnieMoeModel(Layer):
         out, aux = ffn(tokens, sl["blocks_gate_w"], sl["blocks_expert_w1"],
                        sl["blocks_expert_b1"], sl["blocks_expert_w2"],
                        sl["blocks_expert_b2"], k=c.top_k,
-                       capacity_factor=c.capacity_factor, mesh=mesh,
-                       expert_axis=c.expert_axis)
+                       capacity_factor=(c.capacity_factor
+                                        if capacity_factor is None
+                                        else capacity_factor),
+                       mesh=mesh, expert_axis=c.expert_axis)
         return h + out.reshape(B, Lq, H), aux
+
+    def block_fn(self, sl: Dict[str, Any], h, mesh=None):
+        """One block; returns (h, aux_loss)."""
+        q, k, v = self._block_qkv(sl, h)
+        att = flash_attention(q, k, v, causal=True)
+        h = self._attn_residual(sl, h, att)
+        return self._moe_residual(sl, h, mesh=mesh)
 
     def scan_blocks(self, params, h, mesh=None, remat=True):
         stacked = {k: params[k] for k in self.stacked_param_names()}
@@ -166,19 +187,21 @@ class ErnieMoeModel(Layer):
                                          unroll=resolve_scan_unroll(self.config))
         return out, aux_sum
 
-    def head_loss_fn(self, params, h, labels, aux_sum=0.0):
+    def _head_logits(self, params, h, dtype=None):
         c = self.config
         x32 = h.astype(jnp.float32)
         m = x32.mean(-1, keepdims=True)
         v = x32.var(-1, keepdims=True)
         hn = (x32 - m) * jax.lax.rsqrt(v + c.layer_norm_epsilon) * params["lnf_w"] \
             + params["lnf_b"]
-        dt = jnp.dtype(c.compute_dtype)
-        logits = hn.astype(dt) @ params["wte"].astype(dt).T
+        dt = jnp.dtype(c.compute_dtype) if dtype is None else dtype
+        return hn.astype(dt) @ params["wte"].astype(dt).T
+
+    def head_loss_fn(self, params, h, labels, aux_sum=0.0):
         # fused CE — no fp32 (B, L, V) log-prob tensor (ops/loss.py)
         from ..ops.loss import softmax_cross_entropy_mean
-        nll = softmax_cross_entropy_mean(logits, labels)
-        return nll + c.aux_loss_weight * aux_sum
+        nll = softmax_cross_entropy_mean(self._head_logits(params, h), labels)
+        return nll + self.config.aux_loss_weight * aux_sum
 
     # ------------------------------------------------------------- nn.Layer
     def forward(self, input_ids, labels=None):
@@ -187,17 +210,145 @@ class ErnieMoeModel(Layer):
         h = self.embed_fn(params, raw)
         h, aux = self.scan_blocks(params, h, remat=False)
         if labels is None:
-            c = self.config
-            x32 = h.astype(jnp.float32)
-            m = x32.mean(-1, keepdims=True)
-            v = x32.var(-1, keepdims=True)
-            hn = (x32 - m) * jax.lax.rsqrt(v + c.layer_norm_epsilon) \
-                * params["lnf_w"] + params["lnf_b"]
-            logits = hn @ params["wte"].astype(jnp.float32).T
+            logits = self._head_logits(params, h, dtype=jnp.float32)
             return Tensor(logits) if isinstance(input_ids, Tensor) else logits
         raw_labels = getattr(labels, "_data", labels)
         loss = self.head_loss_fn(params, h, raw_labels, aux)
         return Tensor(loss) if isinstance(input_ids, Tensor) else loss
+
+    # ------------------------------------------------- KV-cache generation
+    # Same static-cache single-scan design as models/gpt.py, with one MoE
+    # twist: capacity-based token dropping is CONTEXT-dependent, so an
+    # incremental decode only reproduces the full forward if nothing drops.
+    # Generation therefore routes with a no-drop capacity (cf = E/k ⇒
+    # C >= T always) in both prefill and decode — which is also the right
+    # serving behavior (dropping a live request's FFN output is not an
+    # option at inference).
+
+    def _nodrop_cf(self) -> float:
+        c = self.config
+        return float(c.num_experts) / float(c.top_k)
+
+    def _moe_residual_gather(self, sl, h):
+        """ln2 + capacity-free gather-dispatch FFN + residual — the decode
+        hot path: O(k·T) expert FLOPs, no (E, C, H) buffer (ops/moe.py:
+        moe_ffn_gather; equal to the no-drop indices path by test)."""
+        c = self.config
+        dt = h.dtype
+        B, Lq, H = h.shape
+        m_in = self._block_ln(h, sl["blocks_ln2_w"], sl["blocks_ln2_b"], dt)
+        out = moe_ffn_gather(m_in.reshape(B * Lq, H), sl["blocks_gate_w"],
+                             sl["blocks_expert_w1"], sl["blocks_expert_b1"],
+                             sl["blocks_expert_w2"], sl["blocks_expert_b2"],
+                             k=c.top_k)
+        return h + out.reshape(B, Lq, H)
+
+    def _block_decode(self, sl, h, ck, cv, t):
+        """One block for one new token at position t (h (B,1,H); ck/cv
+        (B, max_len, nh, hd))."""
+        from .gpt import cached_attention
+        q, k, v = self._block_qkv(sl, h)
+        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
+        att = cached_attention(q, ck, cv, t)
+        h = self._attn_residual(sl, h, att)
+        return self._moe_residual_gather(sl, h), ck, cv
+
+    def prefill(self, params, input_ids, max_len: int):
+        """Prompt pass with no-drop routing; returns (h, (ck, cv)) with
+        caches filled at [0, P).  Uses the buffered no-drop indices dispatch
+        (cf = E/k): at prefill T = B·P is large, so gathering (T, k, H, I)
+        weight slices would cost more than the padded buffer does."""
+        c = self.config
+        B, P = input_ids.shape
+        h = self.embed_fn(params, input_ids)
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+
+        def body(carry, sl):
+            q, k, v = self._block_qkv(sl, carry)
+            att = flash_attention(q, k, v, causal=True)
+            hh = self._attn_residual(sl, carry, att)
+            hh, _ = self._moe_residual(sl, hh,
+                                       capacity_factor=self._nodrop_cf())
+            return hh, (k, v)
+
+        h, (ks, vs) = jax.lax.scan(body, h, stacked)
+        pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
+        cdt = jnp.dtype(c.compute_dtype)
+        return h, (jnp.pad(ks.astype(cdt), pad), jnp.pad(vs.astype(cdt), pad))
+
+    def decode_step(self, params, h, caches, t):
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+
+        def body(carry, xs):
+            sl, ck, cv = xs
+            out, ck, cv = self._block_decode(sl, carry, ck, cv, t)
+            return out, (ck, cv)
+
+        h, (cks, cvs) = jax.lax.scan(body, h, (stacked, caches[0], caches[1]))
+        return h, (cks, cvs)
+
+    def generate(self, params, input_ids, max_new_tokens: int,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, greedy: bool = True,
+                 key=None):
+        """Greedy / temperature(+top-k/top-p) generation with the static KV
+        cache and no-drop MoE routing (see class notes).  Returns
+        (B, max_new_tokens) int32."""
+        from .gpt import validate_sampler_args
+        c = self.config
+        B, P = input_ids.shape
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        max_len = P + max_new_tokens
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
+                             f"max_position_embeddings "
+                             f"({c.max_position_embeddings})")
+        validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
+        key = jax.random.key(0) if key is None else key
+        run = self._gen_program(P, max_new_tokens, float(temperature),
+                                None if top_k is None else int(top_k),
+                                None if top_p is None else float(top_p),
+                                greedy)
+        return run(params, jnp.asarray(input_ids), key)
+
+    def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
+                    greedy):
+        from .gpt import make_token_sampler
+        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        if cache_key in progs:
+            return progs[cache_key]
+        c = self.config
+        max_len = P + max_new_tokens
+        dt = jnp.dtype(c.compute_dtype)
+        sample = make_token_sampler(temperature, top_k, top_p, greedy)
+
+        @jax.jit
+        def run(params, input_ids, key):
+            h, caches = self.prefill(params, input_ids, max_len)
+            key, k0 = jax.random.split(key)
+            tok0 = sample(self._head_logits(params, h[:, -1:],
+                                            dtype=jnp.float32), k0)
+
+            def body(carry, i):
+                tok, caches, key = carry
+                t = P + i
+                hh = (jnp.take(params["wte"], tok[:, None], axis=0)
+                      + params["wpe"][t][None, None, :]).astype(dt)
+                hh, caches = self.decode_step(params, hh, caches, t)
+                key, sub = jax.random.split(key)
+                ntok = sample(self._head_logits(params, hh,
+                                                dtype=jnp.float32), sub)
+                return (ntok, caches, key), ntok
+
+            (_, _, _), toks = jax.lax.scan(
+                body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        progs[cache_key] = run
+        return run
 
 
 def make_ernie_moe_train_step(model: ErnieMoeModel, optimizer, hcg,

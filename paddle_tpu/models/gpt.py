@@ -33,6 +33,55 @@ from ..nn.layer.base import Layer
 from ..ops.attention import flash_attention
 
 
+def cached_attention(q, ck, cv, t):
+    """Single-query attention against a static KV cache, masked to positions
+    ≤ t (slots beyond t hold zeros or stale values).  q (B, 1, nh, hd);
+    ck/cv (B, max_len, nh, hd).  Shared by the GPT and ERNIE-MoE decode
+    paths so the mask/scale/precision conventions cannot drift."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    mask = jnp.arange(ck.shape[1]) <= t
+    scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def make_token_sampler(temperature, top_k, top_p, greedy):
+    """Shared last-position sampler for the decode loops (GPT + ERNIE-MoE):
+    temperature → optional top-k filter → optional nucleus (top-p) filter →
+    argmax or categorical.  ``logits32`` is (B, 1, V) fp32."""
+    def sample(logits32, key):
+        logits32 = logits32[:, -1, :] / jnp.asarray(
+            max(temperature, 1e-6), jnp.float32)
+        if top_k is not None:
+            vals, _ = jax.lax.top_k(logits32, top_k)
+            logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf, logits32)
+        if top_p is not None:
+            # nucleus: keep the smallest prefix of the sorted vocab with
+            # cumulative probability ≥ top_p (the boundary token stays)
+            srt = jnp.sort(logits32, -1)[:, ::-1]
+            cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
+            n_keep = jnp.sum(cdf < top_p, -1) + 1
+            kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None], 1)
+            logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
+        if greedy:
+            return jnp.argmax(logits32, -1).astype(jnp.int32)
+        return jax.random.categorical(key, logits32, -1).astype(jnp.int32)
+    return sample
+
+
+def validate_sampler_args(vocab_size, top_k, top_p, greedy, key):
+    """Common generate() argument validation (fail before tracing)."""
+    if not greedy and key is None:
+        raise ValueError("sampling (greedy=False) requires key")
+    if top_k is not None and not 1 <= int(top_k) <= vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size={vocab_size}], "
+                         f"got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 class GPTConfig:
     def __init__(self, vocab_size=50304, hidden_size=768, num_layers=12,
                  num_attention_heads=12, intermediate_size=None,
@@ -290,13 +339,7 @@ class GPTModel(Layer):
         q, k, v = self._block_qkv(sl, h)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
-        hd = q.shape[-1]
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
-            jnp.asarray(hd, jnp.float32)).astype(q.dtype)
-        mask = jnp.arange(ck.shape[1]) <= t
-        scores = jnp.where(mask[None, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
-        att = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        att = cached_attention(q, ck, cv, t)
         return self._block_post_attn(sl, h, att), ck, cv
 
     def _embed_one(self, params, tok, t):
@@ -365,10 +408,7 @@ class GPTModel(Layer):
         if max_len > c.max_position_embeddings:
             raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
                              f"max_position_embeddings ({c.max_position_embeddings})")
-        if not greedy and key is None:
-            raise ValueError("sampling (greedy=False) requires key")
-        if top_p is not None and not (0.0 < top_p <= 1.0):
-            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
         key = jax.random.key(0) if key is None else key
         run = self._gen_program(P, max_new_tokens, float(temperature),
                                 None if top_k is None else int(top_k),
@@ -386,28 +426,8 @@ class GPTModel(Layer):
         progs = self.__dict__.setdefault("_gen_programs", {})
         if cache_key in progs:
             return progs[cache_key]
-        c = self.config
         max_len = P + max_new_tokens
-        dt = jnp.dtype(c.compute_dtype)
-
-        def sample(logits32, k):
-            logits32 = logits32[:, -1, :] / jnp.asarray(
-                max(temperature, 1e-6), jnp.float32)
-            if top_k is not None:
-                vals, _ = jax.lax.top_k(logits32, top_k)
-                logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf,
-                                     logits32)
-            if top_p is not None:
-                # nucleus: keep the smallest prefix of the sorted vocab with
-                # cumulative probability ≥ top_p (the boundary token stays)
-                srt = jnp.sort(logits32, -1)[:, ::-1]
-                cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
-                n_keep = jnp.sum(cdf < top_p, -1) + 1            # (B,)
-                kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None], 1)
-                logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
-            if greedy:
-                return jnp.argmax(logits32, -1).astype(jnp.int32)
-            return jax.random.categorical(k, logits32, -1).astype(jnp.int32)
+        sample = make_token_sampler(temperature, top_k, top_p, greedy)
 
         @jax.jit
         def run(params, input_ids, key):

@@ -224,3 +224,37 @@ def moe_ffn_indices(x, gate_w, w1, b1, w2, b2, k: int = 2,
     out = jnp.sum(picked.astype(jnp.float32)
                   * gates[..., None], axis=1).astype(x.dtype)
     return out, aux
+
+
+def moe_ffn_gather(x, gate_w, w1, b1, w2, b2, k: int = 2,
+                   activation=jax.nn.gelu):
+    """Capacity-FREE MoE FFN via per-token expert-weight gather — the
+    inference/decode dispatch (≙ the reference's no-drop serving path).
+
+    No (E, C, H) buffer and no wasted rows: expert FLOPs are exactly O(k·T)
+    at the price of gathering k weight slices per token, which wins when T
+    is small (the per-token decode loop).  Numerically equal to
+    ``moe_ffn_indices`` at a no-drop capacity (same renormalized top-k
+    combine weights); returns the output only — the aux load-balance loss is
+    a training quantity.
+    """
+    T, H = x.shape
+    logits32 = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits32, axis=-1)
+    top_p, top_e = jax.lax.top_k(probs, k)              # (T, k)
+    if k > 1:  # GShard renormalization over the selected k
+        gates = top_p / jnp.maximum(jnp.sum(top_p, -1, keepdims=True), 1e-9)
+    else:
+        gates = top_p
+    w1s = jnp.take(w1, top_e, axis=0)                   # (T, k, H, I)
+    b1s = jnp.take(b1, top_e, axis=0)                   # (T, k, I)
+    h1 = activation(jnp.einsum("th,tkhi->tki", x, w1s.astype(x.dtype))
+                    + b1s.astype(x.dtype))
+    w2s = jnp.take(w2, top_e, axis=0)                   # (T, k, I, H)
+    b2s = jnp.take(b2, top_e, axis=0)                   # (T, k, H)
+    out = jnp.einsum("tki,tkih->tkh", h1, w2s.astype(x.dtype)) \
+        + b2s.astype(x.dtype)
+    # combine in fp32 like moe_ffn_indices — the numerical-equality contract
+    # must hold at bf16 compute dtype too
+    return jnp.sum(out.astype(jnp.float32) * gates[..., None],
+                   axis=1).astype(x.dtype)

@@ -300,3 +300,46 @@ class TestIndexDispatchParity:
         o2, _ = moe_ffn_indices(x, gw, w1, b1, w2, b2, k=2, capacity_factor=0.3)
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestGatherDispatch:
+    def test_gather_equals_indices_at_nodrop(self):
+        """moe_ffn_gather == moe_ffn_indices with a no-drop capacity (the
+        contract the decode path relies on), for k=1 and k=2."""
+        from paddle_tpu.ops.moe import moe_ffn_gather, moe_ffn_indices
+
+        rs = np.random.RandomState(0)
+        T, H, I, E = 10, 16, 32, 4
+        x = jnp.asarray(rs.randn(T, H), jnp.float32)
+        gw = jnp.asarray(rs.randn(H, E), jnp.float32)
+        w1 = jnp.asarray(rs.randn(E, H, I) * 0.1, jnp.float32)
+        b1 = jnp.asarray(rs.randn(E, I) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rs.randn(E, I, H) * 0.1, jnp.float32)
+        b2 = jnp.asarray(rs.randn(E, H) * 0.1, jnp.float32)
+        for k in (1, 2):
+            want, _ = moe_ffn_indices(x, gw, w1, b1, w2, b2, k=k,
+                                      capacity_factor=float(E) / k)
+            got = moe_ffn_gather(x, gw, w1, b1, w2, b2, k=k)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-6, err_msg=f"k={k}")
+
+    def test_gather_equals_indices_bf16(self):
+        """The equality contract must hold at the default bf16 compute dtype
+        too (decode runs bf16 in production; the combine accumulates fp32 on
+        both paths)."""
+        from paddle_tpu.ops.moe import moe_ffn_gather, moe_ffn_indices
+
+        rs = np.random.RandomState(1)
+        T, H, I, E = 8, 16, 32, 4
+        x = jnp.asarray(rs.randn(T, H), jnp.bfloat16)
+        gw = jnp.asarray(rs.randn(H, E), jnp.float32)
+        w1 = jnp.asarray(rs.randn(E, H, I) * 0.1, jnp.float32)
+        b1 = jnp.asarray(rs.randn(E, I) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rs.randn(E, I, H) * 0.1, jnp.float32)
+        b2 = jnp.asarray(rs.randn(E, H) * 0.1, jnp.float32)
+        want, _ = moe_ffn_indices(x, gw, w1, b1, w2, b2, k=2,
+                                  capacity_factor=float(E) / 2)
+        got = moe_ffn_gather(x, gw, w1, b1, w2, b2, k=2)
+        np.testing.assert_allclose(np.asarray(got, np.float32),
+                                   np.asarray(want, np.float32),
+                                   rtol=3e-2, atol=3e-2)
