@@ -1,0 +1,294 @@
+"""Shared KV-cache decode machinery for the causal LMs (GPT, ERNIE-MoE).
+
+≙ the reference ecosystem's generation stack (paddlenlp generation_utils;
+fused_multi_transformer_op's CacheKV).  One module so the mask/scale/
+precision conventions and the sampler cannot drift between model families.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def cached_attention(q, ck, cv, t):
+    """Single-query attention against a static KV cache, masked to positions
+    ≤ t (slots beyond t hold zeros or stale values).  q (B, 1, nh, hd);
+    ck/cv (B, max_len, nh, hd).  Shared by the GPT and ERNIE-MoE decode
+    paths so the mask/scale/precision conventions cannot drift."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
+        jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    mask = jnp.arange(ck.shape[1]) <= t
+    scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def make_token_sampler(temperature, top_k, top_p, greedy):
+    """Shared last-position sampler for the decode loops (GPT + ERNIE-MoE):
+    temperature → optional top-k filter → optional nucleus (top-p) filter →
+    argmax or categorical.  ``logits32`` is (B, 1, V) fp32."""
+    def sample(logits32, key):
+        logits32 = logits32[:, -1, :] / jnp.asarray(
+            max(temperature, 1e-6), jnp.float32)
+        if top_k is not None:
+            vals, _ = jax.lax.top_k(logits32, top_k)
+            logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf, logits32)
+        if top_p is not None:
+            # nucleus: keep the smallest prefix of the sorted vocab with
+            # cumulative probability ≥ top_p (the boundary token stays)
+            srt = jnp.sort(logits32, -1)[:, ::-1]
+            cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
+            n_keep = jnp.sum(cdf < top_p, -1) + 1
+            kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None], 1)
+            logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
+        if greedy:
+            return jnp.argmax(logits32, -1).astype(jnp.int32)
+        return jax.random.categorical(key, logits32, -1).astype(jnp.int32)
+    return sample
+
+
+def validate_sampler_args(vocab_size, top_k, top_p, greedy, key):
+    """Common generate() argument validation (fail before tracing)."""
+    if not greedy and key is None:
+        raise ValueError("sampling (greedy=False) requires key")
+    if top_k is not None and not 1 <= int(top_k) <= vocab_size:
+        raise ValueError(f"top_k must be in [1, vocab_size={vocab_size}], "
+                         f"got {top_k}")
+    if top_p is not None and not (0.0 < top_p <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+
+class CausalDecoderMixin:
+    """KV-cache generation shared by the causal LMs (GPT, ERNIE-MoE).
+
+    ≙ the reference ecosystem's generation stack (paddlenlp generation_utils;
+    fused_multi_transformer_op's CacheKV).  TPU-native shape: the cache is a
+    STATIC (num_layers, B, max_len, nh, hd) buffer written with
+    dynamic_update_slice, the decode loop is one lax.scan — a single XLA
+    program regardless of how many tokens are generated, memoized per
+    signature.
+
+    Host-class contract: ``self.config`` (vocab_size, compute_dtype,
+    max_position_embeddings, num_layers, num_attention_heads, hidden_size),
+    ``prefill(params, ids, max_len) -> (h, caches)``,
+    ``decode_step(params, h, caches, t) -> (h, caches)``,
+    ``decode_logits(params, h) -> fp32 (B, 1, V)``, and wte/wpe param keys.
+    """
+
+    def _embed_one(self, params, tok, t):
+        """Embed one token per row at position ``t``: (B,) -> (B, 1, H)."""
+        dt = jnp.dtype(self.config.compute_dtype)
+        return (jnp.take(params["wte"], tok[:, None], axis=0)
+                + params["wpe"][t][None, None, :]).astype(dt)
+
+    def init_cache(self, batch_size: int, max_len: int):
+        c = self.config
+        dt = jnp.dtype(c.compute_dtype)
+        nh = c.num_attention_heads
+        hd = c.hidden_size // nh
+        shape = (c.num_layers, batch_size, max_len, nh, hd)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    def generate(self, params, input_ids, max_new_tokens: int,
+                 temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None, greedy: bool = True, key=None):
+        """Autoregressive generation with a static KV cache.
+
+        input_ids (B, P) int32; returns (B, max_new_tokens) generated ids.
+        greedy=True → argmax decoding; else temperature (+ optional top-k
+        and/or nucleus top-p) sampling with ``key``.  The whole decode loop
+        is ONE compiled program per (P, max_new_tokens, temperature, top_k,
+        top_p, greedy) signature, memoized on the model — vary only the
+        prompt content (and bucket P via paddle.jit.bucketize) for serving
+        cache hits.
+        """
+        c = self.config
+        B, P = input_ids.shape
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        max_len = P + max_new_tokens
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
+                             f"max_position_embeddings ({c.max_position_embeddings})")
+        validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
+        key = jax.random.key(0) if key is None else key
+        run = self._gen_program(P, max_new_tokens, float(temperature),
+                                None if top_k is None else int(top_k),
+                                None if top_p is None else float(top_p),
+                                greedy)
+        return run(params, jnp.asarray(input_ids), key)
+
+    def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
+                     greedy):
+        """Build (and memoize) the jitted prefill+decode program for one
+        (P, max_new_tokens, temperature, top_k, top_p, greedy) signature —
+        repeated generate() calls with the same signature hit the jit cache
+        instead of recompiling the whole model."""
+        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        if cache_key in progs:
+            return progs[cache_key]
+        max_len = P + max_new_tokens
+        sample = make_token_sampler(temperature, top_k, top_p, greedy)
+
+        @jax.jit
+        def run(params, input_ids, key):
+            h, caches = self.prefill(params, input_ids, max_len)
+            key, k0 = jax.random.split(key)
+            tok0 = sample(self.decode_logits(params, h[:, -1:]), k0)
+
+            def body(carry, i):
+                tok, caches, key = carry
+                t = P + i  # this token's position in the cache
+                h = self._embed_one(params, tok, t)
+                h, caches = self.decode_step(params, h, caches, t)
+                key, sub = jax.random.split(key)
+                ntok = sample(self.decode_logits(params, h), sub)
+                return (ntok, caches, key), ntok
+
+            (last, _, _), toks = jax.lax.scan(
+                body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        progs[cache_key] = run
+        return run
+
+    def generate_beam(self, params, input_ids, max_new_tokens: int,
+                      num_beams: int = 4, length_penalty: float = 1.0,
+                      eos_token_id: Optional[int] = None):
+        """Beam-search decoding on the KV cache (≙ generation_utils
+        BeamSearchScorer semantics, fixed length budget).
+
+        Returns (sequences (B, max_new_tokens), scores (B,)) for the best
+        beam per batch row; ``scores`` are summed log-probs divided by
+        length**length_penalty.  ``eos_token_id``: beams that emit EOS are
+        frozen (EOS repeats, log-prob stops accumulating) so shorter
+        hypotheses compete under the penalty.
+
+        TPU shape: beams fold into the batch dim (B*K), the cache reorder is
+        one take_along_axis per step, and the whole search is a single
+        lax.scan — no dynamic shapes, no host sync inside the loop.
+        """
+        c = self.config
+        B, P = input_ids.shape
+        K = int(num_beams)
+        if not 1 <= K <= c.vocab_size:
+            raise ValueError(f"num_beams must be in [1, vocab_size="
+                             f"{c.vocab_size}], got {num_beams}")
+        if eos_token_id is not None and not 0 <= eos_token_id < c.vocab_size:
+            raise ValueError(f"eos_token_id {eos_token_id} outside the vocab "
+                             f"[0, {c.vocab_size}) — EOS freezing would "
+                             f"silently never trigger")
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32), jnp.zeros((B,), jnp.float32)
+        max_len = P + max_new_tokens
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
+                             f"max_position_embeddings ({c.max_position_embeddings})")
+        run = self._beam_program(P, max_new_tokens, K, float(length_penalty),
+                                 eos_token_id)
+        return run(params, jnp.asarray(input_ids))
+
+    def _beam_program(self, P, max_new_tokens, K, length_penalty,
+                      eos_token_id):
+        cache_key = ("beam", P, max_new_tokens, K, length_penalty,
+                     eos_token_id)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        if cache_key in progs:
+            return progs[cache_key]
+        c = self.config
+        max_len = P + max_new_tokens
+        V = c.vocab_size
+        NEG = jnp.float32(-1e30)
+
+        def logprobs_last(params, h):
+            return jax.nn.log_softmax(
+                self.decode_logits(params, h)[:, -1, :].astype(jnp.float32),
+                -1)
+
+        @jax.jit
+        def run(params, input_ids):
+            B = input_ids.shape[0]
+            h, caches = self.prefill(params, input_ids, max_len)
+            lp0 = logprobs_last(params, h)                      # (B, V)
+            # beams start identical: only beam 0 is live at step 0
+            top_lp, top_tok = jax.lax.top_k(lp0, K)             # (B, K)
+            cum = top_lp
+            if eos_token_id is not None:
+                finished0 = top_tok == eos_token_id
+            else:
+                finished0 = jnp.zeros((B, K), bool)
+            # per-beam hypothesis length (tokens incl. EOS): finished beams
+            # keep the length at which they finished so the length penalty
+            # ranks short hypotheses correctly (BeamSearchScorer semantics)
+            lengths0 = jnp.where(finished0, 1.0,
+                                 float(max_new_tokens)).astype(jnp.float32)
+            # tile caches per beam: (nl, B, ...) -> (nl, B*K, ...)
+            caches = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, K, axis=1), caches)
+
+            def body(carry, i):
+                tok, caches, cum, finished, lengths = carry
+                t = P + i
+                hh = self._embed_one(params, tok, t)
+                hh, caches = self.decode_step(params, hh, caches, t)
+                lp = logprobs_last(params, hh).reshape(B, K, V)
+                if eos_token_id is not None:
+                    # frozen beams: only EOS continues, at zero cost
+                    eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                    lp = jnp.where(finished[..., None], eos_only[None, None],
+                                   lp)
+                total = cum[..., None] + lp                      # (B, K, V)
+                flat = total.reshape(B, K * V)
+                cum, idx = jax.lax.top_k(flat, K)                # (B, K)
+                parent = idx // V
+                ntok = (idx % V).astype(jnp.int32)
+                if eos_token_id is not None:
+                    was = jnp.take_along_axis(finished, parent, axis=1)
+                    lengths = jnp.take_along_axis(lengths, parent, axis=1)
+                    newly = ~was & (ntok == eos_token_id)
+                    # token emitted at body step i is hypothesis token i+2
+                    lengths = jnp.where(newly, (i + 2).astype(jnp.float32),
+                                        lengths)
+                    finished = was | newly
+                # reorder caches to the surviving beams
+                def reorder(a):
+                    nl = a.shape[0]
+                    ab = a.reshape((nl, B, K) + a.shape[2:])
+                    pidx = parent.reshape((1, B, K) + (1,) * (ab.ndim - 3))
+                    return jnp.take_along_axis(ab, pidx, axis=2).reshape(a.shape)
+                caches = jax.tree_util.tree_map(reorder, caches)
+                tok = ntok.reshape(B * K)
+                return (tok, caches, cum, finished, lengths), (ntok, parent)
+
+            (_, _, cum, _, lengths), (toks, parents) = jax.lax.scan(
+                body, (top_tok.reshape(B * K), caches, cum, finished0,
+                       lengths0),
+                jnp.arange(max_new_tokens - 1))
+
+            # backtrace: walk parents from the best final beam to step 0
+            scores = cum / jnp.power(lengths, length_penalty)
+            best = jnp.argmax(scores, axis=1)                    # (B,)
+
+            def back(k, step):
+                st, sp = step                                    # (B,K) each
+                tok_t = jnp.take_along_axis(st, k[:, None], 1)[:, 0]
+                k = jnp.take_along_axis(sp, k[:, None], 1)[:, 0]
+                return k, tok_t
+
+            k_last, toks_rev = jax.lax.scan(
+                back, best, (toks[::-1], parents[::-1]))
+            first = jnp.take_along_axis(top_tok, k_last[:, None], 1)[:, 0]
+            seq = jnp.concatenate([first[:, None], toks_rev[::-1].T], axis=1)
+            best_score = jnp.take_along_axis(scores, best[:, None], 1)[:, 0]
+            return seq, best_score
+
+        progs[cache_key] = run
+        return run
+
+

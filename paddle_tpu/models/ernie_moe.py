@@ -14,13 +14,14 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Parameter, Tensor
 from ..nn.layer.base import Layer
+from ._decode import CausalDecoderMixin
 from ..ops.attention import flash_attention
 from ..ops.moe import moe_ffn, moe_ffn_gather, moe_ffn_indices
 
@@ -51,7 +52,7 @@ class ErnieMoeConfig:
         self.index_dispatch = index_dispatch
 
 
-class ErnieMoeModel(Layer):
+class ErnieMoeModel(CausalDecoderMixin, Layer):
     """Causal LM with MoE FFNs in every block."""
 
     def __init__(self, config: ErnieMoeConfig):
@@ -246,7 +247,7 @@ class ErnieMoeModel(Layer):
     def _block_decode(self, sl, h, ck, cv, t):
         """One block for one new token at position t (h (B,1,H); ck/cv
         (B, max_len, nh, hd))."""
-        from .gpt import cached_attention
+        from ._decode import cached_attention
         q, k, v = self._block_qkv(sl, h)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
@@ -288,67 +289,9 @@ class ErnieMoeModel(Layer):
         h, (cks, cvs) = jax.lax.scan(body, h, (stacked, caches[0], caches[1]))
         return h, (cks, cvs)
 
-    def generate(self, params, input_ids, max_new_tokens: int,
-                 temperature: float = 1.0, top_k: Optional[int] = None,
-                 top_p: Optional[float] = None, greedy: bool = True,
-                 key=None):
-        """Greedy / temperature(+top-k/top-p) generation with the static KV
-        cache and no-drop MoE routing (see class notes).  Returns
-        (B, max_new_tokens) int32."""
-        from .gpt import validate_sampler_args
-        c = self.config
-        B, P = input_ids.shape
-        if max_new_tokens <= 0:
-            return jnp.zeros((B, 0), jnp.int32)
-        max_len = P + max_new_tokens
-        if max_len > c.max_position_embeddings:
-            raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
-                             f"max_position_embeddings "
-                             f"({c.max_position_embeddings})")
-        validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
-        key = jax.random.key(0) if key is None else key
-        run = self._gen_program(P, max_new_tokens, float(temperature),
-                                None if top_k is None else int(top_k),
-                                None if top_p is None else float(top_p),
-                                greedy)
-        return run(params, jnp.asarray(input_ids), key)
-
-    def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
-                    greedy):
-        from .gpt import make_token_sampler
-        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy)
-        progs = self.__dict__.setdefault("_gen_programs", {})
-        if cache_key in progs:
-            return progs[cache_key]
-        c = self.config
-        max_len = P + max_new_tokens
-        dt = jnp.dtype(c.compute_dtype)
-        sample = make_token_sampler(temperature, top_k, top_p, greedy)
-
-        @jax.jit
-        def run(params, input_ids, key):
-            h, caches = self.prefill(params, input_ids, max_len)
-            key, k0 = jax.random.split(key)
-            tok0 = sample(self._head_logits(params, h[:, -1:],
-                                            dtype=jnp.float32), k0)
-
-            def body(carry, i):
-                tok, caches, key = carry
-                t = P + i
-                hh = (jnp.take(params["wte"], tok[:, None], axis=0)
-                      + params["wpe"][t][None, None, :]).astype(dt)
-                hh, caches = self.decode_step(params, hh, caches, t)
-                key, sub = jax.random.split(key)
-                ntok = sample(self._head_logits(params, hh,
-                                                dtype=jnp.float32), sub)
-                return (ntok, caches, key), ntok
-
-            (_, _, _), toks = jax.lax.scan(
-                body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
-            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
-
-        progs[cache_key] = run
-        return run
+    def decode_logits(self, params, h):
+        """fp32 logits for the shared decode loops (CausalDecoderMixin)."""
+        return self._head_logits(params, h, dtype=jnp.float32)
 
 
 def make_ernie_moe_train_step(model: ErnieMoeModel, optimizer, hcg,

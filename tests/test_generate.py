@@ -277,3 +277,24 @@ class TestMoEGenerate:
                            top_k=8, key=k)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert a.shape == (2, 5)
+
+
+class TestMoEBeam:
+    def test_single_beam_equals_greedy_moe(self):
+        """The shared CausalDecoderMixin gives ERNIE-MoE beam search for
+        free; num_beams=1 must reproduce greedy decoding."""
+        from paddle_tpu.models.ernie_moe import ErnieMoeConfig, ErnieMoeModel
+
+        paddle.seed(17)
+        cfg = ErnieMoeConfig(vocab_size=61, hidden_size=32, num_layers=2,
+                             num_attention_heads=4, num_experts=4, top_k=2,
+                             max_position_embeddings=32,
+                             compute_dtype="float32")
+        model = ErnieMoeModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        prompt = np.random.RandomState(18).randint(0, 61, (2, 4))
+        greedy = model.generate(params, prompt, max_new_tokens=4)
+        beam, score = model.generate_beam(params, prompt, max_new_tokens=4,
+                                          num_beams=1)
+        np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
+        assert score.shape == (2,)
