@@ -13,16 +13,21 @@ import jax
 import jax.numpy as jnp
 
 
-def cached_attention(q, ck, cv, t):
+def cached_attention(q, ck, cv, t, pad_lens=None):
     """Single-query attention against a static KV cache, masked to positions
     ≤ t (slots beyond t hold zeros or stale values).  q (B, 1, nh, hd);
-    ck/cv (B, max_len, nh, hd).  Shared by the GPT and ERNIE-MoE decode
-    paths so the mask/scale/precision conventions cannot drift."""
+    ck/cv (B, max_len, nh, hd).  ``pad_lens`` (B,) int32 additionally masks
+    the first pad_lens[b] cache slots (left-padded prompts).  Shared by the
+    GPT and ERNIE-MoE decode paths so the mask/scale/precision conventions
+    cannot drift."""
     hd = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
         jnp.asarray(hd, jnp.float32)).astype(q.dtype)
-    mask = jnp.arange(ck.shape[1]) <= t
-    scores = jnp.where(mask[None, None, None, :], scores, -1e30)
+    pos = jnp.arange(ck.shape[1])
+    mask = (pos <= t)[None, None, None, :]
+    if pad_lens is not None:
+        mask = mask & (pos[None, :] >= pad_lens[:, None])[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
 
@@ -80,11 +85,55 @@ class CausalDecoderMixin:
     ``decode_logits(params, h) -> fp32 (B, 1, V)``, and wte/wpe param keys.
     """
 
-    def _embed_one(self, params, tok, t):
-        """Embed one token per row at position ``t``: (B,) -> (B, 1, H)."""
+    def _prefill_embed(self, params, input_ids, pad_lens):
+        """Embed a (left-padded) prompt: positions shift by the per-row pad
+        length so real tokens get logical positions 0..n-1."""
         dt = jnp.dtype(self.config.compute_dtype)
-        return (jnp.take(params["wte"], tok[:, None], axis=0)
-                + params["wpe"][t][None, None, :]).astype(dt)
+        P = input_ids.shape[1]
+        pos = jnp.maximum(jnp.arange(P)[None, :] - pad_lens[:, None], 0)
+        h = jnp.take(params["wte"], input_ids, axis=0) \
+            + jnp.take(params["wpe"], pos, axis=0)
+        return h.astype(dt)
+
+    @staticmethod
+    def _prefill_key_mask(P, pad_lens):
+        """Additive key mask for a left-padded prompt: finite -1e30 on pad
+        columns (all-pad causal rows then produce garbage-but-finite values
+        that nothing reads, instead of NaNs)."""
+        return jnp.where(jnp.arange(P)[None, :] < pad_lens[:, None],
+                         -1e30, 0.0).astype(jnp.float32)
+
+    @staticmethod
+    def _validate_prompt_mask(prompt_mask, input_ids):
+        """Eager checks (mask is a host array at generate() time): shape
+        match, LEFT padding only (per-row nondecreasing, last column real),
+        at least one real token per row."""
+        import numpy as _np
+        m = _np.asarray(prompt_mask)
+        if m.shape != tuple(input_ids.shape):
+            raise ValueError(f"prompt_mask shape {m.shape} != input_ids "
+                             f"shape {tuple(input_ids.shape)}")
+        if not _np.isin(m, (0, 1)).all():
+            raise ValueError("prompt_mask must be 0/1")
+        if (m.sum(axis=1) == 0).any():
+            raise ValueError("prompt_mask has an all-padding row")
+        if (_np.diff(m.astype(_np.int8), axis=1) < 0).any() or \
+                not m[:, -1].all():
+            raise ValueError(
+                "prompt_mask must be LEFT-padded (zeros then ones; the last "
+                "position must be a real token) — right-padded masks would "
+                "silently generate from a pad position")
+
+    def _embed_one(self, params, tok, t, pad_lens=None):
+        """Embed one token per row at cache slot ``t``: (B,) -> (B, 1, H).
+        With left-padded prompts the LOGICAL position is t - pad_lens[b]."""
+        dt = jnp.dtype(self.config.compute_dtype)
+        wte = jnp.take(params["wte"], tok[:, None], axis=0)
+        if pad_lens is None:
+            wpe = params["wpe"][t][None, None, :]
+        else:
+            wpe = params["wpe"][t - pad_lens][:, None, :]
+        return (wte + wpe).astype(dt)
 
     def init_cache(self, batch_size: int, max_len: int):
         c = self.config
@@ -96,7 +145,8 @@ class CausalDecoderMixin:
 
     def generate(self, params, input_ids, max_new_tokens: int,
                  temperature: float = 1.0, top_k: Optional[int] = None,
-                 top_p: Optional[float] = None, greedy: bool = True, key=None):
+                 top_p: Optional[float] = None, greedy: bool = True, key=None,
+                 prompt_mask=None):
         """Autoregressive generation with a static KV cache.
 
         input_ids (B, P) int32; returns (B, max_new_tokens) generated ids.
@@ -106,6 +156,12 @@ class CausalDecoderMixin:
         top_p, greedy) signature, memoized on the model — vary only the
         prompt content (and bucket P via paddle.jit.bucketize) for serving
         cache hits.
+
+        ``prompt_mask`` (B, P), 1 = real token, 0 = padding: prompts must be
+        LEFT-padded (real tokens at the end, so the last position is always
+        real).  Pad positions are excluded from attention and position ids
+        shift by the per-row pad length — pad lengths are traced data, so
+        ragged batches share one compiled program per bucket.
         """
         c = self.config
         B, P = input_ids.shape
@@ -120,16 +176,22 @@ class CausalDecoderMixin:
         run = self._gen_program(P, max_new_tokens, float(temperature),
                                 None if top_k is None else int(top_k),
                                 None if top_p is None else float(top_p),
-                                greedy)
-        return run(params, jnp.asarray(input_ids), key)
+                                greedy, masked=prompt_mask is not None)
+        if prompt_mask is None:
+            return run(params, jnp.asarray(input_ids), key)
+        self._validate_prompt_mask(prompt_mask, input_ids)
+        pad_lens = (P - jnp.sum(jnp.asarray(prompt_mask, jnp.int32), axis=1)) \
+            .astype(jnp.int32)
+        return run(params, jnp.asarray(input_ids), key, pad_lens)
 
     def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
-                     greedy):
+                     greedy, masked=False):
         """Build (and memoize) the jitted prefill+decode program for one
         (P, max_new_tokens, temperature, top_k, top_p, greedy) signature —
         repeated generate() calls with the same signature hit the jit cache
         instead of recompiling the whole model."""
-        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy)
+        cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy,
+                     masked)
         progs = self.__dict__.setdefault("_gen_programs", {})
         if cache_key in progs:
             return progs[cache_key]
@@ -137,16 +199,18 @@ class CausalDecoderMixin:
         sample = make_token_sampler(temperature, top_k, top_p, greedy)
 
         @jax.jit
-        def run(params, input_ids, key):
-            h, caches = self.prefill(params, input_ids, max_len)
+        def run(params, input_ids, key, pad_lens=None):
+            h, caches = self.prefill(params, input_ids, max_len,
+                                     pad_lens=pad_lens)
             key, k0 = jax.random.split(key)
             tok0 = sample(self.decode_logits(params, h[:, -1:]), k0)
 
             def body(carry, i):
                 tok, caches, key = carry
-                t = P + i  # this token's position in the cache
-                h = self._embed_one(params, tok, t)
-                h, caches = self.decode_step(params, h, caches, t)
+                t = P + i  # this token's slot in the cache
+                h = self._embed_one(params, tok, t, pad_lens=pad_lens)
+                h, caches = self.decode_step(params, h, caches, t,
+                                             pad_lens=pad_lens)
                 key, sub = jax.random.split(key)
                 ntok = sample(self.decode_logits(params, h), sub)
                 return (ntok, caches, key), ntok

@@ -244,30 +244,36 @@ class ErnieMoeModel(CausalDecoderMixin, Layer):
                              k=c.top_k)
         return h + out.reshape(B, Lq, H)
 
-    def _block_decode(self, sl, h, ck, cv, t):
+    def _block_decode(self, sl, h, ck, cv, t, pad_lens=None):
         """One block for one new token at position t (h (B,1,H); ck/cv
         (B, max_len, nh, hd))."""
         from ._decode import cached_attention
         q, k, v = self._block_qkv(sl, h)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
-        att = cached_attention(q, ck, cv, t)
+        att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
         h = self._attn_residual(sl, h, att)
         return self._moe_residual_gather(sl, h), ck, cv
 
-    def prefill(self, params, input_ids, max_len: int):
+    def prefill(self, params, input_ids, max_len: int, pad_lens=None):
         """Prompt pass with no-drop routing; returns (h, (ck, cv)) with
         caches filled at [0, P).  Uses the buffered no-drop indices dispatch
         (cf = E/k): at prefill T = B·P is large, so gathering (T, k, H, I)
-        weight slices would cost more than the padded buffer does."""
+        weight slices would cost more than the padded buffer does.  With
+        ``pad_lens`` (left-padded prompts), pad keys get a finite -1e30 mask
+        and positions shift per row (see GPT.prefill)."""
         c = self.config
         B, P = input_ids.shape
-        h = self.embed_fn(params, input_ids)
+        if pad_lens is None:
+            h, key_mask = self.embed_fn(params, input_ids), None
+        else:
+            h = self._prefill_embed(params, input_ids, pad_lens)
+            key_mask = self._prefill_key_mask(P, pad_lens)
         stacked = {k: params[k] for k in self.stacked_param_names()}
 
         def body(carry, sl):
             q, k, v = self._block_qkv(sl, carry)
-            att = flash_attention(q, k, v, causal=True)
+            att = flash_attention(q, k, v, causal=True, key_mask=key_mask)
             hh = self._attn_residual(sl, carry, att)
             hh, _ = self._moe_residual(sl, hh,
                                        capacity_factor=self._nodrop_cf())
@@ -278,12 +284,13 @@ class ErnieMoeModel(CausalDecoderMixin, Layer):
         cdt = jnp.dtype(c.compute_dtype)
         return h, (jnp.pad(ks.astype(cdt), pad), jnp.pad(vs.astype(cdt), pad))
 
-    def decode_step(self, params, h, caches, t):
+    def decode_step(self, params, h, caches, t, pad_lens=None):
         stacked = {k: params[k] for k in self.stacked_param_names()}
 
         def body(carry, xs):
             sl, ck, cv = xs
-            out, ck, cv = self._block_decode(sl, carry, ck, cv, t)
+            out, ck, cv = self._block_decode(sl, carry, ck, cv, t,
+                                             pad_lens=pad_lens)
             return out, (ck, cv)
 
         h, (cks, cvs) = jax.lax.scan(body, h, (stacked, caches[0], caches[1]))

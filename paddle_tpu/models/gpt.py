@@ -284,30 +284,36 @@ class GPTModel(CausalDecoderMixin, Layer):
         """fp32 logits for the decode loops (mixin contract)."""
         return self.head_fn(params, h)
 
-    def _block_decode(self, sl, h, ck, cv, t):
+    def _block_decode(self, sl, h, ck, cv, t, pad_lens=None):
         """One block for ONE new token at position ``t``.
 
         h (B, 1, H); ck/cv (B, max_len, nh, hd) are this layer's caches.
         Returns (h_out, ck, cv) with the new k/v written at index t and
         attention taken over cache positions ≤ t (later slots hold zeros or
-        stale values and are masked)."""
+        stale values — and left-pad slots, when pad_lens is set — masked)."""
         q, k, v = self._block_qkv(sl, h)
         ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
-        att = cached_attention(q, ck, cv, t)
+        att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
         return self._block_post_attn(sl, h, att), ck, cv
 
-    def prefill(self, params, input_ids, max_len: int):
+    def prefill(self, params, input_ids, max_len: int, pad_lens=None):
         """Run the prompt through all blocks, returning the final hidden
-        states (B, P, H) and caches filled at positions [0, P)."""
+        states (B, P, H) and caches filled at positions [0, P).  With
+        ``pad_lens`` (left-padded prompts), embedding positions shift and
+        pad keys are masked (mixin helpers — one canonical convention)."""
         c = self.config
         B, P = input_ids.shape
-        h = self.embed_fn(params, input_ids)
+        if pad_lens is None:
+            h, key_mask = self.embed_fn(params, input_ids), None
+        else:
+            h = self._prefill_embed(params, input_ids, pad_lens)
+            key_mask = self._prefill_key_mask(P, pad_lens)
         stacked = {k: params[k] for k in self.stacked_param_names()}
 
         def body(carry, sl):
             q, k, v = self._block_qkv(sl, carry)
-            att = flash_attention(q, k, v, causal=True)
+            att = flash_attention(q, k, v, causal=True, key_mask=key_mask)
             return self._block_post_attn(sl, carry, att), (k, v)
 
         h, (ks, vs) = jax.lax.scan(body, h, stacked)
@@ -315,14 +321,15 @@ class GPTModel(CausalDecoderMixin, Layer):
         dt = jnp.dtype(c.compute_dtype)
         return h, (jnp.pad(ks.astype(dt), pad), jnp.pad(vs.astype(dt), pad))
 
-    def decode_step(self, params, h, caches, t):
+    def decode_step(self, params, h, caches, t, pad_lens=None):
         """All blocks for one token: h (B,1,H), caches = (ck, cv) stacked
         over layers.  Returns (h_out, caches)."""
         stacked = {k: params[k] for k in self.stacked_param_names()}
 
         def body(carry, xs):
             sl, ck, cv = xs
-            out, ck, cv = self._block_decode(sl, carry, ck, cv, t)
+            out, ck, cv = self._block_decode(sl, carry, ck, cv, t,
+                                             pad_lens=pad_lens)
             return out, (ck, cv)
 
         h, (cks, cvs) = jax.lax.scan(body, h, (stacked, caches[0], caches[1]))

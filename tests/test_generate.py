@@ -298,3 +298,86 @@ class TestMoEBeam:
                                           num_beams=1)
         np.testing.assert_array_equal(np.asarray(beam), np.asarray(greedy))
         assert score.shape == (2,)
+
+
+class TestMaskedPrompts:
+    def test_left_padded_equals_unpadded_gpt(self, model_and_params):
+        """Ragged prompts served through one bucket: left-pad + prompt_mask
+        must reproduce each row's unpadded greedy generation exactly (pad
+        keys masked out of attention, positions shifted per row)."""
+        model, params = model_and_params
+        rs = np.random.RandomState(30)
+        P = 8
+        lens = [3, 8, 5]
+        rows, masks, singles = [], [], []
+        for L in lens:
+            ids = rs.randint(0, 97, (1, L))
+            singles.append(model.generate(params, ids, max_new_tokens=6))
+            rows.append(np.concatenate([np.zeros((1, P - L), np.int64), ids],
+                                       axis=1))
+            masks.append(np.concatenate([np.zeros((1, P - L), np.int32),
+                                         np.ones((1, L), np.int32)], axis=1))
+        batch = np.concatenate(rows)
+        mask = np.concatenate(masks)
+        got = model.generate(params, batch, max_new_tokens=6,
+                             prompt_mask=mask)
+        for i, single in enumerate(singles):
+            np.testing.assert_array_equal(np.asarray(got)[i],
+                                          np.asarray(single)[0],
+                                          err_msg=f"row {i} len {lens[i]}")
+
+    def test_left_padded_equals_unpadded_moe(self):
+        from paddle_tpu.models.ernie_moe import ErnieMoeConfig, ErnieMoeModel
+
+        paddle.seed(19)
+        cfg = ErnieMoeConfig(vocab_size=71, hidden_size=32, num_layers=2,
+                             num_attention_heads=4, num_experts=4, top_k=2,
+                             max_position_embeddings=32,
+                             compute_dtype="float32")
+        model = ErnieMoeModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        rs = np.random.RandomState(31)
+        ids = rs.randint(0, 71, (1, 4))
+        single = model.generate(params, ids, max_new_tokens=4)
+        padded = np.concatenate([np.zeros((1, 3), np.int64), ids], axis=1)
+        mask = np.concatenate([np.zeros((1, 3), np.int32),
+                               np.ones((1, 4), np.int32)], axis=1)
+        got = model.generate(params, padded, max_new_tokens=4,
+                             prompt_mask=mask)
+        np.testing.assert_array_equal(np.asarray(got)[0],
+                                      np.asarray(single)[0])
+
+    def test_mask_shares_program_across_pad_lengths(self, model_and_params):
+        """pad lengths are traced data: two ragged batches with the same
+        bucket shape reuse ONE compiled program."""
+        model, params = model_and_params
+        mask1 = np.array([[0, 0, 1, 1, 1, 1]], np.int32)
+        mask2 = np.array([[0, 0, 0, 0, 1, 1]], np.int32)
+        ids = np.random.RandomState(32).randint(0, 97, (1, 6))
+        model.generate(params, ids, 3, prompt_mask=mask1)
+        r1 = model._gen_program(6, 3, 1.0, None, None, True, masked=True)
+        model.generate(params, ids, 3, prompt_mask=mask2)
+        r2 = model._gen_program(6, 3, 1.0, None, None, True, masked=True)
+        assert r1 is r2
+
+
+class TestMaskValidation:
+    def test_right_padded_mask_rejected(self, model_and_params):
+        model, params = model_and_params
+        ids = np.zeros((1, 5), np.int64)
+        with pytest.raises(ValueError, match="LEFT-padded"):
+            model.generate(params, ids, 3,
+                           prompt_mask=np.array([[1, 1, 1, 0, 0]]))
+
+    def test_all_pad_row_rejected(self, model_and_params):
+        model, params = model_and_params
+        ids = np.zeros((2, 4), np.int64)
+        mask = np.array([[0, 0, 1, 1], [0, 0, 0, 0]])
+        with pytest.raises(ValueError, match="all-padding"):
+            model.generate(params, ids, 3, prompt_mask=mask)
+
+    def test_shape_mismatch_rejected(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="shape"):
+            model.generate(params, np.zeros((1, 5), np.int64), 3,
+                           prompt_mask=np.ones((1, 4), np.int32))
