@@ -68,6 +68,20 @@ def main():
                                          num_beams=args.num_beams)
         print(f"beam (k={args.num_beams}):", np.asarray(seq)[0].tolist(),
               "score", float(score[0]))
+
+    # ragged serving: left-pad prompts of different lengths into one bucket;
+    # pad lengths are traced data, so BOTH requests share ONE program
+    short = rs.randint(0, cfg.vocab_size, (1, 3))
+    padded = np.concatenate([np.zeros((1, 5), np.int64), short], axis=1)
+    mask = np.concatenate([np.zeros((1, 5), np.int32),
+                           np.ones((1, 3), np.int32)], axis=1)
+    ragged = model.generate(params, padded, args.max_new_tokens,
+                            prompt_mask=mask)
+    exact = model.generate(params, short, args.max_new_tokens)
+    assert np.array_equal(np.asarray(ragged), np.asarray(exact)), \
+        "padded serving must be exact"
+    print("ragged     :", np.asarray(ragged)[0].tolist(),
+          "(== unpadded run)")
     print("GENERATION_OK")
 
 

@@ -356,3 +356,105 @@ class CausalDecoderMixin:
         return run
 
 
+
+
+def save_generate_program(model, params, path: str, prompt_len: int,
+                          max_new_tokens: int, batch_size: int = 1,
+                          temperature: float = 1.0, top_k=None, top_p=None,
+                          greedy: bool = True, masked: bool = False,
+                          platforms=("cpu", "tpu")):
+    """Export one generation program as a self-contained serving artifact.
+
+    ≙ jit.save's ``__model__`` + params layout (save_inference_model), but
+    for the full prefill+decode loop: the StableHLO program (jax.export
+    bytes) plus pickled weights.  The exported function takes
+    (input_ids (B, P) int32, seed uint32[, pad_lens int32 when
+    ``masked=True`` — left-padded ragged prompts]) — the PRNG key is built
+    inside the program so no key types cross the serialization boundary.
+    Lowered for every platform in ``platforms`` so a CPU-built artifact
+    serves on TPU.
+
+    Files: path + ".genmodel" (program), path + ".genparams" (weights),
+    path + ".genmeta" (shapes/sampler signature).
+    """
+    import pickle
+
+    import numpy as _np
+    from jax import export as jax_export
+
+    # same eager contract as generate(): fail here, not at serve time
+    if max_new_tokens <= 0:
+        raise ValueError("max_new_tokens must be positive for an exported "
+                         "program (an empty program is not a useful artifact)")
+    max_len = prompt_len + max_new_tokens
+    if max_len > model.config.max_position_embeddings:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {max_len} exceeds "
+            f"max_position_embeddings ({model.config.max_position_embeddings})")
+    validate_sampler_args(model.config.vocab_size, top_k, top_p, greedy,
+                          key=object())  # key is generated in-program
+
+    run = model._gen_program(prompt_len, max_new_tokens, float(temperature),
+                             None if top_k is None else int(top_k),
+                             None if top_p is None else float(top_p), greedy,
+                             masked=masked)
+
+    if masked:
+        def entry(params, input_ids, seed, pad_lens):
+            return run(params, input_ids, jax.random.key(seed), pad_lens)
+        extra = [jax.ShapeDtypeStruct((batch_size,), jnp.int32)]
+    else:
+        def entry(params, input_ids, seed):
+            return run(params, input_ids, jax.random.key(seed))
+        extra = []
+
+    p_shapes = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+    exported = jax_export.export(jax.jit(entry), platforms=list(platforms))(
+        p_shapes,
+        jax.ShapeDtypeStruct((batch_size, prompt_len), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.uint32), *extra)
+    with open(path + ".genmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".genparams", "wb") as f:
+        pickle.dump(jax.tree_util.tree_map(_np.asarray, params), f)
+    with open(path + ".genmeta", "wb") as f:
+        pickle.dump({"prompt_len": prompt_len, "batch_size": batch_size,
+                     "max_new_tokens": max_new_tokens,
+                     "temperature": temperature, "top_k": top_k,
+                     "top_p": top_p, "greedy": greedy, "masked": masked,
+                     "platforms": tuple(platforms)}, f)
+
+
+def load_generate_program(path: str):
+    """Load a save_generate_program artifact.  Returns (fn, meta) where
+    ``fn(input_ids, seed=0[, prompt_mask=...]) -> (B, max_new_tokens)``
+    has the weights baked in; ``prompt_mask`` is accepted (and required)
+    when the artifact was exported with ``masked=True``."""
+    import pickle
+
+    from jax import export as jax_export
+
+    with open(path + ".genmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".genparams", "rb") as f:
+        params = pickle.load(f)
+    with open(path + ".genmeta", "rb") as f:
+        meta = pickle.load(f)
+
+    def fn(input_ids, seed=0, prompt_mask=None):
+        ids = jnp.asarray(input_ids, jnp.int32)
+        args = [params, ids, jnp.asarray(seed, jnp.uint32)]
+        if meta["masked"]:
+            if prompt_mask is None:
+                raise ValueError("this artifact was exported masked=True; "
+                                 "pass prompt_mask")
+            CausalDecoderMixin._validate_prompt_mask(prompt_mask, ids)
+            args.append((ids.shape[1] - jnp.sum(
+                jnp.asarray(prompt_mask, jnp.int32), axis=1)).astype(jnp.int32))
+        elif prompt_mask is not None:
+            raise ValueError("artifact exported without masked=True cannot "
+                             "serve ragged prompts")
+        return exported.call(*args)
+
+    return fn, meta

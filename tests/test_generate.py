@@ -381,3 +381,74 @@ class TestMaskValidation:
         with pytest.raises(ValueError, match="shape"):
             model.generate(params, np.zeros((1, 5), np.int64), 3,
                            prompt_mask=np.ones((1, 4), np.int32))
+
+
+class TestExportedProgram:
+    def test_save_load_roundtrip(self, model_and_params, tmp_path):
+        """The generation loop exports as a StableHLO artifact and a fresh
+        load reproduces the live program's tokens exactly (≙ jit.save's
+        __model__+params serving contract, for the decode loop)."""
+        from paddle_tpu.models._decode import (load_generate_program,
+                                               save_generate_program)
+
+        model, params = model_and_params
+        prompt = np.random.RandomState(40).randint(0, 97, (2, 5))
+        want = model.generate(params, prompt, max_new_tokens=6)
+
+        path = str(tmp_path / "gpt_gen")
+        save_generate_program(model, params, path, prompt_len=5,
+                              max_new_tokens=6, batch_size=2)
+        fn, meta = load_generate_program(path)
+        assert meta["max_new_tokens"] == 6
+        got = fn(prompt)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_sampled_export_deterministic_per_seed(self, model_and_params,
+                                                   tmp_path):
+        from paddle_tpu.models._decode import (load_generate_program,
+                                               save_generate_program)
+
+        model, params = model_and_params
+        path = str(tmp_path / "gpt_gen_s")
+        save_generate_program(model, params, path, prompt_len=4,
+                              max_new_tokens=5, batch_size=1, greedy=False,
+                              temperature=0.9, top_k=12)
+        fn, _ = load_generate_program(path)
+        prompt = np.random.RandomState(41).randint(0, 97, (1, 4))
+        a, b = fn(prompt, seed=7), fn(prompt, seed=7)
+        c = fn(prompt, seed=8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.shape == (1, 5)
+        # the seed operand must actually reach the sampler (verified once
+        # for these fixed seeds/weights — a baked-in key would tie a == c)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_masked_export_roundtrip(self, model_and_params, tmp_path):
+        """Ragged serving from an artifact: masked=True exports a pad_lens
+        operand; the loaded fn reproduces live masked generation."""
+        from paddle_tpu.models._decode import (load_generate_program,
+                                               save_generate_program)
+
+        model, params = model_and_params
+        path = str(tmp_path / "gpt_gen_m")
+        save_generate_program(model, params, path, prompt_len=6,
+                              max_new_tokens=4, batch_size=1, masked=True)
+        fn, meta = load_generate_program(path)
+        assert meta["masked"]
+        ids = np.random.RandomState(42).randint(0, 97, (1, 4))
+        padded = np.concatenate([np.zeros((1, 2), np.int64), ids], axis=1)
+        mask = np.array([[0, 0, 1, 1, 1, 1]], np.int32)
+        want = model.generate(params, padded, 4, prompt_mask=mask)
+        got = fn(padded, prompt_mask=mask)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        with pytest.raises(ValueError, match="pass prompt_mask"):
+            fn(padded)
+
+    def test_export_validates_position_bound(self, model_and_params,
+                                             tmp_path):
+        from paddle_tpu.models._decode import save_generate_program
+
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            save_generate_program(model, params, str(tmp_path / "x"),
+                                  prompt_len=10, max_new_tokens=200)
