@@ -308,6 +308,50 @@ def bench_mnist_lenet(on_tpu):
                    B, iters, dt, flops, on_tpu, loss)
 
 
+def bench_gpt_decode(on_tpu):
+    """Serving decode throughput: greedy KV-cache generation on gpt2-small
+    (prefill amortized into the measured program — the user-visible serving
+    number).  No training-FLOPs MFU (decode is bandwidth-bound by design);
+    vs_baseline is null — the reference publishes no decode figure."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_attention_heads=12, max_position_embeddings=1024,
+                        compute_dtype="bfloat16")
+        B, P, N, iters = 8, 128, 128, 5
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=128,
+                        compute_dtype="float32")
+        B, P, N, iters = 2, 8, 8, 2
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    run = model._gen_program(P, N, 1.0, None, None, True)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                                       (B, P)))
+    # warm compile
+    out = run(params, ids, jax.random.key(0))
+    np.asarray(out[0, 0])
+    # _run_timed discipline: queue all iterations, then ONE host fetch that
+    # depends on every output (iterations are independent, so the final
+    # fetch must touch all of them — a single out[0,0] would only prove the
+    # last one ran)
+    t0 = time.perf_counter()
+    outs = [run(params, ids, jax.random.key(i)) for i in range(iters)]
+    np.asarray(jnp.stack([o[0, 0] for o in outs]))
+    dt = time.perf_counter() - t0
+    thpt = B * N * iters / dt
+    return {"metric": "gpt2s_decode_tokens_per_sec", "value": round(thpt, 1),
+            "unit": "tokens/s/chip", "mfu": None, "vs_baseline": None,
+            "loss": 0.0, "backend": "tpu" if on_tpu else "cpu"}
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2s,
     "gpt_long": bench_gpt_long,
@@ -315,6 +359,7 @@ CONFIGS = {
     "ernie_moe": bench_ernie_moe,
     "resnet50": bench_resnet50,
     "mnist_lenet": bench_mnist_lenet,
+    "gpt_decode": bench_gpt_decode,
 }
 
 
