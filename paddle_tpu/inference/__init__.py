@@ -151,16 +151,35 @@ class Predictor:
 
     def __init__(self, config: Config):
         from ..jit import load as jit_load
-        if not os.path.exists(config.prog_file()):
-            raise ValueError(f"model file {config.prog_file()!r} not found")
-        self._layer = jit_load(config._prefix)
-        meta = getattr(self._layer, "_meta", {}) or {}
-        names = meta.get("input_names") or \
-            [f"x{i}" for i in range(meta.get("n_inputs", 1))]
-        shapes = meta.get("input_shapes") or [None] * len(names)
-        dtypes = meta.get("input_dtypes") or [None] * len(names)
+        self._gen_fn = None
+        prefix = config._prefix or ""
+        if os.path.exists(prefix + ".genmodel") and \
+                not os.path.exists(config.prog_file()):
+            # generation artifact (models/_decode.py save_generate_program):
+            # same handle surface — inputs are input_ids + seed [+ mask]
+            from ..models._decode import load_generate_program
+            self._gen_fn, meta = load_generate_program(prefix)
+            self._layer = None
+            names = ["input_ids", "seed"]
+            shapes = [(meta["batch_size"], meta["prompt_len"]), ()]
+            dtypes = ["int32", "uint32"]
+            if meta["masked"]:
+                names.append("prompt_mask")
+                shapes.append((meta["batch_size"], meta["prompt_len"]))
+                dtypes.append("int32")
+        else:
+            if not os.path.exists(config.prog_file()):
+                raise ValueError(f"model file {config.prog_file()!r} not found")
+            self._layer = jit_load(config._prefix)
+            meta = getattr(self._layer, "_meta", {}) or {}
+            names = meta.get("input_names") or \
+                [f"x{i}" for i in range(meta.get("n_inputs", 1))]
+            shapes = meta.get("input_shapes") or [None] * len(names)
+            dtypes = meta.get("input_dtypes") or [None] * len(names)
         self._inputs: Dict[str, _IOHandle] = {
             n: _IOHandle(n, s, d) for n, s, d in zip(names, shapes, dtypes)}
+        if self._gen_fn is not None:
+            self._inputs["seed"]._value = np.uint32(0)  # optional input
         self._input_order = names
         self._outputs: Dict[str, _IOHandle] = {}
         self._output_order: List[str] = []
@@ -187,9 +206,16 @@ class Predictor:
                    if self._inputs[n]._value is None]
         if missing:
             raise RuntimeError(f"inputs not set: {missing}")
-        args = [self._inputs[n]._value for n in self._input_order]
-        out = self._layer._exported.call(self._layer._params,
-                                         self._layer._buffers, *args)
+        if self._gen_fn is not None:
+            kw = {}
+            if "prompt_mask" in self._inputs:
+                kw["prompt_mask"] = self._inputs["prompt_mask"]._value
+            out = self._gen_fn(self._inputs["input_ids"]._value,
+                               seed=self._inputs["seed"]._value, **kw)
+        else:
+            args = [self._inputs[n]._value for n in self._input_order]
+            out = self._layer._exported.call(self._layer._params,
+                                             self._layer._buffers, *args)
         leaves = jax.tree_util.tree_leaves(out)
         self._output_order = [f"output_{i}" for i in range(len(leaves))]
         self._outputs = {}
@@ -216,8 +242,11 @@ class Predictor:
     def clone(self) -> "Predictor":
         p = Predictor.__new__(Predictor)
         p._layer = self._layer  # share the compiled executable + weights
+        p._gen_fn = self._gen_fn
         p._inputs = {n: _IOHandle(h.name, h._shape, h._dtype)
                      for n, h in self._inputs.items()}
+        if self._gen_fn is not None:
+            p._inputs["seed"]._value = np.uint32(0)
         p._input_order = list(self._input_order)
         p._outputs = {}
         p._output_order = []

@@ -452,3 +452,63 @@ class TestExportedProgram:
         with pytest.raises(ValueError, match="max_position_embeddings"):
             save_generate_program(model, params, str(tmp_path / "x"),
                                   prompt_len=10, max_new_tokens=200)
+
+
+class TestPredictorIntegration:
+    def test_predictor_serves_generation_artifact(self, model_and_params,
+                                                  tmp_path):
+        """paddle.inference.Config/Predictor recognizes a .genmodel artifact:
+        the reference predictor calling convention (handles + run) serves the
+        exported decode loop."""
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.models._decode import save_generate_program
+
+        model, params = model_and_params
+        path = str(tmp_path / "served")
+        save_generate_program(model, params, path, prompt_len=5,
+                              max_new_tokens=4, batch_size=2)
+        pred = create_predictor(Config(path))
+        assert pred.get_input_names() == ["input_ids", "seed"]
+
+        prompt = np.random.RandomState(50).randint(0, 97, (2, 5))
+        pred.get_input_handle("input_ids").copy_from_cpu(
+            prompt.astype(np.int32))
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        want = model.generate(params, prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(out, np.asarray(want))
+
+        # clone shares the executable and serves independently
+        p2 = pred.clone()
+        p2.get_input_handle("input_ids").copy_from_cpu(
+            prompt.astype(np.int32))
+        p2.run()
+        out2 = p2.get_output_handle(p2.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_array_equal(out2, out)
+
+    def test_predictor_serves_masked_artifact(self, model_and_params,
+                                              tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+        from paddle_tpu.models._decode import save_generate_program
+
+        model, params = model_and_params
+        path = str(tmp_path / "served_m")
+        save_generate_program(model, params, path, prompt_len=6,
+                              max_new_tokens=3, batch_size=1, masked=True)
+        pred = create_predictor(Config(path))
+        assert pred.get_input_names() == ["input_ids", "seed", "prompt_mask"]
+        ids = np.random.RandomState(51).randint(0, 97, (1, 4))
+        padded = np.concatenate([np.zeros((1, 2), np.int32),
+                                 ids.astype(np.int32)], axis=1)
+        mask = np.array([[0, 0, 1, 1, 1, 1]], np.int32)
+        pred.get_input_handle("input_ids").copy_from_cpu(padded)
+        pred.get_input_handle("prompt_mask").copy_from_cpu(mask)
+        pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        want = model.generate(params, padded, 3, prompt_mask=mask)
+        np.testing.assert_array_equal(out, np.asarray(want))
+
+    def test_predictor_missing_model_still_clear_error(self):
+        from paddle_tpu.inference import Config, create_predictor
+        with pytest.raises(ValueError, match="not found"):
+            create_predictor(Config("/nonexistent/prefix"))
