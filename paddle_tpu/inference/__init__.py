@@ -154,7 +154,13 @@ class Predictor:
         self._gen_fn = None
         prefix = config._prefix or ""
         if os.path.exists(prefix + ".genmodel") and \
-                not os.path.exists(config.prog_file()):
+                os.path.exists(config.prog_file()):
+            raise ValueError(
+                f"ambiguous artifacts at {prefix!r}: both a jit.save "
+                f".pdmodel and a generation .genmodel exist — use distinct "
+                f"prefixes (silently picking one would serve the wrong "
+                f"program)")
+        if os.path.exists(prefix + ".genmodel"):
             # generation artifact (models/_decode.py save_generate_program):
             # same handle surface — inputs are input_ids + seed [+ mask]
             from ..models._decode import load_generate_program

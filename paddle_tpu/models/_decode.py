@@ -14,18 +14,23 @@ import jax.numpy as jnp
 
 
 def cached_attention(q, ck, cv, t, pad_lens=None):
-    """Single-query attention against a static KV cache, masked to positions
-    ≤ t (slots beyond t hold zeros or stale values).  q (B, 1, nh, hd);
-    ck/cv (B, max_len, nh, hd).  ``pad_lens`` (B,) int32 additionally masks
-    the first pad_lens[b] cache slots (left-padded prompts).  Shared by the
-    GPT and ERNIE-MoE decode paths so the mask/scale/precision conventions
-    cannot drift."""
+    """Attention for new tokens written at cache slots [t, t+k) against a
+    static KV cache: query row i attends to positions ≤ t + i (causal within
+    the chunk, full history before it; slots beyond hold zeros or stale
+    values).  q (B, k, nh, hd) — k = 1 is the plain decode step, k > 1 is the
+    chunk form used by speculative-decoding verification.  ``pad_lens`` (B,)
+    int32 additionally masks the first pad_lens[b] cache slots (left-padded
+    prompts).  Shared by the GPT and ERNIE-MoE decode paths so the mask/
+    scale/precision conventions cannot drift."""
+    kq = q.shape[1]
     hd = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
         jnp.asarray(hd, jnp.float32)).astype(q.dtype)
-    pos = jnp.arange(ck.shape[1])
-    mask = (pos <= t)[None, None, None, :]
+    row = jnp.arange(kq)[:, None]
+    col = jnp.arange(ck.shape[1])[None, :]
+    mask = (col <= t + row)[None, None]                # (1, 1, k, max_len)
     if pad_lens is not None:
+        pos = jnp.arange(ck.shape[1])
         mask = mask & (pos[None, :] >= pad_lens[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
@@ -220,6 +225,131 @@ class CausalDecoderMixin:
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
 
         progs[cache_key] = run
+        return run
+
+    def _embed_chunk(self, params, toks, t0):
+        """Embed a (k,) token chunk at cache slots [t0, t0+k): (1, k, H)."""
+        dt = jnp.dtype(self.config.compute_dtype)
+        k = toks.shape[0]
+        return (jnp.take(params["wte"], toks, axis=0)[None]
+                + params["wpe"][t0 + jnp.arange(k)][None]).astype(dt)
+
+    def generate_speculative(self, params, input_ids, max_new_tokens: int,
+                             draft_model, draft_params, draft_k: int = 4):
+        """Greedy speculative decoding (≙ the draft-and-verify serving
+        optimization; LOSSLESS — output is bit-identical to this model's
+        greedy ``generate``).
+
+        Per round: the draft proposes ``draft_k`` greedy tokens one at a
+        time; the target verifies all of them (plus one bonus token) in ONE
+        chunked cache step (cached_attention's k-query form).  The longest
+        matching prefix + the target's correction are accepted, so each
+        round emits 1..draft_k+1 tokens at the cost of one target chunk —
+        the speedup is the draft's acceptance rate.  Both KV caches
+        self-heal: a stale slot (from a rejected draft token) is always
+        rewritten as the next round's input before anything reads it.
+
+        B = 1 only (the latency-bound serving shape); greedy only (lossless
+        acceptance needs matching argmax).  The draft must share the
+        vocabulary.
+        """
+        c = self.config
+        B, P = input_ids.shape
+        if B != 1:
+            raise NotImplementedError(
+                "speculative decoding is the B=1 latency path (rows would "
+                "advance at different rates)")
+        if draft_model.config.vocab_size != c.vocab_size:
+            raise ValueError(
+                f"draft vocab ({draft_model.config.vocab_size}) != target "
+                f"vocab ({c.vocab_size}) — speculative acceptance compares "
+                f"token ids")
+        if max_new_tokens <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        K = int(draft_k)
+        if K < 1:
+            raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+        need = P + max_new_tokens + K
+        for m, who in ((c, "target"), (draft_model.config, "draft")):
+            if need > m.max_position_embeddings:
+                raise ValueError(
+                    f"P + max_new_tokens + draft_k = {need} exceeds the "
+                    f"{who}'s max_position_embeddings "
+                    f"({m.max_position_embeddings})")
+        run = self._spec_program(draft_model, P, max_new_tokens, K)
+        return run(params, draft_params, jnp.asarray(input_ids))
+
+    def _spec_program(self, draft_model, P, max_new_tokens, K):
+        # keyed by the draft's config signature with a weakref identity
+        # check: one entry per signature (bounded memory — a fresh draft
+        # instance replaces, never accumulates), and a recycled id() can
+        # never alias a dead draft
+        import weakref
+        dcfg = draft_model.config
+        cache_key = ("spec", type(draft_model).__name__, dcfg.vocab_size,
+                     dcfg.num_layers, dcfg.hidden_size, P, max_new_tokens, K)
+        progs = self.__dict__.setdefault("_gen_programs", {})
+        entry = progs.get(cache_key)
+        if entry is not None:
+            ref, cached_run = entry
+            if ref() is draft_model:
+                return cached_run
+        N = max_new_tokens
+        buf_len = P + N + K + 1  # slack: a round may write past P+N-1
+        max_len = buf_len
+
+        @jax.jit
+        def run(params, dparams, ids):
+            h, tc = self.prefill(params, ids, max_len)
+            _, dc = draft_model.prefill(dparams, ids, max_len)
+            tok0 = jnp.argmax(
+                self.decode_logits(params, h[:, -1:])[:, -1], -1) \
+                .astype(jnp.int32)                              # (1,)
+            buf = jnp.zeros((1, buf_len), jnp.int32) \
+                .at[:, :P].set(ids.astype(jnp.int32))
+            buf = jax.lax.dynamic_update_slice(buf, tok0[:, None], (0, P))
+
+            def cond(st):
+                return st[1] < P + N
+
+            def body(st):
+                buf, n, tc, dc = st
+                prev = jax.lax.dynamic_slice(buf, (0, n - 1), (1, 1))[:, 0]
+
+                def dstep(carry, i):
+                    tok, dc = carry
+                    hh = draft_model._embed_one(dparams, tok, n - 1 + i)
+                    hh, dc = draft_model.decode_step(dparams, hh, dc,
+                                                     n - 1 + i)
+                    ntok = jnp.argmax(
+                        draft_model.decode_logits(dparams, hh)[:, -1], -1) \
+                        .astype(jnp.int32)
+                    return (ntok, dc), ntok
+
+                (_, dc), d = jax.lax.scan(dstep, (prev, dc), jnp.arange(K))
+                d = d[:, 0]                                     # (K,)
+
+                # verify: one target chunk over [prev, d_0..d_{K-1}] gives
+                # the target's argmax for positions n..n+K (incl. the bonus)
+                inp = jnp.concatenate([prev, d])                # (K+1,)
+                hin = self._embed_chunk(params, inp, n - 1)
+                hv, tc = self.decode_step(params, hin, tc, n - 1)
+                tpred = jnp.argmax(
+                    self.decode_logits(params, hv)[0].astype(jnp.float32),
+                    -1).astype(jnp.int32)                       # (K+1,)
+                lead = jnp.sum(jnp.cumprod(
+                    (d == tpred[:K]).astype(jnp.int32)))
+                d_ext = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)])
+                cand = jnp.where(jnp.arange(K + 1) < lead, d_ext, tpred)
+                buf = jax.lax.dynamic_update_slice(buf, cand[None], (0, n))
+                n = jnp.minimum(n + lead + 1, P + N)
+                return (buf, n, tc, dc)
+
+            buf, n, tc, dc = jax.lax.while_loop(
+                cond, body, (buf, jnp.asarray(P + 1), tc, dc))
+            return jax.lax.dynamic_slice(buf, (0, P), (1, N))
+
+        progs[cache_key] = (weakref.ref(draft_model), run)
         return run
 
     def generate_beam(self, params, input_ids, max_new_tokens: int,

@@ -512,3 +512,64 @@ class TestPredictorIntegration:
         from paddle_tpu.inference import Config, create_predictor
         with pytest.raises(ValueError, match="not found"):
             create_predictor(Config("/nonexistent/prefix"))
+
+
+class TestSpeculative:
+    """Greedy speculative decoding must be LOSSLESS: bit-identical to the
+    target's plain greedy generate, for any draft quality."""
+
+    @pytest.fixture(scope="class")
+    def draft(self):
+        paddle.seed(99)
+        cfg = GPTConfig(vocab_size=97, hidden_size=16, num_layers=1,
+                        num_attention_heads=2, max_position_embeddings=64,
+                        compute_dtype="float32")
+        m = GPTModel(cfg)
+        return m, {n: p._data for n, p in m.named_parameters()}
+
+    @pytest.mark.parametrize("K", [1, 2, 4])
+    def test_lossless_vs_greedy_random_draft(self, model_and_params, draft,
+                                             K):
+        model, params = model_and_params
+        dmodel, dparams = draft
+        prompt = np.random.RandomState(60).randint(0, 97, (1, 5))
+        want = model.generate(params, prompt, max_new_tokens=9)
+        got = model.generate_speculative(params, prompt, 9, dmodel, dparams,
+                                         draft_k=K)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"K={K}")
+
+    def test_lossless_with_perfect_draft(self, model_and_params):
+        """Draft == target: every round accepts draft_k+1 tokens and the
+        output is still exactly greedy."""
+        model, params = model_and_params
+        prompt = np.random.RandomState(61).randint(0, 97, (1, 4))
+        want = model.generate(params, prompt, max_new_tokens=7)
+        got = model.generate_speculative(params, prompt, 7, model, params,
+                                         draft_k=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_single_token_and_validation(self, model_and_params, draft):
+        model, params = model_and_params
+        dmodel, dparams = draft
+        prompt = np.zeros((1, 3), np.int64)
+        out = model.generate_speculative(params, prompt, 1, dmodel, dparams)
+        want = model.generate(params, prompt, 1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+        with pytest.raises(NotImplementedError, match="B=1"):
+            model.generate_speculative(params, np.zeros((2, 3), np.int64), 2,
+                                       dmodel, dparams)
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            model.generate_speculative(params, prompt, 60, dmodel, dparams)
+
+    def test_vocab_mismatch_rejected(self, model_and_params):
+        model, params = model_and_params
+        paddle.seed(98)
+        other = GPTModel(GPTConfig(vocab_size=50, hidden_size=16,
+                                   num_layers=1, num_attention_heads=2,
+                                   max_position_embeddings=64,
+                                   compute_dtype="float32"))
+        oparams = {n: p._data for n, p in other.named_parameters()}
+        with pytest.raises(ValueError, match="vocab"):
+            model.generate_speculative(params, np.zeros((1, 3), np.int64), 2,
+                                       other, oparams)
