@@ -28,13 +28,32 @@ def cached_attention(q, ck, cv, t, pad_lens=None):
         jnp.asarray(hd, jnp.float32)).astype(q.dtype)
     row = jnp.arange(kq)[:, None]
     col = jnp.arange(ck.shape[1])[None, :]
-    mask = (col <= t + row)[None, None]                # (1, 1, k, max_len)
+    t_arr = jnp.asarray(t)
+    if t_arr.ndim == 0:                                # one slot for all rows
+        mask = (col <= t_arr + row)[None, None]        # (1, 1, k, max_len)
+    else:                                              # per-row slots (B,)
+        mask = (col[None, None] <=
+                t_arr[:, None, None, None] + row[None, None])
     if pad_lens is not None:
         pos = jnp.arange(ck.shape[1])
         mask = mask & (pos[None, :] >= pad_lens[:, None])[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+
+
+def write_cache(cache, chunk, t):
+    """Write a (B, kq, nh, hd) k/v chunk into the cache at slots [t, t+kq):
+    scalar ``t`` → one dynamic_update_slice; per-row (B,) ``t`` → scatter
+    (batched speculative decoding, rows at different positions)."""
+    t_arr = jnp.asarray(t)
+    if t_arr.ndim == 0:
+        return jax.lax.dynamic_update_slice(
+            cache, chunk.astype(cache.dtype), (0, t_arr, 0, 0))
+    B, kq = chunk.shape[:2]
+    rows = jnp.arange(B)[:, None]
+    slots = t_arr[:, None] + jnp.arange(kq)[None, :]
+    return cache.at[rows, slots].set(chunk.astype(cache.dtype))
 
 
 def make_token_sampler(temperature, top_k, top_p, greedy):
@@ -130,14 +149,18 @@ class CausalDecoderMixin:
                 "silently generate from a pad position")
 
     def _embed_one(self, params, tok, t, pad_lens=None):
-        """Embed one token per row at cache slot ``t``: (B,) -> (B, 1, H).
-        With left-padded prompts the LOGICAL position is t - pad_lens[b]."""
+        """Embed one token per row at cache slot ``t`` (scalar or per-row
+        (B,)): (B,) -> (B, 1, H).  With left-padded prompts the LOGICAL
+        position is t - pad_lens[b]."""
         dt = jnp.dtype(self.config.compute_dtype)
         wte = jnp.take(params["wte"], tok[:, None], axis=0)
-        if pad_lens is None:
-            wpe = params["wpe"][t][None, None, :]
+        t_arr = jnp.asarray(t)
+        if pad_lens is not None:
+            wpe = params["wpe"][t_arr - pad_lens][:, None, :]
+        elif t_arr.ndim == 0:
+            wpe = params["wpe"][t_arr][None, None, :]
         else:
-            wpe = params["wpe"][t - pad_lens][:, None, :]
+            wpe = params["wpe"][t_arr][:, None, :]
         return (wte + wpe).astype(dt)
 
     def init_cache(self, batch_size: int, max_len: int):
@@ -228,11 +251,19 @@ class CausalDecoderMixin:
         return run
 
     def _embed_chunk(self, params, toks, t0):
-        """Embed a (k,) token chunk at cache slots [t0, t0+k): (1, k, H)."""
+        """Embed a token chunk at cache slots [t0, t0+k).
+
+        toks (k,) with scalar t0 → (1, k, H); toks (B, k) with t0 (B,) →
+        (B, k, H) (per-row slots — batched speculative decoding)."""
         dt = jnp.dtype(self.config.compute_dtype)
-        k = toks.shape[0]
-        return (jnp.take(params["wte"], toks, axis=0)[None]
-                + params["wpe"][t0 + jnp.arange(k)][None]).astype(dt)
+        if toks.ndim == 1:
+            k = toks.shape[0]
+            return (jnp.take(params["wte"], toks, axis=0)[None]
+                    + params["wpe"][t0 + jnp.arange(k)][None]).astype(dt)
+        B, k = toks.shape
+        pos = jnp.asarray(t0)[:, None] + jnp.arange(k)[None, :]   # (B, k)
+        return (jnp.take(params["wte"], toks, axis=0)
+                + jnp.take(params["wpe"], pos, axis=0)).astype(dt)
 
     def generate_speculative(self, params, input_ids, max_new_tokens: int,
                              draft_model, draft_params, draft_k: int = 4):
@@ -249,16 +280,14 @@ class CausalDecoderMixin:
         self-heal: a stale slot (from a rejected draft token) is always
         rewritten as the next round's input before anything reads it.
 
-        B = 1 only (the latency-bound serving shape); greedy only (lossless
-        acceptance needs matching argmax).  The draft must share the
-        vocabulary.
+        Batched: rows accept independently (per-row cache slots via the
+        vectorized write/attention offsets); finished rows keep writing
+        into the buffer's slack region until the slowest row completes.
+        Greedy only (lossless acceptance needs matching argmax); the draft
+        must share the vocabulary.
         """
         c = self.config
         B, P = input_ids.shape
-        if B != 1:
-            raise NotImplementedError(
-                "speculative decoding is the B=1 latency path (rows would "
-                "advance at different rates)")
         if draft_model.config.vocab_size != c.vocab_size:
             raise ValueError(
                 f"draft vocab ({draft_model.config.vocab_size}) != target "
@@ -300,54 +329,66 @@ class CausalDecoderMixin:
 
         @jax.jit
         def run(params, dparams, ids):
+            B = ids.shape[0]
+            rows = jnp.arange(B)
             h, tc = self.prefill(params, ids, max_len)
             _, dc = draft_model.prefill(dparams, ids, max_len)
             tok0 = jnp.argmax(
                 self.decode_logits(params, h[:, -1:])[:, -1], -1) \
-                .astype(jnp.int32)                              # (1,)
-            buf = jnp.zeros((1, buf_len), jnp.int32) \
+                .astype(jnp.int32)                              # (B,)
+            buf = jnp.zeros((B, buf_len), jnp.int32) \
                 .at[:, :P].set(ids.astype(jnp.int32))
-            buf = jax.lax.dynamic_update_slice(buf, tok0[:, None], (0, P))
+            buf = buf.at[:, P].set(tok0)
 
             def cond(st):
-                return st[1] < P + N
+                return jnp.any(st[1] < P + N)
+
+            # B == 1 keeps the scalar slot index: dynamic_update_slice /
+            # dynamic_slice instead of scatter/gather on the latency path
+            def slot(t_vec):
+                return t_vec if B > 1 else t_vec[0]
 
             def body(st):
-                buf, n, tc, dc = st
-                prev = jax.lax.dynamic_slice(buf, (0, n - 1), (1, 1))[:, 0]
+                buf, n, tc, dc = st                             # n (B,)
+                prev = buf[rows, n - 1]                         # (B,)
 
                 def dstep(carry, i):
                     tok, dc = carry
-                    hh = draft_model._embed_one(dparams, tok, n - 1 + i)
+                    hh = draft_model._embed_one(dparams, tok, slot(n - 1 + i))
                     hh, dc = draft_model.decode_step(dparams, hh, dc,
-                                                     n - 1 + i)
+                                                     slot(n - 1 + i))
                     ntok = jnp.argmax(
                         draft_model.decode_logits(dparams, hh)[:, -1], -1) \
                         .astype(jnp.int32)
                     return (ntok, dc), ntok
 
                 (_, dc), d = jax.lax.scan(dstep, (prev, dc), jnp.arange(K))
-                d = d[:, 0]                                     # (K,)
+                d = d.T                                         # (B, K)
 
                 # verify: one target chunk over [prev, d_0..d_{K-1}] gives
                 # the target's argmax for positions n..n+K (incl. the bonus)
-                inp = jnp.concatenate([prev, d])                # (K+1,)
-                hin = self._embed_chunk(params, inp, n - 1)
-                hv, tc = self.decode_step(params, hin, tc, n - 1)
+                inp = jnp.concatenate([prev[:, None], d], axis=1)  # (B, K+1)
+                hin = self._embed_chunk(params, inp[0] if B == 1 else inp,
+                                        slot(n - 1))
+                hv, tc = self.decode_step(params, hin, tc, slot(n - 1))
                 tpred = jnp.argmax(
-                    self.decode_logits(params, hv)[0].astype(jnp.float32),
-                    -1).astype(jnp.int32)                       # (K+1,)
+                    self.decode_logits(params, hv).astype(jnp.float32),
+                    -1).astype(jnp.int32)                       # (B, K+1)
                 lead = jnp.sum(jnp.cumprod(
-                    (d == tpred[:K]).astype(jnp.int32)))
-                d_ext = jnp.concatenate([d, jnp.zeros((1,), jnp.int32)])
-                cand = jnp.where(jnp.arange(K + 1) < lead, d_ext, tpred)
-                buf = jax.lax.dynamic_update_slice(buf, cand[None], (0, n))
+                    (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
+                d_ext = jnp.concatenate(
+                    [d, jnp.zeros((B, 1), jnp.int32)], axis=1)  # (B, K+1)
+                cand = jnp.where(jnp.arange(K + 1)[None] < lead[:, None],
+                                 d_ext, tpred)
+                slots = n[:, None] + jnp.arange(K + 1)[None]
+                buf = buf.at[rows[:, None], slots].set(cand)
                 n = jnp.minimum(n + lead + 1, P + N)
                 return (buf, n, tc, dc)
 
+            n0 = jnp.full((B,), P + 1)
             buf, n, tc, dc = jax.lax.while_loop(
-                cond, body, (buf, jnp.asarray(P + 1), tc, dc))
-            return jax.lax.dynamic_slice(buf, (0, P), (1, N))
+                cond, body, (buf, n0, tc, dc))
+            return buf[:, P:P + N]
 
         progs[cache_key] = (weakref.ref(draft_model), run)
         return run

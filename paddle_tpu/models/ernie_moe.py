@@ -247,10 +247,10 @@ class ErnieMoeModel(CausalDecoderMixin, Layer):
     def _block_decode(self, sl, h, ck, cv, t, pad_lens=None):
         """One block for one new token at position t (h (B,1,H); ck/cv
         (B, max_len, nh, hd))."""
-        from ._decode import cached_attention
+        from ._decode import cached_attention, write_cache
         q, k, v = self._block_qkv(sl, h)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
+        ck = write_cache(ck, k, t)
+        cv = write_cache(cv, v, t)
         att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
         h = self._attn_residual(sl, h, att)
         return self._moe_residual_gather(sl, h), ck, cv

@@ -30,7 +30,7 @@ import numpy as np
 from ..core import rng
 from ..core.tensor import Parameter, Tensor, apply
 from ._decode import (CausalDecoderMixin, cached_attention,  # noqa: F401
-                      make_token_sampler, validate_sampler_args)
+                      make_token_sampler, validate_sampler_args, write_cache)
 from ..nn.layer.base import Layer
 from ..ops.attention import flash_attention
 
@@ -292,8 +292,8 @@ class GPTModel(CausalDecoderMixin, Layer):
         attention taken over cache positions ≤ t (later slots hold zeros or
         stale values — and left-pad slots, when pad_lens is set — masked)."""
         q, k, v = self._block_qkv(sl, h)
-        ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, t, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, t, 0, 0))
+        ck = write_cache(ck, k, t)
+        cv = write_cache(cv, v, t)
         att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
         return self._block_post_attn(sl, h, att), ck, cv
 

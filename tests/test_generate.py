@@ -556,11 +556,24 @@ class TestSpeculative:
         out = model.generate_speculative(params, prompt, 1, dmodel, dparams)
         want = model.generate(params, prompt, 1)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
-        with pytest.raises(NotImplementedError, match="B=1"):
-            model.generate_speculative(params, np.zeros((2, 3), np.int64), 2,
-                                       dmodel, dparams)
         with pytest.raises(ValueError, match="max_position_embeddings"):
             model.generate_speculative(params, prompt, 60, dmodel, dparams)
+
+    def test_batched_rows_accept_independently(self, model_and_params,
+                                               draft):
+        """B=3: every row's speculative output equals that row's solo greedy
+        run — per-row acceptance/cache offsets are independent even though
+        rows finish their token budgets at different round counts."""
+        model, params = model_and_params
+        dmodel, dparams = draft
+        prompts = np.random.RandomState(62).randint(0, 97, (3, 5))
+        got = model.generate_speculative(params, prompts, 8, dmodel, dparams,
+                                         draft_k=3)
+        for b in range(3):
+            solo = model.generate(params, prompts[b:b + 1], 8)
+            np.testing.assert_array_equal(np.asarray(got)[b],
+                                          np.asarray(solo)[0],
+                                          err_msg=f"row {b}")
 
     def test_vocab_mismatch_rejected(self, model_and_params):
         model, params = model_and_params
