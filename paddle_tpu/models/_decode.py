@@ -56,28 +56,73 @@ def write_cache(cache, chunk, t):
     return cache.at[rows, slots].set(chunk.astype(cache.dtype))
 
 
+def filter_logits(logits32, temperature, top_k, top_p):
+    """The temperature → top-k → nucleus (top-p) filtering pipeline on the
+    last axis of an (..., V) fp32 logits array (position-generic: used for
+    the single decode position and for speculative verify chunks)."""
+    logits32 = logits32 / jnp.asarray(max(temperature, 1e-6), jnp.float32)
+    if top_k is not None:
+        vals, _ = jax.lax.top_k(logits32, top_k)
+        logits32 = jnp.where(logits32 < vals[..., -1:], -jnp.inf, logits32)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of the sorted vocab with
+        # cumulative probability ≥ top_p (the boundary token stays)
+        srt = jnp.flip(jnp.sort(logits32, -1), -1)
+        cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
+        n_keep = jnp.sum(cdf < top_p, -1) + 1
+        kth = jnp.take_along_axis(srt, (n_keep - 1)[..., None], -1)
+        logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
+    return logits32
+
+
 def make_token_sampler(temperature, top_k, top_p, greedy):
     """Shared last-position sampler for the decode loops (GPT + ERNIE-MoE):
-    temperature → optional top-k filter → optional nucleus (top-p) filter →
-    argmax or categorical.  ``logits32`` is (B, 1, V) fp32."""
+    the filter_logits pipeline then argmax or categorical.  ``logits32`` is
+    (B, 1, V) fp32."""
     def sample(logits32, key):
-        logits32 = logits32[:, -1, :] / jnp.asarray(
-            max(temperature, 1e-6), jnp.float32)
-        if top_k is not None:
-            vals, _ = jax.lax.top_k(logits32, top_k)
-            logits32 = jnp.where(logits32 < vals[:, -1:], -jnp.inf, logits32)
-        if top_p is not None:
-            # nucleus: keep the smallest prefix of the sorted vocab with
-            # cumulative probability ≥ top_p (the boundary token stays)
-            srt = jnp.sort(logits32, -1)[:, ::-1]
-            cdf = jnp.cumsum(jax.nn.softmax(srt, -1), -1)
-            n_keep = jnp.sum(cdf < top_p, -1) + 1
-            kth = jnp.take_along_axis(srt, (n_keep - 1)[:, None], 1)
-            logits32 = jnp.where(logits32 < kth, -jnp.inf, logits32)
+        logits32 = filter_logits(logits32[:, -1, :], temperature, top_k,
+                                 top_p)
         if greedy:
             return jnp.argmax(logits32, -1).astype(jnp.int32)
         return jax.random.categorical(key, logits32, -1).astype(jnp.int32)
     return sample
+
+
+def speculative_accept(q_probs, p_probs, d_tokens, key):
+    """Leviathan/Chen acceptance-rejection for one speculative round — the
+    output token sequence is distributed EXACTLY as autoregressive sampling
+    from the target distributions ``p`` (the lossless-in-distribution
+    guarantee; tests/test_generate.py checks the marginal empirically).
+
+    q_probs (B, K, V): draft distributions the K proposed tokens were drawn
+    from; p_probs (B, K+1, V): target distributions at the same positions
+    plus the bonus position; d_tokens (B, K): the draft proposals.
+
+    Returns (lead (B,), repl (B,)): per row, the count of accepted draft
+    tokens and the replacement token for position ``lead`` — drawn from the
+    residual distribution norm(max(p - q, 0)) on rejection, or from the
+    bonus target distribution when every proposal was accepted.
+    """
+    B, K, V = q_probs.shape
+    key_u, key_r = jax.random.split(key)
+    u = jax.random.uniform(key_u, (B, K))
+    qd = jnp.take_along_axis(q_probs, d_tokens[..., None], -1)[..., 0]
+    pd = jnp.take_along_axis(p_probs[:, :K], d_tokens[..., None], -1)[..., 0]
+    accept = u * qd < pd                  # u < p/q without dividing by 0
+    lead = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # residual distribution at the first rejected position (bonus p when
+    # lead == K); gather per-row with a clamped index then overwrite
+    idx = jnp.minimum(lead, K - 1)
+    p_at = jnp.take_along_axis(p_probs, idx[:, None, None]
+                               .repeat(V, -1), 1)[:, 0]          # (B, V)
+    q_at = jnp.take_along_axis(q_probs, idx[:, None, None]
+                               .repeat(V, -1), 1)[:, 0]
+    resid = jnp.maximum(p_at - q_at, 0.0)
+    resid = resid / jnp.maximum(jnp.sum(resid, -1, keepdims=True), 1e-20)
+    dist = jnp.where((lead == K)[:, None], p_probs[:, K], resid)
+    repl = jax.random.categorical(
+        key_r, jnp.log(jnp.maximum(dist, 1e-20)), -1).astype(jnp.int32)
+    return lead, repl
 
 
 def validate_sampler_args(vocab_size, top_k, top_p, greedy, key):
@@ -266,25 +311,37 @@ class CausalDecoderMixin:
                 + jnp.take(params["wpe"], pos, axis=0)).astype(dt)
 
     def generate_speculative(self, params, input_ids, max_new_tokens: int,
-                             draft_model, draft_params, draft_k: int = 4):
-        """Greedy speculative decoding (≙ the draft-and-verify serving
-        optimization; LOSSLESS — output is bit-identical to this model's
-        greedy ``generate``).
+                             draft_model, draft_params, draft_k: int = 4,
+                             greedy: bool = True, temperature: float = 1.0,
+                             top_k: Optional[int] = None,
+                             top_p: Optional[float] = None, key=None,
+                             return_rounds: bool = False):
+        """Speculative decoding (≙ the draft-and-verify serving
+        optimization; LOSSLESS — greedy mode is bit-identical to this
+        model's greedy ``generate``, and sampling mode draws from EXACTLY
+        the target's filtered distribution via Leviathan/Chen
+        acceptance-rejection, `speculative_accept`).
 
-        Per round: the draft proposes ``draft_k`` greedy tokens one at a
-        time; the target verifies all of them (plus one bonus token) in ONE
-        chunked cache step (cached_attention's k-query form).  The longest
-        matching prefix + the target's correction are accepted, so each
-        round emits 1..draft_k+1 tokens at the cost of one target chunk —
-        the speedup is the draft's acceptance rate.  Both KV caches
-        self-heal: a stale slot (from a rejected draft token) is always
-        rewritten as the next round's input before anything reads it.
+        Per round: the draft proposes ``draft_k`` tokens one at a time
+        (argmax in greedy mode, sampled from its filtered distribution in
+        sampling mode); the target verifies all of them (plus one bonus
+        token) in ONE chunked cache step (cached_attention's k-query form).
+        The accepted prefix + a correction/resample are kept, so each round
+        emits 1..draft_k+1 tokens at the cost of one target chunk — the
+        speedup is the draft's acceptance rate.  The draft cache is then
+        re-ingested from the same verify chunk (its sequential loop never
+        fed the last proposal, which would leave a permanent zero-kv hole
+        after a fully-accepted round); stale slots from rejected tokens are
+        always rewritten as the next round's input before anything reads
+        them.
 
         Batched: rows accept independently (per-row cache slots via the
         vectorized write/attention offsets); finished rows keep writing
         into the buffer's slack region until the slowest row completes.
-        Greedy only (lossless acceptance needs matching argmax); the draft
-        must share the vocabulary.
+        The draft must share the vocabulary.  In sampling mode both models
+        apply the SAME temperature/top-k/top-p filter; the draft proposes
+        from its filtered distribution and rejections resample from the
+        residual norm(max(p - q, 0)).
         """
         c = self.config
         B, P = input_ids.shape
@@ -305,10 +362,17 @@ class CausalDecoderMixin:
                     f"P + max_new_tokens + draft_k = {need} exceeds the "
                     f"{who}'s max_position_embeddings "
                     f"({m.max_position_embeddings})")
-        run = self._spec_program(draft_model, P, max_new_tokens, K)
-        return run(params, draft_params, jnp.asarray(input_ids))
+        validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
+        key = jax.random.key(0) if key is None else key
+        run = self._spec_program(
+            draft_model, P, max_new_tokens, K, greedy, float(temperature),
+            None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p))
+        toks, rounds = run(params, draft_params, jnp.asarray(input_ids), key)
+        return (toks, rounds) if return_rounds else toks
 
-    def _spec_program(self, draft_model, P, max_new_tokens, K):
+    def _spec_program(self, draft_model, P, max_new_tokens, K, greedy,
+                      temperature, top_k, top_p):
         # keyed by the draft's config signature with a weakref identity
         # check: one entry per signature (bounded memory — a fresh draft
         # instance replaces, never accumulates), and a recycled id() can
@@ -316,7 +380,8 @@ class CausalDecoderMixin:
         import weakref
         dcfg = draft_model.config
         cache_key = ("spec", type(draft_model).__name__, dcfg.vocab_size,
-                     dcfg.num_layers, dcfg.hidden_size, P, max_new_tokens, K)
+                     dcfg.num_layers, dcfg.hidden_size, P, max_new_tokens, K,
+                     greedy, temperature, top_k, top_p)
         progs = self.__dict__.setdefault("_gen_programs", {})
         entry = progs.get(cache_key)
         if entry is not None:
@@ -327,15 +392,20 @@ class CausalDecoderMixin:
         buf_len = P + N + K + 1  # slack: a round may write past P+N-1
         max_len = buf_len
 
+        def filt(logits):
+            return filter_logits(logits.astype(jnp.float32), temperature,
+                                 top_k, top_p)
+
+        sample0 = make_token_sampler(temperature, top_k, top_p, greedy)
+
         @jax.jit
-        def run(params, dparams, ids):
+        def run(params, dparams, ids, key):
             B = ids.shape[0]
             rows = jnp.arange(B)
             h, tc = self.prefill(params, ids, max_len)
             _, dc = draft_model.prefill(dparams, ids, max_len)
-            tok0 = jnp.argmax(
-                self.decode_logits(params, h[:, -1:])[:, -1], -1) \
-                .astype(jnp.int32)                              # (B,)
+            key, k0 = jax.random.split(key)
+            tok0 = sample0(self.decode_logits(params, h[:, -1:]), k0)  # (B,)
             buf = jnp.zeros((B, buf_len), jnp.int32) \
                 .at[:, :P].set(ids.astype(jnp.int32))
             buf = buf.at[:, P].set(tok0)
@@ -349,46 +419,71 @@ class CausalDecoderMixin:
                 return t_vec if B > 1 else t_vec[0]
 
             def body(st):
-                buf, n, tc, dc = st                             # n (B,)
+                buf, n, tc, dc, key, rounds = st                # n (B,)
                 prev = buf[rows, n - 1]                         # (B,)
+                key, kd, ka = jax.random.split(key, 3)
 
                 def dstep(carry, i):
                     tok, dc = carry
                     hh = draft_model._embed_one(dparams, tok, slot(n - 1 + i))
                     hh, dc = draft_model.decode_step(dparams, hh, dc,
                                                      slot(n - 1 + i))
-                    ntok = jnp.argmax(
-                        draft_model.decode_logits(dparams, hh)[:, -1], -1) \
-                        .astype(jnp.int32)
-                    return (ntok, dc), ntok
+                    ql = filt(draft_model.decode_logits(dparams, hh)[:, -1])
+                    if greedy:
+                        ntok = jnp.argmax(ql, -1).astype(jnp.int32)
+                        qout = jnp.zeros((ql.shape[0], 0))  # probs unused
+                    else:
+                        ntok = jax.random.categorical(
+                            jax.random.fold_in(kd, i), ql, -1) \
+                            .astype(jnp.int32)
+                        qout = jax.nn.softmax(ql, -1)
+                    return (ntok, dc), (ntok, qout)
 
-                (_, dc), d = jax.lax.scan(dstep, (prev, dc), jnp.arange(K))
+                (_, dc), (d, qp) = jax.lax.scan(dstep, (prev, dc),
+                                                jnp.arange(K))
                 d = d.T                                         # (B, K)
 
-                # verify: one target chunk over [prev, d_0..d_{K-1}] gives
-                # the target's argmax for positions n..n+K (incl. the bonus)
+                # verify: ONE target chunk over [prev, d_0..d_{K-1}] gives
+                # the target's filtered logits for positions n..n+K
                 inp = jnp.concatenate([prev[:, None], d], axis=1)  # (B, K+1)
                 hin = self._embed_chunk(params, inp[0] if B == 1 else inp,
                                         slot(n - 1))
                 hv, tc = self.decode_step(params, hin, tc, slot(n - 1))
-                tpred = jnp.argmax(
-                    self.decode_logits(params, hv).astype(jnp.float32),
-                    -1).astype(jnp.int32)                       # (B, K+1)
-                lead = jnp.sum(jnp.cumprod(
-                    (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
+                tl = filt(self.decode_logits(params, hv))       # (B, K+1, V)
+                # re-ingest the chunk into the DRAFT cache: the sequential
+                # draft loop never fed d_{K-1}, so slot n+K-1 would stay a
+                # zero-kv hole after a fully-accepted round (permanently
+                # degrading acceptance; outputs stay correct so only a
+                # round-count test can see it)
+                dh = draft_model._embed_chunk(dparams,
+                                              inp[0] if B == 1 else inp,
+                                              slot(n - 1))
+                _, dc = draft_model.decode_step(dparams, dh, dc, slot(n - 1))
+                if greedy:
+                    tpred = jnp.argmax(tl, -1).astype(jnp.int32)
+                    lead = jnp.sum(jnp.cumprod(
+                        (d == tpred[:, :K]).astype(jnp.int32), axis=1),
+                        axis=1)
+                    repl_src = tpred                            # (B, K+1)
+                    repl = jnp.take_along_axis(
+                        repl_src, jnp.minimum(lead, K)[:, None], 1)[:, 0]
+                else:
+                    q_probs = jnp.swapaxes(qp, 0, 1)            # (B, K, V)
+                    p_probs = jax.nn.softmax(tl, -1)            # (B, K+1, V)
+                    lead, repl = speculative_accept(q_probs, p_probs, d, ka)
                 d_ext = jnp.concatenate(
                     [d, jnp.zeros((B, 1), jnp.int32)], axis=1)  # (B, K+1)
                 cand = jnp.where(jnp.arange(K + 1)[None] < lead[:, None],
-                                 d_ext, tpred)
+                                 d_ext, repl[:, None])
                 slots = n[:, None] + jnp.arange(K + 1)[None]
                 buf = buf.at[rows[:, None], slots].set(cand)
                 n = jnp.minimum(n + lead + 1, P + N)
-                return (buf, n, tc, dc)
+                return (buf, n, tc, dc, key, rounds + 1)
 
             n0 = jnp.full((B,), P + 1)
-            buf, n, tc, dc = jax.lax.while_loop(
-                cond, body, (buf, n0, tc, dc))
-            return buf[:, P:P + N]
+            buf, n, tc, dc, key, rounds = jax.lax.while_loop(
+                cond, body, (buf, n0, tc, dc, key, jnp.zeros((), jnp.int32)))
+            return buf[:, P:P + N], rounds
 
         progs[cache_key] = (weakref.ref(draft_model), run)
         return run
