@@ -72,6 +72,13 @@ def main():
                                             draft_k=args.draft_k))
     assert np.array_equal(np.asarray(out_plain), np.asarray(out_spec)), \
         "speculative decoding must be lossless"
+    _, rounds = target.generate_speculative(tparams, ids, N, draft, dparams,
+                                            draft_k=args.draft_k,
+                                            return_rounds=True)
+    rounds = int(rounds)
+    # acceptance per round: N-1 loop tokens over `rounds` rounds of at most
+    # draft_k+1; the minimum possible is ceil((N-1)/(draft_k+1))
+    min_rounds = -(-(N - 1) // (args.draft_k + 1))
 
     plain_tps = N * iters / dt_plain
     spec_tps = N * iters / dt_spec
@@ -82,6 +89,8 @@ def main():
         "speedup": round(spec_tps / plain_tps, 3),
         "draft_k": args.draft_k,
         "draft_layers": dcfg["num_layers"],
+        "rounds": rounds,
+        "round_efficiency": round(min_rounds / max(rounds, 1), 3),
         "lossless_check": "passed",
         "backend": jax.default_backend(),
     }), flush=True)
