@@ -735,3 +735,30 @@ class TestSpeculativeRounds:
         want = model.generate(params, prompt, N)
         np.testing.assert_array_equal(np.asarray(toks), np.asarray(want))
         assert int(rounds) == -(-(N - 1) // (K + 1)), int(rounds)
+
+
+class TestCrossFamilySpeculative:
+    def test_moe_target_with_gpt_draft(self):
+        """The mixin contract makes speculative decoding model-agnostic:
+        an ERNIE-MoE target accelerated by a dense GPT draft stays
+        bit-lossless vs the MoE's own greedy decode."""
+        from paddle_tpu.models.ernie_moe import ErnieMoeConfig, ErnieMoeModel
+
+        paddle.seed(90)
+        moe = ErnieMoeModel(ErnieMoeConfig(
+            vocab_size=53, hidden_size=32, num_layers=2,
+            num_attention_heads=4, num_experts=4, top_k=2,
+            max_position_embeddings=32, compute_dtype="float32"))
+        mparams = {n: p._data for n, p in moe.named_parameters()}
+        paddle.seed(91)
+        draft = GPTModel(GPTConfig(
+            vocab_size=53, hidden_size=16, num_layers=1,
+            num_attention_heads=2, max_position_embeddings=32,
+            compute_dtype="float32"))
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+
+        prompt = np.random.RandomState(92).randint(0, 53, (1, 4))
+        want = moe.generate(mparams, prompt, max_new_tokens=6)
+        got = moe.generate_speculative(mparams, prompt, 6, draft, dparams,
+                                       draft_k=2)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
