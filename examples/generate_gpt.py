@@ -82,6 +82,23 @@ def main():
         "padded serving must be exact"
     print("ragged     :", np.asarray(ragged)[0].tolist(),
           "(== unpadded run)")
+
+    # speculative decoding: a cheap draft proposes, the target verifies in
+    # one chunk per round — greedy mode is bit-lossless
+    paddle.seed(1)
+    draft_cfg = GPTConfig(vocab_size=cfg.vocab_size, hidden_size=32,
+                          num_layers=1, num_attention_heads=4,
+                          max_position_embeddings=cfg.max_position_embeddings,
+                          compute_dtype="float32")
+    draft = GPTModel(draft_cfg)
+    dparams = {n: p._data for n, p in draft.named_parameters()}
+    spec, rounds = model.generate_speculative(
+        params, prompt, args.max_new_tokens, draft, dparams, draft_k=3,
+        return_rounds=True)
+    assert np.array_equal(np.asarray(spec), np.asarray(greedy)), \
+        "speculative decoding must be lossless"
+    print(f"speculative: lossless in {int(rounds)} rounds "
+          f"({args.max_new_tokens} tokens, draft_k=3)")
     print("GENERATION_OK")
 
 
