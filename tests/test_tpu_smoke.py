@@ -8,9 +8,9 @@ the dense/XLA path on-device.
 
 Run (TPU only — skipped wholesale elsewhere):
     PYTHONPATH=/root/repo:/root/.axon_site python -m pytest -m tpu -q \
-        tests/test_tpu_smoke.py 2>&1 | tee TPU_SMOKE_r03.log
+        tests/test_tpu_smoke.py 2>&1 | tee TPU_SMOKE_r04.log
 
-The committed log (TPU_SMOKE_r03.log) is the round's hardware evidence.
+The committed log (TPU_SMOKE_r04.log) is the round's hardware evidence.
 """
 import numpy as np
 import pytest

@@ -3,10 +3,10 @@
 # Everything runs under tpu_guard.sh (claim hygiene: no signal ever reaches
 # a claim-holder) and writes committed artifacts:
 #   BENCH_pre.json       - bench.py --config all (the driver artifact's dry run)
-#   TPU_SMOKE_r03.log    - Mosaic smoke suite (pytest -m tpu)
-#   FUSED_PROBE_r03.json - XLA-fusion roofline numbers for the kernel decision
-#   FLASH_SWEEP_r03.json - flash block-size sweep on gpt2s (pick the winner)
-#   SPEC_BENCH_r03.json  - speculative-decode speedup (lossless check + tok/s)
+#   TPU_SMOKE_r04.log    - Mosaic smoke suite (pytest -m tpu)
+#   FUSED_PROBE_r04.json - XLA-fusion roofline numbers for the kernel decision
+#   FLASH_SWEEP_r04.json - flash block-size sweep on gpt2s (pick the winner)
+#   SPEC_BENCH_r04.json  - speculative-decode speedup (lossless check + tok/s)
 #
 # Usage: from /root/repo:  bash tools/tpu_session.sh
 set -u
@@ -19,22 +19,22 @@ TPU_GUARD_LOG=/tmp/bench_all.log $G python bench.py --config all
 grep "^{" /tmp/bench_all.log | tee BENCH_pre.json
 
 echo "=== 2/5 Mosaic smoke suite"
-TPU_GUARD_LOG=TPU_SMOKE_r03.log PADDLE_TPU_TEST_TPU=1 \
+TPU_GUARD_LOG=TPU_SMOKE_r04.log PADDLE_TPU_TEST_TPU=1 \
     $G python -m pytest -m tpu tests/test_tpu_smoke.py -q -v
-tail -5 TPU_SMOKE_r03.log
+tail -5 TPU_SMOKE_r04.log
 
 echo "=== 3/5 fusion roofline probe"
 TPU_GUARD_LOG=/tmp/fused_probe.log $G python tools/fused_probe.py
-grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_r03.json
+grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_r04.json
 
 echo "=== 4/5 flash block sweep (gpt2s)"
 TPU_GUARD_LOG=/tmp/flash_sweep.log $G python tools/flash_sweep.py
-grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_r03.json
+grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_r04.json
 
 echo "=== 5/5 speculative-decode speedup"
 TPU_GUARD_LOG=/tmp/spec_bench.log $G python tools/spec_bench.py
 if grep -q "^{" /tmp/spec_bench.log; then
-    grep "^{" /tmp/spec_bench.log | tee SPEC_BENCH_r03.json
+    grep "^{" /tmp/spec_bench.log | tee SPEC_BENCH_r04.json
 else
     echo "spec_bench FAILED (no JSON line); tail of log:" >&2
     tail -5 /tmp/spec_bench.log >&2
