@@ -462,16 +462,23 @@ def _probe_backend(timeout=300.0):
             out = f.read()
     finally:
         outf.close()
-        try:
-            os.unlink(outf.name)
-        except OSError:
-            pass
+        # An in-flight probe keeps writing after we move on — keep its file
+        # (and say where it is) so the eventual traceback of a half-up wedge
+        # is not lost; that trace is the root-cause evidence HEALTH.log
+        # exists to point at.
+        if proc.poll() is not None:
+            try:
+                os.unlink(outf.name)
+            except OSError:
+                pass
     healthy = exited and proc.returncode == 0 and "COMPUTE_HEALTHY" in out
     rc = proc.returncode if exited else "inflight"
     detail = next((ln for ln in out.splitlines()
                    if ln.startswith("COMPUTE_HEALTHY")), "")
     _health_log(f"probe rc={rc} {'ok ' + detail if healthy else 'FAIL'} "
-                + ("" if healthy else out[-200:].replace("\n", " ")))
+                + ("" if healthy else out[-200:].replace("\n", " "))
+                + ("" if exited else f" [probe left running; output -> "
+                                     f"{outf.name}]"))
     return healthy, rc, out
 
 

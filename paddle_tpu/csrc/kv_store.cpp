@@ -117,8 +117,13 @@ bool handle_requests(Server* srv, Conn* c) {
         memcpy(&delta, val.data(), 8);
         int64_t cur = 0;
         auto it = srv->kv.find(key);
-        if (it != srv->kv.end() && it->second.size() == 8)
+        if (it != srv->kv.end()) {
+          // ADD on a key holding a non-counter value (e.g. a string SET by
+          // rendezvous) is a protocol error, not a silent reset-to-zero —
+          // close the connection as malformed rather than clobber the value.
+          if (it->second.size() != 8) return false;
           memcpy(&cur, it->second.data(), 8);
+        }
         cur += delta;
         std::string stored((const char*)&cur, 8);
         srv->kv[key] = stored;
@@ -211,9 +216,16 @@ void* serve_loop(void* arg) {
       if (!c->wbuf.empty()) {
         ssize_t n = send(fd, c->wbuf.data(), c->wbuf.size(), MSG_NOSIGNAL);
         if (n > 0) c->wbuf.erase(0, n);
-        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK)
+        else if (n < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
           dead.push_back(fd);
+          continue;  // don't let the wbuf cap below double-add this fd
+        }
       }
+      // rbuf is bounded by the frame sanity caps, but wbuf is not: a client
+      // that stops reading while piling up LIST/WAIT responses would grow
+      // server memory without limit.  4x the max frame size is far beyond
+      // any legitimate backlog.
+      if (c->wbuf.size() > (4u << 26)) dead.push_back(fd);
     }
     for (int fd : dead) drop_conn(srv, fd);
   }

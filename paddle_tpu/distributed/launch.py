@@ -144,11 +144,24 @@ def _maybe_host_store(args):
     local = host in ("127.0.0.1", "localhost", "0.0.0.0", "")
     if not (local or args.node_rank == 0):
         return None
+    from .store import StoreServer  # import outside the try: a missing /
+    # unbuildable native library must surface as itself, not as a port error
     try:
-        from .store import StoreServer
         return StoreServer(port=int(port or 0))
-    except OSError:
-        return None  # already bound (another launcher on this host owns it)
+    except OSError as e:
+        # Bind failed.  Only "another launcher on this host already owns the
+        # port" is benign — confirm by dialing it; any other failure
+        # (permission, bad port) must surface, or the workers hang forever
+        # dialing a store that never comes up.
+        import socket as _socket
+        try:
+            with _socket.create_connection(
+                    ("127.0.0.1", int(port or 0)), timeout=2.0):
+                return None  # live listener: another launcher hosts the store
+        except OSError:
+            raise RuntimeError(
+                f"--elastic_store {target}: could not bind the store port "
+                f"and nothing is listening on it") from e
 
 
 def launch(argv=None) -> int:

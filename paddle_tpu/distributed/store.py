@@ -188,34 +188,36 @@ class FileStore:
             return None
 
     def add(self, key: str, delta: int = 1) -> int:
-        # advisory-locked read-modify-write (single host: O_EXCL lock file).
-        # A holder that dies mid-section (SIGKILL — the exact fault elastic
-        # exists for) leaves the lock behind; steal it once it goes stale.
+        # flock-locked read-modify-write on a persistent per-key lock file.
+        # The kernel drops the lock when the holder dies (SIGKILL included —
+        # the exact fault elastic exists for), so there is no stale-lock
+        # heuristic and no steal race: the previous O_EXCL+mtime scheme could
+        # unlink a *fresh* holder's lock between the staleness check and the
+        # unlink, admitting two writers and losing an increment.
+        import fcntl
         lock = self._p(key) + ".lock"
         deadline = time.time() + 10.0
-        stale_after = 5.0
-        while True:
-            try:
-                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                os.close(fd)
-                break
-            except FileExistsError:
-                try:
-                    if time.time() - os.path.getmtime(lock) > stale_after:
-                        os.unlink(lock)  # dead holder: break the lock
-                        continue
-                except OSError:
-                    continue  # raced with the holder's own unlink
-                if time.time() > deadline:
-                    raise TimeoutError(f"store lock stuck: {lock}")
-                time.sleep(0.01)
+        fd = os.open(lock, os.O_CREAT | os.O_WRONLY)
         try:
+            while True:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                    break
+                except BlockingIOError:
+                    if time.time() > deadline:
+                        raise TimeoutError(f"store lock stuck: {lock}")
+                    time.sleep(0.01)
             cur = self.get(key)
+            if cur and len(cur) != 8:
+                # same contract as the TCP backend: ADD on a key holding a
+                # non-counter value is a protocol error (OSError), never a
+                # silent clobber
+                raise OSError(f"add({key!r}): existing value is not a counter")
             new = (struct.unpack("<q", cur)[0] if cur else 0) + delta
             self.set(key, struct.pack("<q", new))
             return new
         finally:
-            os.unlink(lock)
+            os.close(fd)  # closing the fd releases the flock
 
     def wait(self, key: str, timeout: Optional[float] = None) -> bytes:
         deadline = None if timeout is None else time.time() + timeout

@@ -101,6 +101,19 @@ class TestTCPStore:
         assert st.get("via-url") == b"y"
         st.close()
 
+    def test_add_on_string_value_is_protocol_error(self, server):
+        """ADD on a key SET to a non-8-byte value must not silently clobber
+        it with a counter; the server closes the connection as malformed and
+        the value survives (ADVICE r3: kv_store.cpp ADD type confusion)."""
+        st = TCPStore("127.0.0.1", server.port)
+        st.set("strkey", b"not-a-counter")
+        with pytest.raises(OSError):
+            st.add("strkey")           # server drops the malformed connection
+        st2 = TCPStore("127.0.0.1", server.port)
+        assert st2.get("strkey") == b"not-a-counter"   # value untouched
+        st.close()
+        st2.close()
+
 
 class TestFileStoreParity:
     """FileStore implements the same contract (dir backend)."""
@@ -118,6 +131,42 @@ class TestFileStoreParity:
         assert st.wait("n", timeout=1.0) == struct.pack("<q", 3)
         with pytest.raises(TimeoutError):
             st.wait("never", timeout=0.2)
+
+    def test_add_on_string_value_is_error(self, tmp_path):
+        # same contract as TCPStore: protocol error (OSError), value intact
+        st = FileStore(str(tmp_path))
+        st.set("strkey", b"not-a-counter")
+        with pytest.raises(OSError):
+            st.add("strkey")
+        assert st.get("strkey") == b"not-a-counter"
+
+    def test_add_lock_released_on_holder_sigkill(self, tmp_path):
+        """A lock holder SIGKILLed mid-section (the exact fault elastic
+        exists for) must not wedge or double-admit later adders: flock is
+        kernel-released on death, unlike the old mtime-staleness steal
+        (ADVICE r3: FileStore.add TOCTOU race)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import textwrap
+        st = FileStore(str(tmp_path))
+        st.add("c", 7)
+        holder = subprocess.Popen(
+            [sys.executable, "-c", textwrap.dedent(f"""
+                import fcntl, os, time
+                fd = os.open({str(tmp_path)!r} + "/c.lock",
+                             os.O_CREAT | os.O_WRONLY)
+                fcntl.flock(fd, fcntl.LOCK_EX)
+                print("locked", flush=True)
+                time.sleep(60)
+            """)], stdout=subprocess.PIPE)
+        assert holder.stdout.readline().strip() == b"locked"
+        os.kill(holder.pid, signal.SIGKILL)
+        holder.wait()
+        t0 = time.time()
+        assert st.add("c", 1) == 8          # no stall, no lost increment
+        assert time.time() - t0 < 2.0
 
 
 class TestElasticOverTCP:
