@@ -449,6 +449,7 @@ def _probe_backend(timeout=300.0):
     left running at the deadline."""
     import tempfile
     outf = tempfile.NamedTemporaryFile(mode="w+", suffix=".probe", delete=False)
+    exited = False
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_SRC], stdout=outf, stderr=outf,
@@ -465,8 +466,10 @@ def _probe_backend(timeout=300.0):
         # An in-flight probe keeps writing after we move on — keep its file
         # (and say where it is) so the eventual traceback of a half-up wedge
         # is not lost; that trace is the root-cause evidence HEALTH.log
-        # exists to point at.
-        if proc.poll() is not None:
+        # exists to point at.  Gate on the same `exited` the verdict uses:
+        # a probe finishing right after the deadline must not have the file
+        # we are about to advertise unlinked from under the log line.
+        if exited:
             try:
                 os.unlink(outf.name)
             except OSError:

@@ -84,6 +84,9 @@ def _child_env(args, local_rank: int, world: int, nproc: int) -> dict:
         env["PADDLE_MASTER"] = args.master
     env["PADDLE_LOCAL_RANK"] = str(local_rank)
     env["FLAGS_selected_tpus"] = str(local_rank)
+    if args.elastic_store:
+        # children see the store target without re-plumbing it themselves
+        env["PADDLE_ELASTIC_STORE"] = str(args.elastic_store)
     if args.devices == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["PADDLE_TPU_PLATFORM"] = "cpu"
@@ -144,8 +147,10 @@ def _maybe_host_store(args):
     local = host in ("127.0.0.1", "localhost", "0.0.0.0", "")
     if not (local or args.node_rank == 0):
         return None
-    from .store import StoreServer  # import outside the try: a missing /
-    # unbuildable native library must surface as itself, not as a port error
+    from .store import StoreServer
+    from ..csrc import load_library
+    load_library("kv_store")  # outside the try: a missing / unbuildable
+    # native library must surface as itself, not as a port error
     try:
         return StoreServer(port=int(port or 0))
     except OSError as e:

@@ -4,8 +4,10 @@ Reference: fleet/elastic/manager.py:130 (etcd client: host registration,
 heartbeat leases, watches) and the raw-TCP NCCL-id bootstrap
 (gen_comm_id_helper.cc).  Two backends behind one interface:
 
-- ``FileStore`` — a directory on a shared mount (GCS fuse / NFS); the
-  original single-host/shared-fs path.
+- ``FileStore`` — a directory on a local disk or an NFSv4 mount; the
+  original single-host/shared-fs path.  ``add`` needs working advisory
+  locks, which object-store mounts (gcsfuse) don't provide — multi-host
+  jobs should use the TCP store.
 - ``TCPStore`` — client for the native store server (csrc/kv_store.cpp), a
   single C++ poll-loop the launcher's rank-0 hosts in-process.  This is the
   multi-host path: workers dial ``tcp://master:port`` — no etcd, no shared
@@ -194,6 +196,13 @@ class FileStore:
         # heuristic and no steal race: the previous O_EXCL+mtime scheme could
         # unlink a *fresh* holder's lock between the staleness check and the
         # unlink, admitting two writers and losing an increment.
+        #
+        # Deployment contract: advisory locking must actually reach the other
+        # writers — true on a local filesystem (one host, the common case)
+        # and on NFSv4 mounts (server-side lockd).  Object-store mounts like
+        # gcsfuse implement NO file locking (each host would lock privately);
+        # for those, counters must go through the TCP store
+        # (``tcp://host:port``), which is the designed multi-host path.
         import fcntl
         lock = self._p(key) + ".lock"
         deadline = time.time() + 10.0

@@ -39,7 +39,7 @@ TRAINER = textwrap.dedent("""
     from paddle_tpu.distributed import checkpoint as dckpt
     from paddle_tpu.distributed.fleet.elastic import ElasticManager
 
-    STORE = os.environ["REHEARSAL_STORE"]
+    STORE = os.environ["PADDLE_ELASTIC_STORE"]   # exported by the launcher
     CKPT = os.environ["REHEARSAL_CKPT"]
     FLAG = os.environ["REHEARSAL_FLAG"]     # exists => the fault already fired
     TOTAL_STEPS = 6
@@ -111,7 +111,6 @@ def test_launch_tcp_store_fault_restart_resume(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if k not in ("PALLAS_AXON_POOL_IPS", "XLA_FLAGS", "JAX_PLATFORMS")}
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
-    env["REHEARSAL_STORE"] = f"tcp://127.0.0.1:{store_port}"
     env["REHEARSAL_CKPT"] = str(tmp_path / "ckpt")
     env["REHEARSAL_FLAG"] = str(tmp_path / "fault_fired")
 
