@@ -21,6 +21,10 @@ from .core import rng
 from .core.tensor import Tensor, apply
 
 __all__ = ["Distribution", "Uniform", "Normal", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Gamma", "LogNormal",
+           "Laplace", "Independent", "TransformedDistribution",
+           "Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "ChainTransform",
            "kl_divergence", "register_kl"]
 
 
@@ -205,6 +209,425 @@ class Bernoulli(Distribution):
         return Tensor(-(p * jnp.log(p) + (1.0 - p) * jnp.log1p(-p)))
 
 
+class Gamma(Distribution):
+    """Gamma(concentration, rate) — density r^c x^{c-1} e^{-rx} / Γ(c).
+
+    Reference: python/paddle/distribution/gamma.py.  Sampling uses
+    ``jax.random.gamma`` (reparameterized via implicit differentiation, so
+    ``rsample`` gradients flow to ``concentration``)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _to_array(concentration)
+        self.rate = _to_array(rate)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.concentration.shape, self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate * jnp.ones(self._batch))
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2
+                      * jnp.ones(self._batch))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self._batch
+        g = jax.random.gamma(rng.next_key(),
+                             jnp.broadcast_to(self.concentration, shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        def f(v, c, r):
+            return (c * jnp.log(r) + (c - 1.0) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(c))
+        return apply(f, value, Tensor(self.concentration), Tensor(self.rate))
+
+    def entropy(self):
+        c, r = jnp.broadcast_arrays(self.concentration, self.rate)
+        dg = jax.scipy.special.digamma(c)
+        return Tensor(c - jnp.log(r) + jax.scipy.special.gammaln(c)
+                      + (1.0 - c) * dg)
+
+
+class Beta(Distribution):
+    """Beta(alpha, beta) on (0, 1).
+
+    Reference: python/paddle/distribution/beta.py (dirichlet-backed there
+    too).  Sampling composes two reparameterized gammas: X = Ga/(Ga+Gb)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _to_array(alpha)
+        self.beta = _to_array(beta)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta)
+                      * jnp.ones(self._batch))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1.0))
+                      * jnp.ones(self._batch))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self._batch
+        ga = jax.random.gamma(rng.next_key(),
+                              jnp.broadcast_to(self.alpha, shape))
+        gb = jax.random.gamma(rng.next_key(),
+                              jnp.broadcast_to(self.beta, shape))
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        def f(v, a, b):
+            return ((a - 1.0) * jnp.log(v) + (b - 1.0) * jnp.log1p(-v)
+                    - jax.scipy.special.betaln(a, b))
+        return apply(f, value, Tensor(self.alpha), Tensor(self.beta))
+
+    def entropy(self):
+        a, b = jnp.broadcast_arrays(self.alpha, self.beta)
+        dg = jax.scipy.special.digamma
+        return Tensor(jax.scipy.special.betaln(a, b)
+                      - (a - 1.0) * dg(a) - (b - 1.0) * dg(b)
+                      + (a + b - 2.0) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    """Dirichlet(concentration) over the simplex (last axis).
+
+    Reference: python/paddle/distribution/dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _to_array(concentration)
+
+    @property
+    def mean(self):
+        c = self.concentration
+        return Tensor(c / jnp.sum(c, axis=-1, keepdims=True))
+
+    @property
+    def variance(self):
+        c = self.concentration
+        c0 = jnp.sum(c, axis=-1, keepdims=True)
+        m = c / c0
+        return Tensor(m * (1.0 - m) / (c0 + 1.0))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self.concentration.shape[:-1]
+        out = jax.random.dirichlet(rng.next_key(), self.concentration, shape)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        def f(v, c):
+            norm = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
+                    - jax.scipy.special.gammaln(jnp.sum(c, axis=-1)))
+            return jnp.sum((c - 1.0) * jnp.log(v), axis=-1) - norm
+        return apply(f, value, Tensor(self.concentration))
+
+    def entropy(self):
+        c = self.concentration
+        c0 = jnp.sum(c, axis=-1)
+        k = c.shape[-1]
+        dg = jax.scipy.special.digamma
+        lnB = (jnp.sum(jax.scipy.special.gammaln(c), axis=-1)
+               - jax.scipy.special.gammaln(c0))
+        return Tensor(lnB + (c0 - k) * dg(c0)
+                      - jnp.sum((c - 1.0) * dg(c), axis=-1))
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) — counts over the last axis.
+
+    Reference: python/paddle/distribution/multinomial.py.  ``total_count``
+    is static (a trace-time int), matching the reference's int argument."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _to_array(probs)
+        self.probs_ = p / jnp.sum(p, axis=-1, keepdims=True)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1.0 - self.probs_))
+
+    def sample(self, shape=()):
+        shape = _shape_tuple(shape) + self.probs_.shape[:-1]
+        n = jnp.full(shape, self.total_count, jnp.float32)
+        out = jax.random.multinomial(
+            rng.next_key(), n, jnp.broadcast_to(
+                self.probs_, shape + self.probs_.shape[-1:]))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def f(v, p):
+            gl = jax.scipy.special.gammaln
+            coeff = gl(jnp.sum(v, axis=-1) + 1.0) - jnp.sum(gl(v + 1.0),
+                                                            axis=-1)
+            return coeff + jnp.sum(
+                jnp.where(v == 0, 0.0, v * jnp.log(p)), axis=-1)
+        return apply(f, value, Tensor(self.probs_))
+
+
+# --------------------------------------------------------------------------
+# Transforms + TransformedDistribution
+# (reference: python/paddle/distribution/transform.py — AffineTransform,
+#  ExpTransform, SigmoidTransform, TanhTransform, PowerTransform,
+#  ChainTransform — and transformed_distribution.py)
+# --------------------------------------------------------------------------
+
+class Transform:
+    """Bijection y = forward(x) with log|det J| tracked elementwise."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _to_array(loc)
+        self.scale = _to_array(scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    """y = exp(x)."""
+
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _to_array(power)
+
+    def forward(self, x):
+        return x ** self.power
+
+    def inverse(self, y):
+        return y ** (1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * x ** (self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    """y = sigmoid(x) ∈ (0, 1)."""
+
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        # log σ'(x) = -softplus(-x) - softplus(x)
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    """y = tanh(x) ∈ (-1, 1)."""
+
+    def forward(self, x):
+        return jnp.tanh(x)
+
+    def inverse(self, y):
+        return jnp.arctanh(y)
+
+    def forward_log_det_jacobian(self, x):
+        # log(1 - tanh²x) = 2(log2 - x - softplus(-2x)) — stable form
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    """Composition t_n ∘ … ∘ t_1 (first transform applied first)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t.forward_log_det_jacobian(x)
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a (chain of) transform(s).
+
+    Reference: python/paddle/distribution/transformed_distribution.py —
+    log_prob(y) = base.log_prob(t⁻¹(y)) + log|det J_{t⁻¹}|(y)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(list(transforms))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return Tensor(self.transform.forward(_to_array(x)))
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return Tensor(self.transform.forward(_to_array(x)))
+
+    def log_prob(self, value):
+        y = _to_array(value)
+        x = self.transform.inverse(y)
+        base_lp = _to_array(self.base.log_prob(Tensor(x)))
+        # equivalent to + inverse_log_det_jacobian(y), but reuses the x we
+        # already inverted instead of inverting the whole chain again
+        return Tensor(base_lp - self.transform.forward_log_det_jacobian(x))
+
+
+class LogNormal(TransformedDistribution):
+    """exp(N(loc, scale²)) — the canonical TransformedDistribution.
+
+    Reference: python/paddle/distribution/lognormal.py (Normal + ExpTransform
+    there as well)."""
+
+    def __init__(self, loc, scale, name=None):
+        super().__init__(Normal(loc, scale), ExpTransform())
+        self.loc = _to_array(loc)
+        self.scale = _to_array(scale)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + 0.5 * self.scale ** 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1.0) * jnp.exp(2.0 * self.loc + s2))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2.0 * math.pi)
+                      + jnp.log(self.scale) + self.loc)
+
+
+class Laplace(Distribution):
+    """Laplace(loc, scale).  Reference: python/paddle/distribution/laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _to_array(loc)
+        self.scale = _to_array(scale)
+
+    @property
+    def _batch(self):
+        return jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc * jnp.ones(self._batch))
+
+    @property
+    def variance(self):
+        return Tensor(2.0 * self.scale ** 2 * jnp.ones(self._batch))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shape = _shape_tuple(shape) + self._batch
+        u = jax.random.uniform(rng.next_key(), shape, jnp.float32,
+                               minval=-0.5, maxval=0.5)
+        # minval is inclusive: u = -0.5 would give log1p(-1) = -inf; pull
+        # the endpoint in by one ulp-scale step (same guard torch uses)
+        u = jnp.clip(u, -0.5 + 1e-7, 0.5 - 1e-7)
+        return Tensor(self.loc
+                      - self.scale * jnp.sign(u) * jnp.log1p(-2.0 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        def f(v, mu, b):
+            return -jnp.abs(v - mu) / b - jnp.log(2.0 * b)
+        return apply(f, value, Tensor(self.loc), Tensor(self.scale))
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2.0 * self.scale * jnp.ones(self._batch)))
+
+
+class Independent(Distribution):
+    """Reinterpret the last ``reinterpreted_batch_rank`` batch dims as event
+    dims: log_prob sums over them.  Reference: python/paddle/distribution/
+    independent.py."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _to_array(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        ent = _to_array(self.base.entropy())
+        return Tensor(jnp.sum(ent, axis=tuple(range(-self.rank, 0))))
+
+
 # --------------------------------------------------------------------------
 # KL divergence registry (reference pattern: paddle.distribution.kl.register_kl)
 # --------------------------------------------------------------------------
@@ -258,3 +681,52 @@ def _kl_bernoulli_bernoulli(p, q):
     qq = jnp.clip(q.probs_, 1e-7, 1.0 - 1e-7)
     return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
                   + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    dg = jax.scipy.special.digamma
+    a1, b1 = jnp.broadcast_arrays(p.alpha, p.beta)
+    a2, b2 = q.alpha, q.beta
+    s1 = a1 + b1
+    return Tensor(jax.scipy.special.betaln(a2, b2)
+                  - jax.scipy.special.betaln(a1, b1)
+                  + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                  + (a2 - a1 + b2 - b1) * dg(s1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    c1, c2 = p.concentration, q.concentration
+    s1 = jnp.sum(c1, axis=-1)
+    return Tensor(gl(s1) - jnp.sum(gl(c1), axis=-1)
+                  - gl(jnp.sum(c2, axis=-1)) + jnp.sum(gl(c2), axis=-1)
+                  + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]),
+                            axis=-1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    c1, r1 = jnp.broadcast_arrays(p.concentration, p.rate)
+    c2, r2 = q.concentration, q.rate
+    return Tensor((c1 - c2) * dg(c1) - gl(c1) + gl(c2)
+                  + c2 * (jnp.log(r1) - jnp.log(r2))
+                  + c1 * (r2 - r1) / r1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    b1, b2 = p.scale, q.scale
+    ad = jnp.abs(p.loc - q.loc)
+    return Tensor(jnp.log(b2 / b1)
+                  + (b1 * jnp.exp(-ad / b1) + ad) / b2 - 1.0)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    # KL is invariant under the shared exp bijection: reduce to the bases
+    return _kl_normal_normal(Normal(p.loc, p.scale), Normal(q.loc, q.scale))
