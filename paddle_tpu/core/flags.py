@@ -75,6 +75,9 @@ define_flag("FLAGS_default_dtype", "float32", "Default floating point dtype.")
 define_flag("FLAGS_seed", 0, "Global random seed.")
 define_flag("FLAGS_eager_log_ops", False, "Log every eagerly dispatched op (debug tracing).")
 define_flag("FLAGS_benchmark", False, "Block on every eager op result (perf debugging).")
+define_flag("FLAGS_eager_nudge_after", 20000,
+            "Warn once after this many consecutive grad-recording eager "
+            "dispatches with no jit step (0 disables).")
 define_flag("FLAGS_use_fused_ln", False,
             "Route LN+residual+dropout through the Pallas kernel (ops/fused.py); "
             "off by default — flip only where tools/fused_probe.py shows XLA "

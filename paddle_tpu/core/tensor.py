@@ -338,6 +338,37 @@ class Parameter(Tensor):
         return "Parameter containing:\n" + super().__repr__()
 
 
+_EAGER_STREAK = [0]  # grad-recording eager dispatches since the last jit
+
+
+def _nudge_eager_loop(traced: bool, record: bool):
+    """One-time perf nudge for training loops ground out op-by-op (the
+    reference nudges dygraph users toward static the same way): each eager
+    dispatch is a separate host->device round-trip, while the supported
+    training path compiles the whole step.  Counting only grad-recording
+    dispatches keeps inference/debug scripting quiet; any traced dispatch
+    (user is inside jit) resets the streak."""
+    limit = flags.flag("FLAGS_eager_nudge_after")
+    if limit <= 0 or _EAGER_STREAK[0] < 0:  # disabled / already warned
+        return
+    if traced:
+        _EAGER_STREAK[0] = 0
+        return
+    if not record:
+        return
+    _EAGER_STREAK[0] += 1
+    if _EAGER_STREAK[0] >= limit:
+        import warnings
+        warnings.warn(
+            f"{limit} consecutive eagerly-dispatched ops recorded gradients "
+            "without any jit-compiled step. Eager mode is the debugging "
+            "surface; for training speed wrap the step in paddle.jit."
+            "make_train_step / @paddle.jit.to_static or use hapi Model.fit "
+            "(set FLAGS_eager_nudge_after=0 to silence).",
+            UserWarning, stacklevel=3)
+        _EAGER_STREAK[0] = -1  # warn once per process
+
+
 def apply(fn: Callable, *args, name: Optional[str] = None, **kwargs) -> Any:
     """Dispatch one eager op (the ``TraceOp`` analog).
 
@@ -388,6 +419,7 @@ def apply(fn: Callable, *args, name: Optional[str] = None, **kwargs) -> Any:
 
     if flags.flag("FLAGS_eager_log_ops"):
         print(f"[eager] {name or getattr(fn, '__name__', fn)}")
+    _nudge_eager_loop(traced, record)
     if flags.flag("FLAGS_benchmark") and not traced:
         jax.block_until_ready(out_raw)
 

@@ -86,12 +86,14 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
     def f(k, probs):
         logits = jnp.log(jnp.maximum(probs, 1e-30))
         if replacement:
-            return jax.random.categorical(k, logits, axis=-1,
-                                          shape=(*probs.shape[:-1], num_samples)).astype(jnp.int64)
+            return jax.random.categorical(
+                k, logits, axis=-1,
+                shape=(*probs.shape[:-1], num_samples)
+            ).astype(convert_dtype("int64"))
         # without replacement: Gumbel top-k trick
         g = jax.random.gumbel(k, probs.shape, logits.dtype)
         _, idx = jax.lax.top_k(logits + g, num_samples)
-        return idx.astype(jnp.int64)
+        return idx.astype(convert_dtype("int64"))
     return apply(f, Tensor(key), x)
 
 
@@ -114,8 +116,8 @@ def poisson(x, name=None):
 
 def binomial(count, prob, name=None):
     key = rng.next_key()
-    return apply(lambda k, n, p: jax.random.binomial(k, n, p).astype(jnp.int64),
-                 Tensor(key), count, prob)
+    return apply(lambda k, n, p: jax.random.binomial(k, n, p).astype(
+        convert_dtype("int64")), Tensor(key), count, prob)
 
 
 def exponential_(x, lam=1.0, name=None):
