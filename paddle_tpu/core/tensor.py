@@ -341,13 +341,23 @@ class Parameter(Tensor):
 _EAGER_STREAK = [0]  # grad-recording eager dispatches since the last jit
 
 
+def note_compiled_call():
+    """Reset the eager-nudge streak: called by every compiled-step wrapper
+    (jit/functional steps, StaticFunction) on EVERY invocation — cache hits
+    included, which dispatch zero eager ops and would otherwise never reset
+    the counter, nudging users who already follow the advice."""
+    if _EAGER_STREAK[0] > 0:
+        _EAGER_STREAK[0] = 0
+
+
 def _nudge_eager_loop(traced: bool, record: bool):
     """One-time perf nudge for training loops ground out op-by-op (the
     reference nudges dygraph users toward static the same way): each eager
     dispatch is a separate host->device round-trip, while the supported
     training path compiles the whole step.  Counting only grad-recording
-    dispatches keeps inference/debug scripting quiet; any traced dispatch
-    (user is inside jit) resets the streak."""
+    dispatches keeps inference/debug scripting quiet; the streak resets on
+    any traced dispatch (tracing time) and on every compiled-step call
+    (note_compiled_call)."""
     limit = flags.flag("FLAGS_eager_nudge_after")
     if limit <= 0 or _EAGER_STREAK[0] < 0:  # disabled / already warned
         return
@@ -358,14 +368,25 @@ def _nudge_eager_loop(traced: bool, record: bool):
         return
     _EAGER_STREAK[0] += 1
     if _EAGER_STREAK[0] >= limit:
+        import os
+        import sys
         import warnings
+        # point the warning at the user's loop, not a paddle_tpu wrapper:
+        # walk out of the package so file:line (and the once-per-location
+        # filter key) land in user code
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        level, f = 2, sys._getframe(1)
+        while f.f_back is not None and \
+                f.f_code.co_filename.startswith(pkg + os.sep):
+            f = f.f_back
+            level += 1
         warnings.warn(
             f"{limit} consecutive eagerly-dispatched ops recorded gradients "
             "without any jit-compiled step. Eager mode is the debugging "
             "surface; for training speed wrap the step in paddle.jit."
             "make_train_step / @paddle.jit.to_static or use hapi Model.fit "
             "(set FLAGS_eager_nudge_after=0 to silence).",
-            UserWarning, stacklevel=3)
+            UserWarning, stacklevel=level)
         _EAGER_STREAK[0] = -1  # warn once per process
 
 

@@ -74,6 +74,8 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if not _to_static_state["enabled"]:
             return self._orig(*args, **kwargs)  # ProgramTranslator.enable(False)
+        from ..core.tensor import note_compiled_call
+        note_compiled_call()  # compiled calls (cache hits too) reset the nudge
         return self._call(*args, **kwargs)
 
     @property

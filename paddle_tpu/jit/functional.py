@@ -19,7 +19,17 @@ import jax
 import jax.numpy as jnp
 
 from ..core import rng
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, note_compiled_call
+
+
+def _tracks_compiled_calls(fn):
+    """Every invocation (cache hits included) resets the eager-nudge streak
+    — see core.tensor.note_compiled_call."""
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        note_compiled_call()
+        return fn(*args, **kwargs)
+    return wrapped
 
 
 def _wrap(x):
@@ -162,7 +172,7 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
         return {"params": new_params, "opt": new_opt, "buffers": new_b,
                 **scaler_state}, (loss, out)
 
-    return step, state0
+    return _tracks_compiled_calls(step), state0
 
 
 def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
@@ -200,7 +210,7 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
                      "acc": acc_out, "acc_count": cnt_out}
         return new_state, (loss, out)
 
-    return step, state0
+    return _tracks_compiled_calls(step), state0
 
 
 def make_eval_step(layer, loss_fn=None):
@@ -215,7 +225,7 @@ def make_eval_step(layer, loss_fn=None):
         loss_t = loss_fn(_wrap(main_out), *wrap_tree(labels))
         return main_out, _unwrap(loss_t)
 
-    return step
+    return _tracks_compiled_calls(step)
 
 
 def sync_state_to_layer(layer, state) -> None:

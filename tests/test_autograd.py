@@ -130,8 +130,23 @@ def test_eager_loop_perf_nudge_warns_once():
         tmod._EAGER_STREAK[0] = 0
         for _ in range(5):
             x * 2
-        jax.jit(lambda a: (paddle.to_tensor(a, stop_gradient=False)
-                           * 2)._data)(jnp.ones(1))
+        jitted = jax.jit(lambda a: (paddle.to_tensor(a, stop_gradient=False)
+                                    * 2)._data)
+        jitted(jnp.ones(1))
+        assert tmod._EAGER_STREAK[0] == 0
+
+        # compiled-step CACHE HITS reset it too (no eager dispatch happens
+        # on a cache hit, so the reset must come from the step wrapper)
+        from paddle_tpu.jit.functional import make_eval_step
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(2, 2)
+        estep = make_eval_step(lin)
+        p, b = lin.raw_state()
+        estep(p, b, (jnp.ones((1, 2)),))       # compile
+        for _ in range(5):
+            x * 2
+        assert tmod._EAGER_STREAK[0] == 5
+        estep(p, b, (jnp.ones((1, 2)),))       # cache hit
         assert tmod._EAGER_STREAK[0] == 0
 
         # 0 disables
