@@ -139,15 +139,22 @@ needs8 = pytest.mark.skipif(len(local_devices()) < 8,
 
 
 @needs8
-def test_ring_memory_stays_per_shard_linear():
-    """Long-context CPU-side proof (VERDICT r3 #8): under sep=8 ring
-    attention, the grad jaxpr — INCLUDING the shard_map body and cond
-    branches — holds nothing bigger than a few per-device panels/shards.
-    Plain JAX AD of the fwd scan stacks (sp-1) received k/v shards
-    ((sp-1)*Lc*H*D per device = the full global K/V), which this bound
-    rejects; dims are chosen so that blow-up exceeds the limit while the
-    legitimate (B,H,Lc,Lc) score panel and (Lc,H,D) shards fit."""
-    L, H, D, sep = 2048, 4, 256, 8
+@pytest.mark.parametrize("L", [2048, 32768])
+def test_ring_memory_stays_per_shard_linear(L):
+    """Long-context CPU-side proof (VERDICT r3 #8; the 32k case is r3's
+    'add the L=32k memory assertion'): under sep=8 ring attention, the grad
+    jaxpr — INCLUDING the shard_map body and cond branches — holds nothing
+    bigger than the per-device (Lc,Lc) score panel / (Lc,H,D) shards, and —
+    the stacking check — NO buffer anywhere carries a leading (sp-1)/sp
+    stack of k/v shards.  Plain JAX AD of the fwd scan produces exactly
+    that ((sp-1, B, Lc, H, D) stacked ppermute payloads = the full global
+    K/V resident on every device); the hand-written ring backward re-rotates
+    blocks instead.  At L=2048 the size bound alone rejects stacking; at
+    L=32768 the transient score panel legitimately dominates (Lc > 7*D), so
+    the shape-aware stacking check is what carries the assertion.
+    Trace-only (make_jaxpr): nothing executes, so 32k costs tracing time,
+    not memory."""
+    H, D, sep = 4, 256, 8
     Lc = L // sep
     mesh = Mesh(np.array(jax.devices()[:sep]), ("sep",))
     q = jax.ShapeDtypeStruct((1, L, H, D), jnp.float32)
@@ -163,8 +170,18 @@ def test_ring_memory_stays_per_shard_linear():
     outer_limit = 2 * L * H * D          # global shards/grads
     panel = Lc * Lc * H                  # per-device score panel (B=1)
     shard = Lc * H * D
-    inner_limit = 4 * max(panel, shard)  # << (sep-1)*shard = 7*shard
-    assert (sep - 1) * shard > inner_limit  # the guarded blow-up must trip
+    inner_limit = 4 * max(panel, shard)
+    # the stacking signature has the k/v-shard element count with an extra
+    # leading (sp-1) or sp axis — reject it by SHAPE so it is caught even
+    # when the score panel legitimately exceeds (sp-1)*shard in size
+    stacked_sizes = {(sep - 1) * shard, sep * shard}  # B=1
+
+    def is_kv_stack(shape):
+        return (len(shape) >= 5 and shape[0] in (sep - 1, sep)
+                and int(np.prod(shape)) in stacked_sizes)
+
+    if L == 2048:  # shard dominates: size bound alone must catch stacking
+        assert (sep - 1) * shard > inner_limit
 
     visited = {"inner": 0}
 
@@ -180,15 +197,20 @@ def test_ring_memory_stays_per_shard_linear():
         for eqn in jx.eqns:
             is_manual = inner or eqn.primitive.name == "shard_map"
             for var in eqn.outvars:
-                sz = int(np.prod(var.aval.shape)) if var.aval.shape else 1
+                shape = var.aval.shape
+                sz = int(np.prod(shape)) if shape else 1
                 if inner:
                     visited["inner"] += 1
                     assert sz <= inner_limit, (
-                        f"per-device buffer {var.aval.shape} "
+                        f"per-device buffer {shape} "
                         f"({eqn.primitive}) exceeds O(L/sp) bound")
+                    assert not is_kv_stack(shape), (
+                        f"stacked k/v shards {shape} ({eqn.primitive}) — "
+                        f"the naive-AD blow-up the ring backward exists "
+                        f"to avoid")
                 else:
                     assert sz <= outer_limit, (
-                        f"global buffer {var.aval.shape} ({eqn.primitive})")
+                        f"global buffer {shape} ({eqn.primitive})")
             for sub in sub_jaxprs(eqn):
                 walk(sub, is_manual)
 
@@ -196,6 +218,40 @@ def test_ring_memory_stays_per_shard_linear():
     # the walker must actually have seen the ring internals — a vacuous
     # walk (e.g. shard_map body not entered) would pass every assert
     assert visited["inner"] > 20, visited
+
+    # negative control: plain JAX AD through the fwd scan (custom_vjp
+    # bypassed) DOES stack the received k/v blocks, and the same walker
+    # must catch it — otherwise the checks above prove nothing
+    from paddle_tpu.ops import ring_attention as R
+
+    def naive_loss(q, k, v):
+        f = shard_map(
+            lambda a, b, c: R._ring_fwd_pass(
+                a, b, c, "sep", True, 1.0 / np.sqrt(D))[0],
+            mesh=mesh, in_specs=(P(None, "sep"),) * 3,
+            out_specs=P(None, None, "sep", None))  # fwd emits (B,H,Lc,D)
+        return jnp.sum(f(q, k, v))
+
+    njaxpr = jax.make_jaxpr(jax.grad(naive_loss, argnums=(0, 1, 2)))(q, q, q)
+    # outer walk: the hoisted scan residuals already violate the global
+    # bound (shape (sep*(sep-1), B, Lc, H, D) on the shard_map eqn)
+    with pytest.raises(AssertionError, match="global buffer"):
+        walk(njaxpr.jaxpr, False)
+    # and the INNER stacking detector must fire on the shard_map body
+    # itself — this is the only guard at 32k, where (sep-1)*shard fits
+    # under the panel-dominated size limit, so it must be shown live
+    bodies = [sub for eqn in njaxpr.jaxpr.eqns
+              if eqn.primitive.name == "shard_map"
+              for sub in sub_jaxprs(eqn)]
+    assert bodies
+    fired = 0
+    for body in bodies:
+        try:
+            walk(body, True)
+        except AssertionError as e:
+            assert "stacked k/v" in str(e) or "O(L/sp)" in str(e), e
+            fired += 1
+    assert fired, "no shard_map body tripped the stacking detector"
 
 
 @needs8
