@@ -371,10 +371,14 @@ class _MultiprocessIterator:
         import weakref
 
         self.loader = loader
-        # fork by default (workers inherit loaded modules — instant start and
-        # no pickling requirement; they only run numpy, never JAX).  Set
-        # PADDLE_TPU_WORKER_START=forkserver to trade startup time for
-        # immunity to fork-while-JAX-threads-hold-locks hazards.
+        # fork by default (workers inherit loaded modules — instant start, no
+        # pickling requirement, torch-DataLoader-compatible UX for locally
+        # defined datasets; workers only run numpy, never JAX).  Python 3.12
+        # warns that forking a JAX-multithreaded parent can deadlock; the
+        # alternative default (forkserver) breaks every locally-defined
+        # dataset/collate_fn on pickling, which is the worse trade.  Set
+        # PADDLE_TPU_WORKER_START=forkserver for fork-immunity when your
+        # dataset is picklable (the suite's fallback test runs that path).
         method = os.environ.get("PADDLE_TPU_WORKER_START", "fork")
         ctx = mp.get_context(method)
         n = loader.num_workers

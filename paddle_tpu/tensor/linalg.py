@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..core.dtype import convert_dtype
 from ..core.tensor import apply
 
 
@@ -145,7 +146,7 @@ def histogram(input, bins=100, min=0, max=0, name=None):
     def f(a):
         lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
         h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi))
-        return h.astype(jnp.int64)
+        return h.astype(convert_dtype("int64"))  # int32 under no-x64, silent
     return apply(f, input)
 
 

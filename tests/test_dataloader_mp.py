@@ -87,6 +87,16 @@ class TestMultiprocessLoader:
                                       use_shared_memory=False)]
         np.testing.assert_array_equal(np.concatenate(par), np.arange(32))
 
+    def test_forkserver_start_method(self, monkeypatch):
+        """PADDLE_TPU_WORKER_START=forkserver: the fork-immune path (for
+        picklable datasets) produces the same ordered stream — keeps the
+        documented escape hatch from the fork-by-default tradeoff working."""
+        monkeypatch.setenv("PADDLE_TPU_WORKER_START", "forkserver")
+        ds = ArrayDataset(32)
+        par = [np.asarray(y._data)
+               for _, y in DataLoader(ds, batch_size=8, num_workers=2)]
+        np.testing.assert_array_equal(np.concatenate(par), np.arange(32))
+
     def test_worker_error_propagates(self):
         loader = DataLoader(FailingDataset(32), batch_size=8, num_workers=2)
         with pytest.raises(RuntimeError, match="boom at 13"):

@@ -1191,8 +1191,12 @@ def test_forward_fp32(case):
     for g, w in zip(got, want):
         assert g.shape == np.asarray(w).shape, \
             f"{case.id}: shape {g.shape} vs {np.asarray(w).shape}"
+        # complex outputs (as_complex etc.) compare in complex128 — a
+        # float64 cast would drop the imaginary part (and warn)
+        cmp = ("complex128" if np.iscomplexobj(np.asarray(g))
+               or np.iscomplexobj(np.asarray(w)) else "float64")
         np.testing.assert_allclose(
-            np.asarray(g, "float64"), np.asarray(w, "float64"),
+            np.asarray(g, cmp), np.asarray(w, cmp),
             rtol=rtol, atol=atol, err_msg=case.id)
 
 
