@@ -27,6 +27,18 @@ import jax
 import jax.numpy as jnp
 
 
+def select_bucket(length: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket admitting ``length`` — the single bucket policy
+    shared by bucketize() and the serving engine, so the selection rule
+    (and its error contract) cannot drift between them."""
+    bucket = next((b for b in buckets if b >= length), None)
+    if bucket is None:
+        raise ValueError(
+            f"length {length} exceeds the largest bucket {max(buckets)}; "
+            f"add a bucket or truncate the input")
+    return bucket
+
+
 def pad_to_bucket(x, bucket: int, axis: int, pad_value=0):
     """Pad ``x`` along ``axis`` up to ``bucket`` with ``pad_value``."""
     cur = x.shape[axis]
@@ -56,11 +68,7 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
         if not arrs:
             raise ValueError(f"no array argument with ndim > {axis}")
         L = arrs[0].shape[axis]
-        bucket = next((b for b in bkts if b >= L), None)
-        if bucket is None:
-            raise ValueError(
-                f"length {L} exceeds the largest bucket {bkts[-1]}; add a "
-                f"bucket or truncate the input")
+        bucket = select_bucket(L, bkts)
         padded = tuple(
             pad_to_bucket(a, bucket, axis, pad_value)
             if hasattr(a, "shape") and a.ndim > axis and a.shape[axis] == L

@@ -1,0 +1,255 @@
+"""Continuous-batching serving engine for the causal decoder stack.
+
+No reference counterpart at this granularity — the reference serves
+generation through fused_multi_transformer's CacheKV with static batches
+(generation_utils batches are admitted and retired together).  This engine
+is the TPU-native upgrade: requests join and leave a running decode batch at
+any step (the JetStream/Orca "continuous batching" discipline), while every
+device program stays STATIC-shape so XLA compiles each signature exactly
+once:
+
+- one global KV cache of ``max_slots`` rows (a slot = one in-flight request,
+  layout (num_layers, S, max_len, nh, hd) — slot is the batch index);
+- admission runs a per-bucket prefill program that writes ONE slot's cache
+  region (prompts are left-padded to the bucket length; the mixin's
+  ``pad_lens`` machinery masks pad keys and shifts positions);
+- every decode tick is ONE compiled step over all S slots with per-row cache
+  clocks (``write_cache``/``cached_attention`` per-row ``t`` — the same
+  scatter form batched speculative decoding uses); inactive slots are
+  carried inert: their clock is frozen and their stale writes land at
+  positions a future occupant overwrites before it can ever read them
+  (decode at position u writes u before attending ≤ u).
+
+Typical use::
+
+    eng = ContinuousBatchingEngine(model, params, max_slots=8, max_len=256)
+    rid = eng.add_request([12, 71, 9], max_new_tokens=32)
+    while eng.pending():          # interleaves admission + batched decode
+        eng.step()
+    out = eng.pop_finished()[rid]
+
+Greedy by default; temperature/top-k/top-p sampling share the engine key.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import partial
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .jit.bucketing import select_bucket
+from .models._decode import make_token_sampler, validate_sampler_args
+
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+class Request:
+    """One in-flight generation request (host-side bookkeeping)."""
+
+    def __init__(self, rid: int, prompt: List[int], max_new_tokens: int):
+        self.id = rid
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.generated: List[int] = []
+        self.done = False
+
+    def __repr__(self):
+        return (f"Request(id={self.id}, prompt_len={len(self.prompt)}, "
+                f"generated={len(self.generated)}, done={self.done})")
+
+
+class ContinuousBatchingEngine:
+    """Slot-scheduled continuous batching over a CausalDecoderMixin model.
+
+    ``max_slots`` bounds concurrent requests; ``max_len`` bounds
+    prompt+generation length per request (one request's logical positions
+    must also fit max_position_embeddings).  ``prompt_buckets`` quantizes
+    admission prefills so the number of compiled prefill programs is
+    len(buckets), not len(distinct prompt lengths).
+    """
+
+    def __init__(self, model, params, max_slots: int, max_len: int,
+                 prompt_buckets=None, temperature: float = 1.0,
+                 top_k: Optional[int] = None, top_p: Optional[float] = None,
+                 greedy: bool = True, eos_token_id: Optional[int] = None,
+                 key=None):
+        c = model.config
+        if max_len > c.max_position_embeddings:
+            raise ValueError(f"max_len {max_len} exceeds "
+                             f"max_position_embeddings "
+                             f"({c.max_position_embeddings})")
+        self._key = key if key is not None else jax.random.key(0)
+        validate_sampler_args(c.vocab_size, top_k, top_p, greedy,
+                              None if greedy else self._key)
+        self.model = model
+        self.params = params
+        self.S = int(max_slots)
+        self.max_len = int(max_len)
+        if prompt_buckets is None:
+            prompt_buckets = [b for b in (16, 32, 64, 128, 256, 512, 1024)
+                              if b <= max_len] or [max_len]
+        self.buckets = sorted(set(int(b) for b in prompt_buckets))
+        self.eos_token_id = eos_token_id
+        self._sample = make_token_sampler(
+            float(temperature), None if top_k is None else int(top_k),
+            None if top_p is None else float(top_p), greedy)
+
+        self.caches = model.init_cache(self.S, self.max_len)
+        # per-slot host state
+        self._slot_req: List[Optional[Request]] = [None] * self.S
+        self._t = np.zeros(self.S, np.int32)         # next physical slot
+        self._pad = np.zeros(self.S, np.int32)       # left-pad length
+        self._tok = np.zeros(self.S, np.int32)       # last sampled token
+        self._active = np.zeros(self.S, bool)
+
+        self._queue: List[Request] = []
+        self._finished: Dict[int, List[int]] = {}
+        self._ids = itertools.count()
+        self._prefill_progs = {}
+        self._decode_prog = None
+
+    # ---------------------------------------------------------- programs --
+
+    def _prefill_prog(self, P: int):
+        """Prefill ONE request (left-padded to bucket length P) directly
+        into slot ``slot`` of the global cache; returns the first token."""
+        if P in self._prefill_progs:
+            return self._prefill_progs[P]
+        model = self.model
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params, big_ck, big_cv, ids, pad_len, slot, key):
+            h, (ck, cv) = model.prefill(params, ids, P,
+                                        pad_lens=pad_len[None])
+            big_ck = jax.lax.dynamic_update_slice(
+                big_ck, ck.astype(big_ck.dtype), (0, slot, 0, 0, 0))
+            big_cv = jax.lax.dynamic_update_slice(
+                big_cv, cv.astype(big_cv.dtype), (0, slot, 0, 0, 0))
+            tok = self._sample(model.decode_logits(params, h[:, -1:]), key)
+            return big_ck, big_cv, tok[0]
+
+        self._prefill_progs[P] = run
+        return run
+
+    def _decode_prog_all(self):
+        """One decode tick over all S slots (per-row cache clocks)."""
+        if self._decode_prog is not None:
+            return self._decode_prog
+        model = self.model
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params, big_ck, big_cv, toks, ts, pads, active, key):
+            h = model._embed_one(params, toks, ts, pad_lens=pads)
+            h, (big_ck, big_cv) = model.decode_step(
+                params, h, (big_ck, big_cv), ts, pad_lens=pads)
+            ntok = self._sample(model.decode_logits(params, h), key)
+            # inactive slots carry their token unchanged (their stale cache
+            # writes are never read — see module docstring)
+            return big_ck, big_cv, jnp.where(active, ntok, toks)
+
+        self._decode_prog = run
+        return run
+
+    # --------------------------------------------------------- scheduling --
+
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        """Queue a prompt; returns the request id.  Admission happens inside
+        ``step()`` whenever a slot is free."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) <= 0:
+            # generate() returns an empty array here; a scheduler admitting
+            # the request would still emit the prefill token, silently
+            # over-generating — refuse instead
+            raise ValueError("max_new_tokens must be >= 1")
+        # budget against the BUCKETED length: the cache region really used is
+        # bucket + generated (pad slots occupy physical positions)
+        P = select_bucket(len(prompt), self.buckets)
+        if P + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"bucketed prompt ({len(prompt)} -> bucket {P}) + "
+                f"max_new_tokens ({max_new_tokens}) exceeds max_len "
+                f"({self.max_len})")
+        req = Request(next(self._ids), prompt, max_new_tokens)
+        self._queue.append(req)
+        return req.id
+
+    def pending(self) -> bool:
+        return bool(self._queue) or bool(self._active.any())
+
+    def pop_finished(self) -> Dict[int, List[int]]:
+        out, self._finished = self._finished, {}
+        return out
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _admit(self):
+        while self._queue and not self._active.all():
+            slot = int(np.flatnonzero(~self._active)[0])
+            req = self._queue.pop(0)
+            P = select_bucket(len(req.prompt), self.buckets)
+            pad = P - len(req.prompt)
+            ids = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
+            run = self._prefill_prog(P)
+            ck, cv, tok0 = run(self.params, self.caches[0], self.caches[1],
+                               ids, jnp.int32(pad), jnp.int32(slot),
+                               self._next_key())
+            self.caches = (ck, cv)
+            tok0 = int(tok0)
+            self._slot_req[slot] = req
+            self._t[slot] = P
+            self._pad[slot] = pad
+            self._tok[slot] = tok0
+            self._active[slot] = True
+            self._record(slot, tok0)
+
+    def _record(self, slot: int, tok: int):
+        """Append a token to the slot's request; retire on EOS/budget."""
+        req = self._slot_req[slot]
+        req.generated.append(tok)
+        hit_eos = (self.eos_token_id is not None and tok == self.eos_token_id)
+        # _t already points at the slot's NEXT write position (both callers
+        # update it first); another decode tick needs _t < max_len
+        out_of_room = int(self._t[slot]) >= self.max_len
+        if len(req.generated) >= req.max_new_tokens or hit_eos or out_of_room:
+            req.done = True
+            self._finished[req.id] = list(req.generated)
+            self._slot_req[slot] = None
+            self._active[slot] = False
+
+    def step(self):
+        """One scheduler tick: admit waiting requests into free slots, then
+        run one batched decode step for every active slot."""
+        self._admit()
+        if not self._active.any():
+            return
+        run = self._decode_prog_all()
+        ck, cv, ntok = run(self.params, self.caches[0], self.caches[1],
+                           jnp.asarray(self._tok), jnp.asarray(self._t),
+                           jnp.asarray(self._pad),
+                           jnp.asarray(self._active), self._next_key())
+        self.caches = (ck, cv)
+        ntok_h = np.asarray(ntok)
+        for slot in np.flatnonzero(self._active):
+            self._t[slot] += 1
+            self._tok[slot] = ntok_h[slot]
+            self._record(int(slot), int(ntok_h[slot]))
+
+    def run_to_completion(self, max_ticks: Optional[int] = None
+                          ) -> Dict[int, List[int]]:
+        """Drive step() until every queued request finishes; returns
+        {request_id: generated tokens}."""
+        ticks = 0
+        while self.pending():
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(f"not done after {max_ticks} ticks")
+        return self.pop_finished()
