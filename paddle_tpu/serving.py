@@ -76,7 +76,14 @@ class ContinuousBatchingEngine:
                  prompt_buckets=None, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  greedy: bool = True, eos_token_id: Optional[int] = None,
-                 key=None):
+                 key=None, ticks_per_sync: int = 1):
+        """``ticks_per_sync``: decode ticks fused into one device program
+        between host synchronizations.  1 = retire/admit after every token
+        (lowest latency); k > 1 amortizes the host round-trip over k tokens
+        — tokens a request emits past its EOS/budget inside a chunk are
+        discarded host-side (wasted compute < k per request), and a slot
+        retires when it lacks room for a FULL chunk, stranding at most k-1
+        cache positions.  Greedy outputs are identical for any k."""
         c = model.config
         if max_len > c.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
@@ -94,9 +101,13 @@ class ContinuousBatchingEngine:
                               if b <= max_len] or [max_len]
         self.buckets = sorted(set(int(b) for b in prompt_buckets))
         self.eos_token_id = eos_token_id
-        self._sample = make_token_sampler(
-            float(temperature), None if top_k is None else int(top_k),
-            None if top_p is None else float(top_p), greedy)
+        self.ticks_per_sync = int(ticks_per_sync)
+        if self.ticks_per_sync < 1:
+            raise ValueError("ticks_per_sync must be >= 1")
+        self._sample_sig = (float(temperature),
+                            None if top_k is None else int(top_k),
+                            None if top_p is None else float(top_p), greedy)
+        self._sample = make_token_sampler(*self._sample_sig)
 
         self.caches = model.init_cache(self.S, self.max_len)
         # per-slot host state
@@ -109,17 +120,26 @@ class ContinuousBatchingEngine:
         self._queue: List[Request] = []
         self._finished: Dict[int, List[int]] = {}
         self._ids = itertools.count()
-        self._prefill_progs = {}
-        self._decode_prog = None
 
     # ---------------------------------------------------------- programs --
+
+    @property
+    def _sig(self):
+        """Program-cache signature: engines with identical shapes and
+        sampler config share compiled programs via the MODEL (the
+        _gen_program pattern) — constructing a fresh engine per request
+        wave must not recompile."""
+        return (self.S, self.max_len, self.ticks_per_sync, self._sample_sig)
 
     def _prefill_prog(self, P: int):
         """Prefill ONE request (left-padded to bucket length P) directly
         into slot ``slot`` of the global cache; returns the first token."""
-        if P in self._prefill_progs:
-            return self._prefill_progs[P]
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        cache_key = ("prefill", P, self._sig)
+        if cache_key in progs:
+            return progs[cache_key]
         model = self.model
+        sample = self._sample
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def run(params, big_ck, big_cv, ids, pad_len, slot, key):
@@ -129,29 +149,42 @@ class ContinuousBatchingEngine:
                 big_ck, ck.astype(big_ck.dtype), (0, slot, 0, 0, 0))
             big_cv = jax.lax.dynamic_update_slice(
                 big_cv, cv.astype(big_cv.dtype), (0, slot, 0, 0, 0))
-            tok = self._sample(model.decode_logits(params, h[:, -1:]), key)
+            tok = sample(model.decode_logits(params, h[:, -1:]), key)
             return big_ck, big_cv, tok[0]
 
-        self._prefill_progs[P] = run
+        progs[cache_key] = run
         return run
 
     def _decode_prog_all(self):
-        """One decode tick over all S slots (per-row cache clocks)."""
-        if self._decode_prog is not None:
-            return self._decode_prog
+        """``ticks_per_sync`` decode ticks over all S slots (per-row cache
+        clocks), one host sync: returns the (k, S) token block."""
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        cache_key = ("decode", self._sig)
+        if cache_key in progs:
+            return progs[cache_key]
         model = self.model
+        k_ticks = self.ticks_per_sync
+        sample = self._sample
 
         @partial(jax.jit, donate_argnums=(1, 2))
         def run(params, big_ck, big_cv, toks, ts, pads, active, key):
-            h = model._embed_one(params, toks, ts, pad_lens=pads)
-            h, (big_ck, big_cv) = model.decode_step(
-                params, h, (big_ck, big_cv), ts, pad_lens=pads)
-            ntok = self._sample(model.decode_logits(params, h), key)
-            # inactive slots carry their token unchanged (their stale cache
-            # writes are never read — see module docstring)
-            return big_ck, big_cv, jnp.where(active, ntok, toks)
+            def tick(carry, i):
+                big_ck, big_cv, tok, key = carry
+                h = model._embed_one(params, tok, ts + i, pad_lens=pads)
+                h, (big_ck, big_cv) = model.decode_step(
+                    params, h, (big_ck, big_cv), ts + i, pad_lens=pads)
+                key, sub = jax.random.split(key)
+                ntok = sample(model.decode_logits(params, h), sub)
+                # inactive slots carry their token unchanged (their stale
+                # cache writes are never read — see module docstring)
+                ntok = jnp.where(active, ntok, tok)
+                return (big_ck, big_cv, ntok, key), ntok
 
-        self._decode_prog = run
+            (big_ck, big_cv, _, _), toks_out = jax.lax.scan(
+                tick, (big_ck, big_cv, toks, key), jnp.arange(k_ticks))
+            return big_ck, big_cv, toks_out        # (k, S)
+
+        progs[cache_key] = run
         return run
 
     # --------------------------------------------------------- scheduling --
@@ -167,13 +200,19 @@ class ContinuousBatchingEngine:
             # the request would still emit the prefill token, silently
             # over-generating — refuse instead
             raise ValueError("max_new_tokens must be >= 1")
-        # budget against the BUCKETED length: the cache region really used is
-        # bucket + generated (pad slots occupy physical positions)
+        # budget against the BUCKETED length and CHUNK-ROUNDED decode: the
+        # first token comes from prefill (no decode position), the remaining
+        # budget-1 tokens consume ceil((budget-1)/k)*k cache positions after
+        # the bucket (decode advances k ticks per sync; pad slots occupy
+        # physical positions)
         P = select_bucket(len(prompt), self.buckets)
-        if P + int(max_new_tokens) > self.max_len:
+        k = self.ticks_per_sync
+        rounded = -(-(int(max_new_tokens) - 1) // k) * k
+        if P + rounded > self.max_len:
             raise ValueError(
-                f"bucketed prompt ({len(prompt)} -> bucket {P}) + "
-                f"max_new_tokens ({max_new_tokens}) exceeds max_len "
+                f"bucketed prompt ({len(prompt)} -> bucket {P}) needs "
+                f"{rounded} decode positions for max_new_tokens="
+                f"{max_new_tokens} at ticks_per_sync={k}; exceeds max_len "
                 f"({self.max_len})")
         req = Request(next(self._ids), prompt, max_new_tokens)
         self._queue.append(req)
@@ -215,32 +254,45 @@ class ContinuousBatchingEngine:
         req = self._slot_req[slot]
         req.generated.append(tok)
         hit_eos = (self.eos_token_id is not None and tok == self.eos_token_id)
-        # _t already points at the slot's NEXT write position (both callers
-        # update it first); another decode tick needs _t < max_len
-        out_of_room = int(self._t[slot]) >= self.max_len
-        if len(req.generated) >= req.max_new_tokens or hit_eos or out_of_room:
-            req.done = True
-            self._finished[req.id] = list(req.generated)
-            self._slot_req[slot] = None
-            self._active[slot] = False
+        if len(req.generated) >= req.max_new_tokens or hit_eos:
+            self._retire(slot)
+
+    def _retire(self, slot: int):
+        req = self._slot_req[slot]
+        req.done = True
+        self._finished[req.id] = list(req.generated)
+        self._slot_req[slot] = None
+        self._active[slot] = False
 
     def step(self):
-        """One scheduler tick: admit waiting requests into free slots, then
-        run one batched decode step for every active slot."""
+        """One scheduler round: admit waiting requests into free slots, then
+        run ``ticks_per_sync`` batched decode ticks and retire finished
+        requests from the returned token block."""
         self._admit()
         if not self._active.any():
             return
         run = self._decode_prog_all()
-        ck, cv, ntok = run(self.params, self.caches[0], self.caches[1],
-                           jnp.asarray(self._tok), jnp.asarray(self._t),
-                           jnp.asarray(self._pad),
-                           jnp.asarray(self._active), self._next_key())
+        active_before = self._active.copy()
+        ck, cv, blk = run(self.params, self.caches[0], self.caches[1],
+                          jnp.asarray(self._tok), jnp.asarray(self._t),
+                          jnp.asarray(self._pad),
+                          jnp.asarray(active_before), self._next_key())
         self.caches = (ck, cv)
-        ntok_h = np.asarray(ntok)
-        for slot in np.flatnonzero(self._active):
-            self._t[slot] += 1
-            self._tok[slot] = ntok_h[slot]
-            self._record(int(slot), int(ntok_h[slot]))
+        blk = np.asarray(blk)                      # (k, S)
+        for slot in np.flatnonzero(active_before):
+            for j in range(self.ticks_per_sync):
+                if not self._active[slot]:
+                    break  # retired mid-chunk: discard the chunk's tail
+                self._t[slot] += 1
+                self._tok[slot] = blk[j, slot]
+                self._record(int(slot), int(blk[j, slot]))
+            # room is a CHUNK-boundary concern: a surviving slot must fit a
+            # whole next chunk.  Admission-validated budgets always do; this
+            # is the safety net against inconsistent slot state, truncating
+            # rather than writing past the cache.
+            if self._active[slot] and \
+                    int(self._t[slot]) + self.ticks_per_sync > self.max_len:
+                self._retire(int(slot))
 
     def run_to_completion(self, max_ticks: Optional[int] = None
                           ) -> Dict[int, List[int]]:

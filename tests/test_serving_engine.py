@@ -39,20 +39,24 @@ PROMPTS = [[5, 17, 3], [40, 2], [9, 9, 9, 9, 9, 1], [61], [8, 30, 12, 4],
 
 
 class TestContinuousBatching:
-    def test_interleaved_matches_solo_generate(self, model_and_params):
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_interleaved_matches_solo_generate(self, model_and_params, k):
         """Six requests with different prompt lengths and budgets, admitted
         into 3 slots (so retirement/re-admission happens mid-run): every
-        request's tokens equal its solo model.generate output."""
+        request's tokens equal its solo model.generate output — for both
+        per-token sync (k=1) and chunked decode (k=4, where budgets that
+        are not chunk multiples force mid-chunk retirement + discard)."""
         model, params = model_and_params
         budgets = [10, 4, 7, 12, 3, 8]
         eng = ContinuousBatchingEngine(model, params, max_slots=3,
-                                       max_len=32, prompt_buckets=[8, 16])
+                                       max_len=32, prompt_buckets=[8, 16],
+                                       ticks_per_sync=k)
         rids = [eng.add_request(p, n) for p, n in zip(PROMPTS, budgets)]
         got = eng.run_to_completion(max_ticks=200)
         assert sorted(got) == sorted(rids)
         for rid, p, n in zip(rids, PROMPTS, budgets):
             assert got[rid] == _solo_greedy(model, params, p, n), \
-                f"request {rid} diverged from solo generation"
+                f"request {rid} diverged from solo generation (k={k})"
 
     def test_late_admission_does_not_perturb_running_request(
             self, model_and_params):
@@ -69,12 +73,15 @@ class TestContinuousBatching:
         assert got[r0] == _solo_greedy(model, params, PROMPTS[0], 12)
         assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 6)
 
-    def test_slot_reuse_after_retirement(self, model_and_params):
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_slot_reuse_after_retirement(self, model_and_params, k):
         """A slot freed by a finished request is reused by a later one and
-        the stale cache contents do not leak into its output."""
+        the stale cache contents (including a chunked run's discarded-tail
+        writes) do not leak into its output."""
         model, params = model_and_params
         eng = ContinuousBatchingEngine(model, params, max_slots=1,
-                                       max_len=32, prompt_buckets=[8])
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=k)
         r0 = eng.add_request(PROMPTS[2], 4)
         r1 = eng.add_request(PROMPTS[3], 9)   # waits for the only slot
         got = eng.run_to_completion(max_ticks=100)
@@ -102,16 +109,29 @@ class TestContinuousBatching:
         assert got[r1] == _solo_greedy(model, params, PROMPTS[4], 3)
 
     def test_compiled_program_count_is_bounded(self, model_and_params):
-        """The engine compiles one decode program and one prefill program
-        per bucket — admission order / request count never adds programs."""
+        """One decode program + one prefill program per bucket, cached on
+        the MODEL keyed by engine signature — admission order, request
+        count, and even fresh engine instances never add programs."""
         model, params = model_and_params
-        eng = ContinuousBatchingEngine(model, params, max_slots=2,
-                                       max_len=32, prompt_buckets=[4, 8])
+        model.__dict__.pop("_serving_programs", None)
+
+        def make():
+            return ContinuousBatchingEngine(model, params, max_slots=2,
+                                            max_len=32, prompt_buckets=[4, 8])
+
+        eng = make()
         for p, n in zip(PROMPTS, [3] * len(PROMPTS)):
             eng.add_request(p, n)
         eng.run_to_completion(max_ticks=200)
-        assert set(eng._prefill_progs) <= {4, 8}
-        assert eng._decode_prog is not None
+        progs = model._serving_programs
+        before = set(progs)
+        assert {kind for kind, *_ in before} == {"prefill", "decode"}
+        assert len(before) <= 3                  # <= len(buckets) + 1
+
+        eng2 = make()                            # same signature: no growth
+        eng2.add_request(PROMPTS[0], 3)
+        eng2.run_to_completion(max_ticks=50)
+        assert set(model._serving_programs) == before
 
     def test_budget_validation(self, model_and_params):
         model, params = model_and_params
