@@ -76,14 +76,21 @@ class ContinuousBatchingEngine:
                  prompt_buckets=None, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  greedy: bool = True, eos_token_id: Optional[int] = None,
-                 key=None, ticks_per_sync: int = 1):
+                 key=None, ticks_per_sync: int = 1, mesh=None):
         """``ticks_per_sync``: decode ticks fused into one device program
         between host synchronizations.  1 = retire/admit after every token
         (lowest latency); k > 1 amortizes the host round-trip over k tokens
         — tokens a request emits past its EOS/budget inside a chunk are
         discarded host-side (wasted compute < k per request), and a slot
         retires when it lacks room for a FULL chunk, stranding at most k-1
-        cache positions.  Greedy outputs are identical for any k."""
+        cache positions.  Greedy outputs are identical for any k.
+
+        ``mesh``: optional ``jax.sharding.Mesh`` with a "model" axis for
+        tensor-parallel serving — params are placed by their
+        ``_dims_mapping`` specs (the same metadata the training path uses)
+        and the KV cache shards over the heads dim; GSPMD inserts the TP
+        collectives in the prefill/decode programs exactly as it does for
+        training."""
         c = model.config
         if max_len > c.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
@@ -109,7 +116,35 @@ class ContinuousBatchingEngine:
                             None if top_p is None else float(top_p), greedy)
         self._sample = make_token_sampler(*self._sample_sig)
 
-        self.caches = model.init_cache(self.S, self.max_len)
+        self.mesh = mesh
+        if mesh is None:
+            self.caches = model.init_cache(self.S, self.max_len)
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from .distributed.spmd import build_param_specs
+            specs = build_param_specs(params, mesh, layer=model)
+            self.params = {name: jax.device_put(
+                v, NamedSharding(mesh, specs[name]))
+                for name, v in params.items()}
+            nh = c.num_attention_heads
+            mp = mesh.shape.get("model", 1)
+            if mp > 1 and nh % mp == 0:
+                cache_spec = P(None, None, None, "model", None)
+            else:
+                cache_spec = P()
+                if mp > 1:
+                    import warnings
+                    warnings.warn(
+                        f"num_attention_heads ({nh}) is not divisible by the "
+                        f"model axis ({mp}): the KV cache falls back to full "
+                        f"replication — per-device memory is {mp}x the "
+                        f"sharded size", UserWarning)
+            # allocate the cache SHARDED from the start — a transient
+            # replicated (L, S, max_len, nh, hd) buffer on one device is
+            # exactly the allocation TP serving exists to avoid
+            self.caches = jax.jit(
+                lambda: model.init_cache(self.S, self.max_len),
+                out_shardings=NamedSharding(mesh, cache_spec))()
         # per-slot host state
         self._slot_req: List[Optional[Request]] = [None] * self.S
         self._t = np.zeros(self.S, np.int32)         # next physical slot

@@ -10,6 +10,7 @@ the no-cache forward."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -147,11 +148,30 @@ class TestContinuousBatching:
         with pytest.raises(ValueError, match="exceeds the largest bucket"):
             eng.add_request(list(range(12)), 2)
 
+    @pytest.mark.skipif(
+        len(jax.devices()) < 4, reason="needs 4 devices")
+    def test_tensor_parallel_mesh_matches_single_device(self,
+                                                        model_and_params):
+        """mp=4 serving: params placed by their _dims_mapping (the training
+        path's metadata), cache sharded over heads — every request's tokens
+        must equal the single-device engine's."""
+        from jax.sharding import Mesh
+        model, params = model_and_params
+        mesh = Mesh(np.array(jax.devices()[:4]), ("model",))
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=2, mesh=mesh)
+        rids = [eng.add_request(p, n)
+                for p, n in zip(PROMPTS[:4], [10, 4, 7, 5])]
+        got = eng.run_to_completion(max_ticks=200)
+        for rid, p, n in zip(rids, PROMPTS[:4], [10, 4, 7, 5]):
+            assert got[rid] == _solo_greedy(model, params, p, n), \
+                f"TP request {rid} diverged"
+
     def test_sampling_mode_runs_and_respects_budget(self, model_and_params):
         """Sampling engines produce exactly max_new_tokens valid ids (the
         distributional properties of the shared sampler are oracle-tested in
         test_generate; here we pin the scheduler contract)."""
-        import jax
         model, params = model_and_params
         eng = ContinuousBatchingEngine(model, params, max_slots=2,
                                        max_len=32, prompt_buckets=[8],
