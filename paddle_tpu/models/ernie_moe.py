@@ -247,11 +247,13 @@ class ErnieMoeModel(CausalDecoderMixin, Layer):
     def _block_decode(self, sl, h, ck, cv, t, pad_lens=None):
         """One block for one new token at position t (h (B,1,H); ck/cv
         (B, max_len, nh, hd))."""
-        from ._decode import cached_attention, write_cache
+        from ._decode import cached_attention, dequantize_cache, write_cache
         q, k, v = self._block_qkv(sl, h)
         ck = write_cache(ck, k, t)
         cv = write_cache(cv, v, t)
-        att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
+        att = cached_attention(q, dequantize_cache(ck, q.dtype),
+                               dequantize_cache(cv, q.dtype), t,
+                               pad_lens=pad_lens)
         h = self._attn_residual(sl, h, att)
         return self._moe_residual_gather(sl, h), ck, cv
 

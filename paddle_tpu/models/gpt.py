@@ -30,7 +30,8 @@ import numpy as np
 from ..core import rng
 from ..core.tensor import Parameter, Tensor, apply
 from ._decode import (CausalDecoderMixin, cached_attention,  # noqa: F401
-                      make_token_sampler, validate_sampler_args, write_cache)
+                      dequantize_cache, make_token_sampler, quantize_kv,
+                      validate_sampler_args, write_cache)
 from ..nn.layer.base import Layer
 from ..ops.attention import flash_attention
 
@@ -43,7 +44,7 @@ class GPTConfig:
                  layer_norm_epsilon=1e-5, compute_dtype="bfloat16",
                  use_flash_attention=True, tie_word_embeddings=True,
                  sequence_parallel=None, scan_unroll=1,
-                 hidden_act="gelu_approx"):
+                 hidden_act="gelu_approx", kv_cache_dtype=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -64,6 +65,13 @@ class GPTConfig:
             raise ValueError(f"hidden_act must be 'gelu' or 'gelu_approx', "
                              f"got {hidden_act!r}")
         self.hidden_act = hidden_act
+        # None → KV cache stored in compute_dtype; "int8" → per-(position,
+        # head) symmetric-quantized cache (half the decode HBM traffic of
+        # bf16; serving accuracy tradeoff, see models/_decode.py)
+        if kv_cache_dtype not in (None, "int8"):
+            raise ValueError(f"kv_cache_dtype must be None or 'int8', "
+                             f"got {kv_cache_dtype!r}")
+        self.kv_cache_dtype = kv_cache_dtype
         # None → GSPMD decides (sequence gathered for attention);
         # "ring"/"ulysses" → explicit context parallelism over the "sep" axis
         if sequence_parallel not in (None, "ring", "ulysses"):
@@ -294,7 +302,12 @@ class GPTModel(CausalDecoderMixin, Layer):
         q, k, v = self._block_qkv(sl, h)
         ck = write_cache(ck, k, t)
         cv = write_cache(cv, v, t)
-        att = cached_attention(q, ck, cv, t, pad_lens=pad_lens)
+        # int8 caches dequantize here; XLA fuses the convert*scale into the
+        # attention einsum's operand read (no fp cache copy materializes)
+        dt = q.dtype
+        att = cached_attention(q, dequantize_cache(ck, dt),
+                               dequantize_cache(cv, dt), t,
+                               pad_lens=pad_lens)
         return self._block_post_attn(sl, h, att), ck, cv
 
     def prefill(self, params, input_ids, max_len: int, pad_lens=None):
@@ -317,6 +330,12 @@ class GPTModel(CausalDecoderMixin, Layer):
             return self._block_post_attn(sl, carry, att), (k, v)
 
         h, (ks, vs) = jax.lax.scan(body, h, stacked)
+        if getattr(c, "kv_cache_dtype", None) == "int8":
+            def padq(x):
+                q, s = quantize_kv(x)
+                pad5 = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
+                return (jnp.pad(q, pad5), jnp.pad(s, pad5[:-1]))
+            return h, (padq(ks), padq(vs))
         pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
         dt = jnp.dtype(c.compute_dtype)
         return h, (jnp.pad(ks.astype(dt), pad), jnp.pad(vs.astype(dt), pad))

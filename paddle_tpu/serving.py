@@ -128,23 +128,32 @@ class ContinuousBatchingEngine:
                 for name, v in params.items()}
             nh = c.num_attention_heads
             mp = mesh.shape.get("model", 1)
-            if mp > 1 and nh % mp == 0:
-                cache_spec = P(None, None, None, "model", None)
-            else:
-                cache_spec = P()
-                if mp > 1:
-                    import warnings
-                    warnings.warn(
-                        f"num_attention_heads ({nh}) is not divisible by the "
-                        f"model axis ({mp}): the KV cache falls back to full "
-                        f"replication — per-device memory is {mp}x the "
-                        f"sharded size", UserWarning)
+            shard_heads = mp > 1 and nh % mp == 0
+            if mp > 1 and not shard_heads:
+                import warnings
+                warnings.warn(
+                    f"num_attention_heads ({nh}) is not divisible by the "
+                    f"model axis ({mp}): the KV cache falls back to full "
+                    f"replication — per-device memory is {mp}x the "
+                    f"sharded size", UserWarning)
+
+            def leaf_spec(leaf):
+                # heads is dim 3 of both the (L,S,T,nh,hd) value plane and
+                # the (L,S,T,nh) int8 scale plane
+                if not shard_heads:
+                    return NamedSharding(mesh, P())
+                entries = [None] * leaf.ndim
+                entries[3] = "model"
+                return NamedSharding(mesh, P(*entries))
+
             # allocate the cache SHARDED from the start — a transient
             # replicated (L, S, max_len, nh, hd) buffer on one device is
             # exactly the allocation TP serving exists to avoid
+            shapes = jax.eval_shape(
+                lambda: model.init_cache(self.S, self.max_len))
             self.caches = jax.jit(
                 lambda: model.init_cache(self.S, self.max_len),
-                out_shardings=NamedSharding(mesh, cache_spec))()
+                out_shardings=jax.tree.map(leaf_spec, shapes))()
         # per-slot host state
         self._slot_req: List[Optional[Request]] = [None] * self.S
         self._t = np.zeros(self.S, np.int32)         # next physical slot
@@ -180,10 +189,14 @@ class ContinuousBatchingEngine:
         def run(params, big_ck, big_cv, ids, pad_len, slot, key):
             h, (ck, cv) = model.prefill(params, ids, P,
                                         pad_lens=pad_len[None])
-            big_ck = jax.lax.dynamic_update_slice(
-                big_ck, ck.astype(big_ck.dtype), (0, slot, 0, 0, 0))
-            big_cv = jax.lax.dynamic_update_slice(
-                big_cv, cv.astype(big_cv.dtype), (0, slot, 0, 0, 0))
+
+            def put(big, new):  # tree-aware: int8 caches are (vals, scales)
+                return jax.lax.dynamic_update_slice(
+                    big, new.astype(big.dtype),
+                    (0, slot) + (0,) * (big.ndim - 2))
+
+            big_ck = jax.tree.map(put, big_ck, ck)
+            big_cv = jax.tree.map(put, big_cv, cv)
             tok = sample(model.decode_logits(params, h[:, -1:]), key)
             return big_ck, big_cv, tok[0]
 
