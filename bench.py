@@ -331,15 +331,18 @@ def bench_gpt_decode(on_tpu):
     from paddle_tpu.models.gpt import GPTConfig, GPTModel
 
     paddle.seed(0)
+    # PADDLE_TPU_DECODE_KV=int8 A/Bs the quantized cache (half the decode
+    # HBM traffic — the headline lever for this bandwidth-bound config)
+    kv = os.environ.get("PADDLE_TPU_DECODE_KV") or None
     if on_tpu:
         cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                         num_attention_heads=12, max_position_embeddings=1024,
-                        compute_dtype="bfloat16")
+                        compute_dtype="bfloat16", kv_cache_dtype=kv)
         B, P, N, iters = 8, 128, 128, 5
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
                         num_attention_heads=4, max_position_embeddings=128,
-                        compute_dtype="float32")
+                        compute_dtype="float32", kv_cache_dtype=kv)
         B, P, N, iters = 2, 8, 8, 2
     model = GPTModel(cfg)
     params = {n: p._data for n, p in model.named_parameters()}

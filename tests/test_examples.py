@@ -61,3 +61,7 @@ class TestExamples:
     def test_serve_bucketed(self):
         out = _run("serve_bucketed.py")
         assert "SERVE_OK" in out
+
+    def test_serve_continuous(self):
+        out = _run("serve_continuous.py", "--int8", "--ticks_per_sync", "2")
+        assert "6 requests, 112 tokens" in out
