@@ -110,6 +110,23 @@ def filter_logits(logits32, temperature, top_k, top_p):
     return logits32
 
 
+def apply_repetition_penalty(logits32, presence, penalty):
+    """Reference generation_utils / HF RepetitionPenaltyLogitsProcessor
+    semantics: for every token already seen in the row (prompt + generated,
+    tracked in the (B, V) ``presence`` mask), positive logits divide by the
+    penalty and negative logits multiply — both push the token down for
+    penalty > 1."""
+    pen = jnp.where(logits32 > 0, logits32 / penalty, logits32 * penalty)
+    return jnp.where(presence, pen, logits32)
+
+
+def suppress_eos(logits32, eos_token_id, suppress):
+    """Mask the EOS column with -inf while ``suppress`` (scalar bool) —
+    the min_new_tokens contract (HF MinNewTokensLengthLogitsProcessor)."""
+    col = jnp.arange(logits32.shape[-1]) == eos_token_id
+    return jnp.where(suppress & col[None, :], -jnp.inf, logits32)
+
+
 def make_token_sampler(temperature, top_k, top_p, greedy):
     """Shared last-position sampler for the decode loops (GPT + ERNIE-MoE):
     the filter_logits pipeline then argmax or categorical.  ``logits32`` is
@@ -259,7 +276,8 @@ class CausalDecoderMixin:
     def generate(self, params, input_ids, max_new_tokens: int,
                  temperature: float = 1.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, greedy: bool = True, key=None,
-                 prompt_mask=None):
+                 prompt_mask=None, repetition_penalty: float = 1.0,
+                 min_new_tokens: int = 0, eos_token_id: Optional[int] = None):
         """Autoregressive generation with a static KV cache.
 
         input_ids (B, P) int32; returns (B, max_new_tokens) generated ids.
@@ -275,6 +293,10 @@ class CausalDecoderMixin:
         real).  Pad positions are excluded from attention and position ids
         shift by the per-row pad length — pad lengths are traced data, so
         ragged batches share one compiled program per bucket.
+
+        ``repetition_penalty`` > 1 pushes already-seen tokens (prompt +
+        generated) down (reference generation_utils semantics);
+        ``min_new_tokens`` masks ``eos_token_id`` for the first n emissions.
         """
         c = self.config
         B, P = input_ids.shape
@@ -285,11 +307,28 @@ class CausalDecoderMixin:
             raise ValueError(f"P + max_new_tokens = {max_len} exceeds "
                              f"max_position_embeddings ({c.max_position_embeddings})")
         validate_sampler_args(c.vocab_size, top_k, top_p, greedy, key)
+        if repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+        if min_new_tokens > 0 and eos_token_id is None:
+            raise ValueError("min_new_tokens needs eos_token_id (it works "
+                             "by suppressing EOS)")
+        if eos_token_id is not None and not 0 <= eos_token_id < c.vocab_size:
+            raise ValueError(f"eos_token_id {eos_token_id} outside vocab "
+                             f"(size {c.vocab_size}) — suppression would be "
+                             f"a silent no-op")
         key = jax.random.key(0) if key is None else key
         run = self._gen_program(P, max_new_tokens, float(temperature),
                                 None if top_k is None else int(top_k),
                                 None if top_p is None else float(top_p),
-                                greedy, masked=prompt_mask is not None)
+                                greedy, masked=prompt_mask is not None,
+                                repetition_penalty=float(repetition_penalty),
+                                min_new_tokens=int(min_new_tokens),
+                                # eos only shapes the program when it
+                                # suppresses; don't fragment the jit cache
+                                # (and recompile) per tokenizer eos id
+                                eos_token_id=(eos_token_id
+                                              if min_new_tokens > 0
+                                              else None))
         if prompt_mask is None:
             return run(params, jnp.asarray(input_ids), key)
         self._validate_prompt_mask(prompt_mask, input_ids)
@@ -298,38 +337,69 @@ class CausalDecoderMixin:
         return run(params, jnp.asarray(input_ids), key, pad_lens)
 
     def _gen_program(self, P, max_new_tokens, temperature, top_k, top_p,
-                     greedy, masked=False):
+                     greedy, masked=False, repetition_penalty=1.0,
+                     min_new_tokens=0, eos_token_id=None):
         """Build (and memoize) the jitted prefill+decode program for one
-        (P, max_new_tokens, temperature, top_k, top_p, greedy) signature —
-        repeated generate() calls with the same signature hit the jit cache
-        instead of recompiling the whole model."""
+        (P, max_new_tokens, temperature, top_k, top_p, greedy, processors)
+        signature — repeated generate() calls with the same signature hit
+        the jit cache instead of recompiling the whole model."""
         cache_key = (P, max_new_tokens, temperature, top_k, top_p, greedy,
-                     masked)
+                     masked, repetition_penalty, min_new_tokens, eos_token_id)
         progs = self.__dict__.setdefault("_gen_programs", {})
         if cache_key in progs:
             return progs[cache_key]
         max_len = P + max_new_tokens
         sample = make_token_sampler(temperature, top_k, top_p, greedy)
+        V = self.config.vocab_size
+        track = repetition_penalty != 1.0  # presence mask only when needed
+
+        def process(logits32, presence, n_emitted):
+            """(B, 1, V) logits through the pre-filter processors."""
+            l2 = logits32[:, -1, :]
+            if track:
+                l2 = apply_repetition_penalty(l2, presence,
+                                              repetition_penalty)
+            if min_new_tokens > 0:
+                l2 = suppress_eos(l2, eos_token_id,
+                                  n_emitted < min_new_tokens)
+            return l2[:, None, :]
 
         @jax.jit
         def run(params, input_ids, key, pad_lens=None):
+            B = input_ids.shape[0]
+            if track:
+                # seed presence from the prompt (pad positions excluded)
+                valid = (jnp.ones_like(input_ids, bool)
+                         if pad_lens is None else
+                         jnp.arange(P)[None, :] >= pad_lens[:, None])
+                presence = jnp.zeros((B, V), bool).at[
+                    jnp.arange(B)[:, None], input_ids].max(valid)
+            else:
+                presence = None
             h, caches = self.prefill(params, input_ids, max_len,
                                      pad_lens=pad_lens)
             key, k0 = jax.random.split(key)
-            tok0 = sample(self.decode_logits(params, h[:, -1:]), k0)
+            tok0 = sample(process(self.decode_logits(params, h[:, -1:]),
+                                  presence, 0), k0)
+            if track:
+                presence = presence.at[jnp.arange(B), tok0].set(True)
 
             def body(carry, i):
-                tok, caches, key = carry
+                tok, caches, key, presence = carry
                 t = P + i  # this token's slot in the cache
                 h = self._embed_one(params, tok, t, pad_lens=pad_lens)
                 h, caches = self.decode_step(params, h, caches, t,
                                              pad_lens=pad_lens)
                 key, sub = jax.random.split(key)
-                ntok = sample(self.decode_logits(params, h), sub)
-                return (ntok, caches, key), ntok
+                ntok = sample(process(self.decode_logits(params, h),
+                                      presence, i + 1), sub)
+                if track:
+                    presence = presence.at[jnp.arange(B), ntok].set(True)
+                return (ntok, caches, key, presence), ntok
 
-            (last, _, _), toks = jax.lax.scan(
-                body, (tok0, caches, key), jnp.arange(max_new_tokens - 1))
+            (last, _, _, _), toks = jax.lax.scan(
+                body, (tok0, caches, key, presence),
+                jnp.arange(max_new_tokens - 1))
             return jnp.concatenate([tok0[:, None], toks.T], axis=1)
 
         progs[cache_key] = run
