@@ -120,11 +120,27 @@ def apply_repetition_penalty(logits32, presence, penalty):
     return jnp.where(presence, pen, logits32)
 
 
+def seed_presence(ids, vocab_size, pad_lens=None):
+    """(B, P) prompt ids → (B, V) bool presence plane for the repetition
+    penalty, pad positions excluded — ONE copy of the seeding invariant,
+    shared by generate() and the serving engine's admission prefill."""
+    B, P = ids.shape
+    valid = (jnp.ones_like(ids, dtype=bool) if pad_lens is None else
+             jnp.arange(P)[None, :] >= pad_lens[:, None])
+    return jnp.zeros((B, vocab_size), bool).at[
+        jnp.arange(B)[:, None], ids].max(valid)
+
+
 def suppress_eos(logits32, eos_token_id, suppress):
-    """Mask the EOS column with -inf while ``suppress`` (scalar bool) —
-    the min_new_tokens contract (HF MinNewTokensLengthLogitsProcessor)."""
+    """Mask the EOS column with -inf while ``suppress`` — scalar bool (one
+    window for the whole batch) or (B,) bool (per-row windows, the serving
+    engine's case).  The min_new_tokens contract (HF
+    MinNewTokensLengthLogitsProcessor)."""
     col = jnp.arange(logits32.shape[-1]) == eos_token_id
-    return jnp.where(suppress & col[None, :], -jnp.inf, logits32)
+    sup = jnp.asarray(suppress)
+    if sup.ndim == 0:
+        sup = sup[None]
+    return jnp.where(sup[:, None] & col[None, :], -jnp.inf, logits32)
 
 
 def make_token_sampler(temperature, top_k, top_p, greedy):
@@ -367,15 +383,8 @@ class CausalDecoderMixin:
         @jax.jit
         def run(params, input_ids, key, pad_lens=None):
             B = input_ids.shape[0]
-            if track:
-                # seed presence from the prompt (pad positions excluded)
-                valid = (jnp.ones_like(input_ids, bool)
-                         if pad_lens is None else
-                         jnp.arange(P)[None, :] >= pad_lens[:, None])
-                presence = jnp.zeros((B, V), bool).at[
-                    jnp.arange(B)[:, None], input_ids].max(valid)
-            else:
-                presence = None
+            presence = seed_presence(input_ids, V, pad_lens) if track \
+                else None
             h, caches = self.prefill(params, input_ids, max_len,
                                      pad_lens=pad_lens)
             key, k0 = jax.random.split(key)

@@ -42,7 +42,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .jit.bucketing import select_bucket
-from .models._decode import make_token_sampler, validate_sampler_args
+from .models._decode import (apply_repetition_penalty, make_token_sampler,
+                             seed_presence, suppress_eos,
+                             validate_sampler_args)
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
 
@@ -76,7 +78,8 @@ class ContinuousBatchingEngine:
                  prompt_buckets=None, temperature: float = 1.0,
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  greedy: bool = True, eos_token_id: Optional[int] = None,
-                 key=None, ticks_per_sync: int = 1, mesh=None):
+                 key=None, ticks_per_sync: int = 1, mesh=None,
+                 repetition_penalty: float = 1.0, min_new_tokens: int = 0):
         """``ticks_per_sync``: decode ticks fused into one device program
         between host synchronizations.  1 = retire/admit after every token
         (lowest latency); k > 1 amortizes the host round-trip over k tokens
@@ -90,7 +93,12 @@ class ContinuousBatchingEngine:
         ``_dims_mapping`` specs (the same metadata the training path uses)
         and the KV cache shards over the heads dim; GSPMD inserts the TP
         collectives in the prefill/decode programs exactly as it does for
-        training."""
+        training.
+
+        ``repetition_penalty`` / ``min_new_tokens``: the generate()
+        processors, engine-wide — a per-slot (S, V) presence plane rides
+        next to the KV cache (reset and seeded by admission prefill), and
+        EOS windows are per-row (each request's own emission count)."""
         c = model.config
         if max_len > c.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
@@ -111,10 +119,25 @@ class ContinuousBatchingEngine:
         self.ticks_per_sync = int(ticks_per_sync)
         if self.ticks_per_sync < 1:
             raise ValueError("ticks_per_sync must be >= 1")
+        self.repetition_penalty = float(repetition_penalty)
+        self.min_new_tokens = int(min_new_tokens)
+        if self.repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")
+        if self.min_new_tokens > 0 and eos_token_id is None:
+            raise ValueError("min_new_tokens needs eos_token_id")
+        if eos_token_id is not None and \
+                not 0 <= eos_token_id < c.vocab_size:
+            raise ValueError(f"eos_token_id {eos_token_id} outside vocab "
+                             f"(size {c.vocab_size})")
+        self._track = self.repetition_penalty != 1.0
         self._sample_sig = (float(temperature),
                             None if top_k is None else int(top_k),
-                            None if top_p is None else float(top_p), greedy)
-        self._sample = make_token_sampler(*self._sample_sig)
+                            None if top_p is None else float(top_p), greedy,
+                            self.repetition_penalty, self.min_new_tokens,
+                            eos_token_id if self.min_new_tokens > 0 else None)
+        self._sample = make_token_sampler(*self._sample_sig[:4])
+        self._presence = (jnp.zeros((self.S, c.vocab_size), bool)
+                          if self._track else None)
 
         self.mesh = mesh
         if mesh is None:
@@ -185,8 +208,12 @@ class ContinuousBatchingEngine:
         model = self.model
         sample = self._sample
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params, big_ck, big_cv, ids, pad_len, slot, key):
+        track = self._track
+        rp, min_new, eos = self._sample_sig[4:]
+        V = model.config.vocab_size
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7))
+        def run(params, big_ck, big_cv, ids, pad_len, slot, key, presence):
             h, (ck, cv) = model.prefill(params, ids, P,
                                         pad_lens=pad_len[None])
 
@@ -197,8 +224,19 @@ class ContinuousBatchingEngine:
 
             big_ck = jax.tree.map(put, big_ck, ck)
             big_cv = jax.tree.map(put, big_cv, cv)
-            tok = sample(model.decode_logits(params, h[:, -1:]), key)
-            return big_ck, big_cv, tok[0]
+            l2 = model.decode_logits(params, h[:, -1:])[:, -1]
+            if track:
+                # reset + seed the slot's presence row from the prompt
+                row = seed_presence(ids, V, pad_len[None])
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row, (slot, 0))
+                l2 = apply_repetition_penalty(l2, presence[slot][None], rp)
+            if min_new > 0:
+                l2 = suppress_eos(l2, eos, jnp.bool_(True))  # 0 < min_new
+            tok = sample(l2[:, None, :], key)
+            if track:
+                presence = presence.at[slot, tok[0]].set(True)
+            return big_ck, big_cv, tok[0], presence
 
         progs[cache_key] = run
         return run
@@ -214,23 +252,37 @@ class ContinuousBatchingEngine:
         k_ticks = self.ticks_per_sync
         sample = self._sample
 
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params, big_ck, big_cv, toks, ts, pads, active, key):
+        track = self._track
+        rp, min_new, eos = self._sample_sig[4:]
+        S = self.S
+
+        @partial(jax.jit, donate_argnums=(1, 2, 8))
+        def run(params, big_ck, big_cv, toks, ts, pads, active, key,
+                presence, emitted0):
             def tick(carry, i):
-                big_ck, big_cv, tok, key = carry
+                big_ck, big_cv, tok, key, presence = carry
                 h = model._embed_one(params, tok, ts + i, pad_lens=pads)
                 h, (big_ck, big_cv) = model.decode_step(
                     params, h, (big_ck, big_cv), ts + i, pad_lens=pads)
                 key, sub = jax.random.split(key)
-                ntok = sample(model.decode_logits(params, h), sub)
+                l2 = model.decode_logits(params, h)[:, -1]
+                if track:
+                    l2 = apply_repetition_penalty(l2, presence, rp)
+                if min_new > 0:
+                    # per-row window: each request's own emission count
+                    l2 = suppress_eos(l2, eos, emitted0 + i < min_new)
+                ntok = sample(l2[:, None, :], sub)
                 # inactive slots carry their token unchanged (their stale
                 # cache writes are never read — see module docstring)
                 ntok = jnp.where(active, ntok, tok)
-                return (big_ck, big_cv, ntok, key), ntok
+                if track:
+                    presence = presence.at[jnp.arange(S), ntok].set(True)
+                return (big_ck, big_cv, ntok, key, presence), ntok
 
-            (big_ck, big_cv, _, _), toks_out = jax.lax.scan(
-                tick, (big_ck, big_cv, toks, key), jnp.arange(k_ticks))
-            return big_ck, big_cv, toks_out        # (k, S)
+            (big_ck, big_cv, _, _, presence), toks_out = jax.lax.scan(
+                tick, (big_ck, big_cv, toks, key, presence),
+                jnp.arange(k_ticks))
+            return big_ck, big_cv, toks_out, presence      # toks (k, S)
 
         progs[cache_key] = run
         return run
@@ -285,9 +337,10 @@ class ContinuousBatchingEngine:
             pad = P - len(req.prompt)
             ids = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
             run = self._prefill_prog(P)
-            ck, cv, tok0 = run(self.params, self.caches[0], self.caches[1],
-                               ids, jnp.int32(pad), jnp.int32(slot),
-                               self._next_key())
+            ck, cv, tok0, self._presence = run(
+                self.params, self.caches[0], self.caches[1], ids,
+                jnp.int32(pad), jnp.int32(slot), self._next_key(),
+                self._presence)
             self.caches = (ck, cv)
             tok0 = int(tok0)
             self._slot_req[slot] = req
@@ -321,10 +374,14 @@ class ContinuousBatchingEngine:
             return
         run = self._decode_prog_all()
         active_before = self._active.copy()
-        ck, cv, blk = run(self.params, self.caches[0], self.caches[1],
-                          jnp.asarray(self._tok), jnp.asarray(self._t),
-                          jnp.asarray(self._pad),
-                          jnp.asarray(active_before), self._next_key())
+        emitted0 = np.asarray(
+            [len(r.generated) if r is not None else 0
+             for r in self._slot_req], np.int32)
+        ck, cv, blk, self._presence = run(
+            self.params, self.caches[0], self.caches[1],
+            jnp.asarray(self._tok), jnp.asarray(self._t),
+            jnp.asarray(self._pad), jnp.asarray(active_before),
+            self._next_key(), self._presence, jnp.asarray(emitted0))
         self.caches = (ck, cv)
         blk = np.asarray(blk)                      # (k, S)
         for slot in np.flatnonzero(active_before):

@@ -168,6 +168,57 @@ class TestContinuousBatching:
             assert got[rid] == _solo_greedy(model, params, p, n), \
                 f"TP request {rid} diverged"
 
+    @pytest.mark.parametrize("k", [1, 3])
+    def test_repetition_penalty_matches_solo_generate(self, model_and_params,
+                                                      k):
+        """Engine-wide repetition penalty: the per-slot presence plane must
+        reproduce generate()'s processor exactly, across slot reuse (the
+        plane row is reset by admission prefill) and chunked decode."""
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=k,
+                                       repetition_penalty=5.0)
+        budgets = [10, 6, 8]
+        rids = [eng.add_request(p, n)
+                for p, n in zip(PROMPTS[:3], budgets)]
+        got = eng.run_to_completion(max_ticks=200)
+        for rid, p, n in zip(rids, PROMPTS[:3], budgets):
+            solo = model.generate(params, jnp.asarray([p], jnp.int32), n,
+                                  greedy=True, repetition_penalty=5.0)
+            assert got[rid] == [int(t) for t in np.asarray(solo)[0]], \
+                f"request {rid} (k={k})"
+
+    def test_min_new_tokens_per_row_windows(self, model_and_params):
+        """Each request's EOS window counts ITS OWN emissions: a request
+        admitted mid-run must not inherit the older request's lapsed
+        window."""
+        model, params = model_and_params
+        probe = ContinuousBatchingEngine(model, params, max_slots=1,
+                                         max_len=32, prompt_buckets=[8])
+        pid = probe.add_request(PROMPTS[0], 8)
+        eos = probe.run_to_completion(max_ticks=100)[pid][0]  # emitted 1st
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=2, eos_token_id=eos,
+                                       min_new_tokens=4)
+        r0 = eng.add_request(PROMPTS[0], 10)
+        for _ in range(3):              # r0 is past its window when r1 joins
+            eng.step()
+        r1 = eng.add_request(PROMPTS[0], 10)  # same prompt: same dynamics
+        got = eng.run_to_completion(max_ticks=200)
+        for rid in (r0, r1):
+            toks = got[rid]
+            assert eos not in toks[:4], (rid, toks)
+            solo = model.generate(params, jnp.asarray([PROMPTS[0]],
+                                                      jnp.int32), 10,
+                                  greedy=True, min_new_tokens=4,
+                                  eos_token_id=int(eos))
+            solo_l = [int(t) for t in np.asarray(solo)[0]]
+            if eos in solo_l:
+                solo_l = solo_l[:solo_l.index(eos) + 1]
+            assert toks == solo_l, (rid, toks, solo_l)
+
     def test_sampling_mode_runs_and_respects_budget(self, model_and_params):
         """Sampling engines produce exactly max_new_tokens valid ids (the
         distributional properties of the shared sampler are oracle-tested in
