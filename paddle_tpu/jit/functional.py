@@ -24,11 +24,15 @@ from ..core.tensor import Tensor, note_compiled_call
 
 def _tracks_compiled_calls(fn):
     """Every invocation (cache hits included) resets the eager-nudge streak
-    — see core.tensor.note_compiled_call."""
+    — see core.tensor.note_compiled_call.  The jit API surface (lower /
+    eval_shape / trace — used by tests and AOT benches) passes through."""
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         note_compiled_call()
         return fn(*args, **kwargs)
+    for attr in ("lower", "eval_shape", "trace", "clear_cache"):
+        if hasattr(fn, attr):
+            setattr(wrapped, attr, getattr(fn, attr))
     return wrapped
 
 
