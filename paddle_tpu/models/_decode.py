@@ -414,18 +414,25 @@ class CausalDecoderMixin:
         progs[cache_key] = run
         return run
 
-    def _embed_chunk(self, params, toks, t0):
+    def _embed_chunk(self, params, toks, t0, pad_lens=None):
         """Embed a token chunk at cache slots [t0, t0+k).
 
         toks (k,) with scalar t0 → (1, k, H); toks (B, k) with t0 (B,) →
-        (B, k, H) (per-row slots — batched speculative decoding)."""
+        (B, k, H) (per-row slots — batched speculative decoding).  With
+        left-padded prompts (``pad_lens``) logical positions shift by the
+        per-row pad length, matching _embed_one/_prefill_embed."""
         dt = jnp.dtype(self.config.compute_dtype)
         if toks.ndim == 1:
             k = toks.shape[0]
+            pos = t0 + jnp.arange(k)
+            if pad_lens is not None:
+                pos = jnp.maximum(pos - pad_lens[0], 0)
             return (jnp.take(params["wte"], toks, axis=0)[None]
-                    + params["wpe"][t0 + jnp.arange(k)][None]).astype(dt)
+                    + params["wpe"][pos][None]).astype(dt)
         B, k = toks.shape
         pos = jnp.asarray(t0)[:, None] + jnp.arange(k)[None, :]   # (B, k)
+        if pad_lens is not None:
+            pos = jnp.maximum(pos - pad_lens[:, None], 0)
         return (jnp.take(params["wte"], toks, axis=0)
                 + jnp.take(params["wpe"], pos, axis=0)).astype(dt)
 

@@ -79,7 +79,8 @@ class ContinuousBatchingEngine:
                  top_k: Optional[int] = None, top_p: Optional[float] = None,
                  greedy: bool = True, eos_token_id: Optional[int] = None,
                  key=None, ticks_per_sync: int = 1, mesh=None,
-                 repetition_penalty: float = 1.0, min_new_tokens: int = 0):
+                 repetition_penalty: float = 1.0, min_new_tokens: int = 0,
+                 prefill_chunk: Optional[int] = None):
         """``ticks_per_sync``: decode ticks fused into one device program
         between host synchronizations.  1 = retire/admit after every token
         (lowest latency); k > 1 amortizes the host round-trip over k tokens
@@ -98,7 +99,13 @@ class ContinuousBatchingEngine:
         ``repetition_penalty`` / ``min_new_tokens``: the generate()
         processors, engine-wide — a per-slot (S, V) presence plane rides
         next to the KV cache (reset and seeded by admission prefill), and
-        EOS windows are per-row (each request's own emission count)."""
+        EOS windows are per-row (each request's own emission count).
+
+        ``prefill_chunk``: admission prefills at most this many prompt
+        positions per scheduler round (must divide every bucket), so one
+        long prompt cannot stall every running request's decode for a full
+        prefill — the head-of-line latency fix.  None = whole-bucket
+        prefill in one round."""
         c = model.config
         if max_len > c.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
@@ -119,6 +126,29 @@ class ContinuousBatchingEngine:
         self.ticks_per_sync = int(ticks_per_sync)
         if self.ticks_per_sync < 1:
             raise ValueError("ticks_per_sync must be >= 1")
+        self.prefill_chunk = None if prefill_chunk is None \
+            else int(prefill_chunk)
+        if self.prefill_chunk is not None:
+            if self.prefill_chunk < 1:
+                raise ValueError("prefill_chunk must be >= 1")
+            # only buckets that actually chunk (b > chunk) need to divide;
+            # smaller buckets take the whole-bucket path untouched
+            chunked = [b for b in self.buckets if b > self.prefill_chunk]
+            bad = [b for b in chunked if b % self.prefill_chunk]
+            if bad:
+                raise ValueError(
+                    f"prefill_chunk ({self.prefill_chunk}) must divide "
+                    f"every prompt bucket it chunks; doesn't divide {bad}")
+            if chunked and max(chunked) + self.ticks_per_sync > self.max_len:
+                # a filling slot's stale decode writes park in the strip
+                # [max_len - ticks_per_sync, max_len); it must sit ABOVE
+                # the largest chunked bucket or parking would clobber the
+                # prompt region being filled (see _admit)
+                raise ValueError(
+                    f"chunked prefill needs max_len >= largest chunked "
+                    f"bucket ({max(chunked)}) + ticks_per_sync "
+                    f"({self.ticks_per_sync}) as a stale-write parking "
+                    f"strip; max_len is {self.max_len}")
         self.repetition_penalty = float(repetition_penalty)
         self.min_new_tokens = int(min_new_tokens)
         if self.repetition_penalty <= 0:
@@ -183,6 +213,7 @@ class ContinuousBatchingEngine:
         self._pad = np.zeros(self.S, np.int32)       # left-pad length
         self._tok = np.zeros(self.S, np.int32)       # last sampled token
         self._active = np.zeros(self.S, bool)
+        self._filling: Dict[int, dict] = {}          # slot -> chunked state
 
         self._queue: List[Request] = []
         self._finished: Dict[int, List[int]] = {}
@@ -198,6 +229,28 @@ class ContinuousBatchingEngine:
         wave must not recompile."""
         return (self.S, self.max_len, self.ticks_per_sync, self._sample_sig)
 
+    def _first_token_tail(self):
+        """The first-token sampling sequence (penalty → EOS window → draw →
+        presence update) shared by whole-bucket prefill and the last
+        prefill segment — ONE copy, so the two admission paths cannot
+        drift (test_chunked_prefill_matches_whole_prefill pins it)."""
+        sample = self._sample
+        track = self._track
+        rp, min_new, eos = self._sample_sig[4:]
+        model = self.model
+
+        def tail(params, h_last, presence, slot, key):
+            l2 = model.decode_logits(params, h_last)[:, -1]
+            if track:
+                l2 = apply_repetition_penalty(l2, presence[slot][None], rp)
+            if min_new > 0:
+                l2 = suppress_eos(l2, eos, jnp.bool_(True))  # emitted 0
+            tok = sample(l2[:, None, :], key)[0]
+            if track:
+                presence = presence.at[slot, tok].set(True)
+            return tok, presence
+        return tail
+
     def _prefill_prog(self, P: int):
         """Prefill ONE request (left-padded to bucket length P) directly
         into slot ``slot`` of the global cache; returns the first token."""
@@ -206,11 +259,9 @@ class ContinuousBatchingEngine:
         if cache_key in progs:
             return progs[cache_key]
         model = self.model
-        sample = self._sample
-
         track = self._track
-        rp, min_new, eos = self._sample_sig[4:]
         V = model.config.vocab_size
+        tail = self._first_token_tail()
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
         def run(params, big_ck, big_cv, ids, pad_len, slot, key, presence):
@@ -224,19 +275,61 @@ class ContinuousBatchingEngine:
 
             big_ck = jax.tree.map(put, big_ck, ck)
             big_cv = jax.tree.map(put, big_cv, cv)
-            l2 = model.decode_logits(params, h[:, -1:])[:, -1]
             if track:
                 # reset + seed the slot's presence row from the prompt
                 row = seed_presence(ids, V, pad_len[None])
                 presence = jax.lax.dynamic_update_slice(
                     presence, row, (slot, 0))
-                l2 = apply_repetition_penalty(l2, presence[slot][None], rp)
-            if min_new > 0:
-                l2 = suppress_eos(l2, eos, jnp.bool_(True))  # 0 < min_new
-            tok = sample(l2[:, None, :], key)
+            tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return big_ck, big_cv, tok, presence
+
+        progs[cache_key] = run
+        return run
+
+    def _seg_prog(self, seg: int, first: bool, last: bool):
+        """One prefill SEGMENT for one slot: embed ``seg`` prompt tokens at
+        [t0, t0+seg), write the slot's cache region via the chunk decode
+        path (cached_attention's k-query form — the same machinery as
+        speculative verification), and on the last segment sample the first
+        token.  Only the slot's cache row is computed on (sliced out and
+        written back), so a segment costs B=1 work, not B=S."""
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        cache_key = ("seg", seg, first, last, self._sig)
+        if cache_key in progs:
+            return progs[cache_key]
+        model = self.model
+        track = self._track
+        V = model.config.vocab_size
+        tail = self._first_token_tail()
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7))
+        def run(params, big_ck, big_cv, toks, t0, pad, slot, presence, key):
+            take = lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1)
+            ck_s = jax.tree.map(take, big_ck)
+            cv_s = jax.tree.map(take, big_cv)
+            h = model._embed_chunk(params, toks[0], t0, pad_lens=pad[None])
+            h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s), t0,
+                                                pad_lens=pad[None])
+
+            def put(big, new):
+                return jax.lax.dynamic_update_slice(
+                    big, new.astype(big.dtype),
+                    (0, slot) + (0,) * (big.ndim - 2))
+
+            big_ck = jax.tree.map(put, big_ck, ck_s)
+            big_cv = jax.tree.map(put, big_cv, cv_s)
             if track:
-                presence = presence.at[slot, tok[0]].set(True)
-            return big_ck, big_cv, tok[0], presence
+                if first:
+                    presence = jax.lax.dynamic_update_slice(
+                        presence, jnp.zeros((1, V), bool), (slot, 0))
+                valid = t0 + jnp.arange(seg) >= pad     # pads: segment 0
+                row = presence[slot].at[toks[0]].max(valid)
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row[None], (slot, 0))
+            tok = jnp.int32(0)
+            if last:
+                tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return big_ck, big_cv, tok, presence
 
         progs[cache_key] = run
         return run
@@ -319,7 +412,8 @@ class ContinuousBatchingEngine:
         return req.id
 
     def pending(self) -> bool:
-        return bool(self._queue) or bool(self._active.any())
+        return bool(self._queue) or bool(self._active.any()) \
+            or bool(self._filling)
 
     def pop_finished(self) -> Dict[int, List[int]]:
         out, self._finished = self._finished, {}
@@ -329,26 +423,71 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         return sub
 
+    def _free_slots(self):
+        return [s for s in range(self.S)
+                if not self._active[s] and s not in self._filling]
+
     def _admit(self):
-        while self._queue and not self._active.all():
-            slot = int(np.flatnonzero(~self._active)[0])
+        free = self._free_slots()
+        while self._queue and free:
+            slot = free.pop(0)
             req = self._queue.pop(0)
             P = select_bucket(len(req.prompt), self.buckets)
             pad = P - len(req.prompt)
-            ids = jnp.asarray([[0] * pad + req.prompt], jnp.int32)
+            ids = [0] * pad + req.prompt
+            if self.prefill_chunk is not None and P > self.prefill_chunk:
+                # chunked admission: segments run one per scheduler round,
+                # interleaved with everyone else's decode.  PARK the slot's
+                # decode clock in the strip above every chunked bucket:
+                # the batched decode program stale-writes EVERY row at its
+                # clock each tick (inactive ones included), and unlike
+                # whole-bucket prefill — which overwrites [0, P) after any
+                # stale write — segments land progressively, so a stale
+                # write at the old clock (0 for a fresh slot) would corrupt
+                # already-filled prompt positions.  The parking strip is
+                # overwritten by the occupant's own decode before it can
+                # ever be read (write-before-read induction).
+                self._t[slot] = self.max_len - self.ticks_per_sync
+                self._filling[slot] = {"req": req, "ids": ids, "pad": pad,
+                                       "P": P, "seg": 0,
+                                       "nseg": P // self.prefill_chunk}
+                continue
             run = self._prefill_prog(P)
             ck, cv, tok0, self._presence = run(
-                self.params, self.caches[0], self.caches[1], ids,
-                jnp.int32(pad), jnp.int32(slot), self._next_key(),
-                self._presence)
+                self.params, self.caches[0], self.caches[1],
+                jnp.asarray([ids], jnp.int32), jnp.int32(pad),
+                jnp.int32(slot), self._next_key(), self._presence)
             self.caches = (ck, cv)
-            tok0 = int(tok0)
-            self._slot_req[slot] = req
-            self._t[slot] = P
-            self._pad[slot] = pad
-            self._tok[slot] = tok0
-            self._active[slot] = True
-            self._record(slot, tok0)
+            self._activate(slot, req, P, pad, int(tok0))
+
+    def _activate(self, slot, req, P, pad, tok0):
+        self._slot_req[slot] = req
+        self._t[slot] = P
+        self._pad[slot] = pad
+        self._tok[slot] = tok0
+        self._active[slot] = True
+        self._record(slot, tok0)
+
+    def _fill_segments(self):
+        """Run ONE prefill segment for every filling slot (round-robin
+        progress: a long prompt advances without stalling decode)."""
+        seg = self.prefill_chunk
+        for slot, st in list(self._filling.items()):
+            i, first = st["seg"], st["seg"] == 0
+            last = i == st["nseg"] - 1
+            toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
+            run = self._seg_prog(seg, first, last)
+            ck, cv, tok0, self._presence = run(
+                self.params, self.caches[0], self.caches[1], toks,
+                jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
+                self._presence, self._next_key())
+            self.caches = (ck, cv)
+            if last:
+                del self._filling[slot]
+                self._activate(slot, st["req"], st["P"], st["pad"],
+                               int(tok0))
+            else:
+                st["seg"] += 1
 
     def _record(self, slot: int, tok: int):
         """Append a token to the slot's request; retire on EOS/budget."""
@@ -370,6 +509,8 @@ class ContinuousBatchingEngine:
         run ``ticks_per_sync`` batched decode ticks and retire finished
         requests from the returned token block."""
         self._admit()
+        if self._filling:
+            self._fill_segments()
         if not self._active.any():
             return
         run = self._decode_prog_all()

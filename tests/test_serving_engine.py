@@ -219,6 +219,100 @@ class TestContinuousBatching:
                 solo_l = solo_l[:solo_l.index(eos) + 1]
             assert toks == solo_l, (rid, toks, solo_l)
 
+    @pytest.mark.parametrize("penalty", [1.0, 5.0])
+    def test_chunked_prefill_matches_whole_prefill(self, model_and_params,
+                                                   penalty):
+        """prefill_chunk=4 over a 16-bucket: segment-by-segment admission
+        (the chunk decode path) must produce exactly the tokens of
+        whole-bucket prefill, for ragged (left-padded) prompts, with and
+        without the presence-tracking processor."""
+        model, params = model_and_params
+        prompts = [list(range(3, 17)), [7, 8, 9], list(range(40, 50))]
+
+        def run(chunk):
+            eng = ContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, prompt_buckets=[16],
+                ticks_per_sync=2, prefill_chunk=chunk,
+                repetition_penalty=penalty)
+            rids = [eng.add_request(p, 8) for p in prompts]
+            got = eng.run_to_completion(max_ticks=300)
+            return [got[r] for r in rids]
+
+        assert run(4) == run(None)
+
+    def test_chunked_prefill_keeps_decode_flowing(self, model_and_params):
+        """While a long prompt fills over several rounds, an already-active
+        request must emit a token every round — the head-of-line fix this
+        feature exists for."""
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=48, prompt_buckets=[16],
+                                       prefill_chunk=4)
+        r0 = eng.add_request(PROMPTS[0], 20)
+        eng.step()                          # r0 active (filled in 4 rounds?)
+        while not eng._active.any():
+            eng.step()
+        base = len(eng._slot_req[int(np.flatnonzero(eng._active)[0])]
+                   .generated)
+        r1 = eng.add_request(list(range(1, 16)), 4)   # long prompt: 4 segs
+        for i in range(3):                  # r1 still filling these rounds
+            eng.step()
+            assert r1 not in eng.pop_finished()
+            slot0 = int(np.flatnonzero(eng._active)[0])
+            got = len(eng._slot_req[slot0].generated)
+            assert got == base + (i + 1), "decode stalled behind prefill"
+        got_all = eng.run_to_completion(max_ticks=200)
+        assert sorted(got_all) == sorted([r0, r1])
+
+    def test_chunked_fill_survives_concurrent_decode_stale_writes(
+            self, model_and_params):
+        """THE corruption scenario: a full-bucket (pad=0) prompt fills
+        chunk-by-chunk in a fresh slot while another request decodes.  The
+        batched decode program stale-writes EVERY row's cache at its clock
+        each tick — without clock PARKING those writes land inside [0, P)
+        of the filling slot, clobbering prompt k/v that was just written
+        (position 0 is unmasked when pad=0).  Greedy tokens are too robust
+        to witness a two-position corruption, so this checks the CACHE
+        itself against model.prefill's reference — and proves the check is
+        live by re-running with the parking sabotaged."""
+        model, params = model_and_params
+        long_prompt = list(range(3, 19))              # len 16 == bucket: pad 0
+
+        def fill_next_to_decoder(sabotage):
+            eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                           max_len=32, prompt_buckets=[16],
+                                           ticks_per_sync=2, prefill_chunk=4)
+            r0 = eng.add_request(PROMPTS[0], 14)      # decoding throughout
+            for _ in range(5):                        # r0 fills, then decodes
+                eng.step()
+            assert eng._active.any()
+            r1 = eng.add_request(long_prompt, 8)
+            eng.step()                                # r1's first segment
+            slot = next(iter(eng._filling))
+            if sabotage:
+                eng._t[slot] = 0                      # un-park the clock
+            while slot in eng._filling:
+                eng.step()
+            return np.asarray(eng.caches[0][:, slot, :16])
+
+        ref = model.prefill(params, jnp.asarray([long_prompt], jnp.int32),
+                            16)[1][0]
+        ref = np.asarray(ref[:, 0, :16])
+        good = fill_next_to_decoder(sabotage=False)
+        np.testing.assert_allclose(good, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg="stale decode writes corrupted "
+                                           "the filling slot's prompt cache")
+        bad = fill_next_to_decoder(sabotage=True)
+        assert np.abs(bad - ref).max() > 0.1, \
+            "negative control failed: sabotaged parking should corrupt"
+
+    def test_prefill_chunk_must_divide_buckets(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="must divide"):
+            ContinuousBatchingEngine(model, params, max_slots=1, max_len=32,
+                                     prompt_buckets=[8, 12],
+                                     prefill_chunk=8)
+
     def test_sampling_mode_runs_and_respects_budget(self, model_and_params):
         """Sampling engines produce exactly max_new_tokens valid ids (the
         distributional properties of the shared sampler are oracle-tested in
