@@ -46,7 +46,8 @@ from .models._decode import (apply_repetition_penalty, make_token_sampler,
                              seed_presence, suppress_eos,
                              validate_sampler_args)
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "SpeculativeBatchingEngine",
+           "Request"]
 
 
 class Request:
@@ -551,3 +552,238 @@ class ContinuousBatchingEngine:
             if max_ticks is not None and ticks > max_ticks:
                 raise RuntimeError(f"not done after {max_ticks} ticks")
         return self.pop_finished()
+
+
+class SpeculativeBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching WITH speculative decoding: every scheduler round
+    the draft proposes ``draft_k`` tokens for all slots, ONE target chunk
+    verifies them (cached_attention's k-query form, per-row clocks), and
+    each slot advances by its own accepted count — bit-lossless vs greedy
+    (the acceptance rule is the longest argmax-matching prefix, exactly
+    models/_decode.py's greedy speculative contract), so outputs equal the
+    plain engine's token for token while rounds shrink by the acceptance
+    rate.
+
+    The draft keeps its own slot cache, prefilled at admission alongside the
+    target's; both caches self-heal — each round's chunk rewrites
+    [t, t+K+1) BEFORE reading any of it, so leftover k/v from rejected
+    proposals (and inactive slots' parked stale writes) are never read.
+    v1 scope: greedy only, no processors, whole-bucket prefill only.
+    """
+
+    def __init__(self, model, params, draft_model, draft_params,
+                 max_slots: int, max_len: int, draft_k: int = 4,
+                 prompt_buckets=None, eos_token_id: Optional[int] = None,
+                 key=None, mesh=None):
+        if mesh is not None:
+            raise NotImplementedError("speculative engine v1 is single-mesh")
+        super().__init__(model, params, max_slots, max_len,
+                         prompt_buckets=prompt_buckets, greedy=True,
+                         eos_token_id=eos_token_id, key=key,
+                         # round write-span is K+1: reuse the base class's
+                         # parking/room arithmetic by declaring it the sync
+                         # width (step() below never uses it as tick count)
+                         ticks_per_sync=int(draft_k) + 1)
+        dc = draft_model.config
+        if dc.vocab_size != model.config.vocab_size:
+            raise ValueError(f"draft vocab ({dc.vocab_size}) != target "
+                             f"vocab ({model.config.vocab_size})")
+        if max_len > dc.max_position_embeddings:
+            raise ValueError(f"max_len {max_len} exceeds the DRAFT's "
+                             f"max_position_embeddings "
+                             f"({dc.max_position_embeddings})")
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.K = int(draft_k)
+        if self.K < 1:
+            raise ValueError("draft_k must be >= 1")
+        self.draft_caches = draft_model.init_cache(self.S, self.max_len)
+        self.rounds = 0          # spec rounds run (for efficiency reporting)
+
+    @property
+    def _sig(self):
+        d = self.draft_model.config
+        return ("spec", self.S, self.max_len, self.K,
+                (type(self.draft_model).__name__, d.num_layers,
+                 d.hidden_size, d.vocab_size), self._sample_sig)
+
+    def _cached_prog(self, cache_key, build):
+        """Program cache with a DRAFT-identity check (the _spec_program
+        pattern): the compiled closures capture the draft model object, and
+        the config tuple in _sig is not a complete architecture signature —
+        an engine over the same target but a different draft instance must
+        rebuild, never reuse."""
+        import weakref
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        entry = progs.get(cache_key)
+        if entry is not None:
+            ref, cached = entry
+            if ref() is self.draft_model:
+                return cached
+        run = build()
+        progs[cache_key] = (weakref.ref(self.draft_model), run)
+        return run
+
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        # spec rounds over-propose: the LAST round can start at
+        # t = P + budget - 2 and write K+1 positions
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) <= 0:
+            raise ValueError("max_new_tokens must be >= 1")
+        P = select_bucket(len(prompt), self.buckets)
+        mnt = int(max_new_tokens)
+        # budget 1 completes at admission prefill — no round, no slack;
+        # otherwise the LAST round can start at t = P + budget - 2 and
+        # write K+1 positions
+        need = P if mnt == 1 else P + mnt + self.K - 1
+        if need > self.max_len:
+            raise ValueError(
+                f"bucketed prompt ({len(prompt)} -> bucket {P}) + "
+                f"max_new_tokens ({max_new_tokens}) + draft_k slack "
+                f"({self.K}) exceeds max_len ({self.max_len})")
+        req = Request(next(self._ids), prompt, max_new_tokens)
+        self._queue.append(req)
+        return req.id
+
+    def _prefill_prog(self, P: int):
+        """Admission prefill for BOTH caches (target + draft) + tok0."""
+        model, draft = self.model, self.draft_model
+
+        def build():
+            tail = self._first_token_tail()
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def run(params_pair, big, dbig, ids, pad_len, slot, key,
+                    presence):
+                params, dparams = params_pair
+                big_ck, big_cv = big
+                dbig_ck, dbig_cv = dbig
+
+                def put(bigc, new):
+                    return jax.lax.dynamic_update_slice(
+                        bigc, new.astype(bigc.dtype),
+                        (0, slot) + (0,) * (bigc.ndim - 2))
+
+                h, (ck, cv) = model.prefill(params, ids, P,
+                                            pad_lens=pad_len[None])
+                big_ck = jax.tree.map(put, big_ck, ck)
+                big_cv = jax.tree.map(put, big_cv, cv)
+                _, (dck, dcv) = draft.prefill(dparams, ids, P,
+                                              pad_lens=pad_len[None])
+                dbig_ck = jax.tree.map(put, dbig_ck, dck)
+                dbig_cv = jax.tree.map(put, dbig_cv, dcv)
+                tok, presence = tail(params, h[:, -1:], presence, slot, key)
+                return (big_ck, big_cv), (dbig_ck, dbig_cv), tok, presence
+
+            return run
+
+        return self._cached_prog(("spec_prefill", P, self._sig), build)
+
+    def _admit(self):
+        free = self._free_slots()
+        while self._queue and free:
+            slot = free.pop(0)
+            req = self._queue.pop(0)
+            P = select_bucket(len(req.prompt), self.buckets)
+            pad = P - len(req.prompt)
+            ids = [0] * pad + req.prompt
+            run = self._prefill_prog(P)
+            big, dbig, tok0, self._presence = run(
+                (self.params, self.draft_params), self.caches,
+                self.draft_caches, jnp.asarray([ids], jnp.int32),
+                jnp.int32(pad), jnp.int32(slot), self._next_key(),
+                self._presence)
+            self.caches, self.draft_caches = big, dbig
+            self._activate(slot, req, P, pad, int(tok0))
+
+    def _spec_round_prog(self):
+        """One speculative round for all S slots: draft K sequential
+        proposals (per-row clocks), one target verify chunk, greedy
+        longest-prefix acceptance.  Returns per-row accepted counts and the
+        (S, K+1) token block (d_0..d_{K-1}, replacement at position lead)."""
+        model, draft = self.model, self.draft_model
+        K, S = self.K, self.S
+
+        def build():
+            return self._make_spec_round(model, draft, K, S)
+
+        return self._cached_prog(("spec_round", self._sig), build)
+
+    @staticmethod
+    def _make_spec_round(model, draft, K, S):
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params_pair, big, dbig, toks, ts, pads):
+            # greedy + host-side discard: no randomness, no device-side
+            # active masking — inactive rows compute and their writes park
+            params, dparams = params_pair
+            rows = jnp.arange(S)
+
+            def dstep(carry, i):
+                tok, dc = carry
+                hh = draft._embed_one(dparams, tok, ts + i, pad_lens=pads)
+                hh, dc = draft.decode_step(dparams, hh, dc, ts + i,
+                                           pad_lens=pads)
+                ql = draft.decode_logits(dparams, hh)[:, -1]
+                ntok = jnp.argmax(ql, -1).astype(jnp.int32)
+                return (ntok, dc), ntok
+
+            (_, dbig), d = jax.lax.scan(dstep, (toks, dbig), jnp.arange(K))
+            d = d.T                                             # (S, K)
+
+            # ONE verify chunk per row over [prev, d_0..d_{K-1}] at clocks
+            # [ts, ts+K] (prev's kv lands at ts, matching plain decode)
+            inp = jnp.concatenate([toks[:, None], d], axis=1)   # (S, K+1)
+            hin = model._embed_chunk(params, inp, ts, pad_lens=pads)
+            hv, big = model.decode_step(params, hin, big, ts, pad_lens=pads)
+            tl = model.decode_logits(params, hv)                # (S, K+1, V)
+            tpred = jnp.argmax(tl, -1).astype(jnp.int32)        # (S, K+1)
+            lead = jnp.sum(jnp.cumprod(
+                (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
+            repl = jnp.take_along_axis(
+                tpred, jnp.minimum(lead, K)[:, None], 1)[:, 0]  # (S,)
+            # emitted block: d_0..d_{lead-1}, then repl at position lead
+            block = d  # (S, K) proposals
+            block = jnp.concatenate([block, jnp.zeros((S, 1), jnp.int32)],
+                                    axis=1)
+            block = block.at[rows, lead].set(repl)              # (S, K+1)
+
+            # draft self-heal: re-ingest the verify chunk so the draft
+            # cache holds kv for every chunk position (the round-3 hole fix)
+            dh = draft._embed_chunk(dparams, inp, ts, pad_lens=pads)
+            _, dbig = draft.decode_step(dparams, dh, dbig, ts, pad_lens=pads)
+
+            return big, dbig, lead, block
+
+        return run
+
+    def step(self):
+        """One scheduler round: admit, then one speculative round; each
+        active slot advances by its own accepted count + 1."""
+        self._admit()
+        if not self._active.any():
+            return
+        run = self._spec_round_prog()
+        active_before = self._active.copy()
+        big, dbig, lead, block = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, jnp.asarray(self._tok),
+            jnp.asarray(self._t), jnp.asarray(self._pad))
+        self.caches, self.draft_caches = big, dbig
+        self.rounds += 1
+        lead = np.asarray(lead)
+        block = np.asarray(block)
+        for slot in np.flatnonzero(active_before):
+            m = int(lead[slot]) + 1                 # tokens this round
+            for j in range(m):
+                if not self._active[slot]:
+                    break                           # retired mid-round
+                self._t[slot] += 1
+                self._tok[slot] = block[slot, j]
+                self._record(int(slot), int(block[slot, j]))
+            # room safety net at round boundaries (admission guarantees it
+            # never fires for valid budgets)
+            if self._active[slot] and \
+                    int(self._t[slot]) + self.K + 1 > self.max_len:
+                self._retire(int(slot))
