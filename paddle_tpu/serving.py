@@ -34,6 +34,7 @@ Greedy by default; temperature/top-k/top-p sampling share the engine key.
 from __future__ import annotations
 
 import itertools
+import time
 from functools import partial
 from typing import Dict, List, Optional
 
@@ -59,6 +60,9 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.generated: List[int] = []
         self.done = False
+        self.enqueued_at = time.monotonic()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
 
     def __repr__(self):
         return (f"Request(id={self.id}, prompt_len={len(self.prompt)}, "
@@ -219,6 +223,8 @@ class ContinuousBatchingEngine:
         self._queue: List[Request] = []
         self._finished: Dict[int, List[int]] = {}
         self._ids = itertools.count()
+        self._m = {"requests": 0, "tokens": 0, "ttft_sum": 0.0,
+                   "latency_sum": 0.0, "started": time.monotonic()}
 
     # ---------------------------------------------------------- programs --
 
@@ -394,23 +400,26 @@ class ContinuousBatchingEngine:
             # the request would still emit the prefill token, silently
             # over-generating — refuse instead
             raise ValueError("max_new_tokens must be >= 1")
-        # budget against the BUCKETED length and CHUNK-ROUNDED decode: the
-        # first token comes from prefill (no decode position), the remaining
-        # budget-1 tokens consume ceil((budget-1)/k)*k cache positions after
-        # the bucket (decode advances k ticks per sync; pad slots occupy
-        # physical positions)
         P = select_bucket(len(prompt), self.buckets)
-        k = self.ticks_per_sync
-        rounded = -(-(int(max_new_tokens) - 1) // k) * k
-        if P + rounded > self.max_len:
+        need = self._positions_needed(P, int(max_new_tokens))
+        if need > self.max_len:
             raise ValueError(
                 f"bucketed prompt ({len(prompt)} -> bucket {P}) needs "
-                f"{rounded} decode positions for max_new_tokens="
-                f"{max_new_tokens} at ticks_per_sync={k}; exceeds max_len "
-                f"({self.max_len})")
+                f"{need} cache positions for max_new_tokens="
+                f"{max_new_tokens}; exceeds max_len ({self.max_len})")
         req = Request(next(self._ids), prompt, max_new_tokens)
         self._queue.append(req)
         return req.id
+
+    def _positions_needed(self, P: int, mnt: int) -> int:
+        """Worst-case cache positions a request occupies — the bucket plus
+        CHUNK-ROUNDED decode: the first token comes from prefill (no decode
+        position), the remaining budget-1 tokens consume ceil((budget-1)/k)
+        * k positions (decode advances k ticks per sync; pad slots occupy
+        physical positions).  The speculative engine overrides this with
+        its over-proposal arithmetic."""
+        k = self.ticks_per_sync
+        return P + -(-(mnt - 1) // k) * k
 
     def pending(self) -> bool:
         return bool(self._queue) or bool(self._active.any()) \
@@ -462,6 +471,7 @@ class ContinuousBatchingEngine:
             self._activate(slot, req, P, pad, int(tok0))
 
     def _activate(self, slot, req, P, pad, tok0):
+        req.first_token_at = time.monotonic()   # tok0 exists: TTFT point
         self._slot_req[slot] = req
         self._t[slot] = P
         self._pad[slot] = pad
@@ -499,11 +509,20 @@ class ContinuousBatchingEngine:
             self._retire(slot)
 
     def _retire(self, slot: int):
+        from .utils.stats import stat_add
         req = self._slot_req[slot]
         req.done = True
+        req.finished_at = time.monotonic()
         self._finished[req.id] = list(req.generated)
         self._slot_req[slot] = None
         self._active[slot] = False
+        n = len(req.generated)
+        stat_add("serving_requests_finished")
+        stat_add("serving_tokens_emitted", n)
+        self._m["requests"] += 1
+        self._m["tokens"] += n
+        self._m["ttft_sum"] += req.first_token_at - req.enqueued_at
+        self._m["latency_sum"] += req.finished_at - req.enqueued_at
 
     def step(self):
         """One scheduler round: admit waiting requests into free slots, then
@@ -540,6 +559,19 @@ class ContinuousBatchingEngine:
             if self._active[slot] and \
                     int(self._t[slot]) + self.ticks_per_sync > self.max_len:
                 self._retire(int(slot))
+
+    def metrics(self) -> Dict[str, float]:
+        """Serving observability (feeds the same StatRegistry the rest of
+        the framework reports through): finished-request counts, mean
+        time-to-first-token (queue wait + prefill), mean request latency,
+        and lifetime throughput."""
+        m, n = self._m, max(self._m["requests"], 1)
+        dt = max(time.monotonic() - m["started"], 1e-9)
+        return {"requests_finished": m["requests"],
+                "tokens_emitted": m["tokens"],
+                "mean_ttft_s": m["ttft_sum"] / n,
+                "mean_latency_s": m["latency_sum"] / n,
+                "tokens_per_sec": m["tokens"] / dt}
 
     def run_to_completion(self, max_ticks: Optional[int] = None
                           ) -> Dict[int, List[int]]:
@@ -624,28 +656,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         progs[cache_key] = (weakref.ref(self.draft_model), run)
         return run
 
-    def add_request(self, prompt, max_new_tokens: int) -> int:
-        # spec rounds over-propose: the LAST round can start at
-        # t = P + budget - 2 and write K+1 positions
-        prompt = [int(t) for t in prompt]
-        if not prompt:
-            raise ValueError("empty prompt")
-        if int(max_new_tokens) <= 0:
-            raise ValueError("max_new_tokens must be >= 1")
-        P = select_bucket(len(prompt), self.buckets)
-        mnt = int(max_new_tokens)
+    def _positions_needed(self, P: int, mnt: int) -> int:
         # budget 1 completes at admission prefill — no round, no slack;
         # otherwise the LAST round can start at t = P + budget - 2 and
-        # write K+1 positions
-        need = P if mnt == 1 else P + mnt + self.K - 1
-        if need > self.max_len:
-            raise ValueError(
-                f"bucketed prompt ({len(prompt)} -> bucket {P}) + "
-                f"max_new_tokens ({max_new_tokens}) + draft_k slack "
-                f"({self.K}) exceeds max_len ({self.max_len})")
-        req = Request(next(self._ids), prompt, max_new_tokens)
-        self._queue.append(req)
-        return req.id
+        # write its full K+1-wide chunk (draft_k over-proposal slack)
+        return P if mnt == 1 else P + mnt + self.K - 1
 
     def _prefill_prog(self, P: int):
         """Admission prefill for BOTH caches (target + draft) + tok0."""
@@ -749,10 +764,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                                     axis=1)
             block = block.at[rows, lead].set(repl)              # (S, K+1)
 
-            # draft self-heal: re-ingest the verify chunk so the draft
-            # cache holds kv for every chunk position (the round-3 hole fix)
-            dh = draft._embed_chunk(dparams, inp, ts, pad_lens=pads)
-            _, dbig = draft.decode_step(dparams, dh, dbig, ts, pad_lens=pads)
+            # draft self-heal (the round-3 hole fix): the draft scan
+            # already wrote kv for [prev, d_0..d_{K-2}] at [ts, ts+K-1];
+            # only d_{K-1}'s kv at ts+K is missing — one draft step fills
+            # it at ~1/(K+1) the cost of re-ingesting the whole chunk
+            dh = draft._embed_one(dparams, d[:, K - 1], ts + K,
+                                  pad_lens=pads)
+            _, dbig = draft.decode_step(dparams, dh, dbig, ts + K,
+                                        pad_lens=pads)
 
             return big, dbig, lead, block
 

@@ -328,3 +328,23 @@ class TestContinuousBatching:
         for rid in (r0, r1):
             assert len(got[rid]) == 6
             assert all(0 <= t < model.config.vocab_size for t in got[rid])
+
+
+class TestEngineMetrics:
+    def test_metrics_and_stat_registry(self, model_and_params):
+        """metrics() reports finished/tokens/TTFT/latency/throughput and
+        the global StatRegistry sees the serving counters."""
+        from paddle_tpu.utils.stats import get_stat
+        model, params = model_and_params
+        before = get_stat("serving_tokens_emitted") or 0
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8])
+        eng.add_request(PROMPTS[0], 6)
+        eng.add_request(PROMPTS[1], 4)
+        eng.run_to_completion(max_ticks=100)
+        m = eng.metrics()
+        assert m["requests_finished"] == 2
+        assert m["tokens_emitted"] == 10
+        assert 0 < m["mean_ttft_s"] <= m["mean_latency_s"]
+        assert m["tokens_per_sec"] > 0
+        assert (get_stat("serving_tokens_emitted") or 0) == before + 10

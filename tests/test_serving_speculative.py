@@ -116,7 +116,7 @@ class TestSpeculativeEngine:
         spec = SpeculativeBatchingEngine(target, tparams, draft, dparams,
                                          max_slots=1, max_len=20,
                                          draft_k=4, prompt_buckets=[8])
-        with pytest.raises(ValueError, match="draft_k slack"):
+        with pytest.raises(ValueError, match="exceeds max_len"):
             spec.add_request([1, 2, 3], 10)   # 8 + 10 + 3 > 20
         spec.add_request([1, 2, 3], 9)        # 8 + 9 + 3 == 20: fits
         spec.add_request([1, 2, 3], 1)        # budget 1: prefill only,
