@@ -43,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .jit.bucketing import select_bucket
+from .utils.stats import stat_add
 from .models._decode import (apply_repetition_penalty, make_token_sampler,
                              seed_presence, suppress_eos,
                              validate_sampler_args)
@@ -509,7 +510,6 @@ class ContinuousBatchingEngine:
             self._retire(slot)
 
     def _retire(self, slot: int):
-        from .utils.stats import stat_add
         req = self._slot_req[slot]
         req.done = True
         req.finished_at = time.monotonic()

@@ -33,6 +33,9 @@ def main():
     ap.add_argument("--slots", type=int, default=8)
     ap.add_argument("--ticks_per_sync", type=int, default=8,
                     help="decode ticks fused per host sync")
+    ap.add_argument("--speculative", action="store_true",
+                    help="also run the speculative engine (truncated-depth "
+                         "draft) and report its tok/s + rounds")
     args = ap.parse_args()
 
     import numpy as np
@@ -58,8 +61,10 @@ def main():
         budgets = [64, 128, 256, 512] * 6
     # headroom for chunk rounding: budgets round up to multiples of k
     max_len = P_bucket + -(-max(budgets) // k) * k
-    if max_len > cfg.max_position_embeddings:
-        raise SystemExit(f"--ticks_per_sync {k}: max_len {max_len} exceeds "
+    SPEC_SLACK = 5  # speculative engine adds draft_k(=4) + 1 headroom
+    need = max_len + (SPEC_SLACK if args.speculative else 0)
+    if need > cfg.max_position_embeddings:
+        raise SystemExit(f"--ticks_per_sync {k}: max_len {need} exceeds "
                          f"the model's positions")
     model = GPTModel(cfg)
     params = {n: p._data for n, p in model.named_parameters()}
@@ -116,7 +121,7 @@ def main():
     engine_dt = time.perf_counter() - t0
     engine_tok_s = total_tokens / engine_dt
 
-    print(json.dumps({
+    out = {
         "metric": "serve_continuous_batching_tok_s",
         "value": round(engine_tok_s, 1), "unit": "tokens/s/chip",
         "static_tok_s": round(static_tok_s, 1),
@@ -124,7 +129,42 @@ def main():
         "requests": n_req, "slots": S, "total_tokens": total_tokens,
         "ticks_per_sync": args.ticks_per_sync,
         "backend": "cpu" if args.cpu else "tpu",
-    }), flush=True)
+    }
+
+    if args.speculative:
+      try:  # the base metric must survive any speculative failure
+        from paddle_tpu.serving import SpeculativeBatchingEngine
+        dcfg_kw = dict(vocab_size=cfg.vocab_size,
+                       hidden_size=cfg.hidden_size,
+                       num_layers=max(cfg.num_layers // 6, 1),
+                       num_attention_heads=cfg.num_attention_heads,
+                       max_position_embeddings=cfg.max_position_embeddings,
+                       compute_dtype=cfg.compute_dtype)
+        draft = GPTModel(GPTConfig(**dcfg_kw))
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+
+        def run_spec():
+            eng = SpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=S,
+                max_len=max_len + SPEC_SLACK, draft_k=4,
+                prompt_buckets=[P_bucket])
+            for p, n in zip(prompts, budgets):
+                eng.add_request(p, n)
+            got = eng.run_to_completion(max_ticks=100000)
+            assert sum(len(v) for v in got.values()) == total_tokens
+            return eng.rounds
+
+        run_spec()  # warmup compile
+        t0 = time.perf_counter()
+        rounds = run_spec()
+        spec_dt = time.perf_counter() - t0
+        out["speculative_tok_s"] = round(total_tokens / spec_dt, 1)
+        out["speculative_rounds"] = rounds
+        out["speculative_speedup"] = round(engine_dt / spec_dt, 3)
+      except Exception as e:  # noqa: BLE001 - report, don't lose the line
+        out["speculative_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":

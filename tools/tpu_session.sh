@@ -48,7 +48,7 @@ TPU_GUARD_LOG=/tmp/decode_int8.log PADDLE_TPU_DECODE_KV=int8 \
 grep "^{" /tmp/decode_int8.log | tee DECODE_INT8_r04.json
 
 echo "=== 7/7 continuous-batching engine throughput"
-TPU_GUARD_LOG=/tmp/serve_bench.log $G python tools/serve_bench.py
+TPU_GUARD_LOG=/tmp/serve_bench.log $G python tools/serve_bench.py --speculative
 if grep -q "^{" /tmp/serve_bench.log; then
     grep "^{" /tmp/serve_bench.log | tee SERVE_BENCH_r04.json
 else
