@@ -29,6 +29,9 @@ def main():
                     help="tensor-parallel degree (needs that many devices)")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--ticks_per_sync", type=int, default=4)
+    ap.add_argument("--speculative", action="store_true",
+                    help="speculative engine (1-layer draft): lossless, "
+                         "fewer rounds")
     args = ap.parse_args()
 
     import jax
@@ -50,10 +53,21 @@ def main():
         from jax.sharding import Mesh
         mesh = Mesh(np.array(jax.devices()[:args.mp]), ("model",))
 
-    eng = ContinuousBatchingEngine(
-        model, params, max_slots=args.slots, max_len=128,
-        prompt_buckets=[16, 32], ticks_per_sync=args.ticks_per_sync,
-        mesh=mesh)
+    if args.speculative:
+        from paddle_tpu.serving import SpeculativeBatchingEngine
+        dcfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=1,
+                         num_attention_heads=4, max_position_embeddings=256,
+                         compute_dtype="float32")
+        draft = GPTModel(dcfg)
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+        eng = SpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=args.slots,
+            max_len=128, draft_k=3, prompt_buckets=[16, 32], mesh=mesh)
+    else:
+        eng = ContinuousBatchingEngine(
+            model, params, max_slots=args.slots, max_len=128,
+            prompt_buckets=[16, 32], ticks_per_sync=args.ticks_per_sync,
+            mesh=mesh)
 
     rng = np.random.RandomState(0)
     t0 = time.time()
@@ -72,10 +86,14 @@ def main():
     for rid in wave1 + wave2:
         print(f"request {rid}: {len(out[rid])} tokens, "
               f"first 8 = {out[rid][:8]}")
+    extra = (f", spec rounds={eng.rounds}" if args.speculative else "")
+    m = eng.metrics()
     print(f"\n{len(out)} requests, {total} tokens in {dt:.2f}s "
           f"({total / dt:.0f} tok/s) — slots={args.slots}, "
           f"ticks_per_sync={args.ticks_per_sync}, "
-          f"kv={'int8' if args.int8 else 'fp'}, mp={args.mp}")
+          f"kv={'int8' if args.int8 else 'fp'}, mp={args.mp}{extra}; "
+          f"mean TTFT {m['mean_ttft_s'] * 1e3:.0f}ms, "
+          f"mean latency {m['mean_latency_s'] * 1e3:.0f}ms")
 
 
 if __name__ == "__main__":
