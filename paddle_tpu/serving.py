@@ -52,6 +52,16 @@ __all__ = ["ContinuousBatchingEngine", "SpeculativeBatchingEngine",
            "Request"]
 
 
+def _slot_write(slot):
+    """Tree-mapper writing one slot's region of a global cache leaf
+    (rank-generic: int8 caches pair a 5D value plane with a 4D scale
+    plane; slot is the batch dim at axis 1)."""
+    def put(big, new):
+        return jax.lax.dynamic_update_slice(
+            big, new.astype(big.dtype), (0, slot) + (0,) * (big.ndim - 2))
+    return put
+
+
 class Request:
     """One in-flight generation request (host-side bookkeeping)."""
 
@@ -237,6 +247,13 @@ class ContinuousBatchingEngine:
         wave must not recompile."""
         return (self.S, self.max_len, self.ticks_per_sync, self._sample_sig)
 
+    def _cached_prog(self, cache_key, build):
+        """Model-level compiled-program cache (see _sig)."""
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        if cache_key not in progs:
+            progs[cache_key] = build()
+        return progs[cache_key]
+
     def _first_token_tail(self):
         """The first-token sampling sequence (penalty → EOS window → draw →
         presence update) shared by whole-bucket prefill and the last
@@ -262,10 +279,10 @@ class ContinuousBatchingEngine:
     def _prefill_prog(self, P: int):
         """Prefill ONE request (left-padded to bucket length P) directly
         into slot ``slot`` of the global cache; returns the first token."""
-        progs = self.model.__dict__.setdefault("_serving_programs", {})
-        cache_key = ("prefill", P, self._sig)
-        if cache_key in progs:
-            return progs[cache_key]
+        return self._cached_prog(("prefill", P, self._sig),
+                                 lambda: self._build_prefill(P))
+
+    def _build_prefill(self, P: int):
         model = self.model
         track = self._track
         V = model.config.vocab_size
@@ -276,11 +293,7 @@ class ContinuousBatchingEngine:
             h, (ck, cv) = model.prefill(params, ids, P,
                                         pad_lens=pad_len[None])
 
-            def put(big, new):  # tree-aware: int8 caches are (vals, scales)
-                return jax.lax.dynamic_update_slice(
-                    big, new.astype(big.dtype),
-                    (0, slot) + (0,) * (big.ndim - 2))
-
+            put = _slot_write(slot)
             big_ck = jax.tree.map(put, big_ck, ck)
             big_cv = jax.tree.map(put, big_cv, cv)
             if track:
@@ -291,7 +304,6 @@ class ContinuousBatchingEngine:
             tok, presence = tail(params, h[:, -1:], presence, slot, key)
             return big_ck, big_cv, tok, presence
 
-        progs[cache_key] = run
         return run
 
     def _seg_prog(self, seg: int, first: bool, last: bool):
@@ -301,10 +313,11 @@ class ContinuousBatchingEngine:
         speculative verification), and on the last segment sample the first
         token.  Only the slot's cache row is computed on (sliced out and
         written back), so a segment costs B=1 work, not B=S."""
-        progs = self.model.__dict__.setdefault("_serving_programs", {})
-        cache_key = ("seg", seg, first, last, self._sig)
-        if cache_key in progs:
-            return progs[cache_key]
+        return self._cached_prog(
+            ("seg", seg, first, last, self._sig),
+            lambda: self._build_seg(seg, first, last))
+
+    def _build_seg(self, seg: int, first: bool, last: bool):
         model = self.model
         track = self._track
         V = model.config.vocab_size
@@ -319,11 +332,7 @@ class ContinuousBatchingEngine:
             h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s), t0,
                                                 pad_lens=pad[None])
 
-            def put(big, new):
-                return jax.lax.dynamic_update_slice(
-                    big, new.astype(big.dtype),
-                    (0, slot) + (0,) * (big.ndim - 2))
-
+            put = _slot_write(slot)
             big_ck = jax.tree.map(put, big_ck, ck_s)
             big_cv = jax.tree.map(put, big_cv, cv_s)
             if track:
@@ -339,16 +348,14 @@ class ContinuousBatchingEngine:
                 tok, presence = tail(params, h[:, -1:], presence, slot, key)
             return big_ck, big_cv, tok, presence
 
-        progs[cache_key] = run
         return run
 
     def _decode_prog_all(self):
         """``ticks_per_sync`` decode ticks over all S slots (per-row cache
         clocks), one host sync: returns the (k, S) token block."""
-        progs = self.model.__dict__.setdefault("_serving_programs", {})
-        cache_key = ("decode", self._sig)
-        if cache_key in progs:
-            return progs[cache_key]
+        return self._cached_prog(("decode", self._sig), self._build_decode)
+
+    def _build_decode(self):
         model = self.model
         k_ticks = self.ticks_per_sync
         sample = self._sample
@@ -385,7 +392,6 @@ class ContinuousBatchingEngine:
                 jnp.arange(k_ticks))
             return big_ck, big_cv, toks_out, presence      # toks (k, S)
 
-        progs[cache_key] = run
         return run
 
     # --------------------------------------------------------- scheduling --
@@ -640,11 +646,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                  d.hidden_size, d.vocab_size), self._sample_sig)
 
     def _cached_prog(self, cache_key, build):
-        """Program cache with a DRAFT-identity check (the _spec_program
-        pattern): the compiled closures capture the draft model object, and
-        the config tuple in _sig is not a complete architecture signature —
-        an engine over the same target but a different draft instance must
-        rebuild, never reuse."""
+        """Overrides the base cache with a DRAFT-identity check (the
+        _spec_program pattern): the compiled closures capture the draft
+        model object, and the config tuple in _sig is not a complete
+        architecture signature — an engine over the same target but a
+        different draft instance must rebuild, never reuse."""
         import weakref
         progs = self.model.__dict__.setdefault("_serving_programs", {})
         entry = progs.get(cache_key)
@@ -676,11 +682,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 big_ck, big_cv = big
                 dbig_ck, dbig_cv = dbig
 
-                def put(bigc, new):
-                    return jax.lax.dynamic_update_slice(
-                        bigc, new.astype(bigc.dtype),
-                        (0, slot) + (0,) * (bigc.ndim - 2))
-
+                put = _slot_write(slot)
                 h, (ck, cv) = model.prefill(params, ids, P,
                                             pad_lens=pad_len[None])
                 big_ck = jax.tree.map(put, big_ck, ck)
