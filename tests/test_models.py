@@ -136,3 +136,39 @@ class TestErnieMoe:
                 state, loss = step(state, np.float32(1e-3), x, x)
             losses[key] = float(loss)
         assert abs(losses["serial"] - losses["ep"]) < 1e-4, losses
+
+
+class TestRematModes:
+    def test_gpt_remat_modes_loss_parity(self):
+        """remat=False / True / 'dots' (selective policy saving MXU outputs)
+        must produce identical train losses — the policy changes WHAT is
+        recomputed in the backward, never the math.  'dots' is the
+        recommended large-batch mode and was previously untested."""
+        from paddle_tpu.models.gpt import (GPTConfig, GPTModel,
+                                           make_gpt_train_step)
+        from paddle_tpu.optimizer import AdamW
+
+        r = np.random.RandomState(3)
+        ids = jnp.asarray(r.randint(0, 128, (2, 16)))
+        labels = jnp.asarray(r.randint(0, 128, (2, 16)))
+
+        def losses(remat):
+            paddle.seed(5)
+            cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                            num_attention_heads=4,
+                            max_position_embeddings=32,
+                            compute_dtype="float32")
+            model = GPTModel(cfg)
+            hcg = _fleet_hcg()
+            step, state = make_gpt_train_step(model, AdamW(1e-3), hcg,
+                                              remat=remat)
+            out = []
+            for i in range(3):
+                state, loss = step(state, jax.random.key(7),
+                                   np.float32(1e-3), ids, labels)
+                out.append(float(np.asarray(loss)))
+            return out
+
+        base = losses(False)
+        np.testing.assert_allclose(losses(True), base, rtol=1e-6)
+        np.testing.assert_allclose(losses("dots"), base, rtol=1e-6)
