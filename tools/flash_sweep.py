@@ -14,6 +14,9 @@ import os
 import subprocess
 import sys
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
 BLOCKS = [None, 128, 256, 512]   # None = auto (largest divisor)
 
 
