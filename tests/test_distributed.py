@@ -227,6 +227,7 @@ def test_graft_entry_runs():
 
 
 @needs8
+@pytest.mark.slow  # 114s (r4 --durations): the driver runs it separately too
 def test_graft_dryrun():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
