@@ -348,3 +348,53 @@ class TestEngineMetrics:
         assert 0 < m["mean_ttft_s"] <= m["mean_latency_s"]
         assert m["tokens_per_sec"] > 0
         assert (get_stat("serving_tokens_emitted") or 0) == before + 10
+
+
+class TestSchedulerFuzz:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_scenarios_match_solo(self, model_and_params, seed):
+        """Randomized composition stress: random prompts/budgets/admission
+        times under randomly drawn engine configs (ticks_per_sync,
+        prefill_chunk, eos, repetition penalty, int8 cache) — every
+        request's tokens must equal generate() with the same knobs.  The
+        scheduler features compose; pairwise tests can't cover the grid."""
+        import paddle_tpu as _paddle
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel
+        rng = np.random.RandomState(seed)
+        kv = "int8" if rng.rand() < 0.5 else None
+        _paddle.seed(11)   # same seed as the fixture: identical weights
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=96,
+                        compute_dtype="float32", kv_cache_dtype=kv)
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+
+        ticks = int(rng.choice([1, 2, 4]))
+        chunk = int(rng.choice([0, 4, 8]))        # 0 = whole-bucket
+        penalty = float(rng.choice([1.0, 4.0]))
+        eos = int(rng.randint(0, 97)) if rng.rand() < 0.5 else None
+        eng = ContinuousBatchingEngine(
+            model, params, max_slots=int(rng.randint(1, 4)), max_len=48,
+            prompt_buckets=[8, 16], ticks_per_sync=ticks,
+            prefill_chunk=chunk or None, repetition_penalty=penalty,
+            eos_token_id=eos)
+
+        reqs = []
+        for _ in range(int(rng.randint(4, 9))):
+            p = [int(t) for t in rng.randint(1, 97, rng.randint(1, 15))]
+            n = int(rng.randint(1, 12))
+            reqs.append((eng.add_request(p, n), p, n))
+            for _ in range(int(rng.randint(0, 3))):  # staggered admission
+                eng.step()
+        got = eng.run_to_completion(max_ticks=500)
+
+        for rid, p, n in reqs:
+            solo = model.generate(params, jnp.asarray([p], jnp.int32), n,
+                                  greedy=True, repetition_penalty=penalty)
+            want = [int(t) for t in np.asarray(solo)[0]]
+            if eos is not None and eos in want:
+                want = want[:want.index(eos) + 1]
+            assert got[rid] == want, (
+                f"seed={seed} rid={rid} ticks={ticks} chunk={chunk} "
+                f"penalty={penalty} eos={eos} kv={kv}")
