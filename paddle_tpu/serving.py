@@ -74,6 +74,7 @@ class Request:
         self.enqueued_at = time.monotonic()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        self.on_token = None          # optional streaming callback
 
     def __repr__(self):
         return (f"Request(id={self.id}, prompt_len={len(self.prompt)}, "
@@ -396,9 +397,15 @@ class ContinuousBatchingEngine:
 
     # --------------------------------------------------------- scheduling --
 
-    def add_request(self, prompt, max_new_tokens: int) -> int:
+    def add_request(self, prompt, max_new_tokens: int,
+                    on_token=None) -> int:
         """Queue a prompt; returns the request id.  Admission happens inside
-        ``step()`` whenever a slot is free."""
+        ``step()`` whenever a slot is free.
+
+        ``on_token(request_id, token, done)``: optional streaming callback,
+        invoked on the host as each token is accepted (chunked/speculative
+        modes deliver a burst per sync — ordering within a request is
+        guaranteed, across requests it follows slot order)."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -415,6 +422,7 @@ class ContinuousBatchingEngine:
                 f"{need} cache positions for max_new_tokens="
                 f"{max_new_tokens}; exceeds max_len ({self.max_len})")
         req = Request(next(self._ids), prompt, max_new_tokens)
+        req.on_token = on_token
         self._queue.append(req)
         return req.id
 
@@ -512,7 +520,10 @@ class ContinuousBatchingEngine:
         req = self._slot_req[slot]
         req.generated.append(tok)
         hit_eos = (self.eos_token_id is not None and tok == self.eos_token_id)
-        if len(req.generated) >= req.max_new_tokens or hit_eos:
+        done = len(req.generated) >= req.max_new_tokens or hit_eos
+        if req.on_token is not None:
+            req.on_token(req.id, tok, done)
+        if done:
             self._retire(slot)
 
     def _retire(self, slot: int):

@@ -427,3 +427,27 @@ class TestCrossFamily:
                                   greedy=True)
             assert got[rid] == [int(t) for t in np.asarray(solo)[0]], \
                 f"ERNIE-MoE request {rid} diverged"
+
+
+class TestStreaming:
+    def test_on_token_streams_in_order(self, model_and_params):
+        """Streaming callback: every accepted token arrives exactly once, in
+        order, with done on the last — matching the final result, across
+        chunked sync (bursts per sync) and EOS retirement."""
+        model, params = model_and_params
+        seen = {}
+
+        def cb(rid, tok, done):
+            seen.setdefault(rid, []).append((tok, done))
+
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=3)
+        r0 = eng.add_request(PROMPTS[0], 7, on_token=cb)
+        r1 = eng.add_request(PROMPTS[1], 4, on_token=cb)
+        got = eng.run_to_completion(max_ticks=100)
+        for rid in (r0, r1):
+            toks = [t for t, _ in seen[rid]]
+            dones = [d for _, d in seen[rid]]
+            assert toks == got[rid]
+            assert dones == [False] * (len(toks) - 1) + [True]
