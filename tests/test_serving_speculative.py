@@ -143,3 +143,34 @@ class TestSpeculativeEngine:
             SpeculativeBatchingEngine(target, tparams, short_pos, sp,
                                       max_slots=1, max_len=32,
                                       prompt_buckets=[8])
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1])
+def test_speculative_fuzz_matches_solo(models, seed):
+    """Randomized speculative scenarios (draft_k, slots, budgets, staggered
+    admission, optional EOS): every request equals solo greedy generate —
+    the lossless claim under scheduler composition."""
+    target, tparams, draft, dparams = models
+    rng = np.random.RandomState(100 + seed)
+    K = int(rng.choice([1, 2, 4]))
+    eos = int(rng.randint(0, 97)) if rng.rand() < 0.5 else None
+    spec = SpeculativeBatchingEngine(
+        target, tparams, draft, dparams, max_slots=int(rng.randint(1, 4)),
+        max_len=48, draft_k=K, prompt_buckets=[8],
+        eos_token_id=eos)
+    reqs = []
+    for _ in range(int(rng.randint(3, 7))):
+        p = [int(t) for t in rng.randint(1, 97, rng.randint(1, 9))]
+        n = int(rng.randint(1, 12))
+        reqs.append((spec.add_request(p, n), p, n))
+        for _ in range(int(rng.randint(0, 3))):
+            spec.step()
+    got = spec.run_to_completion(max_ticks=500)
+    for rid, p, n in reqs:
+        solo = target.generate(tparams, jnp.asarray([p], jnp.int32), n,
+                               greedy=True)
+        want = [int(t) for t in np.asarray(solo)[0]]
+        if eos is not None and eos in want:
+            want = want[:want.index(eos) + 1]
+        assert got[rid] == want, (seed, rid, K, eos)
