@@ -174,3 +174,27 @@ def test_speculative_fuzz_matches_solo(models, seed):
         if eos is not None and eos in want:
             want = want[:want.index(eos) + 1]
         assert got[rid] == want, (seed, rid, K, eos)
+
+
+def test_speculative_engine_int8_target(models):
+    """Speculative batching over an int8-cache target (and fp draft): the
+    quantized pair flows through the verify chunk's tuple-dispatch writes;
+    outputs equal the int8 model's own solo generation."""
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+    paddle.seed(31)   # same seed as the fixture target: identical weights
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=3,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32", kv_cache_dtype="int8")
+    target = GPTModel(cfg)
+    tparams = {n: p._data for n, p in target.named_parameters()}
+    _, _, draft, dparams = models
+    spec = SpeculativeBatchingEngine(target, tparams, draft, dparams,
+                                     max_slots=2, max_len=48, draft_k=3,
+                                     prompt_buckets=[8])
+    rids = [spec.add_request(p, n) for p, n in zip(PROMPTS[:3], (8, 5, 7))]
+    got = spec.run_to_completion(max_ticks=200)
+    assert spec.caches[0][0].dtype == jnp.int8
+    for rid, p, n in zip(rids, PROMPTS[:3], (8, 5, 7)):
+        solo = target.generate(tparams, jnp.asarray([p], jnp.int32), n,
+                               greedy=True)
+        assert got[rid] == [int(t) for t in np.asarray(solo)[0]], rid
