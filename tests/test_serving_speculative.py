@@ -198,3 +198,27 @@ def test_speculative_engine_int8_target(models):
         solo = target.generate(tparams, jnp.asarray([p], jnp.int32), n,
                                greedy=True)
         assert got[rid] == [int(t) for t in np.asarray(solo)[0]], rid
+
+
+def test_cross_family_moe_target_gpt_draft(models):
+    """The engine's draft and target only meet through the mixin contract:
+    ERNIE-MoE target + GPT draft (the round-3 cross-family pairing, now on
+    the batched scheduler) stays lossless vs the MoE's solo generation."""
+    from paddle_tpu.models.ernie_moe import ErnieMoeConfig, ErnieMoeModel
+    paddle.seed(41)
+    cfg = ErnieMoeConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                         num_attention_heads=4, num_experts=4, top_k=2,
+                         max_position_embeddings=96,
+                         compute_dtype="float32")
+    target = ErnieMoeModel(cfg)
+    tparams = {n: p._data for n, p in target.named_parameters()}
+    _, _, draft, dparams = models   # GPT 1-layer draft, same vocab
+    spec = SpeculativeBatchingEngine(target, tparams, draft, dparams,
+                                     max_slots=2, max_len=48, draft_k=3,
+                                     prompt_buckets=[8])
+    rids = [spec.add_request(p, n) for p, n in zip(PROMPTS[:3], (7, 5, 6))]
+    got = spec.run_to_completion(max_ticks=200)
+    for rid, p, n in zip(rids, PROMPTS[:3], (7, 5, 6)):
+        solo = target.generate(tparams, jnp.asarray([p], jnp.int32), n,
+                               greedy=True)
+        assert got[rid] == [int(t) for t in np.asarray(solo)[0]], rid
