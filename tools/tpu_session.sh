@@ -3,17 +3,18 @@
 # Everything runs under tpu_guard.sh (claim hygiene: no signal ever reaches
 # a claim-holder) and writes committed artifacts:
 #   BENCH_pre.json       - bench.py --config all (the driver artifact's dry run)
-#   TPU_SMOKE_r04.log    - Mosaic smoke suite (pytest -m tpu)
-#   FUSED_PROBE_r04.json - XLA-fusion roofline numbers for the kernel decision
-#   FLASH_SWEEP_r04.json - flash block-size sweep on gpt2s (pick the winner)
-#   SPEC_BENCH_r04.json  - speculative-decode speedup (lossless check + tok/s)
-#   DECODE_INT8_r04.json - gpt_decode with the int8 KV cache (A/B vs bf16)
-#   SERVE_BENCH_r04.json - continuous-batching engine vs static batches
+#   TPU_SMOKE_${R}.log    - Mosaic smoke suite (pytest -m tpu)
+#   FUSED_PROBE_${R}.json - XLA-fusion roofline numbers for the kernel decision
+#   FLASH_SWEEP_${R}.json - flash block-size sweep on gpt2s (pick the winner)
+#   SPEC_BENCH_${R}.json  - speculative-decode speedup (lossless check + tok/s)
+#   DECODE_INT8_${R}.json - gpt_decode with the int8 KV cache (A/B vs bf16)
+#   SERVE_BENCH_${R}.json - continuous-batching engine vs static batches
 #
 # Usage: from /root/repo:  bash tools/tpu_session.sh
 set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH="/root/repo:/root/.axon_site"
+R="${PADDLE_TPU_ROUND:-r05}"
 G=tools/tpu_guard.sh
 
 echo "=== 1/7 bench (all configs)"
@@ -21,22 +22,22 @@ TPU_GUARD_LOG=/tmp/bench_all.log $G python bench.py --config all
 grep "^{" /tmp/bench_all.log | tee BENCH_pre.json
 
 echo "=== 2/7 Mosaic smoke suite"
-TPU_GUARD_LOG=TPU_SMOKE_r04.log PADDLE_TPU_TEST_TPU=1 \
+TPU_GUARD_LOG=TPU_SMOKE_${R}.log PADDLE_TPU_TEST_TPU=1 \
     $G python -m pytest -m tpu tests/test_tpu_smoke.py -q -v
-tail -5 TPU_SMOKE_r04.log
+tail -5 TPU_SMOKE_${R}.log
 
 echo "=== 3/7 fusion roofline probe"
 TPU_GUARD_LOG=/tmp/fused_probe.log $G python tools/fused_probe.py
-grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_r04.json
+grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_${R}.json
 
 echo "=== 4/7 flash block sweep (gpt2s)"
 TPU_GUARD_LOG=/tmp/flash_sweep.log $G python tools/flash_sweep.py
-grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_r04.json
+grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_${R}.json
 
 echo "=== 5/7 speculative-decode speedup"
 TPU_GUARD_LOG=/tmp/spec_bench.log $G python tools/spec_bench.py
 if grep -q "^{" /tmp/spec_bench.log; then
-    grep "^{" /tmp/spec_bench.log | tee SPEC_BENCH_r04.json
+    grep "^{" /tmp/spec_bench.log | tee SPEC_BENCH_${R}.json
 else
     echo "spec_bench FAILED (no JSON line); tail of log:" >&2
     tail -5 /tmp/spec_bench.log >&2
@@ -45,12 +46,12 @@ fi
 echo "=== 6/7 int8 KV-cache decode A/B"
 TPU_GUARD_LOG=/tmp/decode_int8.log PADDLE_TPU_DECODE_KV=int8 \
     $G python bench.py --config gpt_decode
-grep "^{" /tmp/decode_int8.log | tee DECODE_INT8_r04.json
+grep "^{" /tmp/decode_int8.log | tee DECODE_INT8_${R}.json
 
 echo "=== 7/7 continuous-batching engine throughput"
 TPU_GUARD_LOG=/tmp/serve_bench.log $G python tools/serve_bench.py --speculative
 if grep -q "^{" /tmp/serve_bench.log; then
-    grep "^{" /tmp/serve_bench.log | tee SERVE_BENCH_r04.json
+    grep "^{" /tmp/serve_bench.log | tee SERVE_BENCH_${R}.json
 else
     echo "serve_bench FAILED (no JSON line); tail of log:" >&2
     tail -5 /tmp/serve_bench.log >&2
