@@ -34,6 +34,7 @@ Greedy by default; temperature/top-k/top-p sampling share the engine key.
 from __future__ import annotations
 
 import itertools
+import logging
 import time
 from functools import partial
 from typing import Dict, List, Optional
@@ -385,7 +386,11 @@ class ContinuousBatchingEngine:
                 # cache writes are never read — see module docstring)
                 ntok = jnp.where(active, ntok, tok)
                 if track:
-                    presence = presence.at[jnp.arange(S), ntok].set(True)
+                    # bool max == set-only-where-active: an INACTIVE slot's
+                    # ntok is a stale carried token (previous occupant, or a
+                    # chunk-filling request's segment-0-reset row) — marking
+                    # it would poison the next occupant's penalty plane
+                    presence = presence.at[jnp.arange(S), ntok].max(active)
                 return (big_ck, big_cv, ntok, key, presence), ntok
 
             (big_ck, big_cv, _, _, presence), toks_out = jax.lax.scan(
@@ -522,7 +527,13 @@ class ContinuousBatchingEngine:
         hit_eos = (self.eos_token_id is not None and tok == self.eos_token_id)
         done = len(req.generated) >= req.max_new_tokens or hit_eos
         if req.on_token is not None:
-            req.on_token(req.id, tok, done)
+            try:
+                req.on_token(req.id, tok, done)
+            except Exception:  # noqa: BLE001 — a user callback must not
+                # desync host state mid-block (tokens for later slots in
+                # this sync would be silently dropped); log and continue
+                logging.getLogger(__name__).exception(
+                    "on_token callback failed for request %d", req.id)
         if done:
             self._retire(slot)
 

@@ -240,6 +240,45 @@ class TestContinuousBatching:
 
         assert run(4) == run(None)
 
+    def test_chunked_admission_into_used_slot_under_decode(
+            self, model_and_params):
+        """ADVICE r4 (high): the batched-decode presence scatter ran
+        unguarded for INACTIVE rows, so while a slot chunk-filled (its
+        presence row already reset by segment 0) every concurrent decode
+        tick re-marked the slot's stale ``_tok`` — the previous occupant's
+        last token — and the new request wrongly repetition-penalized that
+        token forever.  The triggering schedule the original fuzz missed:
+        one whole-bucket request decoding THROUGHOUT, a short request that
+        uses and frees a slot, then a chunked admission into that used
+        slot with repetition_penalty > 1."""
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=64, prompt_buckets=[4, 16],
+                                       ticks_per_sync=1, prefill_chunk=4,
+                                       repetition_penalty=5.0)
+        finished = {}
+        r0 = eng.add_request(PROMPTS[1], 30)   # bucket 4: whole prefill;
+        r1 = eng.add_request([61], 2)          # decodes the whole test
+        while True:                            # r1 occupies then frees slot
+            eng.step()
+            finished.update(eng.pop_finished())
+            if r1 in finished:
+                break
+        # chunked admission (bucket 16 > chunk 4: fills over 4 rounds with
+        # r0 decoding next door) into the slot r1 just vacated
+        r2 = eng.add_request(list(range(20, 31)), 20)
+        for _ in range(300):
+            eng.step()
+            finished.update(eng.pop_finished())
+            if not eng.pending():
+                break
+        for rid, p, n in [(r0, PROMPTS[1], 30), (r1, [61], 2),
+                          (r2, list(range(20, 31)), 20)]:
+            solo = model.generate(params, jnp.asarray([p], jnp.int32), n,
+                                  greedy=True, repetition_penalty=5.0)
+            assert finished[rid] == [int(t) for t in np.asarray(solo)[0]], \
+                f"request {rid} diverged (presence pollution)"
+
     def test_chunked_prefill_keeps_decode_flowing(self, model_and_params):
         """While a long prompt fills over several rounds, an already-active
         request must emit a token every round — the head-of-line fix this
@@ -451,3 +490,26 @@ class TestStreaming:
             dones = [d for _, d in seen[rid]]
             assert toks == got[rid]
             assert dones == [False] * (len(toks) - 1) + [True]
+
+    def test_raising_callback_does_not_desync_scheduler(self,
+                                                        model_and_params):
+        """ADVICE r4 (low): a user callback that raises must not escape
+        mid-sync-block — host state (_t/_tok, swapped caches) would desync
+        from the unprocessed tail of the token block.  The engine logs and
+        drops; outputs stay oracle-exact for every request."""
+        model, params = model_and_params
+        calls = []
+
+        def bad_cb(rid, tok, done):
+            calls.append(tok)
+            raise RuntimeError("user callback exploded")
+
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=3)
+        r0 = eng.add_request(PROMPTS[0], 7, on_token=bad_cb)
+        r1 = eng.add_request(PROMPTS[1], 4)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[r0] == _solo_greedy(model, params, PROMPTS[0], 7)
+        assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 4)
+        assert calls == got[r0]          # invoked once per token, in order
