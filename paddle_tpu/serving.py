@@ -189,7 +189,7 @@ class ContinuousBatchingEngine:
 
         self.mesh = mesh
         if mesh is None:
-            self.caches = model.init_cache(self.S, self.max_len)
+            self.caches = self._alloc_caches()
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from .distributed.spmd import build_param_specs
@@ -238,6 +238,12 @@ class ContinuousBatchingEngine:
         self._ids = itertools.count()
         self._m = {"requests": 0, "tokens": 0, "ttft_sum": 0.0,
                    "latency_sum": 0.0, "started": time.monotonic()}
+
+    def _alloc_caches(self):
+        """Cache storage seam: the contiguous engine allocates one
+        (L, S, max_len, nh, hd) row per slot; the paged subclass replaces
+        this with a block pool + tables (serving_paged.py)."""
+        return self.model.init_cache(self.S, self.max_len)
 
     # ---------------------------------------------------------- programs --
 
@@ -357,44 +363,55 @@ class ContinuousBatchingEngine:
         clocks), one host sync: returns the (k, S) token block."""
         return self._cached_prog(("decode", self._sig), self._build_decode)
 
-    def _build_decode(self):
+    def _make_decode_tick(self):
+        """One decode tick over all S slots (embed → decode_step → process →
+        sample → presence), shared by the contiguous and paged decode
+        programs so the scheduling semantics cannot drift between cache
+        layouts.  ``caches`` inside the tick is whatever layout the calling
+        program scans over (the paged program passes the gathered logical
+        view)."""
         model = self.model
-        k_ticks = self.ticks_per_sync
         sample = self._sample
-
         track = self._track
         rp, min_new, eos = self._sample_sig[4:]
         S = self.S
 
+        def tick(carry, i, params, ts, pads, active, emitted0):
+            big_ck, big_cv, tok, key, presence = carry
+            h = model._embed_one(params, tok, ts + i, pad_lens=pads)
+            h, (big_ck, big_cv) = model.decode_step(
+                params, h, (big_ck, big_cv), ts + i, pad_lens=pads)
+            key, sub = jax.random.split(key)
+            l2 = model.decode_logits(params, h)[:, -1]
+            if track:
+                l2 = apply_repetition_penalty(l2, presence, rp)
+            if min_new > 0:
+                # per-row window: each request's own emission count
+                l2 = suppress_eos(l2, eos, emitted0 + i < min_new)
+            ntok = sample(l2[:, None, :], sub)
+            # inactive slots carry their token unchanged (their stale
+            # cache writes are never read — see module docstring)
+            ntok = jnp.where(active, ntok, tok)
+            if track:
+                # bool max == set-only-where-active: an INACTIVE slot's
+                # ntok is a stale carried token (previous occupant, or a
+                # chunk-filling request's segment-0-reset row) — marking
+                # it would poison the next occupant's penalty plane
+                presence = presence.at[jnp.arange(S), ntok].max(active)
+            return (big_ck, big_cv, ntok, key, presence), ntok
+
+        return tick
+
+    def _build_decode(self):
+        k_ticks = self.ticks_per_sync
+        tick = self._make_decode_tick()
+
         @partial(jax.jit, donate_argnums=(1, 2, 8))
         def run(params, big_ck, big_cv, toks, ts, pads, active, key,
                 presence, emitted0):
-            def tick(carry, i):
-                big_ck, big_cv, tok, key, presence = carry
-                h = model._embed_one(params, tok, ts + i, pad_lens=pads)
-                h, (big_ck, big_cv) = model.decode_step(
-                    params, h, (big_ck, big_cv), ts + i, pad_lens=pads)
-                key, sub = jax.random.split(key)
-                l2 = model.decode_logits(params, h)[:, -1]
-                if track:
-                    l2 = apply_repetition_penalty(l2, presence, rp)
-                if min_new > 0:
-                    # per-row window: each request's own emission count
-                    l2 = suppress_eos(l2, eos, emitted0 + i < min_new)
-                ntok = sample(l2[:, None, :], sub)
-                # inactive slots carry their token unchanged (their stale
-                # cache writes are never read — see module docstring)
-                ntok = jnp.where(active, ntok, tok)
-                if track:
-                    # bool max == set-only-where-active: an INACTIVE slot's
-                    # ntok is a stale carried token (previous occupant, or a
-                    # chunk-filling request's segment-0-reset row) — marking
-                    # it would poison the next occupant's penalty plane
-                    presence = presence.at[jnp.arange(S), ntok].max(active)
-                return (big_ck, big_cv, ntok, key, presence), ntok
-
             (big_ck, big_cv, _, _, presence), toks_out = jax.lax.scan(
-                tick, (big_ck, big_cv, toks, key, presence),
+                lambda c, i: tick(c, i, params, ts, pads, active, emitted0),
+                (big_ck, big_cv, toks, key, presence),
                 jnp.arange(k_ticks))
             return big_ck, big_cv, toks_out, presence      # toks (k, S)
 
@@ -561,18 +578,10 @@ class ContinuousBatchingEngine:
             self._fill_segments()
         if not self._active.any():
             return
-        run = self._decode_prog_all()
-        active_before = self._active.copy()
-        emitted0 = np.asarray(
-            [len(r.generated) if r is not None else 0
-             for r in self._slot_req], np.int32)
-        ck, cv, blk, self._presence = run(
-            self.params, self.caches[0], self.caches[1],
-            jnp.asarray(self._tok), jnp.asarray(self._t),
-            jnp.asarray(self._pad), jnp.asarray(active_before),
-            self._next_key(), self._presence, jnp.asarray(emitted0))
-        self.caches = (ck, cv)
-        blk = np.asarray(blk)                      # (k, S)
+        res = self._run_decode()
+        if res is None:
+            return
+        active_before, blk = res                   # blk (k, S)
         for slot in np.flatnonzero(active_before):
             for j in range(self.ticks_per_sync):
                 if not self._active[slot]:
@@ -587,6 +596,37 @@ class ContinuousBatchingEngine:
             if self._active[slot] and \
                     int(self._t[slot]) + self.ticks_per_sync > self.max_len:
                 self._retire(int(slot))
+
+    def _prepare_decode(self) -> bool:
+        """Pre-sync hook: the paged subclass grows block tables here
+        (preempting when the pool is dry).  False = nothing left to
+        decode."""
+        return True
+
+    def _decode_extra_operands(self):
+        """Extra traced operands the decode program takes after the caches
+        (the paged subclass passes its block table)."""
+        return ()
+
+    def _run_decode(self):
+        """One ``ticks_per_sync`` decode sync over the engine's cache
+        storage; returns (active_before, (k, S) token block) or None if no
+        slot could decode."""
+        if not self._prepare_decode():
+            return None
+        run = self._decode_prog_all()
+        active_before = self._active.copy()
+        emitted0 = np.asarray(
+            [len(r.generated) if r is not None else 0
+             for r in self._slot_req], np.int32)
+        ck, cv, blk, self._presence = run(
+            self.params, self.caches[0], self.caches[1],
+            *self._decode_extra_operands(),
+            jnp.asarray(self._tok), jnp.asarray(self._t),
+            jnp.asarray(self._pad), jnp.asarray(active_before),
+            self._next_key(), self._presence, jnp.asarray(emitted0))
+        self.caches = (ck, cv)
+        return active_before, np.asarray(blk)
 
     def metrics(self) -> Dict[str, float]:
         """Serving observability (feeds the same StatRegistry the rest of
@@ -830,3 +870,17 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             if self._active[slot] and \
                     int(self._t[slot]) + self.K + 1 > self.max_len:
                 self._retire(int(slot))
+
+
+# paged (block-table) variant — defined in serving_paged.py, re-exported
+# here LAZILY (PEP 562) so `paddle_tpu.serving` is the single public
+# serving namespace without a circular import (serving_paged imports this
+# module at its top)
+__all__.append("PagedContinuousBatchingEngine")
+
+
+def __getattr__(name):
+    if name == "PagedContinuousBatchingEngine":
+        from .serving_paged import PagedContinuousBatchingEngine as cls
+        return cls
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

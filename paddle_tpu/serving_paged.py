@@ -1,0 +1,406 @@
+"""Paged (block-table) KV cache for the continuous-batching engine.
+
+The contiguous engine reserves ``max_slots × max_len`` cache positions
+regardless of actual request lengths — one long request dictates every
+slot's allocation, and ``ticks_per_sync`` strands up to k−1 positions per
+retirement (serving.py documents the waste).  This module replaces the
+per-slot rows with the vLLM/"ragged paged attention" discipline (PAPERS.md),
+re-shaped for XLA's static-shape model:
+
+- ONE physical pool of ``num_blocks`` fixed-size blocks per layer,
+  ``(L, num_blocks + 1, block_size, nh, hd)`` — block 0 is a reserved TRASH
+  block that absorbs inactive slots' parked stale writes (never read);
+- a per-slot BLOCK TABLE ``(S, max_len // block_size)`` int32 mapping
+  logical positions to pool blocks.  The table is a **traced operand**, not
+  a program constant: allocation patterns never recompile — the compiled
+  program count stays exactly the contiguous engine's bound;
+- blocks are allocated LAZILY, right before each decode sync, so persistent
+  HBM scales with tokens actually resident, admission is independent of
+  ``max_new_tokens``, and retirement frees every block immediately;
+- when the pool runs dry mid-decode the YOUNGEST request is preempted
+  (blocks freed, request requeued at the front and rerun from scratch —
+  greedy decoding regenerates the identical prefix, so outputs stay
+  oracle-exact; streaming callbacks see the replayed tokens again).
+
+Device-side the engine stays a pure serving-layer construct: programs
+GATHER each slot's logical cache view from the pool through its table row,
+run the exact same decode/prefill machinery as the contiguous engine
+(serving.py's shared tick), and SCATTER back only the span that was
+written.  v1 cost note: the gathered view is a transient
+``(L, S, max_len, nh, hd)`` buffer per sync — persistent capacity scales
+with the pool, transient peak does not; collapsing the transient needs a
+Pallas paged-attention kernel that walks the table in-kernel (the PAPERS.md
+design), which is the designated TPU hot-path follow-up.
+
+No reference counterpart: the reference snapshot serves static batches only
+(SURVEY §2.3); paged serving is beyond-reference capability.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .serving import ContinuousBatchingEngine
+from .jit.bucketing import select_bucket
+from .models._decode import seed_presence
+
+__all__ = ["PagedContinuousBatchingEngine"]
+
+
+def _gather_view(pool, table):
+    """(L, NB+1, bs, …) pool + (S, MB) table → logical (L, S, MB·bs, …)
+    view.  Rank-generic: the int8 scale plane is one rank short of the
+    value plane; both reshape by merging the (MB, bs) axes."""
+    def one(p):
+        g = p[:, table]                              # (L, S, MB, bs, …)
+        return g.reshape(g.shape[:2] + (g.shape[2] * g.shape[3],)
+                         + g.shape[4:])
+    return jax.tree.map(one, pool)
+
+
+def _scatter_span(pool, view, table, ts, k, bs):
+    """Write logical positions [ts[s], ts[s]+k) of ``view`` back into the
+    pool through ``table``.  Rows whose span maps to the trash block (id 0,
+    inactive slots at their parked clock) collide there harmlessly — trash
+    is never read."""
+    S = table.shape[0]
+    rows = jnp.arange(S)[:, None]
+    slots = ts[:, None] + jnp.arange(k)[None, :]     # (S, k) logical
+    pb = table[rows, slots // bs]                    # (S, k) physical block
+    off = slots % bs
+
+    def one(p, v):
+        chunk = v[:, rows, slots]                    # (L, S, k, …)
+        return p.at[:, pb, off].set(chunk.astype(p.dtype))
+    return jax.tree.map(one, pool, view)
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a paged KV cache (see module docstring).
+
+    ``block_size`` must divide ``max_len`` and every prompt bucket.
+    ``num_blocks`` defaults to the contiguous-equivalent pool
+    (``max_slots × max_len / block_size``); size it smaller to cap HBM —
+    the engine then admits/preempts against the real budget.
+    """
+
+    def __init__(self, model, params, max_slots: int, max_len: int,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 **kw):
+        if kw.get("mesh") is not None:
+            raise NotImplementedError(
+                "paged engine v1 is single-mesh (TP serving uses the "
+                "contiguous engine)")
+        self.bs = int(block_size)
+        if self.bs < 1:
+            raise ValueError("block_size must be >= 1")
+        if max_len % self.bs:
+            raise ValueError(f"block_size ({self.bs}) must divide "
+                             f"max_len ({max_len})")
+        self.MB = max_len // self.bs
+        self.NB = (int(num_blocks) if num_blocks is not None
+                   else int(max_slots) * self.MB)
+        if self.NB < 1:
+            raise ValueError("num_blocks must be >= 1")
+        super().__init__(model, params, max_slots, max_len, **kw)
+        bad = [b for b in self.buckets if b % self.bs]
+        if bad:
+            raise ValueError(f"block_size ({self.bs}) must divide every "
+                             f"prompt bucket; doesn't divide {bad}")
+        # block 0 is trash; real ids are 1..NB
+        self._free = list(range(self.NB, 0, -1))      # pop() -> 1, 2, …
+        self._table = np.zeros((self.S, self.MB), np.int32)
+        self._nblk = np.zeros(self.S, np.int32)       # leading real blocks
+        self._admit_seq = np.zeros(self.S, np.int64)  # preemption (LIFO)
+        self._seq = 0
+        self.blocks_high_water = 0
+        self.preemptions = 0
+
+    # ------------------------------------------------------------ storage --
+
+    def _alloc_caches(self):
+        c = self.model.config
+        nh = c.num_attention_heads
+        hd = c.hidden_size // nh
+        shape = (c.num_layers, self.NB + 1, self.bs, nh, hd)
+        if getattr(c, "kv_cache_dtype", None) == "int8":
+            def one():
+                return (jnp.zeros(shape, jnp.int8),
+                        jnp.zeros(shape[:-1], jnp.float32))
+            return one(), one()
+        dt = jnp.dtype(c.compute_dtype)
+        return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+    @property
+    def _sig(self):
+        return (ContinuousBatchingEngine._sig.fget(self)
+                + ("paged", self.bs, self.NB))
+
+    # --------------------------------------------------------- allocator --
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.NB - len(self._free)
+
+    def _ensure_blocks(self, slot: int, upto: int) -> bool:
+        """Grow the slot's table to cover logical positions [0, upto).
+        TRANSACTIONAL: on a dry pool nothing is taken — partial growth on
+        a slot that then isn't admitted would strand blocks outside every
+        tracked set (not active, not filling, not free) and livelock the
+        preemption loop."""
+        need = -(-int(upto) // self.bs)
+        have = int(self._nblk[slot])
+        if need > have and need - have > len(self._free):
+            return False
+        for i in range(have, need):
+            self._table[slot, i] = self._free.pop()
+        self._nblk[slot] = max(have, need)
+        self.blocks_high_water = max(self.blocks_high_water,
+                                     self.blocks_in_use)
+        return True
+
+    def _free_slot_blocks(self, slot: int):
+        n = int(self._nblk[slot])
+        self._free.extend(int(b) for b in self._table[slot, :n][::-1])
+        self._table[slot] = 0
+        self._nblk[slot] = 0
+
+    def _retire(self, slot: int):
+        super()._retire(slot)
+        self._free_slot_blocks(slot)
+
+    def _preempt_one(self) -> bool:
+        """Evict the YOUNGEST in-flight request (active or still filling),
+        free its blocks, and requeue it at the front for a from-scratch
+        rerun.  Greedy decoding regenerates the identical prefix, so the
+        exactness contract holds; sampled runs redraw from the engine key."""
+        cands = [(int(self._admit_seq[s]), s)
+                 for s in np.flatnonzero(self._active)]
+        cands += [(int(self._admit_seq[s]), s) for s in self._filling]
+        if not cands:
+            return False
+        _, victim = max(cands)
+        if victim in self._filling:
+            req = self._filling.pop(victim)["req"]
+        else:
+            req = self._slot_req[victim]
+            self._slot_req[victim] = None
+            self._active[victim] = False
+        req.generated = []
+        req.first_token_at = None
+        self._queue.insert(0, req)
+        self._free_slot_blocks(victim)
+        self.preemptions += 1
+        return True
+
+    # ---------------------------------------------------------- programs --
+
+    def _build_prefill(self, P: int):
+        model = self.model
+        track = self._track
+        V = model.config.vocab_size
+        tail = self._first_token_tail()
+        bs = self.bs
+        nblk = P // bs
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7))
+        def run(params, pool_ck, pool_cv, ids, pad_len, blkrow, key,
+                presence, slot):
+            h, (ck, cv) = model.prefill(params, ids, P,
+                                        pad_lens=pad_len[None])
+
+            def put(pool, new):                      # new: (L, 1, P, …)
+                r = new.reshape((new.shape[0], nblk, bs) + new.shape[3:])
+                return pool.at[:, blkrow].set(r.astype(pool.dtype))
+
+            pool_ck = jax.tree.map(put, pool_ck, ck)
+            pool_cv = jax.tree.map(put, pool_cv, cv)
+            if track:
+                row = seed_presence(ids, V, pad_len[None])
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row, (slot, 0))
+            tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return pool_ck, pool_cv, tok, presence
+
+        return run
+
+    def _build_seg(self, seg: int, first: bool, last: bool):
+        model = self.model
+        track = self._track
+        V = model.config.vocab_size
+        tail = self._first_token_tail()
+        bs = self.bs
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7))
+        def run(params, pool_ck, pool_cv, toks, t0, pad, slot, presence,
+                key, tabrow):
+            def take(p):                             # one slot's view
+                g = p[:, tabrow]                     # (L, MB, bs, …)
+                g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
+                              + g.shape[3:])
+                return g[:, None]                    # (L, 1, T, …)
+            ck_s = jax.tree.map(take, pool_ck)
+            cv_s = jax.tree.map(take, pool_cv)
+            h = model._embed_chunk(params, toks[0], t0, pad_lens=pad[None])
+            h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s), t0,
+                                                pad_lens=pad[None])
+
+            span = t0 + jnp.arange(seg)              # logical positions
+            pb = tabrow[span // bs]
+            off = span % bs
+
+            def put(pool, v):                        # v: (L, 1, T, …)
+                chunk = v[:, 0, span]                # (L, seg, …)
+                return pool.at[:, pb, off].set(chunk.astype(pool.dtype))
+            pool_ck = jax.tree.map(put, pool_ck, ck_s)
+            pool_cv = jax.tree.map(put, pool_cv, cv_s)
+
+            if track:
+                if first:
+                    presence = jax.lax.dynamic_update_slice(
+                        presence, jnp.zeros((1, V), bool), (slot, 0))
+                valid = t0 + jnp.arange(seg) >= pad
+                row = presence[slot].at[toks[0]].max(valid)
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row[None], (slot, 0))
+            tok = jnp.int32(0)
+            if last:
+                tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return pool_ck, pool_cv, tok, presence
+
+        return run
+
+    def _build_decode(self):
+        k_ticks = self.ticks_per_sync
+        tick = self._make_decode_tick()
+        bs = self.bs
+
+        @partial(jax.jit, donate_argnums=(1, 2, 9))
+        def run(params, pool_ck, pool_cv, table, toks, ts, pads, active,
+                key, presence, emitted0):
+            view_ck = _gather_view(pool_ck, table)
+            view_cv = _gather_view(pool_cv, table)
+            (view_ck, view_cv, _, _, presence), toks_out = jax.lax.scan(
+                lambda c, i: tick(c, i, params, ts, pads, active, emitted0),
+                (view_ck, view_cv, toks, key, presence),
+                jnp.arange(k_ticks))
+            pool_ck = _scatter_span(pool_ck, view_ck, table, ts, k_ticks, bs)
+            pool_cv = _scatter_span(pool_cv, view_cv, table, ts, k_ticks, bs)
+            return pool_ck, pool_cv, toks_out, presence
+
+        return run
+
+    # --------------------------------------------------------- scheduling --
+
+    def add_request(self, prompt, max_new_tokens: int, on_token=None) -> int:
+        prompt_l = [int(t) for t in prompt]
+        if prompt_l:
+            P = select_bucket(len(prompt_l), self.buckets)
+            worst = -(-self._positions_needed(P, int(max_new_tokens))
+                      // self.bs)
+            if worst > self.NB:
+                raise ValueError(
+                    f"request needs up to {worst} blocks; the pool has "
+                    f"{self.NB} — raise num_blocks or lower "
+                    f"max_new_tokens")
+        return super().add_request(prompt_l, max_new_tokens,
+                                   on_token=on_token)
+
+    def _admit(self):
+        free = self._free_slots()
+        while self._queue and free:
+            slot = free[0]
+            req = self._queue[0]
+            P = select_bucket(len(req.prompt), self.buckets)
+            pad = P - len(req.prompt)
+            ids = [0] * pad + req.prompt
+            chunked = (self.prefill_chunk is not None
+                       and P > self.prefill_chunk)
+            # whole-bucket admission needs its P/bs blocks NOW; chunked
+            # admission grows per segment.  A dry pool defers admission
+            # (FIFO preserved) — decoding slots retire and free blocks.
+            if not chunked and not self._ensure_blocks(slot, P):
+                break
+            free.pop(0)
+            self._queue.pop(0)
+            self._seq += 1
+            self._admit_seq[slot] = self._seq
+            if chunked:
+                # same clock-parking discipline as the contiguous engine;
+                # the parked strip's table entry stays at trash (0) while
+                # the slot fills, so stale decode writes land in trash
+                self._t[slot] = self.max_len - self.ticks_per_sync
+                self._filling[slot] = {"req": req, "ids": ids, "pad": pad,
+                                       "P": P, "seg": 0,
+                                       "nseg": P // self.prefill_chunk}
+                continue
+            run = self._prefill_prog(P)
+            blkrow = jnp.asarray(self._table[slot, :P // self.bs])
+            ck, cv, tok0, self._presence = run(
+                self.params, self.caches[0], self.caches[1],
+                jnp.asarray([ids], jnp.int32), jnp.int32(pad), blkrow,
+                self._next_key(), self._presence, jnp.int32(slot))
+            self.caches = (ck, cv)
+            self._activate(slot, req, P, pad, int(tok0))
+
+    def _fill_segments(self):
+        seg = self.prefill_chunk
+        for slot, st in list(self._filling.items()):
+            if slot not in self._filling:      # preempted below mid-loop
+                continue
+            i, first = st["seg"], st["seg"] == 0
+            last = i == st["nseg"] - 1
+            if not self._ensure_blocks(slot, (i + 1) * seg):
+                # pool dry: normally this prompt just stalls while decode
+                # flows and retirements free blocks — but with NO active
+                # decoder nothing will ever free them (fillers jointly
+                # wedged); evict the youngest in-flight request so the
+                # oldest filler is guaranteed to make progress
+                if not self._active.any():
+                    self._preempt_one()
+                continue
+            toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
+            run = self._seg_prog(seg, first, last)
+            ck, cv, tok0, self._presence = run(
+                self.params, self.caches[0], self.caches[1], toks,
+                jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
+                self._presence, self._next_key(),
+                jnp.asarray(self._table[slot]))
+            self.caches = (ck, cv)
+            if last:
+                del self._filling[slot]
+                self._activate(slot, st["req"], st["P"], st["pad"],
+                               int(tok0))
+            else:
+                st["seg"] += 1
+
+    def _prepare_decode(self) -> bool:
+        k = self.ticks_per_sync
+        # grow each active slot's table to cover this sync's [t, t+k) span,
+        # OLDEST first (preemption victims are youngest-first, so the FIFO
+        # head always makes progress — no livelock)
+        order = sorted(np.flatnonzero(self._active),
+                       key=lambda s: int(self._admit_seq[s]))
+        for slot in order:
+            while (self._active[slot]
+                   and not self._ensure_blocks(int(slot),
+                                               int(self._t[slot]) + k)):
+                if not self._preempt_one():
+                    raise RuntimeError(
+                        "block pool exhausted with nothing to preempt")
+        return bool(self._active.any())
+
+    def _decode_extra_operands(self):
+        return (jnp.asarray(self._table),)
+
+    def metrics(self):
+        m = super().metrics()
+        m["blocks_in_use"] = float(self.blocks_in_use)
+        m["blocks_high_water"] = float(self.blocks_high_water)
+        m["preemptions"] = float(self.preemptions)
+        return m
