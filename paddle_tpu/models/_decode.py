@@ -115,7 +115,11 @@ def apply_repetition_penalty(logits32, presence, penalty):
     semantics: for every token already seen in the row (prompt + generated,
     tracked in the (B, V) ``presence`` mask), positive logits divide by the
     penalty and negative logits multiply — both push the token down for
-    penalty > 1."""
+    penalty > 1.  ``penalty`` may be a scalar or a per-row (B,) vector
+    (the serving engine's per-request planes); 1.0 is an exact no-op."""
+    penalty = jnp.asarray(penalty)
+    if penalty.ndim == 1:
+        penalty = penalty[:, None]
     pen = jnp.where(logits32 > 0, logits32 / penalty, logits32 * penalty)
     return jnp.where(presence, pen, logits32)
 
@@ -141,6 +145,53 @@ def suppress_eos(logits32, eos_token_id, suppress):
     if sup.ndim == 0:
         sup = sup[None]
     return jnp.where(sup[:, None] & col[None, :], -jnp.inf, logits32)
+
+
+def filter_logits_rows(logits32, temperature, top_k, top_p):
+    """``filter_logits`` with PER-ROW parameters as traced data — the
+    serving engine's per-request sampling planes (one compiled program for
+    any mix of configs; row params are operands, not constants).
+
+    (B, V) fp32 logits; temperature/top_p (B,) fp32, top_k (B,) int32.
+    Disabled encodings are exact no-ops: top_k <= 0 or > V keeps every
+    token; top_p >= 2.0 is the None encoding (cdf < 2 always holds, so the
+    cut sits at the global minimum and nothing is masked)."""
+    l = logits32 / jnp.maximum(temperature, 1e-6)[:, None]
+    V = l.shape[-1]
+    srt = jnp.flip(jnp.sort(l, -1), -1)
+    k = jnp.where((top_k <= 0) | (top_k > V), V, top_k)
+    kth = jnp.take_along_axis(srt, (k - 1)[:, None], -1)
+    l = jnp.where(l < kth, -jnp.inf, l)
+    # nucleus on the (possibly top-k-masked) logits, same order as
+    # filter_logits: keep the smallest sorted prefix with cdf >= top_p.
+    # No second sort needed — masking only floors values strictly below
+    # kth to -inf, which preserves srt's descending order
+    srt2 = jnp.where(srt < kth, -jnp.inf, srt)
+    cdf = jnp.cumsum(jax.nn.softmax(srt2, -1), -1)
+    n_keep = jnp.sum(cdf < top_p[:, None], -1) + 1
+    kth2 = jnp.take_along_axis(srt2, (jnp.minimum(n_keep, V) - 1)[:, None],
+                               -1)
+    return jnp.where(l < kth2, -jnp.inf, l)
+
+
+def make_row_sampler():
+    """Per-row sampler over the per-request planes: greedy rows argmax,
+    sampling rows draw categorically from the row-filtered logits —
+    one program serves any mixture."""
+    def sample(logits32, key, temperature, top_k, top_p, greedy):
+        l = filter_logits_rows(logits32[:, -1, :], temperature, top_k,
+                               top_p)
+        return jnp.where(greedy, jnp.argmax(l, -1),
+                         jax.random.categorical(key, l, -1)
+                         ).astype(jnp.int32)
+    return sample
+
+
+def suppress_eos_rows(logits32, eos_ids, suppress):
+    """Per-row EOS suppression for per-request windows: ``eos_ids`` (B,)
+    int32 with -1 = this row has no EOS; ``suppress`` (B,) bool."""
+    col = jnp.arange(logits32.shape[-1])[None, :] == eos_ids[:, None]
+    return jnp.where(col & suppress[:, None], -jnp.inf, logits32)
 
 
 def make_token_sampler(temperature, top_k, top_p, greedy):

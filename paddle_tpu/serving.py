@@ -45,8 +45,9 @@ import numpy as np
 
 from .jit.bucketing import select_bucket
 from .utils.stats import stat_add
-from .models._decode import (apply_repetition_penalty, make_token_sampler,
-                             seed_presence, suppress_eos,
+from .models._decode import (apply_repetition_penalty, make_row_sampler,
+                             make_token_sampler, seed_presence,
+                             suppress_eos, suppress_eos_rows,
                              validate_sampler_args)
 
 __all__ = ["ContinuousBatchingEngine", "SpeculativeBatchingEngine",
@@ -98,7 +99,8 @@ class ContinuousBatchingEngine:
                  greedy: bool = True, eos_token_id: Optional[int] = None,
                  key=None, ticks_per_sync: int = 1, mesh=None,
                  repetition_penalty: float = 1.0, min_new_tokens: int = 0,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 per_request_sampling: bool = False):
         """``ticks_per_sync``: decode ticks fused into one device program
         between host synchronizations.  1 = retire/admit after every token
         (lowest latency); k > 1 amortizes the host round-trip over k tokens
@@ -184,6 +186,30 @@ class ContinuousBatchingEngine:
                             self.repetition_penalty, self.min_new_tokens,
                             eos_token_id if self.min_new_tokens > 0 else None)
         self._sample = make_token_sampler(*self._sample_sig[:4])
+        self.per_request = bool(per_request_sampling)
+        if self.per_request:
+            # sampler config becomes per-slot DATA (S-row planes, traced
+            # operands): the ctor args are the defaults a request may
+            # override per call — matching generate()'s per-call contract —
+            # and the compiled program count stays mode-wide, not
+            # config-wide.  Presence tracking is always on (any request
+            # may carry a penalty).
+            self._track = True
+            self._row_sample = make_row_sampler()
+            self._plane_defaults = (
+                float(temperature),
+                0 if top_k is None else int(top_k),
+                2.0 if top_p is None else float(top_p),
+                bool(greedy), self.repetition_penalty,
+                self.min_new_tokens,
+                -1 if eos_token_id is None else int(eos_token_id))
+            self._r_temp = np.ones(self.S, np.float32)
+            self._r_topk = np.zeros(self.S, np.int32)
+            self._r_topp = np.full(self.S, 2.0, np.float32)
+            self._r_greedy = np.ones(self.S, bool)
+            self._r_rp = np.ones(self.S, np.float32)
+            self._r_minnew = np.zeros(self.S, np.int32)
+            self._r_eos = np.full(self.S, -1, np.int32)
         self._presence = (jnp.zeros((self.S, c.vocab_size), bool)
                           if self._track else None)
 
@@ -252,8 +278,22 @@ class ContinuousBatchingEngine:
         """Program-cache signature: engines with identical shapes and
         sampler config share compiled programs via the MODEL (the
         _gen_program pattern) — constructing a fresh engine per request
-        wave must not recompile."""
-        return (self.S, self.max_len, self.ticks_per_sync, self._sample_sig)
+        wave must not recompile.  In per-request mode the sampler config is
+        DATA (planes), so the signature carries only the mode marker —
+        engines with different defaults share programs."""
+        samp = ("perreq",) if self.per_request else self._sample_sig
+        return (self.S, self.max_len, self.ticks_per_sync, samp)
+
+    def _plane_operands(self):
+        """The per-slot sampling planes as one traced operand (empty tuple
+        in classic mode — a pytree with no leaves, so program signatures
+        stay uniform across modes)."""
+        if not self.per_request:
+            return ()
+        return (jnp.asarray(self._r_temp), jnp.asarray(self._r_topk),
+                jnp.asarray(self._r_topp), jnp.asarray(self._r_greedy),
+                jnp.asarray(self._r_rp), jnp.asarray(self._r_minnew),
+                jnp.asarray(self._r_eos))
 
     def _cached_prog(self, cache_key, build):
         """Model-level compiled-program cache (see _sig)."""
@@ -271,8 +311,25 @@ class ContinuousBatchingEngine:
         track = self._track
         rp, min_new, eos = self._sample_sig[4:]
         model = self.model
+        if self.per_request:
+            row_sample = self._row_sample
 
-        def tail(params, h_last, presence, slot, key):
+            def tail(params, h_last, presence, slot, key, planes=()):
+                temp, topk, topp, greedy, rpv, mnv, eosv = planes
+                l2 = model.decode_logits(params, h_last)[:, -1]
+                l2 = apply_repetition_penalty(l2, presence[slot][None],
+                                              rpv[slot][None])
+                # first token: emitted count is 0, window open iff mn > 0
+                l2 = suppress_eos_rows(l2, eosv[slot][None],
+                                       (mnv[slot] > 0)[None])
+                tok = row_sample(l2[:, None, :], key, temp[slot][None],
+                                 topk[slot][None], topp[slot][None],
+                                 greedy[slot][None])[0]
+                presence = presence.at[slot, tok].set(True)
+                return tok, presence
+            return tail
+
+        def tail(params, h_last, presence, slot, key, planes=()):
             l2 = model.decode_logits(params, h_last)[:, -1]
             if track:
                 l2 = apply_repetition_penalty(l2, presence[slot][None], rp)
@@ -297,7 +354,8 @@ class ContinuousBatchingEngine:
         tail = self._first_token_tail()
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
-        def run(params, big_ck, big_cv, ids, pad_len, slot, key, presence):
+        def run(params, big_ck, big_cv, ids, pad_len, slot, key, presence,
+                planes):
             h, (ck, cv) = model.prefill(params, ids, P,
                                         pad_lens=pad_len[None])
 
@@ -309,7 +367,8 @@ class ContinuousBatchingEngine:
                 row = seed_presence(ids, V, pad_len[None])
                 presence = jax.lax.dynamic_update_slice(
                     presence, row, (slot, 0))
-            tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            tok, presence = tail(params, h[:, -1:], presence, slot, key,
+                                 planes)
             return big_ck, big_cv, tok, presence
 
         return run
@@ -332,7 +391,8 @@ class ContinuousBatchingEngine:
         tail = self._first_token_tail()
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
-        def run(params, big_ck, big_cv, toks, t0, pad, slot, presence, key):
+        def run(params, big_ck, big_cv, toks, t0, pad, slot, presence, key,
+                planes):
             take = lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1, axis=1)
             ck_s = jax.tree.map(take, big_ck)
             cv_s = jax.tree.map(take, big_cv)
@@ -353,7 +413,8 @@ class ContinuousBatchingEngine:
                     presence, row[None], (slot, 0))
             tok = jnp.int32(0)
             if last:
-                tok, presence = tail(params, h[:, -1:], presence, slot, key)
+                tok, presence = tail(params, h[:, -1:], presence, slot, key,
+                                     planes)
             return big_ck, big_cv, tok, presence
 
         return run
@@ -375,20 +436,29 @@ class ContinuousBatchingEngine:
         track = self._track
         rp, min_new, eos = self._sample_sig[4:]
         S = self.S
+        per_request = self.per_request
+        row_sample = self._row_sample if per_request else None
 
-        def tick(carry, i, params, ts, pads, active, emitted0):
+        def tick(carry, i, params, ts, pads, active, emitted0, planes=()):
             big_ck, big_cv, tok, key, presence = carry
             h = model._embed_one(params, tok, ts + i, pad_lens=pads)
             h, (big_ck, big_cv) = model.decode_step(
                 params, h, (big_ck, big_cv), ts + i, pad_lens=pads)
             key, sub = jax.random.split(key)
             l2 = model.decode_logits(params, h)[:, -1]
-            if track:
-                l2 = apply_repetition_penalty(l2, presence, rp)
-            if min_new > 0:
-                # per-row window: each request's own emission count
-                l2 = suppress_eos(l2, eos, emitted0 + i < min_new)
-            ntok = sample(l2[:, None, :], sub)
+            if per_request:
+                temp, topk, topp, greedy, rpv, mnv, eosv = planes
+                l2 = apply_repetition_penalty(l2, presence, rpv)
+                l2 = suppress_eos_rows(l2, eosv, emitted0 + i < mnv)
+                ntok = row_sample(l2[:, None, :], sub, temp, topk, topp,
+                                  greedy)
+            else:
+                if track:
+                    l2 = apply_repetition_penalty(l2, presence, rp)
+                if min_new > 0:
+                    # per-row window: each request's own emission count
+                    l2 = suppress_eos(l2, eos, emitted0 + i < min_new)
+                ntok = sample(l2[:, None, :], sub)
             # inactive slots carry their token unchanged (their stale
             # cache writes are never read — see module docstring)
             ntok = jnp.where(active, ntok, tok)
@@ -408,9 +478,10 @@ class ContinuousBatchingEngine:
 
         @partial(jax.jit, donate_argnums=(1, 2, 8))
         def run(params, big_ck, big_cv, toks, ts, pads, active, key,
-                presence, emitted0):
+                presence, emitted0, planes):
             (big_ck, big_cv, _, _, presence), toks_out = jax.lax.scan(
-                lambda c, i: tick(c, i, params, ts, pads, active, emitted0),
+                lambda c, i: tick(c, i, params, ts, pads, active, emitted0,
+                                  planes),
                 (big_ck, big_cv, toks, key, presence),
                 jnp.arange(k_ticks))
             return big_ck, big_cv, toks_out, presence      # toks (k, S)
@@ -420,14 +491,21 @@ class ContinuousBatchingEngine:
     # --------------------------------------------------------- scheduling --
 
     def add_request(self, prompt, max_new_tokens: int,
-                    on_token=None) -> int:
+                    on_token=None, **sampling) -> int:
         """Queue a prompt; returns the request id.  Admission happens inside
         ``step()`` whenever a slot is free.
 
         ``on_token(request_id, token, done)``: optional streaming callback,
         invoked on the host as each token is accepted (chunked/speculative
         modes deliver a burst per sync — ordering within a request is
-        guaranteed, across requests it follows slot order)."""
+        guaranteed, across requests it follows slot order).
+
+        With ``per_request_sampling=True`` the engine accepts the
+        generate()-style per-call knobs here — ``temperature``, ``top_k``,
+        ``top_p``, ``greedy``, ``repetition_penalty``, ``min_new_tokens``,
+        ``eos_token_id`` — each defaulting to the engine's constructor
+        value.  The configs ride per-slot data planes: any mixture shares
+        ONE compiled decode program."""
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -444,9 +522,61 @@ class ContinuousBatchingEngine:
                 f"{need} cache positions for max_new_tokens="
                 f"{max_new_tokens}; exceeds max_len ({self.max_len})")
         req = Request(next(self._ids), prompt, max_new_tokens)
+        req.sampling = self._resolve_sampling(sampling)
         req.on_token = on_token
         self._queue.append(req)
         return req.id
+
+    _SAMPLING_KEYS = ("temperature", "top_k", "top_p", "greedy",
+                      "repetition_penalty", "min_new_tokens",
+                      "eos_token_id")
+
+    def _resolve_sampling(self, overrides):
+        """Merge per-request overrides onto the engine defaults and
+        validate; returns the plane-encoded tuple (or None in classic
+        mode, where any override is an error)."""
+        unknown = set(overrides) - set(self._SAMPLING_KEYS)
+        if unknown:
+            raise TypeError(f"unknown add_request kwargs: {sorted(unknown)}")
+        given = {k: v for k, v in overrides.items() if v is not None}
+        if not self.per_request:
+            if given:
+                raise ValueError(
+                    f"per-request sampling params {sorted(given)} need "
+                    f"per_request_sampling=True")
+            return None
+        V = self.model.config.vocab_size
+        t, k, p, g, rp, mn, eos = self._plane_defaults
+        if "temperature" in given:
+            t = float(given["temperature"])
+            if t <= 0:
+                raise ValueError("temperature must be > 0 (use greedy=True "
+                                 "for deterministic decoding)")
+        if "top_k" in given:
+            k = int(given["top_k"])
+            validate_sampler_args(V, k, None, True, None)
+        if "top_p" in given:
+            p = float(given["top_p"])
+            validate_sampler_args(V, None, p, True, None)
+        if "greedy" in given:
+            g = bool(given["greedy"])
+        if "repetition_penalty" in given:
+            rp = float(given["repetition_penalty"])
+            if rp <= 0:
+                raise ValueError("repetition_penalty must be > 0")
+        if "min_new_tokens" in given:
+            mn = int(given["min_new_tokens"])
+            if mn < 0:
+                raise ValueError("min_new_tokens must be >= 0")
+        if "eos_token_id" in given:
+            eos = int(given["eos_token_id"])
+            if not 0 <= eos < V:
+                raise ValueError(f"eos_token_id {eos} outside vocab "
+                                 f"(size {V})")
+        if mn > 0 and eos < 0:
+            raise ValueError("min_new_tokens needs an eos_token_id "
+                             "(engine default or per-request)")
+        return (t, k, p, g, rp, mn, eos)
 
     def _positions_needed(self, P: int, mnt: int) -> int:
         """Worst-case cache positions a request occupies — the bucket plus
@@ -494,18 +624,37 @@ class ContinuousBatchingEngine:
                 # already-filled prompt positions.  The parking strip is
                 # overwritten by the occupant's own decode before it can
                 # ever be read (write-before-read induction).
+                self._set_planes(slot, req)
                 self._t[slot] = self.max_len - self.ticks_per_sync
                 self._filling[slot] = {"req": req, "ids": ids, "pad": pad,
                                        "P": P, "seg": 0,
                                        "nseg": P // self.prefill_chunk}
                 continue
+            self._set_planes(slot, req)
             run = self._prefill_prog(P)
             ck, cv, tok0, self._presence = run(
                 self.params, self.caches[0], self.caches[1],
                 jnp.asarray([ids], jnp.int32), jnp.int32(pad),
-                jnp.int32(slot), self._next_key(), self._presence)
+                jnp.int32(slot), self._next_key(), self._presence,
+                self._plane_operands())
             self.caches = (ck, cv)
             self._activate(slot, req, P, pad, int(tok0))
+
+    def _set_planes(self, slot, req):
+        """Write the request's effective sampler config into the slot's
+        row of the per-request planes (no-op in classic mode).  Must run
+        BEFORE the admission prefill — the first token samples through the
+        planes."""
+        if not self.per_request:
+            return
+        t, k, p, g, rp, mn, eos = req.sampling
+        self._r_temp[slot] = t
+        self._r_topk[slot] = k
+        self._r_topp[slot] = p
+        self._r_greedy[slot] = g
+        self._r_rp[slot] = rp
+        self._r_minnew[slot] = mn
+        self._r_eos[slot] = eos
 
     def _activate(self, slot, req, P, pad, tok0):
         req.first_token_at = time.monotonic()   # tok0 exists: TTFT point
@@ -528,7 +677,7 @@ class ContinuousBatchingEngine:
             ck, cv, tok0, self._presence = run(
                 self.params, self.caches[0], self.caches[1], toks,
                 jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
-                self._presence, self._next_key())
+                self._presence, self._next_key(), self._plane_operands())
             self.caches = (ck, cv)
             if last:
                 del self._filling[slot]
@@ -541,7 +690,8 @@ class ContinuousBatchingEngine:
         """Append a token to the slot's request; retire on EOS/budget."""
         req = self._slot_req[slot]
         req.generated.append(tok)
-        hit_eos = (self.eos_token_id is not None and tok == self.eos_token_id)
+        eos = (req.sampling[6] if self.per_request else self.eos_token_id)
+        hit_eos = (eos is not None and eos >= 0 and tok == eos)
         done = len(req.generated) >= req.max_new_tokens or hit_eos
         if req.on_token is not None:
             try:
@@ -624,7 +774,8 @@ class ContinuousBatchingEngine:
             *self._decode_extra_operands(),
             jnp.asarray(self._tok), jnp.asarray(self._t),
             jnp.asarray(self._pad), jnp.asarray(active_before),
-            self._next_key(), self._presence, jnp.asarray(emitted0))
+            self._next_key(), self._presence, jnp.asarray(emitted0),
+            self._plane_operands())
         self.caches = (ck, cv)
         return active_before, np.asarray(blk)
 

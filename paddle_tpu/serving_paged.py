@@ -210,7 +210,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
         def run(params, pool_ck, pool_cv, ids, pad_len, blkrow, key,
-                presence, slot):
+                presence, slot, planes):
             h, (ck, cv) = model.prefill(params, ids, P,
                                         pad_lens=pad_len[None])
 
@@ -224,7 +224,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 row = seed_presence(ids, V, pad_len[None])
                 presence = jax.lax.dynamic_update_slice(
                     presence, row, (slot, 0))
-            tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            tok, presence = tail(params, h[:, -1:], presence, slot, key,
+                                 planes)
             return pool_ck, pool_cv, tok, presence
 
         return run
@@ -238,7 +239,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
         def run(params, pool_ck, pool_cv, toks, t0, pad, slot, presence,
-                key, tabrow):
+                key, tabrow, planes):
             def take(p):                             # one slot's view
                 g = p[:, tabrow]                     # (L, MB, bs, …)
                 g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
@@ -270,7 +271,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     presence, row[None], (slot, 0))
             tok = jnp.int32(0)
             if last:
-                tok, presence = tail(params, h[:, -1:], presence, slot, key)
+                tok, presence = tail(params, h[:, -1:], presence, slot, key,
+                                 planes)
             return pool_ck, pool_cv, tok, presence
 
         return run
@@ -282,11 +284,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         @partial(jax.jit, donate_argnums=(1, 2, 9))
         def run(params, pool_ck, pool_cv, table, toks, ts, pads, active,
-                key, presence, emitted0):
+                key, presence, emitted0, planes):
             view_ck = _gather_view(pool_ck, table)
             view_cv = _gather_view(pool_cv, table)
             (view_ck, view_cv, _, _, presence), toks_out = jax.lax.scan(
-                lambda c, i: tick(c, i, params, ts, pads, active, emitted0),
+                lambda c, i: tick(c, i, params, ts, pads, active, emitted0,
+                                  planes),
                 (view_ck, view_cv, toks, key, presence),
                 jnp.arange(k_ticks))
             pool_ck = _scatter_span(pool_ck, view_ck, table, ts, k_ticks, bs)
@@ -297,7 +300,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     # --------------------------------------------------------- scheduling --
 
-    def add_request(self, prompt, max_new_tokens: int, on_token=None) -> int:
+    def add_request(self, prompt, max_new_tokens: int, on_token=None,
+                    **sampling) -> int:
         prompt_l = [int(t) for t in prompt]
         if prompt_l:
             P = select_bucket(len(prompt_l), self.buckets)
@@ -309,7 +313,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     f"{self.NB} — raise num_blocks or lower "
                     f"max_new_tokens")
         return super().add_request(prompt_l, max_new_tokens,
-                                   on_token=on_token)
+                                   on_token=on_token, **sampling)
 
     def _admit(self):
         free = self._free_slots()
@@ -330,6 +334,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self._queue.pop(0)
             self._seq += 1
             self._admit_seq[slot] = self._seq
+            self._set_planes(slot, req)
             if chunked:
                 # same clock-parking discipline as the contiguous engine;
                 # the parked strip's table entry stays at trash (0) while
@@ -344,7 +349,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             ck, cv, tok0, self._presence = run(
                 self.params, self.caches[0], self.caches[1],
                 jnp.asarray([ids], jnp.int32), jnp.int32(pad), blkrow,
-                self._next_key(), self._presence, jnp.int32(slot))
+                self._next_key(), self._presence, jnp.int32(slot),
+                self._plane_operands())
             self.caches = (ck, cv)
             self._activate(slot, req, P, pad, int(tok0))
 
@@ -370,7 +376,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self.params, self.caches[0], self.caches[1], toks,
                 jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
                 self._presence, self._next_key(),
-                jnp.asarray(self._table[slot]))
+                jnp.asarray(self._table[slot]), self._plane_operands())
             self.caches = (ck, cv)
             if last:
                 del self._filling[slot]
