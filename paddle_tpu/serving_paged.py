@@ -38,6 +38,7 @@ No reference counterpart: the reference snapshot serves static batches only
 
 from __future__ import annotations
 
+import collections
 from functools import partial
 from typing import Optional
 
@@ -91,11 +92,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model, params, max_slots: int, max_len: int,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 **kw):
+                 enable_prefix_cache: bool = False, **kw):
         if kw.get("mesh") is not None:
             raise NotImplementedError(
                 "paged engine v1 is single-mesh (TP serving uses the "
                 "contiguous engine)")
+        self.prefix_caching = bool(enable_prefix_cache)
         self.bs = int(block_size)
         if self.bs < 1:
             raise ValueError("block_size must be >= 1")
@@ -120,6 +122,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._seq = 0
         self.blocks_high_water = 0
         self.preemptions = 0
+        # prefix cache: a block is free / referenced (refs > 0) / CACHED
+        # (refs == 0 but registered under its content chain — evictable).
+        # Chain key = (pad, padded prompt tokens through this block): the
+        # pad length shifts logical positions, so identical token blocks at
+        # different pads have different k/v and must not collide.
+        self._refs = {}                               # bid -> refcount
+        self._prefix_cache = collections.OrderedDict()  # chain -> bid (LRU)
+        self._key_of = {}                             # bid -> chain
+        self.prefix_hits = 0
+        self.prefix_blocks_reused = 0
 
     # ------------------------------------------------------------ storage --
 
@@ -147,18 +159,48 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def blocks_in_use(self) -> int:
         return self.NB - len(self._free)
 
+    def _alloc_blocks(self, n: int):
+        """Take ``n`` fresh blocks (refs = 1 each) from the free list,
+        evicting least-recently-used UNREFERENCED cached blocks as needed.
+        TRANSACTIONAL: returns None (nothing taken) when free + evictable
+        can't cover ``n`` — partial growth on a slot that then isn't
+        admitted would strand blocks outside every tracked set and
+        livelock the preemption loop."""
+        if n <= 0:
+            return []
+        evictable = [c for c, b in self._prefix_cache.items()
+                     if self._refs.get(b, 0) == 0]
+        if n > len(self._free) + len(evictable):
+            return None
+        out = []
+        ev = iter(evictable)                      # LRU-first (OrderedDict)
+        while len(out) < n:
+            if self._free:
+                out.append(self._free.pop())
+            else:
+                chain = next(ev)
+                bid = self._prefix_cache.pop(chain)
+                del self._key_of[bid]
+                out.append(bid)
+        for bid in out:
+            self._refs[bid] = 1
+        return out
+
+    def _release(self, bid: int):
+        self._refs[bid] -= 1
+        if self._refs[bid] == 0 and bid not in self._key_of:
+            self._free.append(bid)                # cached blocks linger
+
     def _ensure_blocks(self, slot: int, upto: int) -> bool:
-        """Grow the slot's table to cover logical positions [0, upto).
-        TRANSACTIONAL: on a dry pool nothing is taken — partial growth on
-        a slot that then isn't admitted would strand blocks outside every
-        tracked set (not active, not filling, not free) and livelock the
-        preemption loop."""
+        """Grow the slot's table to cover logical positions [0, upto);
+        transactional via _alloc_blocks."""
         need = -(-int(upto) // self.bs)
         have = int(self._nblk[slot])
-        if need > have and need - have > len(self._free):
+        got = self._alloc_blocks(need - have)
+        if got is None:
             return False
-        for i in range(have, need):
-            self._table[slot, i] = self._free.pop()
+        for i, bid in enumerate(got):
+            self._table[slot, have + i] = bid
         self._nblk[slot] = max(have, need)
         self.blocks_high_water = max(self.blocks_high_water,
                                      self.blocks_in_use)
@@ -166,9 +208,52 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _free_slot_blocks(self, slot: int):
         n = int(self._nblk[slot])
-        self._free.extend(int(b) for b in self._table[slot, :n][::-1])
+        for b in self._table[slot, :n][::-1]:
+            self._release(int(b))
         self._table[slot] = 0
         self._nblk[slot] = 0
+
+    # ------------------------------------------------------ prefix cache --
+
+    def _chain_keys(self, ids, pad, nblocks):
+        """The chain key for each of the first ``nblocks`` prompt blocks:
+        (pad, tokens through block i) — exact content, no hashing (a
+        production build would hash the chain)."""
+        out, chain = [], (pad,)
+        for i in range(nblocks):
+            chain = chain + tuple(ids[i * self.bs:(i + 1) * self.bs])
+            out.append(chain)
+        return out
+
+    def _lookup_prefix(self, ids, pad, P):
+        """Longest cached chain of FULL prompt blocks, capped at
+        P/bs - 1 so the last prompt block is always recomputed (its
+        forward pass yields the first-token hidden state for free)."""
+        F, bids = 0, []
+        for chain in self._chain_keys(ids, pad, P // self.bs - 1):
+            bid = self._prefix_cache.get(chain)
+            if bid is None:
+                break
+            self._prefix_cache.move_to_end(chain)     # LRU touch
+            bids.append(bid)
+            F += 1
+        return F, bids
+
+    def _register_prompt_blocks(self, slot, ids, pad, P):
+        """Publish the slot's (now content-final) prompt blocks into the
+        prefix cache.  Prompt blocks are immutable from here on: buckets
+        are block-aligned, so decode growth starts in a FRESH block and
+        never writes inside [0, P) — sharing needs no copy-on-write.
+        First writer wins on races (a loser's block stays private)."""
+        if not self.prefix_caching:
+            return
+        for i, chain in enumerate(self._chain_keys(ids, pad,
+                                                   P // self.bs)):
+            bid = int(self._table[slot, i])
+            if chain not in self._prefix_cache and \
+                    bid not in self._key_of:
+                self._prefix_cache[chain] = bid
+                self._key_of[bid] = chain
 
     def _retire(self, slot: int):
         super()._retire(slot)
@@ -277,6 +362,61 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         return run
 
+    def _cached_prefill_prog(self, P: int, F: int):
+        return self._cached_prog(("cpre", P, F, self._sig),
+                                 lambda: self._build_cached_prefill(P, F))
+
+    def _build_cached_prefill(self, P: int, F: int):
+        """Admission prefill with the first F blocks already cached: embed
+        and run ONLY the suffix [F·bs, P) through the chunk-decode path,
+        attending to the shared prefix k/v through the slot's table; the
+        suffix's last position yields the first-token hidden state.  One
+        program per (bucket, F) — the program count stays bounded by
+        sum over buckets of P/bs."""
+        model = self.model
+        track = self._track
+        V = model.config.vocab_size
+        tail = self._first_token_tail()
+        bs = self.bs
+        t0 = F * bs
+
+        @partial(jax.jit, donate_argnums=(1, 2, 7))
+        def run(params, pool_ck, pool_cv, ids, pad, tabrow, key, presence,
+                slot, planes):
+            def take(p):                             # slot's logical view
+                g = p[:, tabrow]
+                g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
+                              + g.shape[3:])
+                return g[:, None]
+            ck_s = jax.tree.map(take, pool_ck)
+            cv_s = jax.tree.map(take, pool_cv)
+            h = model._embed_chunk(params, ids[0, t0:], t0,
+                                   pad_lens=pad[None])
+            h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s),
+                                                t0, pad_lens=pad[None])
+
+            span = t0 + jnp.arange(P - t0)
+            pb = tabrow[span // bs]
+            off = span % bs
+
+            def put(pool, v):
+                chunk = v[:, 0, span]
+                return pool.at[:, pb, off].set(chunk.astype(pool.dtype))
+            pool_ck = jax.tree.map(put, pool_ck, ck_s)
+            pool_cv = jax.tree.map(put, pool_cv, cv_s)
+
+            if track:
+                # the presence row seeds from the FULL prompt — shared
+                # prefix tokens count for the repetition penalty too
+                row = seed_presence(ids, V, pad[None])
+                presence = jax.lax.dynamic_update_slice(
+                    presence, row, (slot, 0))
+            tok, presence = tail(params, h[:, -1:], presence, slot, key,
+                                 planes)
+            return pool_ck, pool_cv, tok, presence
+
+        return run
+
     def _build_decode(self):
         k_ticks = self.ticks_per_sync
         tick = self._make_decode_tick()
@@ -325,6 +465,46 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             ids = [0] * pad + req.prompt
             chunked = (self.prefill_chunk is not None
                        and P > self.prefill_chunk)
+            # prefix-cache path: map the cached chain, compute only the
+            # suffix (which also bypasses chunking when the residual work
+            # fits one chunk — the head-of-line cost IS the suffix)
+            F, hit = (self._lookup_prefix(ids, pad, P)
+                      if self.prefix_caching else (0, []))
+            suffix = P - F * self.bs
+            use_cached = F > 0 and (self.prefill_chunk is None
+                                    or suffix <= self.prefill_chunk)
+            if use_cached:
+                for bid in hit:                   # pin before eviction runs
+                    self._refs[bid] += 1
+                fresh = self._alloc_blocks(suffix // self.bs)
+                if fresh is None:
+                    for bid in hit:
+                        self._release(bid)
+                    break                          # defer admission (FIFO)
+                free.pop(0)
+                self._queue.pop(0)
+                self._seq += 1
+                self._admit_seq[slot] = self._seq
+                self._table[slot, :F] = hit
+                for i, bid in enumerate(fresh):
+                    self._table[slot, F + i] = bid
+                self._nblk[slot] = P // self.bs
+                self.blocks_high_water = max(self.blocks_high_water,
+                                             self.blocks_in_use)
+                self._set_planes(slot, req)
+                run = self._cached_prefill_prog(P, F)
+                ck, cv, tok0, self._presence = run(
+                    self.params, self.caches[0], self.caches[1],
+                    jnp.asarray([ids], jnp.int32), jnp.int32(pad),
+                    jnp.asarray(self._table[slot]), self._next_key(),
+                    self._presence, jnp.int32(slot),
+                    self._plane_operands())
+                self.caches = (ck, cv)
+                self.prefix_hits += 1
+                self.prefix_blocks_reused += F
+                self._register_prompt_blocks(slot, ids, pad, P)
+                self._activate(slot, req, P, pad, int(tok0))
+                continue
             # whole-bucket admission needs its P/bs blocks NOW; chunked
             # admission grows per segment.  A dry pool defers admission
             # (FIFO preserved) — decoding slots retire and free blocks.
@@ -352,6 +532,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self._next_key(), self._presence, jnp.int32(slot),
                 self._plane_operands())
             self.caches = (ck, cv)
+            self._register_prompt_blocks(slot, ids, pad, P)
             self._activate(slot, req, P, pad, int(tok0))
 
     def _fill_segments(self):
@@ -380,6 +561,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             self.caches = (ck, cv)
             if last:
                 del self._filling[slot]
+                self._register_prompt_blocks(slot, st["ids"], st["pad"],
+                                             st["P"])
                 self._activate(slot, st["req"], st["P"], st["pad"],
                                int(tok0))
             else:
@@ -409,4 +592,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         m["blocks_in_use"] = float(self.blocks_in_use)
         m["blocks_high_water"] = float(self.blocks_high_water)
         m["preemptions"] = float(self.preemptions)
+        if self.prefix_caching:
+            m["blocks_cached"] = float(sum(
+                1 for b in self._prefix_cache.values()
+                if self._refs.get(b, 0) == 0))
+            m["prefix_hits"] = float(self.prefix_hits)
+            m["prefix_blocks_reused"] = float(self.prefix_blocks_reused)
         return m
