@@ -1,8 +1,12 @@
 """Shared KV-cache decode machinery for the causal LMs (GPT, ERNIE-MoE).
 
-≙ the reference ecosystem's generation stack (paddlenlp generation_utils;
-fused_multi_transformer_op's CacheKV).  One module so the mask/scale/
-precision conventions and the sampler cannot drift between model families.
+≙ the reference snapshot's incremental decode stack: MultiHeadAttention
+.Cache/gen_cache k/v (python/paddle/nn/layer/transformer.py:151) +
+dynamic_decode/BeamSearchDecoder (python/paddle/nn/decode.py) +
+sampling_id/top_k ops (operators/sampling_id_op.cc).  (The later-Paddle
+ecosystem's paddlenlp generation_utils / fused_multi_transformer CacheKV
+are NOT in this snapshot.)  One module so the mask/scale/precision
+conventions and the sampler cannot drift between model families.
 """
 
 from __future__ import annotations
@@ -71,10 +75,12 @@ def quantize_kv(x):
     scale per (…, head, position) vector): HBM traffic for the decode-loop
     cache reads — the serving bottleneck — drops to half of bf16.
 
-    ≙ the reference's cache-KV int8 path (fused_multi_transformer_int8_op.cu
-    quant/dequant round trips); TPU-shape: the scale plane rides NEXT TO the
-    int8 plane and dequantization fuses into the attention einsum's operand
-    read, so no fp copy of the cache ever materializes."""
+    Beyond this reference snapshot (its decode cache is fp only —
+    MultiHeadAttention.Cache, python/paddle/nn/layer/transformer.py:151;
+    int8 cache-KV serving arrives in the later-Paddle ecosystem's
+    fused_multi_transformer path).  TPU-shape: the scale plane rides NEXT
+    TO the int8 plane and dequantization fuses into the attention einsum's
+    operand read, so no fp copy of the cache ever materializes."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=False)
     scale = jnp.maximum(amax, 1e-8) / 127.0
     q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
@@ -259,8 +265,9 @@ def validate_sampler_args(vocab_size, top_k, top_p, greedy, key):
 class CausalDecoderMixin:
     """KV-cache generation shared by the causal LMs (GPT, ERNIE-MoE).
 
-    ≙ the reference ecosystem's generation stack (paddlenlp generation_utils;
-    fused_multi_transformer_op's CacheKV).  TPU-native shape: the cache is a
+    ≙ the reference snapshot's MultiHeadAttention.Cache/gen_cache
+    incremental decode (python/paddle/nn/layer/transformer.py:151) driven
+    by dynamic_decode (python/paddle/nn/decode.py).  TPU-native shape: the cache is a
     STATIC (num_layers, B, max_len, nh, hd) buffer written with
     dynamic_update_slice, the decode loop is one lax.scan — a single XLA
     program regardless of how many tokens are generated, memoized per

@@ -1,8 +1,11 @@
 """Continuous-batching serving engine for the causal decoder stack.
 
-No reference counterpart at this granularity — the reference serves
-generation through fused_multi_transformer's CacheKV with static batches
-(generation_utils batches are admitted and retired together).  This engine
+No reference counterpart at this granularity — the reference snapshot's
+decode machinery is MultiHeadAttention.Cache incremental k/v
+(python/paddle/nn/layer/transformer.py:151) driven whole-batch by
+BeamSearchDecoder/dynamic_decode (python/paddle/nn/decode.py): batches are
+admitted and retired together.  (The later-Paddle ecosystem adds
+fused_multi_transformer CacheKV serving — not in this snapshot.)  This engine
 is the TPU-native upgrade: requests join and leave a running decode batch at
 any step (the JetStream/Orca "continuous batching" discipline), while every
 device program stays STATIC-shape so XLA compiles each signature exactly

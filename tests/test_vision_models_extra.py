@@ -7,11 +7,6 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
-# measured 57-70s per big-model case (r4 full-run --durations): quick-tier
-# excluded, full gate (CI/driver) still runs everything
-pytestmark = pytest.mark.slow
-
-
 def _x(size, B=2):
     return paddle.to_tensor(
         np.random.RandomState(0).standard_normal((B, 3, size, size))
@@ -19,20 +14,27 @@ def _x(size, B=2):
 
 
 @pytest.mark.parametrize("ctor,size", [
-    (lambda: models.densenet121(num_classes=10), 64),
+    # the heavyweight families are slow-tier (60-78s eager forwards on the
+    # CPU host); squeezenet stays in the quick loop as the representative
+    pytest.param(lambda: models.densenet121(num_classes=10), 64,
+                 marks=pytest.mark.slow),
     (lambda: models.squeezenet1_1(num_classes=10), 64),
-    (lambda: models.shufflenet_v2_x0_25(num_classes=10), 64),
-    (lambda: models.googlenet(num_classes=10), 64),
-    (lambda: models.inception_v3(num_classes=10), 80),
+    pytest.param(lambda: models.shufflenet_v2_x0_25(num_classes=10), 64,
+                 marks=pytest.mark.slow),
+    pytest.param(lambda: models.googlenet(num_classes=10), 64,
+                 marks=pytest.mark.slow),
+    pytest.param(lambda: models.inception_v3(num_classes=10), 80,
+                 marks=pytest.mark.slow),
 ])
 def test_forward_shapes(ctor, size):
     paddle.seed(0)
     m = ctor()
     m.eval()
-    out = m(_x(size))
-    assert tuple(out.shape) == (2, 10), out.shape
+    out = m(_x(size, B=1))
+    assert tuple(out.shape) == (1, 10), out.shape
 
 
+@pytest.mark.slow
 def test_googlenet_train_mode_aux_heads():
     paddle.seed(0)
     m = models.googlenet(num_classes=10)
@@ -42,6 +44,7 @@ def test_googlenet_train_mode_aux_heads():
     assert tuple(a1.shape) == (2, 10) and tuple(a2.shape) == (2, 10)
 
 
+@pytest.mark.slow
 def test_shufflenet_trains():
     import paddle_tpu.nn as nn
     paddle.seed(0)
@@ -100,6 +103,7 @@ def test_resnet_nhwc_matches_nchw():
     np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_resnet_nhwc_train_step_parity():
     """Train-mode NHWC vs NCHW: full backward compared in float64, where
     layout equivalence is exact (worst observed diff ~2e-12).

@@ -131,6 +131,75 @@ def main():
         "backend": "cpu" if args.cpu else "tpu",
     }
 
+    # --- paged engine: same workload over the block pool (lazy growth)
+    try:
+        from paddle_tpu.serving import PagedContinuousBatchingEngine
+        blk = 16 if args.cpu else 32
+        max_len_pg = -(-max_len // blk) * blk
+
+        def run_paged():
+            eng = PagedContinuousBatchingEngine(
+                model, params, max_slots=S, max_len=max_len_pg,
+                block_size=blk, prompt_buckets=[P_bucket],
+                ticks_per_sync=args.ticks_per_sync)
+            for p, n in zip(prompts, budgets):
+                eng.add_request(p, n)
+            got = eng.run_to_completion(max_ticks=100000)
+            assert sum(len(v) for v in got.values()) == total_tokens
+            return eng
+
+        run_paged()  # warmup compile
+        t0 = time.perf_counter()
+        eng_pg = run_paged()
+        paged_dt = time.perf_counter() - t0
+        out["paged_tok_s"] = round(total_tokens / paged_dt, 1)
+        out["paged_vs_contiguous"] = round(engine_dt / paged_dt, 3)
+        out["paged_blocks_high_water"] = eng_pg.blocks_high_water
+        out["paged_positions_reserved_contiguous"] = S * max_len_pg
+        out["paged_positions_high_water"] = eng_pg.blocks_high_water * blk
+    except Exception as e:  # noqa: BLE001 - report, don't lose the line
+        out["paged_error"] = f"{type(e).__name__}: {e}"[:200]
+
+    # --- prefix cache: repeated-prefix workload, TTFT A/B (sequential
+    # single-slot requests so TTFT == admission prefill + first token)
+    try:
+        from paddle_tpu.serving import PagedContinuousBatchingEngine
+        # sharing needs multi-block prompts (F <= P/bs - 1): 4 blocks/bucket
+        blk = P_bucket // 4
+        pre = [int(t) for t in rng.randint(1, cfg.vocab_size,
+                                           P_bucket - 4)]
+        tails = [[int(t) for t in rng.randint(1, cfg.vocab_size, 4)]
+                 for _ in range(8)]
+        warm_pre = [int(t) for t in rng.randint(1, cfg.vocab_size,
+                                                P_bucket - 4)]
+
+        def mean_ttft(cache_on):
+            eng = PagedContinuousBatchingEngine(
+                model, params, max_slots=1,
+                max_len=-(-(P_bucket + 8) // blk) * blk, block_size=blk,
+                prompt_buckets=[P_bucket], enable_prefix_cache=cache_on)
+            # compile warmup: two same-prefix requests force BOTH the
+            # whole-bucket and the cached-prefill programs to build
+            for t in ([9], [11]):
+                eng.add_request(warm_pre + t + [1] * 3, 2)
+                eng.run_to_completion(max_ticks=1000)
+            s0, n0 = eng._m["ttft_sum"], eng._m["requests"]
+            for t in tails:
+                eng.add_request(pre + t, 4)
+                eng.run_to_completion(max_ticks=1000)
+            dt = (eng._m["ttft_sum"] - s0) / (eng._m["requests"] - n0)
+            return dt, eng
+
+        off_ttft, _ = mean_ttft(False)
+        on_ttft, eng_on = mean_ttft(True)
+        out["prefix_ttft_ms_off"] = round(off_ttft * 1e3, 2)
+        out["prefix_ttft_ms_on"] = round(on_ttft * 1e3, 2)
+        out["prefix_ttft_win"] = round(off_ttft / on_ttft, 3)
+        out["prefix_hits"] = eng_on.prefix_hits
+        out["prefix_blocks_reused"] = eng_on.prefix_blocks_reused
+    except Exception as e:  # noqa: BLE001 - report, don't lose the line
+        out["prefix_error"] = f"{type(e).__name__}: {e}"[:200]
+
     if args.speculative:
       try:  # the base metric must survive any speculative failure
         from paddle_tpu.serving import SpeculativeBatchingEngine
