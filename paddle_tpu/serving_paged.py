@@ -12,8 +12,9 @@ re-shaped for XLA's static-shape model:
   block that absorbs inactive slots' parked stale writes (never read);
 - a per-slot BLOCK TABLE ``(S, max_len // block_size)`` int32 mapping
   logical positions to pool blocks.  The table is a **traced operand**, not
-  a program constant: allocation patterns never recompile — the compiled
-  program count stays exactly the contiguous engine's bound;
+  a program constant: allocation patterns never recompile — decode compiles
+  one program per power-of-two LENGTH BUCKET (≤ log2(max_len/block_size)
+  programs; see _decode_prog_all), prefill one per prompt bucket;
 - blocks are allocated LAZILY, right before each decode sync, so persistent
   HBM scales with tokens actually resident, admission is independent of
   ``max_new_tokens``, and retirement frees every block immediately;
@@ -27,10 +28,12 @@ GATHER each slot's logical cache view from the pool through its table row,
 run the exact same decode/prefill machinery as the contiguous engine
 (serving.py's shared tick), and SCATTER back only the span that was
 written.  v1 cost note: the gathered view is a transient
-``(L, S, max_len, nh, hd)`` buffer per sync — persistent capacity scales
-with the pool, transient peak does not; collapsing the transient needs a
-Pallas paged-attention kernel that walks the table in-kernel (the PAPERS.md
-design), which is the designated TPU hot-path follow-up.
+``(L, S, C·block_size, nh, hd)`` buffer per sync, where C is the smallest
+power-of-two block count covering the deepest active clock — the transient
+AND the attention width scale with actual sequence length, not max_len;
+collapsing the transient entirely needs a Pallas paged-attention kernel
+that walks the table in-kernel (the PAPERS.md design), the designated TPU
+hot-path follow-up.
 
 No reference counterpart: the reference snapshot serves static batches only
 (SURVEY §2.3); paged serving is beyond-reference capability.
@@ -64,19 +67,24 @@ def _gather_view(pool, table):
     return jax.tree.map(one, pool)
 
 
-def _scatter_span(pool, view, table, ts, k, bs):
+def _scatter_span(pool, view, table, ts, k, bs, active):
     """Write logical positions [ts[s], ts[s]+k) of ``view`` back into the
-    pool through ``table``.  Rows whose span maps to the trash block (id 0,
-    inactive slots at their parked clock) collide there harmlessly — trash
-    is never read."""
+    pool through ``table``.  INACTIVE rows are forced to the trash block
+    (id 0): their clock may sit beyond a length-bucketed view (parked
+    fillers park at max_len - k), where the clamped column lookup could
+    otherwise alias a REAL block of the filling prompt.  Active rows'
+    spans always lie inside the view by construction (_view_cols covers
+    the deepest active clock + k)."""
     S = table.shape[0]
     rows = jnp.arange(S)[:, None]
     slots = ts[:, None] + jnp.arange(k)[None, :]     # (S, k) logical
-    pb = table[rows, slots // bs]                    # (S, k) physical block
+    col = jnp.minimum(slots // bs, table.shape[1] - 1)
+    pb = table[rows, col]                            # (S, k) physical block
+    pb = jnp.where(active[:, None], pb, 0)
     off = slots % bs
 
     def one(p, v):
-        chunk = v[:, rows, slots]                    # (L, S, k, …)
+        chunk = v[:, rows, jnp.minimum(slots, v.shape[2] - 1)]
         return p.at[:, pb, off].set(chunk.astype(p.dtype))
     return jax.tree.map(one, pool, view)
 
@@ -417,7 +425,29 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         return run
 
-    def _build_decode(self):
+    def _decode_prog_all(self):
+        """Decode programs are LENGTH-BUCKETED: each sync gathers only the
+        first C table columns — the smallest power-of-two cover of the
+        deepest active clock — so the transient view AND the attention
+        width scale with actual sequence length, not max_len.  At most
+        log2(MB) compiled decode programs."""
+        C = self._view_cols()
+        return self._cached_prog(("decode", C, self._sig),
+                                 lambda: self._build_decode_cols(C))
+
+    def _view_cols(self) -> int:
+        k = self.ticks_per_sync
+        # active clocks only: parked fillers sit at max_len - k by design
+        # and must not inflate the bucket (their writes land in trash
+        # regardless of C — the table's parked columns are 0 there)
+        ts = self._t[self._active] if self._active.any() else [0]
+        need = -(-int(max(ts) + k) // self.bs)
+        C = 1
+        while C < need:
+            C *= 2
+        return min(C, self.MB)
+
+    def _build_decode_cols(self, C: int):
         k_ticks = self.ticks_per_sync
         tick = self._make_decode_tick()
         bs = self.bs
@@ -425,15 +455,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         @partial(jax.jit, donate_argnums=(1, 2, 9))
         def run(params, pool_ck, pool_cv, table, toks, ts, pads, active,
                 key, presence, emitted0, planes):
-            view_ck = _gather_view(pool_ck, table)
-            view_cv = _gather_view(pool_cv, table)
+            view_ck = _gather_view(pool_ck, table[:, :C])
+            view_cv = _gather_view(pool_cv, table[:, :C])
             (view_ck, view_cv, _, _, presence), toks_out = jax.lax.scan(
                 lambda c, i: tick(c, i, params, ts, pads, active, emitted0,
                                   planes),
                 (view_ck, view_cv, toks, key, presence),
                 jnp.arange(k_ticks))
-            pool_ck = _scatter_span(pool_ck, view_ck, table, ts, k_ticks, bs)
-            pool_cv = _scatter_span(pool_cv, view_cv, table, ts, k_ticks, bs)
+            pool_ck = _scatter_span(pool_ck, view_ck, table[:, :C], ts,
+                                    k_ticks, bs, active)
+            pool_cv = _scatter_span(pool_cv, view_cv, table[:, :C], ts,
+                                    k_ticks, bs, active)
             return pool_ck, pool_cv, toks_out, presence
 
         return run

@@ -161,6 +161,43 @@ class TestPagedAllocator:
         assert got[r0] == _solo_greedy(model, params, PROMPTS[0], 4)
         assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 4)
 
+    def test_bucketed_view_protects_deep_filler_cache(self,
+                                                      model_and_params):
+        """Length-bucketed decode gathers only C table columns covering
+        the deepest ACTIVE clock.  A chunk-filling slot's blocks can
+        extend beyond C while a shallow request decodes next door; the
+        filler's parked-clock scatter-back must hit trash, not the
+        clamped last view column (which aliases a REAL prompt block).
+        Checked against model.prefill's reference cache, block by
+        block."""
+        model, params = model_and_params
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=64, block_size=4,
+            prompt_buckets=[4, 16], ticks_per_sync=2, prefill_chunk=4)
+        # admitted TOGETHER: r0 starts at t=4, so during the filler's
+        # second segment the view bucket is still C=2 (covers t+k=8)
+        # while the filler's table col 1 is already a REAL block — the
+        # clamped parked-clock scatter-back aliases it unless gated
+        r0 = eng.add_request([40, 2], 20)      # bucket 4: shallow decoder
+        long_prompt = list(range(3, 19))       # bucket 16, pad 0: 4 blocks
+        eng.add_request(long_prompt, 6)
+        eng.step()
+        assert eng._active[0] and eng._filling
+        slot = next(iter(eng._filling))
+        while slot in eng._filling:
+            eng.step()
+        ref = model.prefill(params, jnp.asarray([long_prompt], jnp.int32),
+                            16)[1][0]
+        ref = np.asarray(ref[:, 0, :16])       # (L, 16, nh, hd)
+        tab = eng._table[slot, :4]
+        got = np.asarray(eng.caches[0][:, tab])            # (L, 4, bs, ...)
+        got = got.reshape(ref.shape)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg="stale scatter-back corrupted "
+                                           "the filler's prompt blocks")
+        got_all = eng.run_to_completion(max_ticks=200)
+        assert len(got_all) == 2
+
     def test_wedged_fillers_preempt_and_recover(self, model_and_params):
         """Review repro: two chunked fillers jointly exhaust the pool with
         NO active decoder — nothing will ever free blocks, so the stalled
@@ -240,7 +277,8 @@ class TestPagedAllocator:
     def test_compiled_program_count_is_bounded(self, model_and_params):
         """Block tables are traced operands: allocation patterns,
         preemptions, and fresh engine instances never add programs — one
-        decode program + one prefill program per bucket."""
+        decode program per length bucket + one prefill program per
+        prompt bucket."""
         model, params = model_and_params
         model.__dict__.pop("_serving_programs", None)
 
