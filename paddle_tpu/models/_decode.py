@@ -26,6 +26,11 @@ def cached_attention(q, ck, cv, t, pad_lens=None):
     int32 additionally masks the first pad_lens[b] cache slots (left-padded
     prompts).  Shared by the GPT and ERNIE-MoE decode paths so the mask/
     scale/precision conventions cannot drift."""
+    if isinstance(ck, PagedKV):
+        # paged fallback: densify this layer's table-selected blocks (the
+        # Pallas in-kernel table walk replaces this on TPU)
+        ck = ck.gather(q.dtype)
+        cv = cv.gather(q.dtype)
     kq = q.shape[1]
     hd = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(
@@ -46,13 +51,86 @@ def cached_attention(q, ck, cv, t, pad_lens=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
 
 
+@jax.tree_util.register_pytree_node_class
+class PagedKV:
+    """One k-or-v cache over a BLOCK POOL + slot block table (the serving
+    engine's paged layout, flowing through the same decode code path as
+    dense caches via dispatch in write_cache/cached_attention).
+
+    ``pool``: (NB+1, bs, nh, hd) — or with a leading layer axis, which
+    lax.scan over layers slices off; block 0 is the reserved trash block.
+    int8 pools are (values, scales) pairs.  ``table``: (S, C) int32 —
+    C table columns cover every ACTIVE row's positions; inactive rows'
+    table rows must be pre-zeroed by the caller (their writes then land
+    in trash even where the clamped column lookup would alias a real
+    block).  As a pytree, scanning over layers slices pool and table
+    together (the engine broadcasts the table across layers)."""
+
+    def __init__(self, pool, table):
+        self.pool = pool
+        self.table = table
+
+    def tree_flatten(self):
+        return (self.pool, self.table), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def block_size(self):
+        # axis 1 of the PER-LAYER pool is bs for BOTH planes — the value
+        # plane is (NB+1, bs, nh, hd), the int8 scale plane (NB+1, bs, nh)
+        # is one rank short, so a from-the-right index would be wrong
+        vals = self.pool[0] if isinstance(self.pool, tuple) else self.pool
+        return vals.shape[1]
+
+    def gather(self, dtype):
+        """Dense (S, C·bs, nh, hd) view of the table-selected blocks —
+        the XLA fallback read path (one layer at a time inside the layer
+        scan, so the transient is 1/L of the all-layer view; a Pallas
+        kernel walking the table in-kernel replaces this on TPU).
+        Gather FIRST, then dequantize: only the S·C selected blocks pay
+        the int8→fp convert, never the whole pool."""
+        picked = jax.tree.map(lambda p: p[self.table], self.pool)
+        g = dequantize_cache(picked, dtype)        # (S, C, bs, nh, hd)
+        return g.reshape((g.shape[0], g.shape[1] * g.shape[2])
+                         + g.shape[3:])
+
+    def write(self, chunk, t):
+        """Write a (S, kq, …) chunk at per-row positions [t, t+kq) through
+        the table (column lookup clamped; pre-zeroed inactive rows land in
+        trash)."""
+        if isinstance(self.pool, tuple):
+            vals, scales = self.pool
+            q, s = quantize_kv(chunk)
+            return PagedKV((PagedKV(vals, self.table).write(q, t).pool,
+                            PagedKV(scales, self.table).write(s, t).pool),
+                           self.table)
+        bs = self.block_size
+        t_arr = jnp.asarray(t)
+        B, kq = chunk.shape[:2]
+        if t_arr.ndim == 0:
+            t_arr = jnp.broadcast_to(t_arr, (B,))
+        rows = jnp.arange(B)[:, None]
+        slots = t_arr[:, None] + jnp.arange(kq)[None, :]   # (S, kq)
+        col = jnp.minimum(slots // bs, self.table.shape[1] - 1)
+        pb = self.table[rows, col]
+        off = slots % bs
+        pool = self.pool.at[pb, off].set(chunk.astype(self.pool.dtype))
+        return PagedKV(pool, self.table)
+
+
 def write_cache(cache, chunk, t):
     """Write a (B, kq, nh, hd) k/v chunk into the cache at slots [t, t+kq):
     scalar ``t`` → one dynamic_update_slice; per-row (B,) ``t`` → scatter
     (batched speculative decoding, rows at different positions).
 
     ``cache`` may be a quantized pair ``(values_int8, scales)`` (see
-    ``quantize_kv``): the chunk is quantized and both planes written."""
+    ``quantize_kv``) — the chunk is quantized and both planes written —
+    or a ``PagedKV`` (block-pool writes through the slot table)."""
+    if isinstance(cache, PagedKV):
+        return cache.write(chunk, t)
     if isinstance(cache, tuple):
         vals, scales = cache
         q, s = quantize_kv(chunk)
@@ -90,7 +168,11 @@ def quantize_kv(x):
 
 def dequantize_cache(cache, dtype):
     """(values_int8, scales) → dense ``dtype`` array; plain arrays pass
-    through (so attention call sites stay cache-format agnostic)."""
+    through (so attention call sites stay cache-format agnostic).
+    ``PagedKV`` defers to attention time (cached_attention gathers —
+    or a Pallas kernel reads the pool directly)."""
+    if isinstance(cache, PagedKV):
+        return cache
     if isinstance(cache, tuple):
         vals, scales = cache
         return (vals.astype(jnp.float32) * scales[..., None]).astype(dtype)

@@ -23,17 +23,19 @@ re-shaped for XLA's static-shape model:
   greedy decoding regenerates the identical prefix, so outputs stay
   oracle-exact; streaming callbacks see the replayed tokens again).
 
-Device-side the engine stays a pure serving-layer construct: programs
-GATHER each slot's logical cache view from the pool through its table row,
-run the exact same decode/prefill machinery as the contiguous engine
-(serving.py's shared tick), and SCATTER back only the span that was
-written.  v1 cost note: the gathered view is a transient
-``(L, S, C·block_size, nh, hd)`` buffer per sync, where C is the smallest
-power-of-two block count covering the deepest active clock — the transient
-AND the attention width scale with actual sequence length, not max_len;
-collapsing the transient entirely needs a Pallas paged-attention kernel
-that walks the table in-kernel (the PAPERS.md design), the designated TPU
-hot-path follow-up.
+Device-side the engine stays a pure serving-layer construct: the decode
+program wraps the pool + (length-bucketed, inactive-zeroed) table as a
+``PagedKV`` pytree and runs the exact same shared tick as the contiguous
+engine — decode_step's layer scan slices pool and table together,
+``write_cache`` scatters straight into pool blocks, and
+``cached_attention`` densifies ONE layer's table-selected blocks at a
+time (a transient ``(S, C·block_size, nh, hd)`` view per layer, where C
+is the smallest power-of-two block count covering the deepest active
+clock; there is no all-layer view and no scatter-back pass).  The
+gather/scatter pattern survives only in the single-slot prefill/segment
+programs.  Collapsing the per-layer transient entirely needs a Pallas
+paged-attention kernel that walks the table in-kernel (the PAPERS.md
+design), the designated TPU hot-path follow-up.
 
 No reference counterpart: the reference snapshot serves static batches only
 (SURVEY §2.3); paged serving is beyond-reference capability.
@@ -51,42 +53,9 @@ import numpy as np
 
 from .serving import ContinuousBatchingEngine
 from .jit.bucketing import select_bucket
-from .models._decode import seed_presence
+from .models._decode import PagedKV, seed_presence
 
 __all__ = ["PagedContinuousBatchingEngine"]
-
-
-def _gather_view(pool, table):
-    """(L, NB+1, bs, …) pool + (S, MB) table → logical (L, S, MB·bs, …)
-    view.  Rank-generic: the int8 scale plane is one rank short of the
-    value plane; both reshape by merging the (MB, bs) axes."""
-    def one(p):
-        g = p[:, table]                              # (L, S, MB, bs, …)
-        return g.reshape(g.shape[:2] + (g.shape[2] * g.shape[3],)
-                         + g.shape[4:])
-    return jax.tree.map(one, pool)
-
-
-def _scatter_span(pool, view, table, ts, k, bs, active):
-    """Write logical positions [ts[s], ts[s]+k) of ``view`` back into the
-    pool through ``table``.  INACTIVE rows are forced to the trash block
-    (id 0): their clock may sit beyond a length-bucketed view (parked
-    fillers park at max_len - k), where the clamped column lookup could
-    otherwise alias a REAL block of the filling prompt.  Active rows'
-    spans always lie inside the view by construction (_view_cols covers
-    the deepest active clock + k)."""
-    S = table.shape[0]
-    rows = jnp.arange(S)[:, None]
-    slots = ts[:, None] + jnp.arange(k)[None, :]     # (S, k) logical
-    col = jnp.minimum(slots // bs, table.shape[1] - 1)
-    pb = table[rows, col]                            # (S, k) physical block
-    pb = jnp.where(active[:, None], pb, 0)
-    off = slots % bs
-
-    def one(p, v):
-        chunk = v[:, rows, jnp.minimum(slots, v.shape[2] - 1)]
-        return p.at[:, pb, off].set(chunk.astype(p.dtype))
-    return jax.tree.map(one, pool, view)
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -450,23 +419,30 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _build_decode_cols(self, C: int):
         k_ticks = self.ticks_per_sync
         tick = self._make_decode_tick()
-        bs = self.bs
+        L = self.model.config.num_layers
 
         @partial(jax.jit, donate_argnums=(1, 2, 9))
         def run(params, pool_ck, pool_cv, table, toks, ts, pads, active,
                 key, presence, emitted0, planes):
-            view_ck = _gather_view(pool_ck, table[:, :C])
-            view_cv = _gather_view(pool_cv, table[:, :C])
-            (view_ck, view_cv, _, _, presence), toks_out = jax.lax.scan(
+            # C table columns cover every active row (host-chosen bucket);
+            # INACTIVE rows are pre-zeroed so their parked-clock writes —
+            # whose clamped column lookup could alias a filling prompt's
+            # real block — land in the trash block instead
+            tb = jnp.where(active[:, None], table[:, :C], 0)
+            tb = jnp.broadcast_to(tb[None], (L,) + tb.shape)
+            pkv_ck = PagedKV(pool_ck, tb)
+            pkv_cv = PagedKV(pool_cv, tb)
+            # the pool flows through the SAME shared tick as the dense
+            # engine: decode_step's layer scan slices pool+table together,
+            # write_cache scatters straight into pool blocks, and
+            # cached_attention densifies one layer at a time (transient
+            # 1/L of the old pre-gathered view; no scatter-back pass)
+            (pkv_ck, pkv_cv, _, _, presence), toks_out = jax.lax.scan(
                 lambda c, i: tick(c, i, params, ts, pads, active, emitted0,
                                   planes),
-                (view_ck, view_cv, toks, key, presence),
+                (pkv_ck, pkv_cv, toks, key, presence),
                 jnp.arange(k_ticks))
-            pool_ck = _scatter_span(pool_ck, view_ck, table[:, :C], ts,
-                                    k_ticks, bs, active)
-            pool_cv = _scatter_span(pool_cv, view_cv, table[:, :C], ts,
-                                    k_ticks, bs, active)
-            return pool_ck, pool_cv, toks_out, presence
+            return pkv_ck.pool, pkv_cv.pool, toks_out, presence
 
         return run
 
