@@ -82,6 +82,9 @@ define_flag("FLAGS_use_fused_ln", False,
             "Route LN+residual+dropout through the Pallas kernel (ops/fused.py); "
             "off by default — flip only where tools/fused_probe.py shows XLA "
             "leaving step time on the table.")
+define_flag("FLAGS_paged_attn_interpret", False,
+            "Run the paged-attention decode kernel in Pallas interpret "
+            "mode (CPU CI of the in-kernel table walk).")
 define_flag("FLAGS_fused_ln_interpret", False,
             "Allow the fused-LN Pallas kernel in interpret mode off-TPU (tests).")
 define_flag("FLAGS_use_fused_adamw", False,

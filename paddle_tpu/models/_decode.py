@@ -27,8 +27,26 @@ def cached_attention(q, ck, cv, t, pad_lens=None):
     prompts).  Shared by the GPT and ERNIE-MoE decode paths so the mask/
     scale/precision conventions cannot drift."""
     if isinstance(ck, PagedKV):
-        # paged fallback: densify this layer's table-selected blocks (the
-        # Pallas in-kernel table walk replaces this on TPU)
+        from ..core.flags import flag
+        kernel_ok = (q.shape[1] == 1                 # the decode tick
+                     and not isinstance(ck.pool, tuple))   # fp pools only
+        # FLAGS_use_pallas_kernels stays the authoritative kill switch (the
+        # ops/fused.py convention); the interpret arm applies only OFF-TPU
+        # (CPU CI of the in-kernel table walk)
+        interp = (bool(flag("FLAGS_paged_attn_interpret"))
+                  and jax.default_backend() != "tpu")
+        use = flag("FLAGS_use_pallas_kernels") and \
+            (jax.default_backend() == "tpu" or interp)
+        if kernel_ok and use:
+            from ..ops.paged_attention import paged_decode_attention
+            S = q.shape[0]
+            t_vec = jnp.broadcast_to(jnp.asarray(t), (S,))
+            pad_vec = (None if pad_lens is None
+                       else jnp.broadcast_to(jnp.asarray(pad_lens), (S,)))
+            o = paged_decode_attention(q[:, 0], ck.pool, cv.pool, ck.table,
+                                       t_vec, pad_vec, interpret=interp)
+            return o[:, None]
+        # fallback: densify this layer's table-selected blocks
         ck = ck.gather(q.dtype)
         cv = cv.gather(q.dtype)
     kq = q.shape[1]

@@ -127,8 +127,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     @property
     def _sig(self):
+        from .core.flags import flag
+        # the kernel-dispatch flags are baked into compiled programs at
+        # trace time — key them so set_flags() takes effect on the next
+        # program fetch instead of being silently ignored
         return (ContinuousBatchingEngine._sig.fget(self)
-                + ("paged", self.bs, self.NB))
+                + ("paged", self.bs, self.NB,
+                   bool(flag("FLAGS_use_pallas_kernels")),
+                   bool(flag("FLAGS_paged_attn_interpret"))))
 
     # --------------------------------------------------------- allocator --
 
