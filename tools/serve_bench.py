@@ -157,6 +157,24 @@ def main():
         out["paged_blocks_high_water"] = eng_pg.blocks_high_water
         out["paged_positions_reserved_contiguous"] = S * max_len_pg
         out["paged_positions_high_water"] = eng_pg.blocks_high_water * blk
+
+        # on TPU: A/B the Pallas in-kernel table walk vs the XLA gather
+        # fallback (FLAGS_use_pallas_kernels is the kill switch; the flag
+        # is part of the paged program-cache signature, so this recompiles
+        # rather than silently reusing)
+        import jax as _jax
+        if _jax.default_backend() == "tpu":
+            from paddle_tpu.core.flags import set_flags
+            try:
+                set_flags({"FLAGS_use_pallas_kernels": False})
+                run_paged()  # warmup the fallback programs
+                t0 = time.perf_counter()
+                run_paged()
+                fb_dt = time.perf_counter() - t0
+                out["paged_fallback_tok_s"] = round(total_tokens / fb_dt, 1)
+                out["paged_kernel_speedup"] = round(fb_dt / paged_dt, 3)
+            finally:
+                set_flags({"FLAGS_use_pallas_kernels": True})
     except Exception as e:  # noqa: BLE001 - report, don't lose the line
         out["paged_error"] = f"{type(e).__name__}: {e}"[:200]
 
