@@ -32,6 +32,9 @@ def main():
     ap.add_argument("--speculative", action="store_true",
                     help="speculative engine (1-layer draft): lossless, "
                          "fewer rounds")
+    ap.add_argument("--paged", action="store_true",
+                    help="paged KV cache (block pool + tables): lazy HBM, "
+                         "preemption, prefix caching")
     args = ap.parse_args()
 
     import jax
@@ -63,6 +66,15 @@ def main():
         eng = SpeculativeBatchingEngine(
             model, params, draft, dparams, max_slots=args.slots,
             max_len=128, draft_k=3, prompt_buckets=[16, 32], mesh=mesh)
+    elif args.paged:
+        from paddle_tpu.serving import PagedContinuousBatchingEngine
+        # per-request sampling + prefix caching ride along: requests may
+        # carry their own knobs, and repeated prompt prefixes reuse blocks
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=args.slots, max_len=128,
+            block_size=16, prompt_buckets=[16, 32],
+            ticks_per_sync=args.ticks_per_sync,
+            enable_prefix_cache=True, per_request_sampling=True)
     else:
         eng = ContinuousBatchingEngine(
             model, params, max_slots=args.slots, max_len=128,
@@ -77,8 +89,9 @@ def main():
     for _ in range(3):
         eng.step()
     # a second wave joins while the first is mid-decode
+    kw2 = [dict(repetition_penalty=1.5), dict()] if args.paged else [{}, {}]
     wave2 = [eng.add_request(list(rng.randint(1, 512, rng.randint(4, 33))),
-                             int(n)) for n in (12, 20)]
+                             int(n), **k) for n, k in zip((12, 20), kw2)]
     out = eng.run_to_completion(max_ticks=10000)
 
     total = sum(len(v) for v in out.values())
@@ -87,6 +100,9 @@ def main():
         print(f"request {rid}: {len(out[rid])} tokens, "
               f"first 8 = {out[rid][:8]}")
     extra = (f", spec rounds={eng.rounds}" if args.speculative else "")
+    if args.paged:
+        extra += (f", blocks hw={eng.blocks_high_water}"
+                  f", prefix hits={eng.prefix_hits}")
     m = eng.metrics()
     print(f"\n{len(out)} requests, {total} tokens in {dt:.2f}s "
           f"({total / dt:.0f} tok/s) — slots={args.slots}, "
