@@ -56,16 +56,26 @@ def main():
         from jax.sharding import Mesh
         mesh = Mesh(np.array(jax.devices()[:args.mp]), ("model",))
 
+    if args.paged and args.mp > 1:
+        raise SystemExit("--paged is single-mesh; drop --mp")
     if args.speculative:
-        from paddle_tpu.serving import SpeculativeBatchingEngine
         dcfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=1,
                          num_attention_heads=4, max_position_embeddings=256,
                          compute_dtype="float32")
         draft = GPTModel(dcfg)
         dparams = {n: p._data for n, p in draft.named_parameters()}
-        eng = SpeculativeBatchingEngine(
-            model, params, draft, dparams, max_slots=args.slots,
-            max_len=128, draft_k=3, prompt_buckets=[16, 32], mesh=mesh)
+        if args.paged:
+            from paddle_tpu.serving import PagedSpeculativeBatchingEngine
+            eng = PagedSpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=args.slots,
+                max_len=128, draft_k=3, prompt_buckets=[16, 32],
+                block_size=16)
+        else:
+            from paddle_tpu.serving import SpeculativeBatchingEngine
+            eng = SpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=args.slots,
+                max_len=128, draft_k=3, prompt_buckets=[16, 32],
+                mesh=mesh)
     elif args.paged:
         from paddle_tpu.serving import PagedContinuousBatchingEngine
         # per-request sampling + prefix caching ride along: requests may
@@ -89,7 +99,8 @@ def main():
     for _ in range(3):
         eng.step()
     # a second wave joins while the first is mid-decode
-    kw2 = [dict(repetition_penalty=1.5), dict()] if args.paged else [{}, {}]
+    perreq = args.paged and not args.speculative
+    kw2 = [dict(repetition_penalty=1.5), dict()] if perreq else [{}, {}]
     wave2 = [eng.add_request(list(rng.randint(1, 512, rng.randint(4, 33))),
                              int(n), **k) for n, k in zip((12, 20), kw2)]
     out = eng.run_to_completion(max_ticks=10000)
@@ -101,8 +112,9 @@ def main():
               f"first 8 = {out[rid][:8]}")
     extra = (f", spec rounds={eng.rounds}" if args.speculative else "")
     if args.paged:
-        extra += (f", blocks hw={eng.blocks_high_water}"
-                  f", prefix hits={eng.prefix_hits}")
+        extra += f", blocks hw={eng.blocks_high_water}"
+        if not args.speculative:
+            extra += f", prefix hits={eng.prefix_hits}"
     m = eng.metrics()
     print(f"\n{len(out)} requests, {total} tokens in {dt:.2f}s "
           f"({total / dt:.0f} tok/s) — slots={args.slots}, "

@@ -828,16 +828,24 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     def __init__(self, model, params, draft_model, draft_params,
                  max_slots: int, max_len: int, draft_k: int = 4,
                  prompt_buckets=None, eos_token_id: Optional[int] = None,
-                 key=None, mesh=None):
+                 key=None, mesh=None, **cache_kw):
         if mesh is not None:
             raise NotImplementedError("speculative engine v1 is single-mesh")
+        # cache_kw forwards ONLY storage-layout args to the paged cache
+        # base (PagedSpeculative composition); everything else - sampler
+        # knobs the greedy spec round would silently ignore, chunked
+        # prefill, prefix caching - is rejected loudly
+        bad = set(cache_kw) - {"block_size", "num_blocks"}
+        if bad:
+            raise NotImplementedError(
+                f"speculative engine v1 does not support {sorted(bad)}")
         super().__init__(model, params, max_slots, max_len,
                          prompt_buckets=prompt_buckets, greedy=True,
                          eos_token_id=eos_token_id, key=key,
                          # round write-span is K+1: reuse the base class's
                          # parking/room arithmetic by declaring it the sync
                          # width (step() below never uses it as tick count)
-                         ticks_per_sync=int(draft_k) + 1)
+                         ticks_per_sync=int(draft_k) + 1, **cache_kw)
         dc = draft_model.config
         if dc.vocab_size != model.config.vocab_size:
             raise ValueError(f"draft vocab ({dc.vocab_size}) != target "
@@ -851,8 +859,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self.K = int(draft_k)
         if self.K < 1:
             raise ValueError("draft_k must be >= 1")
-        self.draft_caches = draft_model.init_cache(self.S, self.max_len)
+        self.draft_caches = self._alloc_draft_caches()
         self.rounds = 0          # spec rounds run (for efficiency reporting)
+
+    def _alloc_draft_caches(self):
+        """Draft-cache storage seam (mirrors _alloc_caches): the paged
+        composition replaces this with a block pool sharing the target's
+        tables - the dense draft cache is never materialized there."""
+        return self.draft_model.init_cache(self.S, self.max_len)
 
     @property
     def _sig(self):
@@ -946,54 +960,64 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
     @staticmethod
     def _make_spec_round(model, draft, K, S):
+        core = SpeculativeBatchingEngine._spec_round_core
+
         @partial(jax.jit, donate_argnums=(1, 2))
         def run(params_pair, big, dbig, toks, ts, pads):
-            # greedy + host-side discard: no randomness, no device-side
-            # active masking — inactive rows compute and their writes park
-            params, dparams = params_pair
-            rows = jnp.arange(S)
-
-            def dstep(carry, i):
-                tok, dc = carry
-                hh = draft._embed_one(dparams, tok, ts + i, pad_lens=pads)
-                hh, dc = draft.decode_step(dparams, hh, dc, ts + i,
-                                           pad_lens=pads)
-                ql = draft.decode_logits(dparams, hh)[:, -1]
-                ntok = jnp.argmax(ql, -1).astype(jnp.int32)
-                return (ntok, dc), ntok
-
-            (_, dbig), d = jax.lax.scan(dstep, (toks, dbig), jnp.arange(K))
-            d = d.T                                             # (S, K)
-
-            # ONE verify chunk per row over [prev, d_0..d_{K-1}] at clocks
-            # [ts, ts+K] (prev's kv lands at ts, matching plain decode)
-            inp = jnp.concatenate([toks[:, None], d], axis=1)   # (S, K+1)
-            hin = model._embed_chunk(params, inp, ts, pad_lens=pads)
-            hv, big = model.decode_step(params, hin, big, ts, pad_lens=pads)
-            tl = model.decode_logits(params, hv)                # (S, K+1, V)
-            tpred = jnp.argmax(tl, -1).astype(jnp.int32)        # (S, K+1)
-            lead = jnp.sum(jnp.cumprod(
-                (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
-            repl = jnp.take_along_axis(
-                tpred, jnp.minimum(lead, K)[:, None], 1)[:, 0]  # (S,)
-            # emitted block: d_0..d_{lead-1}, then repl at position lead
-            block = d  # (S, K) proposals
-            block = jnp.concatenate([block, jnp.zeros((S, 1), jnp.int32)],
-                                    axis=1)
-            block = block.at[rows, lead].set(repl)              # (S, K+1)
-
-            # draft self-heal (the round-3 hole fix): the draft scan
-            # already wrote kv for [prev, d_0..d_{K-2}] at [ts, ts+K-1];
-            # only d_{K-1}'s kv at ts+K is missing — one draft step fills
-            # it at ~1/(K+1) the cost of re-ingesting the whole chunk
-            dh = draft._embed_one(dparams, d[:, K - 1], ts + K,
-                                  pad_lens=pads)
-            _, dbig = draft.decode_step(dparams, dh, dbig, ts + K,
-                                        pad_lens=pads)
-
-            return big, dbig, lead, block
-
+            return core(model, draft, K, S, params_pair, big, dbig, toks,
+                        ts, pads)
         return run
+
+    @staticmethod
+    def _spec_round_core(model, draft, K, S, params_pair, big, dbig, toks,
+                         ts, pads):
+        """One speculative round over any cache layout — the paged
+        composition wraps pools as PagedKV and calls this same core, so
+        the acceptance semantics cannot drift between layouts."""
+        # greedy + host-side discard: no randomness, no device-side
+        # active masking — inactive rows compute and their writes park
+        params, dparams = params_pair
+        rows = jnp.arange(S)
+
+        def dstep(carry, i):
+            tok, dc = carry
+            hh = draft._embed_one(dparams, tok, ts + i, pad_lens=pads)
+            hh, dc = draft.decode_step(dparams, hh, dc, ts + i,
+                                       pad_lens=pads)
+            ql = draft.decode_logits(dparams, hh)[:, -1]
+            ntok = jnp.argmax(ql, -1).astype(jnp.int32)
+            return (ntok, dc), ntok
+
+        (_, dbig), d = jax.lax.scan(dstep, (toks, dbig), jnp.arange(K))
+        d = d.T                                             # (S, K)
+
+        # ONE verify chunk per row over [prev, d_0..d_{K-1}] at clocks
+        # [ts, ts+K] (prev's kv lands at ts, matching plain decode)
+        inp = jnp.concatenate([toks[:, None], d], axis=1)   # (S, K+1)
+        hin = model._embed_chunk(params, inp, ts, pad_lens=pads)
+        hv, big = model.decode_step(params, hin, big, ts, pad_lens=pads)
+        tl = model.decode_logits(params, hv)                # (S, K+1, V)
+        tpred = jnp.argmax(tl, -1).astype(jnp.int32)        # (S, K+1)
+        lead = jnp.sum(jnp.cumprod(
+            (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
+        repl = jnp.take_along_axis(
+            tpred, jnp.minimum(lead, K)[:, None], 1)[:, 0]  # (S,)
+        # emitted block: d_0..d_{lead-1}, then repl at position lead
+        block = d  # (S, K) proposals
+        block = jnp.concatenate([block, jnp.zeros((S, 1), jnp.int32)],
+                                axis=1)
+        block = block.at[rows, lead].set(repl)              # (S, K+1)
+
+        # draft self-heal (the round-3 hole fix): the draft scan
+        # already wrote kv for [prev, d_0..d_{K-2}] at [ts, ts+K-1];
+        # only d_{K-1}'s kv at ts+K is missing — one draft step fills
+        # it at ~1/(K+1) the cost of re-ingesting the whole chunk
+        dh = draft._embed_one(dparams, d[:, K - 1], ts + K,
+                              pad_lens=pads)
+        _, dbig = draft.decode_step(dparams, dh, dbig, ts + K,
+                                    pad_lens=pads)
+
+        return big, dbig, lead, block
 
     def step(self):
         """One scheduler round: admit, then one speculative round; each
@@ -1001,16 +1025,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         self._admit()
         if not self._active.any():
             return
-        run = self._spec_round_prog()
-        active_before = self._active.copy()
-        big, dbig, lead, block = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, jnp.asarray(self._tok),
-            jnp.asarray(self._t), jnp.asarray(self._pad))
-        self.caches, self.draft_caches = big, dbig
+        res = self._run_spec_round()
+        if res is None:
+            return
+        active_before, lead, block = res
         self.rounds += 1
-        lead = np.asarray(lead)
-        block = np.asarray(block)
         for slot in np.flatnonzero(active_before):
             m = int(lead[slot]) + 1                 # tokens this round
             for j in range(m):
@@ -1025,16 +1044,31 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                     int(self._t[slot]) + self.K + 1 > self.max_len:
                 self._retire(int(slot))
 
+    def _run_spec_round(self):
+        """Run one speculative round over the engine's cache storage;
+        returns (active_before, lead, block) or None.  The paged
+        composition overrides this to grow block tables first."""
+        run = self._spec_round_prog()
+        active_before = self._active.copy()
+        big, dbig, lead, block = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, jnp.asarray(self._tok),
+            jnp.asarray(self._t), jnp.asarray(self._pad))
+        self.caches, self.draft_caches = big, dbig
+        return active_before, np.asarray(lead), np.asarray(block)
+
 
 # paged (block-table) variant — defined in serving_paged.py, re-exported
 # here LAZILY (PEP 562) so `paddle_tpu.serving` is the single public
 # serving namespace without a circular import (serving_paged imports this
 # module at its top)
-__all__.append("PagedContinuousBatchingEngine")
+__all__ += ["PagedContinuousBatchingEngine",
+            "PagedSpeculativeBatchingEngine"]
 
 
 def __getattr__(name):
-    if name == "PagedContinuousBatchingEngine":
-        from .serving_paged import PagedContinuousBatchingEngine as cls
-        return cls
+    if name in ("PagedContinuousBatchingEngine",
+                "PagedSpeculativeBatchingEngine"):
+        from . import serving_paged
+        return getattr(serving_paged, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

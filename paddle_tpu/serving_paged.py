@@ -51,11 +51,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .serving import ContinuousBatchingEngine
+from .serving import (ContinuousBatchingEngine,
+                      SpeculativeBatchingEngine)
 from .jit.bucketing import select_bucket
 from .models._decode import PagedKV, seed_presence
 
-__all__ = ["PagedContinuousBatchingEngine"]
+__all__ = ["PagedContinuousBatchingEngine",
+           "PagedSpeculativeBatchingEngine"]
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -112,8 +114,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     # ------------------------------------------------------------ storage --
 
-    def _alloc_caches(self):
-        c = self.model.config
+    def _build_pool(self, c):
+        """Block pools for one model config (the paged-speculative
+        composition builds a second pool for the draft — SAME allocator
+        and tables, different pool storage)."""
         nh = c.num_attention_heads
         hd = c.hidden_size // nh
         shape = (c.num_layers, self.NB + 1, self.bs, nh, hd)
@@ -125,16 +129,24 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         dt = jnp.dtype(c.compute_dtype)
         return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
-    @property
-    def _sig(self):
+    def _alloc_caches(self):
+        return self._build_pool(self.model.config)
+
+    def _paged_sig_suffix(self):
         from .core.flags import flag
         # the kernel-dispatch flags are baked into compiled programs at
         # trace time — key them so set_flags() takes effect on the next
-        # program fetch instead of being silently ignored
+        # program fetch instead of being silently ignored.  ONE helper for
+        # every paged signature (the spec composition included): a flag
+        # added here reaches all of them
+        return ("paged", self.bs, self.NB,
+                bool(flag("FLAGS_use_pallas_kernels")),
+                bool(flag("FLAGS_paged_attn_interpret")))
+
+    @property
+    def _sig(self):
         return (ContinuousBatchingEngine._sig.fget(self)
-                + ("paged", self.bs, self.NB,
-                   bool(flag("FLAGS_use_pallas_kernels")),
-                   bool(flag("FLAGS_paged_attn_interpret"))))
+                + self._paged_sig_suffix())
 
     # --------------------------------------------------------- allocator --
 
@@ -538,16 +550,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                        "P": P, "seg": 0,
                                        "nseg": P // self.prefill_chunk}
                 continue
-            run = self._prefill_prog(P)
-            blkrow = jnp.asarray(self._table[slot, :P // self.bs])
-            ck, cv, tok0, self._presence = run(
-                self.params, self.caches[0], self.caches[1],
-                jnp.asarray([ids], jnp.int32), jnp.int32(pad), blkrow,
-                self._next_key(), self._presence, jnp.int32(slot),
-                self._plane_operands())
-            self.caches = (ck, cv)
-            self._register_prompt_blocks(slot, ids, pad, P)
-            self._activate(slot, req, P, pad, int(tok0))
+            self._run_admission_prefill(slot, req, P, pad, ids)
+
+    def _run_admission_prefill(self, slot, req, P, pad, ids):
+        """Whole-bucket admission prefill for one slot (blocks already
+        ensured).  The speculative composition overrides this with its
+        dual-pool program; the scheduling loop above stays shared."""
+        run = self._prefill_prog(P)
+        blkrow = jnp.asarray(self._table[slot, :P // self.bs])
+        ck, cv, tok0, self._presence = run(
+            self.params, self.caches[0], self.caches[1],
+            jnp.asarray([ids], jnp.int32), jnp.int32(pad), blkrow,
+            self._next_key(), self._presence, jnp.int32(slot),
+            self._plane_operands())
+        self.caches = (ck, cv)
+        self._register_prompt_blocks(slot, ids, pad, P)
+        self._activate(slot, req, P, pad, int(tok0))
 
     def _fill_segments(self):
         seg = self.prefill_chunk
@@ -613,3 +631,135 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             m["prefix_hits"] = float(self.prefix_hits)
             m["prefix_blocks_reused"] = float(self.prefix_blocks_reused)
         return m
+
+
+class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
+                                     PagedContinuousBatchingEngine):
+    """Speculative continuous batching OVER the paged KV cache — the two
+    serving accelerations composed.  The draft keeps its own block POOL
+    but shares the target's block TABLES and allocator: target and draft
+    k/v for a position live under the same block id, so admission,
+    lazy growth (to t + K + 1 per round), retirement, and preemption
+    manage one allocation for both caches.  The spec round runs the SAME
+    `_spec_round_core` as the contiguous engine with pools wrapped as
+    PagedKV (verify chunks take the gather fallback; per-position writes
+    scatter through the tables), so acceptance semantics are shared by
+    construction — outputs stay bit-lossless vs plain greedy.
+
+    v1 scope matches the contiguous speculative engine (greedy only,
+    whole-bucket prefill) plus the paged allocator's deferral/preemption.
+    """
+
+    def __init__(self, model, params, draft_model, draft_params,
+                 max_slots: int, max_len: int, draft_k: int = 4,
+                 prompt_buckets=None, eos_token_id=None, key=None,
+                 block_size: int = 16, num_blocks=None, **kw):
+        # unknown kw flows to the spec base, whose v1 scope guard rejects
+        # prefill_chunk / per_request_sampling / enable_prefix_cache
+        super().__init__(model, params, draft_model, draft_params,
+                         max_slots, max_len, draft_k=draft_k,
+                         prompt_buckets=prompt_buckets,
+                         eos_token_id=eos_token_id, key=key,
+                         block_size=block_size, num_blocks=num_blocks,
+                         **kw)
+    def _alloc_draft_caches(self):
+        # a pool sharing the target's tables — the dense draft cache is
+        # never materialized (the seam exists for exactly this override)
+        return self._build_pool(self.draft_model.config)
+
+    @property
+    def _sig(self):
+        return (SpeculativeBatchingEngine._sig.fget(self)
+                + self._paged_sig_suffix())
+
+    # the paged base's _admit scheduling loop is reused whole (its
+    # prefix/chunked branches are unreachable under the spec v1 guard) —
+    # the explicit alias is needed because the MRO would otherwise pick
+    # SpeculativeBatchingEngine's contiguous _admit; only the per-slot
+    # prefill differs: BOTH pools fill at admission
+    _admit = PagedContinuousBatchingEngine._admit
+
+    def _run_admission_prefill(self, slot, req, P, pad, ids):
+        run = self._prefill_prog(P)
+        blkrow = jnp.asarray(self._table[slot, :P // self.bs])
+        pools, dpools, tok0, self._presence = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, jnp.asarray([ids], jnp.int32),
+            jnp.int32(pad), blkrow, self._next_key(), self._presence,
+            jnp.int32(slot))
+        self.caches, self.draft_caches = pools, dpools
+        self._activate(slot, req, P, pad, int(tok0))
+
+    def _prefill_prog(self, P: int):
+        """Admission prefill scattering BOTH pools' prompt blocks."""
+        model, draft = self.model, self.draft_model
+        bs, nblk = self.bs, P // self.bs
+
+        def build():
+            tail = self._first_token_tail()
+
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def run(params_pair, pools, dpools, ids, pad_len, blkrow, key,
+                    presence, slot):
+                params, dparams = params_pair
+
+                def put(pool, new):                # new: (L, 1, P, …)
+                    r = new.reshape((new.shape[0], nblk, bs)
+                                    + new.shape[3:])
+                    return pool.at[:, blkrow].set(r.astype(pool.dtype))
+
+                h, (ck, cv) = model.prefill(params, ids, P,
+                                            pad_lens=pad_len[None])
+                pools = (jax.tree.map(put, pools[0], ck),
+                         jax.tree.map(put, pools[1], cv))
+                _, (dck, dcv) = draft.prefill(dparams, ids, P,
+                                              pad_lens=pad_len[None])
+                dpools = (jax.tree.map(put, dpools[0], dck),
+                          jax.tree.map(put, dpools[1], dcv))
+                tok, presence = tail(params, h[:, -1:], presence, slot,
+                                     key)
+                return pools, dpools, tok, presence
+
+            return run
+
+        return self._cached_prog(("spec_prefill_paged", P, self._sig),
+                                 build)
+
+    def _run_spec_round(self):
+        # grow every active slot's table to cover this round's write span
+        # [t, t + K + 1) — _prepare_decode's loop with ticks_per_sync
+        # already equal to K + 1 — preempting the youngest when dry
+        if not self._prepare_decode():
+            return None
+        C = self._view_cols()
+        run = self._cached_prog(("spec_round_paged", C, self._sig),
+                                lambda: self._build_spec_round_paged(C))
+        active_before = self._active.copy()
+        # inactive rows pre-zeroed: their parked writes land in trash even
+        # where the clamped column lookup would alias a real block
+        gated = np.where(active_before[:, None], self._table[:, :C], 0)
+        pools, dpools, lead, block = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, jnp.asarray(gated), jnp.asarray(self._tok),
+            jnp.asarray(self._t), jnp.asarray(self._pad))
+        self.caches, self.draft_caches = pools, dpools
+        return active_before, np.asarray(lead), np.asarray(block)
+
+    def _build_spec_round_paged(self, C: int):
+        model, draft, K, S = self.model, self.draft_model, self.K, self.S
+        L = model.config.num_layers
+        Ld = draft.config.num_layers
+        core = SpeculativeBatchingEngine._spec_round_core
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params_pair, pools, dpools, table, toks, ts, pads):
+            tbT = jnp.broadcast_to(table[None], (L,) + table.shape)
+            tbD = jnp.broadcast_to(table[None], (Ld,) + table.shape)
+            big = (PagedKV(pools[0], tbT), PagedKV(pools[1], tbT))
+            dbig = (PagedKV(dpools[0], tbD), PagedKV(dpools[1], tbD))
+            big, dbig, lead, block = core(model, draft, K, S, params_pair,
+                                          big, dbig, toks, ts, pads)
+            return ((big[0].pool, big[1].pool),
+                    (dbig[0].pool, dbig[1].pool), lead, block)
+
+        return run

@@ -1,0 +1,134 @@
+"""Paged speculative continuous batching (PagedSpeculativeBatchingEngine):
+the two serving accelerations composed.  The draft pool shares the
+target's block tables and allocator; the spec round runs the SAME
+_spec_round_core with pools wrapped as PagedKV — so outputs must stay
+bit-lossless vs plain greedy (and vs the contiguous speculative engine),
+and the paged allocator's deferral/preemption must hold under tight
+pools.  Beyond-reference (the snapshot has no serving scheduler)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import (PagedSpeculativeBatchingEngine,
+                                SpeculativeBatchingEngine)
+
+
+def _models(kv=None):
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32", kv_cache_dtype=kv)
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    dcfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=1,
+                     num_attention_heads=4, max_position_embeddings=96,
+                     compute_dtype="float32", kv_cache_dtype=kv)
+    draft = GPTModel(dcfg)
+    dparams = {n: p._data for n, p in draft.named_parameters()}
+    return model, params, draft, dparams
+
+
+def _solo(model, params, p, n):
+    out = model.generate(params, jnp.asarray([p], jnp.int32), n,
+                         greedy=True)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+REQS = [([5, 17, 3], 10), ([40, 2], 6), ([61], 8), ([9, 9, 1], 7)]
+
+
+class TestPagedSpeculative:
+    @pytest.mark.parametrize("K", [1, 3])
+    def test_lossless_vs_solo_and_contiguous(self, K):
+        """Mixed budgets through 2 slots (retirement + reuse): outputs
+        equal plain greedy solo AND the contiguous speculative engine,
+        token for token, with the same round count."""
+        model, params, draft, dparams = _models()
+        paged = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=K, prompt_buckets=[8], block_size=4)
+        rids = [paged.add_request(p, n) for p, n in REQS]
+        got = paged.run_to_completion(max_ticks=300)
+        cont = SpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=K, prompt_buckets=[8])
+        rids_c = [cont.add_request(p, n) for p, n in REQS]
+        got_c = cont.run_to_completion(max_ticks=300)
+        for rid, rc, (p, n) in zip(rids, rids_c, REQS):
+            want = _solo(model, params, p, n)
+            assert got[rid] == want, f"paged diverged (K={K})"
+            assert got_c[rc] == want
+        assert paged.rounds == cont.rounds      # same acceptance schedule
+        assert paged.blocks_in_use == 0
+
+    def test_perfect_draft_minimal_rounds(self):
+        """draft == target: every proposal accepted — one request of N
+        tokens finishes in exactly ceil((N-1)/(K+1)) rounds (the
+        acceptance-degradation regression observable, now on the paged
+        layout)."""
+        model, params, draft, dparams = _models()
+        K, N = 3, 13
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, model, params, max_slots=1, max_len=48,
+            draft_k=K, prompt_buckets=[8], block_size=4)
+        rid = eng.add_request([5, 17, 3], N)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[rid] == _solo(model, params, [5, 17, 3], N)
+        assert eng.rounds == -(-(N - 1) // (K + 1))
+
+    def test_tight_pool_preempts_and_stays_exact(self):
+        """Two long requests cannot both fit: the younger is preempted
+        and rerun, outputs stay greedy-exact, high water respects the
+        cap — the paged allocator composing with spec growth spans."""
+        model, params, draft, dparams = _models()
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=2, prompt_buckets=[8], block_size=4, num_blocks=10)
+        r0 = eng.add_request([5, 17, 3], 24)   # P+mnt+K-1 = 33 -> 9 blocks
+        r1 = eng.add_request([40, 2], 24)
+        got = eng.run_to_completion(max_ticks=500)
+        assert eng.preemptions >= 1
+        assert eng.blocks_high_water <= 10
+        assert got[r0] == _solo(model, params, [5, 17, 3], 24)
+        assert got[r1] == _solo(model, params, [40, 2], 24)
+
+    def test_int8_pools(self):
+        """int8 target AND draft pools through the shared tables."""
+        model, params, draft, dparams = _models(kv="int8")
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=2, prompt_buckets=[8], block_size=8)
+        rids = [eng.add_request(p, n) for p, n in REQS[:3]]
+        got = eng.run_to_completion(max_ticks=300)
+        for rid, (p, n) in zip(rids, REQS[:3]):
+            assert got[rid] == _solo(model, params, p, n)
+
+    def test_program_count_bounded(self):
+        model, params, draft, dparams = _models()
+        model.__dict__.pop("_serving_programs", None)
+
+        def make():
+            return PagedSpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=2, max_len=48,
+                draft_k=2, prompt_buckets=[8], block_size=4)
+
+        eng = make()
+        for p, n in REQS[:3]:
+            eng.add_request(p, n)
+        eng.run_to_completion(max_ticks=300)
+        n_progs = len(model._serving_programs)
+        eng2 = make()
+        eng2.add_request(REQS[3][0], REQS[3][1])
+        eng2.run_to_completion(max_ticks=300)
+        assert len(model._serving_programs) == n_progs
+
+    def test_v1_scope_guards(self):
+        model, params, draft, dparams = _models()
+        with pytest.raises(NotImplementedError, match="prefill_chunk"):
+            PagedSpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=2, max_len=48,
+                prompt_buckets=[8], block_size=4, prefill_chunk=4)
