@@ -248,6 +248,30 @@ def main():
         out["speculative_tok_s"] = round(total_tokens / spec_dt, 1)
         out["speculative_rounds"] = rounds
         out["speculative_speedup"] = round(engine_dt / spec_dt, 3)
+
+        # the composed engine: speculative decoding over the paged pool
+        from paddle_tpu.serving import PagedSpeculativeBatchingEngine
+        blk_s = 16 if args.cpu else 32
+        max_len_ps = -(-(max_len + SPEC_SLACK) // blk_s) * blk_s
+
+        def run_spec_paged():
+            eng = PagedSpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=S,
+                max_len=max_len_ps, draft_k=4, prompt_buckets=[P_bucket],
+                block_size=blk_s)
+            for p, n in zip(prompts, budgets):
+                eng.add_request(p, n)
+            got = eng.run_to_completion(max_ticks=100000)
+            assert sum(len(v) for v in got.values()) == total_tokens
+            return eng
+
+        run_spec_paged()  # warmup compile
+        t0 = time.perf_counter()
+        eng_sp = run_spec_paged()
+        sp_dt = time.perf_counter() - t0
+        out["spec_paged_tok_s"] = round(total_tokens / sp_dt, 1)
+        out["spec_paged_rounds"] = eng_sp.rounds
+        out["spec_paged_blocks_high_water"] = eng_sp.blocks_high_water
       except Exception as e:  # noqa: BLE001 - report, don't lose the line
         out["speculative_error"] = f"{type(e).__name__}: {e}"[:200]
 
