@@ -132,3 +132,35 @@ class TestPagedSpeculative:
             PagedSpeculativeBatchingEngine(
                 model, params, draft, dparams, max_slots=2, max_len=48,
                 prompt_buckets=[8], block_size=4, prefill_chunk=4)
+
+
+class TestPagedSpecFuzz:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [0, 3, 6])
+    def test_random_schedules_match_solo(self, seed):
+        """Randomized paged-speculative schedules: random draft_k, block
+        size, pool size (down to the deferral regime), prompts, budgets,
+        and staggered admission — every request equals solo greedy and
+        the pool drains to zero."""
+        model, params, draft, dparams = _models()
+        rng = np.random.RandomState(100 + seed)
+        K = int(rng.choice([1, 2, 4]))
+        bs = int(rng.choice([4, 8]))
+        worst = -(-(16 + 11 + K - 1) // bs)
+        nb = int(rng.randint(worst, worst * 3))
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams,
+            max_slots=int(rng.randint(1, 4)), max_len=48, draft_k=K,
+            prompt_buckets=[8, 16], block_size=bs, num_blocks=nb)
+        reqs = []
+        for _ in range(int(rng.randint(3, 8))):
+            p = [int(t) for t in rng.randint(1, 97, rng.randint(1, 15))]
+            n = int(rng.randint(1, 12))
+            reqs.append((eng.add_request(p, n), p, n))
+            for _ in range(int(rng.randint(0, 3))):
+                eng.step()
+        got = eng.run_to_completion(max_ticks=1000)
+        for rid, p, n in reqs:
+            assert got[rid] == _solo(model, params, p, n), \
+                (seed, K, bs, nb, rid)
+        assert eng.blocks_in_use == 0
