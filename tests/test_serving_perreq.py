@@ -212,3 +212,26 @@ class TestPerRequestSampling:
             eng.add_request(PROMPTS[0], 4, min_new_tokens=2)
         with pytest.raises(TypeError, match="unknown"):
             eng.add_request(PROMPTS[0], 4, banana=1)
+
+
+class TestPerRequestTP:
+    def test_tp_mesh_with_per_request_planes(self, model_and_params):
+        """Per-request planes compose with tensor-parallel serving: the
+        (S,) config operands replicate under GSPMD while params/cache
+        shard — mixed configs stay oracle-exact on a 4-device mesh."""
+        import jax
+        from jax.sharding import Mesh
+        model, params = model_and_params
+        if len(jax.devices()) < 4:
+            pytest.skip("needs the 8-device CPU mesh conftest")
+        mesh = Mesh(np.asarray(jax.devices()[:4]), ("model",))
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8],
+                                       ticks_per_sync=2, mesh=mesh,
+                                       per_request_sampling=True)
+        r0 = eng.add_request(PROMPTS[0], 8, repetition_penalty=5.0)
+        r1 = eng.add_request(PROMPTS[1], 6)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[r0] == _solo(model, params, PROMPTS[0], 8, greedy=True,
+                                repetition_penalty=5.0)
+        assert got[r1] == _solo(model, params, PROMPTS[1], 6, greedy=True)
