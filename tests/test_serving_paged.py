@@ -354,3 +354,32 @@ class TestPagedFuzz:
                 f"penalty={penalty} eos={eos} kv={kv} "
                 f"preempt={eng.preemptions}")
         assert eng.blocks_in_use == 0
+
+
+class TestLongContextServing:
+    def test_large_max_len_compiles_only_small_programs(self):
+        """The long-context serving story: an engine provisioned for
+        max_len=2048 serving SHORT requests compiles only the small
+        length-bucket decode programs (C covering actual clocks, not
+        MB=128) and the pool holds only resident blocks — provisioned
+        capacity costs neither compile time nor per-sync transients."""
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=2048,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        model.__dict__.pop("_serving_programs", None)
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=3, max_len=2048, block_size=16,
+            num_blocks=64, prompt_buckets=[16])
+        rids = [eng.add_request(p, 12) for p in PROMPTS[:3]]
+        got = eng.run_to_completion(max_ticks=100)
+        for rid, p in zip(rids, PROMPTS[:3]):
+            assert got[rid] == _solo_greedy(model, params, p, 12)
+        cols = [k[1] for k in model._serving_programs if k[0] == "decode"]
+        # 16-token bucket + 12 tokens needs 2 blocks of 16: C=2, never 128
+        assert cols and max(cols) <= 2, cols
+        assert eng.blocks_high_water <= 3 * 2
+        assert eng.blocks_in_use == 0
