@@ -831,11 +831,13 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                  key=None, mesh=None, **cache_kw):
         if mesh is not None:
             raise NotImplementedError("speculative engine v1 is single-mesh")
-        # cache_kw forwards ONLY storage-layout args to the paged cache
-        # base (PagedSpeculative composition); everything else - sampler
-        # knobs the greedy spec round would silently ignore, chunked
-        # prefill, prefix caching - is rejected loudly
-        bad = set(cache_kw) - {"block_size", "num_blocks"}
+        # cache_kw forwards ONLY storage-layout args (and prefix caching,
+        # which the paged composition supports: shared tables mean cached
+        # prompt blocks hold BOTH models' k/v) to the paged cache base;
+        # everything else - sampler knobs the greedy spec round would
+        # silently ignore, chunked prefill - is rejected loudly
+        bad = set(cache_kw) - {"block_size", "num_blocks",
+                               "enable_prefix_cache"}
         if bad:
             raise NotImplementedError(
                 f"speculative engine v1 does not support {sorted(bad)}")

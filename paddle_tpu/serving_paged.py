@@ -361,6 +361,34 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         return self._cached_prog(("cpre", P, F, self._sig),
                                  lambda: self._build_cached_prefill(P, F))
 
+    @staticmethod
+    def _suffix_prefill(m, prm, pools, ids, pad, tabrow, t0, P, bs):
+        """ONE model's suffix prefill over its pools: gather the slot's
+        table view, embed+decode positions [t0, P) through the chunk
+        path (attending to the cached prefix), scatter the suffix back.
+        Shared by the plain and speculative cached-prefill programs so
+        the mechanics cannot drift."""
+        def take(p):
+            g = p[:, tabrow]
+            g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
+                          + g.shape[3:])
+            return g[:, None]
+
+        ck_s = jax.tree.map(take, pools[0])
+        cv_s = jax.tree.map(take, pools[1])
+        h = m._embed_chunk(prm, ids[0, t0:], t0, pad_lens=pad[None])
+        h, (ck_s, cv_s) = m.decode_step(prm, h, (ck_s, cv_s), t0,
+                                        pad_lens=pad[None])
+        span = t0 + jnp.arange(P - t0)
+        pb = tabrow[span // bs]
+        off = span % bs
+
+        def put(pool, v):
+            chunk = v[:, 0, span]
+            return pool.at[:, pb, off].set(chunk.astype(pool.dtype))
+        return h, (jax.tree.map(put, pools[0], ck_s),
+                   jax.tree.map(put, pools[1], cv_s))
+
     def _build_cached_prefill(self, P: int, F: int):
         """Admission prefill with the first F blocks already cached: embed
         and run ONLY the suffix [F·bs, P) through the chunk-decode path,
@@ -374,32 +402,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         tail = self._first_token_tail()
         bs = self.bs
         t0 = F * bs
+        suffix_prefill = self._suffix_prefill
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
         def run(params, pool_ck, pool_cv, ids, pad, tabrow, key, presence,
                 slot, planes):
-            def take(p):                             # slot's logical view
-                g = p[:, tabrow]
-                g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
-                              + g.shape[3:])
-                return g[:, None]
-            ck_s = jax.tree.map(take, pool_ck)
-            cv_s = jax.tree.map(take, pool_cv)
-            h = model._embed_chunk(params, ids[0, t0:], t0,
-                                   pad_lens=pad[None])
-            h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s),
-                                                t0, pad_lens=pad[None])
-
-            span = t0 + jnp.arange(P - t0)
-            pb = tabrow[span // bs]
-            off = span % bs
-
-            def put(pool, v):
-                chunk = v[:, 0, span]
-                return pool.at[:, pb, off].set(chunk.astype(pool.dtype))
-            pool_ck = jax.tree.map(put, pool_ck, ck_s)
-            pool_cv = jax.tree.map(put, pool_cv, cv_s)
-
+            h, (pool_ck, pool_cv) = suffix_prefill(
+                model, params, (pool_ck, pool_cv), ids, pad, tabrow, t0,
+                P, bs)
             if track:
                 # the presence row seeds from the FULL prompt — shared
                 # prefix tokens count for the repetition penalty too
@@ -518,18 +528,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 self.blocks_high_water = max(self.blocks_high_water,
                                              self.blocks_in_use)
                 self._set_planes(slot, req)
-                run = self._cached_prefill_prog(P, F)
-                ck, cv, tok0, self._presence = run(
-                    self.params, self.caches[0], self.caches[1],
-                    jnp.asarray([ids], jnp.int32), jnp.int32(pad),
-                    jnp.asarray(self._table[slot]), self._next_key(),
-                    self._presence, jnp.int32(slot),
-                    self._plane_operands())
-                self.caches = (ck, cv)
+                self._run_cached_prefill(slot, req, P, pad, ids, F)
                 self.prefix_hits += 1
                 self.prefix_blocks_reused += F
-                self._register_prompt_blocks(slot, ids, pad, P)
-                self._activate(slot, req, P, pad, int(tok0))
                 continue
             # whole-bucket admission needs its P/bs blocks NOW; chunked
             # admission grows per segment.  A dry pool defers admission
@@ -551,6 +552,19 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                        "nseg": P // self.prefill_chunk}
                 continue
             self._run_admission_prefill(slot, req, P, pad, ids)
+
+    def _run_cached_prefill(self, slot, req, P, pad, ids, F):
+        """Prefix-hit admission: compute only the suffix (seam — the
+        speculative composition fills BOTH pools' suffixes)."""
+        run = self._cached_prefill_prog(P, F)
+        ck, cv, tok0, self._presence = run(
+            self.params, self.caches[0], self.caches[1],
+            jnp.asarray([ids], jnp.int32), jnp.int32(pad),
+            jnp.asarray(self._table[slot]), self._next_key(),
+            self._presence, jnp.int32(slot), self._plane_operands())
+        self.caches = (ck, cv)
+        self._register_prompt_blocks(slot, ids, pad, P)
+        self._activate(slot, req, P, pad, int(tok0))
 
     def _run_admission_prefill(self, slot, req, P, pad, ids):
         """Whole-bucket admission prefill for one slot (blocks already
@@ -655,7 +669,8 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
                  prompt_buckets=None, eos_token_id=None, key=None,
                  block_size: int = 16, num_blocks=None, **kw):
         # unknown kw flows to the spec base, whose v1 scope guard rejects
-        # prefill_chunk / per_request_sampling / enable_prefix_cache
+        # prefill_chunk / per_request_sampling (enable_prefix_cache IS
+        # supported by this composition and passes the allowlist)
         super().__init__(model, params, draft_model, draft_params,
                          max_slots, max_len, draft_k=draft_k,
                          prompt_buckets=prompt_buckets,
@@ -672,9 +687,10 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
         return (SpeculativeBatchingEngine._sig.fget(self)
                 + self._paged_sig_suffix())
 
-    # the paged base's _admit scheduling loop is reused whole (its
-    # prefix/chunked branches are unreachable under the spec v1 guard) —
-    # the explicit alias is needed because the MRO would otherwise pick
+    # the paged base's _admit scheduling loop is reused whole (chunked
+    # admission stays unreachable under the spec v1 guard; the PREFIX
+    # branch is live and dispatches to _run_cached_prefill below) — the
+    # explicit alias is needed because the MRO would otherwise pick
     # SpeculativeBatchingEngine's contiguous _admit; only the per-slot
     # prefill differs: BOTH pools fill at admission
     _admit = PagedContinuousBatchingEngine._admit
@@ -688,6 +704,7 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
             jnp.int32(pad), blkrow, self._next_key(), self._presence,
             jnp.int32(slot))
         self.caches, self.draft_caches = pools, dpools
+        self._register_prompt_blocks(slot, ids, pad, P)
         self._activate(slot, req, P, pad, int(tok0))
 
     def _prefill_prog(self, P: int):
@@ -724,6 +741,42 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
 
         return self._cached_prog(("spec_prefill_paged", P, self._sig),
                                  build)
+
+    def _run_cached_prefill(self, slot, req, P, pad, ids, F):
+        """Prefix-hit admission for the composition: shared tables mean
+        the cached blocks already hold BOTH models' k/v — only the two
+        SUFFIXES are computed."""
+        run = self._cached_prog(("spec_cpre", P, F, self._sig),
+                                lambda: self._build_spec_cached_prefill(
+                                    P, F))
+        pools, dpools, tok0, self._presence = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, jnp.asarray([ids], jnp.int32),
+            jnp.int32(pad), jnp.asarray(self._table[slot]),
+            self._next_key(), self._presence, jnp.int32(slot))
+        self.caches, self.draft_caches = pools, dpools
+        self._register_prompt_blocks(slot, ids, pad, P)
+        self._activate(slot, req, P, pad, int(tok0))
+
+    def _build_spec_cached_prefill(self, P: int, F: int):
+        model, draft = self.model, self.draft_model
+        bs = self.bs
+        t0 = F * bs
+        tail = self._first_token_tail()
+        suffix_prefill = self._suffix_prefill
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params_pair, pools, dpools, ids, pad, tabrow, key,
+                presence, slot):
+            params, dparams = params_pair
+            h, pools = suffix_prefill(model, params, pools, ids, pad,
+                                      tabrow, t0, P, bs)
+            _, dpools = suffix_prefill(draft, dparams, dpools, ids, pad,
+                                       tabrow, t0, P, bs)
+            tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return pools, dpools, tok, presence
+
+        return run
 
     def _run_spec_round(self):
         # grow every active slot's table to cover this round's write span

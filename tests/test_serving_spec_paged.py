@@ -164,3 +164,59 @@ class TestPagedSpecFuzz:
             assert got[rid] == _solo(model, params, p, n), \
                 (seed, K, bs, nb, rid)
         assert eng.blocks_in_use == 0
+
+
+class TestPagedSpecPrefixCache:
+    def test_identical_prompt_hit_lossless_same_rounds(self):
+        """Prefix caching composes with speculation: shared tables mean a
+        cached prompt block holds BOTH models' k/v, so a hit is lossless
+        AND keeps the same acceptance schedule (equal round counts cold
+        vs warm — the cached DRAFT prefix must be right, not just the
+        target's)."""
+        model, params, draft, dparams = _models()
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=2, prompt_buckets=[16], block_size=4,
+            enable_prefix_cache=True)
+        LONG = list(range(3, 17))
+        r0 = eng.add_request(LONG, 8)
+        g0 = eng.run_to_completion(max_ticks=200)
+        cold = eng.rounds
+        r1 = eng.add_request(LONG, 8)
+        g1 = eng.run_to_completion(max_ticks=200)
+        want = _solo(model, params, LONG, 8)
+        assert g0[r0] == want and g1[r1] == want
+        assert eng.prefix_hits == 1 and eng.prefix_blocks_reused == 3
+        assert eng.rounds == 2 * cold
+
+    def test_concurrent_sharing_with_speculation(self):
+        """Two same-prefix requests decode speculatively side by side with
+        refcounted shared blocks; both stay exact."""
+        model, params, draft, dparams = _models()
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=2, prompt_buckets=[16], block_size=4,
+            enable_prefix_cache=True)
+        a = [7] * 2 + list(range(20, 32))
+        b = a[:8] + list(range(70, 76))         # same length, shared 8
+        r0 = eng.add_request(a, 6)
+        eng.step()                              # a admitted + decoding
+        r1 = eng.add_request(b, 10)
+        got = eng.run_to_completion(max_ticks=300)
+        assert got[r0] == _solo(model, params, a, 6)
+        assert got[r1] == _solo(model, params, b, 10)
+        assert eng.prefix_hits == 1 and eng.prefix_blocks_reused == 2
+
+    def test_int8_dual_pool_prefix(self):
+        model, params, draft, dparams = _models(kv="int8")
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=48,
+            draft_k=2, prompt_buckets=[16], block_size=8,
+            enable_prefix_cache=True)
+        LONG = list(range(3, 17))
+        r0 = eng.add_request(LONG, 6)
+        eng.run_to_completion(max_ticks=200)
+        r1 = eng.add_request(LONG, 6)
+        got = eng.run_to_completion(max_ticks=200)
+        assert eng.prefix_hits == 1
+        assert got[r1] == _solo(model, params, LONG, 6)
