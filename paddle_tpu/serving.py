@@ -822,8 +822,11 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     target's; both caches self-heal — each round's chunk rewrites
     [t, t+K+1) BEFORE reading any of it, so leftover k/v from rejected
     proposals (and inactive slots' parked stale writes) are never read.
-    v1 scope: greedy only, no processors, whole-bucket prefill only.
+    v1 scope: greedy only, no processors, whole-bucket prefill only
+    (the paged composition lifts the prefill restriction).
     """
+
+    _SUPPORTED_CACHE_KW = frozenset()
 
     def __init__(self, model, params, draft_model, draft_params,
                  max_slots: int, max_len: int, draft_k: int = 4,
@@ -831,16 +834,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                  key=None, mesh=None, **cache_kw):
         if mesh is not None:
             raise NotImplementedError("speculative engine v1 is single-mesh")
-        # cache_kw forwards ONLY storage-layout args (and prefix caching,
-        # which the paged composition supports: shared tables mean cached
-        # prompt blocks hold BOTH models' k/v) to the paged cache base;
-        # everything else - sampler knobs the greedy spec round would
-        # silently ignore, chunked prefill - is rejected loudly
-        bad = set(cache_kw) - {"block_size", "num_blocks",
-                               "enable_prefix_cache"}
+        # cache_kw forwards ONLY the class-supported extras (the paged
+        # composition widens _SUPPORTED_CACHE_KW: storage layout, prefix
+        # caching, chunked prefill); everything else - e.g. sampler knobs
+        # the greedy spec round would silently ignore - is rejected loudly
+        bad = set(cache_kw) - self._SUPPORTED_CACHE_KW
         if bad:
             raise NotImplementedError(
-                f"speculative engine v1 does not support {sorted(bad)}")
+                f"{type(self).__name__} does not support {sorted(bad)}")
         super().__init__(model, params, max_slots, max_len,
                          prompt_buckets=prompt_buckets, greedy=True,
                          eos_token_id=eos_token_id, key=key,
@@ -1022,9 +1023,12 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         return big, dbig, lead, block
 
     def step(self):
-        """One scheduler round: admit, then one speculative round; each
-        active slot advances by its own accepted count + 1."""
+        """One scheduler round: admit (advancing any chunked fills in
+        the paged composition), then one speculative round; each active
+        slot advances by its own accepted count + 1."""
         self._admit()
+        if self._filling:
+            self._fill_segments()
         if not self._active.any():
             return
         res = self._run_spec_round()

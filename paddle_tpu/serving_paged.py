@@ -362,12 +362,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                  lambda: self._build_cached_prefill(P, F))
 
     @staticmethod
-    def _suffix_prefill(m, prm, pools, ids, pad, tabrow, t0, P, bs):
-        """ONE model's suffix prefill over its pools: gather the slot's
-        table view, embed+decode positions [t0, P) through the chunk
-        path (attending to the cached prefix), scatter the suffix back.
-        Shared by the plain and speculative cached-prefill programs so
-        the mechanics cannot drift."""
+    def _suffix_prefill(m, prm, pools, toks, t0, pad, tabrow, bs):
+        """ONE model's chunk prefill over its pools: gather the slot's
+        table view, embed+decode the ``toks`` (1, n) chunk at positions
+        [t0, t0+n) through the chunk path (attending to everything the
+        table already holds), scatter the span back.  ``t0`` may be a
+        TRACED scalar (segment programs reuse one compilation across
+        positions) or static (cached-prefill suffixes).  Shared by the
+        plain and speculative cached-prefill AND segment programs so the
+        mechanics cannot drift."""
         def take(p):
             g = p[:, tabrow]
             g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
@@ -376,11 +379,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         ck_s = jax.tree.map(take, pools[0])
         cv_s = jax.tree.map(take, pools[1])
-        h = m._embed_chunk(prm, ids[0, t0:], t0, pad_lens=pad[None])
+        h = m._embed_chunk(prm, toks[0], t0, pad_lens=pad[None])
         h, (ck_s, cv_s) = m.decode_step(prm, h, (ck_s, cv_s), t0,
                                         pad_lens=pad[None])
-        span = t0 + jnp.arange(P - t0)
-        pb = tabrow[span // bs]
+        span = t0 + jnp.arange(toks.shape[1])
+        pb = tabrow[jnp.minimum(span // bs, tabrow.shape[0] - 1)]
         off = span % bs
 
         def put(pool, v):
@@ -408,8 +411,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         def run(params, pool_ck, pool_cv, ids, pad, tabrow, key, presence,
                 slot, planes):
             h, (pool_ck, pool_cv) = suffix_prefill(
-                model, params, (pool_ck, pool_cv), ids, pad, tabrow, t0,
-                P, bs)
+                model, params, (pool_ck, pool_cv), ids[:, t0:], t0, pad,
+                tabrow, bs)
             if track:
                 # the presence row seeds from the FULL prompt — shared
                 # prefix tokens count for the repetition penalty too
@@ -597,22 +600,34 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 if not self._active.any():
                     self._preempt_one()
                 continue
-            toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
-            run = self._seg_prog(seg, first, last)
-            ck, cv, tok0, self._presence = run(
-                self.params, self.caches[0], self.caches[1], toks,
-                jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
-                self._presence, self._next_key(),
-                jnp.asarray(self._table[slot]), self._plane_operands())
-            self.caches = (ck, cv)
+            tok0 = self._run_fill_segment(slot, st, i, first, last)
             if last:
                 del self._filling[slot]
                 self._register_prompt_blocks(slot, st["ids"], st["pad"],
                                              st["P"])
+                # the ONLY host-device sync of the whole fill: non-last
+                # segments return the device dummy unconverted so segment
+                # programs pipeline under async dispatch
                 self._activate(slot, st["req"], st["P"], st["pad"],
                                int(tok0))
             else:
                 st["seg"] += 1
+
+    def _run_fill_segment(self, slot, st, i, first, last):
+        """Run ONE prefill segment's device program (seam — the
+        speculative composition fills both pools).  Returns the
+        first-token value as a DEVICE array (dummy 0 unless ``last``);
+        the fill loop converts once at activation."""
+        seg = self.prefill_chunk
+        toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
+        run = self._seg_prog(seg, first, last)
+        ck, cv, tok0, self._presence = run(
+            self.params, self.caches[0], self.caches[1], toks,
+            jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
+            self._presence, self._next_key(),
+            jnp.asarray(self._table[slot]), self._plane_operands())
+        self.caches = (ck, cv)
+        return tok0                        # device value; caller converts
 
     def _prepare_decode(self) -> bool:
         k = self.ticks_per_sync
@@ -660,17 +675,22 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
     scatter through the tables), so acceptance semantics are shared by
     construction — outputs stay bit-lossless vs plain greedy.
 
-    v1 scope matches the contiguous speculative engine (greedy only,
-    whole-bucket prefill) plus the paged allocator's deferral/preemption.
+    Scope: greedy only (like the contiguous speculative engine), but
+    BOTH chunked prefill and prefix caching compose here — the paged
+    allocator's deferral/preemption included.
     """
+
+    _SUPPORTED_CACHE_KW = frozenset({"block_size", "num_blocks",
+                                     "enable_prefix_cache",
+                                     "prefill_chunk"})
 
     def __init__(self, model, params, draft_model, draft_params,
                  max_slots: int, max_len: int, draft_k: int = 4,
                  prompt_buckets=None, eos_token_id=None, key=None,
                  block_size: int = 16, num_blocks=None, **kw):
-        # unknown kw flows to the spec base, whose v1 scope guard rejects
-        # prefill_chunk / per_request_sampling (enable_prefix_cache IS
-        # supported by this composition and passes the allowlist)
+        # unknown kw flows to the spec base, whose scope guard admits
+        # only _SUPPORTED_CACHE_KW (this composition: prefix caching and
+        # chunked prefill) plus the storage args below
         super().__init__(model, params, draft_model, draft_params,
                          max_slots, max_len, draft_k=draft_k,
                          prompt_buckets=prompt_buckets,
@@ -687,12 +707,12 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
         return (SpeculativeBatchingEngine._sig.fget(self)
                 + self._paged_sig_suffix())
 
-    # the paged base's _admit scheduling loop is reused whole (chunked
-    # admission stays unreachable under the spec v1 guard; the PREFIX
-    # branch is live and dispatches to _run_cached_prefill below) — the
-    # explicit alias is needed because the MRO would otherwise pick
-    # SpeculativeBatchingEngine's contiguous _admit; only the per-slot
-    # prefill differs: BOTH pools fill at admission
+    # the paged base's _admit scheduling loop is reused whole — its
+    # PREFIX branch dispatches to _run_cached_prefill and its CHUNKED
+    # branch parks fillers advanced by _run_fill_segment, both overridden
+    # below with dual-pool programs.  The explicit alias is needed
+    # because the MRO would otherwise pick SpeculativeBatchingEngine's
+    # contiguous _admit
     _admit = PagedContinuousBatchingEngine._admit
 
     def _run_admission_prefill(self, slot, req, P, pad, ids):
@@ -769,11 +789,51 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
         def run(params_pair, pools, dpools, ids, pad, tabrow, key,
                 presence, slot):
             params, dparams = params_pair
-            h, pools = suffix_prefill(model, params, pools, ids, pad,
-                                      tabrow, t0, P, bs)
-            _, dpools = suffix_prefill(draft, dparams, dpools, ids, pad,
-                                       tabrow, t0, P, bs)
+            h, pools = suffix_prefill(model, params, pools, ids[:, t0:],
+                                      t0, pad, tabrow, bs)
+            _, dpools = suffix_prefill(draft, dparams, dpools,
+                                       ids[:, t0:], t0, pad, tabrow, bs)
             tok, presence = tail(params, h[:, -1:], presence, slot, key)
+            return pools, dpools, tok, presence
+
+        return run
+
+    def _run_fill_segment(self, slot, st, i, first, last):
+        """One chunked-prefill segment filling BOTH pools (the spec
+        composition of the paged base's seam).  The filler's parked
+        clock keeps concurrent SPEC ROUNDS' K+1-wide stale writes in
+        trash exactly as plain decode ticks.  Returns the device-array
+        first token (dummy unless ``last``)."""
+        seg = self.prefill_chunk
+        toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
+        run = self._cached_prog(("spec_seg", seg, last, self._sig),
+                                lambda: self._build_spec_seg(seg, last))
+        pools, dpools, tok0, self._presence = run(
+            (self.params, self.draft_params), self.caches,
+            self.draft_caches, toks, jnp.int32(i * seg),
+            jnp.int32(st["pad"]), jnp.int32(slot), self._presence,
+            self._next_key(), jnp.asarray(self._table[slot]))
+        self.caches, self.draft_caches = pools, dpools
+        return tok0                        # device value; caller converts
+
+    def _build_spec_seg(self, seg: int, last: bool):
+        model, draft = self.model, self.draft_model
+        bs = self.bs
+        tail = self._first_token_tail()
+        suffix_prefill = self._suffix_prefill
+
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def run(params_pair, pools, dpools, toks, t0, pad, slot, presence,
+                key, tabrow):
+            params, dparams = params_pair
+            h, pools = suffix_prefill(model, params, pools, toks, t0, pad,
+                                      tabrow, bs)
+            _, dpools = suffix_prefill(draft, dparams, dpools, toks, t0,
+                                       pad, tabrow, bs)
+            tok = jnp.int32(0)
+            if last:
+                tok, presence = tail(params, h[:, -1:], presence, slot,
+                                     key)
             return pools, dpools, tok, presence
 
         return run

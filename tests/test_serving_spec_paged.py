@@ -128,10 +128,18 @@ class TestPagedSpeculative:
 
     def test_v1_scope_guards(self):
         model, params, draft, dparams = _models()
-        with pytest.raises(NotImplementedError, match="prefill_chunk"):
+        # sampler knobs the greedy round would ignore: rejected loudly
+        with pytest.raises(NotImplementedError, match="min_new_tokens"):
             PagedSpeculativeBatchingEngine(
                 model, params, draft, dparams, max_slots=2, max_len=48,
-                prompt_buckets=[8], block_size=4, prefill_chunk=4)
+                prompt_buckets=[8], block_size=4, min_new_tokens=2)
+        # the CONTIGUOUS spec engine still rejects chunked prefill (its
+        # step has no paged filler machinery); the paged composition
+        # supports it (TestPagedSpecChunked)
+        with pytest.raises(NotImplementedError, match="prefill_chunk"):
+            SpeculativeBatchingEngine(
+                model, params, draft, dparams, max_slots=2, max_len=48,
+                prompt_buckets=[8], prefill_chunk=4)
 
 
 class TestPagedSpecFuzz:
@@ -220,3 +228,41 @@ class TestPagedSpecPrefixCache:
         got = eng.run_to_completion(max_ticks=200)
         assert eng.prefix_hits == 1
         assert got[r1] == _solo(model, params, LONG, 6)
+
+
+class TestPagedSpecChunked:
+    def test_chunked_fill_under_speculative_decode(self):
+        """A long prompt chunk-fills over 4 rounds while another request
+        decodes SPECULATIVELY next door — the filler's parked clock must
+        keep the K+1-wide stale writes in trash; both outputs lossless."""
+        model, params, draft, dparams = _models()
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=64,
+            draft_k=2, prompt_buckets=[4, 16], block_size=4,
+            prefill_chunk=4)
+        r0 = eng.add_request([40, 2], 20)      # bucket 4: decodes all test
+        LONG = list(range(3, 19))              # bucket 16, pad 0: 4 segs
+        r1 = eng.add_request(LONG, 8)
+        got = eng.run_to_completion(max_ticks=300)
+        assert got[r0] == _solo(model, params, [40, 2], 20)
+        assert got[r1] == _solo(model, params, LONG, 8)
+
+    def test_chunked_plus_prefix_plus_speculation(self):
+        """All three compose: a warm prefix hit whose suffix fits one
+        chunk bypasses chunked admission entirely, stays lossless, and
+        keeps the acceptance schedule."""
+        model, params, draft, dparams = _models()
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=64,
+            draft_k=2, prompt_buckets=[16], block_size=4,
+            prefill_chunk=4, enable_prefix_cache=True)
+        LONG = list(range(3, 17))
+        r0 = eng.add_request(LONG, 8)
+        g0 = eng.run_to_completion(max_ticks=300)
+        cold = eng.rounds
+        r1 = eng.add_request(LONG, 8)
+        g1 = eng.run_to_completion(max_ticks=300)
+        want = _solo(model, params, LONG, 8)
+        assert g0[r0] == want and g1[r1] == want
+        assert eng.prefix_hits == 1
+        assert eng.rounds == 2 * cold
