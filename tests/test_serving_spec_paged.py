@@ -17,7 +17,14 @@ from paddle_tpu.serving import (PagedSpeculativeBatchingEngine,
                                 SpeculativeBatchingEngine)
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=None)
 def _models(kv=None):
+    """Memoized per kv flag: all tests share the same model OBJECTS, so
+    compiled serving programs (cached on the model) are built once per
+    signature for the whole file instead of once per test."""
     paddle.seed(11)
     cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
                     num_attention_heads=4, max_position_embeddings=96,
