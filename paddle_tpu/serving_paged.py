@@ -212,12 +212,18 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _chain_keys(self, ids, pad, nblocks):
         """The chain key for each of the first ``nblocks`` prompt blocks:
-        (pad, tokens through block i) — exact content, no hashing (a
-        production build would hash the chain)."""
-        out, chain = [], (pad,)
+        a ROLLING sha1 over (pad, tokens through block i).  O(1)-sized
+        keys and O(P) total work per admission — nested token tuples
+        would make every dict operation on the TTFT path re-hash the
+        whole prefix (O(P^2) per admission)."""
+        import hashlib
+        out = []
+        digest = hashlib.sha1(str(pad).encode()).digest()
         for i in range(nblocks):
-            chain = chain + tuple(ids[i * self.bs:(i + 1) * self.bs])
-            out.append(chain)
+            block = np.asarray(ids[i * self.bs:(i + 1) * self.bs],
+                               np.int64).tobytes()
+            digest = hashlib.sha1(digest + block).digest()
+            out.append(digest)
         return out
 
     def _lookup_prefix(self, ids, pad, P):
@@ -316,30 +322,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         V = model.config.vocab_size
         tail = self._first_token_tail()
         bs = self.bs
+        suffix_prefill = self._suffix_prefill
 
         @partial(jax.jit, donate_argnums=(1, 2, 7))
         def run(params, pool_ck, pool_cv, toks, t0, pad, slot, presence,
                 key, tabrow, planes):
-            def take(p):                             # one slot's view
-                g = p[:, tabrow]                     # (L, MB, bs, …)
-                g = g.reshape((g.shape[0], g.shape[1] * g.shape[2])
-                              + g.shape[3:])
-                return g[:, None]                    # (L, 1, T, …)
-            ck_s = jax.tree.map(take, pool_ck)
-            cv_s = jax.tree.map(take, pool_cv)
-            h = model._embed_chunk(params, toks[0], t0, pad_lens=pad[None])
-            h, (ck_s, cv_s) = model.decode_step(params, h, (ck_s, cv_s), t0,
-                                                pad_lens=pad[None])
-
-            span = t0 + jnp.arange(seg)              # logical positions
-            pb = tabrow[span // bs]
-            off = span % bs
-
-            def put(pool, v):                        # v: (L, 1, T, …)
-                chunk = v[:, 0, span]                # (L, seg, …)
-                return pool.at[:, pb, off].set(chunk.astype(pool.dtype))
-            pool_ck = jax.tree.map(put, pool_ck, ck_s)
-            pool_cv = jax.tree.map(put, pool_cv, cv_s)
+            h, (pool_ck, pool_cv) = suffix_prefill(
+                model, params, (pool_ck, pool_cv), toks, t0, pad, tabrow,
+                bs)
 
             if track:
                 if first:

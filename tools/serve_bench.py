@@ -164,7 +164,8 @@ def main():
         # rather than silently reusing)
         import jax as _jax
         if _jax.default_backend() == "tpu":
-            from paddle_tpu.core.flags import set_flags
+            from paddle_tpu.core.flags import flag, set_flags
+            prior = bool(flag("FLAGS_use_pallas_kernels"))
             try:
                 set_flags({"FLAGS_use_pallas_kernels": False})
                 run_paged()  # warmup the fallback programs
@@ -174,7 +175,9 @@ def main():
                 out["paged_fallback_tok_s"] = round(total_tokens / fb_dt, 1)
                 out["paged_kernel_speedup"] = round(fb_dt / paged_dt, 3)
             finally:
-                set_flags({"FLAGS_use_pallas_kernels": True})
+                # restore the OPERATOR's setting (they may have the kill
+                # switch deliberately off after a Mosaic miscompile)
+                set_flags({"FLAGS_use_pallas_kernels": prior})
     except Exception as e:  # noqa: BLE001 - report, don't lose the line
         out["paged_error"] = f"{type(e).__name__}: {e}"[:200]
 
