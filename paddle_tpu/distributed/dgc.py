@@ -27,6 +27,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .spmd import shard_map as _shard_map
+
 __all__ = ["make_dgc_train_step"]
 
 
@@ -144,7 +146,7 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         # scattering all_gather'd (vals, idx) pairs — value-identical on
         # every replica, but the VMA checker cannot statically prove
         # replication through a scatter, so P() out_specs would be rejected
-        w = jax.shard_map(
+        w = _shard_map(
             body, mesh=mesh,
             in_specs=(specs, P()) + (P(axis),) * n_batch,
             out_specs=(specs, P()),

@@ -22,6 +22,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .spmd import shard_map as _shard_map
+
 __all__ = ["make_localsgd_train_step"]
 
 
@@ -98,7 +100,7 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
     # shard_map specs are positional; rebuild per-call for variadic batches
     @functools.lru_cache(maxsize=8)
     def _compiled(n_batch):
-        w = jax.shard_map(
+        w = _shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, P()) + (batch_spec,) * n_batch,
             out_specs=(state_specs, P()))

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .spmd import shard_map as _shard_map
+
 from ..core import rng
 from .spmd import (build_param_specs, build_state_shardings, spmd_pipeline,
                    spmd_pipeline_interleaved)
@@ -166,7 +168,7 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
                 return spmd_pipeline_interleaved(chunk_fn, local, mbs, S, V,
                                                  axis="pipe")
 
-            out_mb = jax.shard_map(
+            out_mb = _shard_map(
                 pipelined, mesh=mesh,
                 in_specs=({k: P("pipe") for k in stacked_keys}, P()),
                 out_specs=P(), axis_names={"pipe"})(block_params, mb)
@@ -182,7 +184,7 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
             # check_vma left ON: spmd_pipeline marks its carry varying via
             # pvary, so the varying-manual-axes checker passes and catches
             # real replication bugs
-            out_mb = jax.shard_map(
+            out_mb = _shard_map(
                 pipelined, mesh=mesh,
                 in_specs=({k: P("pipe") for k in stacked_keys}, P()),
                 out_specs=P(), axis_names={"pipe"})(block_params, mb)

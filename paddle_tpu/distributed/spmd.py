@@ -138,6 +138,42 @@ try:  # jax.core.get_aval warns/moves across versions; prefer the _src home
 except ImportError:  # pragma: no cover - older/newer layout
     _get_aval = jax.core.get_aval
 
+# shard_map itself has moved too: jax.experimental.shard_map -> top-level
+# jax.shard_map, and its kwargs renamed with it (check_rep -> check_vma,
+# auto -> axis_names).  Resolve ONCE here and translate the modern spelling
+# to whatever this JAX accepts — every call site in the framework routes
+# through this adapter, never the bare jax attribute (which raises on
+# pre-promotion releases).
+try:
+    from jax import shard_map as _shard_map_impl  # jax >= 0.6 export
+except ImportError:  # pragma: no cover - experimental home on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+import inspect as _inspect
+
+_SHARD_MAP_KW = frozenset(
+    _inspect.signature(_shard_map_impl).parameters)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None):
+    """``jax.shard_map`` in its MODERN spelling on any supported JAX:
+    ``check_vma`` maps to ``check_rep`` and ``axis_names`` (the manual
+    axes) to ``auto`` (its complement over the mesh) on releases that
+    predate the renames."""
+    kw = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if check_vma is not None:
+        kw["check_vma" if "check_vma" in _SHARD_MAP_KW
+           else "check_rep"] = check_vma
+    if axis_names is not None:
+        if "axis_names" in _SHARD_MAP_KW:
+            kw["axis_names"] = axis_names
+        else:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+    return _shard_map_impl(f, **kw)
+
 #: whether this JAX tracks varying-manual-axes on avals at all (older
 #: releases: no VMA checking, casting is correctly a no-op)
 VMA_AVALS = hasattr(jax.core.ShapedArray((), np.dtype(np.float32)), "vma")

@@ -139,6 +139,51 @@ class PagedKV:
         return PagedKV(pool, self.table)
 
 
+def ragged_attention(q_rows, pool_k, pool_v, table, row_seq, row_pos,
+                     pad_lens=None):
+    """Attention for a flattened ragged pack of rows over ONE layer's block
+    pools (the mixed prefill+decode serving step): q_rows (T, nh, hd),
+    pools (NB+1, bs, nh, hd) — int8 ``(values, scales)`` pairs included —
+    table (S, C), row_seq/row_pos (T,) per-row metadata (see
+    ops/ragged_paged_attention.ragged_rows), pad_lens (S,).
+
+    Dispatches between the Pallas in-kernel table walk (TPU, or interpret
+    mode for CPU CI — the ops/fused.py flag convention shared with
+    cached_attention's paged arm) and the XLA gather fallback; int8 pools
+    take the kernel too (dequant is fused in-kernel)."""
+    from ..core.flags import flag
+    from ..ops.ragged_paged_attention import (ragged_attention_ref,
+                                              ragged_attention_rows)
+    interp = (bool(flag("FLAGS_paged_attn_interpret"))
+              and jax.default_backend() != "tpu")
+    use = flag("FLAGS_use_pallas_kernels") and \
+        (jax.default_backend() == "tpu" or interp)
+    if use:
+        return ragged_attention_rows(q_rows, pool_k, pool_v, table,
+                                     row_seq, row_pos, pad_lens,
+                                     interpret=interp)
+    return ragged_attention_ref(q_rows, pool_k, pool_v, table, row_seq,
+                                row_pos, pad_lens)
+
+
+def ragged_write(pool, chunk, table, row_seq, row_pos):
+    """Scatter a flattened ragged chunk (T, nh, hd) into ONE layer's block
+    pool at each row's (table-mapped block, offset); padding rows
+    (row_pos < 0) land in the trash block.  int8 pools quantize the chunk
+    and write both planes (quantize_kv layout)."""
+    if isinstance(pool, tuple):
+        vals, scales = pool
+        q, s = quantize_kv(chunk)
+        return (ragged_write(vals, q, table, row_seq, row_pos),
+                ragged_write(scales, s, table, row_seq, row_pos))
+    bs = pool.shape[1]
+    seq = jnp.clip(row_seq, 0, table.shape[0] - 1)
+    col = jnp.clip(row_pos // bs, 0, table.shape[1] - 1)
+    pb = jnp.where(row_pos >= 0, table[seq, col], 0)
+    off = jnp.where(row_pos >= 0, row_pos % bs, 0)
+    return pool.at[pb, off].set(chunk.astype(pool.dtype))
+
+
 def write_cache(cache, chunk, t):
     """Write a (B, kq, nh, hd) k/v chunk into the cache at slots [t, t+kq):
     scalar ``t`` → one dynamic_update_slice; per-row (B,) ``t`` → scatter
@@ -593,6 +638,19 @@ class CausalDecoderMixin:
             pos = jnp.maximum(pos - pad_lens[:, None], 0)
         return (jnp.take(params["wte"], toks, axis=0)
                 + jnp.take(params["wpe"], pos, axis=0)).astype(dt)
+
+    def _embed_ragged(self, params, toks, row_seq, row_pos, pad_lens):
+        """Embed a flattened ragged pack: toks (T,) one token per row,
+        row_seq (T,) owning sequence, row_pos (T,) kv position (-1 for
+        padding rows), pad_lens (S,) per-sequence left-pad lengths.
+        Logical positions shift by the owning sequence's pad (the
+        _embed_one/_embed_chunk convention); returns (1, T, H)."""
+        dt = jnp.dtype(self.config.compute_dtype)
+        seq = jnp.clip(row_seq, 0, pad_lens.shape[0] - 1)
+        pos = jnp.clip(row_pos - pad_lens[seq], 0,
+                       params["wpe"].shape[0] - 1)
+        h = jnp.take(params["wte"], toks, axis=0) + params["wpe"][pos]
+        return h[None].astype(dt)
 
     def generate_speculative(self, params, input_ids, max_new_tokens: int,
                              draft_model, draft_params, draft_k: int = 4,

@@ -31,6 +31,7 @@ from ..core import rng
 from ..core.tensor import Parameter, Tensor, apply
 from ._decode import (CausalDecoderMixin, cached_attention,  # noqa: F401
                       dequantize_cache, make_token_sampler, quantize_kv,
+                      ragged_attention, ragged_write,
                       validate_sampler_args, write_cache)
 from ..nn.layer.base import Layer
 from ..ops.attention import flash_attention
@@ -214,8 +215,9 @@ class GPTModel(CausalDecoderMixin, Layer):
             # ring/Ulysses attention inside a partial-manual shard_map region
             # (only "sep" is manual — dp/mp stay under GSPMD)
             from jax.sharding import PartitionSpec as P
+            from ..distributed.spmd import shard_map
             from ..ops.ring_attention import sequence_parallel_attention
-            att = jax.shard_map(
+            att = shard_map(
                 functools.partial(sequence_parallel_attention, axis_name="sep",
                                   causal=True, mode=sp_mode),
                 mesh=mesh, in_specs=P(None, "sep", None, None),
@@ -339,6 +341,40 @@ class GPTModel(CausalDecoderMixin, Layer):
         pad = [(0, 0), (0, 0), (0, max_len - P), (0, 0), (0, 0)]
         dt = jnp.dtype(c.compute_dtype)
         return h, (jnp.pad(ks.astype(dt), pad), jnp.pad(vs.astype(dt), pad))
+
+    def _block_decode_ragged(self, sl, h, pck, pcv, table, row_seq,
+                             row_pos, pad_lens):
+        """One block for a flattened ragged pack: h (1, T, H); pck/pcv are
+        this layer's block pools (NB+1, bs, nh, hd).  Each row's k/v is
+        scattered to its table-mapped pool position BEFORE attention, so
+        intra-pack causal attention (a prefill chunk's rows attending each
+        other) reads the freshly written keys — the _block_decode
+        write-then-attend order over the ragged layout."""
+        q, k, v = self._block_qkv(sl, h)               # (1, T, nh, hd)
+        pck = ragged_write(pck, k[0], table, row_seq, row_pos)
+        pcv = ragged_write(pcv, v[0], table, row_seq, row_pos)
+        att = ragged_attention(q[0], pck, pcv, table, row_seq, row_pos,
+                               pad_lens)
+        return self._block_post_attn(sl, h, att[None]), pck, pcv
+
+    def decode_ragged(self, params, h, pools, table, row_seq, row_pos,
+                      pad_lens):
+        """All blocks for one mixed ragged step (the serving engine's
+        fused prefill+decode tick): h (1, T, H) from _embed_ragged,
+        ``pools`` = (pool_ck, pool_cv) stacked over layers (int8
+        ``(values, scales)`` pairs included), table (S, C) shared across
+        layers, row metadata per ops/ragged_paged_attention.ragged_rows.
+        Returns (h_out, pools)."""
+        stacked = {k: params[k] for k in self.stacked_param_names()}
+
+        def body(carry, xs):
+            sl, pck, pcv = xs
+            out, pck, pcv = self._block_decode_ragged(
+                sl, carry, pck, pcv, table, row_seq, row_pos, pad_lens)
+            return out, (pck, pcv)
+
+        h, (cks, cvs) = jax.lax.scan(body, h, (stacked, pools[0], pools[1]))
+        return h, (cks, cvs)
 
     def decode_step(self, params, h, caches, t, pad_lens=None):
         """All blocks for one token: h (B,1,H), caches = (ck, cv) stacked

@@ -190,6 +190,21 @@ class ContinuousBatchingEngine:
                             eos_token_id if self.min_new_tokens > 0 else None)
         self._sample = make_token_sampler(*self._sample_sig[:4])
         self.per_request = bool(per_request_sampling)
+        # classic mode: the ctor knobs ARE the engine-wide sampler, and
+        # greedy=True argmax ignores them — same silent mis-serve the
+        # add_request guard closes (ADVICE r5).  NEUTRAL values pass
+        # (temperature=1.0, top_p=1.0 — clients forwarding their defaults
+        # are not asking for sampling).  Per-request mode is exempt: there
+        # the knobs are request DEFAULTS a greedy=False request may
+        # legitimately inherit.
+        if not self.per_request and greedy and (
+                top_k is not None
+                or (top_p is not None and float(top_p) != 1.0)
+                or float(temperature) != 1.0):
+            raise ValueError(
+                "temperature/top_k/top_p have no effect under greedy "
+                "decoding (the engine default) — pass greedy=False to "
+                "sample, or drop the knobs")
         if self.per_request:
             # sampler config becomes per-slot DATA (S-row planes, traced
             # operands): the ctor args are the defaults a request may
@@ -579,6 +594,19 @@ class ContinuousBatchingEngine:
         if mn > 0 and eos < 0:
             raise ValueError("min_new_tokens needs an eos_token_id "
                              "(engine default or per-request)")
+        # sampling-only knobs are argmax-inert while the effective greedy
+        # flag is True — add_request(p, n, temperature=0.8) would silently
+        # decode greedy (ADVICE r5); fail loudly instead of mis-serving.
+        # NEUTRAL values pass (temperature=1.0, top_p=1.0): clients that
+        # always forward their defaults are not asking for sampling (the
+        # ctor guard draws the same line)
+        if g and (("temperature" in given and t != 1.0)
+                  or "top_k" in given
+                  or ("top_p" in given and p != 1.0)):
+            raise ValueError(
+                "temperature/top_k/top_p have no effect under greedy "
+                "decoding — pass greedy=False with them (or construct the "
+                "engine with greedy=False)")
         return (t, k, p, g, rp, mn, eos)
 
     def _positions_needed(self, P: int, mnt: int) -> int:
@@ -1069,12 +1097,14 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 # serving namespace without a circular import (serving_paged imports this
 # module at its top)
 __all__ += ["PagedContinuousBatchingEngine",
-            "PagedSpeculativeBatchingEngine"]
+            "PagedSpeculativeBatchingEngine",
+            "RaggedPagedContinuousBatchingEngine"]
 
 
 def __getattr__(name):
     if name in ("PagedContinuousBatchingEngine",
-                "PagedSpeculativeBatchingEngine"):
+                "PagedSpeculativeBatchingEngine",
+                "RaggedPagedContinuousBatchingEngine"):
         from . import serving_paged
         return getattr(serving_paged, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -44,6 +44,7 @@ No reference counterpart: the reference snapshot serves static batches only
 from __future__ import annotations
 
 import collections
+import logging
 from functools import partial
 from typing import Optional
 
@@ -54,10 +55,12 @@ import numpy as np
 from .serving import (ContinuousBatchingEngine,
                       SpeculativeBatchingEngine)
 from .jit.bucketing import select_bucket
-from .models._decode import PagedKV, seed_presence
+from .models._decode import (PagedKV, apply_repetition_penalty,
+                             seed_presence, suppress_eos, suppress_eos_rows)
 
 __all__ = ["PagedContinuousBatchingEngine",
-           "PagedSpeculativeBatchingEngine"]
+           "PagedSpeculativeBatchingEngine",
+           "RaggedPagedContinuousBatchingEngine"]
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -154,6 +157,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def blocks_in_use(self) -> int:
         return self.NB - len(self._free)
 
+    def _evictable_count(self) -> int:
+        """Cached prefix blocks with no live pins — allocatable on demand
+        (ONE definition for the allocator, metrics, and the ragged pack
+        builder)."""
+        return sum(1 for b in self._prefix_cache.values()
+                   if self._refs.get(b, 0) == 0)
+
     def _alloc_blocks(self, n: int):
         """Take ``n`` fresh blocks (refs = 1 each) from the free list,
         evicting least-recently-used UNREFERENCED cached blocks as needed.
@@ -212,17 +222,25 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _chain_keys(self, ids, pad, nblocks):
         """The chain key for each of the first ``nblocks`` prompt blocks:
-        a ROLLING sha1 over (pad, tokens through block i).  O(1)-sized
-        keys and O(P) total work per admission — nested token tuples
-        would make every dict operation on the TTFT path re-hash the
-        whole prefix (O(P^2) per admission)."""
+        a ROLLING blake2b-256 over (pad, tokens through block i).
+        O(1)-sized keys and O(P) total work per admission — nested token
+        tuples would make every dict operation on the TTFT path re-hash
+        the whole prefix (O(P^2) per admission).  blake2b rather than
+        sha1: prompt tokens are attacker-controlled in a shared
+        multi-tenant cache, and a chosen-prefix sha1 collision would
+        silently map one tenant's cached k/v blocks into another's
+        attention context (ADVICE r5)."""
         import hashlib
+
+        def h(data):
+            return hashlib.blake2b(data, digest_size=32).digest()
+
         out = []
-        digest = hashlib.sha1(str(pad).encode()).digest()
+        digest = h(str(pad).encode())
         for i in range(nblocks):
             block = np.asarray(ids[i * self.bs:(i + 1) * self.bs],
                                np.int64).tobytes()
-            digest = hashlib.sha1(digest + block).digest()
+            digest = h(digest + block)
             out.append(digest)
         return out
 
@@ -264,7 +282,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         """Evict the YOUNGEST in-flight request (active or still filling),
         free its blocks, and requeue it at the front for a from-scratch
         rerun.  Greedy decoding regenerates the identical prefix, so the
-        exactness contract holds; sampled runs redraw from the engine key."""
+        exactness contract holds; sampled runs redraw from the engine key.
+
+        Streaming consumers see the replayed prefix again: before the
+        rerun, ``on_token(request_id, None, False)`` is invoked once as
+        the documented replay/reset signal (``token is None`` == discard
+        everything streamed for this request so far; see add_request)."""
         cands = [(int(self._admit_seq[s]), s)
                  for s in np.flatnonzero(self._active)]
         cands += [(int(self._admit_seq[s]), s) for s in self._filling]
@@ -282,6 +305,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._queue.insert(0, req)
         self._free_slot_blocks(victim)
         self.preemptions += 1
+        if req.on_token is not None:
+            try:
+                req.on_token(req.id, None, False)      # replay/reset signal
+            except Exception:  # noqa: BLE001 — same contract as _record:
+                # a user callback must not desync the scheduler
+                logging.getLogger(__name__).exception(
+                    "on_token replay signal failed for request %d", req.id)
         return True
 
     # ---------------------------------------------------------- programs --
@@ -471,6 +501,21 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def add_request(self, prompt, max_new_tokens: int, on_token=None,
                     **sampling) -> int:
+        """Queue a prompt (the base-engine contract, plus the paged
+        engine's preemption semantics).
+
+        PREEMPTION AND STREAMING: when the block pool runs dry the
+        youngest in-flight request is preempted and rerun from scratch.
+        An ``on_token`` consumer is told via a single
+        ``on_token(request_id, None, False)`` call — ``token is None`` is
+        the documented replay/reset signal: discard everything streamed
+        for the request so far; the rerun re-delivers the stream from the
+        first token.  Greedy (and deterministic per-request-config) rows
+        regenerate the identical prefix; SAMPLED rows redraw from the
+        engine key on replay, so a preempted sampling request's rerun is
+        a different — still correctly distributed — stream.  Consumers
+        needing replay-stable sampled streams should buffer until
+        ``done`` or size ``num_blocks`` so preemption cannot occur."""
         prompt_l = [int(t) for t in prompt]
         if prompt_l:
             P = select_bucket(len(prompt_l), self.buckets)
@@ -644,11 +689,295 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         m["blocks_high_water"] = float(self.blocks_high_water)
         m["preemptions"] = float(self.preemptions)
         if self.prefix_caching:
-            m["blocks_cached"] = float(sum(
-                1 for b in self._prefix_cache.values()
-                if self._refs.get(b, 0) == 0))
+            m["blocks_cached"] = float(self._evictable_count())
             m["prefix_hits"] = float(self.prefix_hits)
             m["prefix_blocks_reused"] = float(self.prefix_blocks_reused)
+        return m
+
+
+class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
+    """Continuous batching where the WHOLE scheduler tick is ONE compiled
+    mixed-batch program (the "ragged paged attention" serving step,
+    arxiv 2604.15464 / PAPERS.md).
+
+    The parent engine compiles a prefill program per (bucket, prefix
+    depth) plus a separate decode family — prefill and decode tokens can
+    never share a step, and every new bucket pays a fresh compile (the
+    compile dial that has repeatedly eaten bench rounds; HEALTH.log).
+    This engine instead packs every step into ONE flattened ragged token
+    batch of at most ``token_budget`` rows:
+
+    - every ACTIVE decode slot contributes its 1 next-token row;
+    - the remaining budget is filled with admission-prefill chunks
+      (oldest request first) at whatever granularity fits — chunking is
+      inherent, so there is no ``prefill_chunk`` knob and no per-bucket
+      program family;
+    - the model runs the pack through ``decode_ragged`` (k/v scattered
+      straight into pool blocks, attention via the ragged Pallas kernel
+      or its gather fallback), then ONE (S,)-row sampler draws the next
+      token for each decode slot and each prompt that completed this
+      step.
+
+    Compiled-program count: one program per (token_budget, table-width
+    bucket) — at most log2(max_len/block_size) + 1 programs TOTAL,
+    regardless of prompt buckets, prefix depths, or arrival patterns.
+    Because only packed rows are computed, there are no parked clocks and
+    no inactive-row trash gating: every row in the program is a real
+    token.
+
+    The allocator (lazy growth, prefix cache, deferral, youngest-first
+    preemption) is inherited unchanged from the paged engine; prompts
+    longer than the budget simply span several steps, stalling — not
+    failing — when the pool runs dry.  ``ticks_per_sync`` is fixed at 1:
+    the budget knob amortizes dispatch instead (one step can carry a
+    whole prompt plus every decoder).  Outputs stay oracle-exact vs solo
+    ``generate()`` (greedy / deterministic configs), fp32 and int8 pools
+    alike.
+    """
+
+    def __init__(self, model, params, max_slots: int, max_len: int,
+                 token_budget: Optional[int] = None, **kw):
+        if kw.get("prefill_chunk") is not None:
+            raise ValueError(
+                "the ragged engine chunks prefill via token_budget; "
+                "prefill_chunk is the bucketed engines' knob")
+        if int(kw.pop("ticks_per_sync", 1)) != 1:
+            raise NotImplementedError(
+                "ragged engine v1 syncs every step — amortize dispatch "
+                "with token_budget, not ticks_per_sync")
+        if not hasattr(model, "decode_ragged"):
+            raise NotImplementedError(
+                f"{type(model).__name__} has no decode_ragged path; the "
+                f"ragged engine needs the model-side ragged chunk support "
+                f"(models/gpt.py) — use PagedContinuousBatchingEngine")
+        super().__init__(model, params, max_slots, max_len, **kw)
+        tb = (int(token_budget) if token_budget is not None
+              else int(max_slots) + max(self.buckets))
+        if tb < int(max_slots):
+            raise ValueError(
+                f"token_budget ({tb}) must cover every decode slot "
+                f"(max_slots={max_slots})")
+        self.token_budget = tb
+        self.ragged_steps = 0
+        self.mixed_steps = 0      # steps that carried prefill AND decode
+
+    # --------------------------------------------------------- scheduling --
+
+    def _admit(self):
+        """Admission reserves a slot and (on a prefix hit) pins the cached
+        chain — NO device work and NO block allocation happen here; the
+        prompt's rows flow into subsequent ragged steps as budget and
+        blocks allow."""
+        free = self._free_slots()
+        while self._queue and free:
+            slot = free.pop(0)
+            req = self._queue.pop(0)
+            P = select_bucket(len(req.prompt), self.buckets)
+            pad = P - len(req.prompt)
+            ids = [0] * pad + req.prompt
+            F, hit = (self._lookup_prefix(ids, pad, P)
+                      if self.prefix_caching else (0, []))
+            if F:
+                for bid in hit:                   # pin before eviction runs
+                    self._refs[bid] += 1
+                self._table[slot, :F] = hit
+                self._nblk[slot] = F
+                self.prefix_hits += 1
+                self.prefix_blocks_reused += F
+            self._seq += 1
+            self._admit_seq[slot] = self._seq
+            self._set_planes(slot, req)
+            self._pad[slot] = pad
+            self._t[slot] = 0
+            if self._track:
+                # presence seeds from the FULL prompt at admission (shared
+                # prefix tokens count for the penalty even though their
+                # rows are never recomputed) — a host-built row, not a
+                # compiled program family
+                V = self.model.config.vocab_size
+                row = np.zeros((1, V), bool)
+                # clip == the device scatter's out-of-vocab clamping
+                # (seed_presence); numpy fancy indexing would raise and
+                # leave the slot half-admitted
+                row[0, np.clip(np.asarray(req.prompt, np.int64),
+                               0, V - 1)] = True
+                self._presence = jax.lax.dynamic_update_slice(
+                    self._presence, jnp.asarray(row), (slot, 0))
+            self._filling[slot] = {"req": req, "ids": ids, "pad": pad,
+                                   "P": P, "filled": F * self.bs}
+
+    def _build_pack(self):
+        """Assemble one step's flattened ragged pack: all active decode
+        rows first (block coverage grown via _prepare_decode, preempting
+        the youngest when dry), then prefill chunks oldest-first into the
+        remaining budget (a dry pool shrinks the chunk — the filler
+        stalls while decode retirements free blocks).  Returns None when
+        there is nothing to run."""
+        T = self.token_budget
+        if self._active.any():
+            self._prepare_decode()        # table growth + preemption loop
+        toks = np.zeros(T, np.int32)
+        row_seq = np.full(T, -1, np.int32)
+        row_pos = np.full(T, -1, np.int32)
+        sample_rows = np.zeros(self.S, np.int32)
+        sample_active = np.zeros(self.S, bool)
+        n = 0
+        dec_slots = []
+        for slot in np.flatnonzero(self._active):
+            toks[n] = self._tok[slot]
+            row_seq[n] = slot
+            row_pos[n] = self._t[slot]
+            sample_rows[slot] = n
+            sample_active[slot] = True
+            dec_slots.append(int(slot))
+            n += 1
+        fill_adv = {}
+        for slot in sorted(self._filling,
+                           key=lambda s: int(self._admit_seq[s])):
+            if n >= T:
+                break
+            st = self._filling[slot]
+            want = min(st["P"] - st["filled"], T - n)
+            have = int(self._nblk[slot])
+            if have * self.bs < st["filled"] + want:
+                # grant what the pool can actually cover in ONE
+                # transactional request (one prefix-cache scan, not one
+                # per block) — a dry pool shrinks the chunk and the
+                # filler stalls while decode retirements free blocks
+                grantable = len(self._free) + self._evictable_count()
+                need = -(-(st["filled"] + want) // self.bs) - have
+                take = min(need, grantable)
+                if take > 0:
+                    self._ensure_blocks(slot, (have + take) * self.bs)
+            m = min(want, int(self._nblk[slot]) * self.bs - st["filled"])
+            if m <= 0:
+                continue
+            for k in range(m):
+                toks[n] = st["ids"][st["filled"] + k]
+                row_seq[n] = slot
+                row_pos[n] = st["filled"] + k
+                n += 1
+            fill_adv[slot] = m
+            if st["filled"] + m == st["P"]:
+                # the prompt's last row yields the first-token hidden state
+                sample_rows[slot] = n - 1
+                sample_active[slot] = True
+        if n == 0:
+            # jointly wedged fillers with no decoder: nothing will ever
+            # free blocks — evict the youngest so the oldest progresses
+            # (the chunked-prefill discipline); rows are empty, so no
+            # packed state is invalidated by the eviction
+            if self._filling and self._preempt_one():
+                return self._build_pack()
+            return None
+        need_cols = -(-(int(row_pos[:n].max()) + 1) // self.bs)
+        C = 1
+        while C < need_cols:
+            C *= 2
+        C = min(C, self.MB)
+        if dec_slots and fill_adv:
+            self.mixed_steps += 1
+        return (toks, row_seq, row_pos, C, sample_rows, sample_active,
+                dec_slots, fill_adv)
+
+    def step(self):
+        """One scheduler round = ONE device program: admit, pack, run the
+        ragged step, unpack sampled tokens (decode slots advance;
+        completed prompts activate with their first token)."""
+        self._admit()
+        pack = self._build_pack()
+        if pack is None:
+            return
+        (toks, row_seq, row_pos, C, sample_rows, sample_active, dec_slots,
+         fill_adv) = pack
+        emitted0 = np.asarray(
+            [len(self._slot_req[s].generated) if self._active[s] else 0
+             for s in range(self.S)], np.int32)
+        run = self._ragged_prog(C)
+        ck, cv, ntok, self._presence = run(
+            self.params, self.caches[0], self.caches[1],
+            jnp.asarray(toks), jnp.asarray(row_seq), jnp.asarray(row_pos),
+            jnp.asarray(self._table[:, :C]), jnp.asarray(self._pad),
+            jnp.asarray(sample_rows), jnp.asarray(sample_active),
+            jnp.asarray(emitted0), self._next_key(), self._presence,
+            self._plane_operands())
+        self.caches = (ck, cv)
+        self.ragged_steps += 1
+        ntok = np.asarray(ntok)
+        for slot in dec_slots:
+            self._t[slot] += 1
+            self._tok[slot] = int(ntok[slot])
+            self._record(slot, int(ntok[slot]))
+            # room safety net (admission-validated budgets never trigger)
+            if self._active[slot] and int(self._t[slot]) + 1 > self.max_len:
+                self._retire(slot)
+        for slot, m in fill_adv.items():
+            st = self._filling[slot]
+            st["filled"] += m
+            if st["filled"] == st["P"]:
+                del self._filling[slot]
+                self._register_prompt_blocks(slot, st["ids"], st["pad"],
+                                             st["P"])
+                self._activate(slot, st["req"], st["P"], st["pad"],
+                               int(ntok[slot]))
+
+    # ---------------------------------------------------------- programs --
+
+    def _ragged_prog(self, C: int):
+        """ONE program per (token_budget, table-width bucket) — the whole
+        mixed admission+decode tick, no per-bucket prefill family."""
+        return self._cached_prog(
+            ("ragged_step", self.token_budget, C, self._sig),
+            lambda: self._build_ragged_step(self.token_budget, C))
+
+    def _build_ragged_step(self, T: int, C: int):
+        model = self.model
+        track = self._track
+        S = self.S
+        sample = self._sample
+        rp, min_new, eos = self._sample_sig[4:]
+        per_request = self.per_request
+        row_sample = self._row_sample if per_request else None
+
+        @partial(jax.jit, donate_argnums=(1, 2, 12))
+        def run(params, pool_ck, pool_cv, toks, row_seq, row_pos, table,
+                pads, sample_rows, sample_active, emitted0, key, presence,
+                planes):
+            h = model._embed_ragged(params, toks, row_seq, row_pos, pads)
+            h, (pool_ck, pool_cv) = model.decode_ragged(
+                params, h, (pool_ck, pool_cv), table, row_seq, row_pos,
+                pads)
+            # ONE sampler over S gathered rows: each decode slot's row and
+            # each completing prompt's last row (dummy row 0 for the rest
+            # — computed, ignored host-side)
+            h_s = h[0, sample_rows][:, None]            # (S, 1, H)
+            l2 = model.decode_logits(params, h_s)[:, -1]
+            key, sub = jax.random.split(key)
+            if per_request:
+                temp, topk, topp, greedy, rpv, mnv, eosv = planes
+                l2 = apply_repetition_penalty(l2, presence, rpv)
+                l2 = suppress_eos_rows(l2, eosv, emitted0 < mnv)
+                ntok = row_sample(l2[:, None, :], sub, temp, topk, topp,
+                                  greedy)
+            else:
+                if track:
+                    l2 = apply_repetition_penalty(l2, presence, rp)
+                if min_new > 0:
+                    l2 = suppress_eos(l2, eos, emitted0 < min_new)
+                ntok = sample(l2[:, None, :], sub)
+            if track:
+                # prompt tokens were seeded at admission; only SAMPLED
+                # tokens update presence in-program
+                presence = presence.at[jnp.arange(S), ntok].max(
+                    sample_active)
+            return pool_ck, pool_cv, ntok, presence
+
+        return run
+
+    def metrics(self):
+        m = super().metrics()
+        m["ragged_steps"] = float(self.ragged_steps)
+        m["mixed_steps"] = float(self.mixed_steps)
         return m
 
 

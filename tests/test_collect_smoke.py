@@ -1,0 +1,41 @@
+"""Import-time regression gate: every test module must import cleanly.
+
+A broken import does NOT fail the tier-1 run — `pytest
+--continue-on-collection-errors` just drops the whole module's tests from
+the count (round 5's `from jax import shard_map` regression silently hid
+tests/test_spmd_vma_seam.py for a full round).  This test imports every
+tests/*.py module IN-PROCESS (modules already imported by the collecting
+pytest are free; a standalone run pays one jax import total) and fails
+LOUDLY with the offending module and traceback.  tools/collect_smoke.sh is
+the standalone subprocess form of the same gate."""
+
+import importlib
+import pathlib
+import sys
+import traceback
+
+HERE = pathlib.Path(__file__).parent
+
+
+def test_every_test_module_imports():
+    failures = []
+    for path in sorted(HERE.glob("test_*.py")):
+        name = path.stem
+        try:
+            importlib.import_module(name)
+        except Exception:  # noqa: BLE001 — report ALL broken modules
+            failures.append(f"{name}:\n{traceback.format_exc()}")
+    assert not failures, (
+        "test modules with import-time errors (these are silently dropped "
+        "from tier-1 counts — fix before anything else):\n\n"
+        + "\n".join(failures))
+
+
+def test_package_namespace_imports():
+    """The serving/ops surface this suite leans on must resolve through
+    the public namespace (lazy re-exports included)."""
+    import paddle_tpu.serving as serving
+    for name in ("ContinuousBatchingEngine", "PagedContinuousBatchingEngine",
+                 "RaggedPagedContinuousBatchingEngine"):
+        assert getattr(serving, name) is not None
+    assert "paddle_tpu.serving_paged" in sys.modules

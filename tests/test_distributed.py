@@ -16,6 +16,7 @@ import paddle_tpu.nn as nn
 
 
 from paddle_tpu.core.device import local_devices
+from paddle_tpu.distributed.spmd import shard_map
 
 needs8 = pytest.mark.skipif(len(local_devices()) < 8, reason="needs 8 devices")
 
@@ -67,7 +68,7 @@ class TestCollectives:
             s = dist.all_reduce(jnp.squeeze(x, 0), group=g)
             gathered = dist.all_gather(None, jnp.squeeze(x, 0), group=g)
             return s[None], gathered[None]
-        f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("x"),
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("x"),
                                   out_specs=(P("x"), P("x"))))
         s, gathered = f(jnp.asarray(data))
         np.testing.assert_allclose(np.asarray(s)[0], data.sum(0))
@@ -84,7 +85,7 @@ class TestCollectives:
             out = dist.alltoall(jnp.squeeze(x, 0)[:, None], group=g)
             rs = dist.reduce_scatter(None, input_tensor=jnp.squeeze(x, 0), group=g)
             return out.reshape(1, 4), rs[None]
-        f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("x"),
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("x"),
                                   out_specs=(P("x"), P("x"))))
         out, rs = f(jnp.asarray(data))
         np.testing.assert_allclose(np.asarray(out), data.T)  # alltoall == transpose
@@ -100,7 +101,7 @@ class TestCollectives:
             shifted = jax.lax.ppermute(jnp.squeeze(x, 0), "x",
                                        [(i, (i + 1) % 4) for i in range(4)])
             return shifted[None]
-        f = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x")))
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("x"), out_specs=P("x")))
         out = f(jnp.asarray(data))
         np.testing.assert_allclose(np.asarray(out).reshape(-1), [3, 0, 1, 2])
 
@@ -140,7 +141,7 @@ class TestTPLayers:
             out = h @ wr_
             out = jax.lax.psum(out, "model")
             return out + br_
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P(), P(None, "model"), P("model"), P("model"), P()),
             out_specs=P()))
@@ -290,7 +291,7 @@ def test_pipeline_bubble_fraction_is_structural():
     def run(sp, mbs):
         return spmd_pipeline(stage_fn, sp, mbs, S, axis="pipe")
 
-    fn = jax.shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
                        out_specs=P(None), axis_names={"pipe"})
     jaxpr = jax.make_jaxpr(fn)(sparams, mb)
     # one while/scan with trip count M+S-1: find `length=15` style binding
@@ -335,7 +336,7 @@ def test_pipeline_interleaved_matches_serial():
             lambda chp, x, mi, v: chunk_fn(chp, x, mi, v), local, m, S, V,
             axis="pipe")
 
-    fn = jax.shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
                        out_specs=P(None), axis_names={"pipe"})
     out = fn(chunk_params, mbs)
 
@@ -430,7 +431,7 @@ def test_pipeline_interleaved_sweep(S, V, M):
             lambda chp, x, mi, v: x * chp[0] + chp[1], local, m, S, V,
             axis="pipe")
 
-    fn = jax.shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
+    fn = shard_map(run, mesh=mesh, in_specs=(P("pipe"), P(None)),
                        out_specs=P(None), axis_names={"pipe"})
     out = fn(chunk_params, mbs)
     expect = np.asarray(mbs)

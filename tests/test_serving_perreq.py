@@ -11,6 +11,7 @@ scheduler at all."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -212,6 +213,36 @@ class TestPerRequestSampling:
             eng.add_request(PROMPTS[0], 4, min_new_tokens=2)
         with pytest.raises(TypeError, match="unknown"):
             eng.add_request(PROMPTS[0], 4, banana=1)
+
+    def test_sampling_knobs_under_greedy_raise(self, model_and_params):
+        """Sampling-only knobs while the effective greedy flag is True
+        would silently decode argmax (ADVICE r5): loud failure instead —
+        passing greedy=False alongside them is the accepted form, and an
+        engine whose DEFAULT is greedy=False accepts them bare."""
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=1,
+                                       max_len=32, prompt_buckets=[8],
+                                       per_request_sampling=True)
+        with pytest.raises(ValueError, match="greedy"):
+            eng.add_request(PROMPTS[0], 4, temperature=0.8)
+        with pytest.raises(ValueError, match="greedy"):
+            eng.add_request(PROMPTS[0], 4, top_k=5, greedy=True)
+        eng.add_request(PROMPTS[0], 4, temperature=0.8, greedy=False)
+        # NEUTRAL values are no-ops, not sampling requests — clients
+        # forwarding their defaults must not be rejected
+        eng.add_request(PROMPTS[0], 4, temperature=1.0)
+        eng.add_request(PROMPTS[0], 4, top_p=1.0)
+        # classic mode: the CTOR knobs are the engine-wide sampler — the
+        # same guard applies where the effective tuple is formed
+        with pytest.raises(ValueError, match="greedy"):
+            ContinuousBatchingEngine(model, params, max_slots=1,
+                                     max_len=32, prompt_buckets=[8],
+                                     temperature=0.7)
+        sampling_default = ContinuousBatchingEngine(
+            model, params, max_slots=1, max_len=32, prompt_buckets=[8],
+            per_request_sampling=True, greedy=False,
+            key=jax.random.key(0))
+        sampling_default.add_request(PROMPTS[0], 4, temperature=0.8)
 
 
 class TestPerRequestTP:

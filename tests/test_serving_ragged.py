@@ -1,0 +1,328 @@
+"""Ragged mixed prefill+decode serving engine
+(paddle_tpu/serving_paged.py: RaggedPagedContinuousBatchingEngine): ONE
+compiled program per scheduler tick serves any mixture of admission
+prefill chunks and in-flight decode rows — no per-bucket prefill program
+family, no separate decode tick — while every request's tokens stay
+oracle-exact vs solo model.generate(), across fp32 and int8 KV pools,
+prefix-cache hits, preemption, and per-request sampling planes.
+
+No reference counterpart (the reference serves static batches only); the
+oracle is the framework's own single-request generation path."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+def _solo_greedy(model, params, prompt, n, **kw):
+    out = model.generate(params, jnp.asarray([prompt], jnp.int32), n,
+                         greedy=True, **kw)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+PROMPTS = [[5, 17, 3], [40, 2], [9, 9, 9, 9, 9, 1], [61], [8, 30, 12, 4],
+           [77, 13, 2, 5, 6, 7, 8]]
+
+
+class TestRaggedParity:
+    def test_interleaved_matches_solo_generate(self, model_and_params):
+        """Six ragged requests through 3 slots with retirement and
+        re-admission: token-for-token solo parity, clean allocator."""
+        model, params = model_and_params
+        budgets = [10, 4, 7, 12, 3, 8]
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=3, max_len=32, block_size=4,
+            prompt_buckets=[8, 16], token_budget=12)
+        rids = [eng.add_request(p, n) for p, n in zip(PROMPTS, budgets)]
+        got = eng.run_to_completion(max_ticks=300)
+        assert sorted(got) == sorted(rids)
+        for rid, p, n in zip(rids, PROMPTS, budgets):
+            assert got[rid] == _solo_greedy(model, params, p, n), \
+                f"request {rid} diverged"
+        assert eng.blocks_in_use == 0
+
+    def test_one_program_serves_the_mixed_tick(self, model_and_params):
+        """THE tentpole claim: a workload mixing admissions into running
+        decode dispatches ONLY ragged_step programs — no per-bucket
+        prefill family, no cached-prefill family, no separate decode
+        programs — and at least one step really carried prefill AND
+        decode rows.  Program count stays bounded by table-width buckets
+        (and a fresh engine adds none)."""
+        model, params = model_and_params
+        model.__dict__.pop("_serving_programs", None)
+
+        def make():
+            return RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=3, max_len=32, block_size=4,
+                prompt_buckets=[8, 16], token_budget=12)
+
+        eng = make()
+        r0 = eng.add_request(PROMPTS[0], 8)
+        eng.step()                               # r0 prefills + first token
+        r1 = eng.add_request(PROMPTS[5], 6)      # arrives mid-decode
+        r2 = eng.add_request(PROMPTS[1], 5)
+        got = eng.run_to_completion(max_ticks=200)
+        kinds = {k[0] for k in model._serving_programs}
+        assert kinds == {"ragged_step"}, kinds
+        assert eng.mixed_steps >= 1
+        n_progs = len(model._serving_programs)
+        eng2 = make()                            # same shapes: no new progs
+        eng2.add_request(PROMPTS[2], 5)
+        eng2.run_to_completion(max_ticks=200)
+        assert len(model._serving_programs) == n_progs
+        for rid, p, n in [(r0, PROMPTS[0], 8), (r1, PROMPTS[5], 6),
+                          (r2, PROMPTS[1], 5)]:
+            assert got[rid] == _solo_greedy(model, params, p, n)
+
+    def test_prompt_longer_than_budget_spans_steps(self, model_and_params):
+        """A bucket-16 prompt under a budget of 6 rows prefills across
+        several ragged steps (chunking is inherent — no prefill_chunk
+        knob) while a short request decodes next to it."""
+        model, params = model_and_params
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=48, block_size=4,
+            prompt_buckets=[4, 16], token_budget=6)
+        r0 = eng.add_request([40, 2], 12)              # bucket 4
+        long_p = list(range(3, 17))                    # bucket 16 > budget
+        r1 = eng.add_request(long_p, 5)
+        got = eng.run_to_completion(max_ticks=300)
+        assert got[r0] == _solo_greedy(model, params, [40, 2], 12)
+        assert got[r1] == _solo_greedy(model, params, long_p, 5)
+        assert eng.mixed_steps >= 1
+
+    @pytest.mark.parametrize("interp", [
+        False,
+        pytest.param(True, marks=pytest.mark.slow),  # interpret-mode
+        # Pallas is minutes-scale on CPU; the quick tier keeps the
+        # cheaper kernel_on_off interpret coverage
+    ])
+    def test_int8_kv_pool(self, interp):
+        """int8 (values, scales) pools ride the ragged step with dequant
+        fused into the kernel (interpret arm) or the gather fallback:
+        parity vs solo generate on the SAME int8-cached model."""
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=96,
+                        compute_dtype="float32", kv_cache_dtype="int8")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        set_flags({"FLAGS_paged_attn_interpret": interp})
+        try:
+            eng = RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=8,
+                prompt_buckets=[8], token_budget=10)
+            budgets = [9, 5, 7]
+            rids = [eng.add_request(p, n)
+                    for p, n in zip(PROMPTS[:3], budgets)]
+            got = eng.run_to_completion(max_ticks=200)
+        finally:
+            set_flags({"FLAGS_paged_attn_interpret": False})
+        for rid, p, n in zip(rids, PROMPTS[:3], budgets):
+            assert got[rid] == _solo_greedy(model, params, p, n), \
+                f"int8 request {rid} diverged (interp={interp})"
+
+    def test_kernel_on_off_identical(self, model_and_params):
+        """Engine outputs are token-identical with the ragged Pallas
+        kernel (interpret mode) vs the XLA gather fallback."""
+        model, params = model_and_params
+
+        def run(interp):
+            set_flags({"FLAGS_paged_attn_interpret": interp})
+            try:
+                model.__dict__.pop("_serving_programs", None)
+                eng = RaggedPagedContinuousBatchingEngine(
+                    model, params, max_slots=3, max_len=32, block_size=4,
+                    prompt_buckets=[8, 16], token_budget=12)
+                rids = [eng.add_request(p, n)
+                        for p, n in zip(PROMPTS[:4], [9, 5, 7, 6])]
+                got = eng.run_to_completion(max_ticks=200)
+                return [got[r] for r in rids]
+            finally:
+                set_flags({"FLAGS_paged_attn_interpret": False})
+                model.__dict__.pop("_serving_programs", None)
+
+        assert run(True) == run(False)
+
+
+class TestRaggedAllocator:
+    @pytest.mark.parametrize("interp", [
+        False,
+        pytest.param(True, marks=pytest.mark.slow),  # interpret-mode
+        # Pallas is minutes-scale on CPU; the quick tier keeps the
+        # cheaper kernel_on_off interpret coverage
+    ])
+    def test_preemption_stays_exact_and_signals_replay(self, interp,
+                                                       model_and_params):
+        """Two long requests over a pool that fits one: the younger is
+        preempted and rerun; outputs stay greedy-exact (kernel interpret
+        arm included) and the streaming consumer receives the documented
+        on_token(rid, None, False) replay signal before the re-delivered
+        prefix."""
+        model, params = model_and_params
+        events = []
+        set_flags({"FLAGS_paged_attn_interpret": interp})
+        try:
+            eng = RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=4,
+                num_blocks=8, prompt_buckets=[8], token_budget=10)
+            r0 = eng.add_request(PROMPTS[0], 14)
+            r1 = eng.add_request(PROMPTS[1], 14,
+                                 on_token=lambda rid, tok, done:
+                                 events.append((rid, tok, done)))
+            got = eng.run_to_completion(max_ticks=500)
+        finally:
+            set_flags({"FLAGS_paged_attn_interpret": False})
+        assert eng.preemptions >= 1
+        assert got[r0] == _solo_greedy(model, params, PROMPTS[0], 14)
+        assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 14)
+        resets = [i for i, (rid, tok, _) in enumerate(events)
+                  if tok is None]
+        assert resets, "preempted request never got the replay signal"
+        # the stream AFTER the last reset is the complete, exact answer
+        tail = [tok for rid, tok, _ in events[resets[-1] + 1:]]
+        assert tail == got[r1]
+        assert eng.blocks_in_use == 0
+
+    @pytest.mark.parametrize("interp", [
+        False,
+        pytest.param(True, marks=pytest.mark.slow),  # interpret-mode
+        # Pallas is minutes-scale on CPU; the quick tier keeps the
+        # cheaper kernel_on_off interpret coverage
+    ])
+    def test_prefix_cache_reuses_blocks(self, interp, model_and_params):
+        """Same-pad shared prefix: the second admission pins the cached
+        chain and computes only the suffix rows; outputs stay exact on
+        both the kernel (interpret) and gather arms."""
+        model, params = model_and_params
+        set_flags({"FLAGS_paged_attn_interpret": interp})
+        try:
+            eng = RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=64, block_size=4,
+                prompt_buckets=[16], token_budget=20,
+                enable_prefix_cache=True)
+            sysp = list(range(7, 19))
+            p1, p2 = sysp + [1], sysp + [2]  # same length => shared chain
+            ra = eng.add_request(p1, 6)
+            got = eng.run_to_completion(max_ticks=200)
+            rb = eng.add_request(p2, 6)
+            got2 = eng.run_to_completion(max_ticks=200)
+        finally:
+            set_flags({"FLAGS_paged_attn_interpret": False})
+        assert eng.prefix_hits >= 1
+        assert eng.prefix_blocks_reused >= 1
+        assert got[ra] == _solo_greedy(model, params, p1, 6)
+        assert got2[rb] == _solo_greedy(model, params, p2, 6)
+
+    def test_per_request_planes(self, model_and_params):
+        """Heterogeneous deterministic configs in one ragged batch — the
+        per-request data planes ride the single mixed program."""
+        model, params = model_and_params
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=3, max_len=48, block_size=4,
+            prompt_buckets=[8], token_budget=12, per_request_sampling=True)
+        probe = _solo_greedy(model, params, PROMPTS[0], 8)
+        eos = probe[1]
+        cases = [(PROMPTS[0], 8, {}),
+                 (PROMPTS[1], 7, dict(repetition_penalty=5.0)),
+                 (PROMPTS[0], 8, dict(min_new_tokens=4, eos_token_id=eos))]
+        rids = [eng.add_request(p, n, **c) for p, n, c in cases]
+        got = eng.run_to_completion(max_ticks=300)
+        for rid, (p, n, c) in zip(rids, cases):
+            assert got[rid] == _solo_greedy(model, params, p, n, **c), \
+                f"request {rid} cfg={c}"
+
+    def test_ctor_validation(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="token_budget"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=4, max_len=32, block_size=4,
+                token_budget=2)
+        with pytest.raises(NotImplementedError, match="ticks_per_sync"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=4,
+                ticks_per_sync=2)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=4,
+                prefill_chunk=8)
+
+
+class TestRaggedFuzz:
+    @pytest.mark.slow
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_scenarios_match_solo(self, seed):
+        """Randomized mixed-batch stress: random prompts/budgets/arrival
+        times under randomly drawn engine configs INCLUDING tight pools
+        (deferral + preemption), token budgets, prefix caching, penalty,
+        eos, and int8 — every request's tokens must equal solo generate()
+        with the same knobs, and the allocator must quiesce clean."""
+        rng = np.random.RandomState(seed)
+        kv = "int8" if rng.rand() < 0.5 else None
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=96,
+                        compute_dtype="float32", kv_cache_dtype=kv)
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+
+        penalty = float(rng.choice([1.0, 4.0]))
+        eos = int(rng.randint(0, 97)) if rng.rand() < 0.5 else None
+        bs = int(rng.choice([2, 4, 8]))
+        budget = int(rng.choice([6, 10, 16]))
+        prefix = bool(rng.rand() < 0.5)
+        slots = int(rng.randint(1, 4))
+        budget = max(budget, slots)
+        # worst single request: bucket 16 + decode budget of 11
+        worst = -(-(16 + 11 - 1) // bs)
+        nb = int(rng.randint(worst, worst * 3))
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=slots, max_len=48, block_size=bs,
+            num_blocks=nb, prompt_buckets=[8, 16], token_budget=budget,
+            enable_prefix_cache=prefix, repetition_penalty=penalty,
+            eos_token_id=eos)
+
+        sysp = [int(t) for t in rng.randint(1, 97, 9)]
+        reqs = []
+        for _ in range(int(rng.randint(4, 9))):
+            p = (sysp + [int(t) for t in rng.randint(1, 97,
+                                                     rng.randint(1, 6))]
+                 if rng.rand() < 0.4 else
+                 [int(t) for t in rng.randint(1, 97, rng.randint(1, 15))])
+            n = int(rng.randint(1, 12))
+            reqs.append((eng.add_request(p, n), p, n))
+            for _ in range(int(rng.randint(0, 3))):
+                eng.step()
+        got = eng.run_to_completion(max_ticks=800)
+
+        for rid, p, n in reqs:
+            want = _solo_greedy(model, params, p, n,
+                                repetition_penalty=penalty)
+            if eos is not None and eos in want:
+                want = want[:want.index(eos) + 1]
+            assert got[rid] == want, (
+                f"seed={seed} bs={bs} nb={nb} budget={budget} "
+                f"penalty={penalty} eos={eos} kv={kv} prefix={prefix} "
+                f"preempt={eng.preemptions}")
+        if prefix:
+            cached = sum(1 for b in eng._prefix_cache.values()
+                         if eng._refs.get(b, 0) == 0)
+            assert eng.blocks_in_use == cached
+        else:
+            assert eng.blocks_in_use == 0

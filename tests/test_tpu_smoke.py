@@ -162,3 +162,32 @@ class TestPagedAttentionMosaic:
         np.testing.assert_allclose(got.astype(np.float32),
                                    ref.astype(np.float32),
                                    rtol=2e-2, atol=2e-2)
+
+    def test_ragged_mixed_pack_matches_gather_fallback(self):
+        """The ragged mixed prefill+decode kernel (per-ROW table walk)
+        through the REAL Mosaic compiler vs the XLA gather fallback —
+        the one-program serving step's hot path."""
+        from paddle_tpu.ops.ragged_paged_attention import (
+            ragged_attention_ref, ragged_paged_attention, ragged_rows)
+        r = np.random.RandomState(0)
+        S, nh, hd, NB1, bs, C, T = 8, 12, 64, 33, 32, 8, 64
+        pk = jnp.asarray(r.standard_normal((NB1, bs, nh, hd)), jnp.bfloat16)
+        pv = jnp.asarray(r.standard_normal((NB1, bs, nh, hd)), jnp.bfloat16)
+        table = jnp.asarray(r.randint(0, NB1, (S, C)), jnp.int32)
+        # mixed pack: prefill chunks + single decode rows + an idle seq
+        q_lens = np.array([16, 1, 0, 8, 1, 1, 24, 4])
+        cu = jnp.asarray(np.concatenate([[0], np.cumsum(q_lens)]), jnp.int32)
+        kv = jnp.asarray([q + int(r.randint(0, C * bs - q + 1)) if q else 0
+                          for q in q_lens], jnp.int32)
+        pad = jnp.asarray([int(r.randint(0, 8)) for _ in range(S)],
+                          jnp.int32)
+        q = jnp.asarray(r.standard_normal((T, nh, hd)), jnp.bfloat16)
+        got = _sync(jax.jit(lambda *a: ragged_paged_attention(*a))(
+            q, pk, pv, table, cu, kv, pad))
+        rs, rp = ragged_rows(cu, kv, T)
+        ref = _sync(jax.jit(lambda *a: ragged_attention_ref(*a))(
+            q, pk, pv, table, rs, rp, pad))
+        n_real = int(q_lens.sum())
+        np.testing.assert_allclose(got[:n_real].astype(np.float32),
+                                   ref[:n_real].astype(np.float32),
+                                   rtol=2e-2, atol=2e-2)

@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Fast import-time regression gate: `pytest --collect-only` over tests/
+# must be CLEAN (a single broken import silently deselects a whole module
+# from the tier-1 run — round 5's `from jax import shard_map` regression
+# hid tests/test_spmd_vma_seam.py for a full round).  Run before pushing;
+# tests/test_collect_smoke.py enforces the same invariant in-suite.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+out=$(JAX_PLATFORMS=cpu python -m pytest tests/ -q --collect-only \
+      -p no:cacheprovider 2>&1)
+rc=$?
+echo "$out" | tail -3
+if [ "$rc" -ne 0 ]; then
+    echo "COLLECT SMOKE FAILED: import-time error in tests/ (rc=$rc)"
+    exit 1
+fi
+echo "collect smoke OK"

@@ -181,6 +181,39 @@ def main():
     except Exception as e:  # noqa: BLE001 - report, don't lose the line
         out["paged_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # --- ragged engine: the SAME workload AND the same upfront arrival
+    # schedule as the contiguous/paged baselines (so ragged_vs_contiguous
+    # is a clean engine A/B).  Admissions still mix into running decode:
+    # with every slot busy the queue drains as slots retire, and
+    # ragged_mixed_steps counts the fused prefill+decode ticks that
+    # actually happened.
+    try:
+        from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+        blk = 16 if args.cpu else 32
+        max_len_rg = -(-max_len // blk) * blk
+
+        def run_ragged():
+            eng = RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=S, max_len=max_len_rg,
+                block_size=blk, prompt_buckets=[P_bucket],
+                token_budget=S + P_bucket)
+            for p, n in zip(prompts, budgets):
+                eng.add_request(p, n)
+            got = eng.run_to_completion(max_ticks=100000)
+            assert sum(len(v) for v in got.values()) == total_tokens
+            return eng
+
+        run_ragged()  # warmup the (budget, C) family
+        t0 = time.perf_counter()
+        eng_rg = run_ragged()
+        ragged_dt = time.perf_counter() - t0
+        out["ragged_tok_s"] = round(total_tokens / ragged_dt, 1)
+        out["ragged_vs_contiguous"] = round(engine_dt / ragged_dt, 3)
+        out["ragged_mixed_steps"] = int(eng_rg.mixed_steps)
+        out["ragged_steps"] = int(eng_rg.ragged_steps)
+    except Exception as e:  # noqa: BLE001 - report, don't lose the line
+        out["ragged_error"] = f"{type(e).__name__}: {e}"[:200]
+
     # --- prefix cache: repeated-prefix workload, TTFT A/B (sequential
     # single-slot requests so TTFT == admission prefill + first token)
     try:
