@@ -398,6 +398,8 @@ def bench_gpt_serving(on_tpu):
                         compute_dtype="float32", kv_cache_dtype=kv)
         slots, max_len, bs, budget = 2, 64, 8, 24
         buckets, n_reqs, lo_new, hi_new = [8, 16], 6, 4, 8
+    from paddle_tpu.telemetry import Tracer
+
     model = GPTModel(cfg)
     params = {n: p._data for n, p in model.named_parameters()}
     rng = np.random.RandomState(0)
@@ -406,10 +408,10 @@ def bench_gpt_serving(on_tpu):
                                                       buckets[-1] + 1))],
              int(rng.randint(lo_new, hi_new + 1))) for _ in range(n_reqs)]
 
-    def run_once():
+    def run_once(tracer=None):
         eng = RaggedPagedContinuousBatchingEngine(
             model, params, max_slots=slots, max_len=max_len, block_size=bs,
-            prompt_buckets=buckets, token_budget=budget)
+            prompt_buckets=buckets, token_budget=budget, tracer=tracer)
         added = 0
         while added < len(reqs) or eng.pending():
             # staggered arrivals: two new requests per tick, so admission
@@ -423,17 +425,41 @@ def bench_gpt_serving(on_tpu):
         return sum(len(v) for v in out.values()), eng
 
     run_once()                      # warm: compiles the (budget, C) family
+    tracer = Tracer(capacity=16384)  # host-side only; off path untouched
     t0 = time.perf_counter()
-    total, eng = run_once()
+    total, eng = run_once(tracer)
     dt = time.perf_counter() - t0
     assert total == sum(n for _, n in reqs), (total, "tokens dropped")
+    tel = tracer.summary()
+    tick = tel["tick_wall_s"] or {}
+    req = tel["requests"]
+
+    def ms(v):
+        return None if v is None else round(v * 1e3, 3)
+
     return {"metric": "gpt_serving_tokens_per_sec",
             "value": round(total / dt, 1), "unit": "tokens/s/chip",
             "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
             "loss": 0.0, "backend": "tpu" if on_tpu else "cpu",
             "requests": len(reqs),
             "mixed_steps": int(eng.mixed_steps),
-            "ragged_steps": int(eng.ragged_steps)}
+            "ragged_steps": int(eng.ragged_steps),
+            # telemetry snapshot for the measured run: the warm run built
+            # every program, so compile misses here == recompile storms
+            "telemetry": {
+                "ticks": tel["ticks"],
+                "tick_ms_p50": ms(tick.get("p50")),
+                "tick_ms_p95": ms(tick.get("p95")),
+                "tick_ms_max": ms(tick.get("max")),
+                "compile_hits": tel["compile"]["hits"],
+                "compile_misses": tel["compile"]["misses"],
+                "compile_wall_s": round(tel["compile"]["wall_s"], 3),
+                "ttft_ms_p50": ms((req["ttft_s"] or {}).get("p50")),
+                "ttft_ms_p99": ms((req["ttft_s"] or {}).get("p99")),
+                "itl_ms_p50": ms((req["inter_token_s"] or {}).get("p50")),
+                "itl_ms_p99": ms((req["inter_token_s"] or {}).get("p99")),
+                "preempted": req["replays"],
+            }}
 
 
 CONFIGS = {

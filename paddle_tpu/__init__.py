@@ -84,7 +84,8 @@ _LAZY = ("nn", "optimizer", "amp", "metric", "io", "vision", "distributed", "jit
          "static", "hapi", "ops", "models", "distribution", "profiler", "text",
          "incubate", "utils", "autograd", "regularizer", "callbacks", "linalg", "fft",
          "signal", "sparse", "onnx", "device", "framework", "inference",
-         "quantization", "compat", "sysconfig", "hub", "reader", "dataset")
+         "quantization", "compat", "sysconfig", "hub", "reader", "dataset",
+         "serving", "telemetry")
 
 
 def __getattr__(name):

@@ -21,6 +21,7 @@ sliced back to the true length.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -51,16 +52,26 @@ def pad_to_bucket(x, bucket: int, axis: int, pad_value=0):
 
 def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
               pad_value=0, length_arg: Optional[str] = None,
-              unpad_outputs: bool = True) -> Callable:
+              unpad_outputs: bool = True, tracer=None) -> Callable:
     """Wrap ``fn`` so calls with any length ≤ max(buckets) reuse a bounded
     set of compiled programs.  Array positional args whose ``axis`` size
     matches the leading arg's are padded together; scalars/mismatched args
     pass through untouched.
+
+    Compile visibility: the wrapper exposes ``bucket_calls`` ({bucket:
+    call count}); a bucket's FIRST call — the one that pays the XLA
+    compile — bumps the global ``bucketize_bucket_compiles`` stat and,
+    with a ``paddle_tpu.telemetry.Tracer`` passed as ``tracer``, emits a
+    wall-timed compile event (later calls emit compile hits), so bucket
+    churn shows up in the same place the serving engines report recompile
+    storms.
     """
     bkts = sorted(set(int(b) for b in buckets))
     if not bkts:
         raise ValueError("buckets must be non-empty")
     jfn = jax.jit(fn)
+    calls = {}
+    name = getattr(fn, "__name__", "bucketized")
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
@@ -69,6 +80,8 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
             raise ValueError(f"no array argument with ndim > {axis}")
         L = arrs[0].shape[axis]
         bucket = select_bucket(L, bkts)
+        first = bucket not in calls
+        calls[bucket] = calls.get(bucket, 0) + 1
         padded = tuple(
             pad_to_bucket(a, bucket, axis, pad_value)
             if hasattr(a, "shape") and a.ndim > axis and a.shape[axis] == L
@@ -77,7 +90,20 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
         if length_arg is not None:
             kwargs = dict(kwargs)
             kwargs[length_arg] = jnp.asarray(L, jnp.int32)
-        out = jfn(*padded, **kwargs)
+        if first:
+            from ..utils.stats import stat_add
+            stat_add("bucketize_bucket_compiles")
+            t0 = time.perf_counter()
+            out = jfn(*padded, **kwargs)
+            if tracer is not None:
+                jax.block_until_ready(out)
+                tracer.compile_event(name, (f"bucketize:{name}", bucket),
+                                     False, time.perf_counter() - t0)
+        else:
+            out = jfn(*padded, **kwargs)
+            if tracer is not None:
+                tracer.compile_event(name, (f"bucketize:{name}", bucket),
+                                     True)
 
         if not unpad_outputs:
             return out
@@ -90,6 +116,7 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
         return jax.tree_util.tree_map(unpad, out)
 
     wrapper.buckets = tuple(bkts)
+    wrapper.bucket_calls = calls
     return wrapper
 
 

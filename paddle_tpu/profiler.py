@@ -12,18 +12,25 @@ clock summary table (≙ the aggregated profiler tables).
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
+import threading
 import time
 from collections import defaultdict
-from typing import Optional
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "start_profiler", "stop_profiler",
-           "profiler", "summary"]
+           "reset_profiler", "profiler", "summary", "snapshot_events"]
 
 _events = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+# RecordEvent exits on engine-callback/loader threads mutate _events
+# concurrently with summary() readers — defaultdict creation + list writes
+# race without this
+_events_lock = threading.Lock()
 _active_dir: Optional[str] = None
+_log = logging.getLogger(__name__)
 
 
 class RecordEvent:
@@ -42,9 +49,10 @@ class RecordEvent:
 
     def __exit__(self, *exc):
         dt = time.perf_counter() - self._t0
-        rec = _events[self.name]
-        rec[0] += 1
-        rec[1] += dt
+        with _events_lock:
+            rec = _events[self.name]
+            rec[0] += 1
+            rec[1] += dt
         return self._ann.__exit__(*exc)
 
     # fluid/profiler API aliases
@@ -66,17 +74,39 @@ def start_profiler(log_dir: str = "./profiler_log", state: str = "All",
     _active_dir = log_dir
 
 
-def stop_profiler(sorted_key: str = "total", profile_path: Optional[str] = None):
-    """≙ fluid/profiler.py:257 stop_profiler; prints the aggregated event
-    table and finalizes the trace directory."""
+def stop_profiler(sorted_key: str = "total",
+                  profile_path: Optional[str] = None,
+                  on_summary: Optional[Callable[[str], None]] = None):
+    """≙ fluid/profiler.py:257 stop_profiler; finalizes the trace directory
+    and reports the aggregated event table — through ``on_summary(text)``
+    when given, else the module logger (library code must not print;
+    tests/test_no_print.py enforces it)."""
     global _active_dir
     if _active_dir is None:
         return
     jax.profiler.stop_trace()
-    print(summary(sorted_key))
-    print(f"[profiler] trace written to {_active_dir} "
-          f"(open with TensorBoard / xprof)")
+    text = (summary(sorted_key) + f"\n[profiler] trace written to "
+            f"{_active_dir} (open with TensorBoard / xprof)")
+    if on_summary is not None:
+        on_summary(text)
+    else:
+        _log.info("%s", text)
     _active_dir = None
+
+
+def reset_profiler():
+    """≙ fluid/profiler.py reset_profiler: clear the aggregated host-event
+    table (the XPlane trace state is unaffected)."""
+    with _events_lock:
+        _events.clear()
+
+
+def snapshot_events() -> Dict[str, Tuple[int, float]]:
+    """Consistent copy of the aggregated {name: (calls, total_s)} table —
+    the accessor ``utils.stats.op_summary`` joins on (reading the dict
+    while callback threads mutate it would tear)."""
+    with _events_lock:
+        return {n: (int(c), float(t)) for n, (c, t) in _events.items()}
 
 
 @contextlib.contextmanager
@@ -93,7 +123,7 @@ def profiler(state: str = "All", sorted_key: str = "total",
 def summary(sorted_key: str = "total") -> str:
     """Aggregated host-event table (≙ the reference's profiler summary)."""
     rows = [(name, c, tot, tot / max(c, 1))
-            for name, (c, tot) in _events.items()]
+            for name, (c, tot) in snapshot_events().items()]
     key = {"total": 2, "calls": 1, "ave": 3}.get(sorted_key, 2)
     rows.sort(key=lambda r: r[key], reverse=True)
     lines = [f"{'Event':<40}{'Calls':>8}{'Total(s)':>12}{'Avg(s)':>12}"]

@@ -47,7 +47,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from .jit.bucketing import select_bucket
-from .utils.stats import stat_add
+from .telemetry import program_label
+from .utils.stats import StatRegistry, stat_add
+from .utils.stats import prometheus_text as _prometheus_text
 from .models._decode import (apply_repetition_penalty, make_row_sampler,
                              make_token_sampler, seed_presence,
                              suppress_eos, suppress_eos_rows,
@@ -55,6 +57,26 @@ from .models._decode import (apply_repetition_penalty, make_row_sampler,
 
 __all__ = ["ContinuousBatchingEngine", "SpeculativeBatchingEngine",
            "Request"]
+
+
+def _timed_first_dispatch(run, cb):
+    """Wrap a freshly built program so its FIRST invocation — the one that
+    pays trace + XLA compile — is timed end-to-end (block_until_ready) and
+    reported through ``cb(seconds)``.  Only installed when a tracer is
+    attached at build time; later invocations are one bool check."""
+    state = [False]
+
+    def wrapped(*a, **kw):
+        if state[0]:
+            return run(*a, **kw)
+        t0 = time.perf_counter()
+        out = run(*a, **kw)
+        jax.block_until_ready(out)
+        state[0] = True
+        cb(time.perf_counter() - t0)
+        return out
+
+    return wrapped
 
 
 def _slot_write(slot):
@@ -103,7 +125,7 @@ class ContinuousBatchingEngine:
                  key=None, ticks_per_sync: int = 1, mesh=None,
                  repetition_penalty: float = 1.0, min_new_tokens: int = 0,
                  prefill_chunk: Optional[int] = None,
-                 per_request_sampling: bool = False):
+                 per_request_sampling: bool = False, tracer=None):
         """``ticks_per_sync``: decode ticks fused into one device program
         between host synchronizations.  1 = retire/admit after every token
         (lowest latency); k > 1 amortizes the host round-trip over k tokens
@@ -128,7 +150,13 @@ class ContinuousBatchingEngine:
         positions per scheduler round (must divide every bucket), so one
         long prompt cannot stall every running request's decode for a full
         prefill — the head-of-line latency fix.  None = whole-bucket
-        prefill in one round."""
+        prefill in one round.
+
+        ``tracer``: optional ``paddle_tpu.telemetry.Tracer``; when set the
+        engine emits per-tick, per-compile, and per-request structured
+        events (host-side only — compiled programs are identical with or
+        without it).  None (default) keeps the scheduler hot path at a
+        single attribute check: no event allocation, no tracer lock."""
         c = model.config
         if max_len > c.max_position_embeddings:
             raise ValueError(f"max_len {max_len} exceeds "
@@ -280,8 +308,16 @@ class ContinuousBatchingEngine:
         self._queue: List[Request] = []
         self._finished: Dict[int, List[int]] = {}
         self._ids = itertools.count()
-        self._m = {"requests": 0, "tokens": 0, "ttft_sum": 0.0,
-                   "latency_sum": 0.0, "started": time.monotonic()}
+        # observability: a PRIVATE registry per engine (concurrent engines
+        # must not alias counters) feeding metrics()/prometheus_text();
+        # plain ints for the compile counters (they sit on the program-fetch
+        # path and need no lock under the GIL)
+        self.tracer = tracer
+        self._stats = StatRegistry()
+        self._started = time.monotonic()
+        self._compile_hits = 0
+        self._compile_misses = 0
+        self._tick_note: Dict[str, object] = {}
 
     def _alloc_caches(self):
         """Cache storage seam: the contiguous engine allocates one
@@ -314,11 +350,51 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._r_eos))
 
     def _cached_prog(self, cache_key, build):
-        """Model-level compiled-program cache (see _sig)."""
+        """Model-level compiled-program cache (see _sig), instrumented:
+        every fetch counts a hit or miss, and with a tracer attached a
+        miss's first dispatch is wall-timed — recompile storms become
+        visible, warnable events instead of silent bench sinkholes."""
         progs = self.model.__dict__.setdefault("_serving_programs", {})
-        if cache_key not in progs:
-            progs[cache_key] = build()
-        return progs[cache_key]
+        if cache_key in progs:
+            self._note_prog(cache_key, True)
+            return progs[cache_key]
+        run = build()
+        # the BARE program goes in the model-lifetime cache; only the
+        # engine-local return is timing-wrapped — a wrapper in the cache
+        # would pin this engine's tracer for the model's lifetime and
+        # misroute a later engine's first dispatch to it
+        progs[cache_key] = run
+        return self._note_prog(cache_key, False, run)
+
+    def _note_prog(self, key, hit: bool, run=None):
+        """Compile-cache accounting: bump the engine counters (always —
+        two lock-free int adds), and with a tracer attached emit a compile
+        event; a miss returns ``run`` wrapped so its first dispatch
+        reports the compile wall time."""
+        if hit:
+            self._compile_hits += 1
+        else:
+            self._compile_misses += 1
+        tr = self.tracer
+        if tr is None:
+            return run
+        self._tick_note.setdefault("programs", []).append(
+            program_label(key))
+        name = type(self).__name__
+        if hit:
+            tr.compile_event(name, key, True)
+            return run
+        self._tick_note["compiles"] = \
+            self._tick_note.get("compiles", 0) + 1
+        return _timed_first_dispatch(
+            run, lambda dt: tr.compile_event(name, key, False, dt))
+
+    def _note(self, key: str, value=1):
+        """Accumulate one per-tick telemetry field (no-op when tracing is
+        off — a single attribute check)."""
+        if self.tracer is None:
+            return
+        self._tick_note[key] = self._tick_note.get(key, 0) + value
 
     def _first_token_tail(self):
         """The first-token sampling sequence (penalty → EOS window → draw →
@@ -543,6 +619,9 @@ class ContinuousBatchingEngine:
         req.sampling = self._resolve_sampling(sampling)
         req.on_token = on_token
         self._queue.append(req)
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "queued",
+                                      prompt_len=len(prompt))
         return req.id
 
     _SAMPLING_KEYS = ("temperature", "top_k", "top_p", "greedy",
@@ -669,13 +748,18 @@ class ContinuousBatchingEngine:
                 jnp.int32(slot), self._next_key(), self._presence,
                 self._plane_operands())
             self.caches = (ck, cv)
+            self._note("prefill_tokens", P)
             self._activate(slot, req, P, pad, int(tok0))
 
     def _set_planes(self, slot, req):
         """Write the request's effective sampler config into the slot's
         row of the per-request planes (no-op in classic mode).  Must run
         BEFORE the admission prefill — the first token samples through the
-        planes."""
+        planes.  Doubles as the single admission choke point every engine
+        passes through, so it also emits the ``admitted`` telemetry
+        transition."""
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "admitted", slot=int(slot))
         if not self.per_request:
             return
         t, k, p, g, rp, mn, eos = req.sampling
@@ -689,6 +773,9 @@ class ContinuousBatchingEngine:
 
     def _activate(self, slot, req, P, pad, tok0):
         req.first_token_at = time.monotonic()   # tok0 exists: TTFT point
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "first_token",
+                                      slot=int(slot))
         self._slot_req[slot] = req
         self._t[slot] = P
         self._pad[slot] = pad
@@ -710,6 +797,7 @@ class ContinuousBatchingEngine:
                 jnp.int32(i * seg), jnp.int32(st["pad"]), jnp.int32(slot),
                 self._presence, self._next_key(), self._plane_operands())
             self.caches = (ck, cv)
+            self._note("prefill_tokens", seg)
             if last:
                 del self._filling[slot]
                 self._activate(slot, st["req"], st["P"], st["pad"],
@@ -721,6 +809,8 @@ class ContinuousBatchingEngine:
         """Append a token to the slot's request; retire on EOS/budget."""
         req = self._slot_req[slot]
         req.generated.append(tok)
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "token", token=int(tok))
         eos = (req.sampling[6] if self.per_request else self.eos_token_id)
         hit_eos = (eos is not None and eos >= 0 and tok == eos)
         done = len(req.generated) >= req.max_new_tokens or hit_eos
@@ -745,12 +835,48 @@ class ContinuousBatchingEngine:
         n = len(req.generated)
         stat_add("serving_requests_finished")
         stat_add("serving_tokens_emitted", n)
-        self._m["requests"] += 1
-        self._m["tokens"] += n
-        self._m["ttft_sum"] += req.first_token_at - req.enqueued_at
-        self._m["latency_sum"] += req.finished_at - req.enqueued_at
+        s = self._stats
+        s.add("requests_finished")
+        s.add("tokens_emitted", n)
+        s.add("ttft_seconds_sum", req.first_token_at - req.enqueued_at)
+        s.add("latency_seconds_sum", req.finished_at - req.enqueued_at)
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "retired", tokens=n)
+
+    _TICK_COUNTERS = ("tokens_emitted", "requests_finished")
 
     def step(self):
+        """One scheduler round (each engine's ``_step_impl`` documents its
+        semantics).  With a tracer attached the round is bracketed by tick
+        telemetry — host wall time, queue depth, counter deltas, packed
+        rows, program labels; with ``tracer=None`` (default) this wrapper
+        is ONE attribute check and a tail call: no event allocation, no
+        tracer lock, no extra operands anywhere near a compiled program."""
+        tr = self.tracer
+        if tr is None:
+            return self._step_impl()
+        t0 = time.perf_counter()
+        self._tick_note = {}
+        s = self._stats
+        base = {k: s.value(k) for k in self._TICK_COUNTERS}
+        try:
+            return self._step_impl()
+        finally:
+            fields = {k: s.value(k) - base[k] for k in self._TICK_COUNTERS}
+            fields.update(self._tick_gauges())
+            fields.update(self._tick_note)
+            self._tick_note = {}
+            tr.tick(type(self).__name__, time.perf_counter() - t0,
+                    queue_depth=len(self._queue),
+                    active=int(self._active.sum()),
+                    filling=len(self._filling), **fields)
+
+    def _tick_gauges(self) -> Dict[str, float]:
+        """Instantaneous per-tick gauges (subclass hook; only consulted
+        when tracing is on)."""
+        return {}
+
+    def _step_impl(self):
         """One scheduler round: admit waiting requests into free slots, then
         run ``ticks_per_sync`` batched decode ticks and retire finished
         requests from the returned token block."""
@@ -797,6 +923,7 @@ class ContinuousBatchingEngine:
             return None
         run = self._decode_prog_all()
         active_before = self._active.copy()
+        self._note("decode_rows", int(active_before.sum()))
         emitted0 = np.asarray(
             [len(r.generated) if r is not None else 0
              for r in self._slot_req], np.int32)
@@ -810,18 +937,66 @@ class ContinuousBatchingEngine:
         self.caches = (ck, cv)
         return active_before, np.asarray(blk)
 
+    # metrics() contract: {key: (kind, pytype)}; kind "counter" = monotonic
+    # over the engine's lifetime, "gauge" = instantaneous/derived.  Keys
+    # never change meaning; subclasses extend (docs/OBSERVABILITY.md).
+    METRICS_SCHEMA = {
+        "requests_finished": ("counter", int),
+        "tokens_emitted": ("counter", int),
+        "mean_ttft_s": ("gauge", float),
+        "mean_latency_s": ("gauge", float),
+        "tokens_per_sec": ("gauge", float),
+        "compile_hits": ("counter", int),
+        "compile_misses": ("counter", int),
+    }
+
+    @classmethod
+    def metrics_schema(cls) -> Dict[str, tuple]:
+        """The stable ``metrics()`` schema for this engine class, merged
+        over the MRO.  Every key metrics() returns appears here with its
+        kind and type; conditional keys (prefix caching off) may be absent
+        from a given metrics() dict but never change meaning."""
+        out: Dict[str, tuple] = {}
+        for klass in reversed(cls.__mro__):
+            out.update(klass.__dict__.get("METRICS_SCHEMA", {}))
+        return out
+
     def metrics(self) -> Dict[str, float]:
-        """Serving observability (feeds the same StatRegistry the rest of
-        the framework reports through): finished-request counts, mean
+        """Serving observability, registry-backed (one private
+        ``utils.stats.StatRegistry`` per engine — the same mechanism the
+        rest of the framework counts through, exported whole by
+        ``prometheus_text()``): finished-request counts, mean
         time-to-first-token (queue wait + prefill), mean request latency,
-        and lifetime throughput."""
-        m, n = self._m, max(self._m["requests"], 1)
-        dt = max(time.monotonic() - m["started"], 1e-9)
-        return {"requests_finished": m["requests"],
-                "tokens_emitted": m["tokens"],
-                "mean_ttft_s": m["ttft_sum"] / n,
-                "mean_latency_s": m["latency_sum"] / n,
-                "tokens_per_sec": m["tokens"] / dt}
+        lifetime throughput, and compile-cache hit/miss counts.  Schema:
+        ``metrics_schema()``."""
+        s = self._stats
+        nreq = int(s.value("requests_finished"))
+        n = max(nreq, 1)
+        toks = int(s.value("tokens_emitted"))
+        dt = max(time.monotonic() - self._started, 1e-9)
+        return {"requests_finished": nreq,
+                "tokens_emitted": toks,
+                "mean_ttft_s": float(s.value("ttft_seconds_sum")) / n,
+                "mean_latency_s": float(s.value("latency_seconds_sum")) / n,
+                "tokens_per_sec": toks / dt,
+                "compile_hits": self._compile_hits,
+                "compile_misses": self._compile_misses}
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_serving") -> str:
+        """Prometheus text exposition of this engine's registry plus the
+        derived ``metrics()`` values not stored as raw registry stats,
+        each typed per ``metrics_schema()`` (compile counts stay
+        counters, means/throughput are gauges)."""
+        raw = set(self._stats.snapshot())
+        schema = self.metrics_schema()
+        gauges, counters = {}, {}
+        for k, v in self.metrics().items():
+            if k in raw:
+                continue
+            (counters if schema[k][0] == "counter" else gauges)[k] = v
+        return _prometheus_text(self._stats, namespace=namespace,
+                                extra_gauges=gauges,
+                                extra_counters=counters)
 
     def run_to_completion(self, max_ticks: Optional[int] = None
                           ) -> Dict[int, List[int]]:
@@ -854,7 +1029,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
     (the paged composition lifts the prefill restriction).
     """
 
-    _SUPPORTED_CACHE_KW = frozenset()
+    _SUPPORTED_CACHE_KW = frozenset({"tracer"})
 
     def __init__(self, model, params, draft_model, draft_params,
                  max_slots: int, max_len: int, draft_k: int = 4,
@@ -911,17 +1086,21 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         _spec_program pattern): the compiled closures capture the draft
         model object, and the config tuple in _sig is not a complete
         architecture signature — an engine over the same target but a
-        different draft instance must rebuild, never reuse."""
+        different draft instance must rebuild, never reuse.  Same
+        hit/miss telemetry as the base cache (_note_prog)."""
         import weakref
         progs = self.model.__dict__.setdefault("_serving_programs", {})
         entry = progs.get(cache_key)
         if entry is not None:
             ref, cached = entry
             if ref() is self.draft_model:
+                self._note_prog(cache_key, True)
                 return cached
         run = build()
+        # bare program in the cache, wrapper only on the local return
+        # (same tracer-lifetime reasoning as the base _cached_prog)
         progs[cache_key] = (weakref.ref(self.draft_model), run)
-        return run
+        return self._note_prog(cache_key, False, run)
 
     def _positions_needed(self, P: int, mnt: int) -> int:
         # budget 1 completes at admission prefill — no round, no slack;
@@ -967,6 +1146,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
             P = select_bucket(len(req.prompt), self.buckets)
             pad = P - len(req.prompt)
             ids = [0] * pad + req.prompt
+            self._set_planes(slot, req)     # classic mode: telemetry only
             run = self._prefill_prog(P)
             big, dbig, tok0, self._presence = run(
                 (self.params, self.draft_params), self.caches,
@@ -974,6 +1154,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                 jnp.int32(pad), jnp.int32(slot), self._next_key(),
                 self._presence)
             self.caches, self.draft_caches = big, dbig
+            self._note("prefill_tokens", P)
             self._activate(slot, req, P, pad, int(tok0))
 
     def _spec_round_prog(self):
@@ -1050,7 +1231,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
 
         return big, dbig, lead, block
 
-    def step(self):
+    def _step_impl(self):
         """One scheduler round: admit (advancing any chunked fills in
         the paged composition), then one speculative round; each active
         slot advances by its own accepted count + 1."""
@@ -1084,6 +1265,7 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
         composition overrides this to grow block tables first."""
         run = self._spec_round_prog()
         active_before = self._active.copy()
+        self._note("decode_rows", int(active_before.sum()))
         big, dbig, lead, block = run(
             (self.params, self.draft_params), self.caches,
             self.draft_caches, jnp.asarray(self._tok),

@@ -102,8 +102,6 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._nblk = np.zeros(self.S, np.int32)       # leading real blocks
         self._admit_seq = np.zeros(self.S, np.int64)  # preemption (LIFO)
         self._seq = 0
-        self.blocks_high_water = 0
-        self.preemptions = 0
         # prefix cache: a block is free / referenced (refs > 0) / CACHED
         # (refs == 0 but registered under its content chain — evictable).
         # Chain key = (pad, padded prompt tokens through this block): the
@@ -112,8 +110,32 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._refs = {}                               # bid -> refcount
         self._prefix_cache = collections.OrderedDict()  # chain -> bid (LRU)
         self._key_of = {}                             # bid -> chain
-        self.prefix_hits = 0
-        self.prefix_blocks_reused = 0
+        # allocator counters live in the per-engine registry (serving.py
+        # builds it) so metrics()/prometheus/tick deltas share one source;
+        # the public names below stay readable attributes via properties
+
+    _TICK_COUNTERS = (ContinuousBatchingEngine._TICK_COUNTERS
+                      + ("blocks_allocated", "blocks_released",
+                         "preemptions", "prefix_hits"))
+
+    @property
+    def preemptions(self) -> int:
+        return int(self._stats.value("preemptions"))
+
+    @property
+    def prefix_hits(self) -> int:
+        return int(self._stats.value("prefix_hits"))
+
+    @property
+    def prefix_blocks_reused(self) -> int:
+        return int(self._stats.value("prefix_blocks_reused"))
+
+    @property
+    def blocks_high_water(self) -> int:
+        return int(self._stats.value("blocks_high_water"))
+
+    def _tick_gauges(self):
+        return {"blocks_in_use": self.blocks_in_use}
 
     # ------------------------------------------------------------ storage --
 
@@ -189,12 +211,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 out.append(bid)
         for bid in out:
             self._refs[bid] = 1
+        self._stats.add("blocks_allocated", len(out))
         return out
 
     def _release(self, bid: int):
         self._refs[bid] -= 1
-        if self._refs[bid] == 0 and bid not in self._key_of:
-            self._free.append(bid)                # cached blocks linger
+        if self._refs[bid] == 0:
+            self._stats.add("blocks_released")    # unpinned (maybe cached)
+            if bid not in self._key_of:
+                self._free.append(bid)            # cached blocks linger
 
     def _ensure_blocks(self, slot: int, upto: int) -> bool:
         """Grow the slot's table to cover logical positions [0, upto);
@@ -207,8 +232,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         for i, bid in enumerate(got):
             self._table[slot, have + i] = bid
         self._nblk[slot] = max(have, need)
-        self.blocks_high_water = max(self.blocks_high_water,
-                                     self.blocks_in_use)
+        self._stats.set("blocks_high_water", max(self.blocks_high_water,
+                                                 self.blocks_in_use))
         return True
 
     def _free_slot_blocks(self, slot: int):
@@ -304,7 +329,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         req.first_token_at = None
         self._queue.insert(0, req)
         self._free_slot_blocks(victim)
-        self.preemptions += 1
+        self._stats.add("preemptions")
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "preempted",
+                                      slot=int(victim))
         if req.on_token is not None:
             try:
                 req.on_token(req.id, None, False)      # replay/reset signal
@@ -563,12 +591,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 for i, bid in enumerate(fresh):
                     self._table[slot, F + i] = bid
                 self._nblk[slot] = P // self.bs
-                self.blocks_high_water = max(self.blocks_high_water,
-                                             self.blocks_in_use)
+                self._stats.set("blocks_high_water",
+                                max(self.blocks_high_water,
+                                    self.blocks_in_use))
                 self._set_planes(slot, req)
+                self._note("prefill_tokens", suffix)
                 self._run_cached_prefill(slot, req, P, pad, ids, F)
-                self.prefix_hits += 1
-                self.prefix_blocks_reused += F
+                self._stats.add("prefix_hits")
+                self._stats.add("prefix_blocks_reused", F)
                 continue
             # whole-bucket admission needs its P/bs blocks NOW; chunked
             # admission grows per segment.  A dry pool defers admission
@@ -589,6 +619,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                        "P": P, "seg": 0,
                                        "nseg": P // self.prefill_chunk}
                 continue
+            self._note("prefill_tokens", P)
             self._run_admission_prefill(slot, req, P, pad, ids)
 
     def _run_cached_prefill(self, slot, req, P, pad, ids, F):
@@ -636,6 +667,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     self._preempt_one()
                 continue
             tok0 = self._run_fill_segment(slot, st, i, first, last)
+            self._note("prefill_tokens", seg)
             if last:
                 del self._filling[slot]
                 self._register_prompt_blocks(slot, st["ids"], st["pad"],
@@ -683,10 +715,24 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _decode_extra_operands(self):
         return (jnp.asarray(self._table),)
 
+    METRICS_SCHEMA = {
+        "blocks_in_use": ("gauge", float),
+        "blocks_high_water": ("gauge", float),
+        "blocks_allocated": ("counter", float),
+        "blocks_released": ("counter", float),
+        "preemptions": ("counter", float),
+        # present only with enable_prefix_cache=True:
+        "blocks_cached": ("gauge", float),
+        "prefix_hits": ("counter", float),
+        "prefix_blocks_reused": ("counter", float),
+    }
+
     def metrics(self):
         m = super().metrics()
         m["blocks_in_use"] = float(self.blocks_in_use)
         m["blocks_high_water"] = float(self.blocks_high_water)
+        m["blocks_allocated"] = float(self._stats.value("blocks_allocated"))
+        m["blocks_released"] = float(self._stats.value("blocks_released"))
         m["preemptions"] = float(self.preemptions)
         if self.prefix_caching:
             m["blocks_cached"] = float(self._evictable_count())
@@ -758,8 +804,15 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
                 f"token_budget ({tb}) must cover every decode slot "
                 f"(max_slots={max_slots})")
         self.token_budget = tb
-        self.ragged_steps = 0
-        self.mixed_steps = 0      # steps that carried prefill AND decode
+
+    @property
+    def ragged_steps(self) -> int:
+        return int(self._stats.value("ragged_steps"))
+
+    @property
+    def mixed_steps(self) -> int:
+        """Steps that carried prefill AND decode rows."""
+        return int(self._stats.value("mixed_steps"))
 
     # --------------------------------------------------------- scheduling --
 
@@ -782,8 +835,8 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
                     self._refs[bid] += 1
                 self._table[slot, :F] = hit
                 self._nblk[slot] = F
-                self.prefix_hits += 1
-                self.prefix_blocks_reused += F
+                self._stats.add("prefix_hits")
+                self._stats.add("prefix_blocks_reused", F)
             self._seq += 1
             self._admit_seq[slot] = self._seq
             self._set_planes(slot, req)
@@ -876,11 +929,11 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
             C *= 2
         C = min(C, self.MB)
         if dec_slots and fill_adv:
-            self.mixed_steps += 1
+            self._stats.add("mixed_steps")
         return (toks, row_seq, row_pos, C, sample_rows, sample_active,
                 dec_slots, fill_adv)
 
-    def step(self):
+    def _step_impl(self):
         """One scheduler round = ONE device program: admit, pack, run the
         ragged step, unpack sampled tokens (decode slots advance;
         completed prompts activate with their first token)."""
@@ -890,6 +943,16 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
             return
         (toks, row_seq, row_pos, C, sample_rows, sample_active, dec_slots,
          fill_adv) = pack
+        if self.tracer is not None:
+            pf = int(sum(fill_adv.values()))
+            note = self._tick_note
+            note["decode_rows"] = note.get("decode_rows", 0) \
+                + len(dec_slots)
+            note["prefill_tokens"] = note.get("prefill_tokens", 0) + pf
+            note["budget_used"] = note.get("budget_used", 0) \
+                + len(dec_slots) + pf
+            note["token_budget"] = self.token_budget
+            note["table_cols"] = C
         emitted0 = np.asarray(
             [len(self._slot_req[s].generated) if self._active[s] else 0
              for s in range(self.S)], np.int32)
@@ -902,7 +965,7 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
             jnp.asarray(emitted0), self._next_key(), self._presence,
             self._plane_operands())
         self.caches = (ck, cv)
-        self.ragged_steps += 1
+        self._stats.add("ragged_steps")
         ntok = np.asarray(ntok)
         for slot in dec_slots:
             self._t[slot] += 1
@@ -974,6 +1037,11 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
 
         return run
 
+    METRICS_SCHEMA = {
+        "ragged_steps": ("counter", float),
+        "mixed_steps": ("counter", float),
+    }
+
     def metrics(self):
         m = super().metrics()
         m["ragged_steps"] = float(self.ragged_steps)
@@ -1001,7 +1069,7 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
 
     _SUPPORTED_CACHE_KW = frozenset({"block_size", "num_blocks",
                                      "enable_prefix_cache",
-                                     "prefill_chunk"})
+                                     "prefill_chunk", "tracer"})
 
     def __init__(self, model, params, draft_model, draft_params,
                  max_slots: int, max_len: int, draft_k: int = 4,
@@ -1167,6 +1235,7 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
         run = self._cached_prog(("spec_round_paged", C, self._sig),
                                 lambda: self._build_spec_round_paged(C))
         active_before = self._active.copy()
+        self._note("decode_rows", int(active_before.sum()))
         # inactive rows pre-zeroed: their parked writes land in trash even
         # where the clamped column lookup would alias a real block
         gated = np.where(active_before[:, None], self._table[:, :C], 0)

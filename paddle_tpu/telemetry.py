@@ -1,0 +1,510 @@
+"""Serving telemetry: a bounded ring-buffer structured-event tracer for the
+continuous-batching engines.
+
+The serving engines (``paddle_tpu.serving`` / ``serving_paged``) accept a
+``tracer=Tracer()`` at construction and then emit host-side events on every
+scheduler tick, compile-cache access, and request state transition.  The
+tracer is a pure observer: it adds NO operands to any compiled program, and
+with ``tracer=None`` (the default) the engines' hot path performs a single
+attribute check — no event allocation, no lock.
+
+Event kinds (each event is one flat JSON-serializable dict):
+
+``tick``     one scheduler round.  Fields: ``engine`` (class name),
+             ``dur_s`` (host wall time), ``queue_depth``, ``active``
+             (decoding slots), ``filling`` (prompts mid-prefill), per-tick
+             deltas of the engine counters (``tokens_emitted``,
+             ``requests_finished``, and for paged engines
+             ``blocks_allocated``/``blocks_released``/``preemptions``/
+             ``prefix_hits``), plus whatever the engine packed this tick:
+             ``decode_rows``, ``prefill_tokens``, ``budget_used``/
+             ``token_budget`` (ragged), ``programs`` (short labels of the
+             compiled programs dispatched, e.g. ``ragged_step:12:4``), and
+             ``compiles`` (program-cache misses paid inside the tick).
+``compile``  one program-cache MISS: ``key`` (short label), ``wall_s``
+             (host wall time of the program's first dispatch — trace +
+             XLA compile + first execution), ``engine``.  Hits are
+             counter-only (``compile_hits`` in the registry, plus the
+             tick's ``programs`` labels) so steady-state fetches cannot
+             evict tick/request history from the ring.
+``request``  one request state transition: ``rid`` plus ``what`` in
+             ``queued`` → ``admitted`` → ``first_token`` → ``token`` →
+             (``preempted`` → ``admitted`` → …) → ``retired``.
+
+Exports:
+
+- ``dump_jsonl(path)``            one event per line, replayable;
+- ``to_chrome_trace()``           Chrome-trace JSON (``{"traceEvents":…}``,
+  the same output contract as ``tools/trace_to_chrome.py``'s XPlane
+  conversion, so engine spans and device traces merge in one Perfetto view
+  — ``tools/trace_to_chrome.py --engine-trace`` does the merge);
+- ``prometheus_text()``           text exposition of the tracer registry
+  (tick/TTFT/inter-token/compile histograms + counters) built on
+  ``utils/stats.py``;
+- ``request_summary()``           exact p50/p95/p99 TTFT and inter-token
+  latency over the retained per-request timelines;
+- ``summary()``                   one JSON-able snapshot (tick histogram,
+  compile counts, request percentiles) — what ``bench.py`` attaches to
+  BENCH rounds.
+
+Recompile visibility: every program-cache miss after the engine's first
+completed tick is counted as a *post-warmup* recompile; once
+``recompile_warn_threshold`` of them accumulate the tracer logs ONE warning
+(the recompile-storm dial that has repeatedly eaten bench rounds —
+HEALTH.log).
+
+No single reference counterpart: this is the serving-shaped composition of
+the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
+``monitor.h`` StatRegistry, and ``tools/timeline.py`` chrome-trace export.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
+                          prometheus_text as _stats_prometheus_text)
+
+__all__ = ["Tracer", "RequestTimeline", "program_label",
+           "chrome_trace_from_jsonl"]
+
+_PCTS = (50.0, 95.0, 99.0)
+
+
+def program_label(key) -> str:
+    """Short display label for an engine program-cache key: the kind tag
+    plus its leading shape/bucket ints — the full key embeds the engine
+    signature tuple, which is noise at event granularity."""
+    if not isinstance(key, tuple) or not key:
+        return str(key)
+    parts = [str(key[0])]
+    for k in key[1:]:
+        if isinstance(k, bool) or not isinstance(k, int):
+            break
+        parts.append(str(k))
+    return ":".join(parts)
+
+
+def _percentiles(samples) -> Optional[Dict[str, float]]:
+    if not samples:
+        return None
+    import numpy as np
+    arr = np.asarray(samples, dtype=float)
+    out = {f"p{int(p)}": float(np.percentile(arr, p)) for p in _PCTS}
+    out["mean"] = float(arr.mean())
+    out["max"] = float(arr.max())
+    out["count"] = int(arr.size)
+    return out
+
+
+class RequestTimeline:
+    """Host-side latency timeline of ONE request.  All timestamps are the
+    tracer's monotonic clock (seconds since tracer construction).  A
+    preemption closes the current attempt: streamed tokens are discarded
+    (mirroring the engine's documented ``on_token(rid, None, False)``
+    reset signal) and TTFT restarts measuring at the ORIGINAL queued_at —
+    the replayed prefill is not double-counted, the request simply has one
+    TTFT: queued → the first token that was never rolled back."""
+
+    __slots__ = ("rid", "prompt_len", "queued_at", "admitted_at",
+                 "first_token_at", "token_times", "preempted_spans",
+                 "retired_at", "replays", "tokens_delivered")
+
+    def __init__(self, rid: int, queued_at: float, prompt_len: int = 0):
+        self.rid = rid
+        self.prompt_len = prompt_len
+        self.queued_at = queued_at
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.token_times: List[float] = []
+        self.preempted_spans: List[List[Optional[float]]] = []
+        self.retired_at: Optional[float] = None
+        self.replays = 0
+        self.tokens_delivered = 0
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.queued_at
+
+    def inter_token_s(self) -> List[float]:
+        return [b - a for a, b in zip(self.token_times,
+                                      self.token_times[1:])]
+
+    def spans(self) -> List[Dict[str, Any]]:
+        """Named (start, end) spans for trace export; open spans end at
+        the last known timestamp."""
+        out = []
+        last = max([self.queued_at] + self.token_times
+                   + [t for t in (self.admitted_at, self.first_token_at,
+                                  self.retired_at) if t is not None]
+                   + [s[1] for s in self.preempted_spans
+                      if s[1] is not None])
+
+        def span(name, a, b):
+            if a is not None:
+                out.append({"name": name, "start": a,
+                            "end": b if b is not None else last})
+
+        span("queued", self.queued_at, self.admitted_at)
+        span("prefill", self.admitted_at, self.first_token_at)
+        span("decode", self.first_token_at, self.retired_at)
+        for s in self.preempted_spans:
+            span("preempted", s[0], s[1])
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rid": self.rid, "prompt_len": self.prompt_len,
+                "queued_at": self.queued_at, "admitted_at": self.admitted_at,
+                "first_token_at": self.first_token_at,
+                "retired_at": self.retired_at, "replays": self.replays,
+                "tokens_delivered": self.tokens_delivered,
+                "ttft_s": self.ttft_s,
+                "preempted_spans": [list(s) for s in self.preempted_spans]}
+
+
+class Tracer:
+    """Bounded structured-event tracer (see module docstring).
+
+    ``capacity`` bounds the event ring buffer (oldest events drop;
+    ``events_dropped`` counts them) and the retained COMPLETED request
+    timelines.  All mutation happens under one lock — engines only touch it
+    when a tracer is attached, so the acceptance contract "``step()`` takes
+    no tracer lock when tracing is off" holds by construction.
+    """
+
+    def __init__(self, capacity: int = 4096,
+                 registry: Optional[StatRegistry] = None,
+                 recompile_warn_threshold: int = 8,
+                 logger: Optional[logging.Logger] = None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._events: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._live: Dict[int, RequestTimeline] = {}
+        self._done: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self.registry = registry if registry is not None else StatRegistry()
+        self._t0 = time.monotonic()
+        self.events_dropped = 0
+        self.recompile_warn_threshold = int(recompile_warn_threshold)
+        self._post_warm_misses = 0
+        self._warned_storm = False
+        self._ticks = 0
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        # histograms live in the registry so prometheus_text() exports them
+        self.registry.histogram("tick_seconds", DEFAULT_TIME_BUCKETS)
+        self.registry.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
+        self.registry.histogram("inter_token_seconds", DEFAULT_TIME_BUCKETS)
+        self.registry.histogram("compile_seconds", DEFAULT_TIME_BUCKETS)
+
+    # ------------------------------------------------------------- clock --
+
+    def now(self) -> float:
+        return time.monotonic() - self._t0
+
+    # ----------------------------------------------------------- ingest --
+
+    def _append(self, ev: Dict[str, Any]):
+        if len(self._events) == self.capacity:
+            self.events_dropped += 1
+        self._events.append(ev)
+
+    def emit(self, kind: str, **fields):
+        """Append one structured event (adds ``kind`` and ``ts``)."""
+        ev = {"kind": kind, "ts": self.now()}
+        ev.update(fields)
+        with self._lock:
+            self._append(ev)
+        return ev
+
+    def tick(self, engine: str, dur_s: float, **fields):
+        """One scheduler round; observes the tick-duration histogram and
+        arms the post-warmup recompile accounting."""
+        self.registry.add("ticks")
+        self.registry.observe("tick_seconds", dur_s)
+        with self._lock:
+            self._ticks += 1
+            ev = {"kind": "tick", "ts": self.now(), "engine": engine,
+                  "dur_s": dur_s}
+            ev.update(fields)
+            self._append(ev)
+        return ev
+
+    def compile_event(self, engine: str, key, hit: bool,
+                      wall_s: float = 0.0):
+        """One program-cache access.  HITS are counter-only (several per
+        tick at steady state — ring events for them would evict the tick/
+        request history that summary() percentiles read); MISSES get a
+        ring event, and misses after the first completed tick count toward
+        the recompile-storm warning."""
+        reg = self.registry
+        if hit:
+            reg.add("compile_hits")
+            return None
+        label = program_label(key)
+        reg.add("compile_misses")
+        reg.observe("compile_seconds", wall_s)
+        reg.add("compile_wall_seconds_sum", wall_s)
+        warn = False
+        with self._lock:
+            ev = {"kind": "compile", "ts": self.now(), "engine": engine,
+                  "key": label, "hit": False, "wall_s": wall_s}
+            if self._ticks > 0:
+                self._post_warm_misses += 1
+                if (self._post_warm_misses >= self.recompile_warn_threshold
+                        and not self._warned_storm):
+                    self._warned_storm = True
+                    warn = True
+            self._append(ev)
+        if warn:
+            self._log.warning(
+                "recompile storm: %d program-cache misses after warmup "
+                "(latest: %s) — shape/bucket churn is forcing fresh XLA "
+                "compiles on the serving path",
+                self._post_warm_misses, label)
+        return ev
+
+    def request_event(self, rid: int, what: str, **fields):
+        """One request state transition (see module docstring for the
+        ``what`` vocabulary); maintains the per-request timeline and the
+        TTFT / inter-token histograms."""
+        ts = self.now()
+        with self._lock:
+            tl = self._live.get(rid)
+            if tl is None and what == "queued":
+                tl = self._live[rid] = RequestTimeline(
+                    rid, ts, fields.get("prompt_len", 0))
+            elif tl is None:
+                # transition for an untracked request (tracer attached
+                # mid-flight): open a timeline so spans stay well-formed
+                tl = self._live[rid] = RequestTimeline(rid, ts)
+            if what == "admitted":
+                tl.admitted_at = ts
+                for s in tl.preempted_spans:
+                    if s[1] is None:
+                        s[1] = ts          # replay wait ends at readmission
+            elif what == "first_token":
+                # NOT observed into the histogram here: a later preemption
+                # would roll this attempt back, and the TTFT histogram must
+                # carry one sample per request (the surviving attempt) —
+                # observation happens at "retired"
+                tl.first_token_at = ts
+            elif what == "token":
+                # live observation: rolled-back attempts stay in the
+                # histogram (the client really waited those intervals);
+                # request_summary() excludes them (token_times reset on
+                # preemption)
+                if tl.token_times:
+                    self.registry.observe("inter_token_seconds",
+                                          ts - tl.token_times[-1])
+                tl.token_times.append(ts)
+                tl.tokens_delivered += 1
+            elif what == "preempted":
+                # the engine's on_token(rid, None, False) reset: the
+                # streamed prefix is void — spans record the attempt, the
+                # timeline's live token state starts over so TTFT and ITL
+                # never mix pre- and post-replay attempts
+                tl.replays += 1
+                tl.preempted_spans.append([ts, None])
+                tl.first_token_at = None
+                tl.admitted_at = None
+                tl.token_times = []
+                tl.tokens_delivered = 0
+                self.registry.add("requests_preempted")
+            elif what == "retired":
+                tl.retired_at = ts
+                if tl.ttft_s is not None:
+                    self.registry.observe("ttft_seconds", tl.ttft_s)
+                self.registry.add("requests_retired")
+                self._live.pop(rid, None)
+                self._done.append(tl)
+            ev = {"kind": "request", "ts": ts, "rid": rid, "what": what}
+            ev.update(fields)
+            self._append(ev)
+        return ev
+
+    # ---------------------------------------------------------- queries --
+
+    def events(self, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e["kind"] == kind]
+
+    def timelines(self, include_live: bool = True) -> List[RequestTimeline]:
+        with self._lock:
+            out = list(self._done)
+            if include_live:
+                out.extend(self._live.values())
+        return out
+
+    def request_summary(self) -> Dict[str, Any]:
+        """Exact percentile summary over the retained timelines: p50/p95/
+        p99 TTFT (queued → surviving first token) and inter-token latency
+        (consecutive accepted tokens of one request, replay attempts
+        excluded)."""
+        tls = self.timelines()
+        ttfts = [tl.ttft_s for tl in tls if tl.ttft_s is not None]
+        itl: List[float] = []
+        for tl in tls:
+            itl.extend(tl.inter_token_s())
+        return {"requests_tracked": len(tls),
+                "requests_retired": sum(1 for tl in tls
+                                        if tl.retired_at is not None),
+                "replays": sum(tl.replays for tl in tls),
+                "ttft_s": _percentiles(ttfts),
+                "inter_token_s": _percentiles(itl)}
+
+    def summary(self) -> Dict[str, Any]:
+        """One JSON-able snapshot: tick histogram, compile counters, and
+        request percentiles — the BENCH-round telemetry attachment."""
+        ticks = self.events("tick")
+        reg = self.registry
+        return {
+            "ticks": len(ticks),
+            "ticks_total": int(reg.value("ticks")),
+            "tick_wall_s": _percentiles([e["dur_s"] for e in ticks]),
+            "compile": {
+                "hits": int(reg.value("compile_hits")),
+                "misses": int(reg.value("compile_misses")),
+                "wall_s": float(reg.value("compile_wall_seconds_sum")),
+                "post_warmup_misses": self._post_warm_misses,
+            },
+            "requests": self.request_summary(),
+            "events_dropped": self.events_dropped,
+        }
+
+    # ---------------------------------------------------------- exports --
+
+    def dump_jsonl(self, path: str) -> int:
+        """One event per line (ring-buffer order), then one ``timeline``
+        line per retained request; returns the number of lines written."""
+        evs = self.events()
+        tls = self.timelines()
+        n = 0
+        with open(path, "w") as f:
+            for ev in evs:
+                f.write(json.dumps(ev) + "\n")
+                n += 1
+            for tl in tls:
+                f.write(json.dumps({"kind": "timeline", **tl.to_dict()})
+                        + "\n")
+                n += 1
+        return n
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace JSON: scheduler ticks and compiles as complete
+        ("X") events, request timelines as per-request span rows — the
+        ``{"traceEvents": [...]}`` contract ``tools/trace_to_chrome.py``
+        emits for XPlane device traces, so both open in one Perfetto tab."""
+        return events_to_chrome(self.events(),
+                                [tl for tl in self.timelines()])
+
+    def write_chrome_trace(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_serving") -> str:
+        return _stats_prometheus_text(self.registry, namespace=namespace)
+
+
+_PID = "paddle_tpu.serving"
+
+
+def events_to_chrome(events: List[Dict[str, Any]],
+                     timelines: Optional[List[Any]] = None
+                     ) -> Dict[str, Any]:
+    """Convert tracer events (+ optional timelines) to Chrome-trace JSON.
+    Used by ``Tracer.to_chrome_trace`` and by ``chrome_trace_from_jsonl``
+    for offline conversion of a JSONL dump."""
+    out: List[Dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": _PID,
+         "args": {"name": _PID}},
+    ]
+    for ev in events:
+        us = ev["ts"] * 1e6
+        if ev["kind"] == "tick":
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "ts", "dur_s")}
+            out.append({"name": "tick", "cat": "scheduler", "ph": "X",
+                        "pid": _PID, "tid": "scheduler",
+                        "ts": us - ev.get("dur_s", 0.0) * 1e6,
+                        "dur": ev.get("dur_s", 0.0) * 1e6, "args": args})
+        elif ev["kind"] == "compile":
+            if ev.get("hit"):
+                continue                      # hits are noise on a timeline
+            dur = ev.get("wall_s", 0.0) * 1e6
+            out.append({"name": f"compile:{ev.get('key', '?')}",
+                        "cat": "compile", "ph": "X", "pid": _PID,
+                        "tid": "compile", "ts": us - dur, "dur": dur,
+                        "args": {"engine": ev.get("engine")}})
+        elif ev["kind"] == "request":
+            out.append({"name": ev.get("what", "?"), "cat": "request",
+                        "ph": "i", "s": "t", "pid": _PID,
+                        "tid": f"req:{ev.get('rid')}", "ts": us,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("kind", "ts")}})
+    for tl in timelines or []:
+        spans = tl.spans() if hasattr(tl, "spans") else _dict_spans(tl)
+        rid = tl.rid if hasattr(tl, "rid") else tl.get("rid")
+        for sp in spans:
+            out.append({"name": sp["name"], "cat": "request", "ph": "X",
+                        "pid": _PID, "tid": f"req:{rid}",
+                        "ts": sp["start"] * 1e6,
+                        "dur": max(sp["end"] - sp["start"], 0.0) * 1e6,
+                        "args": {"rid": rid}})
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def _dict_spans(tl: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Span reconstruction for a ``timeline`` dict read back from JSONL
+    (same shape RequestTimeline.spans produces)."""
+    stamps = [tl.get("queued_at"), tl.get("admitted_at"),
+              tl.get("first_token_at"), tl.get("retired_at")]
+    stamps += [t for s in tl.get("preempted_spans", []) for t in s]
+    known = [t for t in stamps if t is not None]
+    last = max(known) if known else 0.0
+    out = []
+    for name, a, b in (("queued", tl.get("queued_at"), tl.get("admitted_at")),
+                       ("prefill", tl.get("admitted_at"),
+                        tl.get("first_token_at")),
+                       ("decode", tl.get("first_token_at"),
+                        tl.get("retired_at"))):
+        if a is not None:
+            out.append({"name": name, "start": a,
+                        "end": b if b is not None else last})
+    for s in tl.get("preempted_spans", []):
+        if s and s[0] is not None:
+            out.append({"name": "preempted", "start": s[0],
+                        "end": s[1] if s[1] is not None else last})
+    return out
+
+
+def chrome_trace_from_jsonl(path: str) -> Dict[str, Any]:
+    """Offline conversion: read a ``dump_jsonl`` file back into the same
+    Chrome-trace JSON ``Tracer.to_chrome_trace`` produces live (used by
+    ``tools/trace_to_chrome.py --engine-trace``)."""
+    events: List[Dict[str, Any]] = []
+    timelines: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if ev.get("kind") == "timeline":
+                timelines.append(ev)
+            else:
+                events.append(ev)
+    return events_to_chrome(events, timelines)

@@ -2,30 +2,43 @@
 StatRegistry + the STAT_ADD/STAT_INT_ADD macros, surfaced in python via
 paddle.fluid.core.get_int_stats).
 
-Named monotonic/gauge counters that any subsystem can bump cheaply, plus an
-op-summary view joining the profiler's RecordEvent timings.  TPU-native
-notes: device-side numbers (memory in use, per-op time) come from XLA/JAX
-introspection rather than a CUDA allocator hook — ``device_memory_stats``
-reads ``jax.local_devices()[i].memory_stats()``.
+Named monotonic/gauge counters that any subsystem can bump cheaply, plus
+fixed-bucket histograms and a Prometheus text exposition — ONE registry
+mechanism for everything that counts (the serving engines keep a private
+``StatRegistry`` instance per engine and the process keeps the global one;
+``paddle_tpu.telemetry`` exports either).  An op-summary view joins the
+profiler's RecordEvent timings.  TPU-native notes: device-side numbers
+(memory in use, per-op time) come from XLA/JAX introspection rather than a
+CUDA allocator hook — ``device_memory_stats`` reads
+``jax.local_devices()[i].memory_stats()``.
 """
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
-import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["StatRegistry", "stat_registry", "stat_add", "stat_sub",
-           "get_stat", "get_all_stats", "device_memory_stats", "op_summary"]
+__all__ = ["StatRegistry", "Histogram", "stat_registry", "stat_add",
+           "stat_sub", "get_stat", "get_all_stats", "device_memory_stats",
+           "op_summary", "prometheus_text", "DEFAULT_TIME_BUCKETS"]
+
+# Prometheus-style latency buckets (seconds): sub-ms ticks through
+# multi-second compiles land in distinct buckets.
+DEFAULT_TIME_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0)
 
 
 class _Stat:
-    __slots__ = ("name", "value", "lock")
+    __slots__ = ("name", "value", "lock", "kind")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, kind: str = "counter"):
         self.name = name
         self.value = 0
         self.lock = threading.Lock()
+        self.kind = kind                 # "counter" (monotonic) or "gauge"
 
     def increase(self, v):
         with self.lock:
@@ -34,17 +47,77 @@ class _Stat:
     def decrease(self, v):
         with self.lock:
             self.value -= v
+            self.kind = "gauge"          # a decremented stat is not monotonic
+
+    def set(self, v):
+        with self.lock:
+            self.value = v
+            self.kind = "gauge"
 
     def reset(self):
         with self.lock:
             self.value = 0
 
 
+class Histogram:
+    """Fixed-bound cumulative-bucket histogram (the Prometheus model):
+    ``observe`` is O(log buckets) under a lock; ``percentile`` is a
+    bucket-resolution estimate (exact sample percentiles live in the
+    telemetry tracer, which keeps the raw events)."""
+
+    __slots__ = ("name", "bounds", "counts", "total", "count", "lock")
+
+    def __init__(self, name: str, bounds: Sequence[float] = None):
+        self.name = name
+        self.bounds = tuple(sorted(bounds if bounds is not None
+                                   else DEFAULT_TIME_BUCKETS))
+        self.counts = [0] * (len(self.bounds) + 1)   # last = +Inf overflow
+        self.total = 0.0
+        self.count = 0
+        self.lock = threading.Lock()
+
+    def observe(self, v: float):
+        i = bisect.bisect_left(self.bounds, v)
+        with self.lock:
+            self.counts[i] += 1
+            self.total += v
+            self.count += 1
+
+    def snapshot(self) -> Dict[str, object]:
+        with self.lock:
+            return {"bounds": self.bounds, "counts": tuple(self.counts),
+                    "sum": self.total, "count": self.count}
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Upper bound of the bucket holding the q-quantile (0 <= q <= 1);
+        None when empty.  Overflow observations report the largest bound."""
+        with self.lock:
+            if self.count == 0:
+                return None
+            target = q * self.count
+            acc = 0
+            for i, c in enumerate(self.counts):
+                acc += c
+                if acc >= target and c:
+                    return (self.bounds[i] if i < len(self.bounds)
+                            else self.bounds[-1])
+            return self.bounds[-1]
+
+    def reset(self):
+        with self.lock:
+            self.counts = [0] * (len(self.bounds) + 1)
+            self.total = 0.0
+            self.count = 0
+
+
 class StatRegistry:
-    """Process-wide named counters (reference monitor.h:77)."""
+    """Named counters/gauges + histograms (reference monitor.h:77).  The
+    process-wide instance backs ``stat_add``; serving engines hold private
+    instances so concurrent engines don't alias each other's counters."""
 
     def __init__(self):
         self._stats: Dict[str, _Stat] = {}
+        self._hists: Dict[str, Histogram] = {}
         self._lock = threading.Lock()
 
     def get(self, name: str) -> _Stat:
@@ -59,12 +132,37 @@ class StatRegistry:
     def sub(self, name: str, value=1):
         self.get(name).decrease(value)
 
+    def set(self, name: str, value):
+        """Gauge write: the stat's current value becomes ``value`` and its
+        exported type becomes gauge (non-monotonic)."""
+        self.get(name).set(value)
+
     def value(self, name: str):
         return self.get(name).value
+
+    def histogram(self, name: str, bounds: Sequence[float] = None
+                  ) -> Histogram:
+        with self._lock:
+            if name not in self._hists:
+                self._hists[name] = Histogram(name, bounds)
+            return self._hists[name]
+
+    def observe(self, name: str, value: float,
+                bounds: Sequence[float] = None):
+        self.histogram(name, bounds).observe(value)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return {n: s.value for n, s in sorted(self._stats.items())}
+
+    def histograms(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            hists = list(self._hists.items())
+        return {n: h.snapshot() for n, h in sorted(hists)}
+
+    def kinds(self) -> Dict[str, str]:
+        with self._lock:
+            return {n: s.kind for n, s in sorted(self._stats.items())}
 
     def reset(self, name: Optional[str] = None):
         if name is not None:
@@ -72,8 +170,11 @@ class StatRegistry:
             return
         with self._lock:
             targets = list(self._stats.values())
+            hists = list(self._hists.values())
         for s in targets:
             s.reset()
+        for h in hists:
+            h.reset()
 
 
 _registry = StatRegistry()
@@ -100,6 +201,56 @@ def get_all_stats() -> Dict[str, float]:
     return _registry.snapshot()
 
 
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(namespace: str, name: str) -> str:
+    n = _METRIC_NAME_RE.sub("_", name)
+    return f"{namespace}_{n}" if namespace else n
+
+
+def _fmt(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text(registry: Optional[StatRegistry] = None,
+                    namespace: str = "paddle_tpu",
+                    extra_gauges: Optional[Dict[str, float]] = None,
+                    extra_counters: Optional[Dict[str, float]] = None
+                    ) -> str:
+    """Prometheus text exposition (format 0.0.4) of one registry: counters
+    and gauges from their recorded kind, histograms as cumulative
+    ``_bucket``/``_sum``/``_count`` series.  ``extra_gauges`` /
+    ``extra_counters`` let a caller append derived values (e.g.
+    ``engine.metrics()`` means and compile counts) without registering
+    them, typed to match their documented kind."""
+    reg = registry if registry is not None else _registry
+    lines: List[str] = []
+    kinds = reg.kinds()
+    for name, value in reg.snapshot().items():
+        pn = _prom_name(namespace, name)
+        lines.append(f"# TYPE {pn} {kinds.get(name, 'counter')}")
+        lines.append(f"{pn} {_fmt(value)}")
+    for name, h in reg.histograms().items():
+        pn = _prom_name(namespace, name)
+        lines.append(f"# TYPE {pn} histogram")
+        acc = 0
+        for bound, c in zip(h["bounds"], h["counts"]):
+            acc += c
+            lines.append(f'{pn}_bucket{{le="{bound}"}} {acc}')
+        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines.append(f"{pn}_sum {_fmt(h['sum'])}")
+        lines.append(f"{pn}_count {h['count']}")
+    for extras, kind in ((extra_gauges, "gauge"),
+                         (extra_counters, "counter")):
+        for name, value in (extras or {}).items():
+            pn = _prom_name(namespace, name)
+            lines.append(f"# TYPE {pn} {kind}")
+            lines.append(f"{pn} {_fmt(value)}")
+    return "\n".join(lines) + "\n"
+
+
 def device_memory_stats(device_index: int = 0) -> Dict[str, int]:
     """Per-device allocator stats from the PJRT client (≙ the reference's
     STAT_gpu0_mem_size family fed by the CUDA allocator)."""
@@ -113,9 +264,10 @@ def device_memory_stats(device_index: int = 0) -> Dict[str, int]:
 
 def op_summary(top: int = 20) -> List[Tuple[str, int, float]]:
     """(name, calls, total seconds) rows from the profiler's RecordEvent
-    aggregation (profiler._events), sorted by total time — the op-summary
-    table view of the reference's profiler output."""
+    aggregation, sorted by total time — the op-summary table view of the
+    reference's profiler output."""
     from .. import profiler
-    rows = [(n, int(c), float(t)) for n, (c, t) in profiler._events.items()]
+    rows = [(n, int(c), float(t))
+            for n, (c, t) in profiler.snapshot_events().items()]
     rows.sort(key=lambda r: -r[2])
     return rows[:top]

@@ -1,0 +1,71 @@
+"""Library hygiene lint: no ``print()`` in paddle_tpu/ library code.
+
+Library output must flow through ``logging`` (or an explicit callback /
+registry) so serving hosts can route, rate-limit, and silence it —
+round-6's profiler ``stop_profiler`` print was invisible to log pipelines
+and unconditionally noisy in tests.  A frozen allowlist covers the
+modules whose printing IS their contract (CLI entry points, console
+progress UIs, reference-parity verbose knobs, the ``paddle.static.Print``
+op).  Adding a print anywhere else fails this test; removing one from an
+allowlisted file requires pruning the list (keeps it honest in both
+directions)."""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).parent.parent / "paddle_tpu"
+
+# Files whose print() calls are their documented job — NOT a dumping
+# ground: every entry must be a CLI entry point, console UI, or a
+# reference-parity API that prints by contract.
+PRINT_ALLOWLIST = {
+    "core/tensor.py",                       # FLAGS-gated eager debug echo
+    "distributed/fleet/utils/__init__.py",  # fleet log_util console sink
+    "distributed/launch.py",                # CLI entry point
+    "hapi/callbacks.py",                    # ProgBarLogger console UI
+    "hapi/dynamic_flops.py",                # flops(print_detail=) contract
+    "hapi/model_summary.py",                # summary() prints per reference
+    "optimizer/lr.py",                      # verbose= knob per reference
+    "static/__init__.py",                   # paddle.static.Print op
+    "utils/__init__.py",                    # run_check console contract
+    "utils/cpp_extension.py",               # verbose build log
+}
+
+
+def _files_with_print():
+    out = set()
+    for path in sorted(PKG.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                out.add(str(path.relative_to(PKG)))
+                break
+    return out
+
+
+def test_no_print_outside_allowlist():
+    printing = _files_with_print()
+    new = printing - PRINT_ALLOWLIST
+    assert not new, (
+        f"print() in library code: {sorted(new)} — route through logging "
+        f"(see paddle_tpu/profiler.py stop_profiler for the pattern) or, "
+        f"for a genuine CLI/console contract, extend PRINT_ALLOWLIST with "
+        f"a justification comment")
+
+
+def test_allowlist_is_pruned():
+    printing = _files_with_print()
+    stale = PRINT_ALLOWLIST - printing
+    assert not stale, (
+        f"allowlist entries with no print() left: {sorted(stale)} — "
+        f"remove them so the list stays a real inventory")
+
+
+def test_profiler_routes_through_logging():
+    """The satellite fix this lint exists to protect: stop_profiler's
+    summary goes to the module logger / on_summary, never stdout."""
+    assert "profiler.py" not in _files_with_print()

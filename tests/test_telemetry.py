@@ -1,0 +1,429 @@
+"""Serving telemetry subsystem (paddle_tpu/telemetry.py + the engine
+wiring in serving.py / serving_paged.py, the unified utils/stats.py
+registry, and the profiler satellites).
+
+The tentpole contract under test (ISSUE 2 acceptance): a mixed workload
+through RaggedPagedContinuousBatchingEngine with tracing ON yields
+per-tick events whose summed packed-token counts exactly reconcile with
+tokens emitted + prefill tokens consumed; compile-cache events show ≥1
+miss then only hits for repeated shapes; everything round-trips through
+the JSONL and Prometheus exports.  With tracing OFF (the default) the
+engines compile the exact same programs and never touch a tracer lock."""
+
+import json
+import logging
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import telemetry
+from paddle_tpu.jit.bucketing import select_bucket
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                RaggedPagedContinuousBatchingEngine)
+from paddle_tpu.telemetry import Tracer
+from paddle_tpu.utils import stats
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+PROMPTS = [[5, 17, 3], [40, 2], [9, 9, 9, 9, 9, 1], [61], [8, 30, 12, 4],
+           [77, 13, 2, 5, 6, 7, 8]]
+BUDGETS = [10, 4, 7, 12, 3, 8]
+
+
+def _ragged(model, params, tracer=None, **kw):
+    cfg = dict(max_slots=3, max_len=32, block_size=4,
+               prompt_buckets=[8, 16], token_budget=12)
+    cfg.update(kw)
+    return RaggedPagedContinuousBatchingEngine(model, params,
+                                               tracer=tracer, **cfg)
+
+
+class TestEndToEnd:
+    def test_tick_accounting_compiles_and_export_roundtrip(
+            self, model_and_params, tmp_path):
+        """THE acceptance test: exact per-tick packed-token accounting,
+        compile miss→hit transition, JSONL + Prometheus round-trips."""
+        model, params = model_and_params
+        model.__dict__.pop("_serving_programs", None)
+        tr = Tracer()
+        eng = _ragged(model, params, tracer=tr)
+        rids = [eng.add_request(p, n) for p, n in zip(PROMPTS, BUDGETS)]
+        got = eng.run_to_completion(max_ticks=300)
+        assert sorted(got) == sorted(rids)
+
+        # --- exact accounting: every packed row is a decode row (one
+        # emitted token) or a prefill row (one padded prompt position
+        # consumed); first tokens ride the last prefill row for free
+        ticks = tr.events("tick")
+        assert ticks, "no tick events emitted"
+        dec = sum(e.get("decode_rows", 0) for e in ticks)
+        pf = sum(e.get("prefill_tokens", 0) for e in ticks)
+        toks = sum(len(v) for v in got.values())
+        padded = sum(select_bucket(len(p), eng.buckets) for p in PROMPTS)
+        assert dec == toks - len(PROMPTS)
+        assert pf == padded
+        # the same totals flow through the per-tick counter deltas
+        assert sum(e["tokens_emitted"] for e in ticks) == toks
+        assert sum(e["requests_finished"] for e in ticks) == len(PROMPTS)
+        # budget is never exceeded and utilization fields are coherent
+        for e in ticks:
+            used = e.get("budget_used", 0)
+            assert used <= e.get("token_budget", eng.token_budget)
+            assert used == e.get("decode_rows", 0) + e.get(
+                "prefill_tokens", 0)
+
+        # --- compile cache: fresh model ⇒ ≥1 miss ring event with wall
+        # time; a second engine with identical shapes fetches ONLY hits
+        # (counter-only — no ring events, so steady state can't evict
+        # tick history)
+        misses = tr.events("compile")
+        assert len(misses) >= 1
+        assert all(not e["hit"] and e["wall_s"] > 0 for e in misses)
+        assert int(tr.registry.value("compile_hits")) > 0
+        tr2 = Tracer()
+        eng2 = _ragged(model, params, tracer=tr2)
+        eng2.add_request(PROMPTS[0], 6)
+        eng2.run_to_completion(max_ticks=100)
+        assert tr2.events("compile") == []     # no misses, hits no events
+        assert int(tr2.registry.value("compile_hits")) > 0
+        assert eng2.metrics()["compile_misses"] == 0
+        assert eng2.metrics()["compile_hits"] > 0
+
+        # --- JSONL round-trip: every event survives byte-identical
+        path = tmp_path / "events.jsonl"
+        n = tr.dump_jsonl(str(path))
+        lines = [json.loads(ln) for ln in
+                 path.read_text().splitlines() if ln]
+        assert len(lines) == n
+        evs = [ln for ln in lines if ln["kind"] != "timeline"]
+        assert evs == tr.events()
+        tls = [ln for ln in lines if ln["kind"] == "timeline"]
+        assert {t["rid"] for t in tls} == set(rids)
+
+        # --- Prometheus round-trip: counters parse back to the source
+        text = tr.prometheus_text()
+        vals = {ln.split()[0]: ln.split()[1]
+                for ln in text.splitlines()
+                if ln and not ln.startswith("#") and "{" not in ln}
+        assert int(vals["paddle_tpu_serving_compile_misses"]) == len(misses)
+        assert int(vals["paddle_tpu_serving_requests_retired"]) == len(rids)
+        assert "paddle_tpu_serving_tick_seconds_count" in vals
+        assert int(vals["paddle_tpu_serving_tick_seconds_count"]) \
+            == len(ticks)
+        etext = eng.prometheus_text()
+        evals = {ln.split()[0]: ln.split()[1]
+                 for ln in etext.splitlines()
+                 if ln and not ln.startswith("#") and "{" not in ln}
+        assert int(evals["paddle_tpu_serving_tokens_emitted"]) == toks
+        assert float(evals["paddle_tpu_serving_mean_ttft_s"]) > 0
+
+    def test_outputs_and_programs_identical_with_tracing(
+            self, model_and_params):
+        """Telemetry is a pure observer: token outputs are identical and
+        NO additional programs exist with tracing on (same cache keys ⇒
+        no extra operands ever reached a compiled program)."""
+        model, params = model_and_params
+
+        def run(tracer):
+            eng = _ragged(model, params, tracer=tracer)
+            rids = [eng.add_request(p, n)
+                    for p, n in zip(PROMPTS[:4], BUDGETS[:4])]
+            got = eng.run_to_completion(max_ticks=200)
+            return [got[r] for r in rids]
+
+        model.__dict__.pop("_serving_programs", None)
+        out_off = run(None)
+        keys_off = set(model._serving_programs)
+        out_on = run(Tracer())
+        assert out_on == out_off
+        assert set(model._serving_programs) == keys_off
+
+    def test_tracing_off_takes_no_tracer_lock(self, model_and_params,
+                                              monkeypatch):
+        """Default engines never construct tracer state: every Tracer
+        entry point is boobytrapped and a full serve completes anyway."""
+        model, params = model_and_params
+
+        def boom(*a, **kw):
+            raise AssertionError("tracer touched with tracing off")
+
+        for meth in ("emit", "tick", "compile_event", "request_event"):
+            monkeypatch.setattr(Tracer, meth, boom)
+        eng = _ragged(model, params)          # tracer=None default
+        assert eng.tracer is None
+        eng.add_request(PROMPTS[0], 5)
+        got = eng.run_to_completion(max_ticks=100)
+        assert len(got) == 1
+
+
+class TestRequestTimelines:
+    def test_preemption_span_and_single_ttft(self, model_and_params):
+        """Satellite: the preemption/replay path.  The engine's
+        on_token(rid, None, False) reset shows up as a closed
+        ``preempted`` span, the timeline's TTFT counts queued → the
+        SURVIVING first token once (no double-counted replayed prefill),
+        and the TTFT histogram holds exactly one sample per retired
+        request."""
+        model, params = model_and_params
+        tr = Tracer()
+        signals = []
+        eng = _ragged(model, params, max_slots=2, num_blocks=8,
+                      prompt_buckets=[8], token_budget=10, tracer=tr)
+        r0 = eng.add_request(PROMPTS[0], 14)
+        r1 = eng.add_request(PROMPTS[1], 14,
+                             on_token=lambda rid, tok, done:
+                             signals.append((rid, tok)))
+        got = eng.run_to_completion(max_ticks=500)
+        assert eng.preemptions >= 1
+        assert (r1, None) in signals           # documented reset signal
+
+        tls = {tl.rid: tl for tl in tr.timelines()}
+        victim = tls[r1] if tls[r1].replays else tls[r0]
+        assert victim.replays >= 1
+        spans = victim.spans()
+        pre = [s for s in spans if s["name"] == "preempted"]
+        assert pre, "preemption never became a span"
+        assert all(s["end"] >= s["start"] for s in pre)
+        # single final TTFT: first token of the surviving attempt only
+        assert victim.first_token_at is not None
+        assert victim.ttft_s == victim.first_token_at - victim.queued_at
+        # the surviving attempt began after the last preemption
+        assert victim.first_token_at > pre[-1]["start"]
+        # histogram: one TTFT sample per retired request, not per attempt
+        h = tr.registry.histogram("ttft_seconds").snapshot()
+        assert h["count"] == len(got)
+        # preemption surfaced in tick deltas too
+        assert sum(e.get("preemptions", 0)
+                   for e in tr.events("tick")) == eng.preemptions
+
+    def test_timeline_token_accounting_and_percentiles(
+            self, model_and_params):
+        model, params = model_and_params
+        tr = Tracer()
+        eng = _ragged(model, params, tracer=tr)
+        rids = [eng.add_request(p, n)
+                for p, n in zip(PROMPTS[:3], BUDGETS[:3])]
+        got = eng.run_to_completion(max_ticks=200)
+        tls = {tl.rid: tl for tl in tr.timelines()}
+        for rid in rids:
+            tl = tls[rid]
+            assert tl.tokens_delivered == len(got[rid])
+            assert tl.retired_at is not None
+            assert tl.queued_at <= tl.admitted_at <= tl.first_token_at
+        s = tr.request_summary()
+        assert s["requests_retired"] == len(rids)
+        for key in ("ttft_s", "inter_token_s"):
+            pct = s[key]
+            assert pct is not None
+            assert pct["p50"] <= pct["p95"] <= pct["p99"] <= pct["max"]
+
+    def test_chrome_trace_contract(self, model_and_params):
+        """{"traceEvents": [...]} with ticks/compiles as X events and
+        request rows — the same shape tools/trace_to_chrome.py emits."""
+        model, params = model_and_params
+        tr = Tracer()
+        eng = _ragged(model, params, tracer=tr)
+        eng.add_request(PROMPTS[0], 6)
+        eng.run_to_completion(max_ticks=100)
+        ct = tr.to_chrome_trace()
+        assert set(ct) == {"traceEvents", "displayTimeUnit"}
+        evs = ct["traceEvents"]
+        phases = {e["ph"] for e in evs}
+        assert "X" in phases
+        ticks = [e for e in evs if e["name"] == "tick"]
+        assert ticks and all(e["tid"] == "scheduler" for e in ticks)
+        req_rows = {e["tid"] for e in evs
+                    if str(e.get("tid", "")).startswith("req:")}
+        assert req_rows
+        spans = [e for e in evs if e.get("cat") == "request"
+                 and e["ph"] == "X"]
+        assert {"queued", "prefill", "decode"} <= {e["name"] for e in spans}
+        json.dumps(ct)                         # fully serializable
+
+
+class TestRingBufferAndStorm:
+    def test_ring_buffer_bounds_and_drop_count(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.emit("tick", i=i)
+        assert len(tr.events()) == 8
+        assert tr.events_dropped == 12
+        assert tr.events()[0]["i"] == 12       # oldest dropped first
+
+    def test_recompile_storm_warns_once(self, model_and_params, caplog):
+        """Post-warmup compile misses past the threshold log ONE warning
+        (the ragged engine naturally compiles a wider table-cols bucket
+        mid-run as decode depth grows)."""
+        model, params = model_and_params
+        model.__dict__.pop("_serving_programs", None)
+        tr = Tracer(recompile_warn_threshold=1)
+        eng = _ragged(model, params, tracer=tr)
+        for p, n in zip(PROMPTS, BUDGETS):
+            eng.add_request(p, n)
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.telemetry"):
+            eng.run_to_completion(max_ticks=300)
+        storm = [r for r in caplog.records
+                 if "recompile storm" in r.getMessage()]
+        assert len(storm) == 1
+        assert tr.summary()["compile"]["post_warmup_misses"] >= 1
+
+
+class TestStatsRegistry:
+    def test_histogram_observe_and_percentile(self):
+        reg = stats.StatRegistry()
+        h = reg.histogram("lat", bounds=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["count"] == 5
+        assert snap["counts"] == (2, 1, 1, 1)
+        assert abs(snap["sum"] - 5.56) < 1e-9
+        assert h.percentile(0.5) == 0.1
+        assert reg.histogram("lat") is h       # idempotent fetch
+        reg.reset()
+        assert h.snapshot()["count"] == 0
+
+    def test_counter_gauge_kinds_in_exposition(self):
+        reg = stats.StatRegistry()
+        reg.add("reqs", 3)
+        reg.set("depth", 7)
+        reg.observe("lat", 0.02, bounds=(0.01, 0.1))
+        text = stats.prometheus_text(reg, namespace="t")
+        assert "# TYPE t_reqs counter" in text
+        assert "t_reqs 3" in text
+        assert "# TYPE t_depth gauge" in text
+        assert "t_depth 7" in text
+        assert '# TYPE t_lat histogram' in text
+        assert 't_lat_bucket{le="0.1"} 1' in text
+        assert 't_lat_bucket{le="+Inf"} 1' in text
+        assert "t_lat_count 1" in text
+        # invalid chars sanitized, extras exported as gauges
+        text2 = stats.prometheus_text(reg, namespace="t",
+                                      extra_gauges={"mean ttft.s": 0.5})
+        assert "t_mean_ttft_s 0.5" in text2
+
+    def test_global_registry_backcompat(self):
+        stats.stat_registry().reset("X_tel_t")
+        stats.stat_add("X_tel_t", 2)
+        stats.stat_sub("X_tel_t", 1)
+        assert stats.get_stat("X_tel_t") == 1
+        assert "X_tel_t" in stats.get_all_stats()
+
+
+class TestMetricsSchema:
+    def test_metrics_match_schema(self, model_and_params):
+        """Satellite: every metrics() key is schema-documented with the
+        right python type, and the PR-1 backward-compatible keys stay."""
+        model, params = model_and_params
+        eng = _ragged(model, params, enable_prefix_cache=True)
+        eng.add_request(PROMPTS[0], 4)
+        eng.run_to_completion(max_ticks=100)
+        m = eng.metrics()
+        schema = type(eng).metrics_schema()
+        assert set(m) <= set(schema), set(m) - set(schema)
+        for k, v in m.items():
+            kind, pytype = schema[k]
+            assert kind in ("counter", "gauge")
+            assert isinstance(v, pytype), (k, type(v))
+        for k in ("requests_finished", "tokens_emitted", "mean_ttft_s",
+                  "mean_latency_s", "tokens_per_sec", "blocks_in_use",
+                  "preemptions", "ragged_steps", "mixed_steps",
+                  "blocks_cached", "prefix_hits"):
+            assert k in m
+
+    def test_plain_engine_schema(self, model_and_params):
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8])
+        eng.add_request(PROMPTS[0], 4)
+        eng.run_to_completion(max_ticks=100)
+        m = eng.metrics()
+        schema = ContinuousBatchingEngine.metrics_schema()
+        assert set(m) == set(schema)
+        assert m["requests_finished"] == 1
+        assert m["compile_misses"] >= 0
+
+
+class TestBucketizeInstrumentation:
+    def test_bucket_compiles_counted_and_traced(self):
+        import jax.numpy as jnp
+        from paddle_tpu.jit.bucketing import bucketize
+        stats.stat_registry().reset("bucketize_bucket_compiles")
+        tr = Tracer()
+        fn = bucketize(lambda x: x * 2, buckets=(4, 8), tracer=tr)
+        fn(jnp.ones((1, 3)))
+        fn(jnp.ones((1, 2)))                   # same bucket: hit
+        fn(jnp.ones((1, 7)))                   # new bucket: compile
+        assert stats.get_stat("bucketize_bucket_compiles") == 2
+        assert fn.bucket_calls == {4: 2, 8: 1}
+        comp = tr.events("compile")                # ring: misses only
+        assert len(comp) == 2 and all(not e["hit"] for e in comp)
+        assert int(tr.registry.value("compile_hits")) == 1
+        assert all(e["key"].startswith("bucketize:") for e in comp)
+
+
+class TestProfilerSatellites:
+    def test_reset_and_snapshot(self):
+        from paddle_tpu import profiler
+        profiler.reset_profiler()
+        with profiler.RecordEvent("tel_test_evt"):
+            pass
+        snap = profiler.snapshot_events()
+        assert snap["tel_test_evt"][0] == 1
+        assert "tel_test_evt" in profiler.summary()
+        rows = stats.op_summary()
+        assert any(r[0] == "tel_test_evt" for r in rows)
+        profiler.reset_profiler()
+        assert profiler.snapshot_events() == {}
+
+    def test_record_event_thread_safety(self):
+        """Concurrent RecordEvent exits from callback threads must not
+        lose counts (the _events defaultdict is lock-guarded now)."""
+        from paddle_tpu import profiler
+        profiler.reset_profiler()
+
+        def work():
+            for _ in range(200):
+                with profiler.RecordEvent("tel_mt_evt"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert profiler.snapshot_events()["tel_mt_evt"][0] == 800
+        profiler.reset_profiler()
+
+    def test_stop_profiler_summary_routing(self, tmp_path, caplog,
+                                           monkeypatch):
+        """stop_profiler reports through on_summary / logging — stdout
+        stays clean (the no-print lint pins the source side)."""
+        from paddle_tpu import profiler
+        profiler.reset_profiler()
+        seen = []
+        monkeypatch.setattr(profiler.jax.profiler, "start_trace",
+                            lambda d: None)
+        monkeypatch.setattr(profiler.jax.profiler, "stop_trace",
+                            lambda: None)
+        profiler.start_profiler(str(tmp_path / "p1"))
+        profiler.stop_profiler(on_summary=seen.append)
+        assert len(seen) == 1 and "Event" in seen[0]
+        with caplog.at_level(logging.INFO, logger="paddle_tpu.profiler"):
+            profiler.start_profiler(str(tmp_path / "p2"))
+            profiler.stop_profiler()
+        assert any("trace written" in r.getMessage()
+                   for r in caplog.records)

@@ -14,4 +14,17 @@ if [ "$rc" -ne 0 ]; then
     echo "COLLECT SMOKE FAILED: import-time error in tests/ (rc=$rc)"
     exit 1
 fi
+# telemetry surface: the observability modules must import clean and the
+# trace CLI must self-describe (its --help path exercises arg wiring
+# without needing xprof)
+if ! JAX_PLATFORMS=cpu python -c \
+    "import paddle_tpu.telemetry, paddle_tpu.utils.stats, paddle_tpu.profiler" \
+    >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: telemetry module import"
+    exit 1
+fi
+if ! JAX_PLATFORMS=cpu python tools/trace_to_chrome.py --help >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: tools/trace_to_chrome.py --help"
+    exit 1
+fi
 echo "collect smoke OK"

@@ -237,11 +237,13 @@ def main():
             for t in ([9], [11]):
                 eng.add_request(warm_pre + t + [1] * 3, 2)
                 eng.run_to_completion(max_ticks=1000)
-            s0, n0 = eng._m["ttft_sum"], eng._m["requests"]
+            s0 = eng._stats.value("ttft_seconds_sum")
+            n0 = eng._stats.value("requests_finished")
             for t in tails:
                 eng.add_request(pre + t, 4)
                 eng.run_to_completion(max_ticks=1000)
-            dt = (eng._m["ttft_sum"] - s0) / (eng._m["requests"] - n0)
+            dt = (eng._stats.value("ttft_seconds_sum") - s0) \
+                / (eng._stats.value("requests_finished") - n0)
             return dt, eng
 
         off_ttft, _ = mean_ttft(False)

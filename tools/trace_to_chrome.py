@@ -3,23 +3,62 @@ tools/timeline.py, which converts profiler protos for chrome://tracing).
 
 Usage:
   python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json
+  python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json \
+      --engine-trace serve_telemetry.jsonl
 
 The input is a directory written by ``paddle_tpu.profiler`` /
-``jax.profiler.trace`` (contains ``**/*.xplane.pb``). Open the output in
-chrome://tracing or https://ui.perfetto.dev.
+``jax.profiler.trace`` (contains ``**/*.xplane.pb``).  ``--engine-trace``
+merges a serving-telemetry dump (``Tracer.dump_jsonl`` JSONL or
+``Tracer.write_chrome_trace`` JSON) into the same output, so scheduler
+ticks / request spans and XPlane device traces land in ONE file.  Open the
+output in chrome://tracing or https://ui.perfetto.dev.
 """
 
 import argparse
 import glob
+import json
 import os
 import sys
 
 
-def main():
-    ap = argparse.ArgumentParser()
+def _load_engine_trace(path):
+    """Engine-telemetry file → chrome-trace dict.  Accepts the Tracer's
+    JSONL event dump or an already-converted chrome JSON.  A multi-line
+    JSONL fails the whole-file parse; a SINGLE-line JSONL parses as a dict
+    but carries the tracer's ``kind`` field, not ``traceEvents`` — both
+    route to the JSONL converter."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict) and "kind" not in data:
+            return data
+        if isinstance(data, list):
+            return {"traceEvents": data}
+    except json.JSONDecodeError:          # multi-line JSONL
+        pass
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from paddle_tpu.telemetry import chrome_trace_from_jsonl
+    return chrome_trace_from_jsonl(path)
+
+
+def _merge(device_payload, engine):
+    """Append the engine trace's events to the device trace JSON."""
+    data = json.loads(device_payload)
+    if isinstance(data, list):
+        data = {"traceEvents": data}
+    data.setdefault("traceEvents", []).extend(engine.get("traceEvents", []))
+    return json.dumps(data)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("logdir", help="profiler output dir (contains *.xplane.pb)")
     ap.add_argument("-o", "--output", default="trace.json")
-    args = ap.parse_args()
+    ap.add_argument("--engine-trace", default=None,
+                    help="serving-telemetry dump (Tracer.dump_jsonl JSONL "
+                         "or chrome JSON) to merge into the output")
+    args = ap.parse_args(argv)
 
     paths = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
                       recursive=True)
@@ -27,10 +66,22 @@ def main():
         print(f"no *.xplane.pb under {args.logdir}", file=sys.stderr)
         return 1
     os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
-    from xprof.convert import raw_to_tool_data as rtd
+    try:
+        from xprof.convert import raw_to_tool_data as rtd
+    except ImportError as e:
+        print("trace_to_chrome: the 'xprof' package is not installed — the "
+              "XPlane -> trace_viewer conversion needs it.\n"
+              "Install it with:  pip install xprof   (ships with "
+              "tensorboard-plugin-profile)\n"
+              f"original error: {e}", file=sys.stderr)
+        return 1
 
     data, _mime = rtd.xspace_to_tool_data(paths, "trace_viewer", {})
     payload = data if isinstance(data, (str, bytes)) else str(data)
+    if args.engine_trace is not None:
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8")
+        payload = _merge(payload, _load_engine_trace(args.engine_trace))
     mode = "wb" if isinstance(payload, bytes) else "w"
     with open(args.output, mode) as f:
         f.write(payload)
