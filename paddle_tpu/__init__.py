@@ -23,7 +23,8 @@ if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
     import jax as _jax  # isort: skip
     try:
         _jax.config.update("jax_platforms", "cpu")
-    except Exception:  # backend already initialized — nothing to fix
+    # tpulint: disable=silent-except(backend already initialized means the config is already right or already latched; logging is not configured this early in import)
+    except Exception:
         pass
     _os.environ.setdefault("PADDLE_TPU_PLATFORM", "cpu")
 

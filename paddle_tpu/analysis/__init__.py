@@ -1,0 +1,19 @@
+"""tpulint — AST static analysis for JAX/TPU correctness hazards.
+
+Stdlib-only on purpose: ``tools/tpulint.py`` loads this package by file
+path (bypassing the JAX-importing ``paddle_tpu/__init__.py``) so a lint
+sweep costs parse time, not framework import time.  Keep jax/numpy out of
+this package.
+
+Entry points: :func:`lint_paths` / :func:`lint_source` run the registered
+rules; ``RULES`` is the registry; ``PRINT_ALLOWLIST`` is the frozen
+no-print inventory that tests/test_no_print.py wraps.  Baseline ratchet
+helpers (``load_baseline`` / ``write_baseline`` / ``diff_baseline``) back
+the CI gate.  See docs/STATIC_ANALYSIS.md.
+"""
+
+from .engine import (Finding, Rule, RULES, SCHEMA_VERSION, diff_baseline,  # noqa: F401
+                     finding_counts, iter_py_files, lint_paths, lint_source,
+                     load_baseline, register, render_json, render_text,
+                     write_baseline)
+from .rules import PRINT_ALLOWLIST  # noqa: F401

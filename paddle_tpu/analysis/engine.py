@@ -1,0 +1,304 @@
+"""tpulint engine — AST static analysis for JAX/TPU correctness hazards.
+
+JAX's worst failure modes are silent: a ``time.time()`` inside ``@jax.jit``
+bakes one wall-clock value into the compiled program forever, a donated
+buffer read after the call aliases freed device memory, an unseeded
+``random.randint`` in distributed code desyncs replicas.  None of these
+fail a unit test on CPU; all of them are visible in the AST.  This module
+is the framework: rule registry, per-file visitor dispatch, pragma
+suppression, ratchet-baseline diffing, and text/JSON rendering.  The rules
+themselves live in :mod:`paddle_tpu.analysis.rules`.
+
+Deliberately stdlib-only (``ast``/``re``/``json``/``pathlib``): the CLI
+(``tools/tpulint.py``) loads this package by file path so a lint run never
+pays a JAX import, and the whole sweep over ``paddle_tpu/`` + ``tools/``
+stays well under the 20 s commit-hook budget.
+
+Suppression: ``# tpulint: disable=<rule>(<reason>)`` on the offending line
+— or on a comment line directly above it — silences that rule there.  The
+reason is mandatory; a pragma without one is itself reported
+(``bad-pragma``) and suppresses nothing, so "disable" can never be spelled
+without an argument for the next reader.  ``disable=all(...)`` silences
+every rule for the line.
+
+Ratchet baseline: ``tools/tpulint_baseline.json`` freezes pre-existing
+violation *counts* per (file, rule) — counts, not line numbers, so
+unrelated edits don't churn it.  A count above baseline is a NEW violation
+(exit 1); below baseline is STALE (exit 3) and the baseline must be
+shrunk with ``--write-baseline`` — the ratchet only turns one way.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import re
+import tokenize
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SCHEMA_VERSION = 1
+
+PRAGMA_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\-]+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    """One violation.  ``path`` is repo-relative POSIX so baselines and
+    JSON output are stable across checkouts."""
+
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}"
+
+
+class Rule:
+    """Base class.  Subclasses set ``name`` (kebab-case id) and ``hazard``
+    (one-line consequence, surfaced in ``--list-rules`` and the docs) and
+    implement ``check``."""
+
+    name: str = ""
+    hazard: str = ""
+    #: substring precheck: when non-empty, the rule is skipped for files
+    #: whose raw source contains none of these — pure optimization, so the
+    #: hints MUST be implied by every finding the rule can produce
+    hints: Tuple[str, ...] = ()
+
+    def check(self, ctx: "FileContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx: "FileContext", node: ast.AST, message: str) -> Finding:
+        return Finding(path=ctx.rel_path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       rule=self.name, message=message)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and enroll a rule.  Duplicate ids are a
+    programming error, not a config surprise."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"{rule_cls.__name__} has no name")
+    if rule.name in RULES:
+        raise ValueError(f"duplicate rule id {rule.name!r}")
+    RULES[rule.name] = rule
+    return rule_cls
+
+
+class FileContext:
+    """Parsed view of one file handed to every rule: tree + raw lines +
+    repo-relative path, plus the shared import map (local name → module
+    fullname) so rules resolve ``np.random.randint`` without re-walking."""
+
+    def __init__(self, rel_path: str, source: str, tree: ast.AST):
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = _import_map(tree)
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted fullname of a Name/Attribute chain with the first segment
+        mapped through this file's imports; None for anything dynamic."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.imports.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+def _import_map(tree: ast.AST) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{node.module}.{alias.name}"
+    return out
+
+
+# --------------------------------------------------------------- suppression
+
+def _pragmas(source: str) -> Tuple[Dict[int, set], List[Finding]]:
+    """Map line number → suppressed rule-id set.  A pragma covers its own
+    line; on a comment-only line it also covers the next line (so multi-line
+    statements can carry the pragma above the offending header).  Returns
+    (suppressions, bad-pragma findings) — a reason is not optional.
+
+    Scans actual COMMENT tokens, not raw lines: pragma syntax quoted in a
+    docstring or string literal is documentation, never a live pragma (and
+    never a bad-pragma finding)."""
+    supp: Dict[int, set] = {}
+    bad: List[Finding] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return supp, bad  # ast.parse already reported the file as broken
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        row, col = tok.start
+        names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            bad.append(Finding(path="", line=row, col=col + m.start() + 1,
+                               rule="bad-pragma",
+                               message="pragma without a (reason) — state why "
+                                       "suppression is correct"))
+            continue
+        supp.setdefault(row, set()).update(names)
+        comment_only = row <= len(lines) and not lines[row - 1][:col].strip()
+        if comment_only:
+            supp.setdefault(row + 1, set()).update(names)
+    return supp, bad
+
+
+def lint_source(rel_path: str, source: str,
+                rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint one file's text.  Syntax errors are findings, not crashes — a
+    file the linter can't parse can't be vouched for."""
+    try:
+        tree = ast.parse(source, filename=rel_path)
+    except SyntaxError as e:
+        return [Finding(path=rel_path, line=e.lineno or 1, col=(e.offset or 0) + 1,
+                        rule="syntax-error", message=f"unparseable: {e.msg}")]
+    ctx = FileContext(rel_path, source, tree)
+    supp, bad = _pragmas(source)
+    out: List[Finding] = [dataclasses.replace(f, path=rel_path) for f in bad]
+    for rule in (rules if rules is not None else RULES.values()):
+        if rule.hints and not any(h in source for h in rule.hints):
+            continue
+        for f in rule.check(ctx):
+            allowed = supp.get(f.line, ())
+            if f.rule in allowed or "all" in allowed:
+                continue
+            out.append(f)
+    return sorted(out)
+
+
+def iter_py_files(paths: Sequence[Path], root: Path) -> Iterable[Tuple[Path, str]]:
+    seen: set = set()  # overlapping args (paddle_tpu + paddle_tpu/analysis)
+    for p in paths:    # must not double-count against the ratchet
+        p = Path(p)
+        files = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts or f.suffix != ".py":
+                continue
+            resolved = f.resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            try:
+                rel = resolved.relative_to(root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            yield f, rel
+
+
+def lint_paths(paths: Sequence[Path], root: Path,
+               rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    out: List[Finding] = []
+    for f, rel in iter_py_files(paths, root):
+        try:
+            source = f.read_text(encoding="utf-8")  # py source is UTF-8 by spec
+        except UnicodeDecodeError as e:
+            out.append(Finding(path=rel, line=1, col=1, rule="syntax-error",
+                               message=f"not valid UTF-8: {e.reason}"))
+            continue
+        out.extend(lint_source(rel, source, rules=rules))
+    return sorted(out)
+
+
+# ------------------------------------------------------------------ baseline
+
+def finding_counts(findings: Iterable[Finding]) -> Dict[str, Dict[str, int]]:
+    counts: Dict[str, Dict[str, int]] = {}
+    for f in findings:
+        counts.setdefault(f.path, {})
+        counts[f.path][f.rule] = counts[f.path].get(f.rule, 0) + 1
+    return counts
+
+
+def load_baseline(path: Path) -> Dict[str, Dict[str, int]]:
+    data = json.loads(Path(path).read_text())
+    if data.get("version") != SCHEMA_VERSION:
+        raise ValueError(f"baseline version {data.get('version')!r}, "
+                         f"expected {SCHEMA_VERSION}")
+    return data["counts"]
+
+
+def write_baseline(path: Path, findings: Iterable[Finding],
+                   paths: Optional[Sequence[str]] = None) -> None:
+    """``paths`` records which lint roots the counts came from, so a later
+    ``--write-baseline`` over a SUBSET can be refused instead of silently
+    truncating the committed baseline."""
+    payload = {
+        "version": SCHEMA_VERSION,
+        "note": ("Ratchet baseline: frozen pre-existing violation counts per "
+                 "(file, rule). New violations fail CI; fixing one requires "
+                 "shrinking this file via `python tools/tpulint.py "
+                 "--write-baseline paddle_tpu tools`. Counts, not lines, so "
+                 "unrelated edits don't churn it."),
+        "counts": finding_counts(findings),
+    }
+    if paths is not None:
+        payload["paths"] = sorted(str(p) for p in paths)
+    Path(path).write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+
+
+def diff_baseline(findings: Sequence[Finding],
+                  baseline: Dict[str, Dict[str, int]]):
+    """Returns (new, stale): ``new`` — findings in (file, rule) buckets whose
+    count exceeds baseline (all sites listed, since the AST can't know which
+    one was just added); ``stale`` — (path, rule, current, baselined) buckets
+    the tree has burned below the frozen count."""
+    current = finding_counts(findings)
+    new: List[Finding] = []
+    stale: List[Tuple[str, str, int, int]] = []
+    for path, rules in sorted(current.items()):
+        for rule, n in sorted(rules.items()):
+            if n > baseline.get(path, {}).get(rule, 0):
+                new.extend(f for f in findings
+                           if f.path == path and f.rule == rule)
+    for path, rules in sorted(baseline.items()):
+        for rule, n in sorted(rules.items()):
+            cur = current.get(path, {}).get(rule, 0)
+            if cur < n:
+                stale.append((path, rule, cur, n))
+    return new, stale
+
+
+# -------------------------------------------------------------------- output
+
+def render_text(findings: Sequence[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def render_json(findings: Sequence[Finding]) -> str:
+    return json.dumps({
+        "version": SCHEMA_VERSION,
+        "findings": [dataclasses.asdict(f) for f in findings],
+        "counts": finding_counts(findings),
+    }, indent=2, sort_keys=True)

@@ -1,0 +1,11 @@
+"""tpulint fixture corpus — intentionally hazardous snippets, one pair per
+rule (``bad_<rule>.py`` must trip exactly that rule; ``clean_<rule>.py`` is
+the near-miss that must stay silent).
+
+These files are PARSED, never imported: the unit tests
+(tests/test_tpulint_rules.py) lint them as text, and the CI gate lints them
+in place so every rule has a baselined true-positive exercised on every
+run — the ratchet machinery itself would catch a rule silently going blind.
+Do not import submodules of this package; several would touch devices or
+crash by design.
+"""

@@ -1,0 +1,17 @@
+"""donated-arg-reuse positive: donated buffer read after the call.
+(Fixture: parsed by tpulint, never imported.)"""
+
+import jax
+
+
+def _apply(params, grads):
+    return params
+
+
+def train_step(params, grads):
+    step = jax.jit(_apply, donate_argnums=(0,))
+    new_params = step(params, grads)
+    # trips: `params` was donated on the line above — its device buffer is
+    # freed/aliased; reading it returns garbage on TPU
+    norm = sum(jax.tree_util.tree_leaves(params))
+    return new_params, norm

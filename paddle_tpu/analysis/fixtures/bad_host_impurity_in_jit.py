@@ -1,0 +1,22 @@
+"""host-impurity-in-jit positives: host state read under trace.  (Fixture:
+parsed by tpulint, never imported — see fixtures/__init__.py.)"""
+
+import functools
+import os
+import time
+
+import jax
+
+
+@jax.jit
+def stamp(x):
+    # trips: one wall-clock value is baked into the compiled program
+    return x * time.time()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scaled(x):
+    # trips twice: env read latched at trace time, print runs once ever
+    lr = float(os.environ.get("LR", "1e-3"))
+    print("tracing scaled")
+    return x * lr

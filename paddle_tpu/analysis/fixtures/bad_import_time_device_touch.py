@@ -1,0 +1,17 @@
+"""import-time-device-touch positives.  (Fixture: parsed by tpulint, NEVER
+imported — importing this file would initialize a JAX backend.)"""
+
+import jax
+import jax.numpy as jnp
+
+# trips: array construction at module scope initializes the backend during
+# import, before JAX_PLATFORMS/jax.config can land
+_ZERO = jnp.zeros((8,))
+
+# trips: device query at import time latches the platform
+NUM_DEVICES = jax.device_count()
+
+
+def pad(x, fill=jnp.zeros(())):
+    # trips: default args evaluate at import time too
+    return x + fill

@@ -1,0 +1,7 @@
+"""no-print positive: library code printing to stdout.  (Fixture: parsed
+by tpulint, never imported.)"""
+
+
+def report(stats):
+    # trips: serving hosts can't route/rate-limit/silence stdout
+    print(f"processed {stats} requests")

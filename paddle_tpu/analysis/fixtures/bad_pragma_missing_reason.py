@@ -1,0 +1,9 @@
+"""bad-pragma positive: a reason-less pragma suppresses nothing and is
+itself reported.  (Fixture: parsed by tpulint, never imported.)"""
+
+
+def closing(sock):
+    try:
+        sock.close()
+    except Exception:  # tpulint: disable=silent-except
+        pass

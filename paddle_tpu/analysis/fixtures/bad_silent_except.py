@@ -1,0 +1,17 @@
+"""silent-except positives.  (Fixture: parsed by tpulint, never
+imported.)"""
+
+
+def best_effort_close(sock):
+    try:
+        sock.close()
+    except Exception:
+        # trips: the first signal of a real fault evaporates here
+        pass
+
+
+def doubly_silent(fn):
+    try:
+        fn()
+    except BaseException:
+        ...

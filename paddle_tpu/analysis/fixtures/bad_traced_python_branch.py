@@ -1,0 +1,24 @@
+"""traced-python-branch positives: Python control flow on traced arrays.
+(Fixture: parsed by tpulint, never imported.)"""
+
+import jax
+
+
+@jax.jit
+def relu_or_zero(x, threshold):
+    # trips: ConcretizationTypeError at trace time (or a retrace per value)
+    if x > threshold:
+        return x
+    return x * 0
+
+
+@jax.jit
+def drain(n):
+    total = 0
+    # trips: Python while cannot iterate on a tracer
+    while n > 0:
+        total = total + 1
+        n = n - 1
+    # trips: assert concretizes the traced value
+    assert n == 0
+    return total

@@ -1,0 +1,19 @@
+"""unhashable-static-arg positives.  (Fixture: parsed by tpulint, never
+imported.)"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(1,))
+def gather(x, idx: list):
+    # trips: static args are dict-keys of the compile cache; a list raises
+    # ValueError on the first call
+    return x
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def run(x, cfg={}):
+    # trips: dict default for a static name
+    return x

@@ -1,0 +1,23 @@
+"""donated-arg-reuse near-misses that must stay silent.  (Fixture: parsed
+by tpulint, never imported.)"""
+
+import jax
+
+
+def _apply(params, grads):
+    return params
+
+
+def train_step(params, grads):
+    # rebinding the donated name in the same statement is THE donation
+    # idiom — silent
+    step = jax.jit(_apply, donate_argnums=(0,))
+    params = step(params, grads)
+    return params
+
+
+def undonated(params, grads):
+    # no donate_argnums: reuse after call is fine — silent
+    step = jax.jit(_apply)
+    new_params = step(params, grads)
+    return new_params, params

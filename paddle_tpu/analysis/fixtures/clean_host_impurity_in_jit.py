@@ -1,0 +1,18 @@
+"""host-impurity-in-jit near-misses that must stay silent.  (Fixture:
+parsed by tpulint, never imported.)"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pure(x):
+    # jax.random is functional, not host randomness — silent
+    return x * jnp.float32(2.0)
+
+
+def host_side(x):
+    # host clock OUTSIDE jit is legitimate (telemetry does this everywhere)
+    return x, time.time()

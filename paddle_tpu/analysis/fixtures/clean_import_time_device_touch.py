@@ -1,0 +1,13 @@
+"""import-time-device-touch near-misses that must stay silent.  (Fixture:
+parsed by tpulint, never imported.)"""
+
+import jax
+import jax.numpy as jnp
+
+# attribute READS (dtypes, submodule aliases) don't init a backend — silent
+f32 = jnp.float32
+
+
+def zeros():
+    # the same calls behind a function run after config — silent
+    return jnp.zeros((8,)), jax.device_count()

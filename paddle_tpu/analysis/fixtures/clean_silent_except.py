@@ -1,0 +1,21 @@
+"""silent-except near-misses that must stay silent.  (Fixture: parsed by
+tpulint, never imported.)"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def narrowed(sock):
+    try:
+        sock.close()
+    except OSError:
+        # narrowed type: deliberate, reviewable, silent for tpulint
+        pass
+
+
+def logged(fn):
+    try:
+        fn()
+    except Exception:
+        logger.debug("best-effort call failed", exc_info=True)

@@ -1,0 +1,26 @@
+"""traced-python-branch near-misses that must stay silent.  (Fixture:
+parsed by tpulint, never imported.)"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("training",))
+def dropout(x, training):
+    # static arg: Python branch is the supported specialization idiom
+    if training:
+        return x * 0.5
+    return x
+
+
+@jax.jit
+def safe(x, y=None):
+    # `is None` is Python identity, decided at trace time by design
+    if y is None:
+        y = jnp.zeros_like(x)
+    # shape/ndim metadata is static under jit
+    if x.ndim > 2:
+        x = x.reshape(-1, x.shape[-1])
+    return x + y

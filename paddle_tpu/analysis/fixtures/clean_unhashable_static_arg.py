@@ -1,0 +1,19 @@
+"""unhashable-static-arg near-misses that must stay silent.  (Fixture:
+parsed by tpulint, never imported.)"""
+
+from functools import partial
+from typing import Tuple
+
+import jax
+
+
+@partial(jax.jit, static_argnums=(1,))
+def gather(x, idx: Tuple[int, ...]):
+    # tuples hash — silent
+    return x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def run(x, mode="greedy", weights=None):
+    # `weights` is traced, not static: its annotation/default is irrelevant
+    return x

@@ -1,0 +1,2 @@
+"""Path-scoped fixtures: unseeded-nondeterminism only fires on files whose
+path contains ``distributed/``.  Parsed, never imported."""

@@ -1,0 +1,17 @@
+"""unseeded-nondeterminism positives (path contains distributed/, where
+every unseeded draw is a replica-divergence hazard).  (Fixture: parsed by
+tpulint, never imported.)"""
+
+import random
+
+import numpy as np
+
+
+def pick_port():
+    # trips: every host picks a different port — rendezvous splits
+    return 20000 + random.randint(0, 1000)
+
+
+def jitter():
+    # trips: numpy global stream differs per process
+    return np.random.uniform(0.0, 0.1)

@@ -1,0 +1,10 @@
+"""unseeded-nondeterminism near-misses that must stay silent.  (Fixture:
+parsed by tpulint, never imported.)"""
+
+import numpy as np
+
+
+def jitter(seed: int, rank: int) -> float:
+    # seeded Generator keyed on (seed, rank): deterministic per replica
+    gen = np.random.default_rng((seed, rank))
+    return float(gen.uniform(0.0, 0.1))

@@ -1,0 +1,18 @@
+"""Pragma demo: correctly suppressed violations must yield ZERO findings —
+this file doubles as the gate's live test of the suppression path.
+(Fixture: parsed by tpulint, never imported.)"""
+
+
+def closing(sock):
+    try:
+        sock.close()
+    except Exception:  # tpulint: disable=silent-except(GC-path close; socket may already be dead and there is nothing to log to)
+        pass
+
+
+def closing_above(sock):
+    try:
+        sock.close()
+    # tpulint: disable=silent-except(pragma on the comment line above the handler also covers it)
+    except Exception:
+        pass

@@ -1,0 +1,534 @@
+"""tpulint rules — each grounded in a hazard this tree already exhibits.
+
+Every rule documents the *consequence* (what breaks on TPU, silently),
+because none of these fail a CPU unit test: trace-time impurity bakes
+stale values into compiled programs, donated-buffer reuse aliases freed
+device memory, unseeded randomness in ``distributed/`` desyncs replicas,
+import-time device touches latch the platform before ``JAX_PLATFORMS``
+config can land.  See docs/STATIC_ANALYSIS.md for the catalog.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import FileContext, Finding, Rule, register
+
+JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _const_int_tuple(node: Optional[ast.AST]) -> Optional[Tuple[int, ...]]:
+    """Literal int / tuple-of-ints value of an argnums expression, or None
+    when it's computed (e.g. ``(0,) if donate else ()``) — computed argnums
+    are opaque to the AST and deliberately not guessed at."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _const_str_tuple(node: Optional[ast.AST]) -> Optional[Tuple[str, ...]]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+@dataclasses.dataclass
+class JitSpec:
+    """Parsed ``jax.jit`` wrapping: which params are static (not traced) and
+    which argument positions are donated."""
+
+    static_argnums: Tuple[int, ...] = ()
+    static_argnames: Tuple[str, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+    kwargs: Dict[str, ast.AST] = dataclasses.field(default_factory=dict)
+
+
+def _jit_call_spec(ctx: FileContext, call: ast.Call) -> Optional[JitSpec]:
+    """JitSpec for ``jax.jit(f, ...)`` / ``functools.partial(jax.jit, ...)``
+    call nodes; None when the call isn't a jit wrapping."""
+    name = ctx.resolve(call.func)
+    if name in PARTIAL_NAMES or (name or "").endswith(".partial"):
+        if not (call.args and ctx.resolve(call.args[0]) in JIT_NAMES):
+            return None
+    elif name not in JIT_NAMES:
+        return None
+    kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    return JitSpec(
+        static_argnums=_const_int_tuple(kwargs.get("static_argnums")) or (),
+        static_argnames=_const_str_tuple(kwargs.get("static_argnames")) or (),
+        donate_argnums=_const_int_tuple(kwargs.get("donate_argnums")) or (),
+        kwargs=kwargs)
+
+
+def _jit_decorator_spec(ctx: FileContext, fn: ast.FunctionDef) -> Optional[JitSpec]:
+    """JitSpec when ``fn`` is decorated ``@jax.jit`` or
+    ``@functools.partial(jax.jit, ...)``; None otherwise."""
+    for dec in fn.decorator_list:
+        if ctx.resolve(dec) in JIT_NAMES:
+            return JitSpec()
+        if isinstance(dec, ast.Call):
+            spec = _jit_call_spec(ctx, dec)
+            if spec is not None:
+                return spec
+    return None
+
+
+def _param_names(fn: ast.FunctionDef) -> List[str]:
+    args = fn.args
+    return [a.arg for a in args.posonlyargs + args.args]
+
+
+def _static_params(fn: ast.FunctionDef, spec: JitSpec) -> Set[str]:
+    params = _param_names(fn)
+    static = set(spec.static_argnames)
+    for i in spec.static_argnums:
+        if 0 <= i < len(params):
+            static.add(params[i])
+    return static
+
+
+def _walk_skipping_nested_defs(body: Sequence[ast.stmt]) -> Iterable[ast.AST]:
+    """Walk statements without descending into nested def/class scopes —
+    nested functions trace only if called, and flagging their bodies against
+    the *outer* jit's params produces noise, not signal."""
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def _jitted_functions(ctx: FileContext) -> List[Tuple[ast.FunctionDef, JitSpec]]:
+    # cached on the context: three rules ask for this list per file
+    cached = getattr(ctx, "_jit_fns", None)
+    if cached is None:
+        cached = [(node, spec) for node in ast.walk(ctx.tree)
+                  if isinstance(node, ast.FunctionDef)
+                  and (spec := _jit_decorator_spec(ctx, node)) is not None]
+        ctx._jit_fns = cached
+    return cached
+
+
+# ------------------------------------------------------------------- rule 1
+
+#: call fullnames whose value is frozen at trace time — the compiled program
+#: replays the value captured during tracing, forever
+IMPURE_CALLS = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "os.getenv", "os.environ.get",
+    "print",
+}
+IMPURE_PREFIXES = ("random.", "numpy.random.")
+
+
+@register
+class HostImpurityInJit(Rule):
+    name = "host-impurity-in-jit"
+    hints = ("jit",)
+    hazard = ("host state read inside @jax.jit is evaluated once at trace "
+              "time and baked into the compiled program — every later call "
+              "replays the stale value")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, _spec in _jitted_functions(ctx):
+            for node in _walk_skipping_nested_defs(fn.body):
+                if isinstance(node, ast.Call):
+                    name = ctx.resolve(node.func)
+                    if name and (name in IMPURE_CALLS
+                                 or name.startswith(IMPURE_PREFIXES)):
+                        yield self.finding(
+                            ctx, node,
+                            f"{name}() inside jitted {fn.name}() runs at "
+                            f"trace time only — its value is baked into the "
+                            f"compiled program")
+                elif isinstance(node, ast.Subscript):
+                    if ctx.resolve(node.value) == "os.environ":
+                        yield self.finding(
+                            ctx, node,
+                            f"os.environ read inside jitted {fn.name}() is "
+                            f"latched at trace time — late env changes are "
+                            f"invisible")
+
+
+# ------------------------------------------------------------------- rule 2
+
+@register
+class DonatedArgReuse(Rule):
+    name = "donated-arg-reuse"
+    hints = ("donate_argnums",)
+    hazard = ("an argument donated to a jitted call aliases freed device "
+              "memory afterwards — reading it returns garbage or raises, "
+              "depending on backend and timing")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        # Per-scope linear scan: collect names bound to jit wrappings with
+        # literal donate_argnums, then after each call through one, any Load
+        # of a donated argument name — until it is rebound — is a use of a
+        # donated buffer.
+        scopes: List[Sequence[ast.stmt]] = [ctx.tree.body]
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(node.body)
+        for body in scopes:
+            yield from self._scan_scope(ctx, body)
+
+    def _scan_scope(self, ctx: FileContext, body: Sequence[ast.stmt]):
+        donors: Dict[str, Tuple[int, ...]] = {}
+        for fn_node in (n for n in body if isinstance(n, ast.FunctionDef)):
+            spec = _jit_decorator_spec(ctx, fn_node)
+            if spec is not None and spec.donate_argnums:
+                donors[fn_node.name] = spec.donate_argnums
+        dead: Dict[str, Tuple[str, int]] = {}  # name -> (callee, call line)
+        for stmt in body:
+            # uses before (re)binding within this statement
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                        and node.id in dead):
+                    callee, line = dead[node.id]
+                    yield self.finding(
+                        ctx, node,
+                        f"{node.id!r} was donated to {callee}() on line "
+                        f"{line}; its buffer may already be freed/aliased")
+            # new donors bound in this scope: g = jax.jit(f, donate_argnums=..)
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+                spec = _jit_call_spec(ctx, stmt.value)
+                if spec is not None and spec.donate_argnums:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            donors[tgt.id] = spec.donate_argnums
+            # calls through donors kill their donated args ...
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                        and node.func.id in donors):
+                    for i in donors[node.func.id]:
+                        if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                            dead[node.args[i].id] = (node.func.id, node.lineno)
+            # ... unless the same statement rebinds the name (x = f(x) idiom)
+            for node in ast.walk(stmt):
+                if (isinstance(node, ast.Name)
+                        and isinstance(node.ctx, (ast.Store, ast.Del))):
+                    dead.pop(node.id, None)
+
+
+# ------------------------------------------------------------------- rule 3
+
+@register
+class TracedPythonBranch(Rule):
+    name = "traced-python-branch"
+    hints = ("jit",)
+    hazard = ("Python control flow on a traced array forces concretization: "
+              "ConcretizationTypeError under jit, or a silent retrace per "
+              "distinct value when the arg reaches the branch as a weak type")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, spec in _jitted_functions(ctx):
+            traced = set(_param_names(fn)) - _static_params(fn, spec)
+            traced.discard("self")
+            for node in _walk_skipping_nested_defs(fn.body):
+                test = None
+                kind = None
+                if isinstance(node, (ast.If, ast.While)):
+                    test, kind = node.test, type(node).__name__.lower()
+                elif isinstance(node, ast.Assert):
+                    test, kind = node.test, "assert"
+                elif isinstance(node, ast.IfExp):
+                    test, kind = node.test, "conditional expression"
+                if test is None:
+                    continue
+                name = self._traced_operand(test, traced)
+                if name:
+                    yield self.finding(
+                        ctx, node,
+                        f"Python {kind} on traced parameter {name!r} of "
+                        f"jitted {fn.name}() — use jnp.where/lax.cond or "
+                        f"mark the arg static")
+
+    @staticmethod
+    def _traced_operand(test: ast.AST, traced: Set[str]) -> Optional[str]:
+        """A traced param used as a *value* in the test.  Metadata access
+        (``x.shape``, ``x.ndim``, ``len(x)``) is static under jit and
+        ``x is None`` is Python-level identity — both are fine and skipped."""
+        skip: Set[int] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Attribute):
+                for sub in ast.walk(node.value):
+                    skip.add(id(sub))
+            elif isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+            elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                  and node.func.id in ("len", "isinstance", "getattr", "hasattr")):
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        for node in ast.walk(test):
+            if (isinstance(node, ast.Name) and id(node) not in skip
+                    and node.id in traced):
+                return node.id
+        return None
+
+
+# ------------------------------------------------------------------- rule 4
+
+UNHASHABLE_ANNOTATIONS = {"list", "dict", "set", "List", "Dict", "Set",
+                          "typing.List", "typing.Dict", "typing.Set"}
+
+
+@register
+class UnhashableStaticArg(Rule):
+    name = "unhashable-static-arg"
+    hints = ("static_arg",)
+    hazard = ("static_argnums/static_argnames require hashable values — a "
+              "list/dict static arg raises ValueError on the first call, or "
+              "worse, retraces per call once wrapped in tuple(map(...)) "
+              "band-aids")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for fn, spec in _jitted_functions(ctx):
+            params = _param_names(fn)
+            static = _static_params(fn, spec)
+            if not static:
+                continue
+            defaults = dict(zip(params[len(params) - len(fn.args.defaults):],
+                                fn.args.defaults))
+            annotations = {a.arg: a.annotation
+                           for a in fn.args.posonlyargs + fn.args.args
+                           if a.annotation is not None}
+            for name in sorted(static):
+                ann = annotations.get(name)
+                ann_name = self._annotation_name(ctx, ann) if ann else None
+                if ann_name in UNHASHABLE_ANNOTATIONS:
+                    yield self.finding(
+                        ctx, ann or fn,
+                        f"static arg {name!r} of {fn.name}() is annotated "
+                        f"{ann_name} — unhashable; jit will raise at call "
+                        f"time (use a tuple, or trace it)")
+                    continue
+                default = defaults.get(name)
+                if isinstance(default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                                        ast.DictComp, ast.SetComp)):
+                    kind = type(default).__name__.lower().replace(
+                        "comp", " comprehension")
+                    yield self.finding(
+                        ctx, default,
+                        f"static arg {name!r} of {fn.name}() defaults to a "
+                        f"{kind} — unhashable; jit will raise at call time")
+
+    @staticmethod
+    def _annotation_name(ctx: FileContext, ann: ast.AST) -> Optional[str]:
+        if isinstance(ann, ast.Subscript):  # List[int] → List
+            ann = ann.value
+        return ctx.resolve(ann)
+
+
+# ------------------------------------------------------------------- rule 5
+
+@register
+class SilentExcept(Rule):
+    name = "silent-except"
+    hints = ("except",)
+    hazard = ("`except Exception: pass` swallows the first signal of real "
+              "faults (dead store server, leaked shm ring) — debugging "
+              "starts hours later from a hung job instead of a log line")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._broad(ctx, node.type):
+                continue
+            if all(isinstance(s, ast.Pass) or
+                   (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant)
+                    and s.value.value is Ellipsis)
+                   for s in node.body):
+                what = ((ctx.resolve(node.type) or "broad except")
+                        if node.type else "bare except")
+                yield self.finding(
+                    ctx, node,
+                    f"{what}: pass — narrow the exception type and log at "
+                    f"debug, or pragma with the reason swallowing is correct")
+
+    @staticmethod
+    def _broad(ctx: FileContext, type_node: Optional[ast.AST]) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Tuple):
+            return any(ctx.resolve(e) in ("Exception", "BaseException")
+                       for e in type_node.elts)
+        return ctx.resolve(type_node) in ("Exception", "BaseException")
+
+
+# ------------------------------------------------------------------- rule 6
+
+NONDET_STDLIB = {"random", "randint", "randrange", "uniform", "choice",
+                 "choices", "shuffle", "sample", "getrandbits",
+                 "normalvariate", "gauss", "betavariate", "expovariate"}
+
+
+@register
+class UnseededNondeterminism(Rule):
+    name = "unseeded-nondeterminism"
+    hazard = ("an unseeded random draw in distributed/ takes a different "
+              "value on every host — seeds, schedules, or layer init silently "
+              "diverge across replicas (the bugs that surface as loss spikes "
+              "three days into a run)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if "distributed/" not in ctx.rel_path:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if not name:
+                continue
+            if name.startswith("random.") and name.split(".")[1] in NONDET_STDLIB:
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() draws from the process-global unseeded stream "
+                    f"— replicas diverge; derive from (global seed, rank) "
+                    f"instead")
+            elif (name.startswith("numpy.random.")
+                  and not name.endswith((".seed", ".default_rng", ".RandomState",
+                                         ".Generator"))):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() uses numpy's global unseeded stream — replicas "
+                    f"diverge; use a seeded Generator keyed on (seed, rank)")
+
+
+# ------------------------------------------------------------------- rule 7
+
+IMPORT_TIME_TOUCH = {"jax.devices", "jax.local_devices", "jax.device_count",
+                     "jax.local_device_count", "jax.default_backend",
+                     "jax.process_index", "jax.process_count"}
+
+
+@register
+class ImportTimeDeviceTouch(Rule):
+    name = "import-time-device-touch"
+    hints = ("jax", "jnp")
+    hazard = ("a jax/jnp call at module scope can initialize the backend "
+              "during import — JAX_PLATFORMS / jax.config set afterwards are "
+              "silently ignored (the plugin-sitecustomize hang paddle_tpu/"
+              "__init__.py works around)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        skip: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                body = (node.body if not isinstance(node, ast.Lambda)
+                        else [node.body])
+                for stmt in body:
+                    for sub in ast.walk(stmt):
+                        skip.add(id(sub))
+        # `if __name__ == "__main__":` bodies run as a script, after any
+        # platform config — not at import time
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.If) and self._is_main_guard(stmt.test):
+                for sub in ast.walk(stmt):
+                    skip.add(id(sub))
+        for node in ast.walk(ctx.tree):
+            if id(node) in skip or not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if not name:
+                continue
+            if (name in IMPORT_TIME_TOUCH or name.startswith("jax.numpy.")
+                    or name.startswith("jnp.")
+                    or name.startswith(("jax.random.", "jax.core.",
+                                        "jax.eval_shape", "jax.make_array"))):
+                yield self.finding(
+                    ctx, node,
+                    f"{name}() runs at import time (module or default-arg "
+                    f"scope) — move it behind a function so platform config "
+                    f"can land first")
+
+    @staticmethod
+    def _is_main_guard(test: ast.AST) -> bool:
+        return (isinstance(test, ast.Compare)
+                and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__"
+                and len(test.ops) == 1 and isinstance(test.ops[0], ast.Eq)
+                and len(test.comparators) == 1
+                and isinstance(test.comparators[0], ast.Constant)
+                and test.comparators[0].value == "__main__")
+
+
+# ------------------------------------------------------------------- rule 8
+
+#: Files (relative to paddle_tpu/) whose print() calls are their documented
+#: job — CLI entry points, console UIs, reference-parity verbose knobs, the
+#: paddle.static.Print op.  NOT a dumping ground; every entry needs a
+#: justification and entries with no print() left are themselves findings,
+#: so the list stays a real inventory in both directions.
+#: Single source of truth: tests/test_no_print.py wraps THIS set.
+PRINT_ALLOWLIST = {
+    "core/tensor.py",                       # FLAGS-gated eager debug echo
+    "distributed/fleet/utils/__init__.py",  # fleet log_util console sink
+    "distributed/launch.py",                # CLI entry point
+    "hapi/callbacks.py",                    # ProgBarLogger console UI
+    "hapi/dynamic_flops.py",                # flops(print_detail=) contract
+    "hapi/model_summary.py",                # summary() prints per reference
+    "optimizer/lr.py",                      # verbose= knob per reference
+    "static/__init__.py",                   # paddle.static.Print op
+    "utils/__init__.py",                    # run_check console contract
+    "utils/cpp_extension.py",               # verbose build log
+}
+
+_PKG_PREFIX = "paddle_tpu/"
+
+
+@register
+class NoPrint(Rule):
+    name = "no-print"
+    hazard = ("print() in library code bypasses logging — serving hosts "
+              "can't route, rate-limit, or silence it (round-6's profiler "
+              "print was invisible to log pipelines)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if not ctx.rel_path.startswith(_PKG_PREFIX):
+            return
+        rel = ctx.rel_path[len(_PKG_PREFIX):]
+        prints = [node for node in ast.walk(ctx.tree)
+                  if isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Name) and node.func.id == "print"]
+        if rel in PRINT_ALLOWLIST:
+            if not prints:
+                yield Finding(path=ctx.rel_path, line=1, col=1, rule=self.name,
+                              message="stale PRINT_ALLOWLIST entry: no print() "
+                                      "left in this file — prune the list "
+                                      "(paddle_tpu/analysis/rules.py)")
+            return
+        for node in prints:
+            yield self.finding(
+                ctx, node,
+                "print() in library code — route through logging (see "
+                "profiler.stop_profiler) or, for a genuine CLI/console "
+                "contract, extend PRINT_ALLOWLIST with a justification")

@@ -15,11 +15,19 @@ def get_rng_state_tracker() -> RNGSequenceTracker:
 
 
 def model_parallel_random_seed(seed: int = None):
-    import random as pyrandom
+    """Seed the MP tracker from ``(global seed, mp rank)``.
+
+    ``seed=None`` derives from the process-wide ``FLAGS_seed`` instead of an
+    unseeded ``random.randint`` — every host must compute the SAME global
+    seed or dropout masks diverge across model-parallel replicas and the
+    sharded forward silently stops matching the single-host one (tpulint
+    rule ``unseeded-nondeterminism``; this was its founding true-positive).
+    """
     from .... import env
+    from .....core import flags
     rank = env.get_rank()
     if seed is None:
-        seed = pyrandom.randint(0, 100000)
+        seed = int(flags.flag("FLAGS_seed"))
     global_seed = seed
     local_seed = seed + 1024 + rank
     tracker = get_rng_state_tracker()

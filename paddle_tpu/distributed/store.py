@@ -20,12 +20,15 @@ elastic manager and launcher accept either form.
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import socket
 import struct
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 _OPS = {"SET": 0, "GET": 1, "ADD": 2, "WAIT": 3, "DEL": 4, "LIST": 5}
 
@@ -55,8 +58,11 @@ class StoreServer:
     def __del__(self):  # pragma: no cover - GC ordering
         try:
             self.stop()
-        except Exception:
-            pass
+        except (OSError, AttributeError) as e:
+            # half-constructed instance (AttributeError) or the native lib
+            # failing mid-teardown; a dead server at GC is worth one debug
+            # line, not a raised-in-__del__ warning
+            logger.debug("StoreServer.__del__: stop failed: %s", e)
 
 
 _UNSET = object()  # wait(timeout=None) must mean "block forever"

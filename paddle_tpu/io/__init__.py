@@ -12,6 +12,7 @@ the current step runs.  ``num_workers=0`` keeps the single prefetch thread.
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import queue
 import threading
@@ -22,6 +23,8 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+
+logger = logging.getLogger(__name__)
 
 
 class Dataset:
@@ -342,8 +345,11 @@ class _MPResources:
         for _ in self.workers:
             try:
                 self.tasks.put_nowait(None)
-            except Exception:
-                pass
+            except (queue.Full, ValueError, OSError) as e:
+                # full task queue or a queue torn down under us — workers
+                # also exit on the closed event, so dropping the sentinel
+                # is safe; still worth a trace for hang forensics
+                logger.debug("shutdown: task sentinel not enqueued: %s", e)
         if self.ring is not None:
             self.ring.close()
         for w in self.workers:
@@ -441,8 +447,11 @@ class _MultiprocessIterator:
     def __del__(self):
         try:
             self._res.shutdown()
-        except Exception:
-            pass
+        except (OSError, RuntimeError, AttributeError) as e:
+            # GC during interpreter teardown: queues/threads may already be
+            # gone (AttributeError on a half-built iterator, RuntimeError
+            # from join); leak forensics want the debug line
+            logger.debug("_MultiprocessIterator.__del__: shutdown failed: %s", e)
 
 
 def _mp_pump(iter_ref, res, index_iter, window, to_tensors, timeout):

@@ -15,6 +15,7 @@ template (tiny, once per batch shape).
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import pickle
 import struct
@@ -23,6 +24,8 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ..csrc import load_library
+
+logger = logging.getLogger(__name__)
 
 
 class _Lib:
@@ -105,8 +108,11 @@ class ShmQueue:
                 if self.owner:
                     self._lib.shm_ring_unlink(self.name)
                 self._ring = None
-        except Exception:
-            pass
+        except (OSError, AttributeError) as e:
+            # native detach/unlink failing at GC means the segment leaks
+            # until reboot — that deserves a debug line, not silence
+            logger.debug("ShmQueue.__del__: detach failed for %s: %s",
+                         getattr(self, "name", "?"), e)
 
 
 # ---------------------------------------------------------------------- codec

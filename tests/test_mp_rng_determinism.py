@@ -1,0 +1,61 @@
+"""Model-parallel RNG seeding must be a pure function of (seed, mp rank).
+
+The pre-tpulint code fell back to ``random.randint(0, 100000)`` when no
+seed was given — every host drew a DIFFERENT global seed, so the
+"identical across ranks" contract of the global stream silently broke the
+moment a job relied on the default (the exact replica-divergence hazard
+tpulint's ``unseeded-nondeterminism`` rule exists for).  Now the default
+derives from ``FLAGS_seed``: same flags ⇒ same tracker state on every
+host, no process-global randomness involved."""
+
+import random as pyrandom
+
+import pytest
+
+import paddle_tpu
+from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers.random import (
+    MODEL_PARALLEL_RNG, get_rng_state_tracker, model_parallel_random_seed)
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_rng_state():
+    """model_parallel_random_seed reseeds the PROCESS-GLOBAL tracker and
+    FLAGS_seed; put both back so this module can't leak state downstream."""
+    yield
+    get_rng_state_tracker().seeds.pop(MODEL_PARALLEL_RNG, None)
+    paddle_tpu.seed(0)  # suite default: FLAGS_seed=0, fresh streams
+
+
+def _tracker_seeds_on_host(monkeypatch, rank, seed=None):
+    """Simulate one host: pin the trainer rank, (re)seed, snapshot."""
+    monkeypatch.setenv("PADDLE_TRAINER_ID", str(rank))
+    model_parallel_random_seed(seed)
+    return dict(get_rng_state_tracker().seeds)
+
+
+def test_same_seed_agrees_across_hosts(monkeypatch):
+    a = _tracker_seeds_on_host(monkeypatch, rank=0, seed=1234)
+    b = _tracker_seeds_on_host(monkeypatch, rank=0, seed=1234)
+    assert a == b, "same (seed, rank) must rebuild the identical tracker"
+    assert a[MODEL_PARALLEL_RNG] == 1234 + 1024 + 0
+
+
+def test_local_stream_differs_per_rank_deterministically(monkeypatch):
+    r0 = _tracker_seeds_on_host(monkeypatch, rank=0, seed=1234)
+    r1 = _tracker_seeds_on_host(monkeypatch, rank=1, seed=1234)
+    # dropout inside sharded layers must differ across TP ranks ...
+    assert r0[MODEL_PARALLEL_RNG] != r1[MODEL_PARALLEL_RNG]
+    # ... but by the documented deterministic offset, not by luck
+    assert r1[MODEL_PARALLEL_RNG] - r0[MODEL_PARALLEL_RNG] == 1
+
+def test_default_seed_is_deterministic_not_process_random(monkeypatch):
+    """seed=None derives from FLAGS_seed — never from random.randint."""
+    def _boom(*a, **k):
+        raise AssertionError("model_parallel_random_seed drew from the "
+                             "process-global random module")
+    monkeypatch.setattr(pyrandom, "randint", _boom)
+    paddle_tpu.set_flags({"FLAGS_seed": 777})
+    host_a = _tracker_seeds_on_host(monkeypatch, rank=1, seed=None)
+    host_b = _tracker_seeds_on_host(monkeypatch, rank=1, seed=None)
+    assert host_a == host_b
+    assert host_a[MODEL_PARALLEL_RNG] == 777 + 1024 + 1

@@ -1,71 +1,60 @@
 """Library hygiene lint: no ``print()`` in paddle_tpu/ library code.
 
-Library output must flow through ``logging`` (or an explicit callback /
-registry) so serving hosts can route, rate-limit, and silence it —
-round-6's profiler ``stop_profiler`` print was invisible to log pipelines
-and unconditionally noisy in tests.  A frozen allowlist covers the
-modules whose printing IS their contract (CLI entry points, console
-progress UIs, reference-parity verbose knobs, the ``paddle.static.Print``
-op).  Adding a print anywhere else fails this test; removing one from an
-allowlisted file requires pruning the list (keeps it honest in both
-directions)."""
+Since the tpulint PR this is a THIN WRAPPER over the ``no-print`` rule in
+``paddle_tpu/analysis`` — the frozen allowlist and the detection logic
+live there (single source of truth), so the print policy enforced here and
+the one enforced by ``tools/tpulint.py`` / tools/collect_smoke.sh cannot
+drift apart.  The policy itself is unchanged: library output must flow
+through ``logging`` (or an explicit callback/registry) so serving hosts
+can route, rate-limit, and silence it; the allowlist covers modules whose
+printing IS their contract, and entries with no print() left are
+themselves violations (keeps the list honest in both directions)."""
 
-import ast
+import functools
 import pathlib
 
-PKG = pathlib.Path(__file__).parent.parent / "paddle_tpu"
+from paddle_tpu.analysis import PRINT_ALLOWLIST, RULES, lint_paths
 
-# Files whose print() calls are their documented job — NOT a dumping
-# ground: every entry must be a CLI entry point, console UI, or a
-# reference-parity API that prints by contract.
-PRINT_ALLOWLIST = {
-    "core/tensor.py",                       # FLAGS-gated eager debug echo
-    "distributed/fleet/utils/__init__.py",  # fleet log_util console sink
-    "distributed/launch.py",                # CLI entry point
-    "hapi/callbacks.py",                    # ProgBarLogger console UI
-    "hapi/dynamic_flops.py",                # flops(print_detail=) contract
-    "hapi/model_summary.py",                # summary() prints per reference
-    "optimizer/lr.py",                      # verbose= knob per reference
-    "static/__init__.py",                   # paddle.static.Print op
-    "utils/__init__.py",                    # run_check console contract
-    "utils/cpp_extension.py",               # verbose build log
-}
+ROOT = pathlib.Path(__file__).parent.parent
+PKG = ROOT / "paddle_tpu"
 
 
-def _files_with_print():
-    out = set()
-    for path in sorted(PKG.rglob("*.py")):
-        if "__pycache__" in path.parts:
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Name)
-                    and node.func.id == "print"):
-                out.add(str(path.relative_to(PKG)))
-                break
-    return out
+@functools.lru_cache(maxsize=1)
+def _no_print_findings():
+    findings = lint_paths([PKG], root=ROOT, rules=[RULES["no-print"]])
+    # rule-filtered (the engine can emit bad-pragma/syntax-error findings
+    # regardless of rule selection — those belong to the tpulint gate, not
+    # the print policy); fixtures are the rule's own frozen test corpus,
+    # baselined in tools/tpulint_baseline.json, not library violations
+    return tuple(f for f in findings if f.rule == "no-print"
+                 and not f.path.startswith("paddle_tpu/analysis/fixtures/"))
 
 
 def test_no_print_outside_allowlist():
-    printing = _files_with_print()
-    new = printing - PRINT_ALLOWLIST
+    new = sorted({f.path for f in _no_print_findings()
+                  if "stale" not in f.message})
     assert not new, (
-        f"print() in library code: {sorted(new)} — route through logging "
+        f"print() in library code: {new} — route through logging "
         f"(see paddle_tpu/profiler.py stop_profiler for the pattern) or, "
-        f"for a genuine CLI/console contract, extend PRINT_ALLOWLIST with "
-        f"a justification comment")
+        f"for a genuine CLI/console contract, extend PRINT_ALLOWLIST in "
+        f"paddle_tpu/analysis/rules.py with a justification comment")
 
 
 def test_allowlist_is_pruned():
-    printing = _files_with_print()
-    stale = PRINT_ALLOWLIST - printing
+    stale = sorted({f.path for f in _no_print_findings()
+                    if "stale" in f.message})
     assert not stale, (
-        f"allowlist entries with no print() left: {sorted(stale)} — "
-        f"remove them so the list stays a real inventory")
+        f"allowlist entries with no print() left: {stale} — remove them "
+        f"from PRINT_ALLOWLIST so the list stays a real inventory")
+    missing = sorted(rel for rel in PRINT_ALLOWLIST
+                     if not (PKG / rel).is_file())
+    assert not missing, (
+        f"allowlist entries pointing at deleted files: {missing}")
 
 
 def test_profiler_routes_through_logging():
     """The satellite fix this lint exists to protect: stop_profiler's
     summary goes to the module logger / on_summary, never stdout."""
-    assert "profiler.py" not in _files_with_print()
+    assert "profiler.py" not in PRINT_ALLOWLIST
+    assert not lint_paths([PKG / "profiler.py"], root=ROOT,
+                          rules=[RULES["no-print"]])

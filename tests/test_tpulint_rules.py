@@ -1,0 +1,157 @@
+"""Per-rule tpulint fixture tests: every rule must trip on its bad_*.py
+fixture and stay silent on its clean_*.py near-miss, plus pragma
+suppression semantics.  The fixture files under
+paddle_tpu/analysis/fixtures/ are the single corpus shared by these tests
+and the CI gate (they are linted in place and frozen in
+tools/tpulint_baseline.json, so a rule silently going blind breaks the
+ratchet too — see docs/STATIC_ANALYSIS.md)."""
+
+import pathlib
+import textwrap
+
+import pytest
+
+from paddle_tpu.analysis import RULES, lint_paths, lint_source
+
+ROOT = pathlib.Path(__file__).parent.parent
+FIXTURES = ROOT / "paddle_tpu" / "analysis" / "fixtures"
+
+#: rule id → bad fixture (path-scoped rules live in subdirs)
+BAD_FIXTURE = {
+    "host-impurity-in-jit": "bad_host_impurity_in_jit.py",
+    "donated-arg-reuse": "bad_donated_arg_reuse.py",
+    "traced-python-branch": "bad_traced_python_branch.py",
+    "unhashable-static-arg": "bad_unhashable_static_arg.py",
+    "silent-except": "bad_silent_except.py",
+    "unseeded-nondeterminism": "distributed/bad_unseeded_nondeterminism.py",
+    "import-time-device-touch": "bad_import_time_device_touch.py",
+    "no-print": "bad_no_print.py",
+}
+CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
+                 for rule, path in BAD_FIXTURE.items()}
+
+
+def _lint(path: pathlib.Path):
+    return lint_paths([path], root=ROOT)
+
+
+def test_fixture_map_covers_every_rule():
+    assert set(BAD_FIXTURE) == set(RULES), (
+        "every registered rule needs a bad/clean fixture pair — add one "
+        "under paddle_tpu/analysis/fixtures/ and rebaseline")
+
+
+@pytest.mark.parametrize("rule", sorted(BAD_FIXTURE))
+def test_bad_fixture_trips_its_rule(rule):
+    findings = _lint(FIXTURES / BAD_FIXTURE[rule])
+    hits = [f for f in findings if f.rule == rule]
+    assert hits, f"{BAD_FIXTURE[rule]} produced no {rule} finding"
+    for f in hits:
+        assert f.line > 0 and f.path.startswith("paddle_tpu/analysis/fixtures/")
+
+
+@pytest.mark.parametrize("rule", sorted(CLEAN_FIXTURE))
+def test_clean_fixture_is_silent(rule):
+    findings = _lint(FIXTURES / CLEAN_FIXTURE[rule])
+    assert findings == [], (
+        f"{CLEAN_FIXTURE[rule]} should be finding-free, got: "
+        f"{[f.render() for f in findings]}")
+
+
+# ------------------------------------------------------------------- pragmas
+
+def test_pragma_fixture_fully_suppressed():
+    assert _lint(FIXTURES / "pragma_suppressed.py") == []
+
+
+def test_pragma_without_reason_reports_and_does_not_suppress():
+    findings = _lint(FIXTURES / "bad_pragma_missing_reason.py")
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["bad-pragma", "silent-except"]
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = textwrap.dedent("""\
+        def f(x):
+            try:
+                x()
+            except Exception:  # tpulint: disable=no-print(wrong rule id)
+                pass
+    """)
+    findings = lint_source("paddle_tpu/x.py", src)
+    assert [f.rule for f in findings] == ["silent-except"]
+
+
+def test_pragma_disable_all():
+    src = textwrap.dedent("""\
+        def f(x):
+            try:
+                x()
+            except Exception:  # tpulint: disable=all(demo of the big hammer)
+                pass
+    """)
+    assert lint_source("paddle_tpu/x.py", src) == []
+
+
+def test_pragma_text_in_docstring_is_documentation_not_a_pragma():
+    """Only COMMENT tokens carry pragmas: quoting the syntax in a docstring
+    must neither suppress findings nor emit bad-pragma."""
+    src = textwrap.dedent('''\
+        """Docs: write # tpulint: disable=silent-except to suppress,
+        never a bare # tpulint: disable=silent-except without a reason."""
+
+        def f(x):
+            try:
+                x()
+            except Exception:
+                pass
+    ''')
+    findings = lint_source("paddle_tpu/x.py", src)
+    assert [f.rule for f in findings] == ["silent-except"]
+
+
+def test_main_guard_is_not_import_time():
+    src = textwrap.dedent("""\
+        import jax.numpy as jnp
+
+        if __name__ == "__main__":
+            jnp.zeros((3,))
+    """)
+    assert lint_source("tools/cli.py", src) == []
+
+
+def test_syntax_error_is_a_finding_not_a_crash():
+    findings = lint_source("paddle_tpu/broken.py", "def f(:\n")
+    assert [f.rule for f in findings] == ["syntax-error"]
+
+
+# ------------------------------------------------- rule-specific edge cases
+
+def test_conditional_donate_argnums_is_not_guessed_at():
+    """The tree's dominant idiom — donate_argnums=(0,) if donate else () —
+    is opaque to the AST and must NOT produce findings."""
+    src = textwrap.dedent("""\
+        import functools
+        import jax
+
+        def make_step(donate):
+            @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
+            def step(state, batch):
+                return state
+            new = step({}, 1)
+            return new, step
+    """)
+    assert lint_source("paddle_tpu/x.py", src) == []
+
+
+def test_no_print_ignores_files_outside_package():
+    assert lint_source("tools/some_cli.py", "print('usage: ...')\n",
+                       rules=[RULES["no-print"]]) == []
+
+
+def test_no_print_reports_stale_allowlist_entry():
+    from paddle_tpu.analysis.rules import PRINT_ALLOWLIST
+    rel = sorted(PRINT_ALLOWLIST)[0]
+    findings = lint_source(f"paddle_tpu/{rel}", "x = 1\n",
+                           rules=[RULES["no-print"]])
+    assert len(findings) == 1 and "stale" in findings[0].message

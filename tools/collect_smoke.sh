@@ -27,4 +27,16 @@ if ! JAX_PLATFORMS=cpu python tools/trace_to_chrome.py --help >/dev/null 2>&1; t
     echo "COLLECT SMOKE FAILED: tools/trace_to_chrome.py --help"
     exit 1
 fi
+# tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
+# (exit 1, rule id + file:line printed above); a STALE baseline (violations
+# burned down but baseline not shrunk) fails with exit 3 — regenerate via
+# `python tools/tpulint.py --write-baseline paddle_tpu tools`.  The linter
+# is stdlib-only (no JAX import), so this stage costs seconds.
+python tools/tpulint.py paddle_tpu tools
+lint_rc=$?
+if [ "$lint_rc" -ne 0 ]; then
+    echo "COLLECT SMOKE FAILED: tpulint (rc=$lint_rc; 1=new violations," \
+         "3=stale baseline — see docs/STATIC_ANALYSIS.md)"
+    exit 1
+fi
 echo "collect smoke OK"

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""tpulint CLI — JAX/TPU correctness lint with a ratcheted baseline.
+
+Usage:
+    python tools/tpulint.py paddle_tpu tools            # CI gate (baseline)
+    python tools/tpulint.py --no-baseline some/file.py  # raw findings
+    python tools/tpulint.py --write-baseline paddle_tpu tools
+    python tools/tpulint.py --json paddle_tpu tools     # machine-readable
+    python tools/tpulint.py --list-rules
+
+Exit codes (the contract tools/collect_smoke.sh and CI key off):
+    0  clean — no findings beyond the committed baseline
+    1  NEW violations (count above baseline for some file+rule), or any
+       finding at all under --no-baseline
+    2  usage / internal error (bad args, unreadable baseline)
+    3  STALE baseline — the tree has fewer violations than the baseline
+       records; shrink it with --write-baseline so the ratchet only
+       turns one way
+
+The engine lives in paddle_tpu/analysis/, loaded here by file path so the
+lint never imports JAX (paddle_tpu/__init__.py pulls in the full
+framework; a commit-time linter must not pay that).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = ROOT / "tools" / "tpulint_baseline.json"
+
+
+def load_analysis():
+    """Import paddle_tpu.analysis WITHOUT importing paddle_tpu."""
+    pkg_dir = ROOT / "paddle_tpu" / "analysis"
+    spec = importlib.util.spec_from_file_location(
+        "_tpulint_analysis", pkg_dir / "__init__.py",
+        submodule_search_locations=[str(pkg_dir)])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_tpulint_analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu", "tools"],
+                    help="files/dirs to lint (default: paddle_tpu tools)")
+    ap.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                    help="ratchet baseline JSON (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding; exit 1 if any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the current tree")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings + counts as JSON on stdout")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--root", type=Path, default=ROOT,
+                    help="repo root for relative paths (default: %(default)s)")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+
+    if args.list_rules:
+        for name, rule in sorted(analysis.RULES.items()):
+            print(f"{name}\n    {rule.hazard}")
+        return 0
+
+    t0 = time.monotonic()
+    paths = [Path(p) if Path(p).is_absolute() else args.root / p
+             for p in (args.paths or ["paddle_tpu", "tools"])]
+    for p in paths:
+        if not p.exists():
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+    findings = analysis.lint_paths(paths, root=args.root)
+    elapsed = time.monotonic() - t0
+
+    if args.write_baseline:
+        # guard: rewriting an existing baseline from a DIFFERENT path set
+        # would silently truncate it to the subset's counts
+        norm = sorted(str(p) for p in (args.paths or ["paddle_tpu", "tools"]))
+        if args.baseline.exists():
+            try:
+                prior = json.loads(args.baseline.read_text()).get("paths")
+            except (OSError, json.JSONDecodeError):
+                prior = None
+            if prior is not None and prior != norm:
+                print(f"tpulint: refusing to overwrite {args.baseline}: it was "
+                      f"generated from paths {prior}, this run lints {norm}.\n"
+                      f"  Re-run over the original paths, or write a subset "
+                      f"baseline elsewhere with --baseline OTHER.json",
+                      file=sys.stderr)
+                return 2
+        analysis.write_baseline(args.baseline, findings, paths=norm)
+        print(f"tpulint: wrote {len(findings)} baselined finding(s) to "
+              f"{args.baseline} ({elapsed:.1f}s)")
+        return 0
+
+    if args.as_json:
+        print(analysis.render_json(findings))
+
+    if args.no_baseline:
+        if not args.as_json and findings:
+            print(analysis.render_text(findings))
+        print(f"tpulint: {len(findings)} finding(s) in {elapsed:.1f}s "
+              f"(no baseline)", file=sys.stderr)
+        return 1 if findings else 0
+
+    try:
+        baseline = analysis.load_baseline(args.baseline)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError) as e:
+        print(f"tpulint: cannot read baseline {args.baseline}: {e}\n"
+              f"  (generate one with --write-baseline)", file=sys.stderr)
+        return 2
+
+    new, stale = analysis.diff_baseline(findings, baseline)
+    if new:
+        if not args.as_json:
+            print(analysis.render_text(new))
+        buckets = sorted({(f.path, f.rule) for f in new})
+        print(f"tpulint: NEW violation(s) above baseline in "
+              f"{len(buckets)} file+rule bucket(s) "
+              f"({elapsed:.1f}s) — all sites for each bucket are listed; "
+              f"fix the new one or (rarely) pragma it with a reason",
+              file=sys.stderr)
+        return 1
+    if stale:
+        for path, rule, cur, base in stale:
+            print(f"{path}: {rule}: baseline records {base}, tree has {cur}",
+                  file=sys.stderr)
+        print("tpulint: STALE baseline — violations were burned down "
+              "(good!); shrink the ratchet:\n"
+              "  python tools/tpulint.py --write-baseline paddle_tpu tools",
+              file=sys.stderr)
+        return 3
+    print(f"tpulint: OK — {len(findings)} baselined finding(s), 0 new, "
+          f"{elapsed:.1f}s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
