@@ -78,18 +78,27 @@ def _transformer_train_flops(B, L, n_layers, H, I, V, moe_topk=1,
     return 3.0 * B * L * per_token
 
 
-def _run_timed(step, args, iters):
+def _run_timed(step, args, iters, monitor=None, examples_per_step=0,
+               tokens_per_step=0):
     """AOT-compile ``step`` on ``args`` (arg 0 = donated state), run ``iters``
     steps, sync via host transfer of the loss (block_until_ready on this
     tunneled backend returns before the chain completes — observed 2026-07-29).
-    Returns (dt_seconds, final_loss, flops_per_step)."""
+    Returns (dt_seconds, final_loss, flops_per_step).
+
+    ``monitor``: optional ``telemetry.TrainMonitor`` observing the run —
+    per-iteration dispatch wall as ``train_step`` events, the AOT compile as
+    a compile event, the final fetch as the device-blocked ``sync`` (which
+    feeds the numerics watchdog), plus an HBM census of the final state."""
     import jax
     import numpy as np
 
     if not hasattr(step, "lower"):  # plain wrapper around an inner jit
         step = jax.jit(step, donate_argnums=(0,))
     lowered = step.lower(*args)
+    t_c = time.perf_counter()
     compiled = lowered.compile()
+    if monitor is not None:
+        monitor.record_compile(("bench_step",), time.perf_counter() - t_c)
     flops = _flops_of(compiled)
 
     state, rest = args[0], args[1:]
@@ -98,13 +107,36 @@ def _run_timed(step, args, iters):
         loss = loss[0]
     float(np.asarray(loss))  # warmup sync
 
+    it_walls = []
     t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = compiled(state, *rest)
-        if isinstance(loss, tuple):
-            loss = loss[0]
+    if monitor is None:
+        for _ in range(iters):
+            state, loss = compiled(state, *rest)
+            if isinstance(loss, tuple):
+                loss = loss[0]
+    else:
+        # timed window stays clean: only a perf_counter pair and a list
+        # append per iteration — monitor bookkeeping (locks, event dicts)
+        # happens after dt is taken
+        for _ in range(iters):
+            it0 = time.perf_counter()
+            state, loss = compiled(state, *rest)
+            if isinstance(loss, tuple):
+                loss = loss[0]
+            it_walls.append(time.perf_counter() - it0)
+    t_sync = time.perf_counter()
     final_loss = float(np.asarray(loss))
     dt = time.perf_counter() - t0
+    if monitor is not None:
+        sync_wall = time.perf_counter() - t_sync
+        for w in it_walls:
+            monitor.record_step(w, trainer="bench",
+                                examples=examples_per_step,
+                                tokens=tokens_per_step)
+        monitor.record_sync(sync_wall, loss=final_loss)
+        if isinstance(state, dict):
+            monitor.hbm_census(params=state.get("params"),
+                               opt=state.get("opt"))
     assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
     return dt, final_loss, flops
 
@@ -145,13 +177,18 @@ def _fleet_hcg(**degrees):
 
 
 def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
-    """Shared GPT bench harness: build config + hybrid step, time, report."""
+    """Shared GPT bench harness: build config + hybrid step, time, report.
+    A TrainMonitor observes the timed run (external to the step — the
+    compiled program is the same one an unmonitored run uses) and its
+    snapshot (step p50/p95, tokens/sec, compile count, peak HBM, watchdog)
+    rides the BENCH JSON under ``"telemetry"``."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
     from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.telemetry import TrainMonitor
 
     paddle.seed(0)
     cfg = GPTConfig(**(cfg_tpu if on_tpu else cfg_cpu))
@@ -164,10 +201,34 @@ def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
     x = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
     y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
     args = (state, jax.random.key(0), np.float32(3e-4), x, y)
-    dt, loss, _ = _run_timed(step, args, iters)
+    mon = TrainMonitor()
+    dt, loss, _ = _run_timed(step, args, iters, monitor=mon,
+                             examples_per_step=B, tokens_per_step=B * L)
     flops = _transformer_train_flops(B, L, cfg.num_layers, cfg.hidden_size,
                                      cfg.intermediate_size, cfg.vocab_size)
-    return _result(metric, "tokens/s/chip", B * L, iters, dt, flops, on_tpu, loss)
+    out = _result(metric, "tokens/s/chip", B * L, iters, dt, flops, on_tpu,
+                  loss)
+    tel = mon.summary()
+    sw = tel["step_wall_s"] or {}
+
+    def ms(v):
+        return None if v is None else round(v * 1e3, 3)
+
+    out["telemetry"] = {
+        "steps": tel["steps"],
+        "step_ms_p50": ms(sw.get("p50")),
+        "step_ms_p95": ms(sw.get("p95")),
+        "tokens_per_sec": (None if tel["tokens_per_sec"] is None
+                           else round(tel["tokens_per_sec"], 1)),
+        "compile_misses": tel["compile"]["misses"],
+        "compile_wall_s": round(tel["compile"]["wall_s"], 3),
+        "peak_hbm_bytes": tel["hbm"]["peak_bytes"],
+        "hbm_params_bytes": tel["hbm"]["params_bytes"],
+        "hbm_opt_bytes": tel["hbm"]["opt_bytes"],
+        "watchdog_non_finite": tel["watchdog"]["non_finite"],
+        "watchdog_loss_spikes": tel["watchdog"]["loss_spikes"],
+    }
+    return out
 
 
 def bench_gpt2s(on_tpu):

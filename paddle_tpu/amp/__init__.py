@@ -162,9 +162,18 @@ def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16"
     return models, optimizers
 
 
+# the single blocking host transfer in GradScaler.unscale_ — a named hook so
+# tests can assert the one-sync-per-step contract by counting calls
+_host_bool = bool
+
+
 class GradScaler:
     """Dynamic loss scaling (reference: grad_scaler.py:26 + fluid/dygraph/amp
-    AmpScaler; kernels amp/check_finite_and_unscale_op, update_loss_scaling_op)."""
+    AmpScaler; kernels amp/check_finite_and_unscale_op, update_loss_scaling_op).
+
+    Telemetry: when a ``telemetry.TrainMonitor`` is active
+    (``set_active_monitor`` / ``TelemetryCallback``), ``unscale_`` emits a
+    ``found_inf`` event and ``update()`` a ``scale_change`` event."""
 
     def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,
@@ -200,16 +209,22 @@ class GradScaler:
         if not self._enable or self._already_unscaled:
             return
         self._already_unscaled = True
-        params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
-        found = False
-        for p in params:
-            if p._grad is not None:
-                g = p._grad.astype(jnp.float32) * inv
-                if not bool(jnp.isfinite(g).all()):
-                    found = True
-                p._grad = g.astype(p._grad.dtype)
+        # one pass: unscale each grad in place (at most ONE transient fp32
+        # copy live at a time — stacking all fp32 copies first would spike
+        # peak HBM) keeping only a scalar finite flag per grad; then ONE
+        # stacked reduction and ONE host sync for the whole parameter list
+        # (the old per-param bool() loop blocked the device once per param)
+        flags = []
+        for p in (optimizer._parameter_list or []):
+            if p._grad is None:
+                continue
+            g = p._grad.astype(jnp.float32) * inv
+            flags.append(jnp.isfinite(g).all())
+            p._grad = g.astype(p._grad.dtype)
+        found = bool(flags) and not _host_bool(jnp.stack(flags).all())
         self._found_inf = found
+        self._emit_telemetry(found)
 
     def step(self, optimizer):
         """Unscale (if not already) and apply the optimizer step unless a
@@ -227,10 +242,17 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    def _emit_telemetry(self, found_inf: bool):
+        from ..telemetry import current_monitor
+        mon = current_monitor()
+        if mon is not None:
+            mon.observe_scaler(self._scale, found_inf)
+
     def update(self):
         self._already_unscaled = False
         if not (self._enable and self._dynamic):
             return
+        old_scale = self._scale
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
@@ -243,6 +265,8 @@ class GradScaler:
             if self._good_steps >= self._incr_every_n_steps:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        if self._scale != old_scale:
+            self._emit_telemetry(False)
 
     # ------------------------------------------------------- functional form
     def init_state(self):

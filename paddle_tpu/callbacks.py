@@ -1,9 +1,12 @@
 """Training callbacks (reference: python/paddle/callbacks.py — a re-export
-of the hapi callback classes, mirrored here the same way)."""
+of the hapi callback classes, mirrored here the same way).
+``TelemetryCallback`` is paddle_tpu-specific: it wires a
+``telemetry.TrainMonitor`` through ``Model.fit`` (docs/OBSERVABILITY.md)."""
 
 from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
                              LRScheduler, ModelCheckpoint, ProgBarLogger,
-                             ReduceLROnPlateau, VisualDL)
+                             ReduceLROnPlateau, TelemetryCallback, VisualDL)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "ReduceLROnPlateau"]
+           "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
+           "TelemetryCallback"]

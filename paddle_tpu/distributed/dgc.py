@@ -43,7 +43,8 @@ def _topk_compress(v, k):
 def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                         mesh: Mesh, sparsity: float = 0.999,
                         momentum: float = 0.9, rampup_begin_step: int = 0,
-                        axis: str = "data", donate: bool = True):
+                        axis: str = "data", donate: bool = True,
+                        monitor=None):
     """Build a data-parallel step with DGC gradient exchange.
 
     ``loss_of(params, *batch) -> scalar``; batch splits over ``axis``.
@@ -157,4 +158,5 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         return _compiled(len(batch))(state, jnp.asarray(lr, jnp.float32),
                                      *batch)
 
-    return step, state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "dgc"), state0

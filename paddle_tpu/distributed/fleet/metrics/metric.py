@@ -3,11 +3,19 @@ all-reduced global metrics over the worker group).
 
 TPU-native: the all-reduce is the eager collective (identity in a single
 process, psum across the mesh inside shard_map/multi-process runs).
+
+The reference-parity functions intentionally shadow the ``sum``/``max``/
+``min`` builtins (``fleet.metrics.sum`` IS the API); internal code uses
+``builtins.*``.  Scalars reduce as raw device arrays — no per-value Tensor
+wrapper object — and ``all_reduce_metrics`` batches a whole dict of step
+metrics into ONE collective (the telemetry cross-host aggregation path:
+one all-reduce per training report instead of one per metric).
 """
 
 from __future__ import annotations
 
 import builtins
+from typing import Dict, Mapping
 
 import numpy as np
 
@@ -18,12 +26,35 @@ def _np(x):
 
 def _allreduce(value, op="sum"):
     from ...collective import all_reduce, ReduceOp
-    from ....core.tensor import Tensor
     import jax.numpy as jnp
-    t = Tensor(jnp.asarray(value))
     ops = {"sum": ReduceOp.SUM, "max": ReduceOp.MAX, "min": ReduceOp.MIN}
-    out = all_reduce(t, op=ops[op])
+    out = all_reduce(jnp.asarray(value), op=ops[op])
     return np.asarray(getattr(out, "_data", out))
+
+
+def all_reduce_metrics(metrics: Mapping[str, float], op: str = "sum"
+                       ) -> Dict[str, float]:
+    """Reduce a whole dict of scalar metrics with ONE collective: values
+    pack into a single vector, reduce once, unpack by key.  Identity in a
+    single process; in multi-process runs the vector rides ONE
+    ``process_allgather`` (host-level — the eager device all_reduce is
+    unsupported cross-process) and reduces host-side.  Used by
+    ``telemetry.TrainMonitor.aggregate()`` for global throughput
+    (``op="sum"``) and straggler wall time (``op="max"``)."""
+    if not metrics:
+        return {}
+    keys = list(metrics)
+    vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
+    import jax
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        rows = np.asarray(multihost_utils.process_allgather(vec),
+                          np.float64).reshape(-1, len(keys))
+        red = {"sum": rows.sum(0), "max": rows.max(0),
+               "min": rows.min(0)}[op]
+        return {k: float(v) for k, v in zip(keys, red)}
+    out = np.asarray(_allreduce(vec, op), np.float64).reshape(-1)
+    return {k: float(v) for k, v in zip(keys, out)}
 
 
 def sum(input, scope=None, util=None):

@@ -29,7 +29,8 @@ __all__ = ["make_localsgd_train_step"]
 
 def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
                              optimizer, mesh: Mesh, k_steps: int = 4,
-                             axis: str = "data", donate: bool = True):
+                             axis: str = "data", donate: bool = True,
+                             monitor=None):
     """Build a LocalSGD step over the ``axis`` mesh axis.
 
     ``loss_of(params, *batch) -> scalar``; ``batch`` leading dim is the
@@ -110,4 +111,5 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
         return _compiled(len(batch))(state, jnp.asarray(lr, jnp.float32),
                                      *batch)
 
-    return step, state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "localsgd"), state0

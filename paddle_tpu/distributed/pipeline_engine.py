@@ -69,19 +69,21 @@ def deinterleave_layers(x, n_stages: int, n_chunks: int):
 
 
 def make_pipeline_train_step(pipeline_layer, loss_fn, optimizer, hcg,
-                             accumulate_steps: int = 1):
+                             accumulate_steps: int = 1, monitor=None):
     """Generic fallback: GSPMD step over the hybrid mesh with stage-placed
     parameters (see module docstring, tier 2)."""
     from .spmd import make_spmd_train_step
     return make_spmd_train_step(pipeline_layer, loss_fn, optimizer, hcg,
-                                accumulate_steps=accumulate_steps)[:2]
+                                accumulate_steps=accumulate_steps,
+                                monitor=monitor)[:2]
 
 
 def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
                                head_loss_fn: Callable, params0, optimizer, hcg,
                                n_layers: int, n_microbatches: int,
                                stacked_keys, layer=None, donate: bool = True,
-                               remat: bool = True, virtual_pp_degree: int = 1):
+                               remat: bool = True, virtual_pp_degree: int = 1,
+                               monitor=None):
     """Build the stacked-stage pipelined train step (tier 1).
 
     - embed_fn(params, x, key)        -> h            (replicated compute)
@@ -209,4 +211,5 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
         return jax.tree_util.tree_map(lambda x, s: jax.device_put(x, s), state,
                                       state_sh, is_leaf=lambda x: hasattr(x, "shape"))
 
-    return step, place(state0)
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "pipeline"), place(state0)

@@ -310,7 +310,8 @@ def spmd_pipeline_interleaved(stage_fn: Callable, chunk_params, microbatches,
 # --------------------------------------------------------------------------
 
 def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
-                         accumulate_steps: int = 1, donate: bool = True):
+                         accumulate_steps: int = 1, donate: bool = True,
+                         monitor=None):
     """GSPMD train step over the hybrid mesh (dp × sharding × model [+ sep]).
 
     ≙ §3.3 of the survey: what the reference achieves by rewriting the
@@ -373,7 +374,8 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
             new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
         return {"params": new_params, "opt": new_opt, "buffers": new_b}, loss
 
-    return step, place(state0), state_sh
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "spmd"), place(state0), state_sh
 
 
 def _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate):

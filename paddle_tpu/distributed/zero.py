@@ -89,13 +89,18 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                          backoff_factor: float = 0.5,
                          growth_factor: float = 2.0,
                          donate: bool = True,
-                         offload: bool = False):
+                         offload: bool = False,
+                         monitor=None):
     """Build the sharded train step.
 
     ``loss_of(params, *batch) -> scalar``.  Returns ``(step, state0)`` with
     ``step(state, lr, *batch) -> (state, loss)``.  state = {params, opt,
     master, scaler}; scaler = {scale, good_steps, found_inf} (found_inf from
     the LAST step, for GradScaler-style inspection).
+
+    ``monitor``: optional ``telemetry.TrainMonitor`` — wraps the returned
+    step with host-side timing outside the jit boundary (compiled program
+    identical either way; ``None`` returns the bare step).
 
     ``offload=True`` (≙ sharding_configs offload) routes through
     ``make_zero_offload_train_step``: optimizer slots + masters in host
@@ -110,7 +115,8 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                 "skip-update semantics)")
         return make_zero_offload_train_step(
             loss_of, params0, optimizer, mesh, layer=layer,
-            zero_stage=zero_stage, master_weights=master_weights)
+            zero_stage=zero_stage, master_weights=master_weights,
+            monitor=monitor)
     if master_weights is None:
         master_weights = any(p.dtype in _HALF_DTYPES
                              for p in jax.tree_util.tree_leaves(params0))
@@ -214,13 +220,15 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
         is_leaf=lambda x: hasattr(x, "shape"))
-    return step, state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "zero"), state0
 
 
 def make_zero_offload_train_step(loss_of: Callable, params0: Dict[str, Any],
                                  optimizer, mesh: Mesh, layer=None,
                                  zero_stage: int = 1,
-                                 master_weights: Optional[bool] = None):
+                                 master_weights: Optional[bool] = None,
+                                 monitor=None):
     """CPU-offload variant (≙ reference sharding_configs ``offload=True`` /
     DygraphShardingOptimizer offload): optimizer slots + fp32 masters live in
     HOST memory; each step ships fp32 grads host-ward, runs the update on the
@@ -306,7 +314,8 @@ def make_zero_offload_train_step(loss_of: Callable, params0: Dict[str, Any],
         }
         return new_state, loss
 
-    return step, state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(step, monitor, "zero_offload"), state0
 
 
 def per_device_state_bytes(state) -> int:

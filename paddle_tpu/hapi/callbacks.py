@@ -264,6 +264,90 @@ class VisualDL(Callback):
                     f.write(f"{self._step}\t{k}\t{v}\n")
 
 
+class TelemetryCallback(Callback):
+    """Training telemetry for ``Model.fit`` — attaches a
+    ``paddle_tpu.telemetry.TrainMonitor`` to the model so every train batch
+    records host wall / device-blocked time, examples/sec, tokens/sec, and
+    the numerics watchdog rides the loss values ``fit`` already fetches at
+    ``log_freq`` (no extra device syncs).  While training runs the monitor
+    is also installed process-wide (``telemetry.set_active_monitor``) so
+    AMP GradScaler found_inf/scale events and ``Profiler.step`` timings
+    land in the same trace.
+
+    ``hbm_every=N`` takes a live-array HBM census every N epochs (0 = only
+    at train end); ``jsonl_path``/``chrome_path`` dump the event trace at
+    train end (the JSONL merges into a device trace via
+    ``tools/trace_to_chrome.py --engine-trace``); ``aggregate_on_end``
+    (default: only when world>1) all-reduces the step counters across
+    hosts and emits the global-throughput/straggler event.
+    Without this callback, ``Model`` pays one attribute check per step.
+    """
+
+    def __init__(self, monitor=None, hbm_every: int = 0,
+                 jsonl_path: Optional[str] = None,
+                 chrome_path: Optional[str] = None,
+                 aggregate_on_end: Optional[bool] = None):
+        super().__init__()
+        if monitor is None:
+            from ..telemetry import TrainMonitor
+            monitor = TrainMonitor()
+        self.monitor = monitor
+        self.hbm_every = int(hbm_every)
+        self.jsonl_path = jsonl_path
+        self.chrome_path = chrome_path
+        self.aggregate_on_end = aggregate_on_end
+        self.last_aggregate = None
+        self._prev_active = None
+
+    def set_model(self, model):
+        super().set_model(model)
+        model._monitor = self.monitor
+
+    def _census(self):
+        state = getattr(self.model, "_state", None) or {}
+        self.monitor.hbm_census(params=state.get("params"),
+                                opt=state.get("opt"))
+
+    def on_train_begin(self, logs=None):
+        from ..telemetry import set_active_monitor
+        self._prev_active = set_active_monitor(self.monitor)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.hbm_every and (epoch + 1) % self.hbm_every == 0:
+            self._census()
+
+    def on_train_end(self, logs=None):
+        import logging
+        from ..telemetry import set_active_monitor
+        log = logging.getLogger(__name__)
+        try:
+            self._census()
+            agg = self.aggregate_on_end
+            if agg is None:
+                from ..distributed import env
+                agg = env.get_world_size() > 1
+            if agg:
+                try:
+                    self.last_aggregate = self.monitor.aggregate()
+                except RuntimeError as e:
+                    # eager cross-process collectives are unsupported on
+                    # some topologies (collective.py all_reduce contract) —
+                    # telemetry must never abort a finished training run
+                    log.warning("telemetry aggregation skipped: %s", e)
+            if self.jsonl_path:
+                self.monitor.dump_jsonl(self.jsonl_path)
+            if self.chrome_path:
+                self.monitor.write_chrome_trace(self.chrome_path)
+        finally:
+            # symmetric teardown even if a census/dump raised: restore the
+            # process-wide monitor and detach from the model so a later
+            # fit() WITHOUT this callback is back to one attr check
+            set_active_monitor(self._prev_active)
+            if self.model is not None \
+                    and getattr(self.model, "_monitor", None) is self.monitor:
+                self.model._monitor = None
+
+
 class ReduceLROnPlateau(Callback):
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):

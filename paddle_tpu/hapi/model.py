@@ -11,6 +11,7 @@ per-op overhead.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional
 
 import jax
@@ -35,6 +36,9 @@ class Model:
         self._eval_step = None
         self._state = None
         self.stop_training = False
+        # telemetry.TrainMonitor attached by hapi.callbacks.TelemetryCallback
+        # (or set directly); None keeps the hot path at ONE attribute check
+        self._monitor = None
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
@@ -118,6 +122,7 @@ class Model:
         host is their contract; with no metrics the step chain stays fully
         async (the round-1 fit loop synced every batch, serializing device
         and host — reference streams at log_freq via callbacks)."""
+        first_call = self._train_step is None
         self._ensure_train_step()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels = labels if labels is None or isinstance(labels, (list, tuple)) else [labels]
@@ -125,7 +130,23 @@ class Model:
         raw_lab = unwrap_tree(list(labels)) if labels is not None else []
         key = rng.next_key()
         lr = np.float32(self._optimizer.get_lr())
+        mon = self._monitor           # the one telemetry check per step
+        t0 = time.perf_counter() if mon is not None else 0.0
         self._state, (loss, out) = self._train_step(self._state, key, lr, raw_in, raw_lab)
+        if mon is not None:
+            wall = time.perf_counter() - t0
+            if first_call:
+                # jit traces+compiles inside the first dispatch — record it
+                # as the compile event (first-dispatch wall; execution stays
+                # async, no block added), keeping step percentiles steady-
+                # state like instrument_train_step's convention
+                mon.record_compile(("hapi_step",), wall)
+            else:
+                lead = getattr(raw_in[0], "shape", (0,)) if raw_in else (0,)
+                mon.record_step(wall, trainer="hapi",
+                                examples=int(lead[0]) if lead else 0,
+                                tokens=int(lead[0] * lead[1])
+                                if len(lead) == 2 else 0)
         self._optimizer._step_count += 1
         for m in self._metrics:
             m.update(m.compute(Tensor(out), *[Tensor(l) for l in raw_lab]),
@@ -133,7 +154,13 @@ class Model:
         return loss
 
     def train_batch(self, inputs, labels=None, update=True):
-        return [float(np.asarray(self._train_batch_device(inputs, labels)))]
+        loss_dev = self._train_batch_device(inputs, labels)
+        t0 = time.perf_counter()
+        val = float(np.asarray(loss_dev))
+        mon = self._monitor
+        if mon is not None:     # watchdog rides the fetch that just happened
+            mon.record_sync(time.perf_counter() - t0, loss=val)
+        return [val]
 
     def eval_batch(self, inputs, labels=None):
         self._ensure_eval_step()
@@ -197,47 +224,66 @@ class Model:
         self.stop_training = False
         cbks.on_begin("train")
         it = 0
-        for epoch in range(epochs):
-            cbks.on_epoch_begin(epoch)
-            for m in self._metrics:
-                m.reset()
-            logs = {}
-            loss_dev, loss_val = None, None
-            train_iter = iter(train_loader)
-            try:
-                for step, batch in enumerate(train_iter):
-                    cbks.on_batch_begin("train", step)
-                    inputs, labels = self._split_batch(batch)
-                    loss_dev = self._train_batch_device(inputs, labels)
-                    # host sync only at log_freq cadence — between log points
-                    # the step chain stays async on device (loss in logs is
-                    # the value at the last sync point, like the reference's
-                    # streamed logs)
-                    if step % log_freq == 0 or (num_iters is not None and
-                                                it + 1 >= num_iters):
-                        loss_val = float(np.asarray(loss_dev))
-                    logs = {"loss": loss_val}
-                    for m in self._metrics:
-                        logs[self._m_name(m)] = m.accumulate()
-                    logs["lr"] = self._optimizer.get_lr()
-                    cbks.on_batch_end("train", step, logs)
-                    it += 1
-                    if num_iters is not None and it >= num_iters:
-                        self.stop_training = True
-                        break
-            finally:
-                close = getattr(train_iter, "close", None)
-                if close is not None:  # release mp workers on early break
-                    close()
-            if loss_dev is not None:  # epoch-end logs carry the true last loss
-                logs["loss"] = float(np.asarray(loss_dev))
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=verbose,
-                                          callbacks=cbks)
-                cbks._call("on_eval_end", eval_logs)
-            if self.stop_training:
-                break
+        try:
+            for epoch in range(epochs):
+                cbks.on_epoch_begin(epoch)
+                for m in self._metrics:
+                    m.reset()
+                logs = {}
+                loss_dev, loss_val = None, None
+                train_iter = iter(train_loader)
+                try:
+                    for step, batch in enumerate(train_iter):
+                        cbks.on_batch_begin("train", step)
+                        inputs, labels = self._split_batch(batch)
+                        loss_dev = self._train_batch_device(inputs, labels)
+                        # host sync only at log_freq cadence — between log points
+                        # the step chain stays async on device (loss in logs is
+                        # the value at the last sync point, like the reference's
+                        # streamed logs)
+                        if step % log_freq == 0 or (num_iters is not None and
+                                                    it + 1 >= num_iters):
+                            t_sync = time.perf_counter()
+                            loss_val = float(np.asarray(loss_dev))
+                            mon = self._monitor
+                            if mon is not None:   # device-blocked wait + watchdog
+                                mon.record_sync(time.perf_counter() - t_sync,
+                                                loss=loss_val)
+                        logs = {"loss": loss_val}
+                        for m in self._metrics:
+                            logs[self._m_name(m)] = m.accumulate()
+                        logs["lr"] = self._optimizer.get_lr()
+                        cbks.on_batch_end("train", step, logs)
+                        it += 1
+                        if num_iters is not None and it >= num_iters:
+                            self.stop_training = True
+                            break
+                finally:
+                    close = getattr(train_iter, "close", None)
+                    if close is not None:  # release mp workers on early break
+                        close()
+                if loss_dev is not None:  # epoch-end logs carry the true last loss
+                    logs["loss"] = float(np.asarray(loss_dev))
+                cbks.on_epoch_end(epoch, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=verbose,
+                                              callbacks=cbks)
+                    cbks._call("on_eval_end", eval_logs)
+                if self.stop_training:
+                    break
+        finally:
+            mon = self._monitor
+            if mon is not None:
+                # whether training finished or raised, a callback-managed
+                # monitor (it is the process-wide active one) must not leak
+                # into later fits or the active slot; a raise skips
+                # TelemetryCallback.on_train_end entirely, so this is the
+                # only guaranteed teardown.  A manually-attached monitor
+                # (never installed as active) is left alone.
+                from ..telemetry import current_monitor, set_active_monitor
+                if current_monitor() is mon:
+                    set_active_monitor(None)
+                    self._monitor = None
         cbks.on_end("train", logs)
         self._sync_back()
         return self

@@ -144,7 +144,7 @@ def _maybe_skip_update(optimizer, grads, state, lr, found_inf):
 
 
 def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
-                    trace_ctx=None, scaler_cfg=None):
+                    trace_ctx=None, scaler_cfg=None, monitor=None):
     """Build a jit-compiled train step closure over (layer, loss, optimizer).
 
     Returns ``(step, state0)`` where
@@ -158,6 +158,10 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
     traces lazily at the first call) — e.g. amp.auto_cast.
     ``scaler_cfg``: optional dict of GradScaler knobs enabling in-step
     dynamic loss scaling (fp16 AMP; bf16 does not need one).
+    ``monitor``: optional ``telemetry.TrainMonitor``; wraps the step with
+    host-side timing OUTSIDE the jit boundary — the compiled program (and
+    its cache key) is identical with or without one, and ``monitor=None``
+    returns the bare step.
     """
     apply_fn, params0, buffers0 = functionalize(layer)
     opt_state0 = optimizer.init_state(params0)
@@ -176,11 +180,13 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
         return {"params": new_params, "opt": new_opt, "buffers": new_b,
                 **scaler_state}, (loss, out)
 
-    return _tracks_compiled_calls(step), state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(_tracks_compiled_calls(step), monitor,
+                                 "train_step"), state0
 
 
 def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
-                          donate: bool = True, trace_ctx=None):
+                          donate: bool = True, trace_ctx=None, monitor=None):
     """Gradient-accumulating train step (≙ GradientMergeOptimizer,
     fluid/optimizer.py:6783): grads from ``accum_steps`` consecutive calls
     are summed in the TrainState; the optimizer applies their mean on every
@@ -214,7 +220,9 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
                      "acc": acc_out, "acc_count": cnt_out}
         return new_state, (loss, out)
 
-    return _tracks_compiled_calls(step), state0
+    from ..telemetry import instrument_train_step
+    return instrument_train_step(_tracks_compiled_calls(step), monitor,
+                                 "accum_train_step"), state0
 
 
 def make_eval_step(layer, loss_fn=None):

@@ -414,7 +414,8 @@ def gpt_preset(name: str, **overrides) -> GPTConfig:
 def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1,
                         remat: bool = True, donate: bool = True,
                         zero_stage: int = 0, dynamic_loss_scale: bool = False,
-                        virtual_pp_degree: Optional[int] = None):
+                        virtual_pp_degree: Optional[int] = None,
+                        monitor=None):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
@@ -422,6 +423,9 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     zero_stage>0 routes through the contractual ZeRO step (distributed/zero.py:
     grad reduce-scatter at stage 2, sharded params at stage 3, fp32 masters +
     found_inf + dynamic loss scaling — ≙ sharding_optimizer.py:45 semantics).
+    ``monitor``: optional ``telemetry.TrainMonitor``, forwarded to the
+    underlying builder (pipeline/zero) or wrapped around the GSPMD step —
+    pure host-side timing, compiled programs identical either way.
     """
     from ..distributed.pipeline_engine import make_stacked_pipeline_step
     from ..distributed.spmd import make_gspmd_step_from_loss
@@ -452,7 +456,8 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
             model.embed_fn, model.block_fn, model.head_loss_fn, params0,
             optimizer, hcg, model.config.num_layers,
             max(n_microbatches, S), model.stacked_param_names(), layer=model,
-            donate=donate, remat=remat, virtual_pp_degree=virtual_pp_degree)
+            donate=donate, remat=remat, virtual_pp_degree=virtual_pp_degree,
+            monitor=monitor)
 
     seq_spec = None
     if "sep" in mesh.shape and mesh.shape["sep"] > 1:
@@ -472,10 +477,12 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         inner_step, state0 = make_zero_train_step(
             loss_of, params0, optimizer, mesh, layer=model,
             zero_stage=zero_stage, dynamic_loss_scale=dynamic_loss_scale,
-            donate=donate)
+            donate=donate, monitor=monitor)
     else:
+        from ..telemetry import instrument_train_step
         inner_step, state0 = make_gspmd_step_from_loss(
             loss_of, params0, optimizer, mesh, layer=model, donate=donate)
+        inner_step = instrument_train_step(inner_step, monitor, "gpt")
 
     def step(state, key, lr, x, labels):
         return inner_step(state, lr, key, x, labels)
@@ -485,7 +492,8 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
 
 def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
                                 zero_stage: int = 0, seed: int = 0,
-                                remat=True, donate: bool = True):
+                                remat=True, donate: bool = True,
+                                monitor=None):
     """GPT train step whose parameters are initialized DIRECTLY sharded on
     the mesh — no host-side full-size materialization (GPT-3 6.7B fp32
     params are ~27GB on host with eager init).  Non-pipeline meshes only;
@@ -526,6 +534,8 @@ def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
         h = meta_model.scan_blocks(params, h, key, remat=remat)
         return meta_model.head_loss_fn(params, h, labels)
 
-    return make_gspmd_sharded_init_step(
+    from ..telemetry import instrument_train_step
+    step, state0 = make_gspmd_sharded_init_step(
         loss_of, build, optimizer, mesh, meta_model, zero_stage=zero_stage,
         donate=donate, seed=seed)
+    return instrument_train_step(step, monitor, "gpt_sharded"), state0

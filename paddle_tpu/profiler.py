@@ -141,6 +141,7 @@ class Profiler:
         self.timer_only = timer_only
         self.on_trace_ready = on_trace_ready
         self._step_times = []
+        self._step_samples = []
         self._last = None
 
     def start(self):
@@ -150,9 +151,22 @@ class Profiler:
         return self
 
     def step(self, num_samples: Optional[int] = None):
+        """Mark a step boundary.  ``num_samples`` (the reference's
+        benchmark-hint arg) is recorded — ``step_info()`` reports items/sec
+        over the timed spans.  When a ``telemetry.TrainMonitor`` is active
+        (``set_active_monitor`` / ``TelemetryCallback``) the step timing is
+        ALSO routed there, so profiler-paced loops land in the same
+        trace/summary as instrumented train steps."""
         now = time.perf_counter()
         if self._last is not None:
-            self._step_times.append(now - self._last)
+            dt = now - self._last
+            self._step_times.append(dt)
+            self._step_samples.append(0 if num_samples is None
+                                      else int(num_samples))
+            from .telemetry import current_monitor
+            mon = current_monitor()
+            if mon is not None:
+                mon.record_profiler_step(dt, samples=int(num_samples or 0))
         self._last = now
 
     def stop(self):
@@ -166,9 +180,13 @@ class Profiler:
             return "no steps recorded"
         import numpy as np
         ts = np.asarray(self._step_times)
-        return (f"steps={len(ts)} avg={ts.mean()*1e3:.2f}ms "
+        info = (f"steps={len(ts)} avg={ts.mean()*1e3:.2f}ms "
                 f"p50={np.percentile(ts,50)*1e3:.2f}ms "
                 f"p99={np.percentile(ts,99)*1e3:.2f}ms")
+        samples = sum(self._step_samples)
+        if samples:
+            info += f" ips={samples / ts.sum():.2f}"
+        return info
 
     def __enter__(self):
         return self.start()

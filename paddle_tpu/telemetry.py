@@ -53,6 +53,26 @@ completed tick is counted as a *post-warmup* recompile; once
 (the recompile-storm dial that has repeatedly eaten bench rounds —
 HEALTH.log).
 
+Training side (``TrainMonitor``, built on the same ring-buffer Tracer —
+the Paddle-profiler/fleet-metrics role for the TRAIN loop):
+
+``train_step``  one optimizer step: ``trainer`` (builder name), ``dur_s``
+                (host dispatch wall — the step chain is async), ``step``,
+                ``examples``/``tokens``.
+``sync``        one host↔device synchronization (the loss fetch): ``dur_s``
+                is the device-blocked host wait, ``loss`` the fetched value
+                — the numerics watchdog piggybacks HERE, on the value that
+                was being fetched anyway (no extra device syncs).
+``watchdog``    a numerics alarm: ``what`` in ``non_finite`` (NaN/Inf
+                loss) / ``loss_spike`` (loss > spike_factor × its EMA).
+``amp``         a GradScaler event: ``what`` in ``found_inf`` /
+                ``scale_change``, with the current ``scale``.
+``hbm``         one live-array census: byte counts split
+                params / opt-state / other, with peak gauges.
+``aggregate``   one cross-host reduction of the step counters
+                (``fleet.metrics.all_reduce_metrics`` — global throughput
+                + per-replica straggler skew).
+
 No single reference counterpart: this is the serving-shaped composition of
 the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
 ``monitor.h`` StatRegistry, and ``tools/timeline.py`` chrome-trace export.
@@ -61,17 +81,19 @@ the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
 from __future__ import annotations
 
 import collections
+import functools
 import json
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
                           prometheus_text as _stats_prometheus_text)
 
-__all__ = ["Tracer", "RequestTimeline", "program_label",
-           "chrome_trace_from_jsonl"]
+__all__ = ["Tracer", "RequestTimeline", "TrainMonitor", "program_label",
+           "chrome_trace_from_jsonl", "instrument_train_step",
+           "set_active_monitor", "current_monitor"]
 
 _PCTS = (50.0, 95.0, 99.0)
 
@@ -419,7 +441,414 @@ class Tracer:
         return _stats_prometheus_text(self.registry, namespace=namespace)
 
 
+# --------------------------------------------------------------------------
+# training-side instrumentation
+# --------------------------------------------------------------------------
+
+_active_monitor: Optional["TrainMonitor"] = None
+
+
+def set_active_monitor(monitor: Optional["TrainMonitor"]
+                       ) -> Optional["TrainMonitor"]:
+    """Install the process-wide active TrainMonitor (or None) and return the
+    previous one.  Consumers that cannot be threaded a handle — GradScaler's
+    eager path, ``Profiler.step`` — report through this; everything else
+    takes an explicit ``monitor=``."""
+    global _active_monitor
+    prev = _active_monitor
+    _active_monitor = monitor
+    return prev
+
+
+def current_monitor() -> Optional["TrainMonitor"]:
+    return _active_monitor
+
+
+class TrainMonitor:
+    """Training-side instrumentation layer over the ring-buffer ``Tracer``.
+
+    One monitor observes ONE training run: per-step host wall vs
+    device-blocked time, throughput counters, compile events, a numerics
+    watchdog (NaN/Inf + loss-spike, fed from loss values the caller was
+    fetching anyway), AMP loss-scale events, a live-array HBM census, and
+    cross-host aggregation of the step counters.  It shares the Tracer's
+    zero-cost-off contract: every producer guards on ``monitor is None`` /
+    ``current_monitor() is None`` — a single attribute/None check — and the
+    monitor never adds operands to a compiled program.
+
+    Exports ride the underlying tracer: ``dump_jsonl`` / ``to_chrome_trace``
+    (merged by ``tools/trace_to_chrome.py --engine-trace``) /
+    ``prometheus_text`` (namespace ``paddle_tpu_train``) / ``summary()``
+    (the bench attachment).
+    """
+
+    def __init__(self, tracer: Optional[Tracer] = None, capacity: int = 4096,
+                 spike_factor: float = 10.0, spike_min_steps: int = 5,
+                 ema_decay: float = 0.9,
+                 logger: Optional[logging.Logger] = None):
+        self.tracer = tracer if tracer is not None else Tracer(
+            capacity=capacity, logger=logger)
+        self.registry = self.tracer.registry
+        self.spike_factor = float(spike_factor)
+        self.spike_min_steps = int(spike_min_steps)
+        self.ema_decay = float(ema_decay)
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._step_idx = 0
+        self._loss_ema: Optional[float] = None
+        self._loss_n = 0
+        self.last_loss: Optional[float] = None
+        self._last_scale: Optional[float] = None
+        self._warned_non_finite = False
+        self.registry.histogram("step_seconds", DEFAULT_TIME_BUCKETS)
+        self.registry.histogram("device_blocked_seconds",
+                                DEFAULT_TIME_BUCKETS)
+        # bucketize compile attribution baseline (jit/bucketing.py bumps the
+        # GLOBAL stat; summary() reports the delta over this run)
+        from .utils.stats import get_stat
+        self._bucket_compiles0 = int(get_stat("bucketize_bucket_compiles"))
+
+    # --------------------------------------------------------- lifecycle --
+    def activate(self) -> "TrainMonitor":
+        """Install as the process-wide active monitor (GradScaler/Profiler
+        routing).  Also usable as a context manager."""
+        self._prev_active = set_active_monitor(self)
+        return self
+
+    def deactivate(self):
+        set_active_monitor(getattr(self, "_prev_active", None))
+
+    __enter__ = activate
+
+    def __exit__(self, *exc):
+        self.deactivate()
+        return False
+
+    # ------------------------------------------------------------ ingest --
+    def record_step(self, wall_s: float, trainer: str = "train",
+                    examples: int = 0, tokens: int = 0,
+                    loss: Optional[float] = None, **fields):
+        """One train step.  ``wall_s`` is HOST dispatch wall (the chain is
+        async — device-blocked time is what ``record_sync`` measures);
+        ``loss``, when given, must already be a host scalar (never fetch
+        one just to pass it here — that would add the sync this layer is
+        contractually not allowed to add)."""
+        reg = self.registry
+        reg.add("train_steps")
+        if examples:
+            reg.add("train_examples", int(examples))
+        if tokens:
+            reg.add("train_tokens", int(tokens))
+        reg.observe("step_seconds", wall_s)
+        self._step_idx += 1
+        ev = self.tracer.emit("train_step", trainer=trainer,
+                              step=self._step_idx, dur_s=wall_s,
+                              examples=int(examples), tokens=int(tokens),
+                              **fields)
+        if loss is not None:
+            self.observe_loss(loss)
+        return ev
+
+    def record_sync(self, wall_s: float, loss: Optional[float] = None):
+        """One host↔device synchronization (typically the log-cadence loss
+        fetch): ``wall_s`` is the blocked host wait; the fetched ``loss``
+        feeds the numerics watchdog for free."""
+        self.registry.add("train_syncs")
+        self.registry.observe("device_blocked_seconds", wall_s)
+        ev = self.tracer.emit("sync", dur_s=wall_s,
+                              **({} if loss is None else {"loss": float(loss)}))
+        if loss is not None:
+            self.observe_loss(loss)
+        return ev
+
+    def record_profiler_step(self, wall_s: float, samples: int = 0):
+        """One ``Profiler.step`` span.  Kept on SEPARATE counters/kind
+        (``profiler_steps``/``profiler_step_seconds``/``profiler_step``
+        events) so a loop that is both monitor-instrumented and
+        profiler-paced never double-counts into ``train_steps`` or the
+        step-wall percentiles."""
+        self.registry.add("profiler_steps")
+        if samples:
+            self.registry.add("profiler_samples", int(samples))
+        self.registry.observe("profiler_step_seconds", wall_s)
+        return self.tracer.emit("profiler_step", dur_s=wall_s,
+                                examples=int(samples))
+
+    def record_compile(self, key, wall_s: float):
+        """One compiled-program build paid by the training loop (first call
+        of an instrumented step, a bucketize miss, an AOT compile)."""
+        return self.tracer.compile_event("train", key, False, wall_s)
+
+    # ---------------------------------------------------------- watchdog --
+    def observe_loss(self, loss) -> Optional[str]:
+        """Numerics watchdog over an already-fetched host loss scalar.
+        Returns the alarm kind (``non_finite``/``loss_spike``) or None.
+        NaN/Inf logs ONE warning per monitor (the storm-dial convention);
+        spikes never fold into the EMA, so a plateau shift re-fires until
+        the caller intervenes."""
+        loss = float(loss)
+        self.last_loss = loss
+        if loss != loss or loss in (float("inf"), float("-inf")):
+            self.registry.add("watchdog_non_finite")
+            self.tracer.emit("watchdog", what="non_finite", loss=loss,
+                             step=self._step_idx)
+            if not self._warned_non_finite:
+                self._warned_non_finite = True
+                self._log.warning(
+                    "numerics watchdog: non-finite loss (%r) at step %d",
+                    loss, self._step_idx)
+            return "non_finite"
+        ema = self._loss_ema
+        if (ema is not None and self._loss_n >= self.spike_min_steps
+                and abs(loss) > self.spike_factor * max(abs(ema), 1e-12)):
+            self.registry.add("watchdog_loss_spikes")
+            self.tracer.emit("watchdog", what="loss_spike", loss=loss,
+                             ema=ema, step=self._step_idx)
+            return "loss_spike"
+        self._loss_ema = loss if ema is None \
+            else self.ema_decay * ema + (1.0 - self.ema_decay) * loss
+        self._loss_n += 1
+        return None
+
+    def observe_scaler(self, scale, found_inf: bool = False):
+        """AMP GradScaler event feed: ``found_inf`` steps and loss-scale
+        changes become ``amp`` events (both host values — the scaler's
+        eager path has them; the functional path reads them only at its
+        own sync points)."""
+        scale = float(scale)
+        if found_inf:
+            self.registry.add("amp_found_inf")
+            self.tracer.emit("amp", what="found_inf", scale=scale,
+                             step=self._step_idx)
+        if self._last_scale is not None and scale != self._last_scale:
+            self.registry.add("amp_scale_changes")
+            self.tracer.emit("amp", what="scale_change", scale=scale,
+                             prev_scale=self._last_scale,
+                             step=self._step_idx)
+        self._last_scale = scale
+
+    # -------------------------------------------------------- HBM census --
+    def hbm_census(self, params=None, opt=None) -> Dict[str, int]:
+        """Live-array byte census: every ``jax.live_arrays()`` entry is
+        classified param / opt-state / other by identity against the passed
+        pytrees (logical bytes — size × itemsize; sharded arrays count
+        their global shape).  Gauges land on the registry with
+        ``set_max``-tracked peaks; returns the census dict."""
+        import jax
+        import numpy as np
+
+        def _ids(tree):
+            return {id(l) for l in jax.tree_util.tree_leaves(tree)
+                    if hasattr(l, "dtype")}
+
+        pid, oid = _ids(params), _ids(opt)
+        counts = {"params_bytes": 0, "opt_bytes": 0, "other_bytes": 0}
+        n_arrays = 0
+        for a in jax.live_arrays():
+            if getattr(a, "is_deleted", lambda: False)():
+                continue
+            n_arrays += 1
+            b = int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize \
+                if a.shape else np.dtype(a.dtype).itemsize
+            if id(a) in pid:
+                counts["params_bytes"] += b
+            elif id(a) in oid:
+                counts["opt_bytes"] += b
+            else:
+                counts["other_bytes"] += b
+        total = sum(counts.values())
+        reg = self.registry
+        for k, v in counts.items():
+            reg.set(f"hbm_{k}", v)
+        reg.set("hbm_live_bytes", total)
+        reg.set("hbm_live_arrays", n_arrays)
+        reg.set_max("hbm_peak_bytes", total)   # the ONE high-water source
+        census = dict(counts, total_bytes=total, arrays=n_arrays,
+                      peak_bytes=int(reg.value("hbm_peak_bytes")))
+        self.tracer.emit("hbm", step=self._step_idx, **census)
+        return census
+
+    # ------------------------------------------------------- aggregation --
+    def aggregate(self) -> Dict[str, Any]:
+        """Cross-host reduction of the step counters (ONE batched
+        collective per reduction op via ``fleet.metrics
+        .all_reduce_metrics``): global examples/tokens per second over the
+        slowest replica's wall, plus per-replica straggler skew (max
+        replica step-wall over the mean).  Identity in a single process
+        (skew 1.0)."""
+        from .distributed import env
+        from .distributed.fleet.metrics.metric import all_reduce_metrics
+
+        reg = self.registry
+        wall = float(reg.histogram("step_seconds").snapshot()["sum"])
+        local = {"steps": float(reg.value("train_steps")),
+                 "examples": float(reg.value("train_examples")),
+                 "tokens": float(reg.value("train_tokens")),
+                 "step_wall_s": wall}
+        sums = all_reduce_metrics(local, "sum")
+        maxs = all_reduce_metrics({"step_wall_s": wall}, "max")
+        world = max(int(env.get_world_size()), 1)
+        wall_max = maxs["step_wall_s"]
+        wall_mean = sums["step_wall_s"] / world
+        out = {
+            "world": world,
+            "steps": sums["steps"],
+            "examples": sums["examples"],
+            "tokens": sums["tokens"],
+            "global_examples_per_sec": (sums["examples"] / wall_max
+                                        if wall_max > 0 else None),
+            "global_tokens_per_sec": (sums["tokens"] / wall_max
+                                      if wall_max > 0 else None),
+            "straggler_skew": (wall_max / wall_mean
+                               if wall_mean > 0 else None),
+        }
+        self.tracer.emit("aggregate", **out)
+        return out
+
+    # ----------------------------------------------------------- queries --
+    def events(self, kind: Optional[str] = None):
+        return self.tracer.events(kind)
+
+    def summary(self) -> Dict[str, Any]:
+        """One JSON-able snapshot — what ``bench.py`` attaches to gpt
+        training BENCH rounds: step-wall percentiles, device-blocked
+        percentiles, throughput, compile counts, watchdog/AMP counters,
+        HBM peaks."""
+        from .utils.stats import get_stat
+        reg = self.registry
+        step_evs = self.events("train_step")
+        sync_evs = self.events("sync")
+        step_sum = float(reg.histogram("step_seconds").snapshot()["sum"])
+        sync_sum = float(
+            reg.histogram("device_blocked_seconds").snapshot()["sum"])
+        wall = step_sum + sync_sum
+        tokens = int(reg.value("train_tokens"))
+        examples = int(reg.value("train_examples"))
+        return {
+            "steps": int(reg.value("train_steps")),
+            "step_wall_s": _percentiles([e["dur_s"] for e in step_evs]),
+            "device_blocked_s": _percentiles(
+                [e["dur_s"] for e in sync_evs]),
+            "examples_per_sec": (examples / wall
+                                 if wall > 0 and examples else None),
+            "tokens_per_sec": (tokens / wall
+                               if wall > 0 and tokens else None),
+            "compile": {
+                "misses": int(reg.value("compile_misses")),
+                "hits": int(reg.value("compile_hits")),
+                "wall_s": float(reg.value("compile_wall_seconds_sum")),
+                "bucket_compiles": int(
+                    get_stat("bucketize_bucket_compiles"))
+                - self._bucket_compiles0,
+            },
+            "watchdog": {
+                "non_finite": int(reg.value("watchdog_non_finite")),
+                "loss_spikes": int(reg.value("watchdog_loss_spikes")),
+                "last_loss": self.last_loss,
+                "loss_ema": self._loss_ema,
+            },
+            "amp": {
+                "found_inf": int(reg.value("amp_found_inf")),
+                "scale_changes": int(reg.value("amp_scale_changes")),
+                "scale": self._last_scale,
+            },
+            "hbm": {
+                "peak_bytes": int(reg.value("hbm_peak_bytes")),
+                "params_bytes": int(reg.value("hbm_params_bytes")),
+                "opt_bytes": int(reg.value("hbm_opt_bytes")),
+                "other_bytes": int(reg.value("hbm_other_bytes")),
+            },
+            "events_dropped": self.tracer.events_dropped,
+        }
+
+    # ----------------------------------------------------------- exports --
+    def dump_jsonl(self, path: str) -> int:
+        return self.tracer.dump_jsonl(path)
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        return self.tracer.to_chrome_trace()
+
+    def write_chrome_trace(self, path: str):
+        self.tracer.write_chrome_trace(path)
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_train") -> str:
+        return self.tracer.prometheus_text(namespace=namespace)
+
+
+def _default_batch_info(args) -> Tuple[int, int]:
+    """(examples, tokens) heuristic for an instrumented step's call args:
+    the LARGEST array leaf among the non-state args is the input batch —
+    its leading dim is examples; for 2-D (token-id) inputs tokens is
+    batch × seq, otherwise 0 (an image batch has no token count)."""
+    import jax
+    best = None
+    for leaf in jax.tree_util.tree_leaves(args[1:]):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or len(shape) < 1:
+            continue
+        size = 1
+        for d in shape:
+            size *= int(d)
+        if best is None or size > best[0]:
+            best = (size, shape)
+    if best is None:
+        return 0, 0
+    shape = best[1]
+    examples = int(shape[0])
+    tokens = examples * int(shape[1]) if len(shape) == 2 else 0
+    return examples, tokens
+
+
+def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
+                          name: str = "train",
+                          batch_info: Optional[Callable] = None) -> Callable:
+    """Wrap a train-step callable with per-call TrainMonitor timing.
+
+    ``monitor=None`` returns ``step`` UNCHANGED — the builders' zero-cost-
+    off contract (no wrapper frame, no checks).  With a monitor, each call
+    times host dispatch wall; the FIRST call blocks until ready and is
+    recorded as this step's compile event ONLY (trace + XLA compile +
+    first run — the same convention as ``jit.bucketize``; it never
+    pollutes the step_seconds percentiles), so steady-state calls add NO
+    synchronization and ``train_steps`` counts post-warmup steps.  The
+    jit API surface (``lower`` /
+    ``eval_shape`` / ``trace`` / ``clear_cache``) passes through to the
+    SAME underlying program — cache keys and lowerings are identical with
+    telemetry on or off."""
+    if monitor is None:
+        return step
+    import jax
+    first = [True]
+
+    @functools.wraps(step)
+    def wrapped(*args, **kwargs):
+        t0 = time.perf_counter()
+        out = step(*args, **kwargs)
+        if first[0]:
+            # the first call pays trace + XLA compile inside its dispatch
+            # (jit blocks through compilation) — it becomes ONLY the compile
+            # event, never a train_step sample, so step percentiles and
+            # throughput measure steady state
+            first[0] = False
+            jax.block_until_ready(out)
+            monitor.record_compile((f"{name}_step",),
+                                   time.perf_counter() - t0)
+            return out
+        examples, tokens = (batch_info(args, kwargs)
+                            if batch_info is not None
+                            else _default_batch_info(args))
+        monitor.record_step(time.perf_counter() - t0, trainer=name,
+                            examples=examples, tokens=tokens)
+        return out
+
+    for attr in ("lower", "eval_shape", "trace", "clear_cache"):
+        if hasattr(step, attr):
+            setattr(wrapped, attr, getattr(step, attr))
+    return wrapped
+
+
 _PID = "paddle_tpu.serving"
+_TRAIN_PID = "paddle_tpu.train"
 
 
 def events_to_chrome(events: List[Dict[str, Any]],
@@ -431,6 +860,8 @@ def events_to_chrome(events: List[Dict[str, Any]],
     out: List[Dict[str, Any]] = [
         {"name": "process_name", "ph": "M", "pid": _PID,
          "args": {"name": _PID}},
+        {"name": "process_name", "ph": "M", "pid": _TRAIN_PID,
+         "args": {"name": _TRAIN_PID}},
     ]
     for ev in events:
         us = ev["ts"] * 1e6
@@ -453,6 +884,21 @@ def events_to_chrome(events: List[Dict[str, Any]],
             out.append({"name": ev.get("what", "?"), "cat": "request",
                         "ph": "i", "s": "t", "pid": _PID,
                         "tid": f"req:{ev.get('rid')}", "ts": us,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("kind", "ts")}})
+        elif ev["kind"] in ("train_step", "sync", "profiler_step"):
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "ts", "dur_s")}
+            dur = ev.get("dur_s", 0.0) * 1e6
+            out.append({"name": ev["kind"], "cat": "train", "ph": "X",
+                        "pid": _TRAIN_PID, "tid": ev["kind"],
+                        "ts": us - dur, "dur": dur, "args": args})
+        elif ev["kind"] in ("watchdog", "amp", "hbm", "aggregate"):
+            name = ev.get("what", ev["kind"])
+            out.append({"name": f"{ev['kind']}:{name}"
+                        if "what" in ev else ev["kind"],
+                        "cat": "train", "ph": "i", "s": "t",
+                        "pid": _TRAIN_PID, "tid": ev["kind"], "ts": us,
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("kind", "ts")}})
     for tl in timelines or []:

@@ -54,6 +54,12 @@ class _Stat:
             self.value = v
             self.kind = "gauge"
 
+    def set_max(self, v):
+        with self.lock:
+            if v > self.value:
+                self.value = v
+            self.kind = "gauge"
+
     def reset(self):
         with self.lock:
             self.value = 0
@@ -136,6 +142,10 @@ class StatRegistry:
         """Gauge write: the stat's current value becomes ``value`` and its
         exported type becomes gauge (non-monotonic)."""
         self.get(name).set(value)
+
+    def set_max(self, name: str, value):
+        """High-water gauge: keeps the max ever written (HBM peaks)."""
+        self.get(name).set_max(value)
 
     def value(self, name: str):
         return self.get(name).value

@@ -1,0 +1,490 @@
+"""Training telemetry (ISSUE 4): TrainMonitor over the ring-buffer Tracer,
+monitor= threading through the step builders and hapi, the numerics
+watchdog, HBM census, cross-host aggregation, and the satellites (fused
+GradScaler sync, all_reduce_metrics, Profiler.step items/sec).
+
+The tentpole contract under test: with telemetry DISABLED an instrumented
+train step produces the SAME lowering/cache key and adds at most one
+attribute check (the hapi hot path) — and with it enabled, every step
+becomes a structured event that round-trips through the frozen PR 2
+exports (JSONL, chrome trace, Prometheus)."""
+
+import json
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import amp, telemetry
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.optimizer import Adam, Momentum
+from paddle_tpu.telemetry import (TrainMonitor, chrome_trace_from_jsonl,
+                                  current_monitor, instrument_train_step,
+                                  set_active_monitor)
+
+
+def _tiny_step(monitor=None, donate=False, seed=0):
+    paddle.seed(seed)
+    layer = nn.Linear(4, 3)
+    step, state = make_train_step(layer, nn.MSELoss(),
+                                  Momentum(learning_rate=0.1, momentum=0.9),
+                                  donate=donate, monitor=monitor)
+    x = jnp.ones((8, 4))
+    y = jnp.zeros((8, 3))
+    return step, state, (jax.random.key(0), np.float32(0.1), [x], [y])
+
+
+class TestOffPathPurity:
+    def test_monitor_none_returns_bare_step(self):
+        """monitor=None adds NOTHING: instrument_train_step is an identity
+        (no wrapper frame, no per-step checks)."""
+        step, _, _ = _tiny_step()
+        assert instrument_train_step(step, None, "x") is step
+
+    def test_identical_lowering_with_and_without_monitor(self):
+        """THE acceptance assertion: the compiled program (and hence its
+        cache key) is byte-identical with telemetry on or off — the
+        monitor wraps OUTSIDE the jit boundary."""
+        step_off, st, rest = _tiny_step(seed=1)
+        step_on, _, _ = _tiny_step(monitor=TrainMonitor(), seed=1)
+        off = step_off.lower(st, *rest).as_text()
+        on = step_on.lower(st, *rest).as_text()
+        assert off == on
+
+    def test_fit_without_callback_never_touches_monitor(self, monkeypatch):
+        """Default Model.fit (no TelemetryCallback): every TrainMonitor
+        entry point is boobytrapped and a fit completes anyway — the hot
+        path is one attribute check against None."""
+        def boom(*a, **kw):
+            raise AssertionError("TrainMonitor touched with telemetry off")
+
+        for meth in ("record_step", "record_sync", "record_compile",
+                     "observe_loss", "observe_scaler", "hbm_census",
+                     "aggregate"):
+            monkeypatch.setattr(TrainMonitor, meth, boom)
+        from paddle_tpu.hapi import Model
+        paddle.seed(2)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        assert m._monitor is None
+        xs = np.ones((8, 4), "float32")
+        ys = np.zeros((8, 2), "float32")
+        m.fit([(xs, ys)], epochs=1, verbose=0)
+
+    def test_one_sync_only_on_first_call(self):
+        """The instrumented step blocks exactly once: the first call is the
+        compile event ONLY (it pays trace+XLA inside dispatch and must not
+        pollute step percentiles); steady-state steps stay async."""
+        mon = TrainMonitor()
+        step, st, rest = _tiny_step(monitor=mon)
+        for i in range(4):
+            st, _ = step(st, *rest)
+        comp = mon.events("compile")
+        assert len(comp) == 1 and comp[0]["wall_s"] > 0
+        assert mon.summary()["compile"]["misses"] == 1
+        steps = mon.events("train_step")
+        assert len(steps) == 3                 # 4 calls - 1 compile call
+        assert all(e["trainer"] == "train_step" for e in steps)
+        # steady-state dispatch is orders faster than the compile call —
+        # the percentiles must not have absorbed it
+        assert max(e["dur_s"] for e in steps) < comp[0]["wall_s"]
+        # batch heuristic: x (8, 4) is the largest leaf — lead dim examples
+        assert steps[0]["examples"] == 8
+
+
+class TestWatchdog:
+    def test_non_finite_fires_and_warns_once(self, caplog):
+        mon = TrainMonitor()
+        mon.observe_loss(1.0)
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.telemetry"):
+            assert mon.observe_loss(float("nan")) == "non_finite"
+            assert mon.observe_loss(float("inf")) == "non_finite"
+        warns = [r for r in caplog.records
+                 if "numerics watchdog" in r.getMessage()]
+        assert len(warns) == 1                     # storm-dial: warn ONCE
+        evs = mon.events("watchdog")
+        assert [e["what"] for e in evs] == ["non_finite", "non_finite"]
+        assert mon.summary()["watchdog"]["non_finite"] == 2
+
+    def test_loss_spike_vs_ema(self):
+        mon = TrainMonitor(spike_factor=10.0, spike_min_steps=5)
+        for _ in range(6):
+            assert mon.observe_loss(1.0) is None
+        assert mon.observe_loss(50.0) == "loss_spike"
+        ev = mon.events("watchdog")[-1]
+        assert ev["loss"] == 50.0 and abs(ev["ema"] - 1.0) < 1e-9
+        # the spike did NOT fold into the EMA: a second spike re-fires
+        assert mon.observe_loss(50.0) == "loss_spike"
+        assert mon.summary()["watchdog"]["loss_spikes"] == 2
+        # below min_steps no spike can fire
+        fresh = TrainMonitor(spike_min_steps=5)
+        fresh.observe_loss(1.0)
+        assert fresh.observe_loss(1000.0) is None
+
+    def test_watchdog_rides_fit_loss_fetch(self):
+        """An injected NaN batch surfaces as a watchdog event through the
+        normal fit log-freq loss fetch — no extra syncs were added to see
+        it."""
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.callbacks import TelemetryCallback
+        paddle.seed(4)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        xs = np.ones((8, 4), "float32")
+        bad = np.full((8, 4), np.nan, "float32")
+        ys = np.zeros((8, 2), "float32")
+        mon = TrainMonitor()
+        m.fit([(xs, ys), (bad, ys)], epochs=1, log_freq=1, verbose=0,
+              callbacks=[TelemetryCallback(monitor=mon)])
+        assert any(e["what"] == "non_finite" for e in mon.events("watchdog"))
+
+
+class TestHBMCensus:
+    def test_byte_accounting_split(self):
+        mon = TrainMonitor()
+        params = {"w": jnp.ones((8, 4), jnp.float32)}          # 128 B
+        opt = {"m": jnp.zeros((8, 4), jnp.float32),            # 128 B
+               "v": jnp.zeros((4,), jnp.float32)}              # 16 B
+        census = mon.hbm_census(params=params, opt=opt)
+        assert census["params_bytes"] == 128
+        assert census["opt_bytes"] == 144
+        assert census["total_bytes"] >= 272
+        assert census["peak_bytes"] == census["total_bytes"]
+        # gauges + set_max peak land on the registry (Prometheus-visible)
+        assert mon.registry.value("hbm_params_bytes") == 128
+        assert mon.registry.value("hbm_peak_bytes") >= 272
+        text = mon.prometheus_text()
+        assert "# TYPE paddle_tpu_train_hbm_peak_bytes gauge" in text
+        # peak is a high-water mark: a smaller second census keeps it
+        del params["w"]
+        c2 = mon.hbm_census(params=params, opt=opt)
+        assert c2["peak_bytes"] >= c2["total_bytes"]
+        assert mon.events("hbm")
+
+
+class TestAggregation:
+    def test_single_process_identity_and_skew(self):
+        mon = TrainMonitor()
+        for _ in range(3):
+            mon.record_step(0.01, examples=8, tokens=64)
+        agg = mon.aggregate()
+        assert agg["world"] == 1
+        assert agg["steps"] == 3.0
+        assert agg["tokens"] == 192.0
+        assert agg["straggler_skew"] == pytest.approx(1.0)
+        assert agg["global_tokens_per_sec"] > 0
+        assert mon.events("aggregate")
+
+    def test_all_reduce_metrics_one_collective(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.metrics import metric
+        calls = []
+        orig = metric._allreduce
+
+        def counting(value, op="sum"):
+            calls.append(op)
+            return orig(value, op)
+
+        monkeypatch.setattr(metric, "_allreduce", counting)
+        d = {"a": 1.0, "b": 2.5, "c": -3.0}
+        out = metric.all_reduce_metrics(d, "sum")
+        assert out == d                            # identity in one process
+        assert calls == ["sum"]                    # ONE collective
+        assert metric.all_reduce_metrics({}) == {}
+        assert len(calls) == 1                     # empty dict: no collective
+
+    def test_fleet_metric_functions_still_work(self):
+        from paddle_tpu.distributed import fleet
+        assert fleet.metrics.sum(np.array([1.0, 2.0])) == 3.0
+        assert fleet.metrics.max(np.array([1.0, 5.0])) == 5.0
+        assert fleet.metrics.all_reduce_metrics({"x": 2.0})["x"] == 2.0
+
+
+class TestAmpScaler:
+    def _fake_opt(self, grads):
+        from paddle_tpu.core.tensor import Parameter
+        ps = []
+        for g in grads:
+            p = Parameter(jnp.zeros_like(g))
+            p._grad = g
+            ps.append(p)
+
+        class FakeOpt:
+            _parameter_list = ps
+
+            def step(self):
+                pass
+
+        return FakeOpt(), ps
+
+    def test_unscale_single_sync_and_correctness(self, monkeypatch):
+        """The fused finiteness reduction pays ONE host sync for the whole
+        parameter list (was one bool() per parameter)."""
+        calls = []
+        real = bool
+        monkeypatch.setattr(amp, "_host_bool",
+                            lambda x: calls.append(1) or real(x))
+        opt, ps = self._fake_opt([jnp.ones((3,)) * 2.0 for _ in range(5)])
+        sc = amp.GradScaler(init_loss_scaling=4.0)
+        sc.unscale_(opt)
+        assert len(calls) == 1
+        assert not sc._found_inf
+        np.testing.assert_allclose(np.asarray(ps[0]._grad), 0.5)
+        # idempotent: second unscale_ is a no-op until update()
+        sc.unscale_(opt)
+        assert len(calls) == 1
+
+    def test_found_inf_and_telemetry_events(self):
+        mon = TrainMonitor()
+        prev = set_active_monitor(mon)
+        try:
+            grads = [jnp.ones((3,)),
+                     jnp.asarray([1.0, np.inf, 2.0]), jnp.ones((2,))]
+            opt, _ = self._fake_opt(grads)
+            sc = amp.GradScaler(init_loss_scaling=8.0,
+                                decr_every_n_nan_or_inf=1)
+            sc.unscale_(opt)
+            assert sc._found_inf
+            sc.update()
+            assert sc.get_loss_scaling() == 4.0
+            whats = [e["what"] for e in mon.events("amp")]
+            assert whats == ["found_inf", "scale_change"]
+            s = mon.summary()["amp"]
+            assert s["found_inf"] == 1 and s["scale_changes"] == 1
+            assert s["scale"] == 4.0
+        finally:
+            set_active_monitor(prev)
+
+    def test_no_monitor_no_cost(self):
+        assert current_monitor() is None
+        opt, _ = self._fake_opt([jnp.ones((2,))])
+        sc = amp.GradScaler(init_loss_scaling=2.0)
+        sc.unscale_(opt)                           # must not raise
+        sc.update()
+
+
+class TestTelemetryCallback:
+    def _fit(self, mon, batches=4, epochs=2, **cb_kw):
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.callbacks import TelemetryCallback
+        paddle.seed(5)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8, 4).astype("float32"),
+                 rng.randn(8, 2).astype("float32")) for _ in range(batches)]
+        cb = TelemetryCallback(monitor=mon, **cb_kw)
+        m.fit(data, epochs=epochs, log_freq=2, verbose=0, callbacks=[cb])
+        return m, cb
+
+    def test_fit_records_steps_syncs_and_census(self, tmp_path):
+        mon = TrainMonitor()
+        jsonl = tmp_path / "train.jsonl"
+        m, cb = self._fit(mon, jsonl_path=str(jsonl))
+        steps = mon.events("train_step")
+        # 4 batches x 2 epochs, minus the first call = the compile event
+        assert len(steps) == 7
+        assert all(e["trainer"] == "hapi" and e["examples"] == 8
+                   for e in steps)
+        comp = mon.events("compile")
+        assert len(comp) == 1 and comp[0]["key"] == "hapi_step"
+        assert mon.events("sync")                  # log-freq loss fetches
+        assert mon.events("hbm")                   # train-end census
+        s = mon.summary()
+        assert s["steps"] == 7
+        assert s["examples_per_sec"] > 0
+        assert s["watchdog"]["last_loss"] is not None
+        # active monitor restored AND detached from the model after fit —
+        # a later fit without the callback is back to one attr check
+        assert current_monitor() is None
+        assert m._monitor is None
+        # JSONL dumped at train end and converts to a chrome trace
+        ct = chrome_trace_from_jsonl(str(jsonl))
+        names = {e["name"] for e in ct["traceEvents"]}
+        assert "train_step" in names and "sync" in names
+        json.dumps(ct)
+
+    def test_default_monitor_and_reuse(self):
+        from paddle_tpu.callbacks import TelemetryCallback
+        cb = TelemetryCallback()
+        assert isinstance(cb.monitor, TrainMonitor)
+
+    def test_fit_exception_still_tears_down(self):
+        """A raise mid-fit skips on_train_end; fit's finally must still
+        restore the active monitor and detach the model."""
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.callbacks import TelemetryCallback
+        paddle.seed(8)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        mon = TrainMonitor()
+
+        def bad_batches():
+            yield (np.ones((8, 4), "float32"), np.zeros((8, 2), "float32"))
+            raise RuntimeError("loader died")
+
+        with pytest.raises(RuntimeError, match="loader died"):
+            m.fit(bad_batches(), epochs=1, verbose=0,
+                  callbacks=[TelemetryCallback(monitor=mon)])
+        assert current_monitor() is None
+        assert m._monitor is None
+
+    def test_aggregate_failure_never_aborts_fit(self, monkeypatch):
+        """Eager cross-process collectives can be unsupported — telemetry
+        must not crash a finished run, and teardown (active-monitor
+        restore + model detach) must still happen."""
+        mon = TrainMonitor()
+
+        def boom(self):
+            raise RuntimeError("eager cross-process all_reduce unsupported")
+
+        monkeypatch.setattr(TrainMonitor, "aggregate", boom)
+        m, cb = self._fit(mon, batches=1, epochs=1, aggregate_on_end=True)
+        assert cb.last_aggregate is None
+        assert current_monitor() is None
+        assert m._monitor is None
+
+    def test_train_batch_feeds_watchdog(self):
+        from paddle_tpu.hapi import Model
+        paddle.seed(6)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        mon = TrainMonitor()
+        m._monitor = mon
+        x = np.ones((4, 4), "float32")
+        y = np.zeros((4, 2), "float32")
+        m.train_batch([x], [y])            # call 1 = the hapi compile event
+        (loss,) = m.train_batch([x], [y])
+        assert mon.events("compile") and mon.events("train_step") \
+            and mon.events("sync")
+        assert mon.summary()["watchdog"]["last_loss"] == pytest.approx(loss)
+
+
+class TestDistributedBuilders:
+    def test_localsgd_step_monitor(self):
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.localsgd import make_localsgd_train_step
+        devs = np.array(jax.devices()[:2])
+        mesh = Mesh(devs, ("data",))
+        params0 = {"w": jnp.ones((4,), jnp.float32)}
+
+        def loss_of(params, x):
+            return jnp.mean((x @ jnp.ones((4, 4)) @ params["w"]) ** 2)
+
+        opt = Momentum(learning_rate=0.05, momentum=0.0)
+        mon = TrainMonitor()
+        step, state = make_localsgd_train_step(loss_of, params0, opt, mesh,
+                                               k_steps=2, monitor=mon)
+        x = jnp.ones((4, 4), jnp.float32)
+        for _ in range(3):
+            state, loss = step(state, 0.05, x)
+        evs = mon.events("train_step")
+        assert len(evs) == 2                   # first call = compile event
+        assert all(e["trainer"] == "localsgd" for e in evs)
+        assert mon.summary()["compile"]["misses"] == 1
+
+    def test_gpt_train_step_monitor(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel, \
+            make_gpt_train_step
+        from paddle_tpu.distributed import fleet
+        paddle.seed(7)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_attention_heads=2, max_position_embeddings=16,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        mon = TrainMonitor()
+        step, state = make_gpt_train_step(
+            model, Adam(1e-3, parameters=model.parameters()), hcg,
+            remat=False, monitor=mon)
+        x = jnp.zeros((2, 8), jnp.int32)
+        y = jnp.zeros((2, 8), jnp.int32)
+        for i in range(2):                     # call 1 = compile event
+            state, loss = step(state, jax.random.key(i), np.float32(1e-3),
+                               x, y)
+        ev = mon.events("train_step")[-1]
+        assert ev["trainer"] == "gpt"
+        assert ev["examples"] == 2 and ev["tokens"] == 16
+
+
+class TestProfilerStep:
+    def test_num_samples_items_per_sec(self):
+        from paddle_tpu.profiler import Profiler
+        prof = Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            prof.step(num_samples=32)
+        prof.stop()
+        info = prof.step_info()
+        assert "steps=3" in info and "ips=" in info
+        # without samples the field stays absent
+        p2 = Profiler(timer_only=True)
+        p2.start()
+        p2.step()
+        p2.stop()
+        assert "ips=" not in p2.step_info()
+
+    def test_routes_into_active_monitor(self):
+        from paddle_tpu.profiler import Profiler
+        mon = TrainMonitor()
+        with mon:
+            assert current_monitor() is mon
+            prof = Profiler(timer_only=True)
+            prof.start()
+            for _ in range(2):
+                prof.step(num_samples=4)
+            prof.stop()
+        assert current_monitor() is None
+        # profiler spans ride their OWN kind/counters so an instrumented
+        # loop paced by Profiler.step never double-counts train_steps
+        assert mon.events("train_step") == []
+        evs = mon.events("profiler_step")
+        assert len(evs) == 2
+        assert all(e["examples"] == 4 for e in evs)
+        assert mon.registry.value("profiler_steps") == 2
+        assert mon.summary()["steps"] == 0
+
+
+class TestExports:
+    def test_jsonl_prometheus_roundtrip(self, tmp_path):
+        mon = TrainMonitor()
+        mon.record_step(0.01, trainer="t", examples=2, tokens=8)
+        mon.record_sync(0.001, loss=1.5)
+        mon.observe_scaler(8.0, found_inf=True)
+        mon.hbm_census()
+        path = tmp_path / "train.jsonl"
+        n = mon.dump_jsonl(str(path))
+        lines = [json.loads(ln) for ln in
+                 path.read_text().splitlines() if ln]
+        assert len(lines) == n
+        kinds = {ln["kind"] for ln in lines}
+        assert {"train_step", "sync", "amp", "hbm"} <= kinds
+        # offline conversion == live conversion (the trace_to_chrome merge
+        # contract for training dumps)
+        assert chrome_trace_from_jsonl(str(path)) == mon.to_chrome_trace()
+        text = mon.prometheus_text()
+        vals = {ln.split()[0]: ln.split()[1] for ln in text.splitlines()
+                if ln and not ln.startswith("#") and "{" not in ln}
+        assert int(vals["paddle_tpu_train_train_steps"]) == 1
+        assert int(vals["paddle_tpu_train_train_tokens"]) == 8
+        assert int(vals["paddle_tpu_train_amp_found_inf"]) == 1
+        assert "paddle_tpu_train_step_seconds_count" in vals
+
+    def test_chrome_train_rows(self):
+        mon = TrainMonitor()
+        mon.record_step(0.02, trainer="t")
+        mon.observe_loss(float("nan"))
+        ct = mon.to_chrome_trace()
+        train = [e for e in ct["traceEvents"]
+                 if e.get("pid") == "paddle_tpu.train"]
+        assert any(e["ph"] == "X" and e["name"] == "train_step"
+                   for e in train)
+        assert any(e["ph"] == "i" and e["name"] == "watchdog:non_finite"
+                   for e in train)

@@ -27,6 +27,31 @@ if ! JAX_PLATFORMS=cpu python tools/trace_to_chrome.py --help >/dev/null 2>&1; t
     echo "COLLECT SMOKE FAILED: tools/trace_to_chrome.py --help"
     exit 1
 fi
+# training telemetry surface: TrainMonitor + the fit callback re-export must
+# import clean, and a training JSONL dump must convert through the trace
+# CLI's merge loader (the --engine-trace ingestion path, exercised without
+# xprof/xplane files)
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'PYEOF'
+import importlib.util, os, tempfile
+from paddle_tpu.telemetry import TrainMonitor
+from paddle_tpu.callbacks import TelemetryCallback  # noqa: F401 re-export
+mon = TrainMonitor()
+mon.record_step(0.01, trainer="smoke", examples=4, tokens=8)
+mon.record_sync(0.001, loss=1.25)
+path = os.path.join(tempfile.mkdtemp(), "train.jsonl")
+mon.dump_jsonl(path)
+spec = importlib.util.spec_from_file_location(
+    "_t2c_smoke", "tools/trace_to_chrome.py")
+t2c = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(t2c)
+ct = t2c._load_engine_trace(path)
+assert any(e.get("name") == "train_step" for e in ct["traceEvents"]), ct
+assert any(e.get("name") == "sync" for e in ct["traceEvents"]), ct
+PYEOF
+then
+    echo "COLLECT SMOKE FAILED: training telemetry import / JSONL merge"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via
