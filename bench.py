@@ -523,6 +523,90 @@ def bench_gpt_serving(on_tpu):
             }}
 
 
+def bench_gpt_grad_comm(on_tpu):
+    """Gradient-communication policy A/B on the sharded GPT trainer: one
+    record comparing step time and bytes-on-wire across the grad_comm
+    policies (fp32 / bf16 / int8_ef — distributed/grad_comm.py).  Byte
+    figures are the policy layer's logical ring-all-reduce estimates from
+    the grad-tree shapes (docs/DISTRIBUTED_COMM.md), reported per policy
+    and as the int8_ef-vs-fp32 savings in the telemetry snapshot; step
+    time measures the (de)quantization compute the policy adds to the
+    compiled step on this backend."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.grad_comm import wire_bytes
+    from paddle_tpu.models.gpt import GPTConfig, make_sharded_gpt_train_step
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.telemetry import TrainMonitor
+
+    if on_tpu:
+        cfg_kw = dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                      num_attention_heads=12, max_position_embeddings=1024,
+                      compute_dtype="bfloat16", scan_unroll=12)
+        B, L, iters = 16, 1024, 20
+    else:
+        cfg_kw = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                      num_attention_heads=4, max_position_embeddings=128,
+                      compute_dtype="float32")
+        B, L, iters = 2, 128, 3
+
+    cfg = GPTConfig(**cfg_kw)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+    y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
+
+    policies = {}
+    int8_comm = None
+    dt_fp32 = loss_fp32 = None
+    for pol in ("fp32", "bf16", "int8_ef"):
+        paddle.seed(0)
+        hcg = _fleet_hcg()
+        mon = TrainMonitor()
+        step, state = make_sharded_gpt_train_step(
+            cfg, AdamW(3e-4, weight_decay=0.01), hcg, remat=False,
+            grad_comm=pol)
+        wb = wire_bytes(state["params"], pol)
+        args = (state, np.float32(3e-4), jax.random.key(0), x, y)
+        dt, loss, _ = _run_timed(step, args, iters, monitor=mon,
+                                 examples_per_step=B, tokens_per_step=B * L)
+        mon.record_comm(policy=pol, pre_bytes=wb["pre_bytes"],
+                        post_bytes=wb["post_bytes"])
+        tel = mon.summary()
+        sw = tel["step_wall_s"] or {}
+        if pol == "fp32":
+            dt_fp32, loss_fp32 = dt, loss
+        elif pol == "int8_ef":
+            int8_comm = tel["comm"]
+        policies[pol] = {
+            "step_ms": round(dt / iters * 1e3, 3),
+            "step_ms_p50": (None if sw.get("p50") is None
+                            else round(sw["p50"] * 1e3, 3)),
+            "tokens_per_sec": round(B * L * iters / dt, 1),
+            "loss": round(loss, 4),
+            "wire_bytes_fp32": wb["pre_bytes"],
+            "wire_bytes": wb["post_bytes"],
+            "wire_savings": round(wb["pre_bytes"] / wb["post_bytes"], 3),
+        }
+
+    base = policies["fp32"]
+    flops = _transformer_train_flops(B, L, cfg.num_layers, cfg.hidden_size,
+                                     cfg.intermediate_size, cfg.vocab_size)
+    out = _result("gpt_grad_comm_tokens_per_sec", "tokens/s/chip", B * L,
+                  iters, dt_fp32, flops, on_tpu, loss_fp32)
+    out["policies"] = policies
+    out["telemetry"] = {
+        "comm": int8_comm,
+        "int8_vs_fp32_bytes_savings": policies["int8_ef"]["wire_savings"],
+        "int8_vs_fp32_step_ratio": (
+            round(policies["int8_ef"]["step_ms"] / base["step_ms"], 3)
+            if base["step_ms"] else None),
+    }
+    return out
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2s,
     "gpt_long": bench_gpt_long,
@@ -532,6 +616,7 @@ CONFIGS = {
     "mnist_lenet": bench_mnist_lenet,
     "gpt_decode": bench_gpt_decode,
     "gpt_serving": bench_gpt_serving,
+    "gpt_grad_comm": bench_gpt_grad_comm,
 }
 
 

@@ -9,6 +9,8 @@ from .collective import (Group, ReduceOp, all_gather, all_gather_object,  # noqa
 from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env  # noqa: F401
 from .fleet.meta_parallel import DataParallel  # noqa: F401
 from .dgc import make_dgc_train_step  # noqa: F401
+from .grad_comm import (GradCommPolicy, compressed_all_reduce,  # noqa: F401
+                        compressed_reduce_scatter, resolve_policy)
 from .localsgd import make_localsgd_train_step  # noqa: F401
 from .spmd import make_spmd_train_step, shard_batch  # noqa: F401
 from .zero import make_zero_train_step, per_device_state_bytes  # noqa: F401

@@ -159,8 +159,30 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         if op == ReduceOp.AVG:
             out = apply(lambda t: jax.lax.pmean(t, group.axis_name), tensor)
         elif op == ReduceOp.PROD:
-            out = apply(lambda t: jnp.exp(jax.lax.psum(jnp.log(t), group.axis_name)),
-                        tensor)
+            # exp(psum(log(t))) NaNs on any non-positive entry.  Correct
+            # decomposition: magnitude via a log-ABS psum (zeros masked to
+            # log 1), sign via a negative-count parity psum, and an any-zero
+            # pmax that forces the product to exactly 0.
+            def _prod(t):
+                # floating inputs keep their dtype (f64 products would
+                # overflow/round in a forced f32); integers go through f32
+                tf = t if jnp.issubdtype(jnp.dtype(t.dtype), jnp.floating) \
+                    else t.astype(jnp.float32)
+                is_zero = tf == 0
+                mag = jnp.exp(jax.lax.psum(
+                    jnp.log(jnp.where(is_zero, 1.0, jnp.abs(tf))),
+                    group.axis_name))
+                neg = jax.lax.psum((tf < 0).astype(jnp.int32), group.axis_name)
+                any_zero = jax.lax.pmax(is_zero.astype(jnp.int32),
+                                        group.axis_name)
+                signed = jnp.where(neg % 2 == 1, -mag, mag)
+                out = jnp.where(any_zero > 0, 0.0, signed)
+                if not jnp.issubdtype(jnp.dtype(t.dtype), jnp.floating):
+                    # exp(Σlog) lands at 41.99999… for an exact 42 — round
+                    # before the cast or integer products truncate off-by-one
+                    out = jnp.round(out)
+                return out.astype(t.dtype)
+            out = apply(_prod, tensor)
         else:
             out = apply(lambda t: fns[op](t, group.axis_name), tensor)
         if isinstance(tensor, Tensor):

@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .grad_comm import ef_accumulate, ef_residual
 from .spmd import shard_map as _shard_map
 
 __all__ = ["make_dgc_train_step"]
@@ -98,11 +99,22 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
             def dgc_branch(args):
                 gf_, u_, v_ = args
                 u2 = momentum * u_ + gf_
-                v2 = v_ + u2
+                # error-feedback accumulate/clear via the SHARED grad_comm
+                # helpers (one implementation with int8_ef, so the two
+                # compressed exchanges cannot drift): v = v + u, and the
+                # residual keeps v minus the decompressed payload — for
+                # top-k that is exactly "clear the sent coordinates"
+                # (v2 - v2[idx] == 0 there, bit-identical to .at[].set(0))
+                v2 = ef_accumulate(v_, u2)
                 vals, idx = _topk_compress(v2, k)
-                # clear residuals at the sent coordinates
-                u3 = u2.at[idx].set(0.0)
-                v3 = v2.at[idx].set(0.0)
+                local_sent = jnp.zeros_like(v2).at[idx].set(vals)
+                sent_mask = jnp.zeros_like(v2).at[idx].set(1.0)
+                # the where pins sent coordinates to exactly 0.0 even for
+                # non-finite entries (v2 - v2 would be NaN for inf), which
+                # is the reference kernel's clear semantics
+                u3 = jnp.where(sent_mask > 0, 0.0, u2)
+                v3 = jnp.where(sent_mask > 0, 0.0,
+                               ef_residual(v2, local_sent))
                 # exchange 2k elements: all replicas' (vals, idx)
                 all_vals = lax.all_gather(vals, axis)      # (R, k)
                 all_idx = lax.all_gather(idx, axis)        # (R, k)

@@ -1,0 +1,454 @@
+"""Quantized gradient collectives — the pluggable grad-comm policy layer.
+
+Every data-parallel trainer in the framework synchronizes gradients (or,
+for LocalSGD, parameters) across replicas.  At scale the bytes those
+collectives put on the wire are the bottleneck, and full-precision fp32
+traffic is 2-4x larger than it needs to be.  This module factors the
+choice of wire format out of the trainers into a POLICY:
+
+``fp32``     today's behavior and the default: full-precision
+             ``lax.pmean``/``psum_scatter``.  Zero risk, zero savings.
+``bf16``     cast -> reduce -> cast back: a 2x traffic cut whose error
+             (bf16 has an fp32 exponent) is usually invisible next to the
+             gradient noise floor.
+``int8_ef``  EQuARX-style block-quantized reduction
+             (https://arxiv.org/pdf/2506.17615): per-block fp32 scales +
+             an int8 payload, composed inside ``shard_map`` as
+
+                 quantize -> all_to_all (int8)        # shard exchange
+                 -> dequantize-accumulate in fp32     # local reduce
+                 -> requantize -> all_gather (int8)   # result broadcast
+                 -> dequantize
+
+             so EVERY hop on the wire is int8 (+ 4 bytes per ``block``
+             elements of scale) — a ~3.9x byte cut at the default
+             ``block=256``.  An error-feedback residual (Karimireddy et
+             al. 2019; the same machinery ``dgc.py`` uses for top-k
+             sparsification) carries each replica's quantization error
+             into the next step, which preserves convergence: the
+             residual update helpers here (``ef_accumulate`` /
+             ``ef_residual``) are shared with DGC so the two
+             compressed-exchange paths cannot drift.
+
+Two application modes, honestly separated:
+
+- **wire mode** (``all_reduce``/``reduce_scatter`` with a bound mesh
+  ``axis``, i.e. inside ``shard_map``): the composition above really runs
+  and the collectives really move quantized bytes.  LocalSGD's parameter
+  averaging and the module-level ``compressed_all_reduce`` /
+  ``compressed_reduce_scatter`` use this mode.
+- **local mode** (``apply_local``, no axis): the same quantize ->
+  (identity reduce) -> requantize -> dequantize pipeline with R=1, bit
+  -identical to the wire composition on one replica.  The GSPMD trainers
+  (``zero.py``, ``spmd.py`` steps, ``jit/functional.py``) use this mode:
+  there XLA owns the collective schedule (the dp reduction is inserted
+  inside ``value_and_grad``), so the policy governs the NUMERICS of the
+  exchanged gradient and the byte accounting, while true quantized hops
+  need the shard_map composition.  This keeps a laptop run's loss curve
+  faithful to what the policy does on a pod.
+
+Byte accounting (``wire_bytes``) uses the logical ring-all-reduce model
+in the large-R limit: a reduction of N elements moves ~2 payload passes
+per replica (reduce-scatter + all-gather halves), so
+
+    fp32:    2 * 4N
+    bf16:    2 * 2N
+    int8_ef: 2 * (N + 4 * ceil(N / block))
+
+independent of the axis size — well-defined on any mesh, including the
+single-device CPU fallback.  ``telemetry.TrainMonitor.record_comm``
+turns these into per-step ``comm`` events (see docs/DISTRIBUTED_COMM.md).
+
+Quantization error bound (the documented contract, pinned by
+tests/test_grad_comm.py): symmetric per-block int8 with scale
+``max|block| / 127`` has per-element dequantization error at most
+``scale / 2 = max|block| / 254``; the two-stage all-reduce composition
+(quantize contributions, requantize the mean) therefore lands within
+``max|block| / 127`` of the exact fp32 mean, per block.  Constant blocks
+round-trip to ~1 ulp (the max element quantizes to exactly +-127).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+__all__ = [
+    "DEFAULT_BLOCK", "GradCommPolicy", "Fp32Policy", "Bf16Policy",
+    "Int8EfPolicy", "POLICIES", "resolve_policy",
+    "compressed_all_reduce", "compressed_reduce_scatter", "tree_from_shards",
+    "quantize_blocks", "dequantize_blocks", "ef_accumulate", "ef_residual",
+    "wire_bytes", "comm_info", "apply_policy_local",
+]
+
+#: default quantization block (elements per fp32 scale); 256 amortizes the
+#: scale overhead to ~1.6% while keeping blocks small enough that one
+#: outlier only poisons 255 neighbors
+DEFAULT_BLOCK = 256
+
+_QMAX = 127.0  # symmetric int8: levels in [-127, 127] (no -128 asymmetry)
+
+
+# --------------------------------------------------------------------------
+# error-feedback primitives — SHARED with dgc.py (one implementation, so
+# the int8 and top-k compressed exchanges cannot drift)
+# --------------------------------------------------------------------------
+
+def ef_accumulate(residual, update):
+    """``v = residual + update``: fold the carried compression error into
+    this step's value before compressing.  ``residual=None`` (stateless
+    caller / first step) passes ``update`` through."""
+    if residual is None:
+        return update
+    return residual + update
+
+
+def ef_residual(v, sent):
+    """``e' = v - sent``: what was accumulated minus what actually went on
+    the wire (the DECOMPRESSED payload, so the residual carries exactly
+    the error the receivers saw)."""
+    return v - sent
+
+
+# --------------------------------------------------------------------------
+# block quantization kernels
+# --------------------------------------------------------------------------
+
+def quantize_blocks(x, block: int = DEFAULT_BLOCK):
+    """Symmetric per-block int8 quantization over the LAST dimension.
+
+    ``x``: float array whose last dim is a multiple of ``block``.  Returns
+    ``(q, scales)``: ``q`` int8 with x's shape, ``scales`` fp32 shaped
+    ``x.shape[:-1] + (last // block,)`` with ``scale = max|block| / 127``
+    (all-zero blocks get scale 1.0 so they stay exactly zero).
+    """
+    shape = x.shape
+    if shape[-1] % block:
+        raise ValueError(f"last dim {shape[-1]} not a multiple of {block}")
+    xb = x.reshape(shape[:-1] + (shape[-1] // block, block)).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scales = jnp.where(amax > 0, amax / _QMAX, 1.0)
+    q = jnp.clip(jnp.round(xb / scales[..., None]), -_QMAX, _QMAX)
+    return q.astype(jnp.int8).reshape(shape), scales
+
+
+def dequantize_blocks(q, scales, block: int = DEFAULT_BLOCK):
+    """Inverse of :func:`quantize_blocks`; returns fp32 with ``q``'s shape."""
+    shape = q.shape
+    qb = q.reshape(shape[:-1] + (shape[-1] // block, block)).astype(jnp.float32)
+    return (qb * scales[..., None]).reshape(shape)
+
+
+# --------------------------------------------------------------------------
+# pytree <-> padded flat vector (one fused buffer so ONE set of collectives
+# serves the whole gradient tree — the seam topology-aware bucketing will
+# later split)
+# --------------------------------------------------------------------------
+
+class TreeMeta(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    n: int
+    n_pad: int
+
+
+def _tree_size(tree) -> int:
+    return int(sum(int(np.prod(np.shape(l)))
+                   for l in jax.tree_util.tree_leaves(tree)))
+
+
+def _flatten_tree(tree, multiple: int, total: Optional[int] = None):
+    """Concatenate all leaves (as fp32) into one flat vector zero-padded to
+    ``total`` elements (or the next multiple of ``multiple``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        raise ValueError("grad_comm: empty pytree")
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    parts = [jnp.ravel(l).astype(jnp.float32) for l in leaves]
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    n = flat.shape[0]
+    n_pad = total if total is not None else -(-n // multiple) * multiple
+    if n_pad < n or n_pad % multiple:
+        raise ValueError(
+            f"grad_comm: residual/pad length {n_pad} incompatible with tree "
+            f"size {n} and multiple {multiple} — was the residual built for "
+            f"a different tree or axis size?")
+    if n_pad > n:
+        flat = jnp.concatenate([flat, jnp.zeros((n_pad - n,), jnp.float32)])
+    return flat, TreeMeta(treedef, shapes, dtypes, n, n_pad)
+
+
+def _unflatten_tree(flat, meta: TreeMeta):
+    out, off = [], 0
+    for shape, dt in zip(meta.shapes, meta.dtypes):
+        size = int(np.prod(shape)) if shape else 1
+        out.append(flat[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return jax.tree_util.tree_unflatten(meta.treedef, out)
+
+
+def _axis_size(axis) -> int:
+    # psum of a unit constant folds to the static axis size inside shard_map
+    return int(lax.psum(1, axis)) if axis is not None else 1
+
+
+# --------------------------------------------------------------------------
+# policies
+# --------------------------------------------------------------------------
+
+class GradCommPolicy:
+    """Base policy: fp32 passthrough (today's behavior).
+
+    The contract every policy implements:
+
+    - ``all_reduce(tree, axis, residual)`` -> ``(mean_tree, residual')``:
+      cross-replica MEAN over mesh axis ``axis`` (must be bound, i.e.
+      inside shard_map) — the operation every dp trainer wants.
+    - ``reduce_scatter(tree, axis, residual)`` -> ``(shard, meta,
+      residual')``: each replica gets its ``1/R`` contiguous shard of the
+      flattened mean (fp32); ``tree_from_shards`` reassembles.
+    - ``apply_local(tree, residual)`` -> ``(tree', residual')``: the R=1
+      wire composition (bit-identical numerics, no collectives) for
+      GSPMD/single-process trainers.
+    - ``residual_for(tree, axis_size)``: zeros of the flat padded residual
+      this policy threads through state (None for stateless policies).
+    - ``wire_bytes(tree)`` -> ``(pre, post)``: fp32-baseline vs this
+      policy's logical ring-all-reduce bytes per step.
+    """
+
+    name = "fp32"
+    #: True when the policy carries an error-feedback residual in state
+    stateful = False
+
+    # -- wire mode ---------------------------------------------------------
+    def all_reduce(self, tree, axis, residual=None):
+        return jax.tree_util.tree_map(
+            lambda t: lax.pmean(t, axis), tree), residual
+
+    def reduce_scatter(self, tree, axis, residual=None):
+        R = _axis_size(axis)
+        flat, meta = _flatten_tree(tree, max(R, 1))
+        shard = lax.psum_scatter(flat, axis, scatter_dimension=0,
+                                 tiled=True) / R
+        return shard, meta, residual
+
+    # -- local mode --------------------------------------------------------
+    def apply_local(self, tree, residual=None):
+        return tree, residual
+
+    # -- state / accounting ------------------------------------------------
+    def residual_for(self, tree, axis_size: int = 1):
+        return None
+
+    def wire_bytes(self, tree) -> Tuple[int, int]:
+        n = _tree_size(tree)
+        return 8 * n, 8 * n
+
+
+class Bf16Policy(GradCommPolicy):
+    """Cast -> reduce -> cast back: every hop moves bf16 (2x cut).  The
+    reduction accumulates in bf16 — acceptable for gradient averaging
+    (bf16 keeps the fp32 exponent), documented rather than hidden."""
+
+    name = "bf16"
+
+    def all_reduce(self, tree, axis, residual=None):
+        return jax.tree_util.tree_map(
+            lambda t: lax.pmean(t.astype(jnp.bfloat16), axis).astype(t.dtype),
+            tree), residual
+
+    def reduce_scatter(self, tree, axis, residual=None):
+        R = _axis_size(axis)
+        flat, meta = _flatten_tree(tree, max(R, 1))
+        shard = lax.psum_scatter(flat.astype(jnp.bfloat16), axis,
+                                 scatter_dimension=0, tiled=True)
+        return shard.astype(jnp.float32) / R, meta, residual
+
+    def apply_local(self, tree, residual=None):
+        return jax.tree_util.tree_map(
+            lambda t: t.astype(jnp.bfloat16).astype(t.dtype), tree), residual
+
+    def wire_bytes(self, tree):
+        n = _tree_size(tree)
+        return 8 * n, 4 * n
+
+
+class Int8EfPolicy(GradCommPolicy):
+    """EQuARX-style block-quantized all-reduce with error feedback (see
+    module docstring for the composition and the error bound)."""
+
+    name = "int8_ef"
+    stateful = True
+
+    def __init__(self, block: int = DEFAULT_BLOCK):
+        if block < 1:
+            raise ValueError(f"block must be >= 1, got {block}")
+        self.block = int(block)
+
+    # residual length: the padded flat size for this (tree, axis_size) —
+    # every entry point below pads to the SAME formula so a residual built
+    # once stays shape-stable across steps
+    def _padded(self, n: int, R: int) -> int:
+        m = self.block * max(R, 1)
+        return -(-n // m) * m
+
+    def residual_for(self, tree, axis_size: int = 1):
+        return jnp.zeros((self._padded(_tree_size(tree), axis_size),),
+                         jnp.float32)
+
+    def _exchange(self, v, R: int, axis):
+        """The quantized exchange on a padded flat vector ``v``: returns
+        ``(mean_hat, mean_shard, sent)`` where ``sent`` is the dequantized
+        OWN contribution (what the receivers saw — the EF reference) and
+        ``mean_shard`` the local fp32 reduced shard (pre-requantization)."""
+        shard = v.shape[0] // R
+        v2 = v.reshape(R, shard)
+        q1, s1 = quantize_blocks(v2, self.block)
+        if R > 1:
+            # hop 1 (int8): row r of q1 is this replica's contribution to
+            # replica r's shard; all_to_all lands all contributions to OUR
+            # shard here
+            qx = lax.all_to_all(q1, axis, split_axis=0, concat_axis=0)
+            sx = lax.all_to_all(s1, axis, split_axis=0, concat_axis=0)
+        else:
+            qx, sx = q1, s1
+        # local reduce in fp32 — the accumulator never rides the wire
+        mean_shard = dequantize_blocks(qx, sx, self.block).sum(0) / R
+        q2, s2 = quantize_blocks(mean_shard, self.block)
+        if R > 1:
+            # hop 2 (int8): broadcast the requantized mean shards
+            qg = lax.all_gather(q2, axis)
+            sg = lax.all_gather(s2, axis)
+        else:
+            qg, sg = q2[None], s2[None]
+        mean_hat = dequantize_blocks(qg, sg, self.block).reshape(-1)
+        sent = dequantize_blocks(q1, s1, self.block).reshape(-1)
+        return mean_hat, mean_shard, sent
+
+    def _run(self, tree, axis, residual):
+        R = _axis_size(axis)
+        flat, meta = _flatten_tree(
+            tree, self.block * R,
+            total=residual.shape[0] if residual is not None else None)
+        v = ef_accumulate(residual, flat)
+        mean_hat, mean_shard, sent = self._exchange(v, R, axis)
+        return meta, mean_hat, mean_shard, ef_residual(v, sent)
+
+    def all_reduce(self, tree, axis, residual=None):
+        meta, mean_hat, _, new_e = self._run(tree, axis, residual)
+        return _unflatten_tree(mean_hat, meta), new_e
+
+    def reduce_scatter(self, tree, axis, residual=None):
+        # stops at the local fp32 shard: the only wire hop is the int8
+        # all_to_all — the ZeRO-2 seam (arXiv:2004.13336) where each
+        # replica updates only its own parameter shard
+        meta, _, mean_shard, new_e = self._run(tree, axis, residual)
+        return mean_shard, meta, new_e
+
+    def apply_local(self, tree, residual=None):
+        meta, mean_hat, _, new_e = self._run(tree, None, residual)
+        return _unflatten_tree(mean_hat, meta), new_e
+
+    def wire_bytes(self, tree):
+        n = _tree_size(tree)
+        scales = -(-n // self.block)
+        return 8 * n, 2 * (n + 4 * scales)
+
+
+POLICIES: Dict[str, Any] = {
+    "fp32": GradCommPolicy,
+    "bf16": Bf16Policy,
+    "int8_ef": Int8EfPolicy,
+}
+
+Fp32Policy = GradCommPolicy
+
+
+def resolve_policy(policy) -> GradCommPolicy:
+    """``None`` / a policy name / a policy instance -> policy instance."""
+    if policy is None:
+        return GradCommPolicy()
+    if isinstance(policy, GradCommPolicy):
+        return policy
+    if isinstance(policy, str):
+        cls = POLICIES.get(policy)
+        if cls is None:
+            raise ValueError(
+                f"unknown grad_comm policy {policy!r}; choose from "
+                f"{sorted(POLICIES)} or pass a GradCommPolicy instance")
+        return cls()
+    raise TypeError(f"grad_comm must be None, a name, or a GradCommPolicy; "
+                    f"got {type(policy).__name__}")
+
+
+# --------------------------------------------------------------------------
+# module-level API (the spelling the trainers and tests use)
+# --------------------------------------------------------------------------
+
+def compressed_all_reduce(tree, axis, policy="fp32", residual=None):
+    """Cross-replica MEAN of ``tree`` over mesh axis ``axis`` under
+    ``policy`` (must run inside shard_map with ``axis`` bound).  Returns
+    ``(mean_tree, new_residual)``; stateless policies pass ``residual``
+    through unchanged."""
+    return resolve_policy(policy).all_reduce(tree, axis, residual)
+
+
+def compressed_reduce_scatter(tree, axis, policy="fp32", residual=None):
+    """Reduce-scatter of the flattened ``tree`` mean: each replica returns
+    its contiguous fp32 shard plus the :class:`TreeMeta` needed to
+    reassemble (``tree_from_shards``).  Returns ``(shard, meta,
+    new_residual)``."""
+    return resolve_policy(policy).reduce_scatter(tree, axis, residual)
+
+
+def tree_from_shards(shard, meta: TreeMeta, axis):
+    """Gather reduce-scatter shards back into the full tree (fp32 hop —
+    for parity tests and consumers that need the whole tree; ZeRO-style
+    consumers keep the shard)."""
+    flat = lax.all_gather(shard, axis, tiled=True)
+    return _unflatten_tree(flat, meta)
+
+
+def wire_bytes(tree, policy="fp32") -> Dict[str, int]:
+    """Host-side logical bytes-on-wire estimate for one reduction of
+    ``tree`` (see module docstring for the model): ``{"pre_bytes":
+    fp32-baseline, "post_bytes": policy, "elements": N}``."""
+    p = resolve_policy(policy)
+    pre, post = p.wire_bytes(tree)
+    return {"pre_bytes": int(pre), "post_bytes": int(post),
+            "elements": _tree_size(tree)}
+
+
+def apply_policy_local(policy, grads, state, found_inf=None):
+    """The GSPMD trainers' shared local-mode seam: apply ``policy`` to the
+    grad tree, threading the error-feedback residual through the state
+    dict.  Returns ``(grads', comm_state)`` where ``comm_state`` is ``{}``
+    or ``{"comm_e": residual'}`` to merge into the new state; when
+    ``found_inf`` is given, a skipped (non-finite) step keeps the old
+    residual so garbage never folds into the error feedback."""
+    if policy.name == "fp32":
+        return grads, {}
+    grads, new_e = policy.apply_local(grads, state.get("comm_e"))
+    if not policy.stateful:
+        return grads, {}
+    if found_inf is not None:
+        new_e = jnp.where(found_inf, state["comm_e"], new_e)
+    return grads, {"comm_e": new_e}
+
+
+def comm_info(tree, policy) -> Optional[Dict[str, Any]]:
+    """The ``comm=`` dict ``telemetry.instrument_train_step`` feeds to
+    ``TrainMonitor.record_comm`` each step — None for the fp32 default so
+    default runs emit no new events (zero-diff contract)."""
+    p = resolve_policy(policy)
+    if p.name == "fp32":
+        return None
+    wb = wire_bytes(tree, p)
+    return {"policy": p.name, "pre_bytes": wb["pre_bytes"],
+            "post_bytes": wb["post_bytes"]}

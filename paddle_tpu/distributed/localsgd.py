@@ -30,7 +30,7 @@ __all__ = ["make_localsgd_train_step"]
 def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
                              optimizer, mesh: Mesh, k_steps: int = 4,
                              axis: str = "data", donate: bool = True,
-                             monitor=None):
+                             monitor=None, grad_comm=None):
     """Build a LocalSGD step over the ``axis`` mesh axis.
 
     ``loss_of(params, *batch) -> scalar``; ``batch`` leading dim is the
@@ -40,7 +40,18 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
     (leading dim R, sharded on ``axis``) and block-averaged every
     ``k_steps``-th call; reading them out: ``state["params"]`` rows are
     identical right after a sync step.
+
+    ``grad_comm``: communication policy for the every-k parameter average
+    (``"fp32"`` default / ``"bf16"`` / ``"int8_ef"`` / a
+    ``grad_comm.GradCommPolicy``).  The whole schedule runs inside
+    shard_map, so non-fp32 policies here are WIRE-real: the sync step's
+    average moves bf16 or int8(+scales) on every hop.  Stateful policies
+    carry a per-replica flat ``"comm_e"`` residual (leading dim R on
+    ``axis``, like DGC's accumulators) absorbing each replica's own
+    quantization error into the next sync.
     """
+    from .grad_comm import comm_info, resolve_policy
+    policy = resolve_policy(grad_comm)
     R = mesh.shape[axis]
     if k_steps < 1:
         raise ValueError(f"k_steps must be >= 1, got {k_steps}")
@@ -57,6 +68,10 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
         "opt": jax.tree_util.tree_map(rep_spec, opt_r),
         "count": P(),
     }
+    if policy.stateful:
+        e0 = policy.residual_for(params0, axis_size=R)
+        state0["comm_e"] = jnp.zeros((R,) + e0.shape, e0.dtype)
+        state_specs["comm_e"] = P(axis, None)
     state0 = jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         state0, state_specs)
@@ -84,16 +99,30 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
             # checker (the values ARE equal across replicas post-pmean)
             return ensure_varying(p, axis)
 
-        new_params = lax.cond(
-            sync,
-            lambda ps: jax.tree_util.tree_map(
-                lambda p: _revary(lax.pmean(p, axis)), ps),
-            lambda ps: ps,
-            new_params)
+        e = state["comm_e"][0] if policy.stateful else None
+        if policy.name == "fp32":
+            new_params = lax.cond(
+                sync,
+                lambda ps: jax.tree_util.tree_map(
+                    lambda p: _revary(lax.pmean(p, axis)), ps),
+                lambda ps: ps,
+                new_params)
+            new_e = e
+        else:
+            def sync_branch(args):
+                ps, e_ = args
+                avg, e2 = policy.all_reduce(ps, axis, e_)
+                avg = jax.tree_util.tree_map(_revary, avg)
+                return avg, (e2 if e2 is None else _revary(e2))
+
+            new_params, new_e = lax.cond(
+                sync, sync_branch, lambda args: args, (new_params, e))
 
         out = {"params": jax.tree_util.tree_map(lambda a: a[None], new_params),
                "opt": jax.tree_util.tree_map(lambda a: a[None], new_opt),
                "count": count}
+        if policy.stateful:
+            out["comm_e"] = new_e[None]
         return out, lax.pmean(loss, axis)
 
     batch_spec = P(axis)
@@ -104,7 +133,11 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
         w = _shard_map(
             body, mesh=mesh,
             in_specs=(state_specs, P()) + (batch_spec,) * n_batch,
-            out_specs=(state_specs, P()))
+            out_specs=(state_specs, P()),
+            # non-fp32: the quantized exchange rebuilds values from
+            # all_to_all'd payloads the VMA checker cannot statically prove
+            # replicated (same rationale as dgc.py's scatter-add)
+            check_vma=False if policy.name != "fp32" else None)
         return jax.jit(w, donate_argnums=(0,) if donate else ())
 
     def step(state, lr, *batch):
@@ -112,4 +145,11 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
                                      *batch)
 
     from ..telemetry import instrument_train_step
-    return instrument_train_step(step, monitor, "localsgd"), state0
+    comm = comm_info(params0, policy)
+    if comm is not None:
+        # the exchange only runs every k_steps-th call: amortize the
+        # per-sync estimate so per-step comm events stay truthful (the
+        # savings ratio is unchanged)
+        comm = dict(comm, pre_bytes=comm["pre_bytes"] // k_steps,
+                    post_bytes=max(comm["post_bytes"] // k_steps, 1))
+    return instrument_train_step(step, monitor, "localsgd", comm=comm), state0

@@ -311,7 +311,7 @@ def spmd_pipeline_interleaved(stage_fn: Callable, chunk_params, microbatches,
 
 def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
                          accumulate_steps: int = 1, donate: bool = True,
-                         monitor=None):
+                         monitor=None, grad_comm=None):
     """GSPMD train step over the hybrid mesh (dp × sharding × model [+ sep]).
 
     ≙ §3.3 of the survey: what the reference achieves by rewriting the
@@ -321,7 +321,9 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
     reduce-scatter/all-gathers — scheduled on ICI.
     """
     from ..jit.functional import functionalize, _wrap, _unwrap, wrap_tree
+    from .grad_comm import apply_policy_local, comm_info, resolve_policy
 
+    policy = resolve_policy(grad_comm)
     mesh = hcg.mesh
     apply_fn, params0, buffers0 = functionalize(layer)
     opt_state0 = optimizer.init_state(params0)
@@ -329,6 +331,9 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
 
     p_specs = build_param_specs(params0, mesh, layer, zero_stage)
     state_sh = build_state_shardings(state0, p_specs, mesh, zero_stage, params0)
+    if policy.stateful:
+        state0["comm_e"] = policy.residual_for(params0)
+        state_sh["comm_e"] = NamedSharding(mesh, P())
     batch_spec = P("data") if "data" in mesh.axis_names and \
         mesh.shape["data"] > 1 else P()
     batch_sh = NamedSharding(mesh, batch_spec)
@@ -367,45 +372,67 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
         else:
             (loss, (new_b, _)), grads = jax.value_and_grad(loss_of, has_aux=True)(
                 state["params"], state["buffers"], key, inputs, labels)
+        grads, comm_state = apply_policy_local(policy, grads, state)
         new_params, new_opt = optimizer.update(grads, state["opt"], state["params"],
                                                lr=lr)
         # keep shardings stable across steps
         new_params = jax.lax.with_sharding_constraint(
             new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
-        return {"params": new_params, "opt": new_opt, "buffers": new_b}, loss
+        return {"params": new_params, "opt": new_opt, "buffers": new_b,
+                **comm_state}, loss
 
     from ..telemetry import instrument_train_step
-    return instrument_train_step(step, monitor, "spmd"), place(state0), state_sh
+    return instrument_train_step(step, monitor, "spmd",
+                                 comm=comm_info(params0, policy)), \
+        place(state0), state_sh
 
 
-def _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate):
+def _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate,
+                     grad_comm=None):
     """The shared jitted step kernel: fwd+bwd+update with params
-    re-constrained each step so shardings stay stable under donation."""
+    re-constrained each step so shardings stay stable under donation.
+
+    ``grad_comm``: gradient-communication policy applied in LOCAL mode at
+    the post-backward seam (GSPMD owns the collective schedule here —
+    the policy pins the exchanged gradient's numerics and byte
+    accounting; see distributed/grad_comm.py).  Stateful policies thread
+    a flat ``"comm_e"`` residual through the state."""
+    from .grad_comm import apply_policy_local, resolve_policy
+    policy = resolve_policy(grad_comm)
+
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, lr, *batch):
         loss, grads = jax.value_and_grad(loss_of)(state["params"], *batch)
+        grads, comm_state = apply_policy_local(policy, grads, state)
         new_params, new_opt = optimizer.update(grads, state["opt"],
                                                state["params"], lr=lr)
         new_params = jax.lax.with_sharding_constraint(
             new_params, {k: NamedSharding(mesh, p_specs[k]) for k in new_params})
-        return {"params": new_params, "opt": new_opt, "buffers": {}}, loss
+        return {"params": new_params, "opt": new_opt, "buffers": {},
+                **comm_state}, loss
     return step
 
 
 def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
-                              zero_stage: int = 0, donate: bool = True):
+                              zero_stage: int = 0, donate: bool = True,
+                              grad_comm=None):
     """Shared GSPMD train-step builder for functional models (gpt/bert/ernie).
 
     ``loss_of(params, *batch) -> scalar loss``.  Returns (step, state0) where
     ``step(state, lr, *batch) -> (state, loss)``; params/opt-state sharded by
-    build_param_specs.
+    build_param_specs.  ``grad_comm`` as in ``_make_gspmd_step``.
     """
+    from .grad_comm import resolve_policy
+    policy = resolve_policy(grad_comm)
     p_specs = build_param_specs(params0, mesh, layer, zero_stage)
     opt_state0 = optimizer.init_state(params0)
     state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
     state_sh = build_state_shardings(state0, p_specs, mesh,
                                      max(zero_stage, 1), params0)
-    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate)
+    if policy.stateful:
+        state0["comm_e"] = policy.residual_for(params0)
+        state_sh["comm_e"] = NamedSharding(mesh, P())
+    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate, policy)
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
         is_leaf=lambda x: hasattr(x, "shape"))
@@ -422,7 +449,8 @@ def shard_batch(batch, hcg):
 
 def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
                                  meta_layer=None, zero_stage: int = 0,
-                                 donate: bool = True, seed: int = 0):
+                                 donate: bool = True, seed: int = 0,
+                                 grad_comm=None):
     """Like make_gspmd_step_from_loss, but the TrainState is *initialized
     directly sharded on the mesh*: ``build_params(key)`` runs under jit with
     per-leaf out_shardings, so each device materializes only its shard and
@@ -430,12 +458,17 @@ def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
     ~27GB host-side otherwise).  ≙ the reference's per-rank startup programs
     after sharding_optimizer pruning; the scaling-book "init on the mesh".
     """
+    from .grad_comm import resolve_policy
+    policy = resolve_policy(grad_comm)
     key0 = jax.random.key(seed)
 
     def init_state(key):
         params = build_params(key)
-        return {"params": params, "opt": optimizer.init_state(params),
-                "buffers": {}}
+        state = {"params": params, "opt": optimizer.init_state(params),
+                 "buffers": {}}
+        if policy.stateful:
+            state["comm_e"] = policy.residual_for(params)
+        return state
 
     # one abstract trace serves both the param specs and the state layout
     state_abs = jax.eval_shape(init_state, key0)
@@ -443,6 +476,8 @@ def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
     p_specs = build_param_specs(abs_params, mesh, meta_layer, zero_stage)
     state_sh = build_state_shardings(state_abs, p_specs, mesh,
                                      max(zero_stage, 1), abs_params)
+    if policy.stateful:
+        state_sh["comm_e"] = NamedSharding(mesh, P())
     state0 = jax.jit(init_state, out_shardings=state_sh)(key0)
-    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate)
+    step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate, policy)
     return step, state0

@@ -90,7 +90,8 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
                          growth_factor: float = 2.0,
                          donate: bool = True,
                          offload: bool = False,
-                         monitor=None):
+                         monitor=None,
+                         grad_comm=None):
     """Build the sharded train step.
 
     ``loss_of(params, *batch) -> scalar``.  Returns ``(step, state0)`` with
@@ -102,11 +103,29 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     step with host-side timing outside the jit boundary (compiled program
     identical either way; ``None`` returns the bare step).
 
+    ``grad_comm``: gradient-communication policy (``"fp32"`` default /
+    ``"bf16"`` / ``"int8_ef"`` / a ``grad_comm.GradCommPolicy``), applied
+    to the unscaled fp32 gradients RIGHT BEFORE the stage-2 sharding
+    constraint — the reduce-scatter seam — so the value GSPMD scatters is
+    the policy's compressed-then-decompressed gradient.  On this GSPMD
+    path XLA owns the collective schedule, so the policy governs numerics
+    + byte accounting; the true int8-hop composition lives in the
+    shard_map trainers (docs/DISTRIBUTED_COMM.md).  Stateful policies add
+    a flat ``"comm_e"`` error-feedback residual to the state, sharded
+    over the "sharding" axis when divisible.
+
     ``offload=True`` (≙ sharding_configs offload) routes through
     ``make_zero_offload_train_step``: optimizer slots + masters in host
     memory, update on the host CPU backend (no dynamic loss scaling there —
     offload targets memory-bound fp32/bf16 runs).
     """
+    from .grad_comm import apply_policy_local, comm_info, resolve_policy
+    policy = resolve_policy(grad_comm)
+    if offload and policy.name != "fp32":
+        raise NotImplementedError(
+            "offload=True with grad_comm != 'fp32' is not wired: the "
+            "offload path's wire is PCIe (host<->device), not ICI — "
+            "compressing it is a different policy axis")
     if offload:
         if dynamic_loss_scale:
             raise NotImplementedError(
@@ -138,6 +157,8 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     }
     state0 = {"params": params0, "opt": opt_state0, "master": master0,
               "scaler": scaler0}
+    if policy.stateful:
+        state0["comm_e"] = policy.residual_for(params0)
 
     rep = NamedSharding(mesh, P())
     p_sh = {k: NamedSharding(mesh, p_specs[k]) for k in params0}
@@ -155,6 +176,14 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         "master": {k: s_sh[k] for k in master0},
         "scaler": {k: rep for k in scaler0},
     }
+    if policy.stateful:
+        # flat EF residual rides the "sharding" axis when divisible (block
+        # padding makes power-of-two degrees always divide), so ZeRO's
+        # memory story extends to the comm state
+        deg = mesh.shape.get("sharding", 1)
+        e_len = int(state0["comm_e"].shape[0])
+        state_sh["comm_e"] = NamedSharding(
+            mesh, P("sharding") if deg > 1 and e_len % deg == 0 else P())
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, lr, *batch):
@@ -168,18 +197,25 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
         grads = jax.tree_util.tree_map(
             lambda g: g.astype(jnp.float32) * inv, grads)
+
+        # found_inf BEFORE clip (check_finite_and_unscale ordering), and
+        # before grad-comm compression (quantizing a non-finite tree is
+        # undefined; the step is skipped either way)
+        found_inf = functools.reduce(
+            jnp.logical_or,
+            [jnp.any(~jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)],
+            jnp.zeros([], jnp.bool_))
+
+        # the reduce-scatter seam: compress here so the value the stage-2
+        # constraint scatters is the policy's dequantized grad
+        grads, comm_state = apply_policy_local(policy, grads, state,
+                                               found_inf=found_inf)
         if zero_stage >= 2:
             # stage-2 contract: gradients land reduce-scattered over the
             # sharding axis (GSPMD turns the dp reduction + this constraint
             # into reduce_scatter; ≙ ShardingOptimizerStage2 grad buckets)
             grads = {k: jax.lax.with_sharding_constraint(
                 g, s_sh[k]) for k, g in grads.items()}
-
-        # found_inf BEFORE clip (check_finite_and_unscale ordering)
-        found_inf = functools.reduce(
-            jnp.logical_or,
-            [jnp.any(~jnp.isfinite(g)) for g in jax.tree_util.tree_leaves(grads)],
-            jnp.zeros([], jnp.bool_))
 
         upd_params = {k: state["master"].get(k, p)
                       for k, p in state["params"].items()}
@@ -214,14 +250,15 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
 
         new_state = {"params": new_params, "opt": new_opt, "master": new_master,
                      "scaler": {"scale": new_scale, "good_steps": good,
-                                "found_inf": found_inf}}
+                                "found_inf": found_inf}, **comm_state}
         return new_state, loss
 
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
         is_leaf=lambda x: hasattr(x, "shape"))
     from ..telemetry import instrument_train_step
-    return instrument_train_step(step, monitor, "zero"), state0
+    return instrument_train_step(step, monitor, "zero",
+                                 comm=comm_info(params0, policy)), state0
 
 
 def make_zero_offload_train_step(loss_of: Callable, params0: Dict[str, Any],

@@ -144,7 +144,8 @@ def _maybe_skip_update(optimizer, grads, state, lr, found_inf):
 
 
 def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
-                    trace_ctx=None, scaler_cfg=None, monitor=None):
+                    trace_ctx=None, scaler_cfg=None, monitor=None,
+                    grad_comm=None):
     """Build a jit-compiled train step closure over (layer, loss, optimizer).
 
     Returns ``(step, state0)`` where
@@ -162,41 +163,62 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
     host-side timing OUTSIDE the jit boundary — the compiled program (and
     its cache key) is identical with or without one, and ``monitor=None``
     returns the bare step.
+    ``grad_comm``: gradient-communication policy (``"fp32"`` default /
+    ``"bf16"`` / ``"int8_ef"`` / a ``distributed.grad_comm
+    .GradCommPolicy``).  This builder has no mesh, so the policy applies
+    in LOCAL mode — the quantize/EF numerics of the wire composition at
+    R=1 (docs/DISTRIBUTED_COMM.md); stateful policies add a
+    ``"comm_e"`` residual leaf to the TrainState.
     """
+    from ..distributed.grad_comm import (apply_policy_local, comm_info,
+                                         resolve_policy)
+    policy = resolve_policy(grad_comm)
     apply_fn, params0, buffers0 = functionalize(layer)
     opt_state0 = optimizer.init_state(params0)
     scaler = _make_scaler(scaler_cfg)
     state0 = {"params": params0, "opt": opt_state0, "buffers": buffers0}
     if scaler is not None:
         state0["scaler"] = scaler.init_state()
+    if policy.stateful:
+        state0["comm_e"] = policy.residual_for(params0)
     loss_of = _make_loss_of(apply_fn, loss_fn, trace_ctx)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, key, lr, inputs, labels):
         loss, new_b, out, grads, scaler_state, found_inf = _scaled_grads(
             loss_of, state, key, inputs, labels, scaler)
+        grads, comm_state = apply_policy_local(policy, grads, state,
+                                               found_inf=found_inf)
         new_params, new_opt = _maybe_skip_update(optimizer, grads, state, lr,
                                                  found_inf)
         return {"params": new_params, "opt": new_opt, "buffers": new_b,
-                **scaler_state}, (loss, out)
+                **scaler_state, **comm_state}, (loss, out)
 
     from ..telemetry import instrument_train_step
     return instrument_train_step(_tracks_compiled_calls(step), monitor,
-                                 "train_step"), state0
+                                 "train_step",
+                                 comm=comm_info(params0, policy)), state0
 
 
 def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
-                          donate: bool = True, trace_ctx=None, monitor=None):
+                          donate: bool = True, trace_ctx=None, monitor=None,
+                          grad_comm=None):
     """Gradient-accumulating train step (≙ GradientMergeOptimizer,
     fluid/optimizer.py:6783): grads from ``accum_steps`` consecutive calls
     are summed in the TrainState; the optimizer applies their mean on every
     ``accum_steps``-th call (lax.cond — one compiled program, no Python
-    branching).  Same signature as make_train_step."""
+    branching).  Same signature as make_train_step.  ``grad_comm`` applies
+    at the accumulation boundary — the communication moment — so only the
+    every-``accum_steps`` exchange pays (and benefits from) compression."""
+    from ..distributed.grad_comm import comm_info, resolve_policy
+    policy = resolve_policy(grad_comm)
     apply_fn, params0, buffers0 = functionalize(layer)
     opt_state0 = optimizer.init_state(params0)
     acc0 = jax.tree.map(jnp.zeros_like, params0)
     state0 = {"params": params0, "opt": opt_state0, "buffers": buffers0,
               "acc": acc0, "acc_count": jnp.zeros((), jnp.int32)}
+    if policy.stateful:
+        state0["comm_e"] = policy.residual_for(params0)
     loss_of = _make_loss_of(apply_fn, loss_fn, trace_ctx)
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
@@ -205,24 +227,35 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
             state["params"], state["buffers"], key, inputs, labels)
         acc = jax.tree.map(lambda a, g: a + g, state["acc"], grads)
         cnt = state["acc_count"] + 1
+        e = state.get("comm_e")
 
         def apply(_):
             mean = jax.tree.map(lambda a: a / accum_steps, acc)
+            mean, new_e = policy.apply_local(mean, e)
             p, o = optimizer.update(mean, state["opt"], state["params"], lr=lr)
-            return p, o, jax.tree.map(jnp.zeros_like, acc), jnp.zeros((), jnp.int32)
+            return (p, o, jax.tree.map(jnp.zeros_like, acc),
+                    jnp.zeros((), jnp.int32), new_e)
 
         def hold(_):
-            return state["params"], state["opt"], acc, cnt
+            return state["params"], state["opt"], acc, cnt, e
 
-        params, opt, acc_out, cnt_out = jax.lax.cond(
+        params, opt, acc_out, cnt_out, e_out = jax.lax.cond(
             cnt >= accum_steps, apply, hold, None)
         new_state = {"params": params, "opt": opt, "buffers": new_b,
                      "acc": acc_out, "acc_count": cnt_out}
+        if policy.stateful:
+            new_state["comm_e"] = e_out
         return new_state, (loss, out)
 
     from ..telemetry import instrument_train_step
+    comm = comm_info(params0, policy)
+    if comm is not None:
+        # the exchange only runs every accum_steps-th call — amortize so
+        # per-step comm events stay truthful (ratio unchanged)
+        comm = dict(comm, pre_bytes=comm["pre_bytes"] // accum_steps,
+                    post_bytes=max(comm["post_bytes"] // accum_steps, 1))
     return instrument_train_step(_tracks_compiled_calls(step), monitor,
-                                 "accum_train_step"), state0
+                                 "accum_train_step", comm=comm), state0
 
 
 def make_eval_step(layer, loss_fn=None):

@@ -415,7 +415,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
                         remat: bool = True, donate: bool = True,
                         zero_stage: int = 0, dynamic_loss_scale: bool = False,
                         virtual_pp_degree: Optional[int] = None,
-                        monitor=None):
+                        monitor=None, grad_comm=None):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
@@ -426,11 +426,17 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     ``monitor``: optional ``telemetry.TrainMonitor``, forwarded to the
     underlying builder (pipeline/zero) or wrapped around the GSPMD step —
     pure host-side timing, compiled programs identical either way.
+    ``grad_comm``: gradient-communication policy ("fp32"/"bf16"/"int8_ef"
+    or a ``distributed.grad_comm.GradCommPolicy``), forwarded to the zero
+    or GSPMD builder; not wired for pp_degree>1 (the pipeline step owns
+    its own exchange schedule).
     """
+    from ..distributed.grad_comm import comm_info, resolve_policy
     from ..distributed.pipeline_engine import make_stacked_pipeline_step
     from ..distributed.spmd import make_gspmd_step_from_loss
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    policy = resolve_policy(grad_comm)
     mesh = hcg.mesh
     params0 = {n: p._data for n, p in model.named_parameters()}
     S = mesh.shape.get("pipe", 1)
@@ -438,6 +444,11 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     sp_mesh = mesh if (sp_mode and mesh.shape.get("sep", 1) > 1) else None
 
     if S > 1:
+        if policy.name != "fp32":
+            raise NotImplementedError(
+                "grad_comm with pp_degree>1 is not wired yet: the stacked "
+                "pipeline step owns its own exchange schedule; use "
+                "pp_degree=1 for compressed gradient collectives")
         if zero_stage > 0 or dynamic_loss_scale:
             raise NotImplementedError(
                 "zero_stage/dynamic_loss_scale with pp_degree>1 is not wired "
@@ -477,12 +488,14 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         inner_step, state0 = make_zero_train_step(
             loss_of, params0, optimizer, mesh, layer=model,
             zero_stage=zero_stage, dynamic_loss_scale=dynamic_loss_scale,
-            donate=donate, monitor=monitor)
+            donate=donate, monitor=monitor, grad_comm=policy)
     else:
         from ..telemetry import instrument_train_step
         inner_step, state0 = make_gspmd_step_from_loss(
-            loss_of, params0, optimizer, mesh, layer=model, donate=donate)
-        inner_step = instrument_train_step(inner_step, monitor, "gpt")
+            loss_of, params0, optimizer, mesh, layer=model, donate=donate,
+            grad_comm=policy)
+        inner_step = instrument_train_step(inner_step, monitor, "gpt",
+                                           comm=comm_info(params0, policy))
 
     def step(state, key, lr, x, labels):
         return inner_step(state, lr, key, x, labels)
@@ -493,7 +506,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
 def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
                                 zero_stage: int = 0, seed: int = 0,
                                 remat=True, donate: bool = True,
-                                monitor=None):
+                                monitor=None, grad_comm=None):
     """GPT train step whose parameters are initialized DIRECTLY sharded on
     the mesh — no host-side full-size materialization (GPT-3 6.7B fp32
     params are ~27GB on host with eager init).  Non-pipeline meshes only;
@@ -504,9 +517,15 @@ def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
     found_inf, dynamic loss scaling — live in make_gpt_train_step's
     make_zero_train_step route and are NOT applied on this path.
 
+    ``grad_comm``: gradient-communication policy (``"fp32"`` / ``"bf16"``
+    / ``"int8_ef"``), applied at the post-backward seam of the GSPMD step
+    (LOCAL mode — see distributed/grad_comm.py); stateful policies add a
+    flat ``"comm_e"`` residual leaf to the sharded TrainState.
+
     Returns ``(step, state0)`` with ``step(state, lr, key, x, labels)``.
     """
     from ..core import rng as _rng
+    from ..distributed.grad_comm import comm_info, resolve_policy
     from ..distributed.spmd import make_gspmd_sharded_init_step
 
     mesh = hcg.mesh
@@ -535,7 +554,10 @@ def make_sharded_gpt_train_step(cfg: GPTConfig, optimizer, hcg,
         return meta_model.head_loss_fn(params, h, labels)
 
     from ..telemetry import instrument_train_step
+    policy = resolve_policy(grad_comm)
     step, state0 = make_gspmd_sharded_init_step(
         loss_of, build, optimizer, mesh, meta_model, zero_stage=zero_stage,
-        donate=donate, seed=seed)
-    return instrument_train_step(step, monitor, "gpt_sharded"), state0
+        donate=donate, seed=seed, grad_comm=policy)
+    return instrument_train_step(
+        step, monitor, "gpt_sharded",
+        comm=comm_info(state0["params"], policy)), state0

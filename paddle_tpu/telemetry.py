@@ -72,6 +72,12 @@ the Paddle-profiler/fleet-metrics role for the TRAIN loop):
 ``aggregate``   one cross-host reduction of the step counters
                 (``fleet.metrics.all_reduce_metrics`` — global throughput
                 + per-replica straggler skew).
+``comm``        one gradient-communication accounting event (the
+                ``distributed.grad_comm`` policy layer): ``policy``,
+                ``pre_bytes`` (fp32-baseline wire bytes for the step's
+                reduction), ``post_bytes`` (the policy's), ``savings``
+                (pre/post) — host-side estimates from the grad-tree
+                shapes, never a device sync.
 
 No single reference counterpart: this is the serving-shaped composition of
 the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
@@ -499,6 +505,7 @@ class TrainMonitor:
         self._loss_n = 0
         self.last_loss: Optional[float] = None
         self._last_scale: Optional[float] = None
+        self._comm_policy: Optional[str] = None
         self._warned_non_finite = False
         self.registry.histogram("step_seconds", DEFAULT_TIME_BUCKETS)
         self.registry.histogram("device_blocked_seconds",
@@ -578,6 +585,24 @@ class TrainMonitor:
         """One compiled-program build paid by the training loop (first call
         of an instrumented step, a bucketize miss, an AOT compile)."""
         return self.tracer.compile_event("train", key, False, wall_s)
+
+    def record_comm(self, policy: str, pre_bytes: int, post_bytes: int,
+                    **fields):
+        """One gradient-communication accounting event (the
+        ``distributed.grad_comm`` policy layer): ``pre_bytes`` is the
+        fp32-baseline wire estimate for this step's reduction,
+        ``post_bytes`` the active policy's.  Pure host arithmetic from
+        tree shapes — never a device sync."""
+        pre, post = int(pre_bytes), int(post_bytes)
+        reg = self.registry
+        reg.add("comm_steps")
+        reg.add("comm_pre_bytes", pre)
+        reg.add("comm_post_bytes", post)
+        self._comm_policy = policy
+        return self.tracer.emit(
+            "comm", policy=policy, pre_bytes=pre, post_bytes=post,
+            savings=(pre / post if post else None), step=self._step_idx,
+            **fields)
 
     # ---------------------------------------------------------- watchdog --
     def observe_loss(self, loss) -> Optional[str]:
@@ -758,7 +783,25 @@ class TrainMonitor:
                 "opt_bytes": int(reg.value("hbm_opt_bytes")),
                 "other_bytes": int(reg.value("hbm_other_bytes")),
             },
+            "comm": self._comm_summary(),
             "events_dropped": self.tracer.events_dropped,
+        }
+
+    def _comm_summary(self) -> Optional[Dict[str, Any]]:
+        """Aggregate grad-comm accounting (None when no policy reported):
+        total pre/post wire bytes over the run and their ratio — the
+        bytes-on-wire savings the active ``grad_comm`` policy delivers."""
+        if self._comm_policy is None:
+            return None
+        reg = self.registry
+        pre = int(reg.value("comm_pre_bytes"))
+        post = int(reg.value("comm_post_bytes"))
+        return {
+            "policy": self._comm_policy,
+            "steps": int(reg.value("comm_steps")),
+            "pre_bytes": pre,
+            "post_bytes": post,
+            "savings": (round(pre / post, 3) if post else None),
         }
 
     # ----------------------------------------------------------- exports --
@@ -801,7 +844,8 @@ def _default_batch_info(args) -> Tuple[int, int]:
 
 def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
                           name: str = "train",
-                          batch_info: Optional[Callable] = None) -> Callable:
+                          batch_info: Optional[Callable] = None,
+                          comm: Optional[Dict[str, Any]] = None) -> Callable:
     """Wrap a train-step callable with per-call TrainMonitor timing.
 
     ``monitor=None`` returns ``step`` UNCHANGED — the builders' zero-cost-
@@ -814,7 +858,11 @@ def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
     jit API surface (``lower`` /
     ``eval_shape`` / ``trace`` / ``clear_cache``) passes through to the
     SAME underlying program — cache keys and lowerings are identical with
-    telemetry on or off."""
+    telemetry on or off.
+
+    ``comm``: optional ``{"policy", "pre_bytes", "post_bytes"}`` dict (a
+    ``grad_comm`` policy's wire estimate for one step's reduction) — each
+    steady-state call additionally records a ``comm`` accounting event."""
     if monitor is None:
         return step
     import jax
@@ -839,6 +887,8 @@ def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
                             else _default_batch_info(args))
         monitor.record_step(time.perf_counter() - t0, trainer=name,
                             examples=examples, tokens=tokens)
+        if comm is not None:
+            monitor.record_comm(**comm)
         return out
 
     for attr in ("lower", "eval_shape", "trace", "clear_cache"):
@@ -893,7 +943,7 @@ def events_to_chrome(events: List[Dict[str, Any]],
             out.append({"name": ev["kind"], "cat": "train", "ph": "X",
                         "pid": _TRAIN_PID, "tid": ev["kind"],
                         "ts": us - dur, "dur": dur, "args": args})
-        elif ev["kind"] in ("watchdog", "amp", "hbm", "aggregate"):
+        elif ev["kind"] in ("watchdog", "amp", "hbm", "aggregate", "comm"):
             name = ev.get("what", ev["kind"])
             out.append({"name": f"{ev['kind']}:{name}"
                         if "what" in ev else ev["kind"],

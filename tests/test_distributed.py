@@ -105,6 +105,40 @@ class TestCollectives:
         out = f(jnp.asarray(data))
         np.testing.assert_allclose(np.asarray(out).reshape(-1), [3, 0, 1, 2])
 
+    @needs8
+    def test_allreduce_prod_signs_and_zeros(self):
+        """ReduceOp.PROD regression: exp(psum(log(t))) NaN'd on any
+        non-positive entry; the log-abs + sign-parity + any-zero
+        decomposition must match the numpy product exactly in sign and
+        to fp tolerance in magnitude."""
+        import paddle_tpu.distributed as dist
+        mesh = Mesh(np.array(local_devices()[:4]), ("x",))
+        g = dist.Group(ranks=[0, 1, 2, 3], axis_name="x")
+
+        def body(x):
+            return dist.all_reduce(jnp.squeeze(x, 0),
+                                   op=dist.ReduceOp.PROD, group=g)[None]
+        f = jax.jit(shard_map(body, mesh=mesh, in_specs=P("x"),
+                              out_specs=P("x")))
+        cases = [
+            np.array([[2.0, 1.0], [-3.0, 2.0], [0.5, -4.0], [-1.0, 0.5]],
+                     np.float32),                       # mixed signs
+            np.array([[2.0, 1.0], [-3.0, 0.0], [0.0, -4.0], [-1.0, 3.0]],
+                     np.float32),                       # zeros -> exactly 0
+        ]
+        for data in cases:
+            out = np.asarray(f(jnp.asarray(data)))
+            expect = data.prod(axis=0)
+            np.testing.assert_allclose(out[0], expect, rtol=1e-5, atol=0.0)
+            for r in range(4):
+                np.testing.assert_allclose(out[r], expect, rtol=1e-5,
+                                           atol=0.0)
+        # integer dtype: exp(Σlog) lands at 41.99999…; the result must be
+        # ROUNDED back to the exact product, not truncated to 41
+        idata = np.array([[2], [3], [7], [1]], np.int32)
+        iout = np.asarray(f(jnp.asarray(idata)))
+        np.testing.assert_array_equal(iout.ravel(), [42, 42, 42, 42])
+
     def test_solo_group_identity(self):
         import paddle_tpu.distributed as dist
         g = dist.Group(ranks=[0], axis_name="solo")

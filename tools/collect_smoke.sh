@@ -52,6 +52,32 @@ then
     echo "COLLECT SMOKE FAILED: training telemetry import / JSONL merge"
     exit 1
 fi
+# grad-comm surface: the policy layer must import clean, the int8 local
+# round trip must run, the byte model must clear the 3.5x contract, and
+# the gpt_grad_comm bench config must be registered with a working
+# --help path
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'GCEOF'
+import jax.numpy as jnp
+from paddle_tpu.distributed.grad_comm import (
+    compressed_all_reduce, compressed_reduce_scatter,  # noqa: F401
+    resolve_policy, wire_bytes)
+p = resolve_policy("int8_ef")
+tree = {"w": jnp.ones((8, 64), jnp.float32)}
+out, e = p.apply_local(tree, None)
+assert e is not None and out["w"].shape == (8, 64)
+wb = wire_bytes(tree, p)
+assert wb["pre_bytes"] / wb["post_bytes"] >= 3.5, wb
+import bench
+assert "gpt_grad_comm" in bench.CONFIGS
+GCEOF
+then
+    echo "COLLECT SMOKE FAILED: grad_comm policy layer / bench config"
+    exit 1
+fi
+if ! python bench.py --help >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: bench.py --help"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via
