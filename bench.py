@@ -523,6 +523,83 @@ def bench_gpt_serving(on_tpu):
             }}
 
 
+def bench_gpt_serving_warmup(on_tpu):
+    """Cold-start vs warmed-start A/B on the ragged serving engine — the
+    compile-latency number (ISSUE 7): time from a fresh engine's first
+    add_request to its first token, and the count of XLA compiles paid ON
+    the serving path, with and without the AOT warmup pass
+    (engine.warmup() precompiles the whole (token_budget, table-width)
+    program grid before traffic).  The warmed engine must pay ZERO
+    in-serve compiles and a strictly lower first-token latency — both
+    asserted, so a regression fails the config rather than shading a
+    number."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+    from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+    from paddle_tpu.telemetry import Tracer
+
+    kv = os.environ.get("PADDLE_TPU_DECODE_KV") or None
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024,
+                        compute_dtype="bfloat16", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 8, 512, 16, 256
+        buckets, plen, n_new = [64, 128], 96, 32
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=128,
+                        compute_dtype="float32", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 2, 64, 8, 24
+        buckets, plen, n_new = [8, 16], 12, 4
+    rng = np.random.RandomState(0)
+    prompt = [int(t) for t in rng.randint(1, cfg.vocab_size, plen)]
+
+    def run_phase(warm):
+        # a fresh model per phase = a fresh program cache: the cold phase
+        # really pays its compiles, the warm phase really pre-pays them
+        paddle.seed(0)
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        tracer = Tracer(capacity=8192)
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=slots, max_len=max_len, block_size=bs,
+            prompt_buckets=buckets, token_budget=budget, tracer=tracer)
+        report = eng.warmup(max_workers=1) if warm else None
+        warm_misses = eng._compile_misses
+        seen = []
+        eng.add_request(list(prompt), n_new,
+                        on_token=lambda r, t, d: seen.append(t))
+        t0 = time.perf_counter()
+        while not seen:
+            eng.step()
+        first_s = time.perf_counter() - t0
+        eng.run_to_completion(max_ticks=1000)
+        return {
+            "first_token_ms": round(first_s * 1e3, 3),
+            "serve_compile_misses": eng._compile_misses - warm_misses,
+            "warmup_programs": 0 if report is None else report["programs"],
+            "warmup_wall_s": (None if report is None
+                              else round(report["wall_s"], 3)),
+            "compile": tracer.summary()["compile"],
+        }
+
+    cold = run_phase(False)
+    warmed = run_phase(True)
+    assert warmed["serve_compile_misses"] == 0, warmed
+    assert warmed["serve_compile_misses"] < cold["serve_compile_misses"], \
+        (cold, warmed)
+    assert warmed["first_token_ms"] < cold["first_token_ms"], (cold, warmed)
+    return {"metric": "gpt_serving_warmup_first_token_ms",
+            "value": warmed["first_token_ms"], "unit": "ms",
+            "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
+            "loss": 0.0, "backend": "tpu" if on_tpu else "cpu",
+            "cold": cold, "warm": warmed,
+            "first_token_speedup": round(
+                cold["first_token_ms"] / warmed["first_token_ms"], 3)}
+
+
 def bench_gpt_grad_comm(on_tpu):
     """Gradient-communication policy A/B on the sharded GPT trainer: one
     record comparing step time and bytes-on-wire across the grad_comm
@@ -616,6 +693,7 @@ CONFIGS = {
     "mnist_lenet": bench_mnist_lenet,
     "gpt_decode": bench_gpt_decode,
     "gpt_serving": bench_gpt_serving,
+    "gpt_serving_warmup": bench_gpt_serving_warmup,
     "gpt_grad_comm": bench_gpt_grad_comm,
 }
 
@@ -771,8 +849,20 @@ def _parent(names, attempts, timeout):
         if p < probe_tries - 1:
             time.sleep(probe_backoff)
     if not probe_ok:
-        errors.extend(probe_errors)  # only then are probe failures the story
-        attempts = 0  # every attempt would hang; emit structured errors now
+        # backend unhealthy ≠ benchmark failure: emit "skipped" records
+        # carrying the probe tail, so the perf trajectory stays parseable
+        # (an "error" here read as a code regression every infra-dead round)
+        for name in names:
+            print(json.dumps({
+                "metric": f"{name}_train_throughput", "value": None,
+                "unit": "skipped", "vs_baseline": None,
+                "vs_a100_flops": None,
+                "skipped": {"reason": "backend unhealthy (compute "
+                                      "round-trip probe failed — see "
+                                      "HEALTH.log)",
+                            "probe": probe_errors},
+            }), flush=True)
+        return 0
     for attempt in range(attempts):
         if not remaining:
             break

@@ -1,0 +1,35 @@
+"""Fixture: jit/shard_map wrappers constructed per iteration / per call —
+every construction is a new function object, so the jit cache never hits
+and each one pays a fresh trace + XLA compile."""
+import functools
+
+import jax
+from jax.experimental.shard_map import shard_map
+
+
+def per_batch(batches, mesh, spec):
+    outs = []
+    for b in batches:
+        f = jax.jit(lambda x: x * 2)          # BAD: new wrapper per batch
+        outs.append(f(b))
+    i = 0
+    while i < 3:
+        g = shard_map(lambda x: x, mesh=mesh,  # BAD: rebuilt per spin
+                      in_specs=spec, out_specs=spec)
+        outs.append(g(batches[0]))
+        i += 1
+    return outs
+
+
+def per_call(x):
+    return jax.jit(lambda a: a + 1)(x)        # BAD: rebuilt on every call
+
+
+def decorated_per_iteration(xs):
+    outs = []
+    for x in xs:
+        @functools.partial(jax.jit, donate_argnums=())   # BAD: decorator
+        def step(a):                                     # re-wraps per spin
+            return a * 2
+        outs.append(step(x))
+    return outs

@@ -532,3 +532,88 @@ class NoPrint(Rule):
                 "print() in library code — route through logging (see "
                 "profiler.stop_profiler) or, for a genuine CLI/console "
                 "contract, extend PRINT_ALLOWLIST with a justification")
+
+
+# ------------------------------------------------------------------- rule 9
+
+#: shard_map spellings (jax's, and the relative-import bare name the
+#: spmd compat adapter is bound to — relative imports are opaque to the
+#: import map, so the bare name is matched too)
+SHARD_MAP_NAMES = {"jax.shard_map", "jax.experimental.shard_map.shard_map",
+                   "paddle_tpu.distributed.spmd.shard_map", "shard_map"}
+
+
+@register
+class JitInHotLoop(Rule):
+    name = "jit-in-hot-loop"
+    hints = ("jit", "shard_map")
+    hazard = ("a jax.jit/shard_map wrapper constructed inside a loop — or "
+              "rebuilt and invoked per call — is a NEW function object each "
+              "time, so the jit cache can never hit: every iteration pays a "
+              "fresh trace + XLA compile (the recompile storms the serving "
+              "telemetry warns about, now preventable at review time)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        yield from self._in_loops(ctx)
+        yield from self._immediately_invoked(ctx)
+
+    def _wrapper_name(self, ctx: FileContext, call: ast.Call) -> Optional[str]:
+        """Resolved name when ``call`` constructs a jit/shard_map wrapper
+        (direct or through functools.partial); None otherwise."""
+        name = ctx.resolve(call.func)
+        if name in JIT_NAMES or name in SHARD_MAP_NAMES \
+                or (name or "").endswith(".shard_map"):
+            return name
+        if name in PARTIAL_NAMES or (name or "").endswith(".partial"):
+            if call.args:
+                inner = ctx.resolve(call.args[0])
+                if inner in JIT_NAMES or inner in SHARD_MAP_NAMES:
+                    return inner
+        return None
+
+    def _in_loops(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            kind = "while" if isinstance(node, ast.While) else "for"
+            for sub in _walk_skipping_nested_defs(node.body + node.orelse):
+                if isinstance(sub, ast.Call):
+                    name = self._wrapper_name(ctx, sub)
+                    if name:
+                        yield self.finding(
+                            ctx, sub,
+                            f"{name}() constructed inside a {kind} loop — "
+                            f"each iteration builds (and recompiles) a "
+                            f"fresh wrapper; hoist it out of the loop")
+                elif isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # the def statement itself re-executes per iteration,
+                    # and its decorators run with it
+                    if _jit_decorator_spec(ctx, sub) is not None:
+                        yield self.finding(
+                            ctx, sub,
+                            f"@jit-decorated {sub.name}() defined inside a "
+                            f"{kind} loop — the decorator re-wraps (and "
+                            f"recompiles) every iteration; define it once "
+                            f"outside")
+
+    def _immediately_invoked(self, ctx: FileContext) -> Iterable[Finding]:
+        # jax.jit(f)(args) inside a function body: wrapper and cache die
+        # with the expression, so every call of the enclosing function
+        # recompiles.  Restricted to jit/pjit — shard_map built inside an
+        # outer-jitted body traces once and is idiomatic (models/gpt.py);
+        # module-scope immediate invocation runs once per import and is
+        # likewise exempt.
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in _walk_skipping_nested_defs(fn.body):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Call)):
+                    continue
+                if _jit_call_spec(ctx, node.func) is not None:
+                    yield self.finding(
+                        ctx, node,
+                        f"jit wrapper built and invoked in one expression "
+                        f"inside {fn.name}() — its compile cache is "
+                        f"discarded after the call; build the jitted "
+                        f"function once outside")

@@ -478,6 +478,7 @@ def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
                                      max(zero_stage, 1), abs_params)
     if policy.stateful:
         state_sh["comm_e"] = NamedSharding(mesh, P())
+    # tpulint: disable=jit-in-hot-loop(one-shot sharded init at builder time, never per step)
     state0 = jax.jit(init_state, out_shardings=state_sh)(key0)
     step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate, policy)
     return step, state0

@@ -8,10 +8,22 @@ import jax
 
 from ..core.tensor import Tensor
 
+#: lowered-program digest -> XLA cost-analysis flops.  flops() used to
+#: re-lower and re-COMPILE the whole model on every call (a multi-second
+#: stall for a one-number query); keyed on the lowered StableHLO text the
+#: cache is config-sensitive by construction (stride/padding/activation
+#: changes alter the program even when param shapes match), and only the
+#: compile — the expensive part — is skipped on a hit.
+_COST_CACHE: dict = {}
+
 
 def flops(net, input_size, custom_ops=None, print_detail=False):
     """Count FLOPs by tracing the jitted forward and summing XLA cost
-    analysis — strictly more accurate than the reference's per-layer hooks."""
+    analysis — strictly more accurate than the reference's per-layer hooks.
+    Every call re-lowers (cheap, and the source of the cache key); the
+    compile + cost_analysis result is cached per lowered program
+    (see _COST_CACHE)."""
+    from ..jit.aot import fingerprint
     from ..jit.functional import functionalize
     apply_fn, params, buffers = functionalize(net)
     x = jax.ShapeDtypeStruct(tuple(input_size), jax.numpy.float32)
@@ -24,15 +36,21 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
         jax.tree_util.tree_map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
         jax.tree_util.tree_map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
         x)
-    try:
-        cost = lowered.compile().cost_analysis()
-        fl = cost.get("flops", 0.0) if isinstance(cost, dict) else cost[0].get("flops", 0.0)
-    except Exception as e:
-        # warn loudly instead of silently reporting 0 FLOPs as a measurement
-        # (round-1 verdict: the bare `except: fl=0.0` hid failures)
-        import warnings
-        warnings.warn(f"XLA cost analysis unavailable: {e!r}; returning 0")
-        fl = 0.0
+    key = fingerprint("hapi_flops", lowered.as_text())
+    fl = _COST_CACHE.get(key)
+    if fl is None:
+        try:
+            cost = lowered.compile().cost_analysis()
+            fl = cost.get("flops", 0.0) if isinstance(cost, dict) else cost[0].get("flops", 0.0)
+            _COST_CACHE[key] = fl
+        except Exception as e:
+            # warn loudly instead of silently reporting 0 FLOPs as a
+            # measurement (round-1 verdict: the bare `except: fl=0.0` hid
+            # failures) — and never cache the failure, so a recovered
+            # backend re-measures
+            import warnings
+            warnings.warn(f"XLA cost analysis unavailable: {e!r}; returning 0")
+            fl = 0.0
     if print_detail:
         print(f"Total FLOPs: {fl:,.0f}")
     return int(fl)

@@ -19,8 +19,13 @@ import numpy as np
 from ..core.dtype import convert_dtype
 from ..core.tensor import Tensor
 from .functional import (functionalize, make_eval_step, make_train_step,  # noqa: F401
-                         sync_state_to_layer, unwrap_tree, wrap_tree)
-from .bucketing import bucketize, length_mask, pad_to_bucket  # noqa: F401
+                         sync_state_to_layer, unwrap_tree, warm_train_step,
+                         wrap_tree)
+from .bucketing import (bucketize, length_mask, pad_to_bucket,  # noqa: F401
+                        pow2_bucket, pow2_grid)
+from .aot import (ExecutableCache, compile_aot,  # noqa: F401
+                  enable_persistent_compilation_cache, fingerprint,
+                  run_warmup, warmup_async)
 
 
 class InputSpec:

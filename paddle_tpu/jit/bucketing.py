@@ -40,6 +40,34 @@ def select_bucket(length: int, buckets: Sequence[int]) -> int:
     return bucket
 
 
+def pow2_bucket(need: int, cap: int) -> int:
+    """Smallest power of two covering ``need``, clamped to ``cap`` — the
+    table-width bucket policy shared by the paged/ragged serving engines'
+    view selection AND their warmup grids, so the set of programs warmup
+    precompiles is by construction the set serving can dispatch."""
+    C = 1
+    while C < max(int(need), 1):
+        C *= 2
+    return min(C, int(cap))
+
+
+def pow2_grid(cap: int):
+    """Every value :func:`pow2_bucket` can return for a given ``cap``:
+    powers of two below it plus the clamp value itself — the full
+    table-width compile grid a paged/ragged engine enumerates for warmup
+    (at most ``log2(cap) + 1`` entries)."""
+    cap = int(cap)
+    if cap < 1:
+        raise ValueError("cap must be >= 1")
+    out = []
+    C = 1
+    while C < cap:
+        out.append(C)
+        C *= 2
+    out.append(cap)
+    return tuple(out)
+
+
 def pad_to_bucket(x, bucket: int, axis: int, pad_value=0):
     """Pad ``x`` along ``axis`` up to ``bucket`` with ``pad_value``."""
     cur = x.shape[axis]
@@ -115,8 +143,34 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
 
         return jax.tree_util.tree_map(unpad, out)
 
+    def warmup(*args, **kwargs):
+        """Precompile EVERY bucket from one example call: each matching
+        array arg is padded/sliced along ``axis`` to each bucket width and
+        dispatched once (outputs discarded, compile accounting identical
+        to a real first call) — the grid-enumeration hook the AOT warmup
+        planner drives so no live request ever pays a bucket's first
+        compile.  Returns the list of buckets warmed this call."""
+        arrs = [a for a in args if hasattr(a, "shape") and a.ndim > axis]
+        if not arrs:
+            raise ValueError(f"no array argument with ndim > {axis}")
+        L = arrs[0].shape[axis]
+        warmed = []
+        for b in bkts:
+            def resize(a):
+                if not (hasattr(a, "shape") and a.ndim > axis
+                        and a.shape[axis] == L):
+                    return a
+                if a.shape[axis] > b:
+                    return jax.lax.slice_in_dim(a, 0, b, axis=axis)
+                return pad_to_bucket(a, b, axis, pad_value)
+            out = wrapper(*tuple(resize(a) for a in args), **kwargs)
+            jax.block_until_ready(out)
+            warmed.append(b)
+        return warmed
+
     wrapper.buckets = tuple(bkts)
     wrapper.bucket_calls = calls
+    wrapper.warmup = warmup
     return wrapper
 
 

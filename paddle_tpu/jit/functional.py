@@ -258,6 +258,26 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
                                  "accum_train_step", comm=comm), state0
 
 
+def warm_train_step(step, example_args, cache=None, monitor=None,
+                    label: str = "train_step", mesh=None):
+    """AOT-compile a built train step — the ``.lower().compile()`` warmup
+    seam for the step builders (make_train_step / make_accum_train_step /
+    make_gpt_train_step's GSPMD path all return steps whose ``lower``
+    passes through the telemetry wrappers, so the compiled program and
+    its cache key are the ones live dispatch would use; the zero_stage>0
+    gpt path raises NotImplementedError from ``lower``).
+
+    ``example_args`` are the step's call args (arrays or
+    ShapeDtypeStructs); ``cache``: an optional ``jit.aot.ExecutableCache``
+    — a second process warming against the same directory loads the
+    serialized executable instead of recompiling (``provenance: disk``).
+    Returns ``(compiled, provenance)``; call ``compiled(*args)`` in place
+    of ``step`` for a zero-compile first step."""
+    from .aot import compile_aot
+    return compile_aot(step, example_args, cache=cache, monitor=monitor,
+                       label=label, mesh=mesh)
+
+
 def make_eval_step(layer, loss_fn=None):
     apply_fn, _, _ = functionalize(layer)
 

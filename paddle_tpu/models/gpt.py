@@ -483,6 +483,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         h = model.scan_blocks(params, h, key, remat=remat, sp_mesh=sp_mesh)
         return model.head_loss_fn(params, h, labels)
 
+    raw_step = None
     if zero_stage > 0:
         from ..distributed.zero import make_zero_train_step
         inner_step, state0 = make_zero_train_step(
@@ -491,14 +492,37 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
             donate=donate, monitor=monitor, grad_comm=policy)
     else:
         from ..telemetry import instrument_train_step
-        inner_step, state0 = make_gspmd_step_from_loss(
+        raw_step, state0 = make_gspmd_step_from_loss(
             loss_of, params0, optimizer, mesh, layer=model, donate=donate,
             grad_comm=policy)
-        inner_step = instrument_train_step(inner_step, monitor, "gpt",
+        inner_step = instrument_train_step(raw_step, monitor, "gpt",
                                            comm=comm_info(params0, policy))
 
     def step(state, key, lr, x, labels):
         return inner_step(state, lr, key, x, labels)
+
+    if raw_step is not None:
+        # AOT seam (jit.functional.warm_train_step): an outer-order alias
+        # of the same program — jit-of-jit inlines at trace time, so the
+        # lowered/compiled executable is callable with step's PUBLIC
+        # signature (the bare pre-instrument step is traced: the monitor
+        # wrapper's host timing must never run under tracing)
+        step.lower = jax.jit(
+            lambda state, key, lr, x, labels: raw_step(
+                state, lr, key, x, labels),
+            donate_argnums=(0,) if donate else ()).lower
+    else:
+        # the zero step's bare program is not reachable from here, and
+        # compile_aot's jax.jit fallback would trace the monitor wrapper
+        # (corrupting its first-call compile accounting) — refuse loudly
+        def _no_lower(*args, **kwargs):
+            raise NotImplementedError(
+                "AOT lowering for zero_stage>0 gpt steps is not wired "
+                "(the ZeRO builder owns its state layout); warm the "
+                "zero_stage=0 GSPMD path, or rely on jit.aot."
+                "enable_persistent_compilation_cache for cross-process "
+                "reuse")
+        step.lower = _no_lower
 
     return step, state0
 

@@ -294,6 +294,7 @@ class ContinuousBatchingEngine:
             # exactly the allocation TP serving exists to avoid
             shapes = jax.eval_shape(
                 lambda: model.init_cache(self.S, self.max_len))
+            # tpulint: disable=jit-in-hot-loop(one-shot sharded alloc at engine construction, never on the request path)
             self.caches = jax.jit(
                 lambda: model.init_cache(self.S, self.max_len),
                 out_shardings=jax.tree.map(leaf_spec, shapes))()
@@ -581,6 +582,120 @@ class ContinuousBatchingEngine:
             return big_ck, big_cv, toks_out, presence      # toks (k, S)
 
         return run
+
+    # ------------------------------------------------------------- warmup --
+
+    def compile_grid(self) -> List[str]:
+        """Labels of every program family this engine can dispatch — the
+        declared compile grid the AOT warmup planner precompiles
+        (jit/aot.py; docs/COMPILATION.md)."""
+        return [t.label for t in self._warmup_tasks()]
+
+    def warmup(self, cache_dir=None, max_workers: int = 1,
+               block: bool = True):
+        """Precompile the engine's full program grid BEFORE traffic, so no
+        request ever pays an XLA compile stall on the serving path.
+
+        ``cache_dir``: also wires jax's persistent compilation cache there,
+        making the compiles durable across processes — a later engine (or
+        restart) warming against the same directory re-traces but skips
+        XLA, and its compile events carry ``provenance: disk``.
+        ``block=False`` runs on a background thread and returns the report
+        Future (``jit.aot.warmup_async``); requests admitted mid-warmup
+        simply compile what they need first.
+
+        Each task dispatches against freshly allocated scratch caches
+        (donated and freed immediately), a constant key, and zeroed
+        metadata: live engine state, the sampling key stream, and request
+        outputs are untouched — a warmed engine serves token-for-token
+        what an unwarmed one would.  Transient memory: each IN-FLIGHT
+        task holds one scratch cache allocation, so peak extra HBM is
+        ``max_workers`` cache copies on top of the live cache — keep the
+        default ``max_workers=1`` on memory-tight configs.  With a tracer
+        attached the run sits in an ``expected_compiles`` window (compile
+        events tagged, storm warning ignores them)."""
+        if self.mesh is not None:
+            # scratch caches come from _alloc_caches (host layout); the TP
+            # engine's live caches are mesh-sharded, so a scratch dispatch
+            # would compile a DIFFERENT program than serving uses — worse
+            # than no warmup (it hides the stall behind a false green)
+            raise NotImplementedError(
+                "warmup v1 is single-mesh; TP serving engines compile on "
+                "first dispatch (persistent-cache reuse still applies via "
+                "jit.aot.enable_persistent_compilation_cache)")
+        from .jit.aot import run_warmup, warmup_async
+        tasks = self._warmup_tasks()
+        kw = dict(tracer=self.tracer, cache_dir=cache_dir,
+                  max_workers=max_workers)
+        if block:
+            return run_warmup(tasks, **kw)
+        return warmup_async(tasks, **kw)
+
+    def _prefill_seg_tasks(self):
+        """Prefill-bucket + chunked-seg warmup tasks — ONE enumeration
+        shared by the contiguous and paged grids (the paged engine
+        overrides only the dispatch helpers and its decode family), so
+        the two engines' seg-variant sets cannot drift."""
+        from .jit.aot import WarmupTask
+        tasks = []
+        chunk = self.prefill_chunk
+        for P in self.buckets:
+            if chunk is not None and P > chunk:
+                continue                  # chunked buckets use seg programs
+            tasks.append(WarmupTask(f"prefill:{P}",
+                                    partial(self._warmup_prefill, P)))
+        if chunk is not None:
+            combos = sorted({(i == 0, i == P // chunk - 1)
+                             for P in self.buckets if P > chunk
+                             for i in range(P // chunk)})
+            for first, last in combos:
+                tasks.append(WarmupTask(
+                    f"seg:{chunk}:{int(first)}{int(last)}",
+                    partial(self._warmup_seg, first, last)))
+        return tasks
+
+    def _warmup_tasks(self):
+        from .jit.aot import WarmupTask
+        tasks = self._prefill_seg_tasks()
+        tasks.append(WarmupTask("decode", self._warmup_decode))
+        return tasks
+
+    def _scratch_presence(self):
+        return None if self._presence is None \
+            else jnp.zeros_like(self._presence)
+
+    @staticmethod
+    def _warmup_key():
+        # constant: warmup must not advance the engine's sampling stream
+        # (a warmed sampled engine draws the same tokens as an unwarmed one)
+        return jax.random.key(0)
+
+    def _warmup_prefill(self, P: int):
+        run = self._prefill_prog(P)
+        ck, cv = self._alloc_caches()
+        jax.block_until_ready(run(
+            self.params, ck, cv, jnp.zeros((1, P), jnp.int32),
+            jnp.int32(0), jnp.int32(0), self._warmup_key(),
+            self._scratch_presence(), self._plane_operands()))
+
+    def _warmup_seg(self, first: bool, last: bool):
+        seg = self.prefill_chunk
+        run = self._seg_prog(seg, first, last)
+        ck, cv = self._alloc_caches()
+        jax.block_until_ready(run(
+            self.params, ck, cv, jnp.zeros((1, seg), jnp.int32),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            self._scratch_presence(), self._warmup_key(),
+            self._plane_operands()))
+
+    def _warmup_decode(self):
+        run = self._decode_prog_all()
+        ck, cv = self._alloc_caches()
+        z = jnp.zeros(self.S, jnp.int32)
+        jax.block_until_ready(run(
+            self.params, ck, cv, z, z, z, jnp.zeros(self.S, bool),
+            self._warmup_key(), self._scratch_presence(), z,
+            self._plane_operands()))
 
     # --------------------------------------------------------- scheduling --
 
@@ -1230,6 +1345,33 @@ class SpeculativeBatchingEngine(ContinuousBatchingEngine):
                                     pad_lens=pads)
 
         return big, dbig, lead, block
+
+    # ------------------------------------------------------------- warmup --
+
+    def _warmup_tasks(self):
+        from .jit.aot import WarmupTask
+        tasks = [WarmupTask(f"spec_prefill:{P}",
+                            partial(self._warmup_prefill, P))
+                 for P in self.buckets]
+        tasks.append(WarmupTask("spec_round", self._warmup_spec_round))
+        return tasks
+
+    def _warmup_prefill(self, P: int):
+        run = self._prefill_prog(P)
+        big = self._alloc_caches()
+        dbig = self._alloc_draft_caches()
+        jax.block_until_ready(run(
+            (self.params, self.draft_params), big, dbig,
+            jnp.zeros((1, P), jnp.int32), jnp.int32(0), jnp.int32(0),
+            self._warmup_key(), self._scratch_presence()))
+
+    def _warmup_spec_round(self):
+        run = self._spec_round_prog()
+        big = self._alloc_caches()
+        dbig = self._alloc_draft_caches()
+        z = jnp.zeros(self.S, jnp.int32)
+        jax.block_until_ready(run(
+            (self.params, self.draft_params), big, dbig, z, z, z))
 
     def _step_impl(self):
         """One scheduler round: admit (advancing any chunked fills in

@@ -54,7 +54,7 @@ import numpy as np
 
 from .serving import (ContinuousBatchingEngine,
                       SpeculativeBatchingEngine)
-from .jit.bucketing import select_bucket
+from .jit.bucketing import pow2_bucket, pow2_grid, select_bucket
 from .models._decode import (PagedKV, apply_repetition_penalty,
                              seed_presence, suppress_eos, suppress_eos_rows)
 
@@ -490,10 +490,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         # regardless of C — the table's parked columns are 0 there)
         ts = self._t[self._active] if self._active.any() else [0]
         need = -(-int(max(ts) + k) // self.bs)
-        C = 1
-        while C < need:
-            C *= 2
-        return min(C, self.MB)
+        return pow2_bucket(need, self.MB)
 
     def _build_decode_cols(self, C: int):
         k_ticks = self.ticks_per_sync
@@ -715,6 +712,53 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _decode_extra_operands(self):
         return (jnp.asarray(self._table),)
 
+    # ------------------------------------------------------------- warmup --
+
+    def _warmup_tasks(self):
+        """Paged grid: the shared prefill/seg enumeration (base class —
+        this engine overrides only the dispatch helpers) plus ONE decode
+        program per table-width bucket — pow2_grid(MB) is the exact set
+        _view_cols can select, so warmup covers every decode width
+        serving can dispatch.  Prefix-hit admission families ((bucket,
+        depth) cached-prefill programs) are compiled on demand: their
+        grid is data-dependent (sum over buckets of P/bs programs) and a
+        miss there costs one suffix program, not a storm."""
+        from .jit.aot import WarmupTask
+        tasks = self._prefill_seg_tasks()
+        for C in pow2_grid(self.MB):
+            tasks.append(WarmupTask(f"decode:{C}",
+                                    partial(self._warmup_decode_cols, C)))
+        return tasks
+
+    def _warmup_prefill(self, P: int):
+        run = self._prefill_prog(P)
+        ck, cv = self._alloc_caches()
+        jax.block_until_ready(run(
+            self.params, ck, cv, jnp.zeros((1, P), jnp.int32),
+            jnp.int32(0), jnp.zeros(P // self.bs, jnp.int32),
+            self._warmup_key(), self._scratch_presence(), jnp.int32(0),
+            self._plane_operands()))
+
+    def _warmup_seg(self, first: bool, last: bool):
+        seg = self.prefill_chunk
+        run = self._seg_prog(seg, first, last)
+        ck, cv = self._alloc_caches()
+        jax.block_until_ready(run(
+            self.params, ck, cv, jnp.zeros((1, seg), jnp.int32),
+            jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            self._scratch_presence(), self._warmup_key(),
+            jnp.zeros(self.MB, jnp.int32), self._plane_operands()))
+
+    def _warmup_decode_cols(self, C: int):
+        run = self._cached_prog(("decode", C, self._sig),
+                                lambda: self._build_decode_cols(C))
+        ck, cv = self._alloc_caches()
+        z = jnp.zeros(self.S, jnp.int32)
+        jax.block_until_ready(run(
+            self.params, ck, cv, jnp.zeros((self.S, self.MB), jnp.int32),
+            z, z, z, jnp.zeros(self.S, bool), self._warmup_key(),
+            self._scratch_presence(), z, self._plane_operands()))
+
     METRICS_SCHEMA = {
         "blocks_in_use": ("gauge", float),
         "blocks_high_water": ("gauge", float),
@@ -924,10 +968,7 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
                 return self._build_pack()
             return None
         need_cols = -(-(int(row_pos[:n].max()) + 1) // self.bs)
-        C = 1
-        while C < need_cols:
-            C *= 2
-        C = min(C, self.MB)
+        C = pow2_bucket(need_cols, self.MB)
         if dec_slots and fill_adv:
             self._stats.add("mixed_steps")
         return (toks, row_seq, row_pos, C, sample_rows, sample_active,
@@ -1036,6 +1077,38 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
             return pool_ck, pool_cv, ntok, presence
 
         return run
+
+    # ------------------------------------------------------------- warmup --
+
+    def _warmup_tasks(self):
+        """The ragged engine's whole compile grid is ONE program per
+        (token_budget, table-width bucket) — pow2_grid(MB) enumerates it
+        completely, so a warmed engine never compiles on the serving
+        path (compile count 0 for ANY arrival pattern)."""
+        from .jit.aot import WarmupTask
+        return [WarmupTask(f"ragged_step:{self.token_budget}:{C}",
+                           partial(self._warmup_ragged, C))
+                for C in pow2_grid(self.MB)]
+
+    def _ragged_scratch_args(self, C: int):
+        """Scratch operand tuple for one table-width bucket's ragged
+        program: fresh pools (donated and freed), rows all parked on slot
+        0 / the trash table — values are irrelevant, shapes and dtypes
+        ARE the program signature (the purity test lowers through these)."""
+        ck, cv = self._alloc_caches()
+        T, S = self.token_budget, self.S
+        z = jnp.zeros(S, jnp.int32)
+        return (self.params, ck, cv, jnp.zeros(T, jnp.int32),
+                jnp.zeros(T, jnp.int32),
+                jnp.minimum(jnp.arange(T, dtype=jnp.int32),
+                            C * self.bs - 1),
+                jnp.zeros((S, C), jnp.int32), z, z,
+                jnp.zeros(S, bool), z, self._warmup_key(),
+                self._scratch_presence(), self._plane_operands())
+
+    def _warmup_ragged(self, C: int):
+        run = self._ragged_prog(C)
+        jax.block_until_ready(run(*self._ragged_scratch_args(C)))
 
     METRICS_SCHEMA = {
         "ragged_steps": ("counter", float),
@@ -1264,3 +1337,63 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
                     (dbig[0].pool, dbig[1].pool), lead, block)
 
         return run
+
+    # ------------------------------------------------------------- warmup --
+
+    def _warmup_tasks(self):
+        """The composition's grid: dual-pool prefill per (unchunked)
+        bucket, both spec-seg variants when chunking, and one spec round
+        per table-width bucket.  Prefix-hit (spec_cpre) families compile
+        on demand, as in the paged base."""
+        from .jit.aot import WarmupTask
+        tasks = []
+        chunk = self.prefill_chunk
+        for P in self.buckets:
+            if chunk is not None and P > chunk:
+                continue
+            tasks.append(WarmupTask(f"spec_prefill_paged:{P}",
+                                    partial(self._warmup_prefill, P)))
+        if chunk is not None and any(P > chunk for P in self.buckets):
+            # chunked buckets always have >= 2 segments, so both the
+            # non-final and final seg variants exist
+            for last in (False, True):
+                tasks.append(WarmupTask(f"spec_seg:{chunk}:{int(last)}",
+                                        partial(self._warmup_spec_seg,
+                                                last)))
+        for C in pow2_grid(self.MB):
+            tasks.append(WarmupTask(
+                f"spec_round_paged:{C}",
+                partial(self._warmup_spec_round_cols, C)))
+        return tasks
+
+    def _warmup_prefill(self, P: int):
+        run = self._prefill_prog(P)
+        pools = self._alloc_caches()
+        dpools = self._alloc_draft_caches()
+        jax.block_until_ready(run(
+            (self.params, self.draft_params), pools, dpools,
+            jnp.zeros((1, P), jnp.int32), jnp.int32(0),
+            jnp.zeros(P // self.bs, jnp.int32), self._warmup_key(),
+            self._scratch_presence(), jnp.int32(0)))
+
+    def _warmup_spec_seg(self, last: bool):
+        seg = self.prefill_chunk
+        run = self._cached_prog(("spec_seg", seg, last, self._sig),
+                                lambda: self._build_spec_seg(seg, last))
+        pools = self._alloc_caches()
+        dpools = self._alloc_draft_caches()
+        jax.block_until_ready(run(
+            (self.params, self.draft_params), pools, dpools,
+            jnp.zeros((1, seg), jnp.int32), jnp.int32(0), jnp.int32(0),
+            jnp.int32(0), self._scratch_presence(), self._warmup_key(),
+            jnp.zeros(self.MB, jnp.int32)))
+
+    def _warmup_spec_round_cols(self, C: int):
+        run = self._cached_prog(("spec_round_paged", C, self._sig),
+                                lambda: self._build_spec_round_paged(C))
+        pools = self._alloc_caches()
+        dpools = self._alloc_draft_caches()
+        z = jnp.zeros(self.S, jnp.int32)
+        jax.block_until_ready(run(
+            (self.params, self.draft_params), pools, dpools,
+            jnp.zeros((self.S, C), jnp.int32), z, z, z))

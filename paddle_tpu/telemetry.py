@@ -23,10 +23,15 @@ Event kinds (each event is one flat JSON-serializable dict):
              ``compiles`` (program-cache misses paid inside the tick).
 ``compile``  one program-cache MISS: ``key`` (short label), ``wall_s``
              (host wall time of the program's first dispatch — trace +
-             XLA compile + first execution), ``engine``.  Hits are
-             counter-only (``compile_hits`` in the registry, plus the
-             tick's ``programs`` labels) so steady-state fetches cannot
-             evict tick/request history from the ring.
+             XLA compile + first execution), ``engine``, ``provenance``
+             (``cold`` = paid an XLA compile, ``disk`` = served by the
+             persistent compilation cache, ``warm`` = already in process
+             — jit/aot.py), and ``expected`` (True inside an
+             ``expected_compiles`` warmup window, where misses never arm
+             the recompile-storm warning).  Hits are counter-only
+             (``compile_hits`` in the registry, plus the tick's
+             ``programs`` labels) so steady-state fetches cannot evict
+             tick/request history from the ring.
 ``request``  one request state transition: ``rid`` plus ``what`` in
              ``queued`` → ``admitted`` → ``first_token`` → ``token`` →
              (``preempted`` → ``admitted`` → …) → ``retired``.
@@ -87,6 +92,7 @@ the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
 from __future__ import annotations
 
 import collections
+import contextlib
 import functools
 import json
 import logging
@@ -227,6 +233,9 @@ class Tracer:
         self._post_warm_misses = 0
         self._warned_storm = False
         self._ticks = 0
+        self._warmup_depth = 0            # expected_compiles nesting
+        self._prov_resolver = None        # compile provenance (jit/aot.py)
+        self._expected_keys = None        # warmup-grid labels, or None=all
         self._log = logger if logger is not None \
             else logging.getLogger(__name__)
         # histograms live in the registry so prometheus_text() exports them
@@ -268,26 +277,90 @@ class Tracer:
             self._append(ev)
         return ev
 
+    @contextlib.contextmanager
+    def expected_compiles(self, provenance_resolver=None, keys=None):
+        """Mark a warmup window (``jit/aot.py`` wraps warmup runs in one):
+        program-cache misses inside it that belong to the warmup grid are
+        EXPECTED — they are tagged ``expected: true``, never count toward
+        the recompile-storm warning, and their ``provenance`` resolves
+        through ``provenance_resolver`` (a callable returning ``"cold"``
+        or ``"disk"``; the aot planner passes a persistent-cache-dir
+        prober) instead of defaulting to ``cold``.
+
+        ``keys``: the grid's program labels (``WarmupTask.label``); with
+        a background warmup (``warmup_async``) live traffic compiles
+        CONCURRENTLY with the window, and only grid programs may be
+        excused — a real recompile storm must still arm the warning.
+        None = every miss in the window is expected (single-purpose
+        tracer, the blocking-warmup case).  Re-entrant; resolver/keys
+        installed by the outermost entry win."""
+        with self._lock:
+            self._warmup_depth += 1
+            if provenance_resolver is not None \
+                    and self._prov_resolver is None:
+                self._prov_resolver = provenance_resolver
+                installed = True
+            else:
+                installed = False
+            if keys is not None and self._expected_keys is None:
+                self._expected_keys = frozenset(keys)
+                keys_installed = True
+            else:
+                keys_installed = False
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._warmup_depth -= 1
+                if installed:
+                    self._prov_resolver = None
+                if keys_installed:
+                    self._expected_keys = None
+
+    @staticmethod
+    def _in_grid(label: str, keys) -> bool:
+        """Whether an event label names a declared warmup task.  Task
+        labels may carry MORE trailing segments than program_label keeps
+        (e.g. task ``seg:8:01`` vs event label ``seg:8`` — bools end the
+        label's int run), so a task extending the label also matches."""
+        return label in keys \
+            or any(k.startswith(label + ":") for k in keys)
+
     def compile_event(self, engine: str, key, hit: bool,
-                      wall_s: float = 0.0):
+                      wall_s: float = 0.0, provenance: Optional[str] = None):
         """One program-cache access.  HITS are counter-only (several per
         tick at steady state — ring events for them would evict the tick/
         request history that summary() percentiles read); MISSES get a
         ring event, and misses after the first completed tick count toward
-        the recompile-storm warning."""
+        the recompile-storm warning — unless they fall inside an
+        ``expected_compiles`` warmup window.  ``provenance``
+        (``cold`` = paid an XLA compile, ``disk`` = loaded from the
+        persistent cache, ``warm`` = already in process) defaults to
+        ``warm`` for hits and ``cold`` for misses; warmup windows resolve
+        it through their prober (docs/COMPILATION.md)."""
         reg = self.registry
         if hit:
             reg.add("compile_hits")
             return None
         label = program_label(key)
+        with self._lock:
+            resolver = self._prov_resolver
+            keys = self._expected_keys
+            expected = self._warmup_depth > 0 and (
+                keys is None or self._in_grid(label, keys))
+        if provenance is None:
+            provenance = (resolver() if expected and resolver is not None
+                          else "cold")
         reg.add("compile_misses")
+        reg.add(f"compile_{provenance}")
         reg.observe("compile_seconds", wall_s)
         reg.add("compile_wall_seconds_sum", wall_s)
         warn = False
         with self._lock:
             ev = {"kind": "compile", "ts": self.now(), "engine": engine,
-                  "key": label, "hit": False, "wall_s": wall_s}
-            if self._ticks > 0:
+                  "key": label, "hit": False, "wall_s": wall_s,
+                  "provenance": provenance, "expected": expected}
+            if self._ticks > 0 and not expected:
                 self._post_warm_misses += 1
                 if (self._post_warm_misses >= self.recompile_warn_threshold
                         and not self._warned_storm):
@@ -408,6 +481,10 @@ class Tracer:
                 "misses": int(reg.value("compile_misses")),
                 "wall_s": float(reg.value("compile_wall_seconds_sum")),
                 "post_warmup_misses": self._post_warm_misses,
+                # provenance split (jit/aot.py): cold = paid XLA, disk =
+                # loaded from the persistent cache
+                "cold": int(reg.value("compile_cold")),
+                "disk": int(reg.value("compile_disk")),
             },
             "requests": self.request_summary(),
             "events_dropped": self.events_dropped,
@@ -581,10 +658,14 @@ class TrainMonitor:
         return self.tracer.emit("profiler_step", dur_s=wall_s,
                                 examples=int(samples))
 
-    def record_compile(self, key, wall_s: float):
+    def record_compile(self, key, wall_s: float,
+                       provenance: Optional[str] = None):
         """One compiled-program build paid by the training loop (first call
-        of an instrumented step, a bucketize miss, an AOT compile)."""
-        return self.tracer.compile_event("train", key, False, wall_s)
+        of an instrumented step, a bucketize miss, an AOT compile).
+        ``provenance``: ``cold``/``disk``/``warm`` — ``jit.aot
+        .compile_aot`` reports where the executable came from."""
+        return self.tracer.compile_event("train", key, False, wall_s,
+                                         provenance=provenance)
 
     def record_comm(self, policy: str, pre_bytes: int, post_bytes: int,
                     **fields):
@@ -762,6 +843,8 @@ class TrainMonitor:
                 "misses": int(reg.value("compile_misses")),
                 "hits": int(reg.value("compile_hits")),
                 "wall_s": float(reg.value("compile_wall_seconds_sum")),
+                "cold": int(reg.value("compile_cold")),
+                "disk": int(reg.value("compile_disk")),
                 "bucket_compiles": int(
                     get_stat("bucketize_bucket_compiles"))
                 - self._bucket_compiles0,

@@ -74,6 +74,7 @@ def run_check():
     x = jnp.ones((4, 4))
     for d in devs:
         y = jax.device_put(x, d)
+        # tpulint: disable=jit-in-hot-loop(run_check probes each device once at diagnosis time)
         out = jax.jit(lambda a: (a @ a).sum())(y)
         assert np.isfinite(float(out))
     g = jax.grad(lambda a: (a @ a).sum())(x)
@@ -83,6 +84,7 @@ def run_check():
         mesh = Mesh(np.array(devs), ("x",))
         xs = jax.device_put(jnp.ones((len(devs) * 2, 4)),
                             NamedSharding(mesh, P("x")))
+        # tpulint: disable=jit-in-hot-loop(run_check's one-shot sharded matmul probe)
         out = jax.jit(lambda a: (a @ a.T).sum(),
                       out_shardings=NamedSharding(mesh, P()))(xs)
         assert np.isfinite(float(out))

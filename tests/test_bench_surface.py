@@ -31,6 +31,30 @@ def test_bench_all_configs_cpu_child():
         assert r["backend"] == "cpu"
 
 
+def test_probe_failure_emits_skipped_not_error(monkeypatch, capsys):
+    """An unhealthy backend is NOT a benchmark failure: the parent emits
+    one ``unit: "skipped"`` record per config carrying the probe tail, so
+    the perf trajectory stays parseable (an "error" record here read as a
+    code regression every infra-dead round — BENCH_r05)."""
+    import json as _json
+
+    import bench
+    monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", "1")
+    monkeypatch.setenv("PADDLE_TPU_BENCH_PROBE_BACKOFF", "0")
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda timeout=0.0: (False, 1, "probe boom tail"))
+    rc = bench._parent(["gpt2s", "gpt_serving"], attempts=2, timeout=5)
+    assert rc == 0
+    recs = [_json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.strip().startswith("{")]
+    assert len(recs) == 2
+    for r in recs:
+        assert r["unit"] == "skipped" and r["value"] is None
+        assert "error" not in r
+        [probe] = r["skipped"]["probe"]
+        assert "probe boom tail" in probe["tail"]
+
+
 def test_analytic_flops_matches_6n_approximation():
     """_transformer_train_flops ≈ 6·N·tokens + attention term for gpt2s
     (Megatron/PaLM convention); guards the MFU denominator's honesty

@@ -26,6 +26,7 @@ BAD_FIXTURE = {
     "unseeded-nondeterminism": "distributed/bad_unseeded_nondeterminism.py",
     "import-time-device-touch": "bad_import_time_device_touch.py",
     "no-print": "bad_no_print.py",
+    "jit-in-hot-loop": "bad_jit_in_hot_loop.py",
 }
 CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
                  for rule, path in BAD_FIXTURE.items()}
@@ -155,3 +156,33 @@ def test_no_print_reports_stale_allowlist_entry():
     findings = lint_source(f"paddle_tpu/{rel}", "x = 1\n",
                            rules=[RULES["no-print"]])
     assert len(findings) == 1 and "stale" in findings[0].message
+
+
+def test_jit_in_hot_loop_flags_all_four_shapes():
+    """The bad fixture carries one positive per detection shape: jit in a
+    for loop, shard_map in a while loop, immediately-invoked jit inside a
+    function, and a jit-decorated def inside a loop (decorator re-runs
+    per iteration)."""
+    findings = _lint(FIXTURES / "bad_jit_in_hot_loop.py")
+    msgs = [f.message for f in findings if f.rule == "jit-in-hot-loop"]
+    assert len(msgs) == 5, msgs
+    assert sum("for loop" in m for m in msgs) == 3
+    assert sum("while loop" in m for m in msgs) == 1
+    assert sum("one expression" in m for m in msgs) == 1
+    assert sum("@jit-decorated" in m for m in msgs) == 1
+
+
+def test_jit_in_hot_loop_ignores_shard_map_invoked_inside_traced_body():
+    """shard_map built-and-called inside a function is the models/gpt.py
+    idiom (the body traces once under the outer jit) — only LOOP
+    construction of shard_map is a hazard."""
+    src = textwrap.dedent("""\
+        import functools
+        from paddle_tpu.distributed.spmd import shard_map
+
+        def block(q, mesh, spec):
+            return shard_map(functools.partial(sum), mesh=mesh,
+                             in_specs=spec, out_specs=spec)(q)
+    """)
+    assert lint_source("paddle_tpu/x.py", src,
+                       rules=[RULES["jit-in-hot-loop"]]) == []

@@ -78,6 +78,41 @@ if ! python bench.py --help >/dev/null 2>&1; then
     echo "COLLECT SMOKE FAILED: bench.py --help"
     exit 1
 fi
+# AOT surface: jit.aot must import clean, a tiny warmup→serve round trip
+# must record ZERO in-serve compile misses (the compile-once contract),
+# and the warmup CLI must self-describe
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'AOTEOF'
+from paddle_tpu.jit.aot import (ExecutableCache, compile_aot,  # noqa: F401
+                                fingerprint, run_warmup, warmup_async)
+from paddle_tpu.jit import warm_train_step  # noqa: F401 functional seam
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                num_attention_heads=2, max_position_embeddings=64,
+                compute_dtype="float32")
+model = GPTModel(cfg)
+params = {n: p._data for n, p in model.named_parameters()}
+eng = RaggedPagedContinuousBatchingEngine(
+    model, params, max_slots=2, max_len=32, block_size=8,
+    prompt_buckets=[8], token_budget=12)
+report = eng.warmup(max_workers=1)
+assert report["programs"] == len(eng.compile_grid()) >= 1, report
+before = eng._compile_misses
+eng.add_request([1, 2, 3], 2)
+out = eng.run_to_completion(max_ticks=50)
+assert eng._compile_misses == before, "warmup missed a program family"
+assert all(len(v) == 2 for v in out.values()), out
+import bench
+assert "gpt_serving_warmup" in bench.CONFIGS
+AOTEOF
+then
+    echo "COLLECT SMOKE FAILED: jit.aot import / warmup round trip"
+    exit 1
+fi
+if ! python tools/warmup.py --help >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: tools/warmup.py --help"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

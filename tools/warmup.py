@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Warm a serving engine's compile grid into a persistent cache directory.
+
+Builds a GPT model + serving engine from a named config, runs the AOT
+warmup pass (paddle_tpu.jit.aot) against ``--cache-dir``, and prints a
+one-line JSON report.  A serving host runs this BEFORE taking traffic (or
+once per image build): the engine process then warms from disk in seconds
+instead of paying multi-second XLA compiles, and the first request on
+every (token_budget, table-width) bucket is compile-free.
+
+Examples::
+
+    python tools/warmup.py --cache-dir /var/cache/paddle-tpu
+    python tools/warmup.py --cache-dir cache --engine paged \\
+        --preset gpt2-small --max-slots 8 --max-len 512 --buckets 64,128
+
+The report's ``compile.cold`` / ``compile.disk`` split shows whether this
+run paid XLA or reused the directory (docs/COMPILATION.md).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+ENGINES = ("ragged", "paged", "contiguous")
+PRESETS = ("tiny", "gpt2-small", "gpt2-medium", "gpt2-large")
+
+
+def _build_engine(args, tracer):
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, gpt_preset
+
+    if args.preset == "tiny":
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=max(128, args.max_len),
+                        compute_dtype="float32")
+    else:
+        cfg = gpt_preset(args.preset,
+                         max_position_embeddings=max(1024, args.max_len))
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    buckets = [int(b) for b in args.buckets.split(",")]
+    common = dict(max_slots=args.max_slots, max_len=args.max_len,
+                  prompt_buckets=buckets, tracer=tracer)
+    if args.engine == "ragged":
+        from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+        return RaggedPagedContinuousBatchingEngine(
+            model, params, block_size=args.block_size,
+            token_budget=args.token_budget, **common)
+    if args.engine == "paged":
+        from paddle_tpu.serving import PagedContinuousBatchingEngine
+        return PagedContinuousBatchingEngine(
+            model, params, block_size=args.block_size, **common)
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(model, params, **common)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="AOT-warm a serving engine's compile grid into a "
+                    "persistent cache dir (prints a JSON report)")
+    ap.add_argument("--cache-dir", required=True,
+                    help="persistent cache directory (created if missing); "
+                         "later processes pointing here skip XLA compiles")
+    ap.add_argument("--engine", choices=ENGINES, default="ragged")
+    ap.add_argument("--preset", choices=PRESETS, default="tiny",
+                    help="model config: 'tiny' (CPU smoke) or a GPT preset")
+    ap.add_argument("--max-slots", type=int, default=2)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--token-budget", type=int, default=24)
+    ap.add_argument("--buckets", default="8,16",
+                    help="comma-separated prompt buckets")
+    ap.add_argument("--max-workers", type=int, default=1,
+                    help=">1 compiles concurrently; provenance attribution "
+                         "may smear across simultaneous tasks, and peak "
+                         "scratch memory is max_workers KV-cache copies")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.telemetry import Tracer
+    tracer = Tracer(capacity=8192)
+    eng = _build_engine(args, tracer)
+    report = eng.warmup(cache_dir=args.cache_dir,
+                        max_workers=args.max_workers)
+    compile_summary = tracer.summary()["compile"]
+    print(json.dumps({
+        "engine": args.engine,
+        "preset": args.preset,
+        "cache_dir": report["cache_dir"],
+        "programs": report["programs"],
+        "grid": [t["label"] for t in report["tasks"]],
+        "wall_s": round(report["wall_s"], 3),
+        "compile": compile_summary,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
