@@ -94,18 +94,25 @@ def _run_timed(step, args, iters, monitor=None, examples_per_step=0,
 
     if not hasattr(step, "lower"):  # plain wrapper around an inner jit
         step = jax.jit(step, donate_argnums=(0,))
-    lowered = step.lower(*args)
     t_c = time.perf_counter()
+    lowered = step.lower(*args)
     compiled = lowered.compile()
     if monitor is not None:
+        # trace + XLA compile — the compile-event convention (telemetry.py)
         monitor.record_compile(("bench_step",), time.perf_counter() - t_c)
     flops = _flops_of(compiled)
 
     state, rest = args[0], args[1:]
+    t_w = time.perf_counter()
     state, loss = compiled(state, *rest)
     if isinstance(loss, tuple):
         loss = loss[0]
-    float(np.asarray(loss))  # warmup sync
+    warm_loss = float(np.asarray(loss))  # warmup sync
+    if monitor is not None:
+        # the warmup execute+fetch is device-blocked wall — record it so a
+        # goodput ledger attached to the monitor attributes it to compute
+        # instead of leaving a hole of unattributed time
+        monitor.record_sync(time.perf_counter() - t_w, loss=warm_loss)
 
     it_walls = []
     t0 = time.perf_counter()
@@ -189,6 +196,7 @@ def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
     from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
     from paddle_tpu.optimizer import AdamW
     from paddle_tpu.telemetry import TrainMonitor
+    from paddle_tpu.telemetry_ledger import RunLedger
 
     paddle.seed(0)
     cfg = GPTConfig(**(cfg_tpu if on_tpu else cfg_cpu))
@@ -202,6 +210,11 @@ def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
     y = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L)))
     args = (state, jax.random.key(0), np.float32(3e-4), x, y)
     mon = TrainMonitor()
+    # goodput ledger over the measured window: AOT compile → compile,
+    # warmup + final fetch → compute, per-iteration dispatch →
+    # host_dispatch; the remainder is unattributed and REPORTED as such
+    ledger = RunLedger()
+    mon.set_ledger(ledger)
     dt, loss, _ = _run_timed(step, args, iters, monitor=mon,
                              examples_per_step=B, tokens_per_step=B * L)
     flops = _transformer_train_flops(B, L, cfg.num_layers, cfg.hidden_size,
@@ -227,6 +240,14 @@ def _bench_gpt(metric, cfg_tpu, geom_tpu, cfg_cpu, geom_cpu, on_tpu):
         "hbm_opt_bytes": tel["hbm"]["opt_bytes"],
         "watchdog_non_finite": tel["watchdog"]["non_finite"],
         "watchdog_loss_spikes": tel["watchdog"]["loss_spikes"],
+    }
+    snap = ledger.snapshot()
+    out["telemetry"]["goodput"] = {
+        "goodput": round(snap["goodput"], 4),
+        "elapsed_s": round(snap["elapsed_s"], 3),
+        "buckets_s": {k: round(v, 4) for k, v in snap["buckets_s"].items()},
+        "unattributed_frac": round(snap["fractions"]["unattributed"], 4),
+        "overflow_s": round(snap["overflow_s"], 4),
     }
     return out
 
@@ -774,49 +795,65 @@ def _probe_backend(timeout=300.0):
     compute sentinel loop documented in BENCH_NOTES.md) — never a bare
     devices() call.
 
-    Claim hygiene (tpu_guard.sh header): the probe compiles+executes, so it
-    is a claim-HOLDER; killing it on timeout poisons the single-chip claim
-    for hours. So the probe is bounded by WAITING, not by killing: it runs
-    in its own session, and if it has not finished by the deadline we report
-    unhealthy and leave it to finish or error on its own.
+    The deadline is HARD: a probe still running at ``timeout`` gets its
+    whole process group SIGTERM'd (SIGKILL after a grace), and the real
+    exit status is recorded.  The earlier leave-it-running policy
+    ("rc=inflight ... [probe left running]", HEALTH.log 2026-08-01) traded
+    one poisoned claim for an orphan that held the claim INDEFINITELY and
+    queued every later probe behind the wedge — a bounded kill releases
+    the claim at a known time and leaves a real rc in the log instead of
+    a process leak.
 
-    Returns (healthy, rc, detail) where rc is 'inflight' if the probe was
-    left running at the deadline."""
+    Returns (healthy, rc, detail); rc is the child's true returncode
+    (negative = died on that signal number)."""
+    import signal as _signal
     import tempfile
     outf = tempfile.NamedTemporaryFile(mode="w+", suffix=".probe", delete=False)
-    exited = False
+    timed_out = False
+    proc = None            # Popen itself may raise; the finally must cope
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_SRC], stdout=outf, stderr=outf,
             start_new_session=True)
         deadline = time.time() + timeout
         while time.time() < deadline and proc.poll() is None:
-            time.sleep(2.0)
-        exited = proc.poll() is not None
+            time.sleep(min(2.0, max(0.05, timeout / 10.0)))
+        timed_out = proc.poll() is None
+        if timed_out:
+            # kill the whole group: the probe may have spawned a compile
+            # helper holding the claim (same escalation as _run_group)
+            for sig in (_signal.SIGTERM, _signal.SIGKILL):
+                try:
+                    os.killpg(proc.pid, sig)
+                except (ProcessLookupError, OSError):
+                    break
+                try:
+                    proc.wait(timeout=10)
+                    break
+                except subprocess.TimeoutExpired:
+                    continue
+            try:                      # reap so rc is real, never None
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
         outf.flush()
         with open(outf.name) as f:
             out = f.read()
     finally:
         outf.close()
-        # An in-flight probe keeps writing after we move on — keep its file
-        # (and say where it is) so the eventual traceback of a half-up wedge
-        # is not lost; that trace is the root-cause evidence HEALTH.log
-        # exists to point at.  Gate on the same `exited` the verdict uses:
-        # a probe finishing right after the deadline must not have the file
-        # we are about to advertise unlinked from under the log line.
-        if exited:
+        if proc is not None and proc.poll() is not None:
             try:
                 os.unlink(outf.name)
             except OSError:
                 pass
-    healthy = exited and proc.returncode == 0 and "COMPUTE_HEALTHY" in out
-    rc = proc.returncode if exited else "inflight"
+    rc = proc.returncode          # negative = killed by that signal
+    healthy = rc == 0 and not timed_out and "COMPUTE_HEALTHY" in out
     detail = next((ln for ln in out.splitlines()
                    if ln.startswith("COMPUTE_HEALTHY")), "")
     _health_log(f"probe rc={rc} {'ok ' + detail if healthy else 'FAIL'} "
                 + ("" if healthy else out[-200:].replace("\n", " "))
-                + ("" if exited else f" [probe left running; output -> "
-                                     f"{outf.name}]"))
+                + (f" [probe killed at {timeout:.0f}s deadline]"
+                   if timed_out else ""))
     return healthy, rc, out
 
 
@@ -841,11 +878,9 @@ def _parent(names, attempts, timeout):
                              "tail": "backend unhealthy (compute round-trip "
                                      "probe failed — see HEALTH.log): "
                                      + (probe_err or "")[-400:]})
-        if probe_rc == "inflight":
-            # Half-up backend: the probe is still dialing/compiling and was
-            # left alive (claim hygiene). Launching more probes would only
-            # queue behind the held claim and make the wedge worse.
-            break
+        # a timed-out probe was killed with its whole group (hard deadline,
+        # real rc) — the claim is released, so retrying after backoff is
+        # safe even for the half-up wedge case
         if p < probe_tries - 1:
             time.sleep(probe_backoff)
     if not probe_ok:

@@ -1,0 +1,20 @@
+"""Fixture: host-blocking fetches inside training loops (one finding per
+marked line — float(np.asarray(...)) is ONE combined fetch)."""
+import jax
+import numpy as np
+
+
+def train(step, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step(state, batch)
+        losses.append(float(np.asarray(loss)))   # BAD: combined fetch
+        loss.block_until_ready()                 # BAD: method sync
+        scalar = loss.item()                     # BAD: scalar fetch
+        jax.block_until_ready(state)             # BAD: function sync
+        host = np.asarray(loss)                  # BAD: bare fetch
+        del scalar, host
+    while losses:
+        pending = losses.pop()
+        _ = jax.device_get(pending)              # BAD: while-loop fetch
+    return losses

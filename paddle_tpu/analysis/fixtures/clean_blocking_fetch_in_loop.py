@@ -1,0 +1,24 @@
+"""Near-miss fixture: loops that touch device values WITHOUT blocking the
+host per iteration — metadata access, post-loop fetches, fetch code inside
+nested defs (only executed if called), and dict ``.items()`` (not the
+Tensor ``.item()`` scalar fetch)."""
+import numpy as np
+
+
+def train(step, state, batches):
+    loss = None
+    for batch in batches:
+        state, loss = step(state, batch)
+        shape = loss.shape            # metadata is free under async dispatch
+        del shape
+    return float(np.asarray(loss))    # ONE fetch, after the loop
+
+
+def table(rows):
+    out = []
+    for row in rows:
+        out.extend(row.items())       # dict items(), not a scalar fetch
+        def fetch():                  # defined per row, never called here
+            return np.asarray(row)
+        out.append(fetch)
+    return out

@@ -109,18 +109,28 @@ def _static_params(fn: ast.FunctionDef, spec: JitSpec) -> Set[str]:
 
 
 def _walk_skipping_nested_defs(body: Sequence[ast.stmt]) -> Iterable[ast.AST]:
-    """Walk statements without descending into nested def/class scopes —
-    nested functions trace only if called, and flagging their bodies against
-    the *outer* jit's params produces noise, not signal."""
+    """Walk statements without descending into nested def/class/lambda
+    BODIES — nested functions run only if called, and flagging their bodies
+    against the *outer* scope produces noise, not signal.  The scope nodes
+    themselves ARE yielded (rules flag e.g. a @jit def in a loop), and so
+    are the parts that DO execute with the enclosing statement: decorators,
+    default values/annotations, class bases (the pop-time guard; the old
+    child-only guard walked straight into defs that were direct statements
+    of the walked body)."""
     stack: List[ast.AST] = list(body)
     while stack:
         node = stack.pop()
         yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda, ast.ClassDef)):
-                continue
-            stack.append(child)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(node.decorator_list)
+            stack.extend(ast.iter_child_nodes(node.args))
+        elif isinstance(node, ast.Lambda):
+            stack.extend(ast.iter_child_nodes(node.args))
+        elif isinstance(node, ast.ClassDef):
+            stack.extend(node.decorator_list)
+            stack.extend(node.bases)
+        else:
+            stack.extend(ast.iter_child_nodes(node))
 
 
 def _jitted_functions(ctx: FileContext) -> List[Tuple[ast.FunctionDef, JitSpec]]:
@@ -617,3 +627,76 @@ class JitInHotLoop(Rule):
                         f"inside {fn.name}() — its compile cache is "
                         f"discarded after the call; build the jitted "
                         f"function once outside")
+
+
+# ------------------------------------------------------------------ rule 10
+
+#: resolved call fullnames that force a device→host round trip (the value
+#: must exist on host, so the async dispatch chain drains first)
+BLOCKING_FETCH_CALLS = {"numpy.asarray", "jax.device_get",
+                        "jax.block_until_ready"}
+#: zero-arg method names that block on a device value; ``item`` is the
+#: scalar fetch (``items``/``len`` etc. never match)
+BLOCKING_FETCH_METHODS = {"block_until_ready", "item"}
+
+
+@register
+class BlockingFetchInLoop(Rule):
+    name = "blocking-fetch-in-loop"
+    hints = ("asarray", "block_until_ready", ".item(", "device_get")
+    hazard = ("a host-blocking fetch (float(np.asarray(x)), np.asarray, "
+              ".item(), block_until_ready) inside a for/while training "
+              "loop drains the async dispatch chain EVERY iteration — host "
+              "and device serialize and the accelerator idles between "
+              "steps (the hapi fit loop fetches only at log_freq cadence "
+              "for exactly this reason; that site carries the canonical "
+              "allow pragma)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        seen: Set[int] = set()   # nested loops: one site reports ONCE
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            kind = "while" if isinstance(node, ast.While) else "for"
+            # float(np.asarray(x)) is ONE fetch: report the float() wrapper
+            # and skip its inner asarray so a single site is a single count
+            wrapped: Set[int] = set()
+            body = list(node.body) + list(node.orelse)
+            for sub in _walk_skipping_nested_defs(body):
+                if (isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name)
+                        and sub.func.id == "float" and sub.args
+                        and isinstance(sub.args[0], ast.Call)
+                        and ctx.resolve(sub.args[0].func)
+                        in BLOCKING_FETCH_CALLS):
+                    wrapped.add(id(sub.args[0]))
+            for sub in _walk_skipping_nested_defs(body):
+                if not isinstance(sub, ast.Call) or id(sub) in seen:
+                    continue
+                msg = self._blocking(ctx, sub, wrapped)
+                if msg:
+                    seen.add(id(sub))
+                    yield self.finding(
+                        ctx, sub,
+                        f"{msg} inside a {kind} loop blocks the host on "
+                        f"device results every iteration — hoist the fetch "
+                        f"out of the loop, fetch at a log cadence, or "
+                        f"pragma the site with why the sync is required")
+
+    @staticmethod
+    def _blocking(ctx: FileContext, call: ast.Call,
+                  wrapped: Set[int]) -> Optional[str]:
+        if (isinstance(call.func, ast.Attribute) and not call.args
+                and not call.keywords
+                and call.func.attr in BLOCKING_FETCH_METHODS):
+            return f".{call.func.attr}() fetch"
+        if (isinstance(call.func, ast.Name) and call.func.id == "float"
+                and call.args and isinstance(call.args[0], ast.Call)
+                and ctx.resolve(call.args[0].func) in BLOCKING_FETCH_CALLS):
+            inner = ctx.resolve(call.args[0].func)
+            return f"float({inner}(...)) fetch"
+        if id(call) in wrapped:
+            return None                    # counted via its float() wrapper
+        name = ctx.resolve(call.func)
+        if name in BLOCKING_FETCH_CALLS:
+            return f"{name}() fetch"
+        return None

@@ -1,12 +1,15 @@
 """Training callbacks (reference: python/paddle/callbacks.py — a re-export
 of the hapi callback classes, mirrored here the same way).
-``TelemetryCallback`` is paddle_tpu-specific: it wires a
-``telemetry.TrainMonitor`` through ``Model.fit`` (docs/OBSERVABILITY.md)."""
+``TelemetryCallback`` and ``GoodputCallback`` are paddle_tpu-specific: the
+first wires a ``telemetry.TrainMonitor`` through ``Model.fit``, the second
+a ``telemetry_ledger.RunLedger`` goodput attribution
+(docs/OBSERVABILITY.md)."""
 
 from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
-                             LRScheduler, ModelCheckpoint, ProgBarLogger,
-                             ReduceLROnPlateau, TelemetryCallback, VisualDL)
+                             GoodputCallback, LRScheduler, ModelCheckpoint,
+                             ProgBarLogger, ReduceLROnPlateau,
+                             TelemetryCallback, VisualDL)
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
            "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "TelemetryCallback"]
+           "TelemetryCallback", "GoodputCallback"]

@@ -151,7 +151,19 @@ def save(state, path: str, async_save: bool = False,
     shards plus (process 0 only) the manifest.  Returns a
     :class:`SaveHandle`; with ``async_save=True`` file writes happen on a
     background thread after a synchronous device→host snapshot.
+
+    The SYNCHRONOUS wall (snapshot, plus the file writes unless
+    ``async_save``) reports to the active goodput ledger as
+    ``checkpoint_save`` — background writes overlap training and do not
+    cost goodput, so they are deliberately outside the span.
     """
+    from ..telemetry_ledger import ledger_span
+    with ledger_span("checkpoint_save"):
+        return _save_impl(state, path, async_save, process_index)
+
+
+def _save_impl(state, path: str, async_save: bool,
+               process_index: Optional[int]) -> SaveHandle:
     flat = _flatten(state)
     pidx = jax.process_index() if process_index is None else process_index
     os.makedirs(path, exist_ok=True)
@@ -302,9 +314,18 @@ def load(path: str, target=None, shardings=None):
     when given, each leaf is assembled directly into that (possibly
     different-mesh) sharding, each device reading only its own slice.
     Without it leaves load as host numpy arrays.
+
+    Wall time reports to the active goodput ledger as
+    ``checkpoint_restore``.
     """
     if target is None:
         raise ValueError("load(...) needs a target pytree template")
+    from ..telemetry_ledger import ledger_span
+    with ledger_span("checkpoint_restore"):
+        return _load_impl(path, target, shardings)
+
+
+def _load_impl(path: str, target, shardings):
     manifest = _merged_manifest(path)
     flat_t = _flatten(target)
     flat_s = _flatten(shardings) if shardings is not None else {}

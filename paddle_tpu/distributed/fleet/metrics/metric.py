@@ -43,18 +43,22 @@ def all_reduce_metrics(metrics: Mapping[str, float], op: str = "sum"
     (``op="sum"``) and straggler wall time (``op="max"``)."""
     if not metrics:
         return {}
-    keys = list(metrics)
-    vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
-    import jax
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        rows = np.asarray(multihost_utils.process_allgather(vec),
-                          np.float64).reshape(-1, len(keys))
-        red = {"sum": rows.sum(0), "max": rows.max(0),
-               "min": rows.min(0)}[op]
-        return {k: float(v) for k, v in zip(keys, red)}
-    out = np.asarray(_allreduce(vec, op), np.float64).reshape(-1)
-    return {k: float(v) for k, v in zip(keys, out)}
+    # goodput seam: this is the host-level collective every telemetry
+    # roll-up rides — its wall is ``comm`` time on the active ledger
+    from ....telemetry_ledger import ledger_span
+    with ledger_span("comm"):
+        keys = list(metrics)
+        vec = np.asarray([float(metrics[k]) for k in keys], np.float64)
+        import jax
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            rows = np.asarray(multihost_utils.process_allgather(vec),
+                              np.float64).reshape(-1, len(keys))
+            red = {"sum": rows.sum(0), "max": rows.max(0),
+                   "min": rows.min(0)}[op]
+            return {k: float(v) for k, v in zip(keys, red)}
+        out = np.asarray(_allreduce(vec, op), np.float64).reshape(-1)
+        return {k: float(v) for k, v in zip(keys, out)}
 
 
 def sum(input, scope=None, util=None):

@@ -49,21 +49,30 @@ def _from_serializable(obj, return_numpy=False):
 
 
 def save(obj: Any, path: str, protocol: int = 4, **configs) -> None:
-    """``paddle.save`` parity."""
+    """``paddle.save`` parity.  Reports its wall time to the active
+    goodput ledger as ``checkpoint_save`` (``telemetry_ledger``; no-op
+    when none is active)."""
+    from ..telemetry_ledger import ledger_span
     if protocol < 2 or protocol > 5:
         raise ValueError("protocol must be in [2, 5]")
-    dirname = os.path.dirname(path)
-    if dirname:
-        os.makedirs(dirname, exist_ok=True)
-    payload = _to_serializable(obj)
-    with open(path, "wb") as f:
-        pickle.dump(payload, f, protocol=protocol)
+    with ledger_span("checkpoint_save"):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        payload = _to_serializable(obj)
+        with open(path, "wb") as f:
+            pickle.dump(payload, f, protocol=protocol)
 
 
 def load(path: str, **configs) -> Any:
-    """``paddle.load`` parity."""
+    """``paddle.load`` parity.  Reports its wall time to the active
+    goodput ledger as ``checkpoint_restore``."""
+    from ..telemetry_ledger import ledger_span
     if not os.path.exists(path):
         raise ValueError(f"path {path} does not exist")
-    with open(path, "rb") as f:
-        payload = pickle.load(f)
-    return _from_serializable(payload, return_numpy=configs.get("return_numpy", False))
+    with ledger_span("checkpoint_restore"):
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        return _from_serializable(payload,
+                                  return_numpy=configs.get("return_numpy",
+                                                           False))

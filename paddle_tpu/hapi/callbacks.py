@@ -348,6 +348,101 @@ class TelemetryCallback(Callback):
                 self.model._monitor = None
 
 
+class GoodputCallback(Callback):
+    """Goodput/badput wall-clock attribution for ``Model.fit``
+    (docs/OBSERVABILITY.md, Goodput section).
+
+    Wraps the fit window in a ``telemetry_ledger.RunLedger``: at train
+    begin the ledger is (re)started and installed process-wide
+    (``set_active_ledger``) so the DataLoader (``data_wait``), checkpoint
+    I/O (``checkpoint_save``/``checkpoint_restore``) and fleet-metric
+    collective (``comm``) seams report; a ``TrainMonitor`` is ensured on
+    the model (reusing an existing one — e.g. ``TelemetryCallback``'s —
+    or creating its own) and its event stream forwards into the ledger,
+    so the hapi blocked-loss-fetch split feeds ``compute`` vs
+    ``host_dispatch`` and first-dispatch walls feed ``compile``;
+    ``Model.evaluate`` runs land in ``eval``.
+
+    At train end ``last_snapshot`` freezes the attribution (buckets sum
+    to the fit window's elapsed wall; ``goodput = compute/elapsed``), one
+    INFO line summarizes it, ``json_path`` optionally dumps the full
+    payload (mergeable into a device trace via ``tools/trace_to_chrome.py
+    --ledger``), and every hook is restored — a later fit without this
+    callback is back to one attribute check per step.
+
+    ``ops_server=``: an ``ops_server.OpsServer`` to attach the ledger
+    (and monitor) to, making ``/ledger`` and the ledger gauges in
+    ``/metrics`` live during training.
+    """
+
+    def __init__(self, ledger=None, monitor=None, json_path=None,
+                 ops_server=None):
+        super().__init__()
+        if ledger is None:
+            from ..telemetry_ledger import RunLedger
+            ledger = RunLedger()
+            self._own_ledger = True
+        else:
+            self._own_ledger = False
+        self.ledger = ledger
+        self._monitor_arg = monitor
+        self.monitor = None
+        self.json_path = json_path
+        self.ops_server = ops_server
+        self.last_snapshot = None
+        self._own_monitor = False
+
+    def on_train_begin(self, logs=None):
+        if self._own_ledger:
+            # elapsed must measure the fit window, not construction-to-fit
+            # dead time; a caller-provided ledger keeps its own clock (it
+            # may span several fits deliberately)
+            self.ledger.reset()
+        mon = getattr(self.model, "_monitor", None)
+        if mon is None:
+            if self._monitor_arg is None:
+                from ..telemetry import TrainMonitor
+                mon = TrainMonitor()
+            else:
+                mon = self._monitor_arg
+            self.model._monitor = mon
+            self._own_monitor = True
+        self.monitor = mon
+        mon.set_ledger(self.ledger)
+        self.ledger.activate()
+        if self.ops_server is not None:
+            self.ops_server.attach(self.ledger, name="fit-ledger")
+            self.ops_server.attach(mon, name="fit-monitor")
+
+    def on_train_end(self, logs=None):
+        import logging
+        try:
+            self.last_snapshot = snap = self.ledger.snapshot()
+            fr = snap["fractions"]
+            logging.getLogger(__name__).info(
+                "goodput %.3f over %.2fs wall (compute %.1f%%, data_wait "
+                "%.1f%%, host_dispatch %.1f%%, compile %.1f%%, "
+                "unattributed %.1f%%)",
+                snap["goodput"], snap["elapsed_s"],
+                100 * fr["compute"], 100 * fr["data_wait"],
+                100 * fr["host_dispatch"], 100 * fr["compile"],
+                100 * fr["unattributed"])
+            if self.json_path:
+                self.ledger.dump_json(self.json_path)
+        finally:
+            # symmetric teardown (the TelemetryCallback convention): detach
+            # the ledger from the monitor and the active slot, and drop an
+            # own monitor so a later fit pays one attribute check again
+            self.ledger.deactivate()
+            mon = self.monitor
+            if mon is not None:
+                mon.set_ledger(None)
+                if self._own_monitor \
+                        and getattr(self.model, "_monitor", None) is mon:
+                    self.model._monitor = None
+            self._own_monitor = False
+
+
 class ReduceLROnPlateau(Callback):
     def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
                  mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):

@@ -244,6 +244,7 @@ class Model:
                         if step % log_freq == 0 or (num_iters is not None and
                                                     it + 1 >= num_iters):
                             t_sync = time.perf_counter()
+                            # tpulint: disable=blocking-fetch-in-loop(the canonical allowed fetch: log_freq-cadence only, and telemetry measures it as THE device-blocked sync)
                             loss_val = float(np.asarray(loss_dev))
                             mon = self._monitor
                             if mon is not None:   # device-blocked wait + watchdog
@@ -263,6 +264,7 @@ class Model:
                     if close is not None:  # release mp workers on early break
                         close()
                 if loss_dev is not None:  # epoch-end logs carry the true last loss
+                    # tpulint: disable=blocking-fetch-in-loop(once per EPOCH, not per step — the epoch-end log contract)
                     logs["loss"] = float(np.asarray(loss_dev))
                 cbks.on_epoch_end(epoch, logs)
                 if eval_loader is not None and (epoch + 1) % eval_freq == 0:
@@ -284,12 +286,35 @@ class Model:
                 if current_monitor() is mon:
                     set_active_monitor(None)
                     self._monitor = None
+                # same guarantee for a GoodputCallback ledger: if THIS
+                # fit's monitor feeds the process-wide active ledger, a
+                # raise must not leave it installed (the callback's
+                # on_train_end never runs on that path)
+                from ..telemetry_ledger import (current_ledger,
+                                                set_active_ledger)
+                led = getattr(mon.tracer, "_ledger", None) \
+                    if hasattr(mon, "tracer") else None
+                if led is not None and current_ledger() is led:
+                    set_active_ledger(None)
+                    mon.set_ledger(None)
         cbks.on_end("train", logs)
         self._sync_back()
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
+        # goodput: the whole evaluation is one EXCLUSIVE ``eval`` span on
+        # the active ledger — its inner data waits and loss fetches are
+        # eval time, not data_wait/compute (double-attribution would break
+        # the buckets-sum-to-elapsed invariant)
+        from ..telemetry_ledger import ledger_span
+        with ledger_span("eval", exclusive=True):
+            return self._evaluate_impl(eval_data, batch_size, log_freq,
+                                       verbose, num_workers, callbacks,
+                                       num_samples)
+
+    def _evaluate_impl(self, eval_data, batch_size, log_freq, verbose,
+                       num_workers, callbacks, num_samples):
         from ..io import DataLoader, Dataset
         loader = DataLoader(eval_data, batch_size=batch_size) \
             if isinstance(eval_data, Dataset) else eval_data

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..telemetry_ledger import current_ledger
 
 logger = logging.getLogger(__name__)
 
@@ -428,6 +429,12 @@ class _MultiprocessIterator:
 
     def __next__(self):
         res = self._res
+        # goodput seam: the consumer-blocked wait for the next batch is
+        # data_wait (worker decode/collate and the pump's device_put are
+        # overlapped — only the stall the training thread actually feels
+        # counts).  One is-None check when no ledger is active.
+        led = current_ledger()
+        t0 = time.perf_counter() if led is not None else 0.0
         while True:
             try:
                 item = res.out_q.get(timeout=1.0)
@@ -435,6 +442,8 @@ class _MultiprocessIterator:
             except queue.Empty:
                 if res.closed.is_set():
                     raise StopIteration
+        if led is not None:
+            led.record("data_wait", time.perf_counter() - t0)
         if item is _DONE:
             raise StopIteration
         if isinstance(item, _Err):
@@ -570,7 +579,14 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
-        item = self.q.get()
+        # goodput seam: consumer-blocked next-batch wait → data_wait
+        led = current_ledger()
+        if led is None:
+            item = self.q.get()
+        else:
+            t0 = time.perf_counter()
+            item = self.q.get()
+            led.record("data_wait", time.perf_counter() - t0)
         if item is self.done:
             if self.error is not None:
                 raise self.error
@@ -612,6 +628,16 @@ class DataLoader:
         batch = self.collate_fn(samples)
         return self._to_tensors(batch)
 
+    def _fetch_timed(self, indices):
+        """Synchronous-path fetch with the goodput data_wait seam: with no
+        prefetch thread, decode + collate + device_put all happen on the
+        consumer thread and ARE the next-batch wait."""
+        led = current_ledger()
+        if led is None:
+            return self._fetch(indices)
+        with led.span("data_wait"):
+            return self._fetch(indices)
+
     def _to_tensors(self, batch):
         if isinstance(batch, np.ndarray):
             return Tensor(jax.device_put(batch))
@@ -631,13 +657,20 @@ class DataLoader:
             return _MultiprocessIterator(self, index_iter)
         if self.use_buffer_reader:
             return _PrefetchIterator(self, index_iter)
-        return (self._fetch(indices) for indices in index_iter)
+        return (self._fetch_timed(indices) for indices in index_iter)
 
     def _iter_iterable(self):
+        def produce(samples):
+            led = current_ledger()
+            if led is None:
+                return self._to_tensors(self.collate_fn(samples))
+            with led.span("data_wait"):
+                return self._to_tensors(self.collate_fn(samples))
+
         it = iter(self.dataset)
         if self.batch_size is None:
             for sample in it:
-                yield self._to_tensors(self.collate_fn([sample]))
+                yield produce([sample])
             return
         while True:
             chunk = list(itertools.islice(it, self.batch_size))
@@ -645,7 +678,7 @@ class DataLoader:
                 return
             if len(chunk) < self.batch_size and self.drop_last:
                 return
-            yield self._to_tensors(self.collate_fn(chunk))
+            yield produce(chunk)
 
     def __len__(self):
         if self.batch_sampler is not None:

@@ -164,6 +164,7 @@ def bucketize(fn: Callable, buckets: Sequence[int], axis: int = 1,
                     return jax.lax.slice_in_dim(a, 0, b, axis=axis)
                 return pad_to_bucket(a, b, axis, pad_value)
             out = wrapper(*tuple(resize(a) for a in args), **kwargs)
+            # tpulint: disable=blocking-fetch-in-loop(warmup loop — each bucket's compile must COMPLETE before the next is declared warm)
             jax.block_until_ready(out)
             warmed.append(b)
         return warmed

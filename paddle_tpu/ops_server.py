@@ -1,0 +1,318 @@
+"""Live ops endpoint: a stdlib HTTP server over the telemetry surfaces.
+
+Everything PR 2/4/8 record — tracer ring buffers, TrainMonitor counters,
+engine stat registries, the goodput ledger — lives in process memory and is
+only visible post-hoc through JSONL dumps.  :class:`OpsServer` makes it
+live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
+``TrainMonitor``, ``Tracer`` or ``RunLedger`` can be attached to, serving
+
+``GET /metrics``
+    merged Prometheus text exposition of every attached source — serving
+    (``paddle_tpu_serving_*``) and training (``paddle_tpu_train_*``)
+    namespaces side by side, engine registries, ledger gauges
+    (``paddle_tpu_ledger_*``), plus the server's own uptime gauge.
+``GET /healthz``
+    liveness JSON; **503** when the last observed step/tick/heartbeat is
+    older than ``stall_threshold_s`` — the load-balancer / watchdog dial.
+    ``?probe=1`` additionally runs an in-process compute probe with the
+    same semantics as ``bench.py``'s backend probe (a jitted matmul
+    ROUND-TRIP to host, never a bare ``jax.devices()`` — a half-up
+    backend enumerates devices while compile/execute hangs), bounded by
+    ``probe_timeout_s``.
+``GET /ledger``
+    the attached :class:`~paddle_tpu.telemetry_ledger.RunLedger`
+    snapshot(s) as JSON (404 when none is attached).
+``GET /trace``
+    ring-buffer tail: the last ``?n=`` events (default 256) per attached
+    tracer/monitor, optionally filtered by ``?kind=``.
+
+Zero cost when not started: constructing the server binds nothing and
+touches no hot path — sources are only read inside request handlers.
+``start()`` binds (``port=0`` → ephemeral) and serves on a daemon thread.
+
+Example::
+
+    from paddle_tpu.ops_server import OpsServer
+    srv = OpsServer(port=9100, stall_threshold_s=120)
+    srv.attach(engine)          # engine registry + its tracer, if any
+    srv.attach(monitor)         # TrainMonitor
+    srv.attach(ledger)          # RunLedger
+    url = srv.start()
+    # curl $url/metrics ; curl $url/healthz ; curl $url/ledger
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["OpsServer", "compute_probe"]
+
+
+def compute_probe(timeout_s: float = 10.0, n: int = 256) -> Dict[str, Any]:
+    """In-process compute health probe — the same semantics as
+    ``bench.py``'s backend probe: health is a jitted ``n×n`` matmul
+    round-trip to host (compile + execute + fetch), never a bare device
+    enumeration.  Runs on a worker thread bounded by ``timeout_s``; on
+    timeout the thread is abandoned (reported unhealthy), not killed — an
+    in-process probe cannot kill its own interpreter."""
+    result: Dict[str, Any] = {}
+
+    def run():
+        try:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            t0 = time.perf_counter()
+            x = jnp.ones((n, n), jnp.float32)
+            # tpulint: disable=jit-in-hot-loop(one-shot probe — paying trace+compile+execute is the health check itself, bench.py probe parity)
+            v = float(np.asarray(jax.jit(lambda a: a @ a)(x)[0, 0]))
+            result.update(ok=True, value=v,
+                          wall_s=round(time.perf_counter() - t0, 4),
+                          devices=len(jax.devices()))
+        except Exception as e:       # the probe verdict IS the error report
+            result.update(ok=False, error=repr(e))
+
+    t = threading.Thread(target=run, daemon=True, name="ops-compute-probe")
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        return {"ok": False,
+                "error": f"compute probe timed out after {timeout_s}s "
+                         f"(dispatch or compile hung — half-up backend)"}
+    return result
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-ops/1"
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):          # noqa: N802 — http.server contract
+        ops: "OpsServer" = self.server.ops     # type: ignore[attr-defined]
+        parsed = urllib.parse.urlsplit(self.path)
+        route = parsed.path.rstrip("/") or "/"
+        query = urllib.parse.parse_qs(parsed.query)
+        try:
+            if route == "/metrics":
+                self._send(200, ops._render_metrics(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                payload, ok = ops._render_healthz(
+                    run_probe=query.get("probe", ["0"])[0]
+                    not in ("0", "", "false"))
+                self._send(200 if ok else 503,
+                           json.dumps(payload, indent=2),
+                           "application/json")
+            elif route == "/ledger":
+                payload = ops._render_ledger()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no ledger attached"}), "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
+            elif route == "/trace":
+                n = int(query.get("n", ["256"])[0])
+                kind = query.get("kind", [None])[0]
+                self._send(200, json.dumps(ops._render_trace(n, kind)),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": f"unknown route {route!r}", "routes":
+                     ["/metrics", "/healthz", "/ledger", "/trace"]}),
+                    "application/json")
+        except Exception as e:
+            ops._log.warning("ops server: %s failed: %r", route, e)
+            try:
+                self._send(500, json.dumps({"error": repr(e)}),
+                           "application/json")
+            except OSError:
+                pass                      # client went away mid-error
+
+    def _send(self, code: int, body: str, ctype: str):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def log_message(self, fmt, *args):     # route through logging, not stderr
+        self.server.ops._log.debug(        # type: ignore[attr-defined]
+            "ops server: %s", fmt % args)
+
+
+class OpsServer:
+    """Attachable live ops endpoint (module docstring).
+
+    ``stall_threshold_s``: /healthz turns 503 when no attached source has
+    shown activity (train step, scheduler tick, explicit ``heartbeat()``)
+    for longer than this.  ``probe_timeout_s`` bounds the optional
+    ``?probe=1`` compute probe."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 stall_threshold_s: float = 120.0,
+                 probe_timeout_s: float = 10.0,
+                 logger: Optional[logging.Logger] = None):
+        self.host = host
+        self.port = int(port)
+        self.stall_threshold_s = float(stall_threshold_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._lock = threading.Lock()
+        self._tracers: List[Tuple[str, Any]] = []   # Tracer / TrainMonitor
+        self._engines: List[Tuple[str, Any]] = []
+        self._ledgers: List[Tuple[str, Any]] = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = time.monotonic()
+        self._last_beat = time.monotonic()
+
+    # ------------------------------------------------------------ attach --
+    def attach(self, obj, name: Optional[str] = None) -> "OpsServer":
+        """Attach a telemetry source; kind is detected:
+
+        - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
+        - ``Tracer`` / ``TrainMonitor`` (has ``events`` +
+          ``prometheus_text``) → /metrics + /trace + liveness;
+        - a serving engine (has ``prometheus_text``; its ``.tracer``, when
+          set, is attached too) → /metrics (+ tracer surfaces).
+        """
+        with self._lock:
+            if hasattr(obj, "snapshot") and hasattr(obj, "record"):
+                self._ledgers.append(
+                    (name or f"ledger{len(self._ledgers)}", obj))
+            elif hasattr(obj, "events") and hasattr(obj, "prometheus_text"):
+                self._tracers.append(
+                    (name or f"tracer{len(self._tracers)}", obj))
+            elif hasattr(obj, "prometheus_text"):
+                base = name or f"engine{len(self._engines)}"
+                self._engines.append((base, obj))
+                tracer = getattr(obj, "tracer", None)
+                if tracer is not None:
+                    self._tracers.append((f"{base}.tracer", tracer))
+            else:
+                raise TypeError(
+                    f"unsupported ops-server source: {type(obj).__name__} "
+                    f"(want a RunLedger, Tracer, TrainMonitor, or engine)")
+        return self
+
+    def heartbeat(self):
+        """Explicit liveness tick for loops with no attached tracer."""
+        self._last_beat = time.monotonic()
+
+    # --------------------------------------------------------- lifecycle --
+    def start(self) -> str:
+        """Bind and serve on a daemon thread; returns the base URL
+        (``port=0`` resolves to the ephemeral port actually bound)."""
+        if self._httpd is not None:
+            return self.url
+        httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        httpd.daemon_threads = True
+        httpd.ops = self                        # type: ignore[attr-defined]
+        self._httpd = httpd
+        self.port = httpd.server_address[1]
+        self._started_at = time.monotonic()
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(target=httpd.serve_forever,
+                                        daemon=True, name="ops-server")
+        self._thread.start()
+        self._log.info("ops server listening on %s", self.url)
+        return self.url
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ----------------------------------------------------------- renders --
+    def _sources(self):
+        with self._lock:
+            return (list(self._tracers), list(self._engines),
+                    list(self._ledgers))
+
+    def last_activity_age_s(self) -> float:
+        """Seconds since the newest sign of life: an explicit heartbeat, or
+        the latest event on any attached tracer/monitor (their ring
+        timestamps are seconds on the tracer's own clock — ``now() - ts``
+        is the event's age)."""
+        tracers, _, _ = self._sources()
+        age = time.monotonic() - self._last_beat
+        for _name, tr in tracers:
+            inner = getattr(tr, "tracer", tr)      # TrainMonitor wraps one
+            try:
+                if hasattr(inner, "last_event_age_s"):
+                    ev_age = inner.last_event_age_s()   # O(1), no ring copy
+                else:
+                    evs = inner.events()
+                    ev_age = (max(0.0, inner.now() - evs[-1]["ts"])
+                              if evs else None)
+                if ev_age is not None:
+                    age = min(age, ev_age)
+            except Exception as e:
+                self._log.debug("ops server: activity scan failed on %s: "
+                                "%r", _name, e)
+        return age
+
+    def _render_metrics(self) -> str:
+        tracers, engines, ledgers = self._sources()
+        parts = []
+        for _name, obj in tracers + engines:
+            parts.append(obj.prometheus_text())
+        for _name, led in ledgers:
+            parts.append(led.prometheus_text())
+        from .utils.stats import StatRegistry, prometheus_text as _pt
+        parts.append(_pt(
+            StatRegistry(), namespace="paddle_tpu_ops",
+            extra_gauges={
+                "uptime_seconds": time.monotonic() - self._started_at,
+                "last_activity_age_seconds": self.last_activity_age_s(),
+                "sources": len(tracers) + len(engines) + len(ledgers)}))
+        return "".join(parts)
+
+    def _render_healthz(self, run_probe: bool = False
+                        ) -> Tuple[Dict[str, Any], bool]:
+        age = self.last_activity_age_s()
+        ok = age <= self.stall_threshold_s
+        out: Dict[str, Any] = {
+            "last_step_age_s": round(age, 3),
+            "stall_threshold_s": self.stall_threshold_s,
+            "stalled": not ok,
+        }
+        if run_probe:
+            probe = compute_probe(self.probe_timeout_s)
+            out["probe"] = probe
+            ok = ok and bool(probe.get("ok"))
+        out["ok"] = ok
+        return out, ok
+
+    def _render_ledger(self) -> Optional[Dict[str, Any]]:
+        _, _, ledgers = self._sources()
+        if not ledgers:
+            return None
+        if len(ledgers) == 1:
+            return ledgers[0][1].snapshot()
+        return {name: led.snapshot() for name, led in ledgers}
+
+    def _render_trace(self, n: int, kind: Optional[str]) -> Dict[str, Any]:
+        tracers, _, _ = self._sources()
+        n = max(1, min(int(n), 65536))
+        events: Dict[str, List[Dict[str, Any]]] = {}
+        for name, tr in tracers:
+            evs = tr.events(kind) if kind else tr.events()
+            events[name] = evs[-n:]
+        return {"n": n, "kind": kind, "events": events}

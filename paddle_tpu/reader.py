@@ -63,7 +63,14 @@ def compose(*readers, check_alignment: bool = True):
 
 
 def buffered(reader, size: int):
-    """Prefetch up to ``size`` items on a background thread."""
+    """Prefetch up to ``size`` items on a background thread.  The
+    consumer-blocked queue wait reports to the active goodput ledger as
+    ``data_wait`` (``telemetry_ledger``) — one is-None check per item when
+    no ledger is active."""
+    import time
+
+    from .telemetry_ledger import current_ledger
+
     END = object()
 
     def buffered_reader():
@@ -78,7 +85,13 @@ def buffered(reader, size: int):
 
         threading.Thread(target=fill, daemon=True).start()
         while True:
-            e = q.get()
+            led = current_ledger()
+            if led is None:
+                e = q.get()
+            else:
+                t0 = time.perf_counter()
+                e = q.get()
+                led.record("data_wait", time.perf_counter() - t0)
             if e is END:
                 return
             yield e

@@ -390,6 +390,20 @@ class ContinuousBatchingEngine:
         return _timed_first_dispatch(
             run, lambda dt: tr.compile_event(name, key, False, dt))
 
+    def attach_ledger(self, ledger):
+        """Route this engine's wall-clock into a ``telemetry_ledger
+        .RunLedger``: scheduler-tick walls feed the ``compute`` bucket and
+        compile-miss walls feed ``compile``, through the attached tracer's
+        event stream (``Tracer.set_ledger``) — the goodput accounting for
+        a serving process.  Requires a ``tracer=``; the ledger consumes
+        tracer events rather than adding a second instrumentation layer."""
+        if self.tracer is None:
+            raise ValueError(
+                "attach_ledger needs a tracer: construct the engine with "
+                "tracer=Tracer() — the ledger consumes its event stream")
+        self.tracer.set_ledger(ledger)
+        return ledger
+
     def _note(self, key: str, value=1):
         """Accumulate one per-tick telemetry field (no-op when tracing is
         off — a single attribute check)."""

@@ -84,6 +84,11 @@ the Paddle-profiler/fleet-metrics role for the TRAIN loop):
                 (pre/post) — host-side estimates from the grad-tree
                 shapes, never a device sync.
 
+Goodput accounting: a ``telemetry_ledger.RunLedger`` attaches to either
+layer via ``set_ledger`` — tick/compile/train_step/sync durations forward
+into its exhaustive wall-clock buckets behind one attribute check (off by
+default), and ``ops_server.OpsServer`` serves the merged picture live.
+
 No single reference counterpart: this is the serving-shaped composition of
 the reference's profiler ``RecordEvent`` (platform/profiler.h:130),
 ``monitor.h`` StatRegistry, and ``tools/timeline.py`` chrome-trace export.
@@ -238,6 +243,14 @@ class Tracer:
         self._expected_keys = None        # warmup-grid labels, or None=all
         self._log = logger if logger is not None \
             else logging.getLogger(__name__)
+        # optional goodput ledger (telemetry_ledger.RunLedger): event
+        # durations forward into its wall-clock buckets behind ONE
+        # attribute check — None (the default) adds nothing.
+        # _ledger_compiles logs (ts, wall) of forwarded compile misses so
+        # tick() can subtract compile wall paid INSIDE the tick from its
+        # compute attribution (the buckets must stay non-overlapping)
+        self._ledger = None
+        self._ledger_compiles: List[Tuple[float, float]] = []
         # histograms live in the registry so prometheus_text() exports them
         self.registry.histogram("tick_seconds", DEFAULT_TIME_BUCKETS)
         self.registry.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
@@ -248,6 +261,32 @@ class Tracer:
 
     def now(self) -> float:
         return time.monotonic() - self._t0
+
+    def last_event_age_s(self) -> Optional[float]:
+        """Seconds since the newest ring event (None when empty) — an O(1)
+        liveness peek for ``ops_server`` that never copies the ring."""
+        with self._lock:
+            if not self._events:
+                return None
+            return max(0.0, self.now() - self._events[-1]["ts"])
+
+    # ------------------------------------------------------------ ledger --
+
+    #: event kind → RunLedger bucket for durations forwarded by set_ledger.
+    #: sync IS device-blocked wait (the host waited on device compute);
+    #: profiler_step is deliberately absent — a loop that is both monitor-
+    #: instrumented and profiler-paced must not attribute the same wall
+    #: time twice (the same double-count rule the counters follow).
+    _LEDGER_BUCKETS = {"train_step": "host_dispatch", "sync": "compute"}
+
+    def set_ledger(self, ledger):
+        """Attach (or with None detach) a ``telemetry_ledger.RunLedger``:
+        tick walls feed ``compute``, compile-miss walls feed ``compile``,
+        train_step dispatch feeds ``host_dispatch`` and sync waits feed
+        ``compute`` — the tracer becomes the ledger's event source with no
+        new instrumentation and one attribute check when detached."""
+        self._ledger = ledger
+        return ledger
 
     # ----------------------------------------------------------- ingest --
 
@@ -262,6 +301,11 @@ class Tracer:
         ev.update(fields)
         with self._lock:
             self._append(ev)
+        led = self._ledger
+        if led is not None:
+            bucket = self._LEDGER_BUCKETS.get(kind)
+            if bucket is not None:
+                led.record(bucket, float(fields.get("dur_s", 0.0)))
         return ev
 
     def tick(self, engine: str, dur_s: float, **fields):
@@ -275,6 +319,24 @@ class Tracer:
                   "dur_s": dur_s}
             ev.update(fields)
             self._append(ev)
+        led = self._ledger
+        if led is not None:
+            # a scheduler tick's host wall is device-driving time — the
+            # serving-side ``compute`` bucket.  Compile misses paid INSIDE
+            # this tick already went to the ``compile`` bucket
+            # (compile_event), so their wall is subtracted here — the
+            # ledger's buckets are non-overlapping by contract
+            now = self.now()
+            start = now - dur_s
+            with self._lock:
+                inside = [w for ts, w in self._ledger_compiles
+                          if ts >= start]
+                # entries older than this tick happened BETWEEN ticks
+                # (warmup etc.) and never overlap a tick wall — drop them
+                self._ledger_compiles = []
+            led.record(
+                "compute",
+                max(0.0, dur_s - sum(min(w, dur_s) for w in inside)))
         return ev
 
     @contextlib.contextmanager
@@ -367,6 +429,11 @@ class Tracer:
                     self._warned_storm = True
                     warn = True
             self._append(ev)
+        led = self._ledger
+        if led is not None:
+            led.record("compile", wall_s)
+            with self._lock:
+                self._ledger_compiles.append((ev["ts"], wall_s))
         if warn:
             self._log.warning(
                 "recompile storm: %d program-cache misses after warmup "
@@ -607,6 +674,13 @@ class TrainMonitor:
     def __exit__(self, *exc):
         self.deactivate()
         return False
+
+    def set_ledger(self, ledger):
+        """Forward this monitor's event durations into a
+        ``telemetry_ledger.RunLedger`` (step dispatch → ``host_dispatch``,
+        device-blocked syncs → ``compute``, compiles → ``compile``); None
+        detaches.  See ``Tracer.set_ledger``."""
+        return self.tracer.set_ledger(ledger)
 
     # ------------------------------------------------------------ ingest --
     def record_step(self, wall_s: float, trainer: str = "train",

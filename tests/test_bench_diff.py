@@ -1,0 +1,149 @@
+"""tools/bench_diff.py: the BENCH trajectory is machine-readable — every
+accepted file shape normalizes, error/skipped records classify as
+non-comparable (exit 2, never a fake regression), direction follows the
+unit, and the exit-code contract holds."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "_bench_diff", os.path.join(ROOT, "tools", "bench_diff.py"))
+bench_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench_diff)
+
+
+def _rec(metric, value, unit="tokens/s/chip"):
+    return {"metric": metric, "value": value, "unit": unit,
+            "vs_baseline": None, "loss": 0.0}
+
+
+ERROR_REC = {"metric": "deadcfg", "value": None, "unit": "error",
+             "error": {"attempts": 2, "detail": []}}
+SKIPPED_REC = {"metric": "deadcfg", "value": None, "unit": "skipped",
+               "skipped": {"reason": "backend unhealthy", "probe": []}}
+
+
+class TestClassify:
+    def test_records(self):
+        assert bench_diff.classify(_rec("m", 1.0)) == "ok"
+        assert bench_diff.classify(ERROR_REC) == "error"
+        assert bench_diff.classify(SKIPPED_REC) == "skipped"
+        assert bench_diff.classify({"metric": "m", "value": None,
+                                    "unit": "x"}) == "invalid"
+        assert bench_diff.classify({"no": "metric"}) == "invalid"
+
+
+class TestLoadShapes:
+    def test_driver_wrapper_with_parsed_dict(self, tmp_path):
+        p = tmp_path / "a.json"
+        p.write_text(json.dumps({"n": 5, "cmd": "x", "rc": 0, "tail": "",
+                                 "parsed": _rec("m", 10.0)}))
+        assert bench_diff.load_records(str(p)) == [_rec("m", 10.0)]
+
+    def test_driver_wrapper_parsed_null_recovers_from_tail(self, tmp_path):
+        tail = "noise\n" + json.dumps(_rec("m", 7.0)) + "\nmore noise\n"
+        p = tmp_path / "a.json"
+        p.write_text(json.dumps({"n": 1, "rc": 1, "tail": tail,
+                                 "parsed": None}))
+        assert bench_diff.load_records(str(p)) == [_rec("m", 7.0)]
+
+    def test_jsonl_and_list_and_single(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        p.write_text(json.dumps(_rec("a", 1.0)) + "\n"
+                     + json.dumps(_rec("b", 2.0)) + "\n")
+        assert len(bench_diff.load_records(str(p))) == 2
+        p2 = tmp_path / "b.json"
+        p2.write_text(json.dumps([_rec("a", 1.0), ERROR_REC]))
+        assert len(bench_diff.load_records(str(p2))) == 2
+        p3 = tmp_path / "c.json"
+        p3.write_text(json.dumps(_rec("solo", 3.0)))
+        assert bench_diff.load_records(str(p3)) == [_rec("solo", 3.0)]
+
+    def test_real_repo_artifacts_parse(self):
+        # the actual trajectory in this repo must at least normalize
+        for name in sorted(os.listdir(ROOT)):
+            if name.startswith("BENCH_r") and name.endswith(".json"):
+                recs = bench_diff.load_records(os.path.join(ROOT, name))
+                for r in recs:
+                    assert bench_diff.classify(r) in ("ok", "error",
+                                                      "skipped")
+
+
+class TestCompare:
+    def test_throughput_drop_is_regression(self):
+        rows, n_reg, n_cmp = bench_diff.compare(
+            [_rec("m", 100.0)], [_rec("m", 80.0)], threshold=0.1)
+        assert n_cmp == 1 and n_reg == 1
+        assert rows[0]["delta_frac"] == pytest.approx(-0.2)
+
+    def test_latency_rise_is_regression_drop_is_not(self):
+        _rows, n_reg, _ = bench_diff.compare(
+            [_rec("lat", 5.0, unit="ms")], [_rec("lat", 9.0, unit="ms")],
+            threshold=0.1)
+        assert n_reg == 1
+        _rows, n_reg, _ = bench_diff.compare(
+            [_rec("lat", 9.0, unit="ms")], [_rec("lat", 5.0, unit="ms")],
+            threshold=0.1)
+        assert n_reg == 0
+
+    def test_within_threshold_ok(self):
+        _rows, n_reg, n_cmp = bench_diff.compare(
+            [_rec("m", 100.0)], [_rec("m", 95.0)], threshold=0.1)
+        assert n_cmp == 1 and n_reg == 0
+
+    def test_error_skipped_never_compare(self):
+        rows, n_reg, n_cmp = bench_diff.compare(
+            [ERROR_REC], [SKIPPED_REC], threshold=0.1)
+        assert n_cmp == 0 and n_reg == 0
+        assert "not comparable" in rows[0]["status"]
+
+
+class TestExitCodes:
+    def _write(self, path, records):
+        path.write_text("\n".join(json.dumps(r) for r in records))
+
+    def test_zero_clean_one_regression_two_nodata(self, tmp_path, capsys):
+        old, new = tmp_path / "old.json", tmp_path / "new.json"
+        self._write(old, [_rec("m", 100.0), ERROR_REC])
+        self._write(new, [_rec("m", 99.0), SKIPPED_REC])
+        assert bench_diff.main([str(old), str(new)]) == 0
+        self._write(new, [_rec("m", 50.0)])
+        assert bench_diff.main([str(old), str(new)]) == 1
+        self._write(new, [SKIPPED_REC])
+        assert bench_diff.main([str(old), str(new)]) == 2
+        capsys.readouterr()
+
+    def test_scan_trajectory_steps_over_dead_rounds(self, tmp_path, capsys):
+        # r1 ok, r2 skipped (infra-dead), r3 ok-but-regressed: the scan
+        # compares r1 against r3, not against the dead round
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "tail": "", "parsed": _rec("m", 100.0)}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "rc": 0, "tail": "", "parsed": SKIPPED_REC}))
+        (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+            {"n": 3, "rc": 0, "tail": "", "parsed": _rec("m", 50.0)}))
+        assert bench_diff.main(["--scan", str(tmp_path)]) == 1
+        out = capsys.readouterr().out
+        assert "100" in out and "50" in out
+
+    def test_scan_all_dead_is_nodata(self, tmp_path, capsys):
+        for i in (1, 2):
+            (tmp_path / f"BENCH_r0{i}.json").write_text(json.dumps(
+                {"n": i, "rc": 0, "tail": "", "parsed": ERROR_REC}))
+        assert bench_diff.main(["--scan", str(tmp_path)]) == 2
+        capsys.readouterr()
+
+    def test_json_output_mode(self, tmp_path, capsys):
+        old, new = tmp_path / "o.json", tmp_path / "n.json"
+        self._write(old, [_rec("m", 100.0)])
+        self._write(new, [_rec("m", 80.0)])
+        assert bench_diff.main([str(old), str(new), "--json"]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["regressions"] == 1 and report["compared"] == 1
+        assert report["rows"][0]["metric"] == "m"

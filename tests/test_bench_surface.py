@@ -72,3 +72,36 @@ def test_analytic_flops_matches_6n_approximation():
     # MoE top-2 doubles only the expert-MLP term
     moe = bench._transformer_train_flops(B, L, n, H, I, V, moe_topk=2)
     assert moe - got == 3 * B * L * n * 4 * H * I
+
+
+def test_probe_hard_timeout_kills_and_records_real_rc(monkeypatch, tmp_path):
+    """The hung-probe leak fix (HEALTH.log `rc=inflight ... [probe left
+    running]`): a probe past its deadline is killed — whole process group,
+    SIGKILL escalation — and the log records a REAL rc, not a leak."""
+    import time as _time
+
+    import bench
+    log = tmp_path / "health.log"
+    monkeypatch.setenv("PADDLE_TPU_BENCH_HEALTH_LOG", str(log))
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import time\ntime.sleep(60)\n")
+    t0 = _time.time()
+    healthy, rc, _out = bench._probe_backend(timeout=1.5)
+    wall = _time.time() - t0
+    assert not healthy
+    assert isinstance(rc, int) and rc < 0       # died on a signal
+    assert wall < 30                            # bounded, not a 60s wait
+    line = log.read_text()
+    assert "rc=-" in line and "probe killed at" in line
+    assert "inflight" not in line and "left running" not in line
+
+
+def test_probe_healthy_fast_path(monkeypatch, tmp_path):
+    import bench
+    log = tmp_path / "health.log"
+    monkeypatch.setenv("PADDLE_TPU_BENCH_HEALTH_LOG", str(log))
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC",
+        "print('COMPUTE_HEALTHY devices=1 dial=0.0s compute=0.0s v=1.0')")
+    healthy, rc, out = bench._probe_backend(timeout=60)
+    assert healthy and rc == 0 and "COMPUTE_HEALTHY" in out
+    assert "ok COMPUTE_HEALTHY" in log.read_text()

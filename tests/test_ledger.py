@@ -1,0 +1,492 @@
+"""Goodput ledger (ISSUE 8): exhaustive wall-clock attribution.
+
+The tentpole contracts under test: buckets sum to elapsed wall time within
+1% (exhaustiveness — `unattributed` is the honest remainder, over-
+attribution surfaces as `overflow_s`), the instrumentation seams (hapi
+fit, DataLoader, reader.buffered, checkpoint io, fleet metrics) report
+through the active ledger with zero cost when none is active (identical
+lowering with and without), and the flight recorder dumps the telemetry
+state on a raised exception."""
+
+import json
+import signal
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.optimizer import Adam, Momentum
+from paddle_tpu.telemetry import Tracer, TrainMonitor
+from paddle_tpu.telemetry_ledger import (BUCKETS, FlightRecorder, RunLedger,
+                                         chrome_counters_from_dump,
+                                         current_ledger, ledger_span,
+                                         set_active_ledger)
+
+
+def _sum_ok(snap, tol=0.01):
+    total = sum(snap["buckets_s"].values())
+    elapsed = snap["elapsed_s"]
+    return abs(total - elapsed) <= tol * elapsed + 1e-9
+
+
+class TestRunLedgerCore:
+    def test_buckets_sum_to_elapsed_exactly(self):
+        led = RunLedger()
+        led.record("compute", 0.005)          # attribution < real elapsed
+        led.record("data_wait", 0.004)
+        time.sleep(0.02)
+        snap = led.snapshot()
+        assert set(snap["buckets_s"]) == set(BUCKETS)
+        assert _sum_ok(snap, tol=0.0)          # exact by construction
+        assert snap["overflow_s"] == 0.0
+        assert snap["buckets_s"]["unattributed"] > 0
+        assert snap["goodput"] == pytest.approx(
+            0.005 / snap["elapsed_s"], rel=1e-6)
+        assert snap["counts"]["compute"] == 1
+
+    def test_overflow_is_reported_not_hidden(self):
+        led = RunLedger()
+        led.record("compute", 1e6)             # absurd over-attribution
+        snap = led.snapshot()
+        assert snap["overflow_s"] > 0
+        assert snap["buckets_s"]["unattributed"] == 0.0
+        # the sum exceeds elapsed by EXACTLY the reported overflow
+        assert sum(snap["buckets_s"].values()) == pytest.approx(
+            snap["elapsed_s"] + snap["overflow_s"], rel=1e-9)
+
+    def test_unknown_bucket_raises(self):
+        led = RunLedger()
+        with pytest.raises(ValueError):
+            led.record("gpu_time", 1.0)
+        with pytest.raises(ValueError):
+            with led.span("nonsense"):
+                pass
+        with pytest.raises(ValueError):
+            led.record("unattributed", 1.0)    # derived, never recorded
+
+    def test_span_and_exclusive_absorption(self):
+        led = RunLedger()
+        with led.span("eval", exclusive=True):
+            led.record("data_wait", 5.0)       # absorbed: inside eval
+            led.record("eval", 0.001)          # same bucket passes through
+            time.sleep(0.01)
+        snap = led.snapshot()
+        assert snap["buckets_s"]["data_wait"] == 0.0
+        assert snap["buckets_s"]["eval"] >= 0.011
+        # absorption is per-thread: another thread's records pass through
+        done = threading.Event()
+
+        def other():
+            led.record("comm", 0.5)
+            done.set()
+
+        with led.span("eval", exclusive=True):
+            t = threading.Thread(target=other)
+            t.start()
+            assert done.wait(5)
+            t.join()
+        assert led.snapshot()["buckets_s"]["comm"] == 0.5
+
+    def test_close_freezes_and_drops(self):
+        led = RunLedger()
+        led.record("compute", 0.1)
+        led.close()
+        e1 = led.snapshot()["elapsed_s"]
+        led.record("compute", 9.9)             # dropped: run is over
+        time.sleep(0.01)
+        snap = led.snapshot()
+        assert snap["elapsed_s"] == e1 and snap["closed"]
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.1)
+
+    def test_reset_restarts_clock(self):
+        led = RunLedger()
+        led.record("compute", 0.5)
+        time.sleep(0.01)
+        led.reset()
+        snap = led.snapshot()
+        assert snap["buckets_s"]["compute"] == 0.0
+        assert snap["elapsed_s"] < 0.01
+
+    def test_capacity_bounds_series_not_totals(self):
+        led = RunLedger(capacity=4)
+        for _ in range(10):
+            led.record("compute", 0.01)
+        d = led.to_dict()
+        assert len(d["series"]) == 4
+        assert d["snapshot"]["buckets_s"]["compute"] == pytest.approx(0.1)
+
+    def test_prometheus_text(self):
+        led = RunLedger()
+        led.record("compute", 0.2)
+        txt = led.prometheus_text()
+        assert "paddle_tpu_ledger_goodput" in txt
+        assert "paddle_tpu_ledger_compute_seconds 0.2" in txt
+        assert "# TYPE paddle_tpu_ledger_compute_events counter" in txt
+
+    def test_chrome_counters_cumulative(self, tmp_path):
+        led = RunLedger()
+        led.record("compute", 0.1)
+        led.record("compute", 0.2)
+        led.record("data_wait", 0.3)
+        evs = led.to_chrome_counters()
+        counters = [e for e in evs if e.get("ph") == "C"]
+        assert len(counters) == 3
+        assert counters[-1]["args"]["compute"] == pytest.approx(0.3)
+        assert counters[-1]["args"]["data_wait"] == pytest.approx(0.3)
+        assert [e["ts"] for e in counters] == sorted(
+            e["ts"] for e in counters)
+        # offline twin: dump_json -> chrome_counters_from_dump round-trips
+        p = tmp_path / "ledger.json"
+        led.dump_json(str(p))
+        off = chrome_counters_from_dump(json.loads(p.read_text()))
+        assert [e.get("args") for e in off if e.get("ph") == "C"] == \
+            [e["args"] for e in counters]
+
+    def test_aggregate_single_process_identity(self):
+        led = RunLedger()
+        led.record("compute", 0.4)
+        led.record("comm", 0.1)
+        agg = led.aggregate()
+        assert agg["world"] == 1
+        assert agg["buckets_s"]["compute"] == pytest.approx(0.4)
+        # goodput over the aggregate's OWN elapsed (the clock keeps ticking
+        # between calls, so a later snapshot would disagree slightly)
+        assert agg["goodput"] == pytest.approx(
+            0.4 / agg["elapsed_s_max"], rel=1e-6)
+        assert agg["straggler_skew"]["compute"] == pytest.approx(1.0)
+        assert agg["straggler_skew"]["checkpoint_save"] is None  # empty
+
+
+class TestActiveLedgerSeams:
+    def test_active_slot_install_restore(self):
+        assert current_ledger() is None
+        led = RunLedger()
+        with led:
+            assert current_ledger() is led
+            inner = RunLedger()
+            with inner:
+                assert current_ledger() is inner
+            assert current_ledger() is led
+        assert current_ledger() is None
+
+    def test_ledger_span_noop_when_inactive(self):
+        with ledger_span("compute") as led:
+            assert led is None
+
+    def test_dataloader_prefetch_data_wait(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        ds = TensorDataset([np.arange(32, dtype="float32").reshape(8, 4)])
+        led = RunLedger()
+        with led:
+            batches = list(DataLoader(ds, batch_size=2))
+        assert len(batches) == 4
+        snap = led.snapshot()
+        assert snap["counts"]["data_wait"] >= 4
+        # and OFF path records nothing
+        led2 = RunLedger()
+        list(DataLoader(ds, batch_size=2))
+        assert led2.snapshot()["counts"]["data_wait"] == 0
+
+    def test_dataloader_sync_path_data_wait(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        ds = TensorDataset([np.arange(32, dtype="float32").reshape(8, 4)])
+        led = RunLedger()
+        with led:
+            batches = list(DataLoader(ds, batch_size=4,
+                                      use_buffer_reader=False))
+        assert len(batches) == 2
+        assert led.snapshot()["counts"]["data_wait"] == 2
+
+    def test_reader_buffered_data_wait(self):
+        from paddle_tpu.reader import buffered
+
+        def r():
+            yield from range(5)
+
+        led = RunLedger()
+        with led:
+            out = list(buffered(r, 2)())
+        assert out == list(range(5))
+        assert led.snapshot()["counts"]["data_wait"] >= 5
+
+    def test_framework_io_checkpoint_spans(self, tmp_path):
+        from paddle_tpu.framework import io as fio
+        led = RunLedger()
+        path = str(tmp_path / "m.pdparams")
+        with led:
+            fio.save({"w": np.ones((4, 4), "float32")}, path)
+            fio.load(path)
+        snap = led.snapshot()
+        assert snap["counts"]["checkpoint_save"] == 1
+        assert snap["counts"]["checkpoint_restore"] == 1
+        assert snap["buckets_s"]["checkpoint_save"] > 0
+
+    def test_distributed_checkpoint_spans(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        state = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        led = RunLedger()
+        with led:
+            ckpt.save(state, str(tmp_path / "ck"))
+            out = ckpt.load(str(tmp_path / "ck"), target=state)
+        np.testing.assert_allclose(np.asarray(out["w"]), np.ones((4, 4)))
+        snap = led.snapshot()
+        assert snap["counts"]["checkpoint_save"] == 1
+        assert snap["counts"]["checkpoint_restore"] == 1
+
+    def test_fleet_metrics_comm_span(self):
+        from paddle_tpu.distributed.fleet.metrics.metric import \
+            all_reduce_metrics
+        led = RunLedger()
+        with led:
+            out = all_reduce_metrics({"a": 1.0, "b": 2.0}, "sum")
+        assert out == {"a": 1.0, "b": 2.0}
+        assert led.snapshot()["counts"]["comm"] == 1
+
+
+class TestMonitorForwarding:
+    def test_monitor_events_map_to_buckets(self):
+        mon = TrainMonitor()
+        led = RunLedger()
+        mon.set_ledger(led)
+        mon.record_compile(("step",), 0.5)
+        mon.record_step(0.2, trainer="t", examples=2)
+        mon.record_sync(0.1, loss=1.0)
+        mon.record_profiler_step(9.0)          # deliberately NOT forwarded
+        snap = led.snapshot()
+        assert snap["buckets_s"]["compile"] == pytest.approx(0.5)
+        assert snap["buckets_s"]["host_dispatch"] == pytest.approx(0.2)
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.1)
+        # detach: nothing records afterwards
+        mon.set_ledger(None)
+        mon.record_step(5.0, trainer="t")
+        assert led.snapshot()["buckets_s"]["host_dispatch"] == \
+            pytest.approx(0.2)
+
+    def test_tracer_tick_and_compile_feed_ledger(self):
+        tr = Tracer()
+        led = RunLedger()
+        tr.set_ledger(led)
+        tr.tick("Eng", 0.05, queue_depth=0)
+        tr.compile_event("Eng", ("prefill", 8), hit=False, wall_s=0.3)
+        tr.compile_event("Eng", ("prefill", 8), hit=True)   # hits don't
+        snap = led.snapshot()
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.05)
+        assert snap["buckets_s"]["compile"] == pytest.approx(0.3)
+        assert snap["counts"]["compile"] == 1
+
+    def test_in_tick_compile_wall_not_double_attributed(self):
+        """A compile paid INSIDE a tick lands in ``compile`` only — the
+        tick's compute attribution subtracts it, keeping the buckets
+        non-overlapping (a cold serving engine would otherwise report
+        attributed > elapsed and a fictitious goodput)."""
+        tr = Tracer()
+        led = RunLedger()
+        tr.set_ledger(led)
+        # tick bracketing a 0.4s compile: 0.5s wall, 0.1s real compute
+        tr.compile_event("Eng", ("prefill", 8), hit=False, wall_s=0.4)
+        tr.tick("Eng", 0.5, queue_depth=0)
+        snap = led.snapshot()
+        assert snap["buckets_s"]["compile"] == pytest.approx(0.4)
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.1)
+        # compiles BETWEEN ticks (warmup) never reduce a later tick
+        tr.compile_event("Eng", ("decode", 4), hit=False, wall_s=9.0)
+        time.sleep(0.02)
+        tr.tick("Eng", 0.01, queue_depth=0)
+        snap = led.snapshot()
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.11, abs=1e-3)
+
+    def test_engine_attach_ledger_requires_tracer(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel
+        from paddle_tpu.serving import ContinuousBatchingEngine
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_attention_heads=2, max_position_embeddings=32,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        eng = ContinuousBatchingEngine(model, params, max_slots=1,
+                                       max_len=16, prompt_buckets=[8])
+        with pytest.raises(ValueError):
+            eng.attach_ledger(RunLedger())
+        eng2 = ContinuousBatchingEngine(model, params, max_slots=1,
+                                        max_len=16, prompt_buckets=[8],
+                                        tracer=Tracer())
+        led = eng2.attach_ledger(RunLedger())
+        assert eng2.tracer._ledger is led
+
+    def test_identical_lowering_with_and_without_ledger(self):
+        """Off-path purity: the ledger observes host-side walls only — the
+        compiled program is byte-identical with ledger attached or not."""
+        def build(with_ledger):
+            paddle.seed(3)
+            layer = nn.Linear(4, 3)
+            mon = TrainMonitor()
+            if with_ledger:
+                mon.set_ledger(RunLedger())
+            step, state = make_train_step(
+                layer, nn.MSELoss(),
+                Momentum(learning_rate=0.1, momentum=0.9), monitor=mon)
+            rest = (jax.random.key(0), np.float32(0.1),
+                    [jnp.ones((8, 4))], [jnp.zeros((8, 3))])
+            return step.lower(state, *rest).as_text()
+
+        assert build(False) == build(True)
+
+
+class TestFitIntegration:
+    def _fit(self, callbacks, epochs=1, batches=6, eval_data=None):
+        paddle.seed(0)
+        from paddle_tpu.hapi import Model
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        xs = np.ones((8, 4), "float32")
+        ys = np.zeros((8, 2), "float32")
+        m.fit([(xs, ys)] * batches, eval_data=eval_data, epochs=epochs,
+              verbose=0, callbacks=callbacks)
+        return m
+
+    def test_goodput_callback_end_to_end(self, tmp_path):
+        from paddle_tpu.callbacks import GoodputCallback
+        path = str(tmp_path / "goodput.json")
+        cb = GoodputCallback(json_path=path)
+        m = self._fit([cb], epochs=2)
+        snap = cb.last_snapshot
+        assert snap is not None
+        # THE acceptance invariant: buckets sum to elapsed wall within 1%
+        assert _sum_ok(snap)
+        assert snap["overflow_s"] == 0.0
+        assert snap["buckets_s"]["compile"] > 0      # first dispatch
+        assert snap["buckets_s"]["host_dispatch"] > 0
+        assert snap["counts"]["compute"] >= 1        # log_freq loss fetch
+        # teardown is symmetric: nothing active, monitor detached
+        assert current_ledger() is None
+        assert m._monitor is None
+        assert json.loads(open(path).read())["snapshot"]["elapsed_s"] > 0
+
+    def test_goodput_callback_reuses_existing_monitor(self):
+        from paddle_tpu.callbacks import GoodputCallback, TelemetryCallback
+        tele = TelemetryCallback()
+        good = GoodputCallback()
+        self._fit([tele, good])
+        assert good.monitor is tele.monitor
+        assert good.last_snapshot["buckets_s"]["host_dispatch"] > 0
+        assert tele.monitor.tracer._ledger is None   # detached at end
+
+    def test_eval_lands_in_eval_bucket(self):
+        from paddle_tpu.callbacks import GoodputCallback
+        cb = GoodputCallback()
+        xs = np.ones((8, 4), "float32")
+        ys = np.zeros((8, 2), "float32")
+        self._fit([cb], eval_data=[(xs, ys)] * 3)
+        snap = cb.last_snapshot
+        assert snap["buckets_s"]["eval"] > 0
+        assert _sum_ok(snap)
+
+
+class TestFlightRecorder:
+    def _recorder(self, tmp_path):
+        mon = TrainMonitor()
+        mon.record_step(0.01, trainer="t", examples=1)
+        led = RunLedger()
+        led.record("compute", 0.2)
+        return FlightRecorder(str(tmp_path / "crash"),
+                              sources=[mon, led]), mon, led
+
+    def test_dump_on_raised_exception(self, tmp_path):
+        fr, mon, led = self._recorder(tmp_path)
+        prev_hook = sys.excepthook
+        fr.install(signals=())
+        try:
+            assert sys.excepthook is not prev_hook
+            try:
+                raise RuntimeError("boom")
+            except RuntimeError:
+                # what the interpreter does on an unhandled exception
+                sys.excepthook(*sys.exc_info())
+        finally:
+            fr.uninstall()
+        assert sys.excepthook is prev_hook
+        dumps = list((tmp_path / "crash").glob("crash-*"))
+        assert len(dumps) == 1
+        out = dumps[0]
+        meta = json.loads((out / "meta.json").read_text())
+        assert "RuntimeError" in meta["reason"]
+        threads = (out / "threads.txt").read_text()
+        assert "Thread" in threads or "File" in threads
+        # the monitor's ring buffer survived as JSONL
+        jsonl = (out / "trainmonitor0.jsonl").read_text().splitlines()
+        assert any(json.loads(l)["kind"] == "train_step" for l in jsonl)
+        # the ledger snapshot survived
+        ldump = json.loads((out / "runledger1.json").read_text())
+        assert ldump["snapshot"]["buckets_s"]["compute"] == \
+            pytest.approx(0.2)
+
+    def test_signal_dump_chains_previous_handler(self, tmp_path):
+        fr, _, _ = self._recorder(tmp_path)
+        hit = []
+        prev = signal.signal(signal.SIGUSR1, lambda s, f: hit.append(s))
+        try:
+            fr.install(signals=(signal.SIGUSR1,), enable_faulthandler=False)
+            signal.raise_signal(signal.SIGUSR1)
+            assert hit == [signal.SIGUSR1]       # chained, process alive
+            assert list((tmp_path / "crash").glob("crash-*"))
+        finally:
+            fr.uninstall()
+            signal.signal(signal.SIGUSR1, prev)
+
+    def test_auto_dump_once_manual_dumps_unique(self, tmp_path):
+        """Two automatic triggers for one death keep the FIRST dump;
+        manual dumps always land, each in its own directory (same-second
+        stamps must not overwrite)."""
+        fr, _, _ = self._recorder(tmp_path)
+        assert fr.dump("first", _auto=True) is not None
+        assert fr.dump("second", _auto=True) is None   # deduped
+        d1 = fr.dump("manual-1")
+        d2 = fr.dump("manual-2")
+        assert d1 is not None and d2 is not None and d1 != d2
+        assert len(list((tmp_path / "crash").glob("crash-*"))) == 3
+
+    def test_dump_never_raises(self, tmp_path):
+        class Bad:
+            def dump_jsonl(self, path):
+                raise OSError("disk gone")
+
+        fr = FlightRecorder(str(tmp_path / "crash"), sources=[Bad()])
+        out = fr.dump("manual")
+        assert out is not None                   # partial dump still lands
+        assert (tmp_path / "crash").exists()
+
+    def test_bad_source_rejected(self, tmp_path):
+        with pytest.raises(TypeError):
+            FlightRecorder(str(tmp_path), sources=[object()])
+
+
+class TestFitExceptionTeardown:
+    def test_raise_mid_fit_never_leaks_active_ledger(self):
+        """A raise skips GoodputCallback.on_train_end — Model.fit's finally
+        must still clear the active ledger and the monitor forwarding."""
+        from paddle_tpu.callbacks import Callback, GoodputCallback
+        from paddle_tpu.hapi import Model
+
+        class Boom(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step >= 1:
+                    raise RuntimeError("boom")
+
+        paddle.seed(0)
+        m = Model(nn.Linear(4, 2), inputs=[None])
+        m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+        cb = GoodputCallback()
+        xs = np.ones((8, 4), "float32")
+        ys = np.zeros((8, 2), "float32")
+        with pytest.raises(RuntimeError):
+            m.fit([(xs, ys)] * 4, epochs=1, verbose=0,
+                  callbacks=[cb, Boom()])
+        assert current_ledger() is None
+        assert cb.monitor.tracer._ledger is None

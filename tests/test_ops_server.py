@@ -1,0 +1,198 @@
+"""Live ops endpoint (ISSUE 8): in-process HTTP round trips over all four
+routes, the 503 stall flip, merged /metrics namespaces, and the
+zero-cost-when-not-started contract."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.ops_server import OpsServer, compute_probe
+from paddle_tpu.telemetry import Tracer, TrainMonitor
+from paddle_tpu.telemetry_ledger import RunLedger
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _get_json(url, timeout=10):
+    status, body = _get(url, timeout)
+    return status, json.loads(body)
+
+
+@pytest.fixture
+def server():
+    tr = Tracer()
+    tr.tick("Engine", 0.01, queue_depth=0, active=1)
+    tr.compile_event("Engine", ("prefill", 8), hit=False, wall_s=0.2)
+    mon = TrainMonitor()
+    mon.record_step(0.02, trainer="t", examples=4, tokens=8)
+    led = RunLedger()
+    led.record("compute", 0.25)
+    led.record("data_wait", 0.05)
+    srv = OpsServer(stall_threshold_s=60.0)
+    srv.attach(tr, name="serving").attach(mon, name="train").attach(led)
+    url = srv.start()
+    yield srv, url, tr, mon, led
+    srv.stop()
+
+
+class TestEndpoints:
+    def test_metrics_merges_all_namespaces(self, server):
+        _srv, url, *_ = server
+        status, body = _get(url + "/metrics")
+        assert status == 200
+        # serving + train + ledger + the server's own gauges, ONE scrape
+        assert "paddle_tpu_serving_ticks 1" in body
+        assert "paddle_tpu_train_train_steps 1" in body
+        assert "paddle_tpu_ledger_goodput" in body
+        assert "paddle_tpu_ledger_compute_seconds 0.25" in body
+        assert "paddle_tpu_ops_uptime_seconds" in body
+        assert "# TYPE paddle_tpu_ledger_goodput gauge" in body
+
+    def test_ledger_endpoint_round_trips_snapshot(self, server):
+        _srv, url, *_rest, led = server
+        status, snap = _get_json(url + "/ledger")
+        assert status == 200
+        assert snap["buckets_s"]["compute"] == pytest.approx(0.25)
+        assert set(snap["buckets_s"]) == set(
+            led.snapshot()["buckets_s"])
+
+    def test_trace_tail(self, server):
+        _srv, url, tr, *_ = server
+        status, out = _get_json(url + "/trace?n=10")
+        assert status == 200
+        assert set(out["events"]) == {"serving", "train"}
+        kinds = [e["kind"] for e in out["events"]["serving"]]
+        assert "tick" in kinds and "compile" in kinds
+        # kind filter + n cap
+        _, out = _get_json(url + "/trace?n=1&kind=tick")
+        assert [e["kind"] for e in out["events"]["serving"]] == ["tick"]
+
+    def test_healthz_ok_then_unknown_route_404(self, server):
+        _srv, url, *_ = server
+        status, h = _get_json(url + "/healthz")
+        assert status == 200 and h["ok"] and not h["stalled"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url + "/nope")
+        assert ei.value.code == 404
+        assert "/metrics" in json.load(ei.value)["routes"]
+
+
+class TestHealthStall:
+    def test_healthz_flips_503_on_stall_and_recovers(self):
+        mon = TrainMonitor()
+        mon.record_step(0.01, trainer="t")
+        srv = OpsServer(stall_threshold_s=0.2)
+        srv.attach(mon, name="train")
+        url = srv.start()
+        try:
+            status, h = _get_json(url + "/healthz")
+            assert status == 200 and h["ok"]
+            time.sleep(0.35)                     # simulated stall
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url + "/healthz")
+            assert ei.value.code == 503
+            body = json.load(ei.value)
+            assert body["stalled"] and not body["ok"]
+            assert body["last_step_age_s"] > 0.2
+            # a new step (or heartbeat) recovers without restart
+            mon.record_step(0.01, trainer="t")
+            status, h = _get_json(url + "/healthz")
+            assert status == 200 and h["ok"]
+            time.sleep(0.35)
+            srv.heartbeat()                      # explicit liveness works too
+            status, h = _get_json(url + "/healthz")
+            assert status == 200
+        finally:
+            srv.stop()
+
+    def test_healthz_probe_param_runs_compute_probe(self):
+        srv = OpsServer(stall_threshold_s=60.0, probe_timeout_s=30.0)
+        srv.attach(RunLedger())
+        url = srv.start()
+        try:
+            srv.heartbeat()
+            status, h = _get_json(url + "/healthz?probe=1", timeout=60)
+            assert status == 200
+            assert h["probe"]["ok"] and h["probe"]["devices"] >= 1
+            # the probe is a ROUND TRIP: it fetched a real matmul value
+            assert h["probe"]["value"] == pytest.approx(256.0)
+        finally:
+            srv.stop()
+
+
+class TestLifecycle:
+    def test_not_started_binds_nothing(self):
+        srv = OpsServer(port=0)
+        assert srv._httpd is None                # construction is passive
+        srv.attach(RunLedger())
+        assert srv._httpd is None
+
+    def test_start_idempotent_and_stop_closes(self):
+        srv = OpsServer()
+        srv.attach(RunLedger())
+        url = srv.start()
+        assert srv.start() == url                # second start is a no-op
+        status, _ = _get_json(url + "/ledger")
+        assert status == 200
+        srv.stop()
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            _get(url + "/ledger", timeout=2)
+
+    def test_ledger_404_when_none_attached(self):
+        srv = OpsServer()
+        srv.attach(TrainMonitor(), name="train")
+        url = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _get(url + "/ledger")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_attach_rejects_unknown(self):
+        with pytest.raises(TypeError):
+            OpsServer().attach(object())
+
+    def test_attach_engine_picks_up_its_tracer(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel
+        from paddle_tpu.serving import ContinuousBatchingEngine
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_attention_heads=2, max_position_embeddings=32,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        tr = Tracer()
+        eng = ContinuousBatchingEngine(model, params, max_slots=1,
+                                       max_len=16, prompt_buckets=[8],
+                                       tracer=tr)
+        tr.tick("ContinuousBatchingEngine", 0.01, queue_depth=0)
+        srv = OpsServer()
+        srv.attach(eng, name="cb")
+        url = srv.start()
+        try:
+            _status, out = _get_json(url + "/trace?n=5")
+            assert "cb.tracer" in out["events"]
+            _status, body = _get(url + "/metrics")
+            assert "ticks 1" in body             # engine registry exposition
+        finally:
+            srv.stop()
+
+
+def test_compute_probe_times_out_cleanly(monkeypatch):
+    import paddle_tpu.ops_server as om
+
+    real_thread = om.threading.Thread
+
+    class Hung(real_thread):
+        def __init__(self, *a, target=None, **kw):
+            super().__init__(*a, target=lambda: time.sleep(30), **kw)
+
+    monkeypatch.setattr(om.threading, "Thread", Hung)
+    out = compute_probe(timeout_s=0.2)
+    assert not out["ok"] and "timed out" in out["error"]

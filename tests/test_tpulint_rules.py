@@ -27,6 +27,7 @@ BAD_FIXTURE = {
     "import-time-device-touch": "bad_import_time_device_touch.py",
     "no-print": "bad_no_print.py",
     "jit-in-hot-loop": "bad_jit_in_hot_loop.py",
+    "blocking-fetch-in-loop": "bad_blocking_fetch_in_loop.py",
 }
 CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
                  for rule, path in BAD_FIXTURE.items()}

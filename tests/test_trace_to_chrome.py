@@ -128,3 +128,26 @@ def test_engine_trace_merge(tool, tmp_path, monkeypatch):
                       "--engine-trace", str(one)]) == 0
     names3 = {e["name"] for e in json.loads(out3.read_text())["traceEvents"]}
     assert "dev" in names3 and "tick" in names3
+
+
+def test_ledger_counter_track_merge(tool, tmp_path, monkeypatch):
+    """--ledger merges a RunLedger.dump_json payload as cumulative counter
+    ("C") events next to the device rows."""
+    from paddle_tpu.telemetry_ledger import RunLedger
+    (tmp_path / "host.xplane.pb").write_bytes(b"\x00")
+    _fake_xprof(monkeypatch,
+                json.dumps({"traceEvents": [{"name": "dev", "ph": "X"}]}))
+    led = RunLedger()
+    led.record("compute", 0.2)
+    led.record("data_wait", 0.1)
+    dump = tmp_path / "goodput.json"
+    led.dump_json(str(dump))
+    out = tmp_path / "merged.json"
+    assert tool.main([str(tmp_path), "-o", str(out),
+                      "--ledger", str(dump)]) == 0
+    evs = json.loads(out.read_text())["traceEvents"]
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert len(counters) == 2
+    assert counters[-1]["args"]["compute"] == pytest.approx(0.2)
+    assert counters[-1]["args"]["data_wait"] == pytest.approx(0.1)
+    assert any(e.get("name") == "dev" for e in evs)

@@ -1,0 +1,270 @@
+"""Compare BENCH_*.json artifacts and flag metric regressions.
+
+The per-round bench artifacts were unreadable by machine: each round is a
+driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` whose ``parsed``
+may be one record, a list, or null, and failed rounds masquerade as
+records with ``unit: "error"`` / ``unit: "skipped"`` (bench.py's probe
+protocol).  This tool normalizes all of that and answers the only
+question that matters between rounds: *did any metric regress beyond the
+threshold?*
+
+Usage:
+  python tools/bench_diff.py OLD.json NEW.json [--threshold 0.1]
+  python tools/bench_diff.py --scan . [--threshold 0.1]
+      # orders BENCH_r*.json by round number and compares, per metric,
+      # the previous comparable value against the latest comparable one
+
+Accepted file shapes (auto-detected):
+  - driver wrapper: {"n": 5, ..., "parsed": <record|list|null>}
+    (when parsed is null, records are recovered from the "tail" lines)
+  - a single bench record: {"metric": ..., "value": ..., "unit": ...}
+  - a JSON list of records
+  - raw bench.py stdout: one JSON record per line (JSONL)
+
+Direction is inferred from the unit: throughputs (``.../s...``) regress
+when they DROP, latencies (``ms``/``s``) regress when they RISE.
+Records with ``unit`` of ``error``/``skipped`` or a null value are
+classified as non-comparable, never as regressions — an infra-dead round
+must not read as a code regression (and must not hide one either: it
+simply doesn't participate).
+
+Exit codes:
+  0  comparable data found, no regression beyond --threshold
+  1  at least one regression beyond --threshold
+  2  no comparable data at all (every record error/skipped/missing)
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+#: units where a LOWER new value is better (latency-shaped)
+_LOWER_IS_BETTER = ("ms", "s", "seconds")
+
+
+def classify(record):
+    """'ok' | 'error' | 'skipped' | 'invalid' for one bench record."""
+    if not isinstance(record, dict) or "metric" not in record:
+        return "invalid"
+    unit = record.get("unit")
+    if unit == "error" or "error" in record:
+        return "error"
+    if unit == "skipped" or "skipped" in record:
+        return "skipped"
+    if not isinstance(record.get("value"), (int, float)):
+        return "invalid"
+    return "ok"
+
+
+def _records_from_payload(payload):
+    """Normalize any accepted file shape into a list of record dicts."""
+    if payload is None:
+        return []
+    if isinstance(payload, list):
+        return [r for r in payload if isinstance(r, dict)]
+    if not isinstance(payload, dict):
+        return []
+    if "metric" in payload:
+        return [payload]
+    if "parsed" in payload:                       # driver wrapper
+        records = _records_from_payload(payload["parsed"])
+        if not records:
+            # parsed=null: the wrapper's "tail" keeps bench.py's stdout —
+            # recover any JSON record lines from it
+            for line in str(payload.get("tail") or "").splitlines():
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and "metric" in rec:
+                        records.append(rec)
+        return records
+    return []
+
+
+def load_records(path):
+    """File → list of bench records (module docstring shapes)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        return _records_from_payload(json.loads(text))
+    except ValueError:
+        pass
+    records = []                                   # JSONL stdout capture
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            records.append(rec)
+    return records
+
+
+def lower_is_better(unit):
+    return (unit or "").strip().lower() in _LOWER_IS_BETTER
+
+
+def compare(old_records, new_records, threshold):
+    """Per-metric comparison.  Returns (rows, n_regressions, n_compared);
+    each row is a dict with metric/status/old/new/delta_frac."""
+    old_by = {r["metric"]: r for r in old_records
+              if isinstance(r, dict) and "metric" in r}
+    new_by = {r["metric"]: r for r in new_records
+              if isinstance(r, dict) and "metric" in r}
+    rows = []
+    n_reg = n_cmp = 0
+    for metric in sorted(set(old_by) | set(new_by)):
+        old, new = old_by.get(metric), new_by.get(metric)
+        co = classify(old) if old is not None else "missing"
+        cn = classify(new) if new is not None else "missing"
+        row = {"metric": metric, "old": old, "new": new,
+               "old_status": co, "new_status": cn, "delta_frac": None}
+        if co != "ok" or cn != "ok":
+            row["status"] = f"not comparable ({co} -> {cn})"
+            rows.append(row)
+            continue
+        ov, nv = float(old["value"]), float(new["value"])
+        if ov == 0.0:
+            row["status"] = "not comparable (old value 0)"
+            rows.append(row)
+            continue
+        n_cmp += 1
+        delta = (nv - ov) / abs(ov)
+        row["delta_frac"] = delta
+        worse = -delta if not lower_is_better(new.get("unit")) else delta
+        if worse > threshold:
+            n_reg += 1
+            row["status"] = f"REGRESSION ({worse:+.1%} worse, " \
+                            f"threshold {threshold:.1%})"
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+    return rows, n_reg, n_cmp
+
+
+def _round_key(path):
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    if m:
+        return int(m.group(1))
+    return os.path.basename(path)
+
+
+def scan_trajectory(directory, pattern="BENCH_r*.json"):
+    """Ordered [(path, records)] for the round trajectory in ``directory``."""
+    paths = sorted(glob.glob(os.path.join(directory, pattern)),
+                   key=_round_key)
+    return [(p, load_records(p)) for p in paths]
+
+
+def _fmt_value(rec):
+    if rec is None:
+        return "-"
+    c = classify(rec)
+    if c != "ok":
+        return c
+    return f"{rec['value']:g} {rec.get('unit', '')}".strip()
+
+
+def _print_rows(rows, out):
+    for row in rows:
+        print(f"{row['metric']}: {_fmt_value(row['old'])} -> "
+              f"{_fmt_value(row['new'])}"
+              + (f" ({row['delta_frac']:+.1%})"
+                 if row["delta_frac"] is not None else "")
+              + f"  [{row['status']}]", file=out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter, epilog=__doc__)
+    ap.add_argument("files", nargs="*",
+                    help="two artifacts to compare (OLD NEW)")
+    ap.add_argument("--scan", metavar="DIR", default=None,
+                    help="scan DIR's BENCH_r*.json trajectory instead of "
+                         "comparing two explicit files")
+    ap.add_argument("--pattern", default="BENCH_r*.json",
+                    help="glob for --scan (default: %(default)s)")
+    ap.add_argument("--threshold", type=float, default=0.1,
+                    help="regression threshold as a fraction "
+                         "(default: %(default)s = 10%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON report on stdout")
+    args = ap.parse_args(argv)
+
+    if args.scan is not None:
+        if args.files:
+            ap.error("--scan and explicit files are mutually exclusive")
+        traj = scan_trajectory(args.scan, args.pattern)
+        if not traj:
+            print(f"bench_diff: no {args.pattern} under {args.scan}",
+                  file=sys.stderr)
+            return 2
+        # per metric: latest comparable value vs the comparable value
+        # before it — intermediate error/skipped rounds are stepped over
+        last = {}        # metric -> (round_path, record) previous comparable
+        old_sel, new_sel = {}, {}
+        for path, records in traj:
+            for rec in records:
+                if classify(rec) != "ok":
+                    continue
+                metric = rec["metric"]
+                if metric in last:
+                    old_sel[metric] = last[metric][1]
+                    new_sel[metric] = rec
+                last[metric] = (path, rec)
+        if not args.json:
+            for path, records in traj:
+                states = [f"{r.get('metric')}={_fmt_value(r)}"
+                          for r in records] or ["<no records>"]
+                print(f"{os.path.basename(path)}: " + ", ".join(states))
+        rows, n_reg, n_cmp = compare(list(old_sel.values()),
+                                     list(new_sel.values()), args.threshold)
+        if not n_cmp and last:
+            # metrics exist but never twice — still nothing to diff
+            rows = [{"metric": m, "old": None, "new": rec,
+                     "old_status": "missing", "new_status": "ok",
+                     "delta_frac": None,
+                     "status": "only one comparable round"}
+                    for m, (_p, rec) in sorted(last.items())]
+    else:
+        if len(args.files) != 2:
+            ap.error("need exactly two files (or --scan DIR)")
+        rows, n_reg, n_cmp = compare(load_records(args.files[0]),
+                                     load_records(args.files[1]),
+                                     args.threshold)
+
+    if args.json:
+        print(json.dumps({"threshold": args.threshold, "compared": n_cmp,
+                          "regressions": n_reg,
+                          "rows": [{k: v for k, v in r.items()
+                                    if k not in ("old", "new")}
+                                   | {"old": _fmt_value(r["old"]),
+                                      "new": _fmt_value(r["new"])}
+                                   for r in rows]}, indent=2))
+    else:
+        _print_rows(rows, sys.stdout)
+    if n_cmp == 0:
+        print("bench_diff: no comparable data (every record error/"
+              "skipped/missing)", file=sys.stderr)
+        return 2
+    if n_reg:
+        print(f"bench_diff: {n_reg} regression(s) beyond "
+              f"{args.threshold:.1%}", file=sys.stderr)
+        return 1
+    print(f"bench_diff: OK — {n_cmp} metric(s) compared, no regression "
+          f"beyond {args.threshold:.1%}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

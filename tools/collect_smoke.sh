@@ -113,6 +113,48 @@ if ! python tools/warmup.py --help >/dev/null 2>&1; then
     echo "COLLECT SMOKE FAILED: tools/warmup.py --help"
     exit 1
 fi
+# goodput ledger + ops server surface: modules import clean, a tiny train
+# run's ledger buckets sum to its elapsed wall time (the exhaustiveness
+# invariant), and a LIVE /metrics scrape returns the merged exposition
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'LEDEOF'
+import urllib.request
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.callbacks import GoodputCallback
+from paddle_tpu.telemetry_ledger import (FlightRecorder, RunLedger,  # noqa
+                                         current_ledger)
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.optimizer import Adam
+paddle.seed(0)
+m = Model(nn.Linear(4, 2), inputs=[None])
+m.prepare(Adam(0.01, parameters=m.parameters()), nn.MSELoss())
+cb = GoodputCallback()
+xs = np.ones((8, 4), "float32"); ys = np.zeros((8, 2), "float32")
+m.fit([(xs, ys)] * 6, epochs=1, verbose=0, callbacks=[cb])
+snap = cb.last_snapshot
+total = sum(snap["buckets_s"].values())
+assert abs(total - snap["elapsed_s"]) <= 0.01 * snap["elapsed_s"] + 1e-9, snap
+assert snap["overflow_s"] == 0.0, snap
+assert current_ledger() is None   # symmetric teardown
+srv = OpsServer()
+srv.attach(cb.ledger)
+url = srv.start()
+txt = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+assert "paddle_tpu_ledger_goodput" in txt, txt[:400]
+code = urllib.request.urlopen(url + "/ledger", timeout=10).status
+assert code == 200
+srv.stop()
+LEDEOF
+then
+    echo "COLLECT SMOKE FAILED: goodput ledger / ops server round trip"
+    exit 1
+fi
+if ! python tools/bench_diff.py --help >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: tools/bench_diff.py --help"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

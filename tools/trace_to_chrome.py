@@ -4,14 +4,17 @@ tools/timeline.py, which converts profiler protos for chrome://tracing).
 Usage:
   python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json
   python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json \
-      --engine-trace serve_telemetry.jsonl
+      --engine-trace serve_telemetry.jsonl --ledger goodput.json
 
 The input is a directory written by ``paddle_tpu.profiler`` /
 ``jax.profiler.trace`` (contains ``**/*.xplane.pb``).  ``--engine-trace``
 merges a serving-telemetry dump (``Tracer.dump_jsonl`` JSONL or
 ``Tracer.write_chrome_trace`` JSON) into the same output, so scheduler
-ticks / request spans and XPlane device traces land in ONE file.  Open the
-output in chrome://tracing or https://ui.perfetto.dev.
+ticks / request spans and XPlane device traces land in ONE file.
+``--ledger`` merges a goodput-ledger dump (``RunLedger.dump_json``) as a
+stacked counter track — cumulative seconds per wall-clock bucket next to
+the event rows.  Open the output in chrome://tracing or
+https://ui.perfetto.dev.
 """
 
 import argparse
@@ -42,6 +45,17 @@ def _load_engine_trace(path):
     return chrome_trace_from_jsonl(path)
 
 
+def _load_ledger(path):
+    """RunLedger ``dump_json`` file → chrome-trace dict of counter events
+    (the goodput buckets as a stacked counter track)."""
+    with open(path) as f:
+        data = json.load(f)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from paddle_tpu.telemetry_ledger import chrome_counters_from_dump
+    return {"traceEvents": chrome_counters_from_dump(data)}
+
+
 def _merge(device_payload, engine):
     """Append the engine trace's events to the device trace JSON."""
     data = json.loads(device_payload)
@@ -58,6 +72,9 @@ def main(argv=None):
     ap.add_argument("--engine-trace", default=None,
                     help="serving-telemetry dump (Tracer.dump_jsonl JSONL "
                          "or chrome JSON) to merge into the output")
+    ap.add_argument("--ledger", default=None,
+                    help="goodput-ledger dump (RunLedger.dump_json) to "
+                         "merge as a stacked counter track")
     args = ap.parse_args(argv)
 
     paths = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
@@ -82,6 +99,10 @@ def main(argv=None):
         if isinstance(payload, bytes):
             payload = payload.decode("utf-8")
         payload = _merge(payload, _load_engine_trace(args.engine_trace))
+    if args.ledger is not None:
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8")
+        payload = _merge(payload, _load_ledger(args.ledger))
     mode = "wb" if isinstance(payload, bytes) else "w"
     with open(args.output, mode) as f:
         f.write(payload)
