@@ -621,6 +621,105 @@ def bench_gpt_serving_warmup(on_tpu):
                 cold["first_token_ms"] / warmed["first_token_ms"], 3)}
 
 
+def bench_gpt_gateway(on_tpu):
+    """Overload A/B through the serving gateway (ISSUE 9): the SAME
+    offered load — more requests than the replica fleet can hold — is
+    pushed through (a) a bounded gateway queue that sheds past its depth
+    limit with structured ``Overloaded`` rejections, and (b) an
+    effectively unbounded queue that admits everything.  Shedding is the
+    tail-latency contract: admitted requests under (a) must see a
+    strictly lower p99 TTFT than under (b), because nobody waits behind
+    work the fleet cannot start — asserted, so a routing/admission
+    regression fails the config rather than shading a number.  Also
+    asserted: no silent drops (every offered request terminates as
+    finished or structured-shed) and a clean fleet at quiescence."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.gateway import ServingGateway
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+    from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+    from paddle_tpu.telemetry import Tracer
+
+    kv = os.environ.get("PADDLE_TPU_DECODE_KV") or None
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024,
+                        compute_dtype="bfloat16", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 4, 256, 16, 128
+        buckets, n_reqs, lo_new, hi_new, depth = [64], 48, 24, 48, 4
+        replicas = 2
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=128,
+                        compute_dtype="float32", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 2, 64, 8, 24
+        buckets, n_reqs, lo_new, hi_new, depth = [8, 16], 24, 6, 12, 3
+        replicas = 2
+    paddle.seed(0)
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    rng = np.random.RandomState(0)
+    reqs = [([int(t) for t in rng.randint(1, cfg.vocab_size,
+                                          rng.randint(buckets[0] // 2,
+                                                      buckets[-1] + 1))],
+             int(rng.randint(lo_new, hi_new + 1))) for _ in range(n_reqs)]
+
+    def run_phase(max_queue_depth):
+        eng = lambda: RaggedPagedContinuousBatchingEngine(  # noqa: E731
+            model, params, max_slots=slots, max_len=max_len,
+            block_size=bs, prompt_buckets=buckets, token_budget=budget,
+            tracer=Tracer())
+        gw = ServingGateway(max_queue_depth=max_queue_depth,
+                            tracer=Tracer(capacity=16384))
+        for i in range(replicas):
+            gw.add_replica(eng(), f"r{i}")
+        # the OVERLOAD shape: arrivals outpace the fleet's drain rate
+        # (two per scheduler round, gpt_serving's stagger) — everything
+        # past capacity either queues (unbounded) or sheds (bounded)
+        t0 = time.perf_counter()
+        handles = []
+        for p, n in reqs:
+            handles.append(gw.submit(p, n))
+            if len(handles) % 2 == 0:
+                gw.step()
+        gw.run_to_completion(max_ticks=100000)
+        wall = time.perf_counter() - t0
+        admitted = [r for r in handles if r.status == "finished"]
+        shed = [r for r in handles if r.status == "shed"]
+        assert len(admitted) + len(shed) == len(handles), \
+            [r.status for r in handles]          # no silent drops
+        assert all(r.error is not None for r in shed)   # structured
+        ttfts = np.asarray([r.first_token_at - r.submitted_at
+                            for r in admitted])
+        for name in ("r0", "r1"):
+            assert gw.replica(name).engine.blocks_in_use == 0
+        return {
+            "admitted": len(admitted), "shed": len(shed),
+            "wall_s": round(wall, 3),
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)) * 1e3, 3),
+            "ttft_ms_p99": round(float(np.percentile(ttfts, 99)) * 1e3, 3),
+            "tokens": int(sum(len(r.tokens) for r in admitted)),
+        }
+
+    run_phase(10 ** 9)                 # warm: compiles the program family
+    unbounded = run_phase(10 ** 9)
+    bounded = run_phase(depth)
+    assert bounded["shed"] > 0, bounded
+    assert unbounded["shed"] == 0, unbounded
+    assert bounded["ttft_ms_p99"] < unbounded["ttft_ms_p99"], \
+        (bounded, unbounded)
+    return {"metric": "gpt_gateway_ttft_ms_p99",
+            "value": bounded["ttft_ms_p99"], "unit": "ms",
+            "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
+            "loss": 0.0, "backend": "tpu" if on_tpu else "cpu",
+            "offered": len(reqs), "replicas": replicas,
+            "queue_depth": depth,
+            "bounded": bounded, "unbounded": unbounded,
+            "p99_ttft_improvement": round(
+                unbounded["ttft_ms_p99"] / bounded["ttft_ms_p99"], 3)}
+
+
 def bench_gpt_grad_comm(on_tpu):
     """Gradient-communication policy A/B on the sharded GPT trainer: one
     record comparing step time and bytes-on-wire across the grad_comm
@@ -715,6 +814,7 @@ CONFIGS = {
     "gpt_decode": bench_gpt_decode,
     "gpt_serving": bench_gpt_serving,
     "gpt_serving_warmup": bench_gpt_serving_warmup,
+    "gpt_gateway": bench_gpt_gateway,
     "gpt_grad_comm": bench_gpt_grad_comm,
 }
 

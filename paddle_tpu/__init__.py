@@ -86,7 +86,7 @@ _LAZY = ("nn", "optimizer", "amp", "metric", "io", "vision", "distributed", "jit
          "incubate", "utils", "autograd", "regularizer", "callbacks", "linalg", "fft",
          "signal", "sparse", "onnx", "device", "framework", "inference",
          "quantization", "compat", "sysconfig", "hub", "reader", "dataset",
-         "serving", "telemetry")
+         "serving", "telemetry", "gateway")
 
 
 def __getattr__(name):

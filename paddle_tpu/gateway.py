@@ -1,0 +1,884 @@
+"""Serving gateway: the front door for a fleet of serving-engine replicas.
+
+PRs 1-7 built everything *behind* the socket — ragged/paged/speculative
+engines, AOT-warmed compile caches, telemetry, a live ops endpoint — but
+``add_request`` has no deadline, no cancel, no backpressure, and nothing
+routes across more than one engine.  :class:`ServingGateway` is that
+missing subsystem: it fronts N engine replicas (any mix of the five engine
+classes in ``paddle_tpu.serving``) and turns a fast engine into a service
+that stays fast under overload, replica stalls, and rolling restarts.
+
+Four disciplines, each host-side only (no compiled program changes):
+
+**Admission control & load shedding.**  Requests wait in bounded
+per-priority queues (priority 0 is served first).  Each priority bounds
+both queue DEPTH (``max_queue_depth``) and queued TOKEN budget
+(``max_queued_tokens`` — prompt + ``max_new_tokens`` per request, the
+token-budget-aware limit: a queue of 8 huge prompts is as overloaded as a
+queue of 800 small ones).  Past either limit ``submit()`` rejects
+IMMEDIATELY with a structured :class:`Overloaded` result — the client gets
+a retryable signal in O(1) instead of a admission that silently grows
+everyone's tail latency.
+
+**Deadlines & cancellation.**  ``submit(..., ttft_deadline_s=,
+deadline_s=)`` bounds time-to-first-token and total latency.  The dispatch
+loop expires overdue QUEUED requests before they ever touch an engine, and
+cancels overdue IN-FLIGHT ones through the ``Engine.cancel(rid)``
+primitive (slots / KV blocks / prefix pins / sampling rows all released;
+serving.py).  Expired requests carry a structured
+:class:`DeadlineExceeded`; streaming consumers get the terminal
+``on_token(gid, None, True)`` end-of-stream either way.
+``gateway.cancel(gid)`` is the client-initiated form of the same path.
+
+**Replica routing.**  Default policy is least-outstanding-tokens (the
+replica with the smallest Σ of prompt + remaining-budget tokens in
+flight).  Replicas with a warm prefix cache get an AFFINITY override:
+requests whose prompt chain-digest prefix matches cached blocks route to
+that replica (deepest match wins; ties fall back to least-outstanding) —
+shared system prompts keep hitting the replica that already holds their
+k/v.  Health is watched per the PR 7 ``/healthz`` stall logic: a replica
+whose tracer's newest event is older than ``stall_threshold_s`` while it
+holds in-flight work is QUARANTINED — its completed requests are
+harvested, and every other in-flight request is re-admitted elsewhere
+after the documented replay signal ``on_token(gid, None, False)``
+(discard the streamed prefix; the rerun re-delivers from token one).
+
+**Graceful drain.**  ``drain(name)`` stops admission to a replica while
+its in-flight requests run to completion (zero drops); optionally a
+``replacement`` engine is AOT-``warmup()``-ed against a ``cache_dir``
+(PR 6) while the old replica drains, and takes traffic the moment the
+drain completes — the rolling-restart primitive.
+
+The gateway is COOPERATIVE and single-threaded, like the engines it
+fronts: ``step()`` runs one round (health → expiry → drains → dispatch →
+replica steps → harvest → in-flight deadlines), and ``run_to_completion``
+drives it.  With a ``tracer=`` it emits ``gateway`` events
+(shed/expired/dispatch/reroute/quarantine/drain) through the PR 2 Tracer
+— ring buffer, ``summary()``, Prometheus, and chrome exports included —
+and ``ops_server.OpsServer.attach(gateway)`` serves the live
+``/gateway`` view.
+
+Typical use::
+
+    gw = ServingGateway(tracer=Tracer())
+    gw.add_replica(engine_a, "a")
+    gw.add_replica(engine_b, "b")
+    req = gw.submit([12, 71, 9], max_new_tokens=32, ttft_deadline_s=0.5)
+    if req.status == "shed":
+        ...                         # req.error is a structured Overloaded
+    while gw.pending():
+        gw.step()
+    assert req.status == "finished" and req.tokens
+
+No reference counterpart: the reference snapshot serves static batches
+with no service layer at all (SURVEY §2.3); this is the serving-system
+capstone over the beyond-reference engines.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
+                          prometheus_text as _prometheus_text)
+
+__all__ = ["ServingGateway", "GatewayRequest", "Replica", "Overloaded",
+           "DeadlineExceeded"]
+
+#: replica lifecycle states
+ACTIVE = "active"
+DRAINING = "draining"
+QUARANTINED = "quarantined"
+STOPPED = "stopped"
+
+#: gateway-request terminal states (plus the live "queued"/"dispatched")
+_TERMINAL = frozenset({"finished", "shed", "expired", "cancelled",
+                       "failed"})
+
+
+class Overloaded:
+    """Structured shed rejection: the queue the request would have joined
+    was over its depth or token budget.  Returned on ``GatewayRequest
+    .error`` with ``status == "shed"`` — never an exception, never a
+    silent drop: the client sees exactly which limit fired and how deep
+    the queue was, the retryable-backpressure contract."""
+
+    __slots__ = ("priority", "queue_depth", "queued_tokens", "est_tokens",
+                 "max_queue_depth", "max_queued_tokens")
+
+    def __init__(self, priority, queue_depth, queued_tokens, est_tokens,
+                 max_queue_depth, max_queued_tokens):
+        self.priority = priority
+        self.queue_depth = queue_depth
+        self.queued_tokens = queued_tokens
+        self.est_tokens = est_tokens
+        self.max_queue_depth = max_queue_depth
+        self.max_queued_tokens = max_queued_tokens
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"Overloaded(priority={self.priority}, "
+                f"queue_depth={self.queue_depth}/{self.max_queue_depth}, "
+                f"queued_tokens={self.queued_tokens}"
+                f"{'' if self.max_queued_tokens is None else '/' + str(self.max_queued_tokens)})")
+
+
+class DeadlineExceeded:
+    """Structured deadline expiry: ``kind`` is ``"ttft"`` (no first token
+    by ``ttft_deadline_s``) or ``"total"`` (``deadline_s`` elapsed).
+    ``tokens_delivered`` counts what the consumer already streamed —
+    a mid-decode total-deadline cancel keeps the partial output on
+    ``GatewayRequest.tokens``."""
+
+    __slots__ = ("kind", "deadline_s", "waited_s", "tokens_delivered")
+
+    def __init__(self, kind, deadline_s, waited_s, tokens_delivered):
+        self.kind = kind
+        self.deadline_s = deadline_s
+        self.waited_s = waited_s
+        self.tokens_delivered = tokens_delivered
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"DeadlineExceeded(kind={self.kind!r}, "
+                f"deadline_s={self.deadline_s}, "
+                f"waited_s={round(self.waited_s, 4)}, "
+                f"tokens_delivered={self.tokens_delivered})")
+
+
+class GatewayRequest:
+    """One gateway-tracked request (host-side handle).  ``status`` walks
+    ``queued`` → ``dispatched`` → ``finished``, or terminates early as
+    ``shed`` / ``expired`` / ``cancelled`` / ``failed`` with the
+    structured reason on ``error``.  Timestamps are the gateway's clock
+    (injectable for tests)."""
+
+    __slots__ = ("gid", "prompt", "max_new_tokens", "priority",
+                 "ttft_deadline_s", "deadline_s", "sampling", "on_token",
+                 "status", "tokens", "error", "replica", "engine_rid",
+                 "submitted_at", "dispatched_at", "first_token_at",
+                 "finished_at", "replays", "_rerouting", "_pending_expiry")
+
+    def __init__(self, gid, prompt, max_new_tokens, priority,
+                 ttft_deadline_s, deadline_s, sampling, on_token,
+                 submitted_at):
+        self.gid = gid
+        self.prompt = list(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.priority = int(priority)
+        self.ttft_deadline_s = ttft_deadline_s
+        self.deadline_s = deadline_s
+        self.sampling = dict(sampling)
+        self.on_token = on_token
+        self.status = "queued"
+        self.tokens: List[int] = []
+        self.error = None
+        self.replica: Optional[str] = None
+        self.engine_rid: Optional[int] = None
+        self.submitted_at = submitted_at
+        self.dispatched_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.replays = 0
+        self._rerouting = False
+        self._pending_expiry: Optional[DeadlineExceeded] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in _TERMINAL
+
+    @property
+    def est_tokens(self) -> int:
+        """Queue-budget estimate: prompt plus full generation budget."""
+        return len(self.prompt) + self.max_new_tokens
+
+    def remaining_tokens(self) -> int:
+        """Outstanding-work estimate for routing: whatever of the
+        prompt+budget has not been delivered yet."""
+        return max(self.est_tokens - len(self.tokens), 0)
+
+    def to_dict(self) -> Dict[str, Any]:
+        err = self.error
+        return {"gid": self.gid, "status": self.status,
+                "priority": self.priority, "replica": self.replica,
+                "prompt_len": len(self.prompt),
+                "max_new_tokens": self.max_new_tokens,
+                "tokens": len(self.tokens), "replays": self.replays,
+                "error": (err.to_dict() if hasattr(err, "to_dict")
+                          else err)}
+
+    def __repr__(self):
+        return (f"GatewayRequest(gid={self.gid}, status={self.status!r}, "
+                f"replica={self.replica!r}, tokens={len(self.tokens)})")
+
+
+class Replica:
+    """One engine replica under gateway management: lifecycle state plus
+    the gateway's view of its in-flight work (engine rid → request)."""
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.state = ACTIVE
+        self.inflight: Dict[int, GatewayRequest] = {}
+        self.reason: Optional[str] = None          # quarantine reason
+        self.replacement = None                    # (engine, name) draining
+        self.warm_report = None
+
+    def outstanding_tokens(self) -> int:
+        return sum(r.remaining_tokens() for r in self.inflight.values())
+
+    def slots_available(self) -> int:
+        """Admission headroom: free engine slots not already spoken for by
+        the engine's own internal queue (the gateway keeps waiting
+        requests in ITS queues, where deadlines and shedding apply)."""
+        eng = self.engine
+        return len(eng._free_slots()) - len(eng._queue)
+
+    def idle(self) -> bool:
+        return not self.inflight and not self.engine.pending()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "state": self.state,
+                "inflight": len(self.inflight),
+                "outstanding_tokens": self.outstanding_tokens(),
+                "engine": type(self.engine).__name__,
+                "reason": self.reason}
+
+
+class ServingGateway:
+    """Multi-replica serving front door (module docstring).
+
+    ``max_queue_depth`` / ``max_queued_tokens``: per-priority admission
+    bounds (None disables the token budget).  ``priorities``: number of
+    priority classes (0 = highest, dispatched first).
+    ``stall_threshold_s``: the PR 7 ``/healthz`` dial — a replica whose
+    tracer shows no event for this long while holding in-flight work is
+    quarantined.  ``tracer``: optional ``telemetry.Tracer`` for structured
+    ``gateway`` events (None keeps every emit behind one attribute
+    check).  ``clock``: monotonic-seconds callable — injectable so tests
+    drive deadlines deterministically."""
+
+    def __init__(self, replicas=None, *, max_queue_depth: int = 64,
+                 max_queued_tokens: Optional[int] = None,
+                 priorities: int = 2, stall_threshold_s: float = 30.0,
+                 tracer=None, clock: Callable[[], float] = time.monotonic,
+                 request_history: int = 4096,
+                 logger: Optional[logging.Logger] = None):
+        if int(priorities) < 1:
+            raise ValueError("priorities must be >= 1")
+        if int(max_queue_depth) < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        self.max_queue_depth = int(max_queue_depth)
+        self.max_queued_tokens = (None if max_queued_tokens is None
+                                  else int(max_queued_tokens))
+        self.priorities = int(priorities)
+        self.stall_threshold_s = float(stall_threshold_s)
+        self.tracer = tracer
+        self._clock = clock
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._queues: List[collections.deque] = [
+            collections.deque() for _ in range(self.priorities)]
+        self._queued_tokens = [0] * self.priorities
+        self._replicas: Dict[str, Replica] = {}
+        # gid → handle while live, plus a BOUNDED tail of terminal
+        # handles for late cancel()/request() lookups — a long-lived
+        # gateway must not grow host memory per request served (the
+        # caller's own handle from submit() stays valid regardless)
+        self.request_history = int(request_history)
+        self._requests: Dict[int, GatewayRequest] = {}
+        self._terminal_order: collections.deque = collections.deque()
+        self._finished: Dict[int, List[int]] = {}
+        self._gids = itertools.count()
+        self._stats = StatRegistry()
+        self._stats.histogram("queue_seconds", DEFAULT_TIME_BUCKETS)
+        self._stats.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
+        for engine in (replicas or []):
+            self.add_replica(engine)
+
+    # ------------------------------------------------------------ fleet --
+
+    def add_replica(self, engine, name: Optional[str] = None) -> str:
+        """Register an engine replica (any of the five serving classes —
+        it only needs the shared scheduling surface: ``add_request`` /
+        ``step`` / ``pop_finished`` / ``cancel`` / ``pending``)."""
+        if not hasattr(engine, "cancel"):
+            raise TypeError(
+                f"{type(engine).__name__} has no cancel(rid) — the gateway "
+                f"needs the serving-engine cancellation primitive")
+        if name is None:
+            i = len(self._replicas)
+            while f"r{i}" in self._replicas:     # auto-names never collide
+                i += 1
+            name = f"r{i}"
+        if name in self._replicas and \
+                self._replicas[name].state != STOPPED:
+            raise ValueError(f"replica {name!r} already registered")
+        self._replicas[name] = Replica(name, engine)
+        self._stats.add("replicas_added")
+        return name
+
+    def replica(self, name: str) -> Replica:
+        rep = self._replicas.get(name)
+        if rep is None:
+            raise KeyError(f"unknown replica {name!r}")
+        return rep
+
+    def quarantine(self, name: str, reason: str = "manual"):
+        """Pull a replica out of rotation: completed requests are
+        harvested, every other in-flight request is cancelled on the
+        replica (host-side bookkeeping — safe even when the device is
+        wedged) and re-admitted at the FRONT of its priority queue after
+        the documented replay signal ``on_token(gid, None, False)``."""
+        rep = self.replica(name)
+        if rep.state in (QUARANTINED, STOPPED):
+            return rep
+        was_draining = rep.state == DRAINING
+        rep.state = QUARANTINED
+        rep.reason = reason
+        self._stats.add("quarantines")
+        self._emit("quarantine", replica=name, reason=reason,
+                   inflight=len(rep.inflight))
+        self._log.warning("gateway: quarantined replica %s (%s), "
+                          "re-admitting %d in-flight request(s)",
+                          name, reason, len(rep.inflight))
+        self._reroute_inflight(rep)
+        if was_draining:
+            # a drain interrupted by quarantine still COMPLETES: the
+            # rerouted work finishes elsewhere, and the (possibly already
+            # warmed) replacement must not be silently dropped —
+            # is_drained() stays answerable and drains_started/_completed
+            # stay symmetric
+            self._complete_drain(rep)
+        return rep
+
+    def reinstate(self, name: str):
+        """Return a quarantined replica to rotation (operator decision —
+        the gateway never auto-reinstates a replica it benched)."""
+        rep = self.replica(name)
+        if rep.state == QUARANTINED:
+            rep.state = ACTIVE
+            rep.reason = None
+        return rep
+
+    def drain(self, name: str, replacement=None,
+              cache_dir: Optional[str] = None, warm: bool = True,
+              replacement_name: Optional[str] = None):
+        """Gracefully drain a replica: admission stops NOW, in-flight work
+        runs to completion under ``step()``, and once idle the replica is
+        STOPPED.  ``replacement``: an engine to take its place — with
+        ``warm=True`` it is AOT-``warmup()``-ed immediately (optionally
+        against ``cache_dir``, the PR 6 persistent compile cache) so it
+        joins the fleet already compiled.  Returns the warmup report (or
+        None)."""
+        rep = self.replica(name)
+        if rep.state == STOPPED:
+            return rep.warm_report
+        # validate the hand-over NOW, not rounds later inside step() when
+        # the drain completes (by then the replacement reference would be
+        # cleared and the fleet left a replica short)
+        if replacement is not None:
+            if not hasattr(replacement, "cancel"):
+                raise TypeError(
+                    f"{type(replacement).__name__} has no cancel(rid) — "
+                    f"the gateway needs the serving-engine cancellation "
+                    f"primitive")
+            other = self._replicas.get(replacement_name)
+            if other is not None and other is not rep \
+                    and other.state != STOPPED:
+                raise ValueError(
+                    f"replacement name {replacement_name!r} is a live "
+                    f"replica")
+        rep.state = DRAINING
+        rep.replacement = (replacement, replacement_name)
+        self._stats.add("drains_started")
+        self._emit("drain_start", replica=name,
+                   inflight=len(rep.inflight),
+                   replacement=replacement is not None)
+        if replacement is not None and warm:
+            try:
+                rep.warm_report = replacement.warmup(cache_dir=cache_dir)
+            except NotImplementedError as e:
+                # TP/mesh engines compile on first dispatch (serving.py);
+                # the swap still proceeds, just unwarmed
+                self._log.debug("gateway: replacement warmup skipped: %r",
+                                e)
+        self._advance_drains()
+        return rep.warm_report
+
+    def is_drained(self, name: str) -> bool:
+        return self.replica(name).state == STOPPED
+
+    # --------------------------------------------------------- admission --
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               ttft_deadline_s: Optional[float] = None,
+               deadline_s: Optional[float] = None, on_token=None,
+               **sampling) -> GatewayRequest:
+        """Admit (or shed) one request; always returns the
+        :class:`GatewayRequest` handle.  A shed request is terminal on
+        return: ``status == "shed"`` with a structured
+        :class:`Overloaded` on ``error`` — and a streaming consumer gets
+        the terminal ``on_token(gid, None, True)`` immediately, so no
+        rejection is ever silent."""
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if not 0 <= int(priority) < self.priorities:
+            raise ValueError(f"priority must be in [0, {self.priorities})")
+        now = self._clock()
+        req = GatewayRequest(next(self._gids), prompt, max_new_tokens,
+                             priority, ttft_deadline_s, deadline_s,
+                             sampling, on_token, now)
+        self._requests[req.gid] = req
+        self._stats.add("submitted")
+        q = self._queues[req.priority]
+        qtok = self._queued_tokens[req.priority]
+        over_depth = len(q) >= self.max_queue_depth
+        over_tokens = (self.max_queued_tokens is not None
+                       and qtok + req.est_tokens > self.max_queued_tokens)
+        if over_depth or over_tokens:
+            req.error = Overloaded(req.priority, len(q), qtok,
+                                   req.est_tokens, self.max_queue_depth,
+                                   self.max_queued_tokens)
+            self._finalize(req, "shed", now)
+            self._emit("shed", gid=req.gid, priority=req.priority,
+                       queue_depth=len(q), queued_tokens=qtok,
+                       over=("depth" if over_depth else "tokens"))
+            return req
+        q.append(req)
+        self._queued_tokens[req.priority] += req.est_tokens
+        return req
+
+    def cancel(self, gid: int) -> bool:
+        """Client-initiated cancellation: a queued request is removed and
+        finalized here; a dispatched one rides ``Engine.cancel`` (exact
+        resource release, terminal stream signal).  False: unknown or
+        already terminal."""
+        req = self._requests.get(gid)
+        if req is None or req.done:
+            return False
+        if req.status == "queued":
+            self._unqueue(req)
+            self._finalize(req, "cancelled", self._clock())
+            self._emit("cancel", gid=gid, where="queued")
+            return True
+        rep = self._replicas.get(req.replica)
+        if rep is None or req.engine_rid is None:
+            return False
+        if rep.engine.cancel(req.engine_rid):
+            # the engine's terminal on_token already finalized the handle
+            self._emit("cancel", gid=gid, where="inflight",
+                       replica=rep.name)
+            return True
+        return False
+
+    # -------------------------------------------------------- scheduling --
+
+    def step(self):
+        """One gateway round: health-check replicas, expire overdue queued
+        requests, advance drains, dispatch to replicas, step every replica
+        with work, harvest completions, enforce in-flight deadlines."""
+        self._check_health()
+        now = self._clock()
+        self._expire_queued(now)
+        self._advance_drains()
+        self._dispatch(now)
+        for rep in self._replicas.values():
+            if rep.state in (ACTIVE, DRAINING) and rep.engine.pending():
+                rep.engine.step()
+        self._harvest()
+        self._enforce_inflight_deadlines(self._clock())
+        self._advance_drains()
+
+    def pending(self) -> bool:
+        if any(self._queues):
+            return True
+        return any(rep.inflight or (rep.state in (ACTIVE, DRAINING)
+                                    and rep.engine.pending())
+                   for rep in self._replicas.values())
+
+    def run_to_completion(self, max_ticks: Optional[int] = None
+                          ) -> Dict[int, List[int]]:
+        """Drive ``step()`` until nothing is queued or in flight; returns
+        ``pop_finished()``."""
+        ticks = 0
+        while self.pending():
+            self.step()
+            ticks += 1
+            if max_ticks is not None and ticks > max_ticks:
+                raise RuntimeError(f"not done after {max_ticks} ticks")
+        return self.pop_finished()
+
+    def pop_finished(self) -> Dict[int, List[int]]:
+        """Completed generations since the last pop: {gid: tokens}.  Only
+        natural completions land here — shed/expired/cancelled requests
+        terminate on their handle (``status`` + ``error``)."""
+        out, self._finished = self._finished, {}
+        return out
+
+    def request(self, gid: int) -> GatewayRequest:
+        req = self._requests.get(gid)
+        if req is None:
+            raise KeyError(f"unknown gateway request {gid}")
+        return req
+
+    # ----------------------------------------------------- step internals --
+
+    def _check_health(self):
+        """PR 7 ``/healthz`` stall logic applied per replica: in-flight
+        work + a tracer whose newest event is older than the threshold =
+        a stalled tick → quarantine.  An idle replica is never flagged
+        (no work → no events is healthy), and a replica without a tracer
+        is trusted (nothing to judge by)."""
+        for rep in list(self._replicas.values()):
+            if rep.state not in (ACTIVE, DRAINING) or not rep.inflight:
+                continue
+            tracer = getattr(rep.engine, "tracer", None)
+            if tracer is None:
+                continue
+            try:
+                age = tracer.last_event_age_s()
+            except Exception as e:  # noqa: BLE001 — a broken tracer must
+                # not take the dispatch loop down with it
+                self._log.debug("gateway: health scan failed on %s: %r",
+                                rep.name, e)
+                continue
+            if age is not None and age > self.stall_threshold_s:
+                self.quarantine(rep.name,
+                                reason=f"stalled tick ({age:.1f}s > "
+                                       f"{self.stall_threshold_s:.1f}s)")
+
+    def _expire_queued(self, now: float):
+        for pri, q in enumerate(self._queues):
+            if not q:
+                continue
+            keep = collections.deque()
+            for req in q:
+                waited = now - req.submitted_at
+                kind = None
+                if req.deadline_s is not None and waited > req.deadline_s:
+                    kind = "total"
+                elif (req.ttft_deadline_s is not None
+                        and waited > req.ttft_deadline_s):
+                    kind = "ttft"
+                if kind is None:
+                    keep.append(req)
+                    continue
+                self._queued_tokens[pri] -= req.est_tokens
+                req.error = DeadlineExceeded(kind, req.deadline_s
+                                             if kind == "total"
+                                             else req.ttft_deadline_s,
+                                             waited, 0)
+                self._finalize(req, "expired", now)
+                self._stats.add(f"expired_{kind}")
+                self._emit("expired", gid=req.gid, kind=kind,
+                           waited_s=waited, where="queued")
+            self._queues[pri] = keep
+
+    def _enforce_inflight_deadlines(self, now: float):
+        for rep in self._replicas.values():
+            for rid, req in list(rep.inflight.items()):
+                waited = now - req.submitted_at
+                kind = None
+                if req.deadline_s is not None and waited > req.deadline_s:
+                    kind = "total"
+                elif (req.first_token_at is None
+                        and req.ttft_deadline_s is not None
+                        and waited > req.ttft_deadline_s):
+                    kind = "ttft"
+                if kind is None:
+                    continue
+                req._pending_expiry = DeadlineExceeded(
+                    kind, req.deadline_s if kind == "total"
+                    else req.ttft_deadline_s, waited, len(req.tokens))
+                self._stats.add(f"expired_{kind}")
+                self._emit("expired", gid=req.gid, kind=kind,
+                           waited_s=waited, where="inflight",
+                           replica=rep.name,
+                           tokens_delivered=len(req.tokens))
+                if not rep.engine.cancel(rid):
+                    # lost the race with retirement: the engine finished
+                    # it this very round — harvest delivers it, the
+                    # deadline miss stays recorded as an event only
+                    req._pending_expiry = None
+
+    def _advance_drains(self):
+        for rep in list(self._replicas.values()):
+            if rep.state == DRAINING and rep.idle():
+                self._complete_drain(rep)
+
+    def _complete_drain(self, rep: Replica):
+        rep.state = STOPPED
+        self._stats.add("drains_completed")
+        self._emit("drain_done", replica=rep.name)
+        replacement, new_name = rep.replacement or (None, None)
+        rep.replacement = None
+        if replacement is not None:
+            name = self.add_replica(replacement, name=new_name)
+            self._emit("replaced", replica=rep.name, by=name)
+
+    def _dispatch(self, now: float):
+        """Move queued requests onto replicas, highest priority first,
+        FIFO within a priority, while any replica has admission
+        headroom."""
+        for pri, q in enumerate(self._queues):
+            while q:
+                target = self._route(q[0])
+                if target is None:
+                    return              # fleet-wide: no headroom anywhere
+                req = q.popleft()
+                self._queued_tokens[pri] -= req.est_tokens
+                self._dispatch_to(target, req, now)
+
+    def _route(self, req: GatewayRequest) -> Optional[Replica]:
+        """Pick the target replica: among ACTIVE replicas with admission
+        headroom, the deepest prefix-cache match wins (prefix affinity);
+        ties — including the common no-match case — go to the least
+        outstanding tokens."""
+        cands = [rep for rep in self._replicas.values()
+                 if rep.state == ACTIVE and rep.slots_available() > 0]
+        if not cands:
+            return None
+        scored = [(-self._prefix_depth(rep.engine, req.prompt),
+                   rep.outstanding_tokens(), i)
+                  for i, rep in enumerate(cands)]
+        return cands[min(scored)[2]]
+
+    @staticmethod
+    def _prefix_depth(engine, prompt: List[int]) -> int:
+        """Length (in blocks) of the prompt's chain-digest prefix already
+        resident in the replica's prefix cache — a pure READ of the chain
+        keys (no LRU touch, no pinning: ``_lookup_prefix`` does those at
+        admission)."""
+        if not getattr(engine, "prefix_caching", False):
+            return 0
+        try:
+            from .jit.bucketing import select_bucket
+            P = select_bucket(len(prompt), engine.buckets)
+        except ValueError:
+            return 0
+        pad = P - len(prompt)
+        ids = [0] * pad + prompt
+        depth = 0
+        for chain in engine._chain_keys(ids, pad, max(P // engine.bs - 1,
+                                                      0)):
+            if chain not in engine._prefix_cache:
+                break
+            depth += 1
+        return depth
+
+    def _dispatch_to(self, rep: Replica, req: GatewayRequest, now: float):
+        queue_s = now - req.submitted_at
+        try:
+            rid = rep.engine.add_request(
+                req.prompt, req.max_new_tokens,
+                on_token=self._make_on_token(rep, req), **req.sampling)
+        except (ValueError, TypeError, NotImplementedError) as e:
+            # a structurally unservable request (prompt over max_len,
+            # sampling knobs the engine rejects): terminal "failed", the
+            # loop keeps running
+            req.error = repr(e)
+            self._finalize(req, "failed", now)
+            self._emit("failed", gid=req.gid, replica=rep.name,
+                       error=repr(e))
+            return
+        req.engine_rid = rid
+        req.replica = rep.name
+        req.dispatched_at = now
+        req.status = "dispatched"
+        rep.inflight[rid] = req
+        self._stats.add("dispatched")
+        self._stats.observe("queue_seconds", queue_s)
+        self._emit("dispatch", gid=req.gid, replica=rep.name,
+                   queue_s=queue_s, priority=req.priority)
+
+    def _make_on_token(self, rep: Replica, req: GatewayRequest):
+        """The engine-facing streaming callback: forwards to the user's
+        ``on_token`` under the GATEWAY id, tracks first-token/TTFT, and
+        translates the engines' two sentinel signals — replay
+        (``None, False``) resets the stream, terminal (``None, True``)
+        resolves to expired/cancelled per what triggered the cancel."""
+        def cb(_rid, tok, done):
+            if tok is None and not done:
+                # engine-level preemption replay (paged pool pressure):
+                # reset and forward — the rerun re-delivers from token one
+                req.tokens = []
+                req.first_token_at = None
+                req.replays += 1
+                if req.on_token is not None:
+                    req.on_token(req.gid, None, False)
+                return
+            if tok is None and done:
+                rep.inflight.pop(req.engine_rid, None)
+                if req._rerouting:
+                    return          # quarantine path signals separately
+                now = self._clock()
+                if req._pending_expiry is not None:
+                    req.error = req._pending_expiry
+                    req._pending_expiry = None
+                    self._finalize(req, "expired", now)      # forwards the
+                else:                                        # terminal sig
+                    self._finalize(req, "cancelled", now)
+                return
+            if req.first_token_at is None:
+                # TTFT is observed into the histogram at FINISH, not here:
+                # a preemption/reroute would roll this attempt back, and
+                # the histogram carries one sample per request — the
+                # surviving attempt (the Tracer's documented semantics)
+                req.first_token_at = self._clock()
+            req.tokens.append(int(tok))
+            if req.on_token is not None:
+                req.on_token(req.gid, int(tok), done)
+        return cb
+
+    def _harvest(self):
+        for rep in self._replicas.values():
+            self._harvest_replica(rep)
+
+    def _harvest_replica(self, rep: Replica):
+        if not hasattr(rep.engine, "pop_finished"):
+            return
+        for rid, tokens in rep.engine.pop_finished().items():
+            req = rep.inflight.pop(rid, None)
+            if req is None:
+                continue            # not gateway-managed (direct client)
+            req.tokens = list(tokens)       # engine list is authoritative
+            if req.first_token_at is not None:
+                self._stats.observe("ttft_seconds",
+                                    req.first_token_at - req.submitted_at)
+            self._finalize(req, "finished", self._clock(), signal=False)
+            self._finished[req.gid] = req.tokens
+
+    def _reroute_inflight(self, rep: Replica):
+        """Quarantine re-admission: completed work is harvested (never
+        replayed), everything else is cancelled on the replica and
+        re-queued at the FRONT of its priority queue, oldest first, after
+        the documented replay signal."""
+        self._harvest_replica(rep)
+        moved = sorted(rep.inflight.items(),
+                       key=lambda kv: kv[1].submitted_at, reverse=True)
+        for rid, req in moved:
+            req._rerouting = True
+            try:
+                rep.engine.cancel(rid)
+            except Exception as e:  # noqa: BLE001 — a wedged replica's
+                # host state is best-effort; the request reroutes anyway
+                self._log.debug("gateway: cancel on quarantined %s "
+                                "failed: %r", rep.name, e)
+            finally:
+                req._rerouting = False
+            rep.inflight.pop(rid, None)
+            req.engine_rid = None
+            req.replica = None
+            req.tokens = []
+            req.first_token_at = None
+            req.replays += 1
+            req.status = "queued"
+            if req.on_token is not None:
+                try:
+                    req.on_token(req.gid, None, False)     # replay signal
+                except Exception:  # noqa: BLE001 — a raising consumer must
+                    # not strand the replica's remaining in-flight requests
+                    self._log.exception(
+                        "gateway on_token replay signal failed for %d",
+                        req.gid)
+            self._queues[req.priority].appendleft(req)
+            self._queued_tokens[req.priority] += req.est_tokens
+            self._stats.add("rerouted")
+            self._emit("reroute", gid=req.gid, from_replica=rep.name)
+
+    def _unqueue(self, req: GatewayRequest):
+        q = self._queues[req.priority]
+        try:
+            q.remove(req)
+        except ValueError:
+            return
+        self._queued_tokens[req.priority] -= req.est_tokens
+
+    def _finalize(self, req: GatewayRequest, status: str, now: float,
+                  signal: bool = True):
+        """Terminal transition.  ``signal=True`` delivers the clean
+        end-of-stream ``on_token(gid, None, True)`` to the consumer —
+        every early termination (shed/expired/cancelled/failed) signals;
+        natural completion does not (the engine already delivered the
+        last token with ``done=True``)."""
+        req.status = status
+        req.finished_at = now
+        self._stats.add(status)
+        self._terminal_order.append(req.gid)
+        while len(self._terminal_order) > self.request_history:
+            old = self._terminal_order.popleft()
+            stale = self._requests.get(old)
+            if stale is not None and stale.done:
+                del self._requests[old]
+        if signal and req.on_token is not None:
+            try:
+                req.on_token(req.gid, None, True)
+            except Exception:  # noqa: BLE001 — consumer bugs must not
+                # break the dispatch loop
+                self._log.exception(
+                    "gateway on_token terminal signal failed for %d",
+                    req.gid)
+
+    def _emit(self, what: str, **fields):
+        if self.tracer is None:
+            return
+        self.tracer.emit("gateway", what=what, **fields)
+
+    # --------------------------------------------------------- telemetry --
+
+    def queue_depths(self) -> Dict[int, Dict[str, int]]:
+        return {pri: {"depth": len(q),
+                      "queued_tokens": self._queued_tokens[pri]}
+                for pri, q in enumerate(self._queues)}
+
+    def gateway_snapshot(self) -> Dict[str, Any]:
+        """JSON-able live view — what ``ops_server``'s ``/gateway`` route
+        serves: replica states, queue depths, counters, latency
+        percentiles."""
+        h_q = self._stats.histogram("queue_seconds")
+        h_t = self._stats.histogram("ttft_seconds")
+        counters = {k: v for k, v in self._stats.snapshot().items()}
+        return {
+            "replicas": [rep.to_dict() for rep in self._replicas.values()],
+            "queues": self.queue_depths(),
+            "counters": counters,
+            # bucket-resolution estimates (utils.stats.Histogram); exact
+            # sample percentiles ride the tracer / request handles
+            "queue_s": {"p50": h_q.percentile(0.50),
+                        "p99": h_q.percentile(0.99)},
+            "ttft_s": {"p50": h_t.percentile(0.50),
+                       "p99": h_t.percentile(0.99)},
+        }
+
+    summary = gateway_snapshot
+
+    def metrics(self) -> Dict[str, float]:
+        out = dict(self._stats.snapshot())
+        out["queued"] = float(sum(len(q) for q in self._queues))
+        out["inflight"] = float(sum(len(rep.inflight)
+                                    for rep in self._replicas.values()))
+        return out
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_gateway") -> str:
+        return _prometheus_text(
+            self._stats, namespace=namespace,
+            extra_gauges={
+                "queued": sum(len(q) for q in self._queues),
+                "inflight": sum(len(rep.inflight)
+                                for rep in self._replicas.values()),
+                "replicas_active": sum(
+                    1 for rep in self._replicas.values()
+                    if rep.state == ACTIVE)})

@@ -25,6 +25,10 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
 ``GET /trace``
     ring-buffer tail: the last ``?n=`` events (default 256) per attached
     tracer/monitor, optionally filtered by ``?kind=``.
+``GET /gateway``
+    the attached :class:`~paddle_tpu.gateway.ServingGateway` snapshot(s)
+    as JSON — replica states, per-priority queue depths, shed/reroute/
+    drain counters, queue/TTFT percentiles (404 when none is attached).
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -121,10 +125,20 @@ class _Handler(BaseHTTPRequestHandler):
                 kind = query.get("kind", [None])[0]
                 self._send(200, json.dumps(ops._render_trace(n, kind)),
                            "application/json")
+            elif route == "/gateway":
+                payload = ops._render_gateway()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no gateway attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
-                     ["/metrics", "/healthz", "/ledger", "/trace"]}),
+                     ["/metrics", "/healthz", "/ledger", "/trace",
+                      "/gateway"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -169,6 +183,7 @@ class OpsServer:
         self._tracers: List[Tuple[str, Any]] = []   # Tracer / TrainMonitor
         self._engines: List[Tuple[str, Any]] = []
         self._ledgers: List[Tuple[str, Any]] = []
+        self._gateways: List[Tuple[str, Any]] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -179,13 +194,22 @@ class OpsServer:
         """Attach a telemetry source; kind is detected:
 
         - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
+        - ``ServingGateway`` (has ``gateway_snapshot``) → /gateway +
+          /metrics (its ``.tracer``, when set, is attached too);
         - ``Tracer`` / ``TrainMonitor`` (has ``events`` +
           ``prometheus_text``) → /metrics + /trace + liveness;
         - a serving engine (has ``prometheus_text``; its ``.tracer``, when
           set, is attached too) → /metrics (+ tracer surfaces).
         """
         with self._lock:
-            if hasattr(obj, "snapshot") and hasattr(obj, "record"):
+            if hasattr(obj, "gateway_snapshot"):
+                base = name or f"gateway{len(self._gateways)}"
+                self._gateways.append((base, obj))
+                self._engines.append((base, obj))   # /metrics exposition
+                tracer = getattr(obj, "tracer", None)
+                if tracer is not None:
+                    self._tracers.append((f"{base}.tracer", tracer))
+            elif hasattr(obj, "snapshot") and hasattr(obj, "record"):
                 self._ledgers.append(
                     (name or f"ledger{len(self._ledgers)}", obj))
             elif hasattr(obj, "events") and hasattr(obj, "prometheus_text"):
@@ -307,6 +331,15 @@ class OpsServer:
         if len(ledgers) == 1:
             return ledgers[0][1].snapshot()
         return {name: led.snapshot() for name, led in ledgers}
+
+    def _render_gateway(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            gateways = list(self._gateways)
+        if not gateways:
+            return None
+        if len(gateways) == 1:
+            return gateways[0][1].gateway_snapshot()
+        return {name: gw.gateway_snapshot() for name, gw in gateways}
 
     def _render_trace(self, n: int, kind: Optional[str]) -> Dict[str, Any]:
         tracers, _, _ = self._sources()

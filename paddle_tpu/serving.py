@@ -721,7 +721,11 @@ class ContinuousBatchingEngine:
         ``on_token(request_id, token, done)``: optional streaming callback,
         invoked on the host as each token is accepted (chunked/speculative
         modes deliver a burst per sync — ordering within a request is
-        guaranteed, across requests it follows slot order).
+        guaranteed, across requests it follows slot order).  A
+        ``cancel(request_id)`` ends the stream with ONE terminal
+        ``on_token(request_id, None, True)`` call — ``token is None`` with
+        ``done=True`` is the documented clean end-of-stream (the paged
+        engines' preemption replay signal is the ``done=False`` variant).
 
         With ``per_request_sampling=True`` the engine accepts the
         generate()-style per-call knobs here — ``temperature``, ``top_k``,
@@ -951,7 +955,9 @@ class ContinuousBatchingEngine:
                 # this sync would be silently dropped); log and continue
                 logging.getLogger(__name__).exception(
                     "on_token callback failed for request %d", req.id)
-        if done:
+        # the callback may have cancel()ed this very request (reentrant
+        # consumer): the slot is already released — nothing left to retire
+        if done and self._slot_req[slot] is not None:
             self._retire(slot)
 
     def _retire(self, slot: int):
@@ -971,6 +977,78 @@ class ContinuousBatchingEngine:
         s.add("latency_seconds_sum", req.finished_at - req.enqueued_at)
         if self.tracer is not None:
             self.tracer.request_event(req.id, "retired", tokens=n)
+
+    def cancel(self, rid: int) -> bool:
+        """Cancel one in-flight request and release every resource it holds.
+
+        Works at ANY lifecycle stage — still queued, mid-(chunked-)prefill,
+        or actively decoding — and is pure host bookkeeping (no device
+        program runs): the slot frees for the next admission, the paged
+        engines additionally release the slot's KV blocks and prefix-cache
+        pins (``_release_cancelled_slot``), and per-request sampling rows
+        reset to the engine defaults.  Cancelled requests never appear in
+        ``pop_finished()``; a streaming consumer gets ONE terminal
+        ``on_token(rid, None, True)`` call — the documented clean
+        end-of-stream (``done=True``, vs the preemption replay signal's
+        ``done=False``).  Returns True iff the request was found in flight;
+        False means an unknown rid or an already-finished request (the
+        caller raced retirement — its tokens are in ``pop_finished()``).
+
+        The slot's stale cache/presence contents need no device work: the
+        next occupant's admission prefill rewrites both before anything
+        reads them (the same write-before-read induction inactive slots
+        rely on — module docstring)."""
+        for i, req in enumerate(self._queue):
+            if req.id == rid:
+                del self._queue[i]
+                self._finalize_cancel(req)
+                return True
+        for slot, st in list(self._filling.items()):
+            if st["req"].id == rid:
+                del self._filling[slot]
+                self._release_cancelled_slot(slot)
+                self._finalize_cancel(st["req"])
+                return True
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.id == rid:
+                self._slot_req[slot] = None
+                self._active[slot] = False
+                self._release_cancelled_slot(slot)
+                self._finalize_cancel(req)
+                return True
+        return False
+
+    def _release_cancelled_slot(self, slot: int):
+        """Free the per-slot resources a cancelled occupant held (seam:
+        the paged engines add block + prefix-pin release)."""
+        if self.per_request:
+            t, k, p, g, rp, mn, eos = self._plane_defaults
+            self._r_temp[slot] = t
+            self._r_topk[slot] = k
+            self._r_topp[slot] = p
+            self._r_greedy[slot] = g
+            self._r_rp[slot] = rp
+            self._r_minnew[slot] = mn
+            self._r_eos[slot] = eos
+
+    def _finalize_cancel(self, req: Request):
+        """Terminal bookkeeping shared by every cancel path: counters, the
+        ``cancelled`` telemetry transition, and the clean end-of-stream
+        signal."""
+        req.done = True
+        req.finished_at = time.monotonic()
+        self._stats.add("requests_cancelled")
+        stat_add("serving_requests_cancelled")
+        if self.tracer is not None:
+            self.tracer.request_event(req.id, "cancelled",
+                                      tokens=len(req.generated))
+        if req.on_token is not None:
+            try:
+                req.on_token(req.id, None, True)   # terminal end-of-stream
+            except Exception:  # noqa: BLE001 — same contract as _record:
+                # a user callback must not desync the scheduler
+                logging.getLogger(__name__).exception(
+                    "on_token cancel signal failed for request %d", req.id)
 
     _TICK_COUNTERS = ("tokens_emitted", "requests_finished")
 
@@ -1071,6 +1149,7 @@ class ContinuousBatchingEngine:
     # never change meaning; subclasses extend (docs/OBSERVABILITY.md).
     METRICS_SCHEMA = {
         "requests_finished": ("counter", int),
+        "requests_cancelled": ("counter", int),
         "tokens_emitted": ("counter", int),
         "mean_ttft_s": ("gauge", float),
         "mean_latency_s": ("gauge", float),
@@ -1104,6 +1183,7 @@ class ContinuousBatchingEngine:
         toks = int(s.value("tokens_emitted"))
         dt = max(time.monotonic() - self._started, 1e-9)
         return {"requests_finished": nreq,
+                "requests_cancelled": int(s.value("requests_cancelled")),
                 "tokens_emitted": toks,
                 "mean_ttft_s": float(s.value("ttft_seconds_sum")) / n,
                 "mean_latency_s": float(s.value("latency_seconds_sum")) / n,

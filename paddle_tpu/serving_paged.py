@@ -214,6 +214,16 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._stats.add("blocks_allocated", len(out))
         return out
 
+    def _pin(self, bid: int):
+        """Take one reference on a cached prefix block.  A 0→1 pin is
+        allocator TRAFFIC — the block leaves the evictable set — and
+        counts ``blocks_allocated``, mirroring ``_release``'s count at
+        1→0: ``blocks_allocated == blocks_released`` holds at quiescence
+        with prefix hits and cancels interleaved (the fuzz pins it)."""
+        self._refs[bid] += 1
+        if self._refs[bid] == 1:
+            self._stats.add("blocks_allocated")
+
     def _release(self, bid: int):
         self._refs[bid] -= 1
         if self._refs[bid] == 0:
@@ -302,6 +312,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _retire(self, slot: int):
         super()._retire(slot)
         self._free_slot_blocks(slot)
+
+    def _release_cancelled_slot(self, slot: int):
+        """Cancel's resource seam: release the slot's blocks exactly as
+        retirement would — decode growth frees outright, cached prompt
+        blocks drop their pin (refcount) and linger evictable, so
+        ``blocks_allocated == blocks_released`` holds at quiescence with
+        cancels interleaved (the allocator fuzz pins it)."""
+        self._free_slot_blocks(slot)
+        super()._release_cancelled_slot(slot)
 
     def _preempt_one(self) -> bool:
         """Evict the YOUNGEST in-flight request (active or still filling),
@@ -574,7 +593,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                                     or suffix <= self.prefill_chunk)
             if use_cached:
                 for bid in hit:                   # pin before eviction runs
-                    self._refs[bid] += 1
+                    self._pin(bid)
                 fresh = self._alloc_blocks(suffix // self.bs)
                 if fresh is None:
                     for bid in hit:
@@ -876,7 +895,7 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
                       if self.prefix_caching else (0, []))
             if F:
                 for bid in hit:                   # pin before eviction runs
-                    self._refs[bid] += 1
+                    self._pin(bid)
                 self._table[slot, :F] = hit
                 self._nblk[slot] = F
                 self._stats.add("prefix_hits")

@@ -34,7 +34,15 @@ Event kinds (each event is one flat JSON-serializable dict):
              tick/request history from the ring.
 ``request``  one request state transition: ``rid`` plus ``what`` in
              ``queued`` → ``admitted`` → ``first_token`` → ``token`` →
-             (``preempted`` → ``admitted`` → …) → ``retired``.
+             (``preempted`` → ``admitted`` → …) → ``retired`` |
+             ``cancelled`` (``engine.cancel(rid)`` — terminal, closes the
+             timeline without a TTFT histogram sample).
+``gateway``  one serving-gateway action (``paddle_tpu.gateway``): ``what``
+             in ``shed`` / ``expired`` / ``dispatch`` / ``reroute`` /
+             ``quarantine`` / ``drain_start`` / ``drain_done`` /
+             ``cancel``, with per-kind fields (priority, queue depths,
+             replica, deadline kind); queue waits feed the registry's
+             ``gateway_queue_seconds`` histogram.
 
 Exports:
 
@@ -496,6 +504,15 @@ class Tracer:
                 self.registry.add("requests_retired")
                 self._live.pop(rid, None)
                 self._done.append(tl)
+            elif what == "cancelled":
+                # engine.cancel(): terminal — the timeline closes like a
+                # retirement but contributes NO TTFT histogram sample (the
+                # histograms describe completed service; cancels are
+                # counted, not averaged in)
+                tl.retired_at = ts
+                self.registry.add("requests_cancelled")
+                self._live.pop(rid, None)
+                self._done.append(tl)
             ev = {"kind": "request", "ts": ts, "rid": rid, "what": what}
             ev.update(fields)
             self._append(ev)
@@ -539,7 +556,21 @@ class Tracer:
         request percentiles — the BENCH-round telemetry attachment."""
         ticks = self.events("tick")
         reg = self.registry
-        return {
+        gw = self.events("gateway")
+        gw_summary = None
+        if gw:
+            counts: Dict[str, int] = {}
+            for ev in gw:
+                counts[ev.get("what", "?")] = \
+                    counts.get(ev.get("what", "?"), 0) + 1
+            gw_summary = {
+                "events": counts,
+                "queue_s": _percentiles(
+                    [ev["queue_s"] for ev in gw
+                     if ev.get("what") == "dispatch"
+                     and ev.get("queue_s") is not None]),
+            }
+        out = {
             "ticks": len(ticks),
             "ticks_total": int(reg.value("ticks")),
             "tick_wall_s": _percentiles([e["dur_s"] for e in ticks]),
@@ -556,6 +587,9 @@ class Tracer:
             "requests": self.request_summary(),
             "events_dropped": self.events_dropped,
         }
+        if gw_summary is not None:     # only gateway-fed tracers carry it
+            out["gateway"] = gw_summary
+        return out
 
     # ---------------------------------------------------------- exports --
 
@@ -1091,6 +1125,15 @@ def events_to_chrome(events: List[Dict[str, Any]],
             out.append({"name": ev.get("what", "?"), "cat": "request",
                         "ph": "i", "s": "t", "pid": _PID,
                         "tid": f"req:{ev.get('rid')}", "ts": us,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("kind", "ts")}})
+        elif ev["kind"] == "gateway":
+            # gateway actions are instants on their own scheduler row —
+            # shed/reroute/drain markers line up against ticks and request
+            # spans in the same Perfetto view
+            out.append({"name": f"gateway:{ev.get('what', '?')}",
+                        "cat": "gateway", "ph": "i", "s": "t",
+                        "pid": _PID, "tid": "gateway", "ts": us,
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("kind", "ts")}})
         elif ev["kind"] in ("train_step", "sync", "profiler_step"):

@@ -1,0 +1,486 @@
+"""Serving gateway (paddle_tpu/gateway.py, ISSUE 9): admission control /
+shedding thresholds, TTFT + total deadlines (pre-dispatch and mid-decode),
+prefix-affinity and least-outstanding routing, quarantine + re-admission
+with the documented replay signal, graceful drain with zero drops, and
+output parity with a solo engine.
+
+All timing runs on a DETERMINISTIC fake clock injected via ``clock=`` —
+deadline behavior is exact, never sleep-based.  No reference counterpart:
+the reference snapshot has no service layer at all (SURVEY §2.3)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.gateway import (DeadlineExceeded, Overloaded,
+                                ServingGateway)
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import (PagedContinuousBatchingEngine,
+                                RaggedPagedContinuousBatchingEngine)
+from paddle_tpu.telemetry import Tracer
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+def _solo_greedy(model, params, prompt, n):
+    out = model.generate(params, jnp.asarray([prompt], jnp.int32), n,
+                         greedy=True)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _paged(model, params, tracer=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_buckets", [8, 16])
+    return PagedContinuousBatchingEngine(model, params, tracer=tracer,
+                                         **kw)
+
+
+def _ragged(model, params, tracer=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_buckets", [8, 16])
+    kw.setdefault("token_budget", 16)
+    return RaggedPagedContinuousBatchingEngine(model, params,
+                                               tracer=tracer, **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+PROMPTS = [([5, 17, 3], 8), ([40, 2], 6), ([61], 5), ([9, 9, 1], 7),
+           ([8, 30, 12, 4], 4)]
+
+
+class TestParity:
+    def test_single_replica_matches_solo(self, model_and_params):
+        """One healthy replica behind the gateway = the solo engine:
+        token-for-token parity, intact streams, clean terminal flags."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "a")
+        streams = {}
+        handles = [gw.submit(p, n, on_token=lambda g, t, d:
+                             streams.setdefault(g, []).append((t, d)))
+                   for p, n in PROMPTS]
+        got = gw.run_to_completion(max_ticks=300)
+        assert sorted(got) == sorted(r.gid for r in handles)
+        for r, (p, n) in zip(handles, PROMPTS):
+            want = _solo_greedy(model, params, p, n)
+            assert r.status == "finished"
+            assert r.tokens == want and got[r.gid] == want
+            assert [t for t, _ in streams[r.gid]] == want
+            assert streams[r.gid][-1][1] is True
+        assert gw.replica("a").engine.blocks_in_use == 0
+
+    def test_mixed_engine_fleet(self, model_and_params):
+        """A heterogeneous fleet (paged + ragged replicas) serves the
+        same oracle-exact outputs — the gateway only relies on the shared
+        engine surface."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "paged")
+        gw.add_replica(_ragged(model, params), "ragged")
+        handles = [gw.submit(p, n) for p, n in PROMPTS]
+        gw.run_to_completion(max_ticks=300)
+        assert {r.replica for r in handles} == {"paged", "ragged"}
+        for r, (p, n) in zip(handles, PROMPTS):
+            assert r.tokens == _solo_greedy(model, params, p, n), r
+
+
+class TestAdmission:
+    def test_depth_threshold_sheds_structured(self, model_and_params):
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), max_queue_depth=2)
+        gw.add_replica(_paged(model, params), "a")
+        sig = []
+        handles = [gw.submit([1, 2], 3,
+                             on_token=lambda g, t, d: sig.append((g, t, d)))
+                   for _ in range(5)]
+        # nothing stepped yet: 2 queued, 3 shed immediately
+        assert [r.status for r in handles] == \
+            ["queued", "queued", "shed", "shed", "shed"]
+        for r in handles[2:]:
+            assert isinstance(r.error, Overloaded)
+            assert r.error.queue_depth == 2
+            assert r.error.max_queue_depth == 2
+            assert (r.gid, None, True) in sig     # never silent
+        got = gw.run_to_completion(max_ticks=200)
+        assert sorted(got) == [handles[0].gid, handles[1].gid]
+        m = gw.metrics()
+        assert m["shed"] == 3 and m["finished"] == 2
+
+    def test_token_budget_sheds(self, model_and_params):
+        """The token-budget-aware limit: a deep budget bound sheds by
+        queued (prompt + max_new_tokens) mass even under the depth
+        limit."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), max_queue_depth=100,
+                            max_queued_tokens=20)
+        gw.add_replica(_paged(model, params), "a")
+        r0 = gw.submit([1, 2, 3], 9)            # est 12
+        r1 = gw.submit([4, 5], 5)               # est 7, total 19 <= 20
+        r2 = gw.submit([6], 3)                  # est 4 → would be 23: shed
+        assert r0.status == r1.status == "queued"
+        assert r2.status == "shed"
+        assert isinstance(r2.error, Overloaded)
+        assert r2.error.queued_tokens == 19 and r2.error.est_tokens == 4
+        gw.run_to_completion(max_ticks=200)
+        assert r0.status == r1.status == "finished"
+
+    def test_priority_dispatch_order(self, model_and_params):
+        """Priority 0 dispatches before priority 1 regardless of
+        submission order; each priority has its own bounded queue."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), max_queue_depth=4,
+                            priorities=2)
+        gw.add_replica(_paged(model, params, max_slots=1), "a")
+        lo = gw.submit([1, 2], 3, priority=1)
+        hi = gw.submit([3, 4], 3, priority=0)
+        gw.step()
+        assert hi.status == "dispatched"
+        assert lo.status == "queued"           # one slot: hi went first
+        gw.run_to_completion(max_ticks=200)
+        assert hi.status == lo.status == "finished"
+
+
+class TestDeadlines:
+    def test_ttft_deadline_expires_pre_dispatch(self, model_and_params):
+        model, params = model_and_params
+        clk = FakeClock()
+        gw = ServingGateway(clock=clk, max_queue_depth=10)
+        gw.add_replica(_paged(model, params), "a")
+        busy = [gw.submit([1, 2, 3], 20) for _ in range(2)]  # hold slots
+        gw.step()
+        late = gw.submit([4, 5], 6, ttft_deadline_s=1.0)
+        clk.advance(2.0)
+        gw.step()
+        assert late.status == "expired"
+        assert isinstance(late.error, DeadlineExceeded)
+        assert late.error.kind == "ttft"
+        assert late.engine_rid is None          # never touched an engine
+        assert late.error.tokens_delivered == 0
+        gw.run_to_completion(max_ticks=300)
+        assert all(r.status == "finished" for r in busy)
+
+    def test_total_deadline_cancels_mid_decode(self, model_and_params):
+        """A running request past its total deadline is cancelled through
+        Engine.cancel: partial tokens stay on the handle, the consumer
+        gets the terminal signal, and the engine releases every block."""
+        model, params = model_and_params
+        clk = FakeClock()
+        gw = ServingGateway(clock=clk)
+        gw.add_replica(_paged(model, params), "a")
+        sig = []
+        r = gw.submit([6, 7], 20, deadline_s=5.0,
+                      on_token=lambda g, t, d: sig.append((t, d)))
+        for _ in range(4):
+            gw.step()
+        assert r.status == "dispatched" and len(r.tokens) > 0
+        clk.advance(10.0)
+        gw.step()
+        assert r.status == "expired"
+        assert r.error.kind == "total"
+        assert r.error.tokens_delivered == len(r.tokens) > 0
+        assert r.tokens == _solo_greedy(model, params, [6, 7],
+                                        20)[:len(r.tokens)]
+        assert sig[-1] == (None, True)
+        eng = gw.replica("a").engine
+        assert eng.blocks_in_use == 0
+        assert eng.metrics()["requests_cancelled"] == 1
+
+    def test_client_cancel_queued_and_inflight(self, model_and_params):
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "a")
+        r0 = gw.submit([5, 17, 3], 20)
+        r1 = gw.submit([40, 2], 6)
+        r2 = gw.submit([61], 5)                 # queued behind 2 slots
+        gw.step()
+        assert gw.cancel(r2.gid)                # queued-side
+        assert r2.status == "cancelled"
+        assert gw.cancel(r0.gid)                # in-flight via Engine.cancel
+        assert r0.status == "cancelled"
+        assert not gw.cancel(999)
+        gw.run_to_completion(max_ticks=200)
+        assert r1.status == "finished"
+        assert r1.tokens == _solo_greedy(model, params, [40, 2], 6)
+        assert not gw.cancel(r1.gid)            # terminal already
+
+
+class TestRouting:
+    def test_least_outstanding_tokens(self, model_and_params):
+        """With no prefix signal the emptier replica wins."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "a")
+        gw.add_replica(_paged(model, params), "b")
+        r0 = gw.submit([1, 2, 3], 20)           # heavy
+        gw.step()
+        first = r0.replica
+        r1 = gw.submit([4, 5], 3)               # light: the OTHER replica
+        gw.step()
+        assert r1.replica != first
+        gw.run_to_completion(max_ticks=300)
+
+    def test_prefix_affinity_overrides(self, model_and_params):
+        """A prompt whose chain-digest prefix is cached on one replica
+        routes there even when the other replica is emptier."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        ea = _paged(model, params, enable_prefix_cache=True)
+        eb = _paged(model, params, enable_prefix_cache=True)
+        gw.add_replica(ea, "warm")
+        gw.add_replica(eb, "cold")
+        sysp = [9] * 8 + [1, 2]                 # first block cacheable
+        ea.add_request(list(sysp), 3)           # warm replica a directly
+        while ea.pending():
+            ea.step()
+        ea.pop_finished()
+        assert len(ea._prefix_cache) > 0
+        # load the warm replica so least-outstanding alone would pick cold
+        filler = gw.submit([70, 71], 3)
+        gw.step()
+        r = gw.submit(sysp[:8] + [3, 4], 3)
+        gw.step()
+        assert r.replica == "warm", (r.replica, filler.replica)
+        gw.run_to_completion(max_ticks=300)
+        assert r.status == "finished"
+
+
+class TestQuarantine:
+    def test_stalled_replica_quarantined_and_replayed(self,
+                                                      model_and_params):
+        """PR 7 /healthz stall logic per replica: a stalled tracer while
+        work is in flight quarantines the replica; its incomplete
+        requests re-admit elsewhere AFTER the documented replay signal
+        ``on_token(gid, None, False)``, and still finish oracle-exact."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0)
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "a")
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "b")
+        sig = []
+        r = gw.submit([5, 17, 3], 8,
+                      on_token=lambda g, t, d: sig.append((t, d)))
+        gw.step()
+        victim = r.replica
+        rep = gw.replica(victim)
+        rep.engine.tracer.last_event_age_s = lambda: 99.0   # wedge it
+        gw.step()
+        assert rep.state == "quarantined"
+        assert "stalled tick" in rep.reason
+        assert r.replays >= 1
+        assert (None, False) in sig             # the replay signal
+        gw.run_to_completion(max_ticks=300)
+        assert r.status == "finished" and r.replica != victim
+        want = _solo_greedy(model, params, [5, 17, 3], 8)
+        assert r.tokens == want
+        # post-replay stream re-delivers from token one
+        assert [t for t, _ in sig[sig.index((None, False)) + 1:]] == want
+        assert gw.metrics()["rerouted"] >= 1
+        # quarantined replicas take no new work until reinstated
+        r2 = gw.submit([61], 4)
+        gw.run_to_completion(max_ticks=300)
+        assert r2.replica != victim
+        gw.reinstate(victim)
+        assert gw.replica(victim).state == "active"
+
+    def test_raising_consumer_does_not_strand_reroute(self,
+                                                      model_and_params):
+        """A consumer whose on_token raises on the replay signal must not
+        abort the quarantine mid-way — every in-flight request still
+        reroutes and finishes (the gateway's consumer-bugs-don't-break-
+        the-loop contract)."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0)
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "a")
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "b")
+
+        def bad_cb(gid, tok, done):
+            if tok is None and not done:
+                raise RuntimeError("consumer exploded on replay")
+        r0 = gw.submit([5, 17, 3], 6, on_token=bad_cb)
+        r1 = gw.submit([40, 2], 5, on_token=bad_cb)
+        gw.step()
+        victim = r0.replica
+        rep = gw.replica(victim)
+        both_there = sum(r.replica == victim for r in (r0, r1))
+        rep.engine.tracer.last_event_age_s = lambda: 99.0
+        gw.step()
+        assert rep.state == "quarantined"
+        assert not rep.inflight            # nothing stranded
+        gw.run_to_completion(max_ticks=300)
+        assert r0.status == r1.status == "finished", (r0, r1, both_there)
+        assert r0.tokens == _solo_greedy(model, params, [5, 17, 3], 6)
+
+    def test_quarantine_during_drain_still_hands_over(self,
+                                                      model_and_params):
+        """A DRAINING replica that stalls is quarantined AND its drain
+        completes: the warmed replacement joins the fleet and the
+        rerouted work finishes there — the rolling restart survives a
+        mid-drain wedge."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0)
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "a")
+        r = gw.submit([5, 17, 3], 6)
+        gw.step()
+        assert r.replica == "a"
+        gw.drain("a", replacement=_paged(model, params),
+                 replacement_name="a2", warm=False)
+        gw.replica("a").engine.tracer.last_event_age_s = lambda: 99.0
+        gw.step()                          # stall fires mid-drain
+        # the wedge quarantined "a" AND completed the drain: is_drained
+        # turns True (operator wait-loops unblock), the reason is kept,
+        # and the replacement took over with the rerouted work
+        assert gw.is_drained("a")
+        assert "stalled tick" in gw.replica("a").reason
+        assert gw.replica("a2").state == "active"
+        gw.run_to_completion(max_ticks=300)
+        assert r.status == "finished" and r.replica == "a2"
+        assert r.tokens == _solo_greedy(model, params, [5, 17, 3], 6)
+
+    def test_completed_work_not_replayed(self, model_and_params):
+        """Quarantine harvests finished-but-unpopped requests instead of
+        replaying them (the 'not in-flight-completed' clause)."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0)
+        gw.add_replica(_paged(model, params, tracer=Tracer()), "a")
+        r = gw.submit([40, 2], 2)
+        # drive the ENGINE directly to completion without gateway harvest
+        eng = None
+        for _ in range(6):
+            gw.step()
+            if r.status == "finished":
+                break
+        assert r.status == "finished" and r.replays == 0
+        assert r.tokens == _solo_greedy(model, params, [40, 2], 2)
+
+
+class TestDrain:
+    def test_drain_completes_inflight_zero_drops(self, model_and_params):
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "a")
+        gw.add_replica(_paged(model, params), "b")
+        handles = [gw.submit(p, n) for p, n in PROMPTS]
+        gw.step()
+        inflight_on_a = [r for r in handles if r.replica == "a"]
+        gw.drain("a")
+        assert gw.replica("a").state in ("draining", "stopped")
+        late = gw.submit([7, 8, 9], 4)          # post-drain: routes to b
+        got = gw.run_to_completion(max_ticks=400)
+        assert gw.is_drained("a")
+        # ZERO drops: every pre-drain request finished, replays included
+        for r, (p, n) in zip(handles, PROMPTS):
+            assert r.status == "finished", (r, inflight_on_a)
+            assert r.tokens == _solo_greedy(model, params, p, n)
+        assert late.status == "finished" and late.replica == "b"
+        assert set(got) == {r.gid for r in handles} | {late.gid}
+        assert gw.replica("a").engine.blocks_in_use == 0
+
+    def test_drain_swaps_in_replacement(self, model_and_params):
+        """The rolling-restart shape: drain(a, replacement=...) activates
+        the replacement once the drain completes, and traffic flows to
+        it."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params), "a")
+        r0 = gw.submit([5, 17, 3], 6)
+        gw.step()
+        gw.drain("a", replacement=_paged(model, params),
+                 replacement_name="a2", warm=False)
+        gw.run_to_completion(max_ticks=300)
+        assert gw.is_drained("a") and r0.status == "finished"
+        assert gw.replica("a2").state == "active"
+        r1 = gw.submit([40, 2], 5)
+        gw.run_to_completion(max_ticks=300)
+        assert r1.status == "finished" and r1.replica == "a2"
+        assert r1.tokens == _solo_greedy(model, params, [40, 2], 5)
+
+
+class TestObservability:
+    def test_tracer_events_prometheus_chrome(self, model_and_params):
+        model, params = model_and_params
+        tr = Tracer()
+        gw = ServingGateway(clock=FakeClock(), max_queue_depth=1,
+                            tracer=tr)
+        gw.add_replica(_paged(model, params), "a")
+        r0 = gw.submit([1, 2], 3)
+        shed = gw.submit([3, 4], 3)             # depth 1: shed
+        gw.step()                               # dispatch r0 first —
+        gw.drain("a")                           # draining stops admission
+        gw.run_to_completion(max_ticks=200)
+        assert shed.status == "shed"
+        assert r0.status == "finished"          # drain drops nothing
+        kinds = {e["what"] for e in tr.events("gateway")}
+        assert "shed" in kinds and "drain_start" in kinds \
+            and "drain_done" in kinds
+        summ = tr.summary()["gateway"]
+        assert summ["events"]["shed"] == 1
+        ct = tr.to_chrome_trace()
+        assert any(e.get("name", "").startswith("gateway:")
+                   for e in ct["traceEvents"])
+        prom = gw.prometheus_text()
+        assert "paddle_tpu_gateway_shed 1" in prom
+        snap = gw.gateway_snapshot()
+        assert snap["queues"][0]["depth"] == 0
+        assert any(rep["name"] == "a" for rep in snap["replicas"])
+
+    def test_ops_server_gateway_route(self, model_and_params):
+        import json
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        model, params = model_and_params
+        gw = ServingGateway(tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        r = gw.submit([5, 17, 3], 3)
+        gw.run_to_completion(max_ticks=200)
+        srv = OpsServer()
+        srv.attach(gw, "gw")
+        url = srv.start()
+        try:
+            body = urllib.request.urlopen(url + "/gateway",
+                                          timeout=10).read()
+            snap = json.loads(body)
+            assert snap["counters"]["finished"] == 1
+            assert snap["replicas"][0]["state"] == "active"
+            txt = urllib.request.urlopen(url + "/metrics",
+                                         timeout=10).read().decode()
+            assert "paddle_tpu_gateway_finished" in txt
+        finally:
+            srv.stop()
+        assert r.status == "finished"
+
+    def test_ops_server_404_without_gateway(self):
+        import urllib.error
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer()
+        url = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/gateway", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()

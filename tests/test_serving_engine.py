@@ -513,3 +513,53 @@ class TestStreaming:
         assert got[r0] == _solo_greedy(model, params, PROMPTS[0], 7)
         assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 4)
         assert calls == got[r0]          # invoked once per token, in order
+
+
+class TestCancel:
+    """Engine.cancel(rid) on the CONTIGUOUS engine (ISSUE 9): slot release
+    at every lifecycle stage, the terminal ``(None, True)`` stream signal,
+    and undisturbed neighbours."""
+
+    def test_cancel_active_and_queued(self, model_and_params):
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32, prompt_buckets=[8])
+        sig = []
+        r0 = eng.add_request(PROMPTS[0], 20,
+                             on_token=lambda r, t, d: sig.append((r, t, d)))
+        r1 = eng.add_request(PROMPTS[1], 6)
+        r2 = eng.add_request(PROMPTS[3], 4)          # queued behind 2 slots
+        for _ in range(3):
+            eng.step()
+        assert eng.cancel(r0)                        # active mid-decode
+        assert sig[-1] == (r0, None, True)
+        assert eng.cancel(r2)                        # still queued
+        assert not eng.cancel(999)
+        got = eng.run_to_completion(max_ticks=100)
+        assert sorted(got) == [r1]
+        assert got[r1] == _solo_greedy(model, params, PROMPTS[1], 6)
+        assert not eng.cancel(r1)                    # already finished
+        assert eng.metrics()["requests_cancelled"] == 2
+        # the freed slots admit fresh work, oracle-exact
+        r3 = eng.add_request(PROMPTS[4], 5)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[r3] == _solo_greedy(model, params, PROMPTS[4], 5)
+
+    def test_cancel_per_request_planes_reset(self, model_and_params):
+        """Cancelling a request with per-request sampling overrides must
+        reset the slot's plane rows to the engine defaults — the next
+        occupant decodes with ITS config, not the cancelled one's."""
+        model, params = model_and_params
+        eng = ContinuousBatchingEngine(model, params, max_slots=1,
+                                       max_len=32, prompt_buckets=[8],
+                                       per_request_sampling=True)
+        rid = eng.add_request(PROMPTS[0], 20, repetition_penalty=5.0)
+        eng.step()
+        slot = next(s for s, r in enumerate(eng._slot_req)
+                    if r is not None and r.id == rid)
+        assert eng._r_rp[slot] == 5.0
+        assert eng.cancel(rid)
+        assert eng._r_rp[slot] == eng._plane_defaults[4]   # default rp
+        r2 = eng.add_request(PROMPTS[1], 5)                # no overrides
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[r2] == _solo_greedy(model, params, PROMPTS[1], 5)

@@ -309,7 +309,13 @@ class TestPagedFuzz:
         prompts/budgets/admission times under randomly drawn engine
         configs INCLUDING tight pools (deferral + preemption), block
         sizes, chunked prefill, penalty, eos, and int8 — every request's
-        tokens must equal solo generate() with the same knobs."""
+        tokens must equal solo generate() with the same knobs.  CANCELS
+        are interleaved at random points (ISSUE 9): a cancelled request's
+        delivered stream must be a prefix of its solo oracle and end with
+        the terminal ``(None, True)`` signal, and at quiescence the
+        allocator must balance exactly — ``blocks_allocated ==
+        blocks_released`` with zero blocks in use (cancel leaks nothing,
+        whatever lifecycle stage it hit)."""
         import paddle_tpu as _paddle
         from paddle_tpu.models.gpt import GPTConfig, GPTModel
         rng = np.random.RandomState(seed)
@@ -335,24 +341,170 @@ class TestPagedFuzz:
             ticks_per_sync=ticks, prefill_chunk=chunk or None,
             repetition_penalty=penalty, eos_token_id=eos)
 
+        streams = {}
+        closed = set()
+
+        def on_tok(rid, t, d):
+            if t is None and not d:
+                streams.get(rid, []).clear()    # preemption replay: reset
+            elif t is not None:
+                streams.setdefault(rid, []).append(int(t))
+            if d:
+                closed.add(rid)
+
         reqs = []
         for _ in range(int(rng.randint(4, 9))):
             p = [int(t) for t in rng.randint(1, 97, rng.randint(1, 15))]
             n = int(rng.randint(1, 12))
-            reqs.append((eng.add_request(p, n), p, n))
+            reqs.append((eng.add_request(p, n, on_token=on_tok), p, n))
             for _ in range(int(rng.randint(0, 3))):
                 eng.step()
-        got = eng.run_to_completion(max_ticks=800)
+        to_cancel = [rid for rid, _, _ in reqs if rng.rand() < 0.35]
+        cancelled = set()
+        steps = 0
+        while eng.pending():
+            eng.step()
+            if to_cancel and rng.rand() < 0.5:
+                rid = to_cancel.pop()
+                if eng.cancel(rid):          # False: already finished
+                    cancelled.add(rid)
+            steps += 1
+            assert steps < 800, "not done after 800 ticks"
+        got = eng.pop_finished()
 
         for rid, p, n in reqs:
             want = _solo_greedy(model, params, p, n,
                                 repetition_penalty=penalty)
             if eos is not None and eos in want:
                 want = want[:want.index(eos) + 1]
-            assert got[rid] == want, (
-                f"seed={seed} ticks={ticks} chunk={chunk} bs={bs} nb={nb} "
-                f"penalty={penalty} eos={eos} kv={kv} "
-                f"preempt={eng.preemptions}")
+            ctx = (f"seed={seed} ticks={ticks} chunk={chunk} bs={bs} "
+                   f"nb={nb} penalty={penalty} eos={eos} kv={kv} "
+                   f"preempt={eng.preemptions} cancelled={cancelled}")
+            if rid in cancelled:
+                assert rid not in got, ctx
+                assert rid in closed, ctx     # terminal (None, True) seen
+                delivered = streams.get(rid, [])
+                assert delivered == want[:len(delivered)], (ctx, delivered)
+            else:
+                assert got[rid] == want, ctx
+                assert streams[rid] == want, ctx
+        assert eng.blocks_in_use == 0
+        assert int(eng._stats.value("blocks_allocated")) == \
+            int(eng._stats.value("blocks_released")), \
+            "cancel leaked (or double-freed) pool blocks"
+
+
+class TestCancel:
+    """Engine.cancel(rid) — ISSUE 9: exact resource release at every
+    lifecycle stage, the terminal ``(None, True)`` stream signal, and a
+    slot that is immediately reusable."""
+
+    def test_cancel_all_stages_releases_blocks(self, model_and_params):
+        model, params = model_and_params
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=32, block_size=4,
+            prompt_buckets=[8])
+        sig = []
+        r0 = eng.add_request([5, 17, 3], 20,
+                             on_token=lambda r, t, d: sig.append((r, t, d)))
+        r1 = eng.add_request([40, 2], 6)
+        r2 = eng.add_request([61], 4)              # queued: 2 slots only
+        for _ in range(3):
+            eng.step()
+        assert eng.cancel(r0)                      # active mid-decode
+        assert sig[-1] == (r0, None, True)         # clean end-of-stream
+        assert eng.cancel(r2)                      # still queued
+        assert not eng.cancel(999)                 # unknown rid
+        got = eng.run_to_completion(max_ticks=100)
+        assert sorted(got) == [r1]                 # cancelled never appear
+        assert not eng.cancel(r1)                  # already finished
+        m = eng.metrics()
+        assert m["requests_cancelled"] == 2
+        assert eng.blocks_in_use == 0
+        assert m["blocks_allocated"] == m["blocks_released"]
+        # the freed slots admit fresh requests with oracle-exact output
+        r3 = eng.add_request([8, 30, 12, 4], 5)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[r3] == _solo_greedy(model, params, [8, 30, 12, 4], 5)
+
+    def test_cancel_mid_chunked_prefill(self, model_and_params):
+        """A filling slot (chunked admission in progress) cancels clean:
+        its partially-grown table releases every block."""
+        model, params = model_and_params
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=1, max_len=48, block_size=4,
+            prompt_buckets=[4, 16], prefill_chunk=4)
+        rid = eng.add_request(list(range(20, 33)), 4)   # 16-bucket, 4 segs
+        eng.step()                                 # one segment in
+        assert eng._filling, "expected a mid-prefill filling slot"
+        assert eng.cancel(rid)
+        assert not eng._filling and not eng.pending()
+        assert eng.blocks_in_use == 0
+        m = eng.metrics()
+        assert m["blocks_allocated"] == m["blocks_released"]
+
+    def test_cancel_releases_prefix_pins(self, model_and_params):
+        """Cancel under prefix caching: pinned chain blocks drop their
+        refcount (stay cached, evictable) instead of leaking pins."""
+        model, params = model_and_params
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=1, max_len=32, block_size=4,
+            prompt_buckets=[8], enable_prefix_cache=True)
+        sysp = [9, 9, 9, 9, 7, 7, 7, 7]
+        r0 = eng.add_request(sysp, 3)
+        eng.run_to_completion(max_ticks=50)
+        assert len(eng._prefix_cache) > 0
+        r1 = eng.add_request(sysp, 6)              # prefix hit re-pins
+        eng.step()
+        assert eng.prefix_hits >= 1
+        assert eng.cancel(r1)
+        assert not [b for b, c in eng._refs.items() if c != 0], \
+            "cancel leaked prefix-cache pins"
+        assert int(eng._stats.value("blocks_allocated")) == \
+            int(eng._stats.value("blocks_released"))
+
+    def test_cancel_ragged_engine(self):
+        """The ragged engine (admission flows through packed steps — the
+        _filling path is the norm) cancels clean at both stages."""
+        from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=96,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=32, block_size=4,
+            prompt_buckets=[8, 16], token_budget=8)
+        r0 = eng.add_request(list(range(1, 14)), 6)    # spans >1 step
+        r1 = eng.add_request([3, 4], 8)
+        eng.step()                                 # r0 still filling
+        assert eng.cancel(r0)
+        got = eng.run_to_completion(max_ticks=100)
+        assert sorted(got) == [r1]
+        assert got[r1] == _solo_greedy(model, params, [3, 4], 8)
+        assert eng.blocks_in_use == 0
+        m = eng.metrics()
+        assert m["blocks_allocated"] == m["blocks_released"]
+
+    def test_cancel_from_on_token_callback(self, model_and_params):
+        """A consumer cancelling its own request from inside ``on_token``
+        (the reentrant case) must not desync the scheduler."""
+        model, params = model_and_params
+        eng = PagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=32, block_size=4,
+            prompt_buckets=[8])
+        seen = []
+
+        def cb(rid, tok, done):
+            seen.append((tok, done))
+            if tok is not None and len(seen) == 2:
+                eng.cancel(rid)
+        rid = eng.add_request([5, 17, 3], 20, on_token=cb)
+        other = eng.add_request([40, 2], 6)
+        got = eng.run_to_completion(max_ticks=100)
+        assert rid not in got and other in got
+        assert (None, True) in seen
         assert eng.blocks_in_use == 0
 
 

@@ -273,3 +273,46 @@ class TestPagedSpecChunked:
         assert g0[r0] == want and g1[r1] == want
         assert eng.prefix_hits == 1
         assert eng.rounds == 2 * cold
+
+
+class TestCancel:
+    """Engine.cancel(rid) on the composed speculative+paged engine
+    (ISSUE 9): the shared-table allocator releases BOTH pools' blocks
+    through one cancel, and the remaining request stays bit-lossless."""
+
+    def test_cancel_releases_shared_tables(self):
+        model, params, draft, dparams = _models()
+        from paddle_tpu.serving import PagedSpeculativeBatchingEngine
+        eng = PagedSpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=64,
+            draft_k=2, prompt_buckets=[8], block_size=4)
+        sig = []
+        r0 = eng.add_request([5, 17, 3], 20,
+                             on_token=lambda r, t, d: sig.append((t, d)))
+        r1 = eng.add_request([40, 2], 6)
+        eng.step()
+        assert eng.cancel(r0)                  # active mid-spec-round
+        assert sig[-1] == (None, True)
+        got = eng.run_to_completion(max_ticks=200)
+        assert sorted(got) == [r1]
+        assert got[r1] == _solo(model, params, [40, 2], 6)
+        assert eng.blocks_in_use == 0
+        m = eng.metrics()
+        assert m["requests_cancelled"] == 1
+        assert m["blocks_allocated"] == m["blocks_released"]
+
+    def test_cancel_contiguous_speculative(self):
+        """The plain (contiguous) speculative engine cancels clean too —
+        base-class slot release, no allocator involved."""
+        model, params, draft, dparams = _models()
+        eng = SpeculativeBatchingEngine(
+            model, params, draft, dparams, max_slots=2, max_len=64,
+            draft_k=2, prompt_buckets=[8])
+        r0 = eng.add_request([5, 17, 3], 20)
+        r1 = eng.add_request([61], 8)
+        eng.step()
+        assert eng.cancel(r0)
+        got = eng.run_to_completion(max_ticks=200)
+        assert sorted(got) == [r1]
+        assert got[r1] == _solo(model, params, [61], 8)
+        assert eng.metrics()["requests_cancelled"] == 1

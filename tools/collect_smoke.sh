@@ -155,6 +155,53 @@ if ! python tools/bench_diff.py --help >/dev/null 2>&1; then
     echo "COLLECT SMOKE FAILED: tools/bench_diff.py --help"
     exit 1
 fi
+# serving gateway surface: the module must import clean, a tiny
+# two-replica submit→stream→drain round trip must finish with zero drops
+# (streamed tokens intact, drained replica stopped), and the gateway CLI
+# must self-describe
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'GWEOF'
+from paddle_tpu.gateway import (DeadlineExceeded, Overloaded,  # noqa: F401
+                                ServingGateway)
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+from paddle_tpu.telemetry import Tracer
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                num_attention_heads=2, max_position_embeddings=64,
+                compute_dtype="float32")
+model = GPTModel(cfg)
+params = {n: p._data for n, p in model.named_parameters()}
+def eng():
+    return RaggedPagedContinuousBatchingEngine(
+        model, params, max_slots=2, max_len=32, block_size=8,
+        prompt_buckets=[8], token_budget=12, tracer=Tracer())
+gw = ServingGateway(tracer=Tracer())
+gw.add_replica(eng(), "a")
+gw.add_replica(eng(), "b")
+streams = {}
+r1 = gw.submit([1, 2, 3], 3,
+               on_token=lambda g, t, d: streams.setdefault(g, [])
+               .append((t, d)))
+r2 = gw.submit([4, 5], 2)
+gw.step()
+gw.drain("a")
+got = gw.run_to_completion(max_ticks=200)
+assert r1.status == r2.status == "finished", (r1.status, r2.status)
+assert gw.is_drained("a")
+assert [t for t, d in streams[r1.gid]] == r1.tokens
+assert streams[r1.gid][-1][1] is True
+assert sorted(got) == sorted([r1.gid, r2.gid])
+assert gw.replica("a").engine.blocks_in_use == 0
+import bench
+assert "gpt_gateway" in bench.CONFIGS
+GWEOF
+then
+    echo "COLLECT SMOKE FAILED: serving gateway round trip"
+    exit 1
+fi
+if ! python tools/serve_gateway.py --help >/dev/null 2>&1; then
+    echo "COLLECT SMOKE FAILED: tools/serve_gateway.py --help"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Run a multi-replica serving gateway over GPT engines and drive a demo
+workload through it.
+
+Builds ``--replicas`` N engine replicas from a named config (the
+``tools/warmup.py`` presets), fronts them with
+``paddle_tpu.gateway.ServingGateway`` (admission control, deadlines,
+routing, drain), optionally exposes the live ops endpoint (``--ops-port``
+→ ``/gateway`` ``/metrics`` ``/healthz`` …), runs a synthetic mixed
+workload, and prints a one-line JSON report: admitted/shed counts, TTFT
+percentiles, per-replica outcomes.
+
+Examples::
+
+    python tools/serve_gateway.py --replicas 2 --demo 12
+    python tools/serve_gateway.py --replicas 2 --demo 24 \\
+        --max-queue-depth 4 --ttft-deadline 5.0 --ops-port 9100
+    python tools/serve_gateway.py --replicas 2 --demo 8 --drain-one
+
+``--drain-one`` gracefully drains replica 0 mid-workload — the rolling-
+restart rehearsal: the report asserts every admitted request still
+finished (zero drops).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+ENGINES = ("ragged", "paged", "contiguous")
+PRESETS = ("tiny", "gpt2-small", "gpt2-medium", "gpt2-large")
+
+
+def _build_model(args):
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, gpt_preset
+    if args.preset == "tiny":
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=max(128, args.max_len),
+                        compute_dtype="float32")
+    else:
+        cfg = gpt_preset(args.preset,
+                         max_position_embeddings=max(1024, args.max_len))
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return cfg, model, params
+
+
+def _build_engine(args, model, params, tracer):
+    buckets = [int(b) for b in args.buckets.split(",")]
+    common = dict(max_slots=args.max_slots, max_len=args.max_len,
+                  prompt_buckets=buckets, tracer=tracer)
+    if args.engine == "ragged":
+        from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+        return RaggedPagedContinuousBatchingEngine(
+            model, params, block_size=args.block_size,
+            token_budget=args.token_budget,
+            enable_prefix_cache=args.prefix_cache, **common)
+    if args.engine == "paged":
+        from paddle_tpu.serving import PagedContinuousBatchingEngine
+        return PagedContinuousBatchingEngine(
+            model, params, block_size=args.block_size,
+            enable_prefix_cache=args.prefix_cache, **common)
+    from paddle_tpu.serving import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(model, params, **common)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Serving gateway demo: N engine replicas behind "
+                    "admission control / deadlines / routing / drain "
+                    "(prints a JSON report)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--engine", choices=ENGINES, default="ragged")
+    ap.add_argument("--preset", choices=PRESETS, default="tiny",
+                    help="model config: 'tiny' (CPU smoke) or a GPT preset")
+    ap.add_argument("--max-slots", type=int, default=2)
+    ap.add_argument("--max-len", type=int, default=64)
+    ap.add_argument("--block-size", type=int, default=8)
+    ap.add_argument("--token-budget", type=int, default=24)
+    ap.add_argument("--buckets", default="8,16",
+                    help="comma-separated prompt buckets")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="enable per-replica prefix caching (and the "
+                         "gateway's prefix-affinity routing)")
+    ap.add_argument("--demo", type=int, default=8,
+                    help="number of synthetic requests to run")
+    ap.add_argument("--max-new", type=int, default=8,
+                    help="max_new_tokens per demo request")
+    ap.add_argument("--max-queue-depth", type=int, default=64)
+    ap.add_argument("--max-queued-tokens", type=int, default=None)
+    ap.add_argument("--ttft-deadline", type=float, default=None,
+                    help="per-request TTFT deadline (seconds)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request total deadline (seconds)")
+    ap.add_argument("--warmup-cache-dir", default=None,
+                    help="AOT-warm every replica against this persistent "
+                         "compile cache before taking traffic (PR 6)")
+    ap.add_argument("--drain-one", action="store_true",
+                    help="drain replica 0 mid-workload (rolling-restart "
+                         "rehearsal; report asserts zero drops)")
+    ap.add_argument("--ops-port", type=int, default=None,
+                    help="start the live ops endpoint on this port "
+                         "(/gateway /metrics /healthz /ledger /trace)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.gateway import ServingGateway
+    from paddle_tpu.telemetry import Tracer
+
+    paddle.seed(0)
+    cfg, model, params = _build_model(args)
+    tracer = Tracer(capacity=16384)
+    gw = ServingGateway(max_queue_depth=args.max_queue_depth,
+                        max_queued_tokens=args.max_queued_tokens,
+                        tracer=tracer)
+    names = []
+    for i in range(args.replicas):
+        eng = _build_engine(args, model, params, Tracer())
+        if args.warmup_cache_dir:
+            eng.warmup(cache_dir=args.warmup_cache_dir)
+        names.append(gw.add_replica(eng, f"r{i}"))
+
+    srv = None
+    if args.ops_port is not None:
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer(port=args.ops_port)
+        srv.attach(gw, "gateway")
+        for name in names:
+            srv.attach(gw.replica(name).engine, name)
+        srv.start()
+
+    rng = np.random.RandomState(0)
+    buckets = [int(b) for b in args.buckets.split(",")]
+    reqs = []
+    for _ in range(args.demo):
+        plen = int(rng.randint(1, buckets[-1] + 1))
+        prompt = [int(t) for t in rng.randint(1, cfg.vocab_size, plen)]
+        n = int(rng.randint(1, args.max_new + 1))
+        reqs.append(gw.submit(prompt, n,
+                              ttft_deadline_s=args.ttft_deadline,
+                              deadline_s=args.deadline))
+    if args.drain_one and names:
+        gw.drain(names[0])
+    gw.run_to_completion(max_ticks=100000)
+
+    outcomes = {}
+    for r in reqs:
+        outcomes[r.status] = outcomes.get(r.status, 0) + 1
+    snap = gw.gateway_snapshot()
+    admitted = [r for r in reqs if r.status not in ("shed", "failed")]
+    dropped = [r.gid for r in admitted
+               if r.status not in ("finished", "expired", "cancelled")]
+    report = {
+        "replicas": snap["replicas"],
+        "offered": len(reqs),
+        "outcomes": outcomes,
+        "queues": snap["queues"],
+        "queue_s": snap["queue_s"],
+        "ttft_s": snap["ttft_s"],
+        "dropped": dropped,            # must stay [] — the drain contract
+        "ops_url": None if srv is None else srv.url,
+    }
+    print(json.dumps(report))
+    if srv is not None:
+        srv.stop()
+    return 0 if not dropped else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
