@@ -81,7 +81,7 @@ import collections
 import itertools
 import logging
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
                           prometheus_text as _prometheus_text)
@@ -165,7 +165,8 @@ class GatewayRequest:
                  "ttft_deadline_s", "deadline_s", "sampling", "on_token",
                  "status", "tokens", "error", "replica", "engine_rid",
                  "submitted_at", "dispatched_at", "first_token_at",
-                 "finished_at", "replays", "_rerouting", "_pending_expiry")
+                 "finished_at", "replays", "trace", "_rerouting",
+                 "_pending_expiry")
 
     def __init__(self, gid, prompt, max_new_tokens, priority,
                  ttft_deadline_s, deadline_s, sampling, on_token,
@@ -188,6 +189,10 @@ class GatewayRequest:
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
         self.replays = 0
+        # end-to-end trace identity (telemetry.TraceContext): the ROOT
+        # span, minted at submit when the gateway traces; each dispatch
+        # mints a child for that engine attempt
+        self.trace = None
         self._rerouting = False
         self._pending_expiry: Optional[DeadlineExceeded] = None
 
@@ -212,6 +217,8 @@ class GatewayRequest:
                 "prompt_len": len(self.prompt),
                 "max_new_tokens": self.max_new_tokens,
                 "tokens": len(self.tokens), "replays": self.replays,
+                "trace_id": (None if self.trace is None
+                             else self.trace.trace_id),
                 "error": (err.to_dict() if hasattr(err, "to_dict")
                           else err)}
 
@@ -295,6 +302,10 @@ class ServingGateway:
         # gateway must not grow host memory per request served (the
         # caller's own handle from submit() stays valid regardless)
         self.request_history = int(request_history)
+        # optional SLO monitor (telemetry_slo.SLOMonitor): gateway-level
+        # TTFT samples and terminal counts forward into its windowed
+        # stores behind one attribute check
+        self._slo = None
         self._requests: Dict[int, GatewayRequest] = {}
         self._terminal_order: collections.deque = collections.deque()
         self._finished: Dict[int, List[int]] = {}
@@ -332,6 +343,18 @@ class ServingGateway:
         if rep is None:
             raise KeyError(f"unknown replica {name!r}")
         return rep
+
+    def replica_tracers(self) -> List[Tuple[str, Any]]:
+        """(name, tracer) for every CURRENT replica engine that has one —
+        the public enumeration ``ops_server`` pulls per ``/requests`` /
+        ``/request/<id>`` query, so drain-swapped replacements feed the
+        trace stitcher without re-attaching anything."""
+        out = []
+        for name, rep in list(self._replicas.items()):
+            tr = getattr(rep.engine, "tracer", None)
+            if tr is not None:
+                out.append((name, tr))
+        return out
 
     def quarantine(self, name: str, reason: str = "manual"):
         """Pull a replica out of rotation: completed requests are
@@ -441,8 +464,20 @@ class ServingGateway:
         req = GatewayRequest(next(self._gids), prompt, max_new_tokens,
                              priority, ttft_deadline_s, deadline_s,
                              sampling, on_token, now)
+        if self.tracer is not None:
+            # mint the request's end-to-end trace: this root context is
+            # THE trace_id every gateway event and (via per-dispatch
+            # child spans) every engine-timeline event will carry
+            from .telemetry import TraceContext
+            req.trace = TraceContext.root()
         self._requests[req.gid] = req
         self._stats.add("submitted")
+        if self._slo is not None:
+            self._slo.count("submitted")
+        self._emit("submit", gid=req.gid, priority=req.priority,
+                   prompt_len=len(prompt),
+                   max_new_tokens=req.max_new_tokens,
+                   **self._trace_fields(req))
         q = self._queues[req.priority]
         qtok = self._queued_tokens[req.priority]
         over_depth = len(q) >= self.max_queue_depth
@@ -455,11 +490,31 @@ class ServingGateway:
             self._finalize(req, "shed", now)
             self._emit("shed", gid=req.gid, priority=req.priority,
                        queue_depth=len(q), queued_tokens=qtok,
-                       over=("depth" if over_depth else "tokens"))
+                       over=("depth" if over_depth else "tokens"),
+                       **self._trace_fields(req))
             return req
         q.append(req)
         self._queued_tokens[req.priority] += req.est_tokens
         return req
+
+    def set_slo(self, slo):
+        """Attach (or with None detach) a ``telemetry_slo.SLOMonitor``:
+        submitted/terminal counts and gateway-level TTFT samples
+        (submit → first surviving token) forward into its windowed
+        stores — the inputs of the shed-rate and TTFT objectives."""
+        self._slo = slo
+        return slo
+
+    @staticmethod
+    def _trace_fields(req: GatewayRequest, ctx=None) -> Dict[str, Any]:
+        """trace_id/span_id/parent_span_id fields for a request-scoped
+        gateway event: the dispatch-attempt child when ``ctx`` is given,
+        else the request's root span; {} for untraced requests."""
+        if ctx is not None:
+            return ctx.to_dict()
+        if req.trace is None:
+            return {}
+        return req.trace.to_dict()
 
     def cancel(self, gid: int) -> bool:
         """Client-initiated cancellation: a queued request is removed and
@@ -472,7 +527,8 @@ class ServingGateway:
         if req.status == "queued":
             self._unqueue(req)
             self._finalize(req, "cancelled", self._clock())
-            self._emit("cancel", gid=gid, where="queued")
+            self._emit("cancel", gid=gid, where="queued",
+                       **self._trace_fields(req))
             return True
         rep = self._replicas.get(req.replica)
         if rep is None or req.engine_rid is None:
@@ -480,7 +536,7 @@ class ServingGateway:
         if rep.engine.cancel(req.engine_rid):
             # the engine's terminal on_token already finalized the handle
             self._emit("cancel", gid=gid, where="inflight",
-                       replica=rep.name)
+                       replica=rep.name, **self._trace_fields(req))
             return True
         return False
 
@@ -584,7 +640,8 @@ class ServingGateway:
                 self._finalize(req, "expired", now)
                 self._stats.add(f"expired_{kind}")
                 self._emit("expired", gid=req.gid, kind=kind,
-                           waited_s=waited, where="queued")
+                           waited_s=waited, where="queued",
+                           **self._trace_fields(req))
             self._queues[pri] = keep
 
     def _enforce_inflight_deadlines(self, now: float):
@@ -607,7 +664,8 @@ class ServingGateway:
                 self._emit("expired", gid=req.gid, kind=kind,
                            waited_s=waited, where="inflight",
                            replica=rep.name,
-                           tokens_delivered=len(req.tokens))
+                           tokens_delivered=len(req.tokens),
+                           **self._trace_fields(req))
                 if not rep.engine.cancel(rid):
                     # lost the race with retirement: the engine finished
                     # it this very round — harvest delivers it, the
@@ -681,10 +739,15 @@ class ServingGateway:
 
     def _dispatch_to(self, rep: Replica, req: GatewayRequest, now: float):
         queue_s = now - req.submitted_at
+        # one child span per engine attempt (reroute re-dispatches mint a
+        # fresh one): the engine binds its rid to this context, so the
+        # attempt's whole timeline carries the shared trace_id
+        ctx = req.trace.child() if req.trace is not None else None
         try:
             rid = rep.engine.add_request(
                 req.prompt, req.max_new_tokens,
-                on_token=self._make_on_token(rep, req), **req.sampling)
+                on_token=self._make_on_token(rep, req), trace_ctx=ctx,
+                **req.sampling)
         except (ValueError, TypeError, NotImplementedError) as e:
             # a structurally unservable request (prompt over max_len,
             # sampling knobs the engine rejects): terminal "failed", the
@@ -692,7 +755,7 @@ class ServingGateway:
             req.error = repr(e)
             self._finalize(req, "failed", now)
             self._emit("failed", gid=req.gid, replica=rep.name,
-                       error=repr(e))
+                       error=repr(e), **self._trace_fields(req))
             return
         req.engine_rid = rid
         req.replica = rep.name
@@ -702,7 +765,8 @@ class ServingGateway:
         self._stats.add("dispatched")
         self._stats.observe("queue_seconds", queue_s)
         self._emit("dispatch", gid=req.gid, replica=rep.name,
-                   queue_s=queue_s, priority=req.priority)
+                   queue_s=queue_s, priority=req.priority,
+                   **self._trace_fields(req, ctx))
 
     def _make_on_token(self, rep: Replica, req: GatewayRequest):
         """The engine-facing streaming callback: forwards to the user's
@@ -756,8 +820,10 @@ class ServingGateway:
                 continue            # not gateway-managed (direct client)
             req.tokens = list(tokens)       # engine list is authoritative
             if req.first_token_at is not None:
-                self._stats.observe("ttft_seconds",
-                                    req.first_token_at - req.submitted_at)
+                ttft = req.first_token_at - req.submitted_at
+                self._stats.observe("ttft_seconds", ttft)
+                if self._slo is not None:
+                    self._slo.observe("ttft_s", ttft)
             self._finalize(req, "finished", self._clock(), signal=False)
             self._finished[req.gid] = req.tokens
 
@@ -797,7 +863,8 @@ class ServingGateway:
             self._queues[req.priority].appendleft(req)
             self._queued_tokens[req.priority] += req.est_tokens
             self._stats.add("rerouted")
-            self._emit("reroute", gid=req.gid, from_replica=rep.name)
+            self._emit("reroute", gid=req.gid, from_replica=rep.name,
+                       **self._trace_fields(req))
 
     def _unqueue(self, req: GatewayRequest):
         q = self._queues[req.priority]
@@ -817,6 +884,15 @@ class ServingGateway:
         req.status = status
         req.finished_at = now
         self._stats.add(status)
+        if self._slo is not None:
+            self._slo.count(status)
+        if status == "finished":
+            # the trace's explicit terminal marker (shed/expired/cancel/
+            # failed already emit their own) — the stitched root span
+            # ends here
+            self._emit("finish", gid=req.gid, tokens=len(req.tokens),
+                       replica=req.replica, replays=req.replays,
+                       **self._trace_fields(req))
         self._terminal_order.append(req.gid)
         while len(self._terminal_order) > self.request_history:
             old = self._terminal_order.popleft()

@@ -456,7 +456,11 @@ def compile_aot(step, example_args: Sequence[Any], *, cache: Optional[
     config change that alters the lowering misses naturally.  Returns
     ``(compiled, provenance)`` with provenance ``"cold" | "disk" | "warm"``;
     with a ``monitor`` (``telemetry.TrainMonitor``) the compile — or the
-    disk load — is recorded as a compile event with that provenance."""
+    disk load — is recorded as a compile event with that provenance, and
+    a cold compile additionally carries the executable's XLA
+    cost-analysis FLOPs/bytes (free — the program was just compiled;
+    the result seeds ``hapi/dynamic_flops``'s digest cache), the
+    per-step model-FLOPs source of the training-side MFU summary."""
     lower = getattr(step, "lower", None)
     lowered = (lower(*example_args) if lower is not None
                else jax.jit(step).lower(*example_args))
@@ -466,6 +470,14 @@ def compile_aot(step, example_args: Sequence[Any], *, cache: Optional[
     # instead of silently missing and stranding orphaned payloads
     key = (label, fingerprint("aot_step", lowered.as_text(), *key_extra,
                               include_env=False))
+
+    def _cost(compiled_exe):
+        try:
+            from ..hapi.dynamic_flops import cost_of_compiled
+            return cost_of_compiled(compiled_exe, lowered=lowered)
+        except Exception:  # noqa: BLE001 — best-effort telemetry only
+            return None
+
     if cache is not None:
         mem_before = cache.hits_memory
         t0 = time.perf_counter()
@@ -475,13 +487,15 @@ def compile_aot(step, example_args: Sequence[Any], *, cache: Optional[
             if monitor is not None:
                 monitor.record_compile((f"{label}_aot",),
                                        time.perf_counter() - t0,
-                                       provenance=provenance)
+                                       provenance=provenance,
+                                       cost=_cost(cached))
             return cached, provenance
     t0 = time.perf_counter()
     compiled = lowered.compile()
     wall = time.perf_counter() - t0
     if monitor is not None:
-        monitor.record_compile((f"{label}_aot",), wall, provenance="cold")
+        monitor.record_compile((f"{label}_aot",), wall, provenance="cold",
+                               cost=_cost(compiled))
     if cache is not None:
         cache.put(key, compiled, mesh=mesh)
     return compiled, "cold"

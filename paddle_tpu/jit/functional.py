@@ -22,6 +22,24 @@ from ..core import rng
 from ..core.tensor import Tensor, note_compiled_call
 
 
+#: the jit API surface every step wrapper must pass through (tests, AOT
+#: benches, and the telemetry wrappers all rely on ``lower`` reaching the
+#: SAME underlying program so cache keys and lowerings never fork)
+JIT_SURFACE_ATTRS = ("lower", "eval_shape", "trace", "clear_cache")
+
+
+def copy_jit_surface(src, dst):
+    """Copy the jit API surface (:data:`JIT_SURFACE_ATTRS`) from ``src``
+    onto the wrapper ``dst`` and return ``dst`` — THE one pass-through
+    implementation shared by this module's wrappers and
+    ``telemetry.instrument_train_step`` (previously two hand-rolled
+    copies that could drift)."""
+    for attr in JIT_SURFACE_ATTRS:
+        if hasattr(src, attr):
+            setattr(dst, attr, getattr(src, attr))
+    return dst
+
+
 def _tracks_compiled_calls(fn):
     """Every invocation (cache hits included) resets the eager-nudge streak
     — see core.tensor.note_compiled_call.  The jit API surface (lower /
@@ -30,10 +48,7 @@ def _tracks_compiled_calls(fn):
     def wrapped(*args, **kwargs):
         note_compiled_call()
         return fn(*args, **kwargs)
-    for attr in ("lower", "eval_shape", "trace", "clear_cache"):
-        if hasattr(fn, attr):
-            setattr(wrapped, attr, getattr(fn, attr))
-    return wrapped
+    return copy_jit_surface(fn, wrapped)
 
 
 def _wrap(x):

@@ -29,6 +29,21 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     the attached :class:`~paddle_tpu.gateway.ServingGateway` snapshot(s)
     as JSON — replica states, per-priority queue depths, shed/reroute/
     drain counters, queue/TTFT percentiles (404 when none is attached).
+``GET /requests``
+    recent end-to-end request traces (``?n=`` newest, default 64):
+    trace_id, status, replicas touched — stitched live from every
+    attached tracer's ring by
+    :class:`~paddle_tpu.telemetry.RequestTraceIndex`.
+``GET /request/<trace_id>``
+    ONE stitched request timeline: the full cross-source span tree
+    (gateway root → per-dispatch engine attempts → queued/prefill/
+    decode phases, preempt markers) plus the raw event sequence (404
+    for an unknown trace).
+``GET /slo``
+    the attached :class:`~paddle_tpu.telemetry_slo.SLOMonitor` snapshot:
+    objectives, live burn rates, alert states, SLIs, and the recent
+    transition ring (404 when none is attached); scraping evaluates, so
+    the states are current as of the request.
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -134,11 +149,35 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/requests":
+                n = int(query.get("n", ["64"])[0])
+                self._send(200, json.dumps(ops._render_requests(n),
+                                           indent=2), "application/json")
+            elif route.startswith("/request/"):
+                trace_id = route[len("/request/"):]
+                payload = ops._render_request(trace_id)
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": f"unknown trace {trace_id!r}"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
+            elif route == "/slo":
+                payload = ops._render_slo()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no slo monitor attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
-                      "/gateway"]}),
+                      "/gateway", "/requests", "/request/<trace_id>",
+                      "/slo"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -184,6 +223,7 @@ class OpsServer:
         self._engines: List[Tuple[str, Any]] = []
         self._ledgers: List[Tuple[str, Any]] = []
         self._gateways: List[Tuple[str, Any]] = []
+        self._slos: List[Tuple[str, Any]] = []      # SLOMonitor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -196,13 +236,24 @@ class OpsServer:
         - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
         - ``ServingGateway`` (has ``gateway_snapshot``) → /gateway +
           /metrics (its ``.tracer``, when set, is attached too);
+        - ``SLOMonitor`` (has ``add_objective``/``evaluate``) → /slo +
+          /metrics burn-rate/alert gauges;
         - ``Tracer`` / ``TrainMonitor`` (has ``events`` +
           ``prometheus_text``) → /metrics + /trace + liveness;
         - a serving engine (has ``prometheus_text``; its ``.tracer``, when
           set, is attached too) → /metrics (+ tracer surfaces).
+
+        Every attached tracer additionally feeds the request-trace
+        stitcher behind ``/requests`` and ``/request/<trace_id>``; an
+        attached gateway also contributes its replicas' engine tracers,
+        enumerated live at query time (drain-swapped replacements
+        included), so ``attach(gateway)`` alone serves full stitched
+        cross-replica timelines.
         """
         with self._lock:
-            if hasattr(obj, "gateway_snapshot"):
+            if hasattr(obj, "add_objective") and hasattr(obj, "evaluate"):
+                self._slos.append((name or f"slo{len(self._slos)}", obj))
+            elif hasattr(obj, "gateway_snapshot"):
                 base = name or f"gateway{len(self._gateways)}"
                 self._gateways.append((base, obj))
                 self._engines.append((base, obj))   # /metrics exposition
@@ -294,11 +345,15 @@ class OpsServer:
 
     def _render_metrics(self) -> str:
         tracers, engines, ledgers = self._sources()
+        with self._lock:
+            slos = list(self._slos)
         parts = []
         for _name, obj in tracers + engines:
             parts.append(obj.prometheus_text())
         for _name, led in ledgers:
             parts.append(led.prometheus_text())
+        for _name, slo in slos:
+            parts.append(slo.prometheus_text())
         from .utils.stats import StatRegistry, prometheus_text as _pt
         parts.append(_pt(
             StatRegistry(), namespace="paddle_tpu_ops",
@@ -349,3 +404,51 @@ class OpsServer:
             evs = tr.events(kind) if kind else tr.events()
             events[name] = evs[-n:]
         return {"n": n, "kind": kind, "events": events}
+
+    def _trace_index(self):
+        """A fresh request-trace stitcher over every attached tracer —
+        a pure pull reader of their bounded rings, so building one per
+        request costs nothing beyond the scan it was going to do.
+
+        Attached gateways contribute their CURRENT replicas' engine
+        tracers, enumerated per query rather than snapshotted at
+        ``attach()`` — a drain-swapped replacement replica shows up in
+        ``/request/<id>`` without re-attaching anything."""
+        from .telemetry import RequestTraceIndex
+        tracers, _, _ = self._sources()
+        with self._lock:
+            gateways = list(self._gateways)
+        seen = {id(tr) for _name, tr in tracers}
+        for base, gw in gateways:
+            enumerate_tracers = getattr(gw, "replica_tracers", None)
+            if enumerate_tracers is None:
+                continue
+            for rname, tr in enumerate_tracers():
+                if id(tr) not in seen:
+                    seen.add(id(tr))
+                    tracers.append((f"{base}.{rname}", tr))
+        idx = RequestTraceIndex()
+        for name, tr in tracers:
+            try:
+                idx.add_source(tr, name)
+            except TypeError:
+                pass                    # source without a usable ring
+        return idx
+
+    def _render_requests(self, n: int) -> Dict[str, Any]:
+        n = max(1, min(int(n), 4096))
+        return {"n": n, "requests": self._trace_index().recent(n)}
+
+    def _render_request(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        if not trace_id:
+            return None
+        return self._trace_index().trace(trace_id)
+
+    def _render_slo(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            slos = list(self._slos)
+        if not slos:
+            return None
+        if len(slos) == 1:
+            return slos[0][1].snapshot()
+        return {name: slo.snapshot() for name, slo in slos}

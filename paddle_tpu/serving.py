@@ -62,8 +62,10 @@ __all__ = ["ContinuousBatchingEngine", "SpeculativeBatchingEngine",
 def _timed_first_dispatch(run, cb):
     """Wrap a freshly built program so its FIRST invocation — the one that
     pays trace + XLA compile — is timed end-to-end (block_until_ready) and
-    reported through ``cb(seconds)``.  Only installed when a tracer is
-    attached at build time; later invocations are one bool check."""
+    reported through ``cb(seconds, args, kwargs)`` (the call operands ride
+    along so the callback can re-lower for cost attribution).  Only
+    installed when a tracer is attached at build time; later invocations
+    are one bool check."""
     state = [False]
 
     def wrapped(*a, **kw):
@@ -73,10 +75,25 @@ def _timed_first_dispatch(run, cb):
         out = run(*a, **kw)
         jax.block_until_ready(out)
         state[0] = True
-        cb(time.perf_counter() - t0)
+        cb(time.perf_counter() - t0, a, kw)
         return out
 
     return wrapped
+
+
+def _program_cost(run, a, kw):
+    """Best-effort XLA cost analysis for a jitted program at its observed
+    call signature: re-lower (cheap) and consult the process-wide
+    digest-keyed cost cache (hapi/dynamic_flops — ONE compile per
+    distinct program per process).  None on any failure; never raises —
+    MFU attribution must not break serving."""
+    try:
+        from .hapi.dynamic_flops import cost_of_lowered
+        return cost_of_lowered(run.lower(*a, **kw))
+    except Exception:  # noqa: BLE001 — best-effort telemetry only
+        logging.getLogger(__name__).debug(
+            "serving cost attribution failed", exc_info=True)
+        return None
 
 
 def _slot_write(slot):
@@ -357,8 +374,7 @@ class ContinuousBatchingEngine:
         visible, warnable events instead of silent bench sinkholes."""
         progs = self.model.__dict__.setdefault("_serving_programs", {})
         if cache_key in progs:
-            self._note_prog(cache_key, True)
-            return progs[cache_key]
+            return self._note_prog(cache_key, True, progs[cache_key])
         run = build()
         # the BARE program goes in the model-lifetime cache; only the
         # engine-local return is timing-wrapped — a wrapper in the cache
@@ -371,7 +387,11 @@ class ContinuousBatchingEngine:
         """Compile-cache accounting: bump the engine counters (always —
         two lock-free int adds), and with a tracer attached emit a compile
         event; a miss returns ``run`` wrapped so its first dispatch
-        reports the compile wall time."""
+        reports the compile wall time.  With ``tracer.attribute_cost``
+        the first dispatch additionally records the program's XLA
+        cost-analysis FLOPs/bytes (digest-cached process-wide) — on
+        misses AND on model-cache hits whose label has no cost yet (a
+        fresh engine over a warm model still gets MFU attribution)."""
         if hit:
             self._compile_hits += 1
         else:
@@ -379,16 +399,28 @@ class ContinuousBatchingEngine:
         tr = self.tracer
         if tr is None:
             return run
-        self._tick_note.setdefault("programs", []).append(
-            program_label(key))
+        label = program_label(key)
+        self._tick_note.setdefault("programs", []).append(label)
         name = type(self).__name__
         if hit:
             tr.compile_event(name, key, True)
+            if tr.attribute_cost and not tr.has_cost(label):
+                # a zero sentinel on probe failure stops re-probing the
+                # same label on every later fetch
+                return _timed_first_dispatch(
+                    run, lambda dt, a, kw: tr.record_cost(
+                        label, _program_cost(run, a, kw)
+                        or {"flops": 0.0, "bytes": 0.0}))
             return run
         self._tick_note["compiles"] = \
             self._tick_note.get("compiles", 0) + 1
-        return _timed_first_dispatch(
-            run, lambda dt: tr.compile_event(name, key, False, dt))
+
+        def report(dt, a, kw):
+            cost = (_program_cost(run, a, kw)
+                    if tr.attribute_cost else None)
+            tr.compile_event(name, key, False, dt, cost=cost)
+
+        return _timed_first_dispatch(run, report)
 
     def attach_ledger(self, ledger):
         """Route this engine's wall-clock into a ``telemetry_ledger
@@ -714,9 +746,16 @@ class ContinuousBatchingEngine:
     # --------------------------------------------------------- scheduling --
 
     def add_request(self, prompt, max_new_tokens: int,
-                    on_token=None, **sampling) -> int:
+                    on_token=None, trace_ctx=None, **sampling) -> int:
         """Queue a prompt; returns the request id.  Admission happens inside
         ``step()`` whenever a slot is free.
+
+        ``trace_ctx``: optional ``telemetry.TraceContext`` propagated by a
+        caller that minted the request's end-to-end trace (the gateway's
+        dispatch path).  Host-side metadata only — it binds the engine
+        rid to the trace in the attached tracer so every request-timeline
+        event carries the shared trace_id; compiled programs and their
+        cache keys are identical with or without one.
 
         ``on_token(request_id, token, done)``: optional streaming callback,
         invoked on the host as each token is accepted (chunked/speculative
@@ -753,6 +792,8 @@ class ContinuousBatchingEngine:
         req.on_token = on_token
         self._queue.append(req)
         if self.tracer is not None:
+            if trace_ctx is not None:
+                self.tracer.bind_trace(req.id, trace_ctx)
             self.tracer.request_event(req.id, "queued",
                                       prompt_len=len(prompt))
         return req.id

@@ -544,9 +544,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     # --------------------------------------------------------- scheduling --
 
     def add_request(self, prompt, max_new_tokens: int, on_token=None,
-                    **sampling) -> int:
+                    trace_ctx=None, **sampling) -> int:
         """Queue a prompt (the base-engine contract, plus the paged
-        engine's preemption semantics).
+        engine's preemption semantics).  ``trace_ctx`` threads through to
+        the base engine's tracer binding (end-to-end request tracing);
+        a preempted request keeps its rid, so its replay events stay on
+        the same trace span.
 
         PREEMPTION AND STREAMING: when the block pool runs dry the
         youngest in-flight request is preempted and rerun from scratch.
@@ -571,7 +574,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     f"{self.NB} — raise num_blocks or lower "
                     f"max_new_tokens")
         return super().add_request(prompt_l, max_new_tokens,
-                                   on_token=on_token, **sampling)
+                                   on_token=on_token, trace_ctx=trace_ctx,
+                                   **sampling)
 
     def _admit(self):
         free = self._free_slots()

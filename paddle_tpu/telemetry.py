@@ -109,18 +109,66 @@ import contextlib
 import functools
 import json
 import logging
+import os
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
                           prometheus_text as _stats_prometheus_text)
 
-__all__ = ["Tracer", "RequestTimeline", "TrainMonitor", "program_label",
-           "chrome_trace_from_jsonl", "instrument_train_step",
-           "set_active_monitor", "current_monitor"]
+__all__ = ["Tracer", "RequestTimeline", "RequestTraceIndex", "TraceContext",
+           "TrainMonitor", "program_label", "chrome_trace_from_jsonl",
+           "instrument_train_step", "set_active_monitor", "current_monitor"]
 
 _PCTS = (50.0, 95.0, 99.0)
+
+
+class TraceContext:
+    """W3C-style trace identity for ONE request across sources.
+
+    ``trace_id`` names the whole end-to-end request (minted once, at the
+    gateway's ``submit()``); ``span_id`` names one unit of work under it
+    (the gateway's root request span, or one engine attempt); ``parent_
+    span_id`` links a child span to its parent.  The context is pure
+    host-side metadata: it rides tracer events (``Tracer.bind_trace``
+    attaches it to every request-timeline event for a rid) and NEVER
+    becomes an operand of a compiled program — lowerings are byte-
+    identical with or without one (pinned by test).
+
+    The gateway mints the root at admission and a fresh CHILD per engine
+    dispatch (including quarantine-reroute re-dispatches), so a request
+    that crosses replicas leaves one trace with one span per attempt —
+    :class:`RequestTraceIndex` stitches them back together."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str] = None):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+        self.parent_span_id = (None if parent_span_id is None
+                               else str(parent_span_id))
+
+    @classmethod
+    def root(cls) -> "TraceContext":
+        """Mint a fresh root context (new trace_id, no parent)."""
+        return cls(uuid.uuid4().hex[:16], uuid.uuid4().hex[:8], None)
+
+    def child(self) -> "TraceContext":
+        """Mint a child span under this one (same trace_id)."""
+        return TraceContext(self.trace_id, uuid.uuid4().hex[:8],
+                            self.span_id)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_span_id": self.parent_span_id}
+
+    def __repr__(self):
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"span_id={self.span_id!r}, "
+                f"parent_span_id={self.parent_span_id!r})")
 
 
 def program_label(key) -> str:
@@ -229,7 +277,9 @@ class Tracer:
     def __init__(self, capacity: int = 4096,
                  registry: Optional[StatRegistry] = None,
                  recompile_warn_threshold: int = 8,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 attribute_cost: bool = False,
+                 peak_flops: Optional[float] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self.capacity = int(capacity)
@@ -259,6 +309,28 @@ class Tracer:
         # compute attribution (the buckets must stay non-overlapping)
         self._ledger = None
         self._ledger_compiles: List[Tuple[float, float]] = []
+        # optional SLO monitor (telemetry_slo.SLOMonitor): TTFT/inter-token
+        # samples and terminal counts forward into its windowed stores
+        # behind ONE attribute check — None (the default) adds nothing
+        self._slo = None
+        # request-trace plumbing: rid -> TraceContext, attached to every
+        # request-timeline event while bound (gateway.submit mints the
+        # trace, engine.add_request binds it here)
+        self._trace_binds: Dict[int, TraceContext] = {}
+        # MFU/roofline attribution: program label -> {"flops", "bytes"}
+        # from XLA cost_analysis at the compile seams.  attribute_cost
+        # opts the ENGINES into probing cost on program fetches (one
+        # extra .lower().compile() per program family, digest-cached
+        # process-wide — hapi/dynamic_flops.py); compile_aot attaches
+        # cost for free either way.  peak_flops (default from
+        # PADDLE_TPU_PEAK_FLOPS) turns model FLOPs/s into MFU.
+        self.attribute_cost = bool(attribute_cost)
+        if peak_flops is None:
+            env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+            peak_flops = float(env) if env else None
+        self.peak_flops = (None if not peak_flops
+                           else float(peak_flops))
+        self._costs: Dict[str, Dict[str, float]] = {}
         # histograms live in the registry so prometheus_text() exports them
         self.registry.histogram("tick_seconds", DEFAULT_TIME_BUCKETS)
         self.registry.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
@@ -266,6 +338,15 @@ class Tracer:
         self.registry.histogram("compile_seconds", DEFAULT_TIME_BUCKETS)
 
     # ------------------------------------------------------------- clock --
+
+    @property
+    def t0(self) -> float:
+        """This tracer's epoch on the process ``time.monotonic`` clock.
+        Event ``ts`` values are seconds since it — ``t0 + ts`` puts
+        events from DIFFERENT tracers (gateway + N engines) on one
+        comparable timebase, which is what cross-source trace stitching
+        (:class:`RequestTraceIndex`) aligns by."""
+        return self._t0
 
     def now(self) -> float:
         return time.monotonic() - self._t0
@@ -296,6 +377,56 @@ class Tracer:
         self._ledger = ledger
         return ledger
 
+    def set_slo(self, slo):
+        """Attach (or with None detach) a ``telemetry_slo.SLOMonitor``:
+        TTFT samples (on retirement — the surviving attempt, the same
+        one-sample-per-request semantics the histogram follows),
+        inter-token samples, and terminal counts (retired / cancelled /
+        preempted) forward into its windowed stores.  One attribute
+        check when detached."""
+        self._slo = slo
+        return slo
+
+    # ----------------------------------------------------- trace context --
+
+    def bind_trace(self, rid: int, ctx: Optional[TraceContext]):
+        """Bind a :class:`TraceContext` to a request id: every subsequent
+        request-timeline event for ``rid`` carries its trace_id/span_id/
+        parent_span_id, so cross-source stitching can reassemble the
+        end-to-end request.  The binding is dropped when the timeline
+        closes (retired/cancelled); ``ctx=None`` unbinds explicitly."""
+        with self._lock:
+            if ctx is None:
+                self._trace_binds.pop(rid, None)
+            else:
+                self._trace_binds[rid] = ctx
+
+    def trace_of(self, rid: int) -> Optional[TraceContext]:
+        with self._lock:
+            return self._trace_binds.get(rid)
+
+    # ---------------------------------------------------- cost / roofline --
+
+    def record_cost(self, label: str, cost: Optional[Dict[str, float]]):
+        """Attach XLA cost-analysis numbers ({"flops", "bytes"}) to a
+        program label — ticks dispatching that label then accumulate
+        model-FLOPs and bytes-accessed, the inputs of the MFU/roofline
+        summary.  None is ignored (cost probing is best-effort)."""
+        if not cost:
+            return
+        with self._lock:
+            self._costs[str(label)] = {
+                "flops": float(cost.get("flops", 0.0)),
+                "bytes": float(cost.get("bytes", 0.0))}
+
+    def has_cost(self, label: str) -> bool:
+        with self._lock:
+            return str(label) in self._costs
+
+    def program_costs(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._costs.items()}
+
     # ----------------------------------------------------------- ingest --
 
     def _append(self, ev: Dict[str, Any]):
@@ -318,9 +449,31 @@ class Tracer:
 
     def tick(self, engine: str, dur_s: float, **fields):
         """One scheduler round; observes the tick-duration histogram and
-        arms the post-warmup recompile accounting."""
+        arms the post-warmup recompile accounting.  When the dispatched
+        program labels (``programs``) have recorded cost-analysis numbers
+        the tick additionally carries its model-FLOPs (``flops`` /
+        ``bytes``) — the per-tick roofline attribution ``summary()``
+        folds into MFU."""
         self.registry.add("ticks")
         self.registry.observe("tick_seconds", dur_s)
+        progs = fields.get("programs")
+        if progs:
+            flops = byts = 0.0
+            with self._lock:
+                for lbl in progs:
+                    c = self._costs.get(lbl)
+                    if c is not None:
+                        flops += c["flops"]
+                        byts += c["bytes"]
+            if flops or byts:
+                fields["flops"] = flops
+                fields["bytes"] = byts
+                reg = self.registry
+                reg.add("model_flops_total", flops)
+                reg.add("model_bytes_total", byts)
+                # only walls that actually dispatched COSTED programs
+                # denominate FLOPs/s — idle ticks must not dilute MFU
+                reg.add("model_flops_wall_seconds", dur_s)
         with self._lock:
             self._ticks += 1
             ev = {"kind": "tick", "ts": self.now(), "engine": engine,
@@ -397,7 +550,8 @@ class Tracer:
             or any(k.startswith(label + ":") for k in keys)
 
     def compile_event(self, engine: str, key, hit: bool,
-                      wall_s: float = 0.0, provenance: Optional[str] = None):
+                      wall_s: float = 0.0, provenance: Optional[str] = None,
+                      cost: Optional[Dict[str, float]] = None):
         """One program-cache access.  HITS are counter-only (several per
         tick at steady state — ring events for them would evict the tick/
         request history that summary() percentiles read); MISSES get a
@@ -425,11 +579,16 @@ class Tracer:
         reg.add(f"compile_{provenance}")
         reg.observe("compile_seconds", wall_s)
         reg.add("compile_wall_seconds_sum", wall_s)
+        if cost:
+            self.record_cost(label, cost)
         warn = False
         with self._lock:
             ev = {"kind": "compile", "ts": self.now(), "engine": engine,
                   "key": label, "hit": False, "wall_s": wall_s,
                   "provenance": provenance, "expected": expected}
+            if cost:
+                ev["flops"] = float(cost.get("flops", 0.0))
+                ev["bytes"] = float(cost.get("bytes", 0.0))
             if self._ticks > 0 and not expected:
                 self._post_warm_misses += 1
                 if (self._post_warm_misses >= self.recompile_warn_threshold
@@ -453,9 +612,16 @@ class Tracer:
     def request_event(self, rid: int, what: str, **fields):
         """One request state transition (see module docstring for the
         ``what`` vocabulary); maintains the per-request timeline and the
-        TTFT / inter-token histograms."""
+        TTFT / inter-token histograms.  Events for a rid with a bound
+        :class:`TraceContext` carry its trace_id/span_id/parent_span_id;
+        with an attached SLO monitor, TTFT/inter-token samples and
+        terminal counts forward into its windowed stores."""
         ts = self.now()
+        slo = self._slo
+        slo_obs: List[Tuple[str, float]] = []
+        slo_cnt: List[str] = []
         with self._lock:
+            ctx = self._trace_binds.get(rid)
             tl = self._live.get(rid)
             if tl is None and what == "queued":
                 tl = self._live[rid] = RequestTimeline(
@@ -483,6 +649,8 @@ class Tracer:
                 if tl.token_times:
                     self.registry.observe("inter_token_seconds",
                                           ts - tl.token_times[-1])
+                    if slo is not None:
+                        slo_obs.append(("itl_s", ts - tl.token_times[-1]))
                 tl.token_times.append(ts)
                 tl.tokens_delivered += 1
             elif what == "preempted":
@@ -497,13 +665,20 @@ class Tracer:
                 tl.token_times = []
                 tl.tokens_delivered = 0
                 self.registry.add("requests_preempted")
+                if slo is not None:
+                    slo_cnt.append("requests_preempted")
             elif what == "retired":
                 tl.retired_at = ts
                 if tl.ttft_s is not None:
                     self.registry.observe("ttft_seconds", tl.ttft_s)
+                    if slo is not None:
+                        slo_obs.append(("ttft_s", tl.ttft_s))
                 self.registry.add("requests_retired")
+                if slo is not None:
+                    slo_cnt.append("requests_retired")
                 self._live.pop(rid, None)
                 self._done.append(tl)
+                self._trace_binds.pop(rid, None)
             elif what == "cancelled":
                 # engine.cancel(): terminal — the timeline closes like a
                 # retirement but contributes NO TTFT histogram sample (the
@@ -511,11 +686,21 @@ class Tracer:
                 # counted, not averaged in)
                 tl.retired_at = ts
                 self.registry.add("requests_cancelled")
+                if slo is not None:
+                    slo_cnt.append("requests_cancelled")
                 self._live.pop(rid, None)
                 self._done.append(tl)
+                self._trace_binds.pop(rid, None)
             ev = {"kind": "request", "ts": ts, "rid": rid, "what": what}
+            if ctx is not None:
+                ev.update(ctx.to_dict())
             ev.update(fields)
             self._append(ev)
+        if slo is not None:
+            for metric, v in slo_obs:
+                slo.observe(metric, v)
+            for metric in slo_cnt:
+                slo.count(metric)
         return ev
 
     # ---------------------------------------------------------- queries --
@@ -585,11 +770,36 @@ class Tracer:
                 "disk": int(reg.value("compile_disk")),
             },
             "requests": self.request_summary(),
+            "mfu": self.mfu_summary(),
             "events_dropped": self.events_dropped,
         }
         if gw_summary is not None:     # only gateway-fed tracers carry it
             out["gateway"] = gw_summary
         return out
+
+    def mfu_summary(self) -> Dict[str, Any]:
+        """MFU/roofline attribution from the compile-seam cost analysis:
+        total model FLOPs and bytes accessed over costed-program ticks,
+        model FLOPs/s over the wall those ticks took, arithmetic
+        intensity (FLOPs per byte accessed), and — when ``peak_flops``
+        is configured — MFU against it.  All-None/zero when no program
+        cost was recorded (``attribute_cost=False`` and no aot seam
+        reported)."""
+        reg = self.registry
+        flops = float(reg.value("model_flops_total"))
+        byts = float(reg.value("model_bytes_total"))
+        wall = float(reg.value("model_flops_wall_seconds"))
+        fps = flops / wall if wall > 0 else None
+        return {
+            "model_flops_total": flops,
+            "model_bytes_total": byts,
+            "model_flops_per_s": fps,
+            "arithmetic_intensity": (flops / byts if byts > 0 else None),
+            "peak_flops": self.peak_flops,
+            "mfu": (fps / self.peak_flops
+                    if fps is not None and self.peak_flops else None),
+            "programs_costed": len(self._costs),
+        }
 
     # ---------------------------------------------------------- exports --
 
@@ -622,7 +832,258 @@ class Tracer:
             json.dump(self.to_chrome_trace(), f)
 
     def prometheus_text(self, namespace: str = "paddle_tpu_serving") -> str:
-        return _stats_prometheus_text(self.registry, namespace=namespace)
+        mfu = self.mfu_summary()
+        extra = {k: v for k, v in
+                 (("model_flops_per_second", mfu["model_flops_per_s"]),
+                  ("arithmetic_intensity", mfu["arithmetic_intensity"]),
+                  ("mfu", mfu["mfu"]))
+                 if v is not None}
+        return _stats_prometheus_text(self.registry, namespace=namespace,
+                                      extra_gauges=extra or None)
+
+
+# --------------------------------------------------------------------------
+# cross-source request-trace stitching
+# --------------------------------------------------------------------------
+
+
+# gateway event `what` → stitched-trace request status.  ONE map for
+# both RequestTraceIndex.recent() and .trace() — a new gateway event
+# added here shows the same status on /requests and /request/<id>.
+_GATEWAY_STATUS = {"submit": "queued", "dispatch": "dispatched",
+                   "shed": "shed", "expired": "expired",
+                   "cancel": "cancelled", "failed": "failed",
+                   "finish": "finished", "reroute": "queued"}
+
+
+class RequestTraceIndex:
+    """Assemble per-request span trees ACROSS tracer sources.
+
+    A gateway-fronted request leaves fragments in several ring buffers:
+    the gateway tracer holds submit/shed/dispatch/reroute/finish events,
+    and each replica engine's tracer holds that attempt's request
+    timeline (queued → admitted → first_token → token* → retired).  All
+    of them carry the same ``trace_id`` (:class:`TraceContext`), and this
+    index stitches them back into ONE tree:
+
+    - the **root span** is the gateway request (submit → terminal);
+    - each engine **attempt span** (one per dispatch, reroutes included)
+      is the child the gateway minted at dispatch time;
+    - the attempt's **phase spans** (queued / prefill / decode, plus
+      preempt markers) are synthetic children of the attempt.
+
+    The index is a pure PULL reader: it holds references to tracers and
+    scans their bounded rings on demand (``ops_server`` serves it live
+    as ``GET /requests`` and ``GET /request/<trace_id>``), so it costs
+    nothing until queried and is bounded by the rings it reads.
+    Timestamps are re-based onto one shared timeline via each tracer's
+    ``t0`` epoch; the stitched output reports seconds since the trace's
+    first event."""
+
+    def __init__(self, sources=()):
+        self._sources: List[Tuple[str, Any]] = []
+        for src in sources:
+            if isinstance(src, tuple):
+                self.add_source(src[1], src[0])
+            else:
+                self.add_source(src)
+
+    def add_source(self, tracer, name: Optional[str] = None
+                   ) -> "RequestTraceIndex":
+        """Attach one event source: a ``Tracer`` or anything wrapping one
+        (``TrainMonitor``, an engine with ``.tracer``, a gateway)."""
+        inner = getattr(tracer, "tracer", tracer)
+        if not (hasattr(inner, "events") and hasattr(inner, "t0")):
+            raise TypeError(
+                f"unsupported trace source: {type(tracer).__name__} "
+                f"(want a Tracer or something carrying one)")
+        self._sources.append(
+            (name or f"source{len(self._sources)}", inner))
+        return self
+
+    # ------------------------------------------------------------- scans --
+
+    def _scan(self, trace_id: Optional[str] = None
+              ) -> List[Tuple[str, Dict[str, Any], float]]:
+        """(source, event, absolute_ts) for every ring event carrying a
+        trace_id (optionally one specific trace)."""
+        out = []
+        for name, tr in self._sources:
+            t0 = tr.t0
+            for ev in tr.events():
+                tid = ev.get("trace_id")
+                if tid is None or (trace_id is not None
+                                   and tid != trace_id):
+                    continue
+                out.append((name, ev, t0 + ev["ts"]))
+        out.sort(key=lambda x: x[2])
+        return out
+
+    def recent(self, n: int = 64) -> List[Dict[str, Any]]:
+        """Summaries of the most recent traces (newest first): trace_id,
+        gateway id, last-known status, replicas touched, span/event
+        counts — the ``GET /requests`` ring."""
+        traces: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        for source, ev, ats in self._scan():
+            tid = ev["trace_id"]
+            t = traces.get(tid)
+            if t is None:
+                t = traces[tid] = {"trace_id": tid, "first_ts": ats,
+                                   "last_ts": ats, "events": 0,
+                                   "status": None, "gid": None,
+                                   "replicas": []}
+                order.append(tid)
+            t["events"] += 1
+            t["last_ts"] = max(t["last_ts"], ats)
+            if ev.get("kind") == "gateway":
+                what = ev.get("what")
+                if t["gid"] is None and ev.get("gid") is not None:
+                    t["gid"] = ev.get("gid")
+                rep = ev.get("replica")
+                if rep is not None and rep not in t["replicas"]:
+                    t["replicas"].append(rep)
+                status = _GATEWAY_STATUS.get(what)
+                if status is not None:
+                    t["status"] = status
+        order.sort(key=lambda tid: traces[tid]["last_ts"], reverse=True)
+        out = []
+        for tid in order[:max(int(n), 1)]:
+            t = traces[tid]
+            out.append(dict(t, duration_s=t["last_ts"] - t["first_ts"]))
+        return out
+
+    def trace(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """The full stitched timeline of one trace: a flat span list
+        (every span carries ``span_id`` + ``parent_span_id``; only the
+        root has no parent) plus the raw cross-source event sequence.
+        None when no source holds any event for the id."""
+        scanned = self._scan(trace_id)
+        if not scanned:
+            return None
+        base = scanned[0][2]
+
+        def rel(ats):
+            return round(ats - base, 6)
+
+        spans: List[Dict[str, Any]] = []
+        events = []
+        root_id = None
+        root_start = root_end = None
+        status = None
+        gid = None
+        # attempt spans keyed by the dispatch-minted span_id
+        attempts: Dict[str, Dict[str, Any]] = {}
+        marks: Dict[str, Dict[str, float]] = {}   # span_id -> phase stamps
+
+        for source, ev, ats in scanned:
+            events.append(dict(ev, source=source, ts_abs=rel(ats)))
+            sid = ev.get("span_id")
+            kind = ev.get("kind")
+            if kind == "gateway":
+                what = ev.get("what")
+                if gid is None and ev.get("gid") is not None:
+                    gid = ev.get("gid")
+                if what == "submit":
+                    root_id = sid
+                    root_start = ats
+                    status = "queued"
+                elif what == "dispatch":
+                    att = attempts.get(sid)
+                    if att is None:
+                        att = attempts[sid] = {
+                            "name": f"attempt@{ev.get('replica')}",
+                            "span_id": sid,
+                            "parent_span_id": ev.get("parent_span_id"),
+                            "replica": ev.get("replica"),
+                            "source": source, "start": ats, "end": ats,
+                            "queue_s": ev.get("queue_s")}
+                    else:
+                        # the engine's own queued event can precede the
+                        # gateway's dispatch event on the shared timebase;
+                        # the dispatch is still the naming authority
+                        att["name"] = f"attempt@{ev.get('replica')}"
+                        att["replica"] = ev.get("replica")
+                        att["queue_s"] = ev.get("queue_s")
+                        att["start"] = min(att["start"], ats)
+                    status = "dispatched"
+                elif what in _GATEWAY_STATUS:
+                    # submit/dispatch were consumed by the branches above
+                    status = _GATEWAY_STATUS[what]
+                    root_end = ats
+                if root_start is None:
+                    root_start = ats          # shed-before-submit safety
+                root_end = ats if root_end is None else max(root_end, ats)
+            elif kind == "request" and sid is not None:
+                att = attempts.get(sid)
+                if att is None:
+                    att = attempts[sid] = {
+                        "name": f"attempt@{source}", "span_id": sid,
+                        "parent_span_id": ev.get("parent_span_id"),
+                        "replica": None, "source": source,
+                        "start": ats, "end": ats, "queue_s": None}
+                att["source"] = source
+                att["start"] = min(att["start"], ats)
+                att["end"] = max(att["end"], ats)
+                st = marks.setdefault(sid, {})
+                what = ev.get("what")
+                if what in ("queued", "admitted", "first_token",
+                            "retired", "cancelled"):
+                    st[what] = ats
+                elif what == "preempted":
+                    st.setdefault("preempts", []).append(ats)
+                root_end = ats if root_end is None else max(root_end, ats)
+
+        if root_id is None:
+            # no gateway submit event in scope (engine-only trace): use
+            # the attempts' shared parent as the root anchor
+            parents = {a["parent_span_id"] for a in attempts.values()}
+            root_id = next(iter(parents)) if len(parents) == 1 else None
+        spans.append({"name": "request", "span_id": root_id,
+                      "parent_span_id": None, "source": "gateway",
+                      "start_s": rel(root_start if root_start is not None
+                                     else base),
+                      "end_s": rel(root_end if root_end is not None
+                                   else base)})
+        for sid, att in attempts.items():
+            spans.append({"name": att["name"], "span_id": sid,
+                          "parent_span_id": att["parent_span_id"],
+                          "source": att["source"],
+                          "replica": att["replica"],
+                          "start_s": rel(att["start"]),
+                          "end_s": rel(att["end"])})
+            st = marks.get(sid, {})
+            for phase, a_key, b_key in (("queued", "queued", "admitted"),
+                                        ("prefill", "admitted",
+                                         "first_token"),
+                                        ("decode", "first_token", None)):
+                a = st.get(a_key)
+                if a is None:
+                    continue
+                b = st.get(b_key) if b_key is not None else None
+                if b is None:
+                    b = st.get("retired", st.get("cancelled", att["end"]))
+                spans.append({"name": phase,
+                              "span_id": f"{sid}:{phase}",
+                              "parent_span_id": sid,
+                              "source": att["source"],
+                              "start_s": rel(a), "end_s": rel(b)})
+            for i, p in enumerate(st.get("preempts", [])):
+                spans.append({"name": "preempted",
+                              "span_id": f"{sid}:preempt{i}",
+                              "parent_span_id": sid,
+                              "source": att["source"],
+                              "start_s": rel(p), "end_s": rel(p)})
+        return {
+            "trace_id": trace_id,
+            "gid": gid,
+            "status": status,
+            "duration_s": rel(root_end if root_end is not None else base),
+            "replicas": sorted({s.get("replica") for s in spans
+                                if s.get("replica") is not None}),
+            "spans": spans,
+            "events": events,
+        }
 
 
 # --------------------------------------------------------------------------
@@ -669,9 +1130,12 @@ class TrainMonitor:
     def __init__(self, tracer: Optional[Tracer] = None, capacity: int = 4096,
                  spike_factor: float = 10.0, spike_min_steps: int = 5,
                  ema_decay: float = 0.9,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 attribute_cost: bool = False,
+                 peak_flops: Optional[float] = None):
         self.tracer = tracer if tracer is not None else Tracer(
-            capacity=capacity, logger=logger)
+            capacity=capacity, logger=logger,
+            attribute_cost=attribute_cost, peak_flops=peak_flops)
         self.registry = self.tracer.registry
         self.spike_factor = float(spike_factor)
         self.spike_min_steps = int(spike_min_steps)
@@ -684,6 +1148,8 @@ class TrainMonitor:
         self.last_loss: Optional[float] = None
         self._last_scale: Optional[float] = None
         self._comm_policy: Optional[str] = None
+        self._step_cost: Optional[Dict[str, float]] = None
+        self._step_cost_is_step = False
         self._warned_non_finite = False
         self.registry.histogram("step_seconds", DEFAULT_TIME_BUCKETS)
         self.registry.histogram("device_blocked_seconds",
@@ -767,13 +1233,29 @@ class TrainMonitor:
                                 examples=int(samples))
 
     def record_compile(self, key, wall_s: float,
-                       provenance: Optional[str] = None):
+                       provenance: Optional[str] = None,
+                       cost: Optional[Dict[str, float]] = None):
         """One compiled-program build paid by the training loop (first call
         of an instrumented step, a bucketize miss, an AOT compile).
         ``provenance``: ``cold``/``disk``/``warm`` — ``jit.aot
-        .compile_aot`` reports where the executable came from."""
+        .compile_aot`` reports where the executable came from.  ``cost``:
+        optional XLA cost-analysis ``{"flops", "bytes"}`` for the program
+        (``compile_aot`` attaches it for free from the compiled
+        executable) — the per-step model-FLOPs source ``summary()``'s
+        ``mfu`` section divides by step wall."""
+        if cost:
+            last = key[-1] if isinstance(key, (tuple, list)) and key else key
+            is_step = str(last).endswith("_step")
+            # the instrumented STEP program's cost is the per-step MFU
+            # numerator; a later costed compile of an aux/eval program
+            # (bucketize miss, AOT-warmed eval) must not clobber it —
+            # only another step program may overwrite a step cost
+            if is_step or not self._step_cost_is_step:
+                self._step_cost = {"flops": float(cost.get("flops", 0.0)),
+                                   "bytes": float(cost.get("bytes", 0.0))}
+                self._step_cost_is_step = is_step
         return self.tracer.compile_event("train", key, False, wall_s,
-                                         provenance=provenance)
+                                         provenance=provenance, cost=cost)
 
     def record_comm(self, policy: str, pre_bytes: int, post_bytes: int,
                     **fields):
@@ -975,7 +1457,29 @@ class TrainMonitor:
                 "other_bytes": int(reg.value("hbm_other_bytes")),
             },
             "comm": self._comm_summary(),
+            "mfu": self._mfu_summary(step_sum),
             "events_dropped": self.tracer.events_dropped,
+        }
+
+    def _mfu_summary(self, step_wall_s: float) -> Optional[Dict[str, Any]]:
+        """Training-side MFU from the step program's cost analysis (None
+        until a compile seam reported one): per-step model FLOPs × steps
+        over the steady-state step wall, arithmetic intensity, and MFU
+        against the tracer's configured peak."""
+        cost = self._step_cost
+        if cost is None:
+            return None
+        steps = int(self.registry.value("train_steps"))
+        fps = (cost["flops"] * steps / step_wall_s
+               if step_wall_s > 0 and steps else None)
+        peak = self.tracer.peak_flops
+        return {
+            "model_flops_per_step": cost["flops"],
+            "model_flops_per_s": fps,
+            "arithmetic_intensity": (cost["flops"] / cost["bytes"]
+                                     if cost["bytes"] else None),
+            "peak_flops": peak,
+            "mfu": (fps / peak if fps is not None and peak else None),
         }
 
     def _comm_summary(self) -> Optional[Dict[str, Any]]:
@@ -1070,8 +1574,20 @@ def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
             # throughput measure steady state
             first[0] = False
             jax.block_until_ready(out)
-            monitor.record_compile((f"{name}_step",),
-                                   time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            cost = None
+            if monitor.tracer.attribute_cost and hasattr(step, "lower"):
+                # opt-in roofline attribution: the step's cost analysis,
+                # digest-cached process-wide (hapi/dynamic_flops) — never
+                # allowed to break the training loop
+                try:
+                    from .hapi.dynamic_flops import cost_of_lowered
+                    cost = cost_of_lowered(step.lower(*args, **kwargs))
+                except Exception:  # noqa: BLE001 — best-effort telemetry
+                    logging.getLogger(__name__).debug(
+                        "cost attribution failed for %s", name,
+                        exc_info=True)
+            monitor.record_compile((f"{name}_step",), dt, cost=cost)
             return out
         examples, tokens = (batch_info(args, kwargs)
                             if batch_info is not None
@@ -1082,10 +1598,8 @@ def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
             monitor.record_comm(**comm)
         return out
 
-    for attr in ("lower", "eval_shape", "trace", "clear_cache"):
-        if hasattr(step, attr):
-            setattr(wrapped, attr, getattr(step, attr))
-    return wrapped
+    from .jit.functional import copy_jit_surface
+    return copy_jit_surface(step, wrapped)
 
 
 _PID = "paddle_tpu.serving"
@@ -1127,6 +1641,15 @@ def events_to_chrome(events: List[Dict[str, Any]],
                         "tid": f"req:{ev.get('rid')}", "ts": us,
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("kind", "ts")}})
+            if ev.get("what") == "admitted" and ev.get("span_id"):
+                # flow FINISH: the engine end of the gateway's dispatch
+                # arrow — same id (the dispatch-minted span) on both
+                # sides, so Perfetto draws gateway row → engine row even
+                # across merged multi-replica trace files
+                out.append({"name": "request", "cat": "trace", "ph": "f",
+                            "bp": "e", "id": ev["span_id"], "pid": _PID,
+                            "tid": f"req:{ev.get('rid')}", "ts": us,
+                            "args": {"trace_id": ev.get("trace_id")}})
         elif ev["kind"] == "gateway":
             # gateway actions are instants on their own scheduler row —
             # shed/reroute/drain markers line up against ticks and request
@@ -1134,6 +1657,23 @@ def events_to_chrome(events: List[Dict[str, Any]],
             out.append({"name": f"gateway:{ev.get('what', '?')}",
                         "cat": "gateway", "ph": "i", "s": "t",
                         "pid": _PID, "tid": "gateway", "ts": us,
+                        "args": {k: v for k, v in ev.items()
+                                 if k not in ("kind", "ts")}})
+            if ev.get("what") == "dispatch" and ev.get("span_id"):
+                # flow START keyed by the dispatch span id (see the
+                # request-event "f" above)
+                out.append({"name": "request", "cat": "trace", "ph": "s",
+                            "id": ev["span_id"], "pid": _PID,
+                            "tid": "gateway", "ts": us,
+                            "args": {"trace_id": ev.get("trace_id"),
+                                     "replica": ev.get("replica")}})
+        elif ev["kind"] == "slo":
+            # SLO alert transitions: instants on their own row, lined up
+            # against the serving ticks they indict
+            out.append({"name": f"slo:{ev.get('what', '?')}"
+                        f":{ev.get('objective', '?')}",
+                        "cat": "slo", "ph": "i", "s": "t",
+                        "pid": _PID, "tid": "slo", "ts": us,
                         "args": {k: v for k, v in ev.items()
                                  if k not in ("kind", "ts")}})
         elif ev["kind"] in ("train_step", "sync", "profiler_step"):

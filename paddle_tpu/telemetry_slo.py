@@ -1,0 +1,594 @@
+"""SLO engine: declarative objectives + multi-window burn-rate alerting
+over the telemetry streams.
+
+PR 2/4/7/8 record everything an operator could ask about — TTFT and
+inter-token samples (``telemetry.Tracer``), shed/expired/failed counts
+(``gateway.ServingGateway``), goodput (``telemetry_ledger.RunLedger``) —
+but nothing *judges* them: an on-call still has to stare at ``/metrics``
+and decide whether the service is in trouble.  :class:`SLOMonitor` closes
+that loop with the SRE-standard machinery:
+
+**Declarative objectives** (:class:`Objective`).  Three kinds:
+
+- ``latency`` — "p-quantile of ``metric`` stays under ``target``":
+  operationally "at most ``1 - compliance`` of samples may exceed
+  ``target``" (a TTFT p99 ≤ 500ms objective is ``compliance=0.99,
+  target=0.5``).  The bad-fraction over a window divided by the error
+  budget (``1 - compliance``) is the window's **burn rate** — burn 1.0
+  spends budget exactly as fast as allowed, burn 10 spends it 10×.
+- ``ratio`` — "``bad`` events stay under ``target`` fraction of
+  ``total``" (shed rate, error rate); burn = (bad/total) / target.
+- ``floor`` — "``metric`` samples stay ABOVE ``target``" (the goodput
+  floor from the PR 7 ledger); bad = sample < target, budget =
+  ``1 - compliance``.
+
+**Multi-window burn-rate alerting.**  An objective alerts only when its
+burn rate exceeds ``burn_threshold`` on **every** window (classic
+long+short pairing: the long window proves sustained damage, the short
+window proves it is STILL happening, so a recovered incident stops
+alerting without waiting out the long window).  The alert walks
+``inactive → pending`` (condition holds) ``→ firing`` (held for
+``for_s``) ``→ resolved`` (burn below ``resolve_ratio × burn_threshold``
+on every window for ``clear_s`` — the hysteresis band, so an SLI
+hovering exactly at the threshold cannot flap the alert).  Transitions
+are emitted as ``slo`` events on the attached tracer (ring buffer +
+chrome export), kept in a bounded local history, and exported via
+``snapshot()`` (the ops server's ``GET /slo``) and ``prometheus_text()``
+(labeled ``burn_rate``/``alert_state``/``sli`` gauges rendered through
+``utils.stats.prom_sample`` — the shared escaping helper).
+
+**Storage.**  Sample metrics land in a ring of time-bucketed
+:class:`PercentileSketch` es (log-bucketed, mergeable — a window query
+merges its buckets' sketches; relative error ``alpha``, default 2%);
+counters land in time-bucketed sums.  Both are bounded by
+``horizon_s / resolution_s`` buckets per metric, so a long-lived monitor
+holds constant memory regardless of traffic.
+
+**Feeds.**  Push: ``Tracer.set_slo`` (TTFT/ITL samples, terminal
+counts), ``ServingGateway.set_slo`` (gateway-level TTFT, submitted/shed/
+expired/failed counts), or direct ``observe``/``count`` calls.  Pull:
+``attach_ledger`` samples the goodput gauge at every ``evaluate()``.
+Everything is zero-cost for producers when no monitor is attached (the
+one-attribute-check contract the whole telemetry stack follows).
+
+The clock is injectable (``clock=``), so burn-rate lifecycles are
+testable with a fake clock — no sleeps anywhere.
+
+No single reference counterpart: this is the alerting layer of
+site-reliability practice (multi-window multi-burn-rate alerts) composed
+over the reference's monitor.h counters.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .utils.stats import StatRegistry, prom_sample, prometheus_text
+
+__all__ = ["PercentileSketch", "Objective", "SLOMonitor"]
+
+#: alert states, in escalation order (prometheus gauge encoding)
+ALERT_STATES = ("inactive", "pending", "firing")
+
+
+class PercentileSketch:
+    """Mergeable log-bucketed quantile sketch (the DDSketch discipline).
+
+    Values map to buckets ``i = ceil(log_gamma(v))`` with
+    ``gamma = (1 + alpha) / (1 - alpha)``, giving every quantile a
+    relative error of at most ``alpha``.  ``merge`` adds bucket counts —
+    merging per-time-bucket sketches answers "p99 over the last N
+    seconds" without retaining samples; merging per-replica sketches
+    would answer fleet quantiles the same way.  Non-positive values clamp
+    to the zero bucket (latencies and rates are non-negative)."""
+
+    __slots__ = ("alpha", "_gamma", "_lg", "counts", "zero", "n",
+                 "min", "max", "sum")
+
+    def __init__(self, alpha: float = 0.02):
+        if not 0.0 < alpha < 1.0:
+            raise ValueError("alpha must be in (0, 1)")
+        self.alpha = float(alpha)
+        self._gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
+        self._lg = math.log(self._gamma)
+        self.counts: Dict[int, int] = {}
+        self.zero = 0
+        self.n = 0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.sum = 0.0
+
+    def _index(self, v: float) -> int:
+        return math.ceil(math.log(v) / self._lg)
+
+    def add(self, v: float, count: int = 1):
+        v = float(v)
+        count = int(count)
+        self.n += count
+        self.sum += v * count
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+        if v <= 0.0:
+            self.zero += count
+            return
+        i = self._index(v)
+        self.counts[i] = self.counts.get(i, 0) + count
+
+    def merge(self, other: "PercentileSketch") -> "PercentileSketch":
+        if other.alpha != self.alpha:
+            raise ValueError("cannot merge sketches with different alpha")
+        for i, c in other.counts.items():
+            self.counts[i] = self.counts.get(i, 0) + c
+        self.zero += other.zero
+        self.n += other.n
+        self.sum += other.sum
+        for attr, pick in (("min", min), ("max", max)):
+            o = getattr(other, attr)
+            if o is not None:
+                s = getattr(self, attr)
+                setattr(self, attr, o if s is None else pick(s, o))
+        return self
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile ``q`` in [0, 1] (None when empty), within
+        ``alpha`` relative error; the zero bucket reports 0.0."""
+        if self.n == 0:
+            return None
+        q = min(max(float(q), 0.0), 1.0)
+        rank = q * (self.n - 1)
+        acc = self.zero
+        if rank < acc:
+            return 0.0
+        for i in sorted(self.counts):
+            acc += self.counts[i]
+            if rank < acc:
+                # bucket midpoint in log space: 2*g^i/(g+1) — the value
+                # with minimal worst-case relative error for the bucket
+                return 2.0 * (self._gamma ** i) / (self._gamma + 1.0)
+        return self.max
+
+    def count_above(self, threshold: float) -> int:
+        """Number of recorded samples strictly greater than ``threshold``
+        (bucket-resolution: the threshold's own bucket counts as not
+        above — consistent with ``alpha`` relative error)."""
+        if threshold < 0.0:
+            return self.n
+        if self.n == 0:
+            return 0
+        t_idx = self._index(threshold) if threshold > 0.0 else 0
+        return sum(c for i, c in self.counts.items() if i > t_idx)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"n": self.n, "min": self.min, "max": self.max,
+                "mean": (self.sum / self.n if self.n else None),
+                "p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+
+class _TimeBuckets:
+    """Ring of per-time-bucket payloads: ``resolution_s``-wide buckets,
+    pruned past ``horizon_s`` — bounded memory for any traffic rate."""
+
+    __slots__ = ("resolution", "horizon", "buckets")
+
+    def __init__(self, resolution_s: float, horizon_s: float):
+        self.resolution = float(resolution_s)
+        self.horizon = float(horizon_s)
+        self.buckets: Dict[float, Any] = {}
+
+    def _key(self, now: float) -> float:
+        return math.floor(now / self.resolution) * self.resolution
+
+    def prune(self, now: float):
+        cut = now - self.horizon - self.resolution
+        for k in [k for k in self.buckets if k < cut]:
+            del self.buckets[k]
+
+    def bucket(self, now: float, make: Callable[[], Any]):
+        k = self._key(now)
+        b = self.buckets.get(k)
+        if b is None:
+            b = self.buckets[k] = make()
+            self.prune(now)
+        return b
+
+    def window(self, window_s: float, now: float) -> List[Any]:
+        cut = now - float(window_s) - self.resolution
+        return [b for k, b in self.buckets.items() if cut < k <= now]
+
+
+class Objective:
+    """One declarative service-level objective (module docstring).
+
+    Use the constructors: :meth:`latency`, :meth:`ratio`, :meth:`floor`.
+    ``windows``: burn-rate windows in seconds, longest first by
+    convention; the alert condition must hold on ALL of them.
+    ``burn_threshold``: the multiple of budget-spend-rate that alerts.
+    ``for_s`` / ``clear_s`` / ``resolve_ratio``: the pending dwell,
+    resolve dwell, and hysteresis band of the state machine."""
+
+    def __init__(self, name: str, kind: str, target: float,
+                 metric: Optional[str] = None,
+                 bad: Optional[str] = None, total: Optional[str] = None,
+                 compliance: float = 0.99,
+                 windows: Tuple[float, ...] = (300.0, 60.0),
+                 burn_threshold: float = 2.0, for_s: float = 30.0,
+                 clear_s: float = 60.0, resolve_ratio: float = 0.9,
+                 description: str = ""):
+        if kind not in ("latency", "ratio", "floor"):
+            raise ValueError(f"unknown objective kind {kind!r}")
+        if kind in ("latency", "floor") and not metric:
+            raise ValueError(f"{kind} objective needs a sample metric")
+        if kind == "ratio" and not (bad and total):
+            raise ValueError("ratio objective needs bad= and total= "
+                             "counter names")
+        if not 0.0 < compliance < 1.0:
+            raise ValueError("compliance must be in (0, 1)")
+        if not windows:
+            raise ValueError("need at least one window")
+        self.name = str(name)
+        self.kind = kind
+        self.target = float(target)
+        self.metric = metric
+        self.bad = bad
+        self.total = total
+        self.compliance = float(compliance)
+        self.windows = tuple(float(w) for w in windows)
+        self.burn_threshold = float(burn_threshold)
+        self.for_s = float(for_s)
+        self.clear_s = float(clear_s)
+        self.resolve_ratio = float(resolve_ratio)
+        self.description = description
+
+    @property
+    def budget(self) -> float:
+        """Allowed bad fraction: the error budget burn rates divide by."""
+        if self.kind == "ratio":
+            return self.target
+        return 1.0 - self.compliance
+
+    @classmethod
+    def latency(cls, name: str, metric: str, target_s: float,
+                compliance: float = 0.99, **kw) -> "Objective":
+        """p-quantile latency objective: at most ``1 - compliance`` of
+        ``metric`` samples may exceed ``target_s`` (TTFT p99 ≤ 0.5s ==
+        ``latency("ttft_p99", "ttft_s", 0.5, compliance=0.99)``)."""
+        return cls(name, "latency", target_s, metric=metric,
+                   compliance=compliance, **kw)
+
+    @classmethod
+    def ratio(cls, name: str, bad: str, total: str, target: float,
+              **kw) -> "Objective":
+        """Event-ratio objective: ``bad``/``total`` stays under
+        ``target`` (shed rate, error rate)."""
+        return cls(name, "ratio", target, bad=bad, total=total, **kw)
+
+    @classmethod
+    def floor(cls, name: str, metric: str, floor: float,
+              compliance: float = 0.95, **kw) -> "Objective":
+        """Gauge-floor objective: at most ``1 - compliance`` of
+        ``metric`` samples may fall BELOW ``floor`` (the goodput
+        floor)."""
+        return cls(name, "floor", floor, metric=metric,
+                   compliance=compliance, **kw)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "kind": self.kind,
+                "target": self.target, "metric": self.metric,
+                "bad": self.bad, "total": self.total,
+                "compliance": self.compliance, "budget": self.budget,
+                "windows_s": list(self.windows),
+                "burn_threshold": self.burn_threshold,
+                "for_s": self.for_s, "clear_s": self.clear_s,
+                "resolve_ratio": self.resolve_ratio,
+                "description": self.description}
+
+
+class _AlertState:
+    __slots__ = ("state", "since", "clear_since", "fired_at")
+
+    def __init__(self):
+        self.state = "inactive"
+        self.since: Optional[float] = None
+        self.clear_since: Optional[float] = None
+        self.fired_at: Optional[float] = None
+
+
+class SLOMonitor:
+    """Declarative SLOs + multi-window burn-rate alerting (module
+    docstring).  ``clock`` is injectable for deterministic tests;
+    ``resolution_s``/``horizon_s`` bound the time-bucketed stores;
+    ``tracer`` (a ``telemetry.Tracer``) receives alert transitions as
+    ``slo`` ring events."""
+
+    def __init__(self, objectives=(), *, clock: Callable[[], float] = None,
+                 tracer=None, resolution_s: float = 5.0,
+                 horizon_s: float = 3600.0, transition_history: int = 256,
+                 logger: Optional[logging.Logger] = None):
+        self._clock = clock if clock is not None else time.monotonic
+        self.tracer = tracer
+        self.resolution_s = float(resolution_s)
+        self.horizon_s = float(horizon_s)
+        self._lock = threading.Lock()
+        # serializes evaluate()'s alert state machine: /slo and /metrics
+        # handler threads may evaluate concurrently, and a half-applied
+        # pending→cancelled transition must never be observable
+        self._eval_lock = threading.Lock()
+        self._samples: Dict[str, _TimeBuckets] = {}
+        self._counters: Dict[str, _TimeBuckets] = {}
+        self._objectives: Dict[str, Objective] = {}
+        self._alerts: Dict[str, _AlertState] = {}
+        self._transitions: collections.deque = collections.deque(
+            maxlen=int(transition_history))
+        self._ledgers: List[Any] = []
+        self.registry = StatRegistry()
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        for obj in objectives:
+            self.add_objective(obj)
+
+    # ---------------------------------------------------------- config --
+
+    def add_objective(self, obj: Objective) -> Objective:
+        with self._lock:
+            if obj.name in self._objectives:
+                raise ValueError(f"objective {obj.name!r} already defined")
+            self._objectives[obj.name] = obj
+            self._alerts[obj.name] = _AlertState()
+        return obj
+
+    def objectives(self) -> List[Objective]:
+        with self._lock:
+            return list(self._objectives.values())
+
+    def attach_ledger(self, ledger) -> "SLOMonitor":
+        """Sample a ``telemetry_ledger.RunLedger``'s goodput gauge into
+        the ``goodput`` metric at every ``evaluate()`` — the pull feed
+        of the goodput-floor objective."""
+        if not hasattr(ledger, "snapshot"):
+            raise TypeError(f"not a ledger: {type(ledger).__name__}")
+        self._ledgers.append(ledger)
+        return self
+
+    # ---------------------------------------------------------- ingest --
+
+    def now(self) -> float:
+        return self._clock()
+
+    def observe(self, metric: str, value: float,
+                now: Optional[float] = None):
+        """Record one SAMPLE of ``metric`` (a latency, a gauge reading)
+        into its time-bucketed sketch ring."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            tb = self._samples.get(metric)
+            if tb is None:
+                tb = self._samples[metric] = _TimeBuckets(
+                    self.resolution_s, self.horizon_s)
+            tb.bucket(now, PercentileSketch).add(float(value))
+
+    def count(self, metric: str, n: int = 1, now: Optional[float] = None):
+        """Record ``n`` EVENTS of ``metric`` (a counter increment) into
+        its time-bucketed sum ring."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            tb = self._counters.get(metric)
+            if tb is None:
+                tb = self._counters[metric] = _TimeBuckets(
+                    self.resolution_s, self.horizon_s)
+            b = tb.bucket(now, lambda: [0.0])
+            b[0] += float(n)
+
+    # ------------------------------------------------------ window math --
+
+    def _window_sketch(self, metric: str, window_s: float, now: float
+                       ) -> PercentileSketch:
+        out = PercentileSketch()
+        tb = self._samples.get(metric)
+        if tb is not None:
+            for sk in tb.window(window_s, now):
+                out.merge(sk)
+        return out
+
+    def _window_count(self, metric: str, window_s: float, now: float
+                      ) -> float:
+        tb = self._counters.get(metric)
+        if tb is None:
+            return 0.0
+        return sum(b[0] for b in tb.window(window_s, now))
+
+    def _bad_fraction(self, obj: Objective, window_s: float, now: float
+                      ) -> Tuple[float, float]:
+        """(bad_fraction, population) for one objective over one window.
+        An empty window is (0, 0): no evidence, no alert."""
+        if obj.kind == "ratio":
+            total = self._window_count(obj.total, window_s, now)
+            if total <= 0.0:
+                return 0.0, 0.0
+            bad = self._window_count(obj.bad, window_s, now)
+            return bad / total, total
+        sk = self._window_sketch(obj.metric, window_s, now)
+        if sk.n == 0:
+            return 0.0, 0.0
+        if obj.kind == "latency":
+            bad = sk.count_above(obj.target)
+        else:                                   # floor: below target is bad
+            bad = sk.n - sk.count_above(obj.target) - _at_or_near(
+                sk, obj.target)
+        return max(bad, 0) / sk.n, float(sk.n)
+
+    def burn_rates(self, obj: Objective, now: Optional[float] = None
+                   ) -> Dict[str, float]:
+        """Burn rate per window: bad-fraction over the window divided by
+        the objective's error budget."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            return {str(int(w)): self._bad_fraction(obj, w, now)[0]
+                    / max(obj.budget, 1e-12)
+                    for w in obj.windows}
+
+    # -------------------------------------------------------- evaluate --
+
+    def _transition(self, obj: Objective, st: _AlertState, what: str,
+                    now: float, burns: Dict[str, float]):
+        st.state = {"pending": "pending", "firing": "firing",
+                    "resolved": "inactive",
+                    "cancelled": "inactive"}[what]
+        ev = {"what": what, "objective": obj.name, "ts": now,
+              "burn": max(burns.values()) if burns else 0.0,
+              "windows": dict(burns)}
+        self._transitions.append(ev)
+        self.registry.add(f"alerts_{what}")
+        if self.tracer is not None:
+            # the tracer stamps its OWN ring-relative ts — passing the
+            # monitor's absolute clock through would corrupt the ring
+            # timebase (and last_event_age_s/healthz liveness with it);
+            # the monitor-clock reading rides along as ``at``
+            self.tracer.emit("slo", at=now,
+                             **{k: v for k, v in ev.items() if k != "ts"})
+        log = (self._log.warning if what == "firing" else self._log.info)
+        log("slo %s: %s (burn %.2f over windows %s)", what, obj.name,
+            ev["burn"], list(obj.windows))
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Advance every objective's alert state machine to ``now`` and
+        return the per-objective status rows (the core of
+        ``snapshot()``).  Pull feeds (attached ledgers) are sampled
+        first.  Idempotent for a fixed clock reading.  Serialized by
+        ``_eval_lock`` — concurrent HTTP scrapes must not interleave a
+        transition (``_lock`` alone guards the windowed stores, which
+        observers keep feeding while an evaluation runs)."""
+        now = self._clock() if now is None else float(now)
+        for led in self._ledgers:
+            try:
+                self.observe("goodput", float(led.snapshot()["goodput"]),
+                             now=now)
+            except Exception as e:  # noqa: BLE001 — a broken pull source
+                # must not take the evaluator down
+                self._log.debug("slo: ledger pull failed: %r", e)
+        with self._eval_lock:
+            return self._evaluate_locked(now)
+
+    def _evaluate_locked(self, now: float) -> List[Dict[str, Any]]:
+        rows = []
+        with self._lock:
+            objectives = list(self._objectives.values())
+        for obj in objectives:
+            with self._lock:
+                fracs = {str(int(w)): self._bad_fraction(obj, w, now)
+                         for w in obj.windows}
+                st = self._alerts[obj.name]
+            budget = max(obj.budget, 1e-12)
+            burns = {k: f / budget for k, (f, _p) in fracs.items()}
+            pops = {k: p for k, (_f, p) in fracs.items()}
+            burning = all(b >= obj.burn_threshold for b in burns.values())
+            cleared = all(b < obj.burn_threshold * obj.resolve_ratio
+                          for b in burns.values())
+            if burning:
+                st.clear_since = None
+                if st.state == "inactive":
+                    st.since = now
+                    self._transition(obj, st, "pending", now, burns)
+                if st.state == "pending" and now - st.since >= obj.for_s:
+                    st.fired_at = now
+                    self._transition(obj, st, "firing", now, burns)
+            elif st.state == "pending":
+                # never fired: cancel quietly (still a recorded transition)
+                st.since = None
+                self._transition(obj, st, "cancelled", now, burns)
+            elif st.state == "firing":
+                # hysteresis: only a burn clearly below the threshold
+                # (resolve_ratio band), sustained for clear_s, resolves —
+                # hovering AT the boundary keeps the alert firing
+                if cleared:
+                    if st.clear_since is None:
+                        st.clear_since = now
+                    elif now - st.clear_since >= obj.clear_s:
+                        st.since = st.clear_since = st.fired_at = None
+                        self._transition(obj, st, "resolved", now, burns)
+                else:
+                    st.clear_since = None
+            rows.append({
+                "name": obj.name, "kind": obj.kind, "target": obj.target,
+                "budget": obj.budget, "state": st.state,
+                "since": st.since, "burn_rates": burns,
+                "window_populations": pops,
+                "burn_threshold": obj.burn_threshold,
+                "sli": self._sli(obj, now),
+            })
+        return rows
+
+    def _sli(self, obj: Objective, now: float) -> Optional[Dict[str, Any]]:
+        """Current service-level indicator over the LONGEST window: the
+        compliance quantile for latency/floor objectives, the rate for
+        ratio ones."""
+        w = max(obj.windows)
+        with self._lock:
+            if obj.kind == "ratio":
+                total = self._window_count(obj.total, w, now)
+                bad = self._window_count(obj.bad, w, now)
+                return {"rate": (bad / total if total else None),
+                        "bad": bad, "total": total}
+            sk = self._window_sketch(obj.metric, w, now)
+            return {"quantile": obj.compliance,
+                    "value": sk.quantile(obj.compliance),
+                    **sk.snapshot()}
+
+    # --------------------------------------------------------- exports --
+
+    def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """The ``GET /slo`` payload: objective definitions, live alert
+        states and burn rates, SLIs, and the recent transition ring."""
+        now = self._clock() if now is None else float(now)
+        rows = self.evaluate(now)
+        with self._lock:
+            transitions = list(self._transitions)
+        return {
+            "now": now,
+            "objectives": [o.to_dict() for o in self.objectives()],
+            "status": rows,
+            "alerts_firing": sum(1 for r in rows
+                                 if r["state"] == "firing"),
+            "transitions": transitions,
+        }
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_slo") -> str:
+        """Labeled burn-rate / alert-state / SLI gauges plus the
+        transition counters — label values escaped through the shared
+        ``utils.stats`` helper."""
+        rows = self.evaluate()
+        lines = [prometheus_text(self.registry, namespace=namespace)
+                 .rstrip("\n")]
+        lines.append(f"# TYPE {namespace}_burn_rate gauge")
+        for r in rows:
+            for w, b in r["burn_rates"].items():
+                lines.append(prom_sample(
+                    f"{namespace}_burn_rate", b,
+                    {"objective": r["name"], "window_s": w}))
+        lines.append(f"# TYPE {namespace}_alert_state gauge")
+        for r in rows:
+            lines.append(prom_sample(
+                f"{namespace}_alert_state",
+                ALERT_STATES.index(r["state"]),
+                {"objective": r["name"]}))
+        lines.append(f"# TYPE {namespace}_sli gauge")
+        for r in rows:
+            sli = r.get("sli") or {}
+            v = sli.get("value", sli.get("rate"))
+            if v is not None:
+                lines.append(prom_sample(f"{namespace}_sli", v,
+                                         {"objective": r["name"]}))
+        return "\n".join(lines) + "\n"
+
+
+def _at_or_near(sk: PercentileSketch, target: float) -> int:
+    """Samples in the target's own bucket (treated as compliant for the
+    floor objective — consistent with the sketch's alpha error band)."""
+    if target <= 0.0 or sk.n == 0:
+        return 0
+    return sk.counts.get(sk._index(target), 0)

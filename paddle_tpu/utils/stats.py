@@ -22,7 +22,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["StatRegistry", "Histogram", "stat_registry", "stat_add",
            "stat_sub", "get_stat", "get_all_stats", "device_memory_stats",
-           "op_summary", "prometheus_text", "DEFAULT_TIME_BUCKETS"]
+           "op_summary", "prometheus_text", "prom_escape_label",
+           "prom_sample", "DEFAULT_TIME_BUCKETS"]
 
 # Prometheus-style latency buckets (seconds): sub-ms ticks through
 # multi-second compiles land in distinct buckets.
@@ -224,6 +225,32 @@ def _fmt(v) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def prom_escape_label(value) -> str:
+    """Escape one label VALUE per the Prometheus text exposition format
+    (0.0.4): backslash, double-quote, and newline — in that order, so the
+    escaping backslashes are not themselves re-escaped.  This is THE
+    escaping implementation: every exposition in the tree (`telemetry`,
+    `serving`, `gateway`, `telemetry_ledger`, `telemetry_slo`, this
+    module's `prometheus_text`) renders label values through it — one
+    copy, so the escaping rules cannot drift between emitters."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def prom_sample(name: str, value, labels: Optional[Dict[str, object]] = None
+                ) -> str:
+    """One exposition sample line ``name{k="v",...} value`` with label
+    values escaped via :func:`prom_escape_label` (label NAMES are
+    sanitized like metric names).  The shared line renderer behind every
+    ``prometheus_text`` emitter."""
+    if labels:
+        body = ",".join(
+            f'{_METRIC_NAME_RE.sub("_", str(k))}="{prom_escape_label(v)}"'
+            for k, v in labels.items())
+        return f"{name}{{{body}}} {_fmt(value)}"
+    return f"{name} {_fmt(value)}"
+
+
 def prometheus_text(registry: Optional[StatRegistry] = None,
                     namespace: str = "paddle_tpu",
                     extra_gauges: Optional[Dict[str, float]] = None,
@@ -241,23 +268,23 @@ def prometheus_text(registry: Optional[StatRegistry] = None,
     for name, value in reg.snapshot().items():
         pn = _prom_name(namespace, name)
         lines.append(f"# TYPE {pn} {kinds.get(name, 'counter')}")
-        lines.append(f"{pn} {_fmt(value)}")
+        lines.append(prom_sample(pn, value))
     for name, h in reg.histograms().items():
         pn = _prom_name(namespace, name)
         lines.append(f"# TYPE {pn} histogram")
         acc = 0
         for bound, c in zip(h["bounds"], h["counts"]):
             acc += c
-            lines.append(f'{pn}_bucket{{le="{bound}"}} {acc}')
-        lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
-        lines.append(f"{pn}_sum {_fmt(h['sum'])}")
-        lines.append(f"{pn}_count {h['count']}")
+            lines.append(prom_sample(f"{pn}_bucket", acc, {"le": bound}))
+        lines.append(prom_sample(f"{pn}_bucket", h["count"], {"le": "+Inf"}))
+        lines.append(prom_sample(f"{pn}_sum", h["sum"]))
+        lines.append(prom_sample(f"{pn}_count", h["count"]))
     for extras, kind in ((extra_gauges, "gauge"),
                          (extra_counters, "counter")):
         for name, value in (extras or {}).items():
             pn = _prom_name(namespace, name)
             lines.append(f"# TYPE {pn} {kind}")
-            lines.append(f"{pn} {_fmt(value)}")
+            lines.append(prom_sample(pn, value))
     return "\n".join(lines) + "\n"
 
 

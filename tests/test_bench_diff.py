@@ -147,3 +147,83 @@ class TestExitCodes:
         report = json.loads(capsys.readouterr().out)
         assert report["regressions"] == 1 and report["compared"] == 1
         assert report["rows"][0]["metric"] == "m"
+
+
+class TestTelemetryExpansion:
+    """Telemetry snapshot fields (TTFT p99, goodput, MFU…) participate in
+    the diff as synthetic <metric>.telemetry.<field> rows with
+    per-field direction — a latency rise OR a goodput/MFU drop flags even
+    when the headline number held."""
+
+    def _tel_rec(self, value, ttft_p99, goodput, mfu):
+        rec = _rec("gpt_serving_tokens_per_sec", value)
+        rec["telemetry"] = {"ttft_ms_p99": ttft_p99, "ticks": 40,
+                            "goodput": {"goodput": goodput}, "mfu": mfu}
+        return rec
+
+    def test_expansion_whitelists_and_flattens(self):
+        rows = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 12.0, 0.8, 0.4)])
+        by = {r["metric"]: r for r in rows}
+        assert "gpt_serving_tokens_per_sec.telemetry.ttft_ms_p99" in by
+        assert "gpt_serving_tokens_per_sec.telemetry.goodput.goodput" \
+            in by                                 # nested dicts flatten
+        assert "gpt_serving_tokens_per_sec.telemetry.mfu" in by
+        # un-whitelisted leaves (raw tick counts) stay out
+        assert not any(m.endswith(".ticks") for m in by)
+        assert by["gpt_serving_tokens_per_sec.telemetry.ttft_ms_p99"][
+            "direction"] == "lower"
+        # error rounds never expand
+        assert bench_diff.expand_telemetry([ERROR_REC]) == [ERROR_REC]
+
+    def test_latency_rise_regresses_throughput_held(self):
+        old = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 10.0, 0.8, 0.4)])
+        new = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 25.0, 0.8, 0.4)])
+        rows, n_reg, n_cmp = bench_diff.compare(old, new, 0.1)
+        bad = [r for r in rows if "REGRESSION" in r["status"]]
+        assert n_reg == 1
+        assert bad[0]["metric"].endswith("ttft_ms_p99")
+
+    def test_goodput_and_mfu_drop_regress_higher_is_better(self):
+        old = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 10.0, 0.8, 0.4)])
+        new = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 10.0, 0.4, 0.1)])
+        rows, n_reg, _ = bench_diff.compare(old, new, 0.1)
+        assert n_reg == 2
+        names = {r["metric"].split(".")[-1] for r in rows
+                 if "REGRESSION" in r["status"]}
+        assert names == {"goodput", "mfu"}
+        # and an IMPROVEMENT in a lower-is-better field never flags
+        rows, n_reg, _ = bench_diff.compare(
+            bench_diff.expand_telemetry(
+                [self._tel_rec(100.0, 25.0, 0.8, 0.4)]),
+            bench_diff.expand_telemetry(
+                [self._tel_rec(100.0, 10.0, 0.8, 0.4)]), 0.1)
+        assert n_reg == 0
+
+    def test_one_sided_telemetry_not_comparable(self):
+        """Only rounds that BOTH carry the field compare — an old round
+        without telemetry must not fabricate a regression."""
+        old = bench_diff.expand_telemetry(
+            [_rec("gpt_serving_tokens_per_sec", 100.0)])
+        new = bench_diff.expand_telemetry(
+            [self._tel_rec(100.0, 25.0, 0.8, 0.4)])
+        rows, n_reg, n_cmp = bench_diff.compare(old, new, 0.1)
+        assert n_reg == 0 and n_cmp == 1          # headline only
+        tel_rows = [r for r in rows if ".telemetry." in r["metric"]]
+        assert tel_rows and all("not comparable" in r["status"]
+                                for r in tel_rows)
+
+    def test_scan_trajectory_diffs_telemetry(self, tmp_path, capsys):
+        (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+            {"n": 1, "rc": 0, "tail": "",
+             "parsed": self._tel_rec(100.0, 10.0, 0.8, 0.4)}))
+        (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+            {"n": 2, "rc": 0, "tail": "",
+             "parsed": self._tel_rec(100.0, 30.0, 0.8, 0.4)}))
+        assert bench_diff.main(["--scan", str(tmp_path)]) == 1
+        out = capsys.readouterr().err
+        assert "1 regression" in out

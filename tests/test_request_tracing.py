@@ -1,0 +1,456 @@
+"""End-to-end request tracing (ISSUE 10): TraceContext minting and
+propagation gateway → engines, cross-source stitching via
+RequestTraceIndex (including the acceptance e2e — a quarantine-rerouted
+request reconstructs as ONE trace spanning both replicas with no orphan
+spans), the ops-server /requests + /request/<id> routes, chrome flow
+events, MFU/roofline attribution at the compile seams, and the PR 4-style
+off-path purity pin extended to trace-context plumbing."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                PagedContinuousBatchingEngine)
+from paddle_tpu.telemetry import (RequestTraceIndex, TraceContext, Tracer,
+                                  events_to_chrome)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+def _paged(model, params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 32)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prompt_buckets", [8, 16])
+    kw.setdefault("tracer", Tracer())
+    return PagedContinuousBatchingEngine(model, params, **kw)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------- context --
+
+class TestTraceContext:
+    def test_root_and_child_identity(self):
+        root = TraceContext.root()
+        child = root.child()
+        assert child.trace_id == root.trace_id
+        assert child.parent_span_id == root.span_id
+        assert child.span_id != root.span_id
+        assert TraceContext.root().trace_id != root.trace_id
+        d = child.to_dict()
+        assert set(d) == {"trace_id", "span_id", "parent_span_id"}
+
+    def test_bind_attaches_to_request_events_and_unbinds_on_terminal(self):
+        tr = Tracer()
+        ctx = TraceContext.root().child()
+        tr.bind_trace(7, ctx)
+        tr.request_event(7, "queued", prompt_len=3)
+        tr.request_event(7, "retired")
+        evs = tr.events("request")
+        assert all(e["trace_id"] == ctx.trace_id for e in evs)
+        assert all(e["span_id"] == ctx.span_id for e in evs)
+        assert all(e["parent_span_id"] == ctx.parent_span_id for e in evs)
+        assert tr.trace_of(7) is None            # dropped at terminal
+        tr.request_event(8, "queued")            # unbound rid: no fields
+        assert "trace_id" not in tr.events("request")[-1]
+
+    def test_engine_add_request_binds_and_preemption_keeps_binding(
+            self, model_and_params):
+        model, params = model_and_params
+        eng = _paged(model, params, num_blocks=6)
+        ctx = TraceContext.root().child()
+        rid = eng.add_request([5, 17, 3], 4, trace_ctx=ctx)
+        eng.run_to_completion(max_ticks=100)
+        evs = [e for e in eng.tracer.events("request") if e["rid"] == rid]
+        assert evs and all(e.get("trace_id") == ctx.trace_id for e in evs)
+        whats = [e["what"] for e in evs]
+        assert whats[0] == "queued" and whats[-1] == "retired"
+
+
+# ------------------------------------------------------- stitched traces --
+
+def _stitched(gw, names):
+    idx = RequestTraceIndex()
+    idx.add_source(gw.tracer, "gateway")
+    for n in names:
+        idx.add_source(gw.replica(n).engine.tracer, n)
+    return idx
+
+
+def _assert_well_formed(trace):
+    """Every span parented, exactly one root, no dangling parents."""
+    spans = trace["spans"]
+    ids = {s["span_id"] for s in spans}
+    roots = [s for s in spans if s["parent_span_id"] is None]
+    assert len(roots) == 1 and roots[0]["name"] == "request"
+    orphans = [s for s in spans if s["parent_span_id"] is not None
+               and s["parent_span_id"] not in ids]
+    assert not orphans, orphans
+
+
+class TestStitchedTraces:
+    def test_quarantine_reroute_yields_one_trace_both_replicas(
+            self, model_and_params):
+        """THE acceptance e2e: a request that survives a quarantine
+        reroute reconstructs as ONE stitched trace via the index (and
+        GET /request/<id>), covering BOTH replicas, every span parented,
+        no orphans."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0,
+                            tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        gw.add_replica(_paged(model, params), "b")
+        r = gw.submit([5, 17, 3], 8)
+        assert r.trace is not None
+        gw.step()
+        victim = r.replica
+        rep = gw.replica(victim)
+        rep.engine.tracer.last_event_age_s = lambda: 99.0    # wedge it
+        gw.step()
+        assert rep.state == "quarantined"
+        gw.run_to_completion(max_ticks=300)
+        assert r.status == "finished" and r.replica != victim
+
+        idx = _stitched(gw, ["a", "b"])
+        trace = idx.trace(r.trace.trace_id)
+        assert trace is not None
+        _assert_well_formed(trace)
+        assert trace["status"] == "finished"
+        assert trace["gid"] == r.gid
+        # one attempt span per dispatch, one per replica — both present
+        attempts = [s for s in trace["spans"]
+                    if s["name"].startswith("attempt@")]
+        assert {a["replica"] for a in attempts} == {"a", "b"}
+        # the surviving attempt has the full phase ladder
+        survivor = [s for s in trace["spans"]
+                    if s["parent_span_id"] in
+                    {a["span_id"] for a in attempts
+                     if a["replica"] == r.replica}]
+        assert {"queued", "prefill", "decode"} <= \
+            {s["name"] for s in survivor}
+        # the event sequence shows the journey: dispatch -> reroute ->
+        # dispatch -> finish, all on one trace_id
+        whats = [e.get("what") for e in trace["events"]
+                 if e.get("kind") == "gateway"]
+        assert whats.count("dispatch") == 2
+        assert "reroute" in whats and whats[-1] == "finish"
+        assert {e["trace_id"] for e in trace["events"]} == \
+            {r.trace.trace_id}
+
+    def test_recent_ring_summaries(self, model_and_params):
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), tracer=Tracer(),
+                            max_queue_depth=1)
+        gw.add_replica(_paged(model, params), "a")
+        ok = gw.submit([5, 17, 3], 4)
+        shed = [gw.submit([1, 2], 3) for _ in range(3)][-1]
+        gw.run_to_completion(max_ticks=200)
+        recents = _stitched(gw, ["a"]).recent(10)
+        by_id = {x["trace_id"]: x for x in recents}
+        assert by_id[ok.trace.trace_id]["status"] == "finished"
+        assert by_id[ok.trace.trace_id]["replicas"] == ["a"]
+        assert by_id[shed.trace.trace_id]["status"] == "shed"
+        # newest first, bounded
+        assert len(_stitched(gw, ["a"]).recent(2)) == 2
+        # a shed trace still stitches (root span only, well-formed)
+        shed_trace = _stitched(gw, ["a"]).trace(shed.trace.trace_id)
+        _assert_well_formed(shed_trace)
+        assert shed_trace["status"] == "shed"
+
+    def test_ops_server_requests_routes(self, model_and_params):
+        from paddle_tpu.ops_server import OpsServer
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        r = gw.submit([5, 17, 3], 4)
+        gw.run_to_completion(max_ticks=200)
+        srv = OpsServer()
+        srv.attach(gw)
+        srv.attach(gw.replica("a").engine)
+        url = srv.start()
+        try:
+            recents = json.loads(urllib.request.urlopen(
+                url + "/requests?n=5", timeout=10).read())
+            assert recents["requests"][0]["trace_id"] == r.trace.trace_id
+            one = json.loads(urllib.request.urlopen(
+                url + f"/request/{r.trace.trace_id}", timeout=10).read())
+            _assert_well_formed(one)
+            assert one["status"] == "finished"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/request/deadbeef",
+                                       timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_ops_server_gateway_only_attach_serves_full_ladder(
+            self, model_and_params):
+        """attach(gateway) ALONE must serve the full stitched timeline:
+        replica engine tracers are enumerated live at query time, so the
+        phase ladder (queued/prefill/decode) and BOTH replicas of a
+        quarantine reroute appear without attaching any engine — and a
+        drain-swapped replacement would, too."""
+        from paddle_tpu.ops_server import OpsServer
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), stall_threshold_s=5.0,
+                            tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        gw.add_replica(_paged(model, params), "b")
+        r = gw.submit([5, 17, 3], 8)
+        gw.step()
+        victim = r.replica
+        gw.replica(victim).engine.tracer.last_event_age_s = lambda: 99.0
+        gw.step()
+        gw.run_to_completion(max_ticks=300)
+        assert r.status == "finished" and r.replica != victim
+        srv = OpsServer()
+        srv.attach(gw)                      # nothing else
+        url = srv.start()
+        try:
+            one = json.loads(urllib.request.urlopen(
+                url + f"/request/{r.trace.trace_id}", timeout=10).read())
+            _assert_well_formed(one)
+            names = {s["name"].split("@")[0] for s in one["spans"]}
+            assert {"queued", "prefill", "decode"} <= names
+            assert {s["replica"] for s in one["spans"]
+                    if s["name"].startswith("attempt@")} == {"a", "b"}
+        finally:
+            srv.stop()
+
+    def test_untraced_gateway_stays_zero_cost(self, model_and_params):
+        """tracer=None: no TraceContext is minted, engines get
+        trace_ctx=None, nothing binds — the off path is one attribute
+        check, same as before."""
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock())
+        gw.add_replica(_paged(model, params, tracer=None), "a")
+        r = gw.submit([5, 17, 3], 4)
+        gw.run_to_completion(max_ticks=200)
+        assert r.status == "finished" and r.trace is None
+
+
+# ------------------------------------------------------------ chrome flow --
+
+class TestChromeFlowEvents:
+    def test_dispatch_and_admit_emit_matching_flow_pair(
+            self, model_and_params):
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        r = gw.submit([5, 17, 3], 4)
+        gw.run_to_completion(max_ticks=200)
+        gw_chrome = events_to_chrome(gw.tracer.events())
+        eng_chrome = events_to_chrome(
+            gw.replica("a").engine.tracer.events())
+        starts = [e for e in gw_chrome["traceEvents"] if e.get("ph") == "s"]
+        finishes = [e for e in eng_chrome["traceEvents"]
+                    if e.get("ph") == "f"]
+        assert starts and finishes
+        assert starts[0]["id"] == finishes[0]["id"]     # same attempt span
+        assert starts[0]["args"]["trace_id"] == r.trace.trace_id
+        assert finishes[0]["bp"] == "e"
+
+    def test_trace_to_chrome_multi_engine_merge(self, tmp_path,
+                                                model_and_params):
+        """tools/trace_to_chrome.py: repeated --engine-trace dumps merge
+        with per-file pid suffixes (replica rid rows must not collide)
+        while flow ids survive untouched."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_t2c", "tools/trace_to_chrome.py")
+        t2c = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(t2c)
+
+        model, params = model_and_params
+        gw = ServingGateway(clock=FakeClock(), tracer=Tracer())
+        gw.add_replica(_paged(model, params), "a")
+        gw.add_replica(_paged(model, params), "b")
+        for p, n in (([5, 17, 3], 4), ([40, 2], 3), ([61], 3)):
+            gw.submit(p, n)
+        gw.run_to_completion(max_ticks=300)
+
+        paths = []
+        for i, tr in enumerate([gw.tracer,
+                                gw.replica("a").engine.tracer,
+                                gw.replica("b").engine.tracer]):
+            p = tmp_path / f"dump{i}.jsonl"
+            tr.dump_jsonl(str(p))
+            paths.append(str(p))
+        merged = {"traceEvents": []}
+        for i, p in enumerate(paths):
+            trace = t2c._suffix_pids(t2c._load_engine_trace(p), i)
+            merged["traceEvents"].extend(trace["traceEvents"])
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert {"paddle_tpu.serving#0", "paddle_tpu.serving#1",
+                "paddle_tpu.serving#2"} <= pids
+        starts = {e["id"] for e in merged["traceEvents"]
+                  if e.get("ph") == "s"}
+        finishes = {e["id"] for e in merged["traceEvents"]
+                    if e.get("ph") == "f"}
+        assert starts and starts == finishes     # every arrow lands
+
+
+# ----------------------------------------------------------- mfu / costs --
+
+class TestCostAttribution:
+    def test_engine_compile_seam_records_flops_and_mfu(self):
+        # a FRESH model: the compile-event flops assertion below needs
+        # real program-cache misses, not hits against the module model
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=96,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        tr = Tracer(attribute_cost=True, peak_flops=1e12)
+        eng = _paged(model, params, tracer=tr)
+        eng.add_request([5, 17, 3], 4)
+        eng.run_to_completion(max_ticks=100)
+        assert any(e.get("flops") for e in tr.events("compile"))
+        mfu = tr.summary()["mfu"]
+        assert mfu["model_flops_total"] > 0
+        assert mfu["model_flops_per_s"] > 0
+        assert mfu["arithmetic_intensity"] > 0
+        assert 0 < mfu["mfu"] < 1
+        assert any(e.get("flops") for e in tr.events("tick"))
+        text = tr.prometheus_text()
+        assert "paddle_tpu_serving_model_flops_total" in text
+        assert "paddle_tpu_serving_mfu" in text
+
+    def test_cost_off_by_default(self, model_and_params):
+        model, params = model_and_params
+        tr = Tracer()
+        # fresh model so the program cache is cold
+        paddle.seed(11)
+        cfg = model.config
+        m2 = GPTModel(cfg)
+        p2 = {n: p._data for n, p in m2.named_parameters()}
+        eng = PagedContinuousBatchingEngine(
+            m2, p2, max_slots=2, max_len=32, block_size=4,
+            prompt_buckets=[8, 16], tracer=tr)
+        eng.add_request([5, 17, 3], 4)
+        eng.run_to_completion(max_ticks=100)
+        assert tr.summary()["mfu"]["model_flops_total"] == 0.0
+        assert tr.summary()["mfu"]["mfu"] is None
+
+    def test_compile_aot_attaches_cost_for_free(self):
+        from paddle_tpu.jit.aot import compile_aot
+        from paddle_tpu.telemetry import TrainMonitor
+        mon = TrainMonitor(peak_flops=1e12)
+
+        def f(x):
+            return x @ x
+
+        compiled, prov = compile_aot(
+            f, [jnp.ones((16, 16), jnp.float32)], monitor=mon,
+            label="mm")
+        assert prov == "cold"
+        ev = mon.events("compile")[-1]
+        assert ev.get("flops", 0) > 0
+        mon.record_step(0.01, trainer="t", examples=1)
+        mon.record_step(0.01, trainer="t", examples=1)
+        mfu = mon.summary()["mfu"]
+        assert mfu["model_flops_per_step"] > 0
+        assert mfu["model_flops_per_s"] > 0 and mfu["mfu"] > 0
+
+
+# ------------------------------------------------------- off-path purity --
+
+class TestOffPathPurity:
+    def test_lowerings_byte_identical_with_tracing_and_trace_ctx(self):
+        """The PR 4 purity pin extended to trace-context plumbing: an
+        engine with a tracer + bound TraceContexts lowers byte-identical
+        programs to a bare engine — tracing is host-side metadata
+        only."""
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_attention_heads=2,
+                        max_position_embeddings=64,
+                        compute_dtype="float32")
+
+        def build(tracer):
+            model = GPTModel(cfg)
+            params = {n: p._data for n, p in model.named_parameters()}
+            return ContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32,
+                prompt_buckets=[8], tracer=tracer)
+
+        def lowered_texts(eng):
+            ck, cv = eng._alloc_caches()
+            pre = eng._build_prefill(8).lower(
+                eng.params, ck, cv, jnp.zeros((1, 8), jnp.int32),
+                jnp.int32(0), jnp.int32(0), jax.random.key(0),
+                eng._scratch_presence(), eng._plane_operands()).as_text()
+            ck, cv = eng._alloc_caches()
+            z = jnp.zeros(eng.S, jnp.int32)
+            dec = eng._build_decode().lower(
+                eng.params, ck, cv, z, z, z,
+                jnp.zeros(eng.S, bool), jax.random.key(0),
+                eng._scratch_presence(), z,
+                eng._plane_operands()).as_text()
+            return pre, dec
+
+        on = build(Tracer(attribute_cost=True))
+        # exercise the traced path (binds a context) before lowering
+        on.add_request([1, 2, 3], 2, trace_ctx=TraceContext.root())
+        on.run_to_completion(max_ticks=50)
+        off = build(None)
+        for a, b in zip(lowered_texts(on), lowered_texts(off)):
+            assert a == b
+
+    def test_program_cache_keys_identical_with_and_without_tracing(self):
+        """Same engine config, traced vs untraced, on SEPARATE models:
+        the model-level program cache keys are identical — a traced
+        engine can never fork the compiled-program population."""
+        paddle.seed(0)
+        cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                        num_attention_heads=2,
+                        max_position_embeddings=64,
+                        compute_dtype="float32")
+
+        def run(tracer, ctx):
+            paddle.seed(0)           # identical params per build
+            model = GPTModel(cfg)
+            params = {n: p._data for n, p in model.named_parameters()}
+            eng = ContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32,
+                prompt_buckets=[8], tracer=tracer)
+            eng.add_request([1, 2, 3], 2, trace_ctx=ctx)
+            out = eng.run_to_completion(max_ticks=50)
+            return (set(model.__dict__["_serving_programs"]),
+                    list(out.values())[0])
+
+        keys_on, toks_on = run(Tracer(), TraceContext.root())
+        keys_off, toks_off = run(None, None)
+        assert keys_on == keys_off
+        assert toks_on == toks_off

@@ -1,0 +1,414 @@
+"""SLO engine (paddle_tpu/telemetry_slo.py, ISSUE 10): mergeable
+percentile sketch accuracy, windowed stores, and the multi-window
+burn-rate alert lifecycle — pending → firing → resolved — under a
+DETERMINISTIC fake clock (no sleeps anywhere), including the
+no-flapping-at-the-boundary hysteresis contract.
+
+No reference counterpart: this is the SRE alerting layer over the
+reference's monitor.h counters."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.telemetry import Tracer
+from paddle_tpu.telemetry_slo import Objective, PercentileSketch, SLOMonitor
+from paddle_tpu.utils.stats import prom_escape_label, prom_sample
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------- sketch --
+
+class TestPercentileSketch:
+    def test_quantile_within_alpha(self):
+        rng = np.random.RandomState(0)
+        vals = rng.lognormal(size=5000)
+        sk = PercentileSketch(alpha=0.02)
+        for v in vals:
+            sk.add(float(v))
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.quantile(vals, q))
+            got = sk.quantile(q)
+            assert abs(got - exact) / exact < 0.05, (q, got, exact)
+        assert sk.n == 5000
+        assert sk.min == pytest.approx(float(vals.min()))
+        assert sk.max == pytest.approx(float(vals.max()))
+
+    def test_merge_equals_union(self):
+        rng = np.random.RandomState(1)
+        vals = rng.exponential(size=2000)
+        whole = PercentileSketch()
+        a, b = PercentileSketch(), PercentileSketch()
+        for i, v in enumerate(vals):
+            whole.add(float(v))
+            (a if i % 2 else b).add(float(v))
+        a.merge(b)
+        assert a.n == whole.n
+        for q in (0.5, 0.95, 0.99):
+            assert a.quantile(q) == whole.quantile(q)
+        assert a.count_above(1.0) == whole.count_above(1.0)
+
+    def test_merge_rejects_mismatched_alpha(self):
+        with pytest.raises(ValueError, match="alpha"):
+            PercentileSketch(0.02).merge(PercentileSketch(0.05))
+
+    def test_count_above_and_zero_bucket(self):
+        sk = PercentileSketch()
+        for v in (0.0, 0.0, 1.0, 10.0, 100.0):
+            sk.add(v)
+        assert sk.count_above(5.0) == 2          # 10, 100
+        assert sk.count_above(-1.0) == 5         # everything
+        assert sk.quantile(0.0) == 0.0           # zero bucket
+        assert sk.n == 5
+
+    def test_empty(self):
+        sk = PercentileSketch()
+        assert sk.quantile(0.5) is None
+        assert sk.count_above(1.0) == 0
+        assert sk.snapshot()["n"] == 0
+
+
+# --------------------------------------------------------------- windows --
+
+class TestWindowedStores:
+    def test_samples_age_out_of_window(self):
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0)
+        obj = slo.add_objective(Objective.latency(
+            "ttft", "ttft_s", 0.1, windows=(10.0,)))
+        for _ in range(5):
+            slo.observe("ttft_s", 1.0)           # all bad
+            clk.advance(1.0)
+        assert slo.burn_rates(obj)["10"] > 0
+        clk.advance(30.0)                        # everything ages out
+        assert slo.burn_rates(obj)["10"] == 0.0
+
+    def test_counter_window_sums(self):
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0)
+        obj = slo.add_objective(Objective.ratio(
+            "shed", bad="shed", total="submitted", target=0.1,
+            windows=(10.0,)))
+        for _ in range(10):
+            slo.count("submitted")
+            clk.advance(1.0)
+        slo.count("shed", 5)
+        # 5/10 shed over 10% budget -> burn 5
+        assert slo.burn_rates(obj)["10"] == pytest.approx(5.0)
+        clk.advance(60.0)
+        assert slo.burn_rates(obj)["10"] == 0.0
+
+    def test_bounded_buckets(self):
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0, horizon_s=10.0)
+        for _ in range(1000):
+            slo.observe("m", 1.0)
+            clk.advance(1.0)
+        assert len(slo._samples["m"].buckets) <= 13   # horizon-bounded
+
+
+# ------------------------------------------------------------- lifecycle --
+
+def _ttft_monitor(clk, tracer=None, **kw):
+    kw.setdefault("windows", (60.0, 10.0))
+    kw.setdefault("burn_threshold", 2.0)
+    kw.setdefault("for_s", 5.0)
+    kw.setdefault("clear_s", 10.0)
+    slo = SLOMonitor(clock=clk, tracer=tracer, resolution_s=1.0)
+    obj = slo.add_objective(Objective.latency(
+        "ttft_p99", "ttft_s", target_s=0.1, compliance=0.99, **kw))
+    return slo, obj
+
+
+class TestBurnRateLifecycle:
+    def test_pending_firing_resolved_on_regression_and_recovery(self):
+        """The acceptance lifecycle: a synthetic TTFT regression drives
+        pending → firing; recovery drives resolved — and every
+        transition lands in the snapshot, the prometheus export, AND the
+        tracer ring."""
+        clk = FakeClock()
+        tracer = Tracer()
+        slo, obj = _ttft_monitor(clk, tracer)
+        # healthy traffic: burn 0, no alert
+        for _ in range(30):
+            slo.observe("ttft_s", 0.01)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "inactive"
+        # regression: every sample breaches -> burn = 1/budget = 100
+        fired_at = None
+        for _ in range(20):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+            row = slo.evaluate()[0]
+            if row["state"] == "firing" and fired_at is None:
+                fired_at = clk.t
+        assert fired_at is not None
+        row = slo.evaluate()[0]
+        assert row["state"] == "firing"
+        assert all(b >= obj.burn_threshold
+                   for b in row["burn_rates"].values())
+        # recovery: good samples push burn under the resolve band on the
+        # short window quickly, on the long window later
+        for _ in range(90):
+            slo.observe("ttft_s", 0.01)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "inactive"
+        whats = [t["what"] for t in slo.snapshot()["transitions"]]
+        assert whats == ["pending", "firing", "resolved"]
+        # transitions rode the tracer ring as slo events
+        assert [e["what"] for e in tracer.events("slo")] == whats
+        assert all(e["objective"] == "ttft_p99"
+                   for e in tracer.events("slo"))
+        # ring events carry the TRACER's timebase (seconds since its
+        # t0), not the monitor's absolute clock: a wedged loop whose
+        # newest event is an slo transition must still age out on
+        # /healthz.  The monitor-clock reading rides along as "at".
+        for e in tracer.events("slo"):
+            assert 0.0 <= e["ts"] <= tracer.now()
+            assert e["at"] >= 3.0          # the fake clock, well past t0
+        assert tracer.last_event_age_s() < 60.0
+        # and the exports agree
+        text = slo.prometheus_text()
+        assert 'paddle_tpu_slo_alert_state{objective="ttft_p99"} 0' in text
+        assert "paddle_tpu_slo_alerts_firing 1" in text
+        assert "paddle_tpu_slo_alerts_resolved 1" in text
+
+    def test_pending_needs_for_s_before_firing(self):
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk, for_s=8.0)
+        for _ in range(3):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+        assert slo.evaluate()[0]["state"] == "pending"
+        for _ in range(10):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "firing"
+
+    def test_short_blip_cancels_without_firing(self):
+        """A blip shorter than for_s never fires: pending → cancelled,
+        and no firing/resolved transitions exist."""
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk, for_s=30.0)
+        for _ in range(3):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "pending"
+        for _ in range(30):
+            slo.observe("ttft_s", 0.01)
+            clk.advance(1.0)
+            slo.evaluate()
+        whats = [t["what"] for t in slo.snapshot()["transitions"]]
+        assert whats == ["pending", "cancelled"]
+
+    def test_no_flapping_at_the_boundary(self):
+        """An SLI hovering AT the burn threshold must not flap: once
+        firing, the alert stays firing until burn drops clearly below
+        the resolve band (resolve_ratio hysteresis) for clear_s."""
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk, burn_threshold=2.0,
+                                 resolve_ratio=0.9)
+        budget = obj.budget                      # 0.01
+        # drive to firing
+        for _ in range(20):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "firing"
+        # hover exactly at the boundary: bad fraction ~= 2x budget
+        # (burn ~2.0), oscillating slightly above/below the threshold
+        # but never below resolve_ratio * threshold
+        rng = np.random.RandomState(0)
+        for i in range(120):
+            bad = budget * (2.0 + (0.3 if i % 2 else -0.05))
+            for _ in range(40):
+                slo.observe("ttft_s",
+                            0.5 if rng.rand() < bad else 0.01)
+            clk.advance(1.0)
+            slo.evaluate()
+        whats = [t["what"] for t in slo.snapshot()["transitions"]]
+        assert whats == ["pending", "firing"], whats   # never resolved
+        assert slo.evaluate()[0]["state"] == "firing"
+
+    def test_multi_window_and_gate(self):
+        """A stale long-window breach with a recovered short window does
+        NOT alert (the multi-window AND): the incident is over."""
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk, for_s=0.0)
+        for _ in range(20):
+            slo.observe("ttft_s", 0.5)
+            clk.advance(1.0)
+        # 20s of pure recovery: the 10s window is clean, the 60s window
+        # still remembers the damage
+        for _ in range(20):
+            slo.observe("ttft_s", 0.01)
+            clk.advance(1.0)
+        row = slo.evaluate()[0]
+        assert row["burn_rates"]["60"] >= obj.burn_threshold
+        assert row["burn_rates"]["10"] < obj.burn_threshold
+        assert row["state"] in ("inactive", "pending")
+        assert not [t for t in slo.snapshot()["transitions"]
+                    if t["what"] == "firing"]
+
+
+# --------------------------------------------------- objectives / feeds --
+
+class TestObjectivesAndFeeds:
+    def test_ratio_objective_shed_rate(self):
+        clk = FakeClock()
+        tracer = Tracer()
+        slo = SLOMonitor(clock=clk, tracer=tracer, resolution_s=1.0)
+        slo.add_objective(Objective.ratio(
+            "shed_rate", bad="shed", total="submitted", target=0.05,
+            windows=(30.0, 10.0), burn_threshold=2.0, for_s=0.0))
+        for _ in range(10):
+            slo.count("submitted", 10)
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "inactive"
+        for _ in range(10):
+            slo.count("submitted", 10)
+            slo.count("shed", 3)                 # 30% shed vs 5% target
+            clk.advance(1.0)
+            slo.evaluate()
+        row = slo.evaluate()[0]
+        assert row["state"] == "firing"
+        assert row["sli"]["rate"] > 0.05
+
+    def test_goodput_floor_via_ledger_pull(self):
+        class StubLedger:
+            goodput = 0.9
+
+            def snapshot(self):
+                return {"goodput": self.goodput}
+
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0)
+        led = StubLedger()
+        slo.attach_ledger(led)
+        slo.add_objective(Objective.floor(
+            "goodput", "goodput", floor=0.5, compliance=0.9,
+            windows=(30.0, 10.0), burn_threshold=1.5, for_s=0.0))
+        for _ in range(15):
+            clk.advance(1.0)
+            slo.evaluate()                       # pulls 0.9 each time
+        assert slo.evaluate()[0]["state"] == "inactive"
+        led.goodput = 0.2                        # collapse below floor
+        for _ in range(15):
+            clk.advance(1.0)
+            slo.evaluate()
+        assert slo.evaluate()[0]["state"] == "firing"
+
+    def test_tracer_forwarding_feeds_samples_and_counts(self):
+        """Tracer.set_slo: retired requests feed ttft_s samples and
+        terminal counts with NO extra instrumentation."""
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0)
+        tr = Tracer()
+        tr.set_slo(slo)
+        tr.request_event(1, "queued", prompt_len=3)
+        tr.request_event(1, "first_token")
+        tr.request_event(1, "token")
+        tr.request_event(1, "token")
+        tr.request_event(1, "retired")
+        assert slo._window_sketch("ttft_s", 60.0, clk.t).n == 1
+        assert slo._window_sketch("itl_s", 60.0, clk.t).n == 1
+        assert slo._window_count("requests_retired", 60.0, clk.t) == 1
+
+    def test_objective_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            Objective("x", "nope", 1.0)
+        with pytest.raises(ValueError, match="sample metric"):
+            Objective("x", "latency", 1.0)
+        with pytest.raises(ValueError, match="counter names"):
+            Objective("x", "ratio", 0.1, bad="b")
+        with pytest.raises(ValueError, match="compliance"):
+            Objective.latency("x", "m", 1.0, compliance=1.0)
+        slo = SLOMonitor(clock=FakeClock())
+        slo.add_objective(Objective.latency("dup", "m", 1.0))
+        with pytest.raises(ValueError, match="already defined"):
+            slo.add_objective(Objective.latency("dup", "m", 1.0))
+
+    def test_empty_window_is_no_evidence(self):
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk)
+        for _ in range(30):
+            clk.advance(1.0)
+            assert slo.evaluate()[0]["state"] == "inactive"
+        assert slo.snapshot()["transitions"] == []
+
+
+# --------------------------------------------------------------- exports --
+
+class TestExports:
+    def test_snapshot_shape(self):
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk)
+        slo.observe("ttft_s", 0.05)
+        snap = slo.snapshot()
+        assert snap["objectives"][0]["name"] == "ttft_p99"
+        assert snap["objectives"][0]["budget"] == pytest.approx(0.01)
+        row = snap["status"][0]
+        assert set(row["burn_rates"]) == {"60", "10"}
+        assert row["sli"]["n"] == 1
+        assert snap["alerts_firing"] == 0
+        import json
+        json.dumps(snap)                         # JSON-able end to end
+
+    def test_prometheus_label_escaping_via_shared_helper(self):
+        r"""Objective names with quotes/backslashes/newlines render
+        escaped — through utils.stats.prom_escape_label, the ONE shared
+        escaping implementation (the consolidation satellite)."""
+        assert prom_escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+        assert prom_sample("m", 1, {"k": 'v"w'}) == 'm{k="v\\"w"} 1'
+        clk = FakeClock()
+        slo = SLOMonitor(clock=clk, resolution_s=1.0)
+        slo.add_objective(Objective.latency('odd"name\\x', "m", 1.0))
+        text = slo.prometheus_text()
+        assert 'objective="odd\\"name\\\\x"' in text
+
+    def test_ops_server_slo_route_and_metrics(self):
+        import json as _json
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        clk = FakeClock()
+        slo, obj = _ttft_monitor(clk)
+        slo.observe("ttft_s", 0.01)
+        srv = OpsServer()
+        srv.attach(slo)
+        url = srv.start()
+        try:
+            payload = _json.loads(urllib.request.urlopen(
+                url + "/slo", timeout=10).read())
+            assert payload["objectives"][0]["name"] == "ttft_p99"
+            text = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            assert "paddle_tpu_slo_burn_rate" in text
+        finally:
+            srv.stop()
+
+    def test_ops_server_slo_404_when_absent(self):
+        import urllib.error
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer()
+        url = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/slo", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()

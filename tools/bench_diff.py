@@ -22,11 +22,21 @@ Accepted file shapes (auto-detected):
   - raw bench.py stdout: one JSON record per line (JSONL)
 
 Direction is inferred from the unit: throughputs (``.../s...``) regress
-when they DROP, latencies (``ms``/``s``) regress when they RISE.
+when they DROP, latencies (``ms``/``s``) regress when they RISE.  A
+record (or synthetic sub-metric) may also carry an explicit
+``direction`` of ``"lower"``/``"higher"`` which wins over the unit rule.
 Records with ``unit`` of ``error``/``skipped`` or a null value are
 classified as non-comparable, never as regressions — an infra-dead round
 must not read as a code regression (and must not hide one either: it
 simply doesn't participate).
+
+Telemetry attachments participate too: when BOTH rounds of a metric
+carry a ``telemetry`` snapshot, its known fields (TTFT/ITL/tick
+percentiles, compile misses, goodput, MFU, model FLOPs/s — see
+``_TELEMETRY_FIELDS``) are expanded into synthetic
+``<metric>.telemetry.<field>`` rows with unit-direction-aware
+thresholds, so a TTFT p99 regression or a goodput/MFU drop is flagged
+even when the headline throughput number held.
 
 Exit codes:
   0  comparable data found, no regression beyond --threshold
@@ -43,6 +53,62 @@ import sys
 
 #: units where a LOWER new value is better (latency-shaped)
 _LOWER_IS_BETTER = ("ms", "s", "seconds")
+
+#: telemetry-snapshot fields worth diffing between rounds: leaf name ->
+#: (synthetic unit, direction).  Anything not listed (counts of ticks,
+#: raw event tallies) is context, not a health signal.
+_TELEMETRY_FIELDS = {
+    "ttft_ms_p50": ("ms", "lower"),
+    "ttft_ms_p99": ("ms", "lower"),
+    "itl_ms_p50": ("ms", "lower"),
+    "itl_ms_p99": ("ms", "lower"),
+    "tick_ms_p50": ("ms", "lower"),
+    "tick_ms_p95": ("ms", "lower"),
+    "step_ms_p50": ("ms", "lower"),
+    "step_ms_p95": ("ms", "lower"),
+    "compile_misses": ("count", "lower"),
+    "compile_wall_s": ("s", "lower"),
+    "goodput": ("frac", "higher"),
+    "mfu": ("frac", "higher"),
+    "model_flops_per_s": ("flops/s", "higher"),
+    "arithmetic_intensity": ("flops/byte", "higher"),
+    "tokens_per_sec": ("tokens/s", "higher"),
+}
+
+
+def _flatten(prefix, obj, out):
+    for k, v in obj.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            _flatten(path, v, out)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out.append((path, k, v))
+
+
+def expand_telemetry(records):
+    """records + synthetic ``<metric>.telemetry.<field>`` rows for every
+    whitelisted telemetry leaf on a comparable record.  Synthetic rows
+    carry their own unit and explicit ``direction`` so the comparison
+    stays direction-aware per field."""
+    out = []
+    for rec in records:
+        out.append(rec)
+        if classify(rec) != "ok":
+            continue
+        tel = rec.get("telemetry")
+        if not isinstance(tel, dict):
+            continue
+        leaves = []
+        _flatten("telemetry", tel, leaves)
+        for path, leaf, value in leaves:
+            spec = _TELEMETRY_FIELDS.get(leaf)
+            if spec is None:
+                continue
+            unit, direction = spec
+            out.append({"metric": f"{rec['metric']}.{path}",
+                        "value": value, "unit": unit,
+                        "direction": direction})
+    return out
 
 
 def classify(record):
@@ -113,6 +179,15 @@ def lower_is_better(unit):
     return (unit or "").strip().lower() in _LOWER_IS_BETTER
 
 
+def record_lower_is_better(rec):
+    """Direction for one record: an explicit ``direction`` key wins,
+    else the unit rule."""
+    d = rec.get("direction")
+    if d in ("lower", "higher"):
+        return d == "lower"
+    return lower_is_better(rec.get("unit"))
+
+
 def compare(old_records, new_records, threshold):
     """Per-metric comparison.  Returns (rows, n_regressions, n_compared);
     each row is a dict with metric/status/old/new/delta_frac."""
@@ -140,7 +215,7 @@ def compare(old_records, new_records, threshold):
         n_cmp += 1
         delta = (nv - ov) / abs(ov)
         row["delta_frac"] = delta
-        worse = -delta if not lower_is_better(new.get("unit")) else delta
+        worse = delta if record_lower_is_better(new) else -delta
         if worse > threshold:
             n_reg += 1
             row["status"] = f"REGRESSION ({worse:+.1%} worse, " \
@@ -214,7 +289,7 @@ def main(argv=None):
         last = {}        # metric -> (round_path, record) previous comparable
         old_sel, new_sel = {}, {}
         for path, records in traj:
-            for rec in records:
+            for rec in expand_telemetry(records):
                 if classify(rec) != "ok":
                     continue
                 metric = rec["metric"]
@@ -239,9 +314,10 @@ def main(argv=None):
     else:
         if len(args.files) != 2:
             ap.error("need exactly two files (or --scan DIR)")
-        rows, n_reg, n_cmp = compare(load_records(args.files[0]),
-                                     load_records(args.files[1]),
-                                     args.threshold)
+        rows, n_reg, n_cmp = compare(
+            expand_telemetry(load_records(args.files[0])),
+            expand_telemetry(load_records(args.files[1])),
+            args.threshold)
 
     if args.json:
         print(json.dumps({"threshold": args.threshold, "compared": n_cmp,

@@ -202,6 +202,74 @@ if ! python tools/serve_gateway.py --help >/dev/null 2>&1; then
     echo "COLLECT SMOKE FAILED: tools/serve_gateway.py --help"
     exit 1
 fi
+# request-tracing + SLO surface: telemetry_slo must import clean, a tiny
+# gateway round trip must serve live /slo + /requests + /request/<id>
+# (one stitched trace, no orphan spans), and the chrome flow-event merge
+# (gateway dump + engine dump through trace_to_chrome's loader) must
+# carry matching s/f flow ids
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'SLOEOF'
+import importlib.util, json, os, tempfile, urllib.request
+from paddle_tpu.telemetry import RequestTraceIndex, TraceContext, Tracer
+from paddle_tpu.telemetry_slo import Objective, PercentileSketch, SLOMonitor
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                num_attention_heads=2, max_position_embeddings=64,
+                compute_dtype="float32")
+model = GPTModel(cfg)
+params = {n: p._data for n, p in model.named_parameters()}
+def eng():
+    return RaggedPagedContinuousBatchingEngine(
+        model, params, max_slots=2, max_len=32, block_size=8,
+        prompt_buckets=[8], token_budget=12, tracer=Tracer())
+slo = SLOMonitor()
+slo.add_objective(Objective.latency("ttft_p99", "ttft_s", 0.5))
+gw = ServingGateway(tracer=Tracer())
+gw.set_slo(slo)
+gw.add_replica(eng(), "a")
+gw.add_replica(eng(), "b")
+r = gw.submit([1, 2, 3], 3)
+gw.run_to_completion(max_ticks=200)
+assert r.status == "finished" and r.trace is not None
+srv = OpsServer()
+srv.attach(gw); srv.attach(gw.replica("a").engine)
+srv.attach(gw.replica("b").engine); srv.attach(slo)
+url = srv.start()
+snap = json.loads(urllib.request.urlopen(url + "/slo", timeout=10).read())
+assert snap["objectives"][0]["name"] == "ttft_p99"
+recents = json.loads(urllib.request.urlopen(
+    url + "/requests", timeout=10).read())["requests"]
+assert any(x["trace_id"] == r.trace.trace_id for x in recents)
+one = json.loads(urllib.request.urlopen(
+    url + f"/request/{r.trace.trace_id}", timeout=10).read())
+ids = {s["span_id"] for s in one["spans"]}
+assert all(s["parent_span_id"] in ids for s in one["spans"]
+           if s["parent_span_id"] is not None), one["spans"]
+assert sum(1 for s in one["spans"] if s["parent_span_id"] is None) == 1
+srv.stop()
+# flow-event chrome merge: gateway + engine dumps through the CLI loader
+d = tempfile.mkdtemp()
+gp, ep = os.path.join(d, "gw.jsonl"), os.path.join(d, "eng.jsonl")
+gw.tracer.dump_jsonl(gp)
+gw.replica(r.replica).engine.tracer.dump_jsonl(ep)
+spec = importlib.util.spec_from_file_location(
+    "_t2c_slo_smoke", "tools/trace_to_chrome.py")
+t2c = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(t2c)
+merged = []
+for i, p in enumerate((gp, ep)):
+    merged.extend(t2c._suffix_pids(
+        t2c._load_engine_trace(p), i)["traceEvents"])
+starts = {e["id"] for e in merged if e.get("ph") == "s"}
+finishes = {e["id"] for e in merged if e.get("ph") == "f"}
+assert starts and starts & finishes, (starts, finishes)
+SLOEOF
+then
+    echo "COLLECT SMOKE FAILED: request-tracing / SLO round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

@@ -4,17 +4,23 @@ tools/timeline.py, which converts profiler protos for chrome://tracing).
 Usage:
   python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json
   python tools/trace_to_chrome.py /tmp/profile_dir -o trace.json \
-      --engine-trace serve_telemetry.jsonl --ledger goodput.json
+      --engine-trace gateway.jsonl --engine-trace replica_a.jsonl \
+      --engine-trace replica_b.jsonl --ledger goodput.json
 
 The input is a directory written by ``paddle_tpu.profiler`` /
 ``jax.profiler.trace`` (contains ``**/*.xplane.pb``).  ``--engine-trace``
-merges a serving-telemetry dump (``Tracer.dump_jsonl`` JSONL or
-``Tracer.write_chrome_trace`` JSON) into the same output, so scheduler
-ticks / request spans and XPlane device traces land in ONE file.
-``--ledger`` merges a goodput-ledger dump (``RunLedger.dump_json``) as a
-stacked counter track — cumulative seconds per wall-clock bucket next to
-the event rows.  Open the output in chrome://tracing or
-https://ui.perfetto.dev.
+(repeatable) merges serving-telemetry dumps (``Tracer.dump_jsonl`` JSONL
+or ``Tracer.write_chrome_trace`` JSON) into the same output, so
+scheduler ticks / request spans and XPlane device traces land in ONE
+file; with more than one dump each gets its own chrome process row
+(``paddle_tpu.serving#<i>`` — replica rids collide otherwise), and the
+gateway↔engine flow arrows (``ph: "s"``/``"f"`` events keyed by the
+dispatch span id, carrying the request's trace_id) still link rows
+ACROSS files, so a request's journey through the fleet draws as arrows
+in Perfetto.  ``--ledger`` merges a goodput-ledger dump
+(``RunLedger.dump_json``) as a stacked counter track — cumulative
+seconds per wall-clock bucket next to the event rows.  Open the output
+in chrome://tracing or https://ui.perfetto.dev.
 """
 
 import argparse
@@ -65,13 +71,31 @@ def _merge(device_payload, engine):
     return json.dumps(data)
 
 
+def _suffix_pids(trace, suffix):
+    """Disambiguate one dump's chrome process ids (``pid#suffix``) so N
+    merged replica dumps land on N separate rows — their per-request
+    ``tid`` values (``req:<rid>``) collide otherwise.  Flow events keep
+    their ids untouched: flows link by id, not pid, which is exactly how
+    gateway→engine arrows survive the merge."""
+    for ev in trace.get("traceEvents", []):
+        if "pid" in ev:
+            ev["pid"] = f"{ev['pid']}#{suffix}"
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                ev.setdefault("args", {})["name"] = ev["pid"]
+    return trace
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("logdir", help="profiler output dir (contains *.xplane.pb)")
     ap.add_argument("-o", "--output", default="trace.json")
-    ap.add_argument("--engine-trace", default=None,
+    ap.add_argument("--engine-trace", action="append", default=None,
+                    metavar="DUMP",
                     help="serving-telemetry dump (Tracer.dump_jsonl JSONL "
-                         "or chrome JSON) to merge into the output")
+                         "or chrome JSON) to merge into the output; "
+                         "repeatable — multiple dumps (gateway + N "
+                         "replicas) each get their own process row, with "
+                         "trace-id flow events linking them")
     ap.add_argument("--ledger", default=None,
                     help="goodput-ledger dump (RunLedger.dump_json) to "
                          "merge as a stacked counter track")
@@ -95,10 +119,14 @@ def main(argv=None):
 
     data, _mime = rtd.xspace_to_tool_data(paths, "trace_viewer", {})
     payload = data if isinstance(data, (str, bytes)) else str(data)
-    if args.engine_trace is not None:
+    engine_traces = args.engine_trace or []
+    for i, path in enumerate(engine_traces):
         if isinstance(payload, bytes):
             payload = payload.decode("utf-8")
-        payload = _merge(payload, _load_engine_trace(args.engine_trace))
+        trace = _load_engine_trace(path)
+        if len(engine_traces) > 1:
+            trace = _suffix_pids(trace, i)
+        payload = _merge(payload, trace)
     if args.ledger is not None:
         if isinstance(payload, bytes):
             payload = payload.decode("utf-8")
