@@ -1,0 +1,686 @@
+"""Elastic autoscaler: closed-loop SLO-driven scaling of the gateway fleet.
+
+Every piece of the loop already exists and nothing connects them: the SLO
+engine (PR 10) judges the service and fires TTFT/shed-rate burn alerts
+into a log, the gateway (PR 8) can ``drain()`` a replica with zero drops,
+and AOT warmup + the persistent executable cache (PR 6) make a fresh
+replica cheap to spin up.  :class:`ElasticAutoscaler` is the controller
+that closes the loop — the serving-side analogue of PaddlePaddle's
+elastic fleet training, where replicas join and leave a running job
+without operator babysitting.
+
+**Signals** (watch side):
+
+- *scale-up*: SLO alert transitions, consumed through the
+  ``SLOMonitor.subscribe`` push feed — an objective (TTFT p99, shed
+  rate, any objective the monitor carries) entering ``firing`` marks the
+  fleet under-provisioned; ``resolved``/``cancelled`` clears it.  The
+  autoscaler drives ``slo.evaluate()`` each round, so the alert state
+  machine advances on the controller's (injectable) clock.
+- *scale-down*: sustained low utilization.  Utilization is the fleet's
+  outstanding-work occupancy — (in-flight requests + queued requests)
+  over total engine slots across ACTIVE replicas — optionally
+  cross-checked against a ``telemetry_ledger.RunLedger`` goodput gauge.
+
+**Policy** (decide side) — production-shaped, every knob explicit:
+
+- ``min_replicas`` / ``max_replicas`` fleet bounds.  The min bound is
+  enforced eagerly: a quarantined/dead replica that leaves the active
+  fleet short is replaced immediately, cooldowns notwithstanding.
+- one replica per decision (the step limit — no thundering spawns).
+- per-direction cooldowns (``scale_up_cooldown_s`` /
+  ``scale_down_cooldown_s``); a scale-up also re-arms the scale-down
+  cooldown (never tear down what was just added).  A FAILED spawn
+  (broken factory, failed activation) arms the scale-up cooldown as a
+  retry backoff — even on the otherwise cooldown-exempt min-bound path —
+  so a persistently broken factory is retried once per cooldown window,
+  not once per ``evaluate()`` round.
+- quarantined replicas are reaped (``reap_quarantined=True``): the
+  gateway never auto-reinstates a replica it benched, so in an
+  autoscaler-managed fleet the benched shell is drained (it holds no
+  in-flight work — quarantine already rerouted it) and removed, while
+  the min-bound check back-fills the lost capacity.  Set
+  ``reap_quarantined=False`` to keep shells registered for operator
+  ``reinstate()``.
+- scale-down hysteresis matching the SLO engine's dwell semantics:
+  occupancy must stay below ``idle_utilization`` for ``idle_dwell_s``
+  before a drain, and once the dwell is running only a clear bounce
+  ABOVE ``idle_utilization * idle_resume_ratio`` resets it — occupancy
+  hovering exactly at the threshold cannot flap decisions (pinned by
+  test, the same resolve-band discipline ``telemetry_slo`` uses).
+
+**Actuation** (act side), through existing primitives only:
+
+- scale-up: build an engine from the registered factory
+  (``ElasticAutoscaler(factory=...)`` or
+  ``gateway.register_replica_factory``), AOT-warm it from the persistent
+  executable cache (``engine.warmup(cache_dir=...)``, PR 6), and only
+  when warm ``gateway.add_replica()`` it.  Warmup may be synchronous
+  (default — the report comes back immediately) or a background future
+  (``warm_async=True``); a pending spawn is activated by a later
+  ``evaluate()`` once its future resolves.  Engines that cannot warm
+  (TP/mesh engines raise ``NotImplementedError``) are activated unwarmed.
+- every spawned replica's warmup grid is registered on its tracer via a
+  held-open ``Tracer.expected_compiles(keys=engine.compile_grid())``
+  window, so the PR 2 recompile-storm warning ignores expected
+  first-dispatch misses on a freshly activated replica (the window is
+  keyed to the grid — a real storm of off-grid misses still arms it);
+  the window closes when the replica is drained or the autoscaler is
+  ``close()``d.
+- scale-down: pick the least-loaded ACTIVE replica and
+  ``gateway.drain()`` it with no replacement — zero drops by the drain
+  contract — then ``gateway.remove_replica()`` the stopped shell.
+
+**Observability**: every decision is emitted as a tracer ``autoscale``
+event and kept in a bounded decision history; ``prometheus_text()``
+exports fleet-size / pending-spawn / last-decision gauges and per-action
+counters; ``autoscaler_snapshot()`` is the ``GET /autoscaler`` ops view
+(``ops_server.OpsServer.attach(autoscaler)``).
+
+The clock is injectable, so whole scale-up/scale-down trajectories run
+deterministically on the fake-clock simulation harness
+(``paddle_tpu.simulation``) — see docs/AUTOSCALING.md.
+
+Typical use::
+
+    slo = SLOMonitor([Objective.latency("ttft_p99", "ttft_s", 0.5),
+                      Objective.ratio("shed_rate", "shed", "submitted",
+                                      0.05)])
+    gw.set_slo(slo)
+    asc = ElasticAutoscaler(gw, factory, slo=slo, min_replicas=1,
+                            max_replicas=8, cache_dir="/var/cache/xla")
+    while serving:
+        gw.step()
+        asc.evaluate()          # one control round per serving round
+
+No reference counterpart: the reference snapshot has no service layer;
+this composes the PR 6/8/10 primitives into the control plane the
+ROADMAP's elastic-fleet item names.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .utils.stats import StatRegistry, prometheus_text as _prometheus_text
+
+__all__ = ["ElasticAutoscaler", "DECISIONS"]
+
+#: decision vocabulary, in gauge-encoding order (0 = none yet)
+DECISIONS = ("none", "scale_up", "activate", "scale_down", "removed",
+             "spawn_failed", "reap")
+
+
+class _PendingSpawn:
+    """One spawned-but-not-yet-active replica: the engine, its warmup
+    future (None when warmup completed synchronously or was skipped), and
+    the decision metadata the activation event echoes."""
+
+    __slots__ = ("engine", "name", "future", "report", "warmed",
+                 "started_at", "reason")
+
+    def __init__(self, engine, name, future, report, warmed, started_at,
+                 reason):
+        self.engine = engine
+        self.name = name
+        self.future = future
+        self.report = report
+        self.warmed = warmed
+        self.started_at = started_at
+        self.reason = reason
+
+    def ready(self) -> bool:
+        return self.future is None or self.future.done()
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "engine": type(self.engine).__name__,
+                "warmed": self.warmed, "started_at": self.started_at,
+                "reason": self.reason,
+                "pending_future": self.future is not None
+                and not self.future.done()}
+
+
+def _engine_slots(engine) -> int:
+    """Slot capacity of one engine — the serving engines expose ``S``
+    (max_slots); anything else counts as one slot."""
+    for attr in ("S", "max_slots"):
+        v = getattr(engine, attr, None)
+        if isinstance(v, int) and v > 0:
+            return v
+    return 1
+
+
+class ElasticAutoscaler:
+    """Closed-loop SLO-driven fleet scaling (module docstring).
+
+    ``gateway``: the :class:`~paddle_tpu.gateway.ServingGateway` to scale.
+    ``factory``: zero-arg engine factory; falls back to the gateway's
+    ``register_replica_factory`` registration.  ``slo``: the
+    :class:`~paddle_tpu.telemetry_slo.SLOMonitor` whose firing objectives
+    drive scale-up (``objectives=`` restricts to a subset of names; None
+    watches all).  ``ledger``: optional
+    :class:`~paddle_tpu.telemetry_ledger.RunLedger` whose goodput gauge
+    rides along in the utilization signal.  ``cache_dir``: the PR 6
+    persistent executable cache new replicas warm from.  ``clock``:
+    injectable monotonic-seconds callable — the whole policy is
+    deterministic under a fake clock."""
+
+    def __init__(self, gateway, factory: Optional[Callable[[], Any]] = None,
+                 *, slo=None, ledger=None, objectives=None,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 scale_up_cooldown_s: float = 30.0,
+                 scale_down_cooldown_s: float = 120.0,
+                 idle_utilization: float = 0.15,
+                 idle_dwell_s: float = 60.0,
+                 idle_resume_ratio: float = 1.5,
+                 cache_dir: Optional[str] = None,
+                 warm_async: bool = False,
+                 reap_quarantined: bool = True,
+                 tracer=None, clock: Callable[[], float] = time.monotonic,
+                 decision_history: int = 256, name_prefix: str = "as",
+                 logger: Optional[logging.Logger] = None):
+        if int(min_replicas) < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if int(max_replicas) < int(min_replicas):
+            raise ValueError("max_replicas must be >= min_replicas")
+        if not 0.0 < float(idle_utilization) < 1.0:
+            raise ValueError("idle_utilization must be in (0, 1)")
+        if float(idle_resume_ratio) < 1.0:
+            raise ValueError("idle_resume_ratio must be >= 1.0 (the "
+                             "hysteresis band sits ABOVE the threshold)")
+        self.gateway = gateway
+        self._factory = factory
+        self.slo = slo
+        self.ledger = ledger
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.scale_up_cooldown_s = float(scale_up_cooldown_s)
+        self.scale_down_cooldown_s = float(scale_down_cooldown_s)
+        self.idle_utilization = float(idle_utilization)
+        self.idle_dwell_s = float(idle_dwell_s)
+        self.idle_resume_ratio = float(idle_resume_ratio)
+        self.cache_dir = cache_dir
+        self.warm_async = bool(warm_async)
+        self.reap_quarantined = bool(reap_quarantined)
+        self.tracer = tracer
+        self._clock = clock
+        self.name_prefix = str(name_prefix)
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._watched = (None if objectives is None
+                         else frozenset(str(n) for n in objectives))
+        # _firing is mutated from SLO subscriber callbacks, which run on
+        # whatever thread drives slo.evaluate() — including ops-server
+        # HTTP scrape threads when the monitor is attached there — so
+        # every access goes through _firing_lock
+        self._firing_lock = threading.Lock()
+        self._firing: set = set()
+        self._pending: List[_PendingSpawn] = []
+        self._draining: List[str] = []     # names this controller drained
+        self._spawn_seq = 0
+        self._last_up_at: Optional[float] = None
+        self._last_down_at: Optional[float] = None
+        self._last_spawn_failure_at: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_decision = "none"
+        self._last_decision_at: Optional[float] = None
+        self._decisions: collections.deque = collections.deque(
+            maxlen=int(decision_history))
+        # held-open expected-compile windows, keyed by replica name: the
+        # entered context managers are exited on drain/close
+        self._expected_windows: Dict[str, Any] = {}
+        self._stats = StatRegistry()
+        self._closed = False
+        if slo is not None:
+            slo.subscribe(self._on_slo_transition)
+            # seed from the monitor's current states: an autoscaler
+            # attached mid-incident must see the already-firing alert
+            seeded = {name for name, state in slo.alert_states().items()
+                      if state == "firing" and self._watches(name)}
+            with self._firing_lock:
+                self._firing |= seeded
+
+    # ----------------------------------------------------------- signals --
+
+    def _watches(self, objective_name: str) -> bool:
+        return self._watched is None or objective_name in self._watched
+
+    def _on_slo_transition(self, ev: Dict[str, Any]):
+        """``SLOMonitor.subscribe`` callback — runs under the monitor's
+        evaluation lock, so it only updates local state (never calls back
+        into the monitor)."""
+        name = ev.get("objective")
+        if name is None or not self._watches(name):
+            return
+        what = ev.get("what")
+        with self._firing_lock:
+            if what == "firing":
+                self._firing.add(name)
+            elif what in ("resolved", "cancelled"):
+                self._firing.discard(name)
+
+    def firing(self) -> List[str]:
+        """Objective names currently firing (the scale-up signal)."""
+        with self._firing_lock:
+            return sorted(self._firing)
+
+    def utilization(self) -> Dict[str, Any]:
+        """The scale-down signal: fleet occupancy — (in-flight + queued)
+        requests over total ACTIVE engine slots — plus the raw terms and,
+        when a ledger is attached, its goodput gauge."""
+        active = [rep for rep in self.gateway.replicas()
+                  if rep.state == "active"]
+        slots = sum(_engine_slots(rep.engine) for rep in active)
+        busy = sum(len(rep.inflight) for rep in active)
+        queued = sum(d["depth"]
+                     for d in self.gateway.queue_depths().values())
+        outstanding = sum(rep.outstanding_tokens() for rep in active)
+        goodput = None
+        if self.ledger is not None:
+            try:
+                goodput = float(self.ledger.snapshot()["goodput"])
+            except Exception as e:  # noqa: BLE001 — a broken pull source
+                # must not take the controller down
+                self._log.debug("autoscaler: ledger pull failed: %r", e)
+        return {"occupancy": (busy + queued) / max(slots, 1),
+                "busy_slots": busy, "total_slots": slots,
+                "queued": queued, "outstanding_tokens": outstanding,
+                "goodput": goodput}
+
+    # ------------------------------------------------------------- fleet --
+
+    def _fleet(self):
+        reps = self.gateway.replicas()
+        active = [r for r in reps if r.state == "active"]
+        draining = [r for r in reps if r.state == "draining"]
+        return active, draining
+
+    def fleet_size(self) -> int:
+        """Replicas that hold (or will hold) serving capacity: active +
+        draining + pending spawns — what the max bound is checked
+        against."""
+        active, draining = self._fleet()
+        return len(active) + len(draining) + len(self._pending)
+
+    # ---------------------------------------------------------- evaluate --
+
+    def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One control round: advance the SLO state machine, activate any
+        warm pending spawns, garbage-collect completed drains, then make
+        at most ONE scale decision (the step limit).  Returns the
+        decisions recorded this round (possibly empty).  Deterministic
+        for an injected clock; safe to call every gateway round."""
+        if self._closed:
+            return []
+        now = self._clock() if now is None else float(now)
+        made: List[Dict[str, Any]] = []
+        if self.slo is not None:
+            # drives transitions → the subscription updates self._firing
+            self.slo.evaluate(now)
+        made.extend(self._activate_ready(now))
+        made.extend(self._reap_quarantined(now))
+        made.extend(self._reap_drained(now))
+        decision = self._decide(now)
+        if decision is not None:
+            made.append(decision)
+        return made
+
+    def _decide(self, now: float) -> Optional[Dict[str, Any]]:
+        active, draining = self._fleet()
+        util = self.utilization()
+        firing = self.firing()
+        # min-bound enforcement first, cooldown-exempt: a quarantined or
+        # dead replica that left the fleet short is replaced NOW (only
+        # the spawn-FAILURE backoff gates it — a persistently broken
+        # factory must not be retried every round)
+        if len(active) + len(self._pending) < self.min_replicas:
+            if self._spawn_backoff(now):
+                return None
+            return self._spawn(now, reason="min_bound", firing=firing,
+                               utilization=util)
+        if firing:
+            self._idle_since = None          # under-provisioned ≠ idle
+            in_up_cooldown = (
+                self._last_up_at is not None
+                and now - self._last_up_at < self.scale_up_cooldown_s)
+            if self.fleet_size() < self.max_replicas \
+                    and not in_up_cooldown and not self._spawn_backoff(now):
+                return self._spawn(now, reason="slo:" + ",".join(firing),
+                                   firing=firing, utilization=util)
+            return None
+        self._track_idle(now, util["occupancy"])
+        if self._scale_down_ok(now, active):
+            return self._drain_one(now, active, utilization=util)
+        return None
+
+    def _spawn_backoff(self, now: float) -> bool:
+        """True while the spawn-failure retry backoff is running: a
+        failed spawn (broken factory, failed activation) re-arms it for
+        one ``scale_up_cooldown_s`` window, bounding retries of a
+        persistently broken factory to one per window instead of one per
+        ``evaluate()`` round (which would flood the log and churn the
+        decision history/tracer ring with identical failures)."""
+        return (self._last_spawn_failure_at is not None
+                and now - self._last_spawn_failure_at
+                < self.scale_up_cooldown_s)
+
+    def _track_idle(self, now: float, occupancy: float):
+        """The idle-dwell state machine (hysteresis, module docstring):
+        dwell starts below ``idle_utilization`` and only a bounce above
+        ``idle_utilization * idle_resume_ratio`` cancels it."""
+        if occupancy < self.idle_utilization:
+            if self._idle_since is None:
+                self._idle_since = now
+        elif occupancy >= self.idle_utilization * self.idle_resume_ratio:
+            self._idle_since = None
+
+    def _scale_down_ok(self, now: float, active) -> bool:
+        if len(active) <= self.min_replicas:
+            return False
+        if self._idle_since is None \
+                or now - self._idle_since < self.idle_dwell_s:
+            return False
+        for stamp, cool in ((self._last_down_at,
+                             self.scale_down_cooldown_s),
+                            (self._last_up_at,
+                             self.scale_down_cooldown_s)):
+            # a recent scale-up also blocks scale-down: never tear down
+            # what was just added
+            if stamp is not None and now - stamp < cool:
+                return False
+        return True
+
+    # ----------------------------------------------------------- actuate --
+
+    def _spawn(self, now: float, reason: str, firing, utilization
+               ) -> Dict[str, Any]:
+        factory = self._factory
+        if factory is None:
+            factory = getattr(self.gateway, "replica_factory", None)
+        if factory is None:
+            self._stats.add("spawn_failures")
+            self._last_spawn_failure_at = now
+            return self._record(now, "spawn_failed", reason=reason,
+                                error="no engine factory registered")
+        name = f"{self.name_prefix}{self._spawn_seq}"
+        self._spawn_seq += 1
+        try:
+            engine = factory()
+        except Exception as e:  # noqa: BLE001 — a broken factory must not
+            # take the control loop down; the failure is a recorded
+            # decision the operator sees
+            self._log.exception("autoscaler: engine factory failed")
+            self._stats.add("spawn_failures")
+            self._last_spawn_failure_at = now
+            return self._record(now, "spawn_failed", reason=reason,
+                                error=repr(e))
+        future = report = None
+        warmed = False
+        try:
+            res = engine.warmup(cache_dir=self.cache_dir,
+                                block=not self.warm_async)
+            if hasattr(res, "done") and hasattr(res, "result"):
+                future = res
+            else:
+                report = res
+            warmed = True
+        except NotImplementedError as e:
+            # TP/mesh engines compile on first dispatch (serving.py); the
+            # replica still joins — its grid window (opened at activation)
+            # keeps the storm warning honest about first-dispatch misses
+            self._log.debug("autoscaler: warmup unsupported for %s: %r",
+                            type(engine).__name__, e)
+        except Exception as e:  # noqa: BLE001 — warmup is best-effort:
+            # an unwarmed replica is strictly better than no replica
+            self._log.warning("autoscaler: warmup failed for %s: %r",
+                              name, e)
+        self._pending.append(_PendingSpawn(engine, name, future, report,
+                                           warmed, now, reason))
+        self._last_up_at = now
+        self._stats.add("scale_ups")
+        return self._record(
+            now, "scale_up", replica=name, reason=reason,
+            warmed=warmed, pending=future is not None,
+            firing=list(firing), occupancy=utilization["occupancy"])
+
+    def _activate_ready(self, now: float) -> List[Dict[str, Any]]:
+        made = []
+        for spawn in list(self._pending):
+            if not spawn.ready():
+                continue
+            self._pending.remove(spawn)
+            if spawn.future is not None:
+                try:
+                    spawn.report = spawn.future.result()
+                except Exception as e:  # noqa: BLE001 — a failed async
+                    # warmup downgrades to unwarmed activation, same as
+                    # the synchronous path
+                    self._log.warning("autoscaler: async warmup failed "
+                                      "for %s: %r", spawn.name, e)
+                    spawn.warmed = False
+            try:
+                name = self.gateway.add_replica(spawn.engine, spawn.name)
+            except (TypeError, ValueError) as e:
+                self._log.exception("autoscaler: activation failed for %s",
+                                    spawn.name)
+                self._stats.add("spawn_failures")
+                self._last_spawn_failure_at = now
+                made.append(self._record(now, "spawn_failed",
+                                         replica=spawn.name,
+                                         error=repr(e)))
+                continue
+            self._open_expected_window(name, spawn.engine)
+            self._stats.add("activations")
+            made.append(self._record(
+                now, "activate", replica=name, reason=spawn.reason,
+                warmed=spawn.warmed,
+                warm_programs=(spawn.report or {}).get("programs")
+                if isinstance(spawn.report, dict) else None,
+                spawn_wait_s=now - spawn.started_at))
+        return made
+
+    def _drain_one(self, now: float, active, utilization) -> Dict[str, Any]:
+        victim = min(active, key=lambda rep: (rep.outstanding_tokens(),
+                                              len(rep.inflight), rep.name))
+        self.gateway.drain(victim.name)       # no replacement: fleet shrinks
+        self._draining.append(victim.name)
+        self._last_down_at = now
+        self._idle_since = None               # dwell restarts after acting
+        self._stats.add("scale_downs")
+        return self._record(
+            now, "scale_down", replica=victim.name, reason="idle",
+            occupancy=utilization["occupancy"],
+            inflight=len(victim.inflight))
+
+    def _reap_quarantined(self, now: float) -> List[Dict[str, Any]]:
+        """Retire quarantined shells (module docstring): the gateway
+        never auto-reinstates a replica it benched, and a long-lived
+        elastic fleet must not accumulate one dead entry per death — so
+        each quarantined replica is sent through the zero-drop ``drain``
+        path (it holds no in-flight work; quarantine already rerouted
+        it) and removed by ``_reap_drained`` once stopped, while the
+        min-bound check back-fills the capacity.  Disabled with
+        ``reap_quarantined=False`` (operator wants ``reinstate()``)."""
+        if not self.reap_quarantined:
+            return []
+        made = []
+        for rep in self.gateway.replicas():
+            if rep.state != "quarantined" or rep.name in self._draining:
+                continue
+            self.gateway.drain(rep.name)       # no replacement: min-bound
+            self._draining.append(rep.name)    # spawns the back-fill
+            self._stats.add("reaps")
+            made.append(self._record(now, "reap", replica=rep.name,
+                                     reason=rep.reason or "quarantined"))
+        return made
+
+    def _reap_drained(self, now: float) -> List[Dict[str, Any]]:
+        made = []
+        still = []
+        for name in self._draining:
+            try:
+                drained = self.gateway.is_drained(name)
+            except KeyError:
+                # already removed (operator raced us): nothing to reap
+                self._close_expected_window(name)
+                continue
+            if not drained:
+                still.append(name)
+                continue
+            self._close_expected_window(name)
+            try:
+                self.gateway.remove_replica(name)
+            except (KeyError, ValueError) as e:
+                self._log.debug("autoscaler: remove_replica(%s): %r",
+                                name, e)
+            self._stats.add("removals")
+            made.append(self._record(now, "removed", replica=name))
+        self._draining = still
+        return made
+
+    # ----------------------------------------- expected-compile windows --
+
+    def _open_expected_window(self, name: str, engine):
+        """Register the replica's warmup grid on its tracer via a
+        held-open ``expected_compiles`` window (module docstring): the
+        recompile-storm warning ignores the grid's first-dispatch misses
+        on this freshly activated replica.  Safe to hold open — a grid
+        label can only miss once per program cache, so the window never
+        masks a real storm (off-grid misses still count)."""
+        tracer = getattr(engine, "tracer", None)
+        if tracer is None or not hasattr(tracer, "expected_compiles"):
+            return
+        try:
+            keys = set(engine.compile_grid())
+        except (AttributeError, NotImplementedError, ValueError) as e:
+            self._log.debug("autoscaler: no compile grid for %s: %r",
+                            name, e)
+            return
+        if not keys:
+            return
+        ctx = tracer.expected_compiles(keys=keys)
+        ctx.__enter__()
+        self._expected_windows[name] = ctx
+
+    def _close_expected_window(self, name: str):
+        ctx = self._expected_windows.pop(name, None)
+        if ctx is not None:
+            try:
+                ctx.__exit__(None, None, None)
+            except Exception as e:  # noqa: BLE001 — window teardown is
+                # best-effort; a broken tracer must not stop the reap
+                self._log.debug("autoscaler: expected window close "
+                                "failed for %s: %r", name, e)
+
+    def close(self):
+        """Detach from the SLO monitor and close every held-open
+        expected-compile window; further ``evaluate()`` calls are
+        no-ops.  Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.slo is not None:
+            self.slo.unsubscribe(self._on_slo_transition)
+        for name in list(self._expected_windows):
+            self._close_expected_window(name)
+
+    # ------------------------------------------------------ observability --
+
+    def _record(self, now: float, action: str, **fields) -> Dict[str, Any]:
+        active, draining = self._fleet()
+        ev = {"ts": now, "action": action,
+              "fleet_active": len(active),
+              "fleet_draining": len(draining),
+              "pending_spawns": len(self._pending)}
+        ev.update({k: v for k, v in fields.items() if v is not None})
+        self._decisions.append(ev)
+        self._last_decision = action
+        self._last_decision_at = now
+        if self.tracer is not None:
+            self.tracer.emit("autoscale", what=action, at=now,
+                             **{k: v for k, v in ev.items()
+                                if k not in ("ts", "action")})
+        log = (self._log.info if action in ("scale_up", "activate",
+                                            "scale_down", "removed")
+               else self._log.warning)
+        log("autoscale %s: %s (fleet %d active / %d draining / %d "
+            "pending)", action, fields.get("reason", fields.get(
+                "error", "")), ev["fleet_active"], ev["fleet_draining"],
+            ev["pending_spawns"])
+        return ev
+
+    def decisions(self) -> List[Dict[str, Any]]:
+        """The bounded decision history, oldest first."""
+        return list(self._decisions)
+
+    def autoscaler_snapshot(self) -> Dict[str, Any]:
+        """JSON-able live view — what ``ops_server``'s ``/autoscaler``
+        route serves: policy knobs, fleet state, live signals, pending
+        spawns, cooldown/dwell clocks, and the decision history."""
+        now = self._clock()
+        active, draining = self._fleet()
+        return {
+            "now": now,
+            "policy": {
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                "scale_up_cooldown_s": self.scale_up_cooldown_s,
+                "scale_down_cooldown_s": self.scale_down_cooldown_s,
+                "idle_utilization": self.idle_utilization,
+                "idle_dwell_s": self.idle_dwell_s,
+                "idle_resume_ratio": self.idle_resume_ratio,
+                "warm_async": self.warm_async,
+                "reap_quarantined": self.reap_quarantined,
+                "cache_dir": self.cache_dir,
+                "objectives": (None if self._watched is None
+                               else sorted(self._watched)),
+            },
+            "fleet": {"active": len(active), "draining": len(draining),
+                      "pending_spawns": len(self._pending),
+                      "replicas": [rep.to_dict()
+                                   for rep in active + draining]},
+            "pending": [s.to_dict() for s in self._pending],
+            "signals": {"firing": self.firing(),
+                        "utilization": self.utilization(),
+                        "idle_since": self._idle_since,
+                        "idle_for_s": (None if self._idle_since is None
+                                       else now - self._idle_since)},
+            "cooldowns": {
+                "last_scale_up_at": self._last_up_at,
+                "last_scale_down_at": self._last_down_at,
+                "last_spawn_failure_at": self._last_spawn_failure_at},
+            "last_decision": self._last_decision,
+            "last_decision_at": self._last_decision_at,
+            "counters": dict(self._stats.snapshot()),
+            "decisions": self.decisions(),
+            "closed": self._closed,
+        }
+
+    def metrics(self) -> Dict[str, float]:
+        active, draining = self._fleet()
+        out = dict(self._stats.snapshot())
+        out["fleet_active"] = float(len(active))
+        out["fleet_draining"] = float(len(draining))
+        out["pending_spawns"] = float(len(self._pending))
+        out["alerts_firing"] = float(len(self._firing))
+        return out
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_autoscaler"
+                        ) -> str:
+        active, draining = self._fleet()
+        return _prometheus_text(
+            self._stats, namespace=namespace,
+            extra_gauges={
+                "fleet_size": len(active),
+                "fleet_draining": len(draining),
+                "pending_spawns": len(self._pending),
+                "alerts_firing": len(self._firing),
+                "min_replicas": self.min_replicas,
+                "max_replicas": self.max_replicas,
+                # enum gauge: index into DECISIONS (0 = no decision yet)
+                "last_decision": DECISIONS.index(self._last_decision)
+                if self._last_decision in DECISIONS else 0})

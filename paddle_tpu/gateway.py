@@ -306,6 +306,9 @@ class ServingGateway:
         # TTFT samples and terminal counts forward into its windowed
         # stores behind one attribute check
         self._slo = None
+        # optional engine factory (autoscaler scale-out spawns from it);
+        # registered via register_replica_factory
+        self._replica_factory: Optional[Callable[[], Any]] = None
         self._requests: Dict[int, GatewayRequest] = {}
         self._terminal_order: collections.deque = collections.deque()
         self._finished: Dict[int, List[int]] = {}
@@ -338,11 +341,51 @@ class ServingGateway:
         self._stats.add("replicas_added")
         return name
 
+    def remove_replica(self, name: str) -> Replica:
+        """Deregister a STOPPED replica — the final step of an elastic
+        scale-down (``drain`` without replacement leaves the stopped
+        shell registered so ``is_drained`` stays answerable; a long-lived
+        elastic fleet must not accumulate one dead entry per drain).
+        Only stopped replicas may be removed: draining ones still hold
+        work, and removing an active one would drop its in-flight
+        bookkeeping."""
+        rep = self.replica(name)
+        if rep.state != STOPPED:
+            raise ValueError(f"replica {name!r} is {rep.state}; only "
+                             f"stopped replicas can be removed (drain it "
+                             f"first)")
+        del self._replicas[name]
+        self._stats.add("replicas_removed")
+        self._emit("removed", replica=name)
+        return rep
+
+    def register_replica_factory(self, factory: Optional[Callable[[], Any]]
+                                 ) -> Optional[Callable[[], Any]]:
+        """Register (or with None clear) the engine factory that elastic
+        scale-out spawns replicas from — a zero-arg callable returning a
+        FRESH engine (any of the five serving classes).  The gateway never
+        calls it itself; ``autoscaler.ElasticAutoscaler`` does, then warms
+        and ``add_replica``s the result."""
+        if factory is not None and not callable(factory):
+            raise TypeError(f"replica factory must be callable, got "
+                            f"{factory!r}")
+        self._replica_factory = factory
+        return factory
+
+    @property
+    def replica_factory(self) -> Optional[Callable[[], Any]]:
+        return self._replica_factory
+
     def replica(self, name: str) -> Replica:
         rep = self._replicas.get(name)
         if rep is None:
             raise KeyError(f"unknown replica {name!r}")
         return rep
+
+    def replicas(self) -> List[Replica]:
+        """Every registered replica (all lifecycle states) — the public
+        fleet enumeration the autoscaler and ops views read."""
+        return list(self._replicas.values())
 
     def replica_tracers(self) -> List[Tuple[str, Any]]:
         """(name, tracer) for every CURRENT replica engine that has one —

@@ -44,6 +44,12 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     objectives, live burn rates, alert states, SLIs, and the recent
     transition ring (404 when none is attached); scraping evaluates, so
     the states are current as of the request.
+``GET /autoscaler``
+    the attached :class:`~paddle_tpu.autoscaler.ElasticAutoscaler`
+    snapshot: policy knobs, fleet/pending-spawn state, live signals
+    (firing objectives, utilization, idle dwell), and the bounded
+    decision history (404 when none is attached).  A pure read — it
+    never advances the control loop.
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -172,12 +178,21 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/autoscaler":
+                payload = ops._render_autoscaler()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no autoscaler attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
-                      "/slo"]}),
+                      "/slo", "/autoscaler"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -224,6 +239,7 @@ class OpsServer:
         self._ledgers: List[Tuple[str, Any]] = []
         self._gateways: List[Tuple[str, Any]] = []
         self._slos: List[Tuple[str, Any]] = []      # SLOMonitor
+        self._autoscalers: List[Tuple[str, Any]] = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -234,6 +250,8 @@ class OpsServer:
         """Attach a telemetry source; kind is detected:
 
         - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
+        - ``ElasticAutoscaler`` (has ``autoscaler_snapshot``) →
+          /autoscaler + /metrics fleet/decision gauges;
         - ``ServingGateway`` (has ``gateway_snapshot``) → /gateway +
           /metrics (its ``.tracer``, when set, is attached too);
         - ``SLOMonitor`` (has ``add_objective``/``evaluate``) → /slo +
@@ -251,7 +269,11 @@ class OpsServer:
         cross-replica timelines.
         """
         with self._lock:
-            if hasattr(obj, "add_objective") and hasattr(obj, "evaluate"):
+            if hasattr(obj, "autoscaler_snapshot"):
+                base = name or f"autoscaler{len(self._autoscalers)}"
+                self._autoscalers.append((base, obj))
+                self._engines.append((base, obj))   # /metrics exposition
+            elif hasattr(obj, "add_objective") and hasattr(obj, "evaluate"):
                 self._slos.append((name or f"slo{len(self._slos)}", obj))
             elif hasattr(obj, "gateway_snapshot"):
                 base = name or f"gateway{len(self._gateways)}"
@@ -452,3 +474,13 @@ class OpsServer:
         if len(slos) == 1:
             return slos[0][1].snapshot()
         return {name: slo.snapshot() for name, slo in slos}
+
+    def _render_autoscaler(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            autoscalers = list(self._autoscalers)
+        if not autoscalers:
+            return None
+        if len(autoscalers) == 1:
+            return autoscalers[0][1].autoscaler_snapshot()
+        return {name: asc.autoscaler_snapshot()
+                for name, asc in autoscalers}

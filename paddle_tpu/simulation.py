@@ -1,0 +1,538 @@
+"""Fake-clock traffic simulation harness for the serving control plane.
+
+Scaling policy is impossible to test against real engines on real time:
+an end-to-end scale-up trajectory (SLO burn → alert dwell → spawn → warm
+→ activate → recovery → idle dwell → drain) spans minutes of wall clock
+and every XLA compile in between.  This module makes the whole
+trajectory a deterministic CPU unit test: a **real**
+:class:`~paddle_tpu.gateway.ServingGateway` (real queues, routing,
+quarantine, drains) fronting **fake-timed** engines, all sharing one
+injectable :class:`SimClock` — no sleeps, no device, no nondeterminism.
+
+Pieces:
+
+- :class:`SimClock` — the shared fake monotonic clock (``advance(dt)``).
+- :class:`SimTracer` — a real :class:`~paddle_tpu.telemetry.Tracer`
+  whose ``now()`` reads the sim clock, so ring timestamps,
+  ``last_event_age_s`` (the gateway's stall/quarantine dial) and SLO
+  windows all live on simulated time.
+- :class:`SimEngine` — a host-only engine with the real scheduling
+  surface (``add_request`` / ``step`` / ``pop_finished`` / ``cancel`` /
+  ``warmup`` / ``compile_grid``): one token per active slot per
+  ``step()``, deterministic token streams (stream equality pins
+  zero-drop/replay correctness), a program-cache model that emits real
+  tracer compile events (so warmup vs in-serve compile accounting — the
+  PR 2/6 contracts — is exercised), and ``kill()`` for replica-death
+  injection (the engine stops ticking while holding work; the gateway's
+  stall health-check quarantines it as simulated time advances).
+- workload generators — ``steady`` (Poisson), ``diurnal`` (sinusoid-
+  modulated Poisson), ``flash_crowd`` (step spike) — seconds → rate
+  callables, sampled per tick with a seeded Poisson draw.
+- :class:`TrafficSim` — the driver: per ``dt`` tick it samples arrivals,
+  submits them to the gateway, runs one gateway round (and one
+  autoscaler ``evaluate()`` when attached), fires any scheduled
+  injections (``at(t, fn)`` — replica death mid-burst), advances the
+  clock, and samples a fleet/queue timeline.  ``run()`` returns a report:
+  outcomes, TTFT percentiles (sim seconds), shed rate, the timeline, the
+  autoscaler decision history, and the ``dropped`` list that must stay
+  empty (the zero-drop contract across every transition).
+
+This doubles as the scenario-diversity workload generator the ROADMAP
+north star asks for: the same arrival processes drive the real engines
+in ``bench.py`` A/Bs (``gpt_autoscale``) and the CPU tier-1 scenario
+tests (``tests/test_autoscaler.py``).
+
+Everything here is stdlib + telemetry — importing it never touches JAX,
+so policy tests cost milliseconds.
+
+No reference counterpart: the reference snapshot serves static batches;
+this is the traffic side of the elastic-serving control plane
+(docs/AUTOSCALING.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import math
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from .telemetry import Tracer
+from .utils.stats import StatRegistry, prometheus_text as _prometheus_text
+
+__all__ = ["SimClock", "SimTracer", "SimEngine", "TrafficSim",
+           "steady", "diurnal", "flash_crowd", "sim_tokens"]
+
+
+class SimClock:
+    """Deterministic fake monotonic clock: a callable returning the
+    current simulated seconds, advanced explicitly."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError("time only moves forward")
+        self.t += float(dt)
+        return self.t
+
+
+class SimTracer(Tracer):
+    """A real :class:`Tracer` on simulated time: ``now()`` reads the
+    injected clock, so every ring event, ``last_event_age_s`` liveness
+    peek and downstream consumer (gateway health checks, SLO windows,
+    trace stitching) sees the fake timebase.  The epoch is 0 — sim time
+    IS the shared timebase across every sim tracer."""
+
+    def __init__(self, clock: Callable[[], float], **kwargs):
+        self._sim_clock = clock
+        super().__init__(**kwargs)
+        self._t0 = 0.0
+
+    def now(self) -> float:
+        return float(self._sim_clock())
+
+
+def sim_tokens(prompt: Sequence[int], n: int) -> List[int]:
+    """The deterministic token stream a :class:`SimEngine` emits for
+    ``prompt`` — the oracle stream-equality checks compare against
+    (replays and reroutes must re-deliver exactly this)."""
+    seed = sum(int(t) for t in prompt) * 31 + len(prompt)
+    return [(seed + 7 * i) % 997 for i in range(int(n))]
+
+
+class _SimRequest:
+    __slots__ = ("rid", "prompt", "max_new", "on_token", "emitted",
+                 "stream")
+
+    def __init__(self, rid, prompt, max_new, on_token):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.max_new = int(max_new)
+        self.on_token = on_token
+        self.emitted = 0
+        self.stream = sim_tokens(prompt, max_new)
+
+
+class SimEngine:
+    """Host-only fake-timed serving engine (module docstring).
+
+    ``max_slots`` concurrent requests; each ``step()`` delivers
+    ``tokens_per_tick`` tokens to every active request — service time in
+    sim seconds is queueing + ``ceil(max_new / tokens_per_tick)`` driver
+    ticks.  ``prompt_buckets`` shape the program-cache model (one
+    ``prefill:<P>`` family per bucket + one ``decode``), matching the
+    real engines' grids so warmup/compile accounting is exercised, not
+    faked.  ``warmup_unsupported=True`` raises ``NotImplementedError``
+    from ``warmup()`` — the TP/mesh-engine shape the autoscaler must
+    degrade gracefully on."""
+
+    prefix_caching = False
+
+    def __init__(self, *, max_slots: int = 4, tokens_per_tick: int = 1,
+                 prompt_buckets: Sequence[int] = (8, 16),
+                 tracer: Optional[Tracer] = None,
+                 compile_wall_s: float = 0.0,
+                 warmup_unsupported: bool = False,
+                 logger: Optional[logging.Logger] = None):
+        if int(max_slots) < 1:
+            raise ValueError("max_slots must be >= 1")
+        if int(tokens_per_tick) < 1:
+            raise ValueError("tokens_per_tick must be >= 1")
+        self.S = self.max_slots = int(max_slots)
+        self.tokens_per_tick = int(tokens_per_tick)
+        self.buckets = tuple(sorted(int(b) for b in prompt_buckets))
+        self.tracer = tracer
+        self.compile_wall_s = float(compile_wall_s)
+        self.warmup_unsupported = bool(warmup_unsupported)
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._rids = 0
+        self._queue: List[_SimRequest] = []
+        self._active: Dict[int, _SimRequest] = {}
+        self._finished: Dict[int, List[int]] = {}
+        self._progs: set = set()
+        self._in_warmup = False
+        self.warmed = False
+        self.dead = False
+        self.in_serve_compiles = 0
+        self.stats = StatRegistry()
+
+    # -------------------------------------------------------------- grid --
+
+    def compile_grid(self) -> List[str]:
+        return [f"prefill:{P}" for P in self.buckets] + ["decode"]
+
+    def _bucket_label(self, prompt_len: int) -> str:
+        for P in self.buckets:
+            if prompt_len <= P:
+                return f"prefill:{P}"
+        return f"prefill:{self.buckets[-1]}"
+
+    def _fetch(self, label: str):
+        """One program-cache access: misses outside warmup are in-serve
+        compiles (the count the zero-compile acceptance pin reads)."""
+        hit = label in self._progs
+        if not hit:
+            self._progs.add(label)
+            if not self._in_warmup:
+                self.in_serve_compiles += 1
+                self.stats.add("in_serve_compiles")
+        if self.tracer is not None:
+            self.tracer.compile_event(
+                "sim", label, hit=hit,
+                wall_s=0.0 if hit else self.compile_wall_s)
+
+    def warmup(self, cache_dir: Optional[str] = None, max_workers: int = 1,
+               block: bool = True) -> Dict[str, Any]:
+        """Precompile the full grid (instant in sim time).  With a tracer
+        the run sits in an ``expected_compiles`` window keyed to the
+        grid, same as the real engines' warmup."""
+        if self.warmup_unsupported:
+            raise NotImplementedError(
+                "sim engine configured unwarmable (the TP/mesh shape)")
+        grid = self.compile_grid()
+        ctx = (self.tracer.expected_compiles(keys=set(grid))
+               if self.tracer is not None else contextlib.nullcontext())
+        self._in_warmup = True
+        try:
+            with ctx:
+                for label in grid:
+                    self._fetch(label)
+        finally:
+            self._in_warmup = False
+        self.warmed = True
+        return {"programs": len(grid), "wall_s": 0.0,
+                "cache_dir": None if cache_dir is None else str(cache_dir)}
+
+    # --------------------------------------------------------- scheduling --
+
+    def _free_slots(self) -> List[int]:
+        return list(range(self.S - len(self._active)))
+
+    def add_request(self, prompt, max_new_tokens: int, on_token=None,
+                    trace_ctx=None, **sampling) -> int:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        rid = self._rids
+        self._rids += 1
+        req = _SimRequest(rid, prompt, max_new_tokens, on_token)
+        if self.tracer is not None and trace_ctx is not None:
+            self.tracer.bind_trace(rid, trace_ctx)
+        self._queue.append(req)
+        self.stats.add("requests_admitted")
+        return rid
+
+    def step(self):
+        """One scheduler round: admit queued requests into free slots
+        (paying the prefill program fetch), then deliver
+        ``tokens_per_tick`` tokens to every active request.  A dead
+        engine does nothing — no tokens AND no tracer events, so its
+        tracer's event age grows with simulated time and the gateway's
+        stall health-check fires."""
+        if self.dead:
+            return
+        while self._queue and len(self._active) < self.S:
+            req = self._queue.pop(0)
+            self._fetch(self._bucket_label(len(req.prompt)))
+            self._active[req.rid] = req
+        if self._active:
+            self._fetch("decode")
+        retired = []
+        for rid, req in list(self._active.items()):
+            for _ in range(self.tokens_per_tick):
+                tok = req.stream[req.emitted]
+                req.emitted += 1
+                done = req.emitted >= req.max_new
+                if req.on_token is not None:
+                    req.on_token(rid, tok, done)
+                if done:
+                    retired.append(rid)
+                    break
+        for rid in retired:
+            req = self._active.pop(rid)
+            self._finished[rid] = list(req.stream)
+            self.stats.add("requests_finished")
+            if self.tracer is not None:
+                self.tracer.bind_trace(rid, None)
+        if self.tracer is not None:
+            self.tracer.tick("sim", 0.0, active=len(self._active),
+                             queued=len(self._queue))
+
+    def cancel(self, rid: int) -> bool:
+        """Release one in-flight request (queued or active) and deliver
+        the terminal stream signal — the serving.py primitive the
+        gateway's deadline/quarantine paths ride."""
+        req = None
+        for i, q in enumerate(self._queue):
+            if q.rid == rid:
+                req = self._queue.pop(i)
+                break
+        if req is None:
+            req = self._active.pop(rid, None)
+        if req is None:
+            return False
+        self.stats.add("requests_cancelled")
+        if self.tracer is not None:
+            self.tracer.bind_trace(rid, None)
+        if req.on_token is not None:
+            req.on_token(rid, None, True)
+        return True
+
+    def pending(self) -> bool:
+        return bool(self._queue) or bool(self._active)
+
+    def pop_finished(self) -> Dict[int, List[int]]:
+        out, self._finished = self._finished, {}
+        return out
+
+    def kill(self):
+        """Replica-death injection: freeze the engine mid-work."""
+        self.dead = True
+
+    # --------------------------------------------------------- telemetry --
+
+    def metrics(self) -> Dict[str, float]:
+        out = dict(self.stats.snapshot())
+        out["active"] = float(len(self._active))
+        out["queued"] = float(len(self._queue))
+        return out
+
+    def prometheus_text(self, namespace: str = "paddle_tpu_sim_engine"
+                        ) -> str:
+        return _prometheus_text(
+            self.stats, namespace=namespace,
+            extra_gauges={"active": len(self._active),
+                          "queued": len(self._queue),
+                          "warmed": int(self.warmed),
+                          "dead": int(self.dead)})
+
+
+# ---------------------------------------------------------------------------
+# workload generators
+# ---------------------------------------------------------------------------
+
+def steady(rate_per_s: float) -> Callable[[float], float]:
+    """Constant-rate Poisson arrivals."""
+    r = float(rate_per_s)
+    if r < 0:
+        raise ValueError("rate must be >= 0")
+    return lambda t: r
+
+
+def diurnal(base_per_s: float, peak_per_s: float, period_s: float,
+            phase_s: float = 0.0) -> Callable[[float], float]:
+    """Sinusoid-modulated arrivals: rate(t) swings ``base → peak → base``
+    over ``period_s``, starting at the trough (t = ``phase_s``) — the
+    day/night traffic shape."""
+    base, peak = float(base_per_s), float(peak_per_s)
+    if base < 0 or peak < base:
+        raise ValueError("need 0 <= base <= peak")
+    period = float(period_s)
+    if period <= 0:
+        raise ValueError("period_s must be > 0")
+
+    def rate(t: float) -> float:
+        x = 2.0 * math.pi * ((t - phase_s) / period)
+        return base + (peak - base) * 0.5 * (1.0 - math.cos(x))
+    return rate
+
+
+def flash_crowd(base_per_s: float, spike_per_s: float, at_s: float,
+                duration_s: float) -> Callable[[float], float]:
+    """Step spike: ``base`` everywhere except ``[at_s, at_s +
+    duration_s)`` where the rate jumps to ``spike`` — the cache-miss
+    stampede / launch-event shape."""
+    base, spike = float(base_per_s), float(spike_per_s)
+    if base < 0 or spike < 0:
+        raise ValueError("rates must be >= 0")
+    t0, t1 = float(at_s), float(at_s) + float(duration_s)
+    return lambda t: spike if t0 <= t < t1 else base
+
+
+def _poisson(rng: random.Random, lam: float) -> int:
+    """Seeded Poisson draw (Knuth for small λ, normal approximation past
+    30 — per-tick λ in any sane sim sits well under that)."""
+    if lam <= 0.0:
+        return 0
+    if lam > 30.0:
+        return max(0, int(round(rng.gauss(lam, math.sqrt(lam)))))
+    limit = math.exp(-lam)
+    n, p = 0, 1.0
+    while True:
+        p *= rng.random()
+        if p <= limit:
+            return n
+        n += 1
+
+
+# ---------------------------------------------------------------------------
+# the driver
+# ---------------------------------------------------------------------------
+
+class TrafficSim:
+    """Drive a workload through a real gateway on the fake clock (module
+    docstring).  ``rate_fn``: seconds → arrivals/second (the generators
+    above, or any callable).  ``dt``: sim seconds per driver tick — each
+    tick is one arrival sample + one ``gateway.step()`` (+ one
+    ``autoscaler.evaluate()``).  ``seed`` fixes the arrival process and
+    request shapes — identical seeds replay identical scenarios."""
+
+    def __init__(self, gateway, clock: SimClock,
+                 rate_fn: Callable[[float], float], *, dt: float = 0.25,
+                 seed: int = 0, prompt_len: Tuple[int, int] = (3, 12),
+                 max_new: Tuple[int, int] = (4, 8), vocab: int = 997,
+                 priority: int = 0, autoscaler=None,
+                 sample_every_s: float = 1.0,
+                 logger: Optional[logging.Logger] = None):
+        if float(dt) <= 0:
+            raise ValueError("dt must be > 0")
+        self.gateway = gateway
+        self.clock = clock
+        self.rate_fn = rate_fn
+        self.dt = float(dt)
+        self.seed = int(seed)
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.max_new = (int(max_new[0]), int(max_new[1]))
+        self.vocab = int(vocab)
+        self.priority = int(priority)
+        self.autoscaler = autoscaler
+        self.sample_every_s = float(sample_every_s)
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self.handles: List[Any] = []
+        self.samples: List[Dict[str, Any]] = []
+        self._injections: List[Tuple[float, Callable[[], None], str]] = []
+        self._fired: List[str] = []
+        self._last_sample_at: Optional[float] = None
+
+    def at(self, t_s: float, fn: Callable[[], None], label: str = "event"
+           ) -> "TrafficSim":
+        """Schedule an injection: ``fn()`` fires on the first tick at or
+        after sim time ``t_s`` (replica death mid-burst:
+        ``sim.at(30, engine.kill, "kill r1")``)."""
+        self._injections.append((float(t_s), fn, str(label)))
+        self._injections.sort(key=lambda e: e[0])
+        return self
+
+    # ------------------------------------------------------------- drive --
+
+    def _submit_arrivals(self, rng: random.Random, n: int):
+        for _ in range(n):
+            plen = rng.randint(*self.prompt_len)
+            prompt = [rng.randint(1, self.vocab) for _ in range(plen)]
+            self.handles.append(self.gateway.submit(
+                prompt, rng.randint(*self.max_new),
+                priority=self.priority))
+
+    def _fire_due(self, t: float):
+        while self._injections and self._injections[0][0] <= t:
+            _ts, fn, label = self._injections.pop(0)
+            self._fired.append(label)
+            fn()
+
+    def _sample(self, t: float):
+        if self._last_sample_at is not None \
+                and t - self._last_sample_at < self.sample_every_s - 1e-9:
+            return
+        self._last_sample_at = t
+        reps = self.gateway.replicas()
+        self.samples.append({
+            "t": t,
+            "active": sum(1 for r in reps if r.state == "active"),
+            "draining": sum(1 for r in reps if r.state == "draining"),
+            "quarantined": sum(1 for r in reps
+                               if r.state == "quarantined"),
+            "queued": sum(d["depth"] for d in
+                          self.gateway.queue_depths().values()),
+            "inflight": sum(len(r.inflight) for r in reps),
+            "rate": self.rate_fn(t),
+        })
+
+    def _tick(self, rng: Optional[random.Random]):
+        t = self.clock()
+        self._fire_due(t)
+        if rng is not None:
+            self._submit_arrivals(rng,
+                                  _poisson(rng, self.rate_fn(t) * self.dt))
+        self.gateway.step()
+        if self.autoscaler is not None:
+            self.autoscaler.evaluate()
+        self._sample(t)
+        self.clock.advance(self.dt)
+
+    def run(self, duration_s: float, drain: bool = True,
+            max_drain_ticks: int = 100000) -> Dict[str, Any]:
+        """Run the scenario for ``duration_s`` sim seconds, then (with
+        ``drain=True``) keep ticking WITHOUT new arrivals until nothing
+        is queued or in flight — every admitted request must reach a
+        terminal state for the report's zero-drop accounting to mean
+        anything.  A scenario that cannot drain inside
+        ``max_drain_ticks`` stops and reports the stuck requests in
+        ``dropped`` instead of raising — report honesty over an
+        exception."""
+        rng = random.Random(self.seed)
+        end = self.clock() + float(duration_s)
+        while self.clock() < end - 1e-9:
+            self._tick(rng)
+        if drain:
+            ticks = 0
+            while self.gateway.pending() and ticks < int(max_drain_ticks):
+                self._tick(None)
+                ticks += 1
+            if self.gateway.pending():
+                self._log.warning(
+                    "sim: scenario did not drain in %d ticks", ticks)
+        return self.report()
+
+    # ------------------------------------------------------------ report --
+
+    @staticmethod
+    def _percentile(sorted_vals: List[float], q: float) -> Optional[float]:
+        if not sorted_vals:
+            return None
+        i = min(len(sorted_vals) - 1,
+                max(0, math.ceil(q * len(sorted_vals)) - 1))
+        return sorted_vals[i]
+
+    def report(self) -> Dict[str, Any]:
+        outcomes: Dict[str, int] = {}
+        ttfts: List[float] = []
+        for h in self.handles:
+            outcomes[h.status] = outcomes.get(h.status, 0) + 1
+            if h.status == "finished" and h.first_token_at is not None:
+                ttfts.append(h.first_token_at - h.submitted_at)
+        ttfts.sort()
+        offered = len(self.handles)
+        shed = outcomes.get("shed", 0)
+        # dropped = admitted but never terminal: the zero-drop contract
+        # every scaling transition must preserve
+        dropped = [h.gid for h in self.handles if not h.done]
+        report = {
+            "offered": offered,
+            "outcomes": outcomes,
+            "shed_rate": (shed / offered) if offered else 0.0,
+            "ttft_s": {
+                "n": len(ttfts),
+                "p50": self._percentile(ttfts, 0.50),
+                "p95": self._percentile(ttfts, 0.95),
+                "p99": self._percentile(ttfts, 0.99),
+                "max": ttfts[-1] if ttfts else None,
+            },
+            "dropped": dropped,
+            "injections_fired": list(self._fired),
+            "timeline": list(self.samples),
+            "end_t": self.clock(),
+        }
+        if self.autoscaler is not None:
+            report["decisions"] = self.autoscaler.decisions()
+            report["fleet"] = self.autoscaler.autoscaler_snapshot()["fleet"]
+        return report

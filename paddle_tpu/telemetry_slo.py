@@ -325,6 +325,10 @@ class SLOMonitor:
         self._alerts: Dict[str, _AlertState] = {}
         self._transitions: collections.deque = collections.deque(
             maxlen=int(transition_history))
+        # transition subscribers (autoscaler etc.): called with a COPY of
+        # each transition event, outside the windowed-store lock but under
+        # _eval_lock — a subscriber must never call back into evaluate()
+        self._subscribers: List[Callable[[Dict[str, Any]], None]] = []
         self._ledgers: List[Any] = []
         self.registry = StatRegistry()
         self._log = logger if logger is not None \
@@ -345,6 +349,37 @@ class SLOMonitor:
     def objectives(self) -> List[Objective]:
         with self._lock:
             return list(self._objectives.values())
+
+    def subscribe(self, fn: Callable[[Dict[str, Any]], None]):
+        """Register a transition subscriber: ``fn(event)`` is called for
+        every alert transition (``pending`` / ``firing`` / ``resolved`` /
+        ``cancelled``) with a copy of the transition-history event — the
+        push feed a controller (``autoscaler.ElasticAutoscaler``) closes
+        its loop on.  Callbacks run under the evaluation lock, so a
+        subscriber must NEVER call back into ``evaluate()``/``snapshot()``
+        (deadlock); read the event, update your own state, return.  A
+        raising subscriber is logged and skipped — it cannot take the
+        evaluator down.  Returns ``fn`` (decorator-friendly)."""
+        if not callable(fn):
+            raise TypeError(f"subscriber must be callable, got {fn!r}")
+        with self._lock:
+            self._subscribers.append(fn)
+        return fn
+
+    def unsubscribe(self, fn) -> bool:
+        with self._lock:
+            try:
+                self._subscribers.remove(fn)
+                return True
+            except ValueError:
+                return False
+
+    def alert_states(self) -> Dict[str, str]:
+        """Current alert state per objective name (no evaluation pass —
+        the states as of the last ``evaluate()``); what a late-attaching
+        subscriber seeds itself from."""
+        with self._lock:
+            return {name: st.state for name, st in self._alerts.items()}
 
     def attach_ledger(self, ledger) -> "SLOMonitor":
         """Sample a ``telemetry_ledger.RunLedger``'s goodput gauge into
@@ -454,6 +489,15 @@ class SLOMonitor:
         log = (self._log.warning if what == "firing" else self._log.info)
         log("slo %s: %s (burn %.2f over windows %s)", what, obj.name,
             ev["burn"], list(obj.windows))
+        with self._lock:
+            subscribers = list(self._subscribers)
+        for fn in subscribers:
+            try:
+                fn(dict(ev))
+            except Exception:  # noqa: BLE001 — a broken subscriber must
+                # not take the alert state machine down with it
+                self._log.exception("slo: transition subscriber failed "
+                                    "for %s %s", obj.name, what)
 
     def evaluate(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
         """Advance every objective's alert state machine to ``now`` and

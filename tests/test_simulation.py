@@ -1,0 +1,234 @@
+"""Fake-clock traffic simulation harness (paddle_tpu/simulation.py,
+ISSUE 11): the sim clock/tracer timebase, the SimEngine scheduling
+surface (deterministic streams, cancel, warmup/compile accounting,
+death injection), the workload generators, and the TrafficSim driver
+against a REAL gateway — all deterministic, no jax, no sleeps."""
+
+import random
+
+import pytest
+
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.simulation import (SimClock, SimEngine, SimTracer,
+                                   TrafficSim, _poisson, diurnal,
+                                   flash_crowd, sim_tokens, steady)
+
+
+class TestClockAndTracer:
+    def test_clock_advances_monotonically(self):
+        clk = SimClock(5.0)
+        assert clk() == 5.0
+        clk.advance(2.5)
+        assert clk() == 7.5
+        with pytest.raises(ValueError):
+            clk.advance(-1.0)
+
+    def test_sim_tracer_lives_on_fake_time(self):
+        """Ring timestamps and the liveness peek read the SIM clock —
+        the gateway's stall/quarantine dial works on simulated time."""
+        clk = SimClock()
+        tr = SimTracer(clk)
+        assert tr.t0 == 0.0 and tr.now() == 0.0
+        clk.advance(3.0)
+        ev = tr.emit("tick", engine="sim")
+        assert ev["ts"] == 3.0
+        assert tr.last_event_age_s() == 0.0
+        clk.advance(7.0)
+        assert tr.last_event_age_s() == 7.0
+
+
+class TestSimEngine:
+    def test_deterministic_streams_and_finish(self):
+        eng = SimEngine(max_slots=2)
+        sig = []
+        r0 = eng.add_request([1, 2, 3], 4,
+                             on_token=lambda r, t, d: sig.append((r, t, d)))
+        r1 = eng.add_request([9], 2)
+        while eng.pending():
+            eng.step()
+        got = eng.pop_finished()
+        assert got[r0] == sim_tokens([1, 2, 3], 4)
+        assert got[r1] == sim_tokens([9], 2)
+        # the stream delivered token-for-token with done on the last
+        assert [t for r, t, d in sig if r == r0] == got[r0]
+        assert sig[-1][2] is True or any(d for r, t, d in sig if r == r0)
+
+    def test_slots_bound_concurrency(self):
+        eng = SimEngine(max_slots=1)
+        eng.add_request([1], 3)
+        eng.add_request([2], 3)
+        eng.step()
+        assert len(eng._active) == 1 and len(eng._queue) == 1
+
+    def test_cancel_queued_and_active(self):
+        eng = SimEngine(max_slots=1)
+        sig = []
+        r0 = eng.add_request([1], 5)
+        r1 = eng.add_request([2], 5,
+                             on_token=lambda r, t, d: sig.append((t, d)))
+        eng.step()                       # r0 active, r1 queued
+        assert eng.cancel(r1)            # queued-side
+        assert sig[-1] == (None, True)   # terminal signal
+        assert eng.cancel(r0)            # active-side frees the slot
+        assert not eng.cancel(r0)        # already gone
+        assert not eng.pending()
+        assert eng.metrics()["requests_cancelled"] == 2
+
+    def test_warmup_zero_in_serve_compiles(self):
+        """A warmed engine pays NO in-serve compile; an unwarmed one pays
+        one per program family it dispatches — the PR 6 contract the
+        acceptance scenario pins on spawned replicas."""
+        warm = SimEngine(max_slots=2, prompt_buckets=(8, 16))
+        rep = warm.warmup(cache_dir="/tmp/unused")
+        assert rep["programs"] == 3      # prefill:8, prefill:16, decode
+        warm.add_request([1, 2], 2)
+        while warm.pending():
+            warm.step()
+        assert warm.in_serve_compiles == 0
+
+        cold = SimEngine(max_slots=2, prompt_buckets=(8, 16))
+        cold.add_request([1, 2], 2)
+        while cold.pending():
+            cold.step()
+        assert cold.in_serve_compiles == 2      # prefill:8 + decode
+
+    def test_warmup_unsupported_raises(self):
+        eng = SimEngine(warmup_unsupported=True)
+        with pytest.raises(NotImplementedError):
+            eng.warmup()
+
+    def test_warmup_compiles_are_expected_on_tracer(self):
+        """Warmup misses sit in an expected_compiles window (tagged, storm
+        warning ignores them) — the same discipline as jit/aot.py."""
+        clk = SimClock()
+        tr = SimTracer(clk, recompile_warn_threshold=1)
+        eng = SimEngine(tracer=tr)
+        eng.warmup()
+        misses = [e for e in tr.events("compile") if not e["hit"]]
+        assert len(misses) == 3 and all(e["expected"] for e in misses)
+        assert not tr._warned_storm
+
+    def test_kill_freezes_engine_and_tracer(self):
+        clk = SimClock()
+        tr = SimTracer(clk)
+        eng = SimEngine(tracer=tr)
+        eng.add_request([1], 8)
+        eng.step()
+        assert tr.last_event_age_s() == 0.0
+        eng.kill()
+        before = eng._active[0].emitted
+        for _ in range(5):
+            clk.advance(1.0)
+            eng.step()
+        assert eng._active[0].emitted == before     # no progress
+        assert tr.last_event_age_s() == 5.0         # stall age grows
+
+
+class TestWorkloads:
+    def test_steady_and_flash_crowd_shapes(self):
+        r = steady(2.0)
+        assert r(0) == r(1e6) == 2.0
+        f = flash_crowd(1.0, 10.0, 100.0, 50.0)
+        assert f(99.9) == 1.0 and f(100.0) == 10.0
+        assert f(149.9) == 10.0 and f(150.0) == 1.0
+
+    def test_diurnal_trough_peak(self):
+        d = diurnal(1.0, 9.0, period_s=100.0)
+        assert d(0.0) == pytest.approx(1.0)          # trough at phase
+        assert d(50.0) == pytest.approx(9.0)         # peak mid-period
+        assert d(100.0) == pytest.approx(1.0)        # back to trough
+        assert all(1.0 - 1e-9 <= d(t) <= 9.0 + 1e-9
+                   for t in range(0, 200, 7))
+
+    def test_poisson_seeded_and_sane(self):
+        rng = random.Random(7)
+        a = [_poisson(rng, 2.0) for _ in range(200)]
+        b = [_poisson(random.Random(7), 2.0) for _ in range(1)]
+        assert a[0] == b[0]                          # seeded → replayable
+        mean = sum(a) / len(a)
+        assert 1.5 < mean < 2.5                      # λ=2 within tolerance
+        assert _poisson(rng, 0.0) == 0
+
+
+class TestTrafficSim:
+    def _gateway(self, clk, replicas=2, **kw):
+        gw = ServingGateway(clock=clk, tracer=SimTracer(clk), **kw)
+        for i in range(replicas):
+            eng = SimEngine(max_slots=4, tracer=SimTracer(clk))
+            eng.warmup()
+            gw.add_replica(eng, f"r{i}")
+        return gw
+
+    def test_steady_run_finishes_everything(self):
+        clk = SimClock()
+        gw = self._gateway(clk)
+        sim = TrafficSim(gw, clk, steady(2.0), dt=0.25, seed=3)
+        rep = sim.run(60.0)
+        assert rep["offered"] > 60                   # λ·T ≈ 120
+        assert rep["outcomes"] == {"finished": rep["offered"]}
+        assert rep["dropped"] == []
+        assert rep["shed_rate"] == 0.0
+        assert rep["ttft_s"]["p99"] is not None
+        assert rep["end_t"] >= 60.0
+        # stream integrity: every finished handle carries its oracle
+        for h in sim.handles:
+            assert h.tokens == sim_tokens(h.prompt, h.max_new_tokens)
+
+    def test_same_seed_replays_identical_scenario(self):
+        def once():
+            clk = SimClock()
+            gw = self._gateway(clk)
+            sim = TrafficSim(gw, clk, flash_crowd(1.0, 5.0, 10.0, 10.0),
+                             dt=0.25, seed=11)
+            rep = sim.run(40.0)
+            return (rep["offered"], rep["outcomes"], rep["ttft_s"])
+        assert once() == once()
+
+    def test_overload_sheds_structured_never_drops(self):
+        clk = SimClock()
+        gw = self._gateway(clk, replicas=1, max_queue_depth=8)
+        sim = TrafficSim(gw, clk, steady(20.0), dt=0.25, seed=5)
+        rep = sim.run(20.0)
+        assert rep["outcomes"].get("shed", 0) > 0
+        assert rep["shed_rate"] > 0.0
+        assert rep["dropped"] == []                  # shed ≠ dropped
+        assert rep["offered"] == sum(rep["outcomes"].values())
+
+    def test_injection_fires_at_time(self):
+        clk = SimClock()
+        gw = self._gateway(clk)
+        fired_at = []
+        sim = TrafficSim(gw, clk, steady(1.0), dt=0.5, seed=1)
+        sim.at(5.0, lambda: fired_at.append(clk()), "probe")
+        rep = sim.run(10.0)
+        assert rep["injections_fired"] == ["probe"]
+        assert fired_at and 5.0 <= fired_at[0] < 5.5 + 1e-9
+
+    def test_timeline_sampled(self):
+        clk = SimClock()
+        gw = self._gateway(clk)
+        sim = TrafficSim(gw, clk, steady(1.0), dt=0.5, seed=2,
+                         sample_every_s=2.0)
+        rep = sim.run(10.0, drain=False)
+        ts = [s["t"] for s in rep["timeline"]]
+        assert ts == sorted(ts) and len(ts) >= 5
+        assert all(s["active"] == 2 for s in rep["timeline"])
+        assert all("rate" in s and "queued" in s for s in rep["timeline"])
+
+    def test_replica_death_requests_still_finish(self):
+        """Death injection end-to-end WITHOUT an autoscaler: the killed
+        replica stalls, the gateway quarantines it on the fake clock,
+        and every request still finishes on the survivor with the oracle
+        stream — zero drops."""
+        clk = SimClock()
+        gw = self._gateway(clk, replicas=2, stall_threshold_s=3.0)
+        sim = TrafficSim(gw, clk, steady(2.0), dt=0.25, seed=9)
+        sim.at(5.0, gw.replica("r0").engine.kill, "kill r0")
+        rep = sim.run(30.0)
+        assert rep["injections_fired"] == ["kill r0"]
+        assert gw.replica("r0").state == "quarantined"
+        assert rep["dropped"] == []
+        assert rep["outcomes"] == {"finished": rep["offered"]}
+        for h in sim.handles:
+            assert h.tokens == sim_tokens(h.prompt, h.max_new_tokens)
+        assert gw.metrics().get("rerouted", 0) >= 0

@@ -28,7 +28,11 @@ record (or synthetic sub-metric) may also carry an explicit
 Records with ``unit`` of ``error``/``skipped`` or a null value are
 classified as non-comparable, never as regressions — an infra-dead round
 must not read as a code regression (and must not hide one either: it
-simply doesn't participate).
+simply doesn't participate).  Likewise two records whose ``backend``
+labels differ (a CPU round against a TPU round): that delta is a
+hardware change, not a code change, so the pair is reported
+``not comparable (backend cpu -> tpu)`` — the first healthy-chip round
+starts a fresh trajectory instead of reading as a giant "improvement".
 
 Telemetry attachments participate too: when BOTH rounds of a metric
 carry a ``telemetry`` snapshot, its known fields (TTFT/ITL/tick
@@ -105,9 +109,14 @@ def expand_telemetry(records):
             if spec is None:
                 continue
             unit, direction = spec
-            out.append({"metric": f"{rec['metric']}.{path}",
-                        "value": value, "unit": unit,
-                        "direction": direction})
+            row = {"metric": f"{rec['metric']}.{path}",
+                   "value": value, "unit": unit,
+                   "direction": direction}
+            if rec.get("backend") is not None:
+                # synthetic rows inherit the parent's backend so the
+                # cross-backend non-comparability guard covers them too
+                row["backend"] = rec["backend"]
+            out.append(row)
     return out
 
 
@@ -205,6 +214,15 @@ def compare(old_records, new_records, threshold):
                "old_status": co, "new_status": cn, "delta_frac": None}
         if co != "ok" or cn != "ok":
             row["status"] = f"not comparable ({co} -> {cn})"
+            rows.append(row)
+            continue
+        ob, nb = old.get("backend"), new.get("backend")
+        if ob is not None and nb is not None and ob != nb:
+            # a CPU round measured against a TPU round is a hardware
+            # change, not a code change — cross-backend pairs are
+            # reported, never judged (the honest-labeling contract:
+            # every bench record carries its backend)
+            row["status"] = f"not comparable (backend {ob} -> {nb})"
             rows.append(row)
             continue
         ov, nv = float(old["value"]), float(new["value"])

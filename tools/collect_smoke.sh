@@ -270,6 +270,66 @@ then
     echo "COLLECT SMOKE FAILED: request-tracing / SLO round trip"
     exit 1
 fi
+# elastic autoscaler + simulation harness: both modules must import clean
+# (no JAX needed — they are host-only), and a tiny fake-clock round trip
+# must close the loop both ways — one SLO-driven scale-up (spawn → warm →
+# activate, zero in-serve compiles) and one sustained-idle drain-down
+# (zero drops) — with the decision timeline served by a live /autoscaler
+# scrape
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'ASCEOF'
+import json, urllib.request
+from paddle_tpu.autoscaler import DECISIONS, ElasticAutoscaler
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.simulation import (SimClock, SimEngine, SimTracer,
+                                   TrafficSim, flash_crowd, steady)
+from paddle_tpu.telemetry_slo import Objective, SLOMonitor
+clock = SimClock()
+tracer = SimTracer(clock, capacity=8192)
+gw = ServingGateway(clock=clock, tracer=tracer)
+spawned = []
+def factory():
+    spawned.append(SimEngine(max_slots=2, tracer=SimTracer(clock)))
+    return spawned[-1]
+seed = SimEngine(max_slots=2, tracer=SimTracer(clock))
+seed.warmup()
+gw.add_replica(seed, "r0")
+slo = SLOMonitor([Objective.latency(
+    "ttft_p99", "ttft_s", 1.0, compliance=0.9, windows=(20.0, 5.0),
+    burn_threshold=1.0, for_s=1.0, clear_s=5.0)],
+    clock=clock, resolution_s=1.0, tracer=tracer)
+gw.set_slo(slo)
+asc = ElasticAutoscaler(gw, factory, slo=slo, min_replicas=1,
+                        max_replicas=2, scale_up_cooldown_s=2.0,
+                        scale_down_cooldown_s=5.0, idle_utilization=0.3,
+                        idle_dwell_s=8.0, tracer=tracer, clock=clock)
+sim = TrafficSim(gw, clock, flash_crowd(0.02, 6.0, 5.0, 15.0),
+                 dt=0.25, seed=0, autoscaler=asc)
+rep = sim.run(90.0)
+assert rep["dropped"] == [], rep["dropped"]
+acts = [d["action"] for d in rep["decisions"]]
+assert "scale_up" in acts and "activate" in acts, acts
+assert "scale_down" in acts and "removed" in acts, acts
+assert all(e.warmed and e.in_serve_compiles == 0 for e in spawned)
+assert rep["fleet"]["active"] == 1
+assert [e["what"] for e in tracer.events("autoscale")] == acts
+srv = OpsServer()
+srv.attach(asc, "asc")
+url = srv.start()
+snap = json.loads(urllib.request.urlopen(url + "/autoscaler",
+                                         timeout=10).read())
+assert [d["action"] for d in snap["decisions"]] == acts
+assert snap["policy"]["max_replicas"] == 2
+txt = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+assert "paddle_tpu_autoscaler_fleet_size 1" in txt
+assert "paddle_tpu_autoscaler_last_decision" in txt
+srv.stop()
+assert DECISIONS[0] == "none"
+ASCEOF
+then
+    echo "COLLECT SMOKE FAILED: autoscaler / simulation round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

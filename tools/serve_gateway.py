@@ -16,10 +16,18 @@ Examples::
     python tools/serve_gateway.py --replicas 2 --demo 24 \\
         --max-queue-depth 4 --ttft-deadline 5.0 --ops-port 9100
     python tools/serve_gateway.py --replicas 2 --demo 8 --drain-one
+    python tools/serve_gateway.py --replicas 1 --demo 24 --autoscale \\
+        --max-replicas 3 --up-cooldown 0 --ops-port 9100
 
 ``--drain-one`` gracefully drains replica 0 mid-workload — the rolling-
 restart rehearsal: the report asserts every admitted request still
 finished (zero drops).
+
+``--autoscale`` closes the loop: a TTFT-p99 + shed-rate ``SLOMonitor``
+feeds an ``ElasticAutoscaler`` (min/max/cooldown knobs below) that
+spawns AOT-warmed replicas from the same engine config on firing alerts
+and drains the least-loaded one under sustained idle; the report gains
+the decision timeline and the live ops endpoint gains ``/autoscaler``.
 """
 
 import argparse
@@ -102,9 +110,34 @@ def main(argv=None):
     ap.add_argument("--drain-one", action="store_true",
                     help="drain replica 0 mid-workload (rolling-restart "
                          "rehearsal; report asserts zero drops)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="attach the SLO-driven elastic autoscaler "
+                         "(paddle_tpu.autoscaler): firing TTFT/shed "
+                         "alerts spawn warmed replicas, sustained idle "
+                         "drains them")
+    ap.add_argument("--min-replicas", type=int, default=None,
+                    help="autoscaler floor (default: --replicas)")
+    ap.add_argument("--max-replicas", type=int, default=4,
+                    help="autoscaler ceiling")
+    ap.add_argument("--up-cooldown", type=float, default=2.0,
+                    help="seconds between scale-ups")
+    ap.add_argument("--down-cooldown", type=float, default=30.0,
+                    help="seconds between scale-downs (also blocks a "
+                         "drain right after a spawn)")
+    ap.add_argument("--idle-utilization", type=float, default=0.15,
+                    help="occupancy below this starts the idle dwell")
+    ap.add_argument("--idle-dwell", type=float, default=10.0,
+                    help="sustained-idle seconds before a scale-down")
+    ap.add_argument("--ttft-slo", type=float, default=2.0,
+                    help="TTFT p99 objective target (seconds) for the "
+                         "autoscaler's SLO monitor")
+    ap.add_argument("--shed-slo", type=float, default=0.05,
+                    help="shed-rate objective target for the "
+                         "autoscaler's SLO monitor")
     ap.add_argument("--ops-port", type=int, default=None,
                     help="start the live ops endpoint on this port "
-                         "(/gateway /metrics /healthz /ledger /trace)")
+                         "(/gateway /metrics /healthz /ledger /trace "
+                         "/autoscaler)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -125,6 +158,34 @@ def main(argv=None):
             eng.warmup(cache_dir=args.warmup_cache_dir)
         names.append(gw.add_replica(eng, f"r{i}"))
 
+    asc = None
+    if args.autoscale:
+        from paddle_tpu.autoscaler import ElasticAutoscaler
+        from paddle_tpu.telemetry_slo import Objective, SLOMonitor
+        slo = SLOMonitor([
+            Objective.latency("ttft_p99", "ttft_s", args.ttft_slo,
+                              compliance=0.99, windows=(60.0, 15.0),
+                              burn_threshold=1.0, for_s=1.0,
+                              clear_s=10.0),
+            Objective.ratio("shed_rate", "shed", "submitted",
+                            args.shed_slo, windows=(60.0, 15.0),
+                            burn_threshold=1.0, for_s=1.0,
+                            clear_s=10.0),
+        ], tracer=tracer)
+        gw.set_slo(slo)
+        gw.register_replica_factory(
+            lambda: _build_engine(args, model, params, Tracer()))
+        asc = ElasticAutoscaler(
+            gw, slo=slo,
+            min_replicas=(args.replicas if args.min_replicas is None
+                          else args.min_replicas),
+            max_replicas=args.max_replicas,
+            scale_up_cooldown_s=args.up_cooldown,
+            scale_down_cooldown_s=args.down_cooldown,
+            idle_utilization=args.idle_utilization,
+            idle_dwell_s=args.idle_dwell,
+            cache_dir=args.warmup_cache_dir, tracer=tracer)
+
     srv = None
     if args.ops_port is not None:
         from paddle_tpu.ops_server import OpsServer
@@ -132,6 +193,10 @@ def main(argv=None):
         srv.attach(gw, "gateway")
         for name in names:
             srv.attach(gw.replica(name).engine, name)
+        if asc is not None:
+            srv.attach(asc, "autoscaler")
+            srv.attach(asc.slo, "slo")   # /slo + burn-rate gauges too —
+            # the monitor driving the autoscaler's decisions
         srv.start()
 
     rng = np.random.RandomState(0)
@@ -146,7 +211,19 @@ def main(argv=None):
                               deadline_s=args.deadline))
     if args.drain_one and names:
         gw.drain(names[0])
-    gw.run_to_completion(max_ticks=100000)
+    if asc is None:
+        gw.run_to_completion(max_ticks=100000)
+    else:
+        # the autoscaler gets one control round per gateway round — the
+        # same interleave the simulation harness drives
+        ticks = 0
+        while gw.pending():
+            gw.step()
+            asc.evaluate()
+            ticks += 1
+            if ticks > 100000:
+                raise RuntimeError("not done after 100000 ticks")
+        gw.pop_finished()
 
     outcomes = {}
     for r in reqs:
@@ -165,6 +242,11 @@ def main(argv=None):
         "dropped": dropped,            # must stay [] — the drain contract
         "ops_url": None if srv is None else srv.url,
     }
+    if asc is not None:
+        asnap = asc.autoscaler_snapshot()
+        report["autoscaler"] = {"fleet": asnap["fleet"],
+                                "decisions": asnap["decisions"],
+                                "counters": asnap["counters"]}
     print(json.dumps(report))
     if srv is not None:
         srv.stop()

@@ -9,32 +9,36 @@
 #   SPEC_BENCH_${R}.json  - speculative-decode speedup (lossless check + tok/s)
 #   DECODE_INT8_${R}.json - gpt_decode with the int8 KV cache (A/B vs bf16)
 #   SERVE_BENCH_${R}.json - continuous-batching engine vs static batches
+#   BENCH_DIFF_${R}.json  - bench_diff of this round's bench vs the
+#                           committed BENCH_r*.json trajectory (backend-
+#                           labeled rounds compare honestly: bench_diff
+#                           classifies cross-backend pairs non-comparable)
 #
 # Usage: from /root/repo:  bash tools/tpu_session.sh
 set -u
 cd "$(dirname "$0")/.."
 export PYTHONPATH="/root/repo:/root/.axon_site"
-R="${PADDLE_TPU_ROUND:-r05}"
+R="${PADDLE_TPU_ROUND:-r06}"
 G=tools/tpu_guard.sh
 
-echo "=== 1/7 bench (all configs)"
+echo "=== 1/8 bench (all configs)"
 TPU_GUARD_LOG=/tmp/bench_all.log $G python bench.py --config all
 grep "^{" /tmp/bench_all.log | tee BENCH_pre.json
 
-echo "=== 2/7 Mosaic smoke suite"
+echo "=== 2/8 Mosaic smoke suite"
 TPU_GUARD_LOG=TPU_SMOKE_${R}.log PADDLE_TPU_TEST_TPU=1 \
     $G python -m pytest -m tpu tests/test_tpu_smoke.py -q -v
 tail -5 TPU_SMOKE_${R}.log
 
-echo "=== 3/7 fusion roofline probe"
+echo "=== 3/8 fusion roofline probe"
 TPU_GUARD_LOG=/tmp/fused_probe.log $G python tools/fused_probe.py
 grep "^{" /tmp/fused_probe.log | tee FUSED_PROBE_${R}.json
 
-echo "=== 4/7 flash block sweep (gpt2s)"
+echo "=== 4/8 flash block sweep (gpt2s)"
 TPU_GUARD_LOG=/tmp/flash_sweep.log $G python tools/flash_sweep.py
 grep "^{" /tmp/flash_sweep.log | tee FLASH_SWEEP_${R}.json
 
-echo "=== 5/7 speculative-decode speedup"
+echo "=== 5/8 speculative-decode speedup"
 TPU_GUARD_LOG=/tmp/spec_bench.log $G python tools/spec_bench.py
 if grep -q "^{" /tmp/spec_bench.log; then
     grep "^{" /tmp/spec_bench.log | tee SPEC_BENCH_${R}.json
@@ -43,16 +47,29 @@ else
     tail -5 /tmp/spec_bench.log >&2
 fi
 
-echo "=== 6/7 int8 KV-cache decode A/B"
+echo "=== 6/8 int8 KV-cache decode A/B"
 TPU_GUARD_LOG=/tmp/decode_int8.log PADDLE_TPU_DECODE_KV=int8 \
     $G python bench.py --config gpt_decode
 grep "^{" /tmp/decode_int8.log | tee DECODE_INT8_${R}.json
 
-echo "=== 7/7 continuous-batching engine throughput"
+echo "=== 7/8 continuous-batching engine throughput"
 TPU_GUARD_LOG=/tmp/serve_bench.log $G python tools/serve_bench.py --speculative
 if grep -q "^{" /tmp/serve_bench.log; then
     grep "^{" /tmp/serve_bench.log | tee SERVE_BENCH_${R}.json
 else
     echo "serve_bench FAILED (no JSON line); tail of log:" >&2
     tail -5 /tmp/serve_bench.log >&2
+fi
+
+echo "=== 8/8 bench_diff vs the committed trajectory"
+# compare this round's fresh bench artifact against the newest committed
+# BENCH_r*.json (CPU rounds included — the backend label keeps the
+# comparison honest; cross-backend pairs are classified non-comparable,
+# never regressions).  bench_diff needs no device, so no guard.
+PREV=$(ls BENCH_r*.json 2>/dev/null | sort -V | tail -1)
+if [ -n "$PREV" ] && [ -s BENCH_pre.json ]; then
+    python tools/bench_diff.py "$PREV" BENCH_pre.json --json \
+        | tee BENCH_DIFF_${R}.json || true
+else
+    echo "bench_diff skipped: no prior BENCH_r*.json or empty BENCH_pre.json"
 fi
