@@ -828,6 +828,125 @@ def bench_gpt_autoscale(on_tpu):
             "decisions": auto["decisions"]}
 
 
+def bench_gpt_chaos(on_tpu):
+    """Seeded fault-plan A/B on the fake-clock simulation harness (ISSUE
+    12): the SAME offered load AND the SAME injected faults — a replica
+    crash mid-burst, a stall window, a 40× slow straggler (a 10× one is
+    indistinguishable from quarantine-recovery noise at this tick size —
+    the straggler must dominate the off-side tail for the A/B to isolate
+    hedging), a transient
+    dispatch-error window (paddle_tpu/faults.py) — against a gateway
+    with resilience OFF vs ON (circuit breakers + bounded retry/backoff
+    + TTFT hedging + brownout, paddle_tpu/gateway.py
+    ``ResiliencePolicy``).  Asserted chaos acceptance pin: on BOTH sides
+    every admitted request reaches a terminal outcome (zero silent
+    drops) and every finished stream is an exact oracle prefix (no
+    duplicated/garbled tokens); on the resilient side retries stay
+    within budget and p99 TTFT is STRICTLY better than resilience-off
+    under the identical plan.  Latencies are SIMULATED seconds on the
+    injected clock — what this benchmarks is the failure-response
+    policy, not the hardware (the record still carries the backend
+    label for trajectory honesty)."""
+    from paddle_tpu.faults import Fault, FaultPlan, FaultyEngine
+    from paddle_tpu.gateway import ServingGateway, ResiliencePolicy
+    from paddle_tpu.simulation import (SimClock, SimEngine, SimTracer,
+                                       TrafficSim, sim_tokens, steady)
+
+    RATE, HORIZON, DT, SEED = 2.0, 120.0, 0.25, 0
+    TTFT_DEADLINE, STALL_THRESHOLD = 60.0, 4.0
+    plan = FaultPlan([
+        Fault("slow", at_s=20.0, duration_s=40.0, factor=40,
+              replica="r0"),
+        Fault("crash", at_s=30.0, replica="r1"),
+        Fault("dispatch_error", at_s=45.0, duration_s=6.0, replica="r2"),
+        Fault("stall", at_s=70.0, duration_s=12.0, replica="r2"),
+    ], seed=7)
+
+    def run(resilient):
+        clock = SimClock()
+        tracer = SimTracer(clock, capacity=32768)
+        pol = None
+        if resilient:
+            pol = ResiliencePolicy(
+                retry_budget=3, retry_backoff_s=0.25,
+                retry_backoff_max_s=2.0, retry_jitter=0.5, seed=SEED,
+                breaker_failures=3, breaker_open_s=2.5,
+                hedge=True, hedge_ttft_frac=0.05, max_hedges=8,
+                brownout=True, brownout_high=3.0, brownout_low=1.0,
+                brownout_down_dwell_s=5.0, brownout_clamp=6,
+                brownout_use_slo=False)
+        gw = ServingGateway(clock=clock, tracer=tracer,
+                            stall_threshold_s=STALL_THRESHOLD,
+                            max_queue_depth=256, resilience=pol)
+        wrappers = []
+        for i in range(3):
+            name = f"r{i}"
+            eng = SimEngine(max_slots=8, tracer=SimTracer(clock))
+            w = FaultyEngine(eng, plan, clock, replica=name)
+            wrappers.append(w)
+            gw.add_replica(w, name)
+        sim = TrafficSim(gw, clock, steady(RATE), dt=DT, seed=SEED,
+                         ttft_deadline_s=TTFT_DEADLINE)
+        rep = sim.run(HORIZON)
+        # chaos acceptance pin, part 1: every admitted request reaches a
+        # terminal outcome, and no finished stream is duplicated/garbled
+        assert not rep["dropped"], rep["dropped"]
+        for h in sim.handles:
+            if h.status == "finished":
+                assert h.tokens == sim_tokens(h.prompt, len(h.tokens)), \
+                    (h.gid, h.tokens)
+        if resilient:
+            budget = pol.retry_budget
+            assert all(h.retries <= budget for h in sim.handles), \
+                max(h.retries for h in sim.handles)
+        rep["injected"] = [ev for w in wrappers for ev in w.injected()]
+        rep["resilience"] = gw.resilience_snapshot()
+        # the decision timeline: every breaker/retry/hedge/brownout
+        # transition, in order, on the simulated clock
+        rep["timeline_resilience"] = tracer.events("resilience")
+        return rep
+
+    off = run(False)
+    on = run(True)
+    assert off["offered"] == on["offered"], (off["offered"],
+                                             on["offered"])
+    f_p99, a_p99 = off["ttft_s"]["p99"], on["ttft_s"]["p99"]
+    # chaos acceptance pin, part 2: under the identical plan the
+    # resilient gateway strictly beats resilience-off on tail latency
+    # and finishes at least as much of the offered load
+    assert a_p99 < f_p99, (a_p99, f_p99)
+    assert on["outcomes"].get("finished", 0) >= \
+        off["outcomes"].get("finished", 0), (on["outcomes"],
+                                             off["outcomes"])
+
+    def phase(rep):
+        return {"offered": rep["offered"], "outcomes": rep["outcomes"],
+                "shed_rate": round(rep["shed_rate"], 4),
+                "ttft_s_p50": rep["ttft_s"]["p50"],
+                "ttft_s_p99": rep["ttft_s"]["p99"],
+                "faults_injected": len(rep["injected"])}
+
+    counters = (on["resilience"] or {}).get("counters", {})
+    return {"metric": "gpt_chaos_ttft_s_p99", "value": a_p99,
+            "unit": "s", "direction": "lower",
+            "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
+            "loss": 0.0, "backend": "tpu" if on_tpu else "cpu",
+            "sim": {"workload": f"steady {RATE}/s", "horizon_s": HORIZON,
+                    "dt_s": DT, "seed": SEED, "clock": "simulated",
+                    "ttft_deadline_s": TTFT_DEADLINE,
+                    "stall_threshold_s": STALL_THRESHOLD},
+            "chaos": {
+                "plan": plan.to_dict(),
+                "resilience_off": phase(off),
+                "resilience_on": phase(on),
+                "p99_ttft_improvement": round(f_p99 / a_p99, 3),
+                "counters": counters,
+                "breakers": (on["resilience"] or {}).get("breakers"),
+                "brownout": (on["resilience"] or {}).get("brownout"),
+            },
+            "decisions": on["timeline_resilience"]}
+
+
 def bench_gpt_grad_comm(on_tpu):
     """Gradient-communication policy A/B on the sharded GPT trainer: one
     record comparing step time and bytes-on-wire across the grad_comm
@@ -924,6 +1043,7 @@ CONFIGS = {
     "gpt_serving_warmup": bench_gpt_serving_warmup,
     "gpt_gateway": bench_gpt_gateway,
     "gpt_autoscale": bench_gpt_autoscale,
+    "gpt_chaos": bench_gpt_chaos,
     "gpt_grad_comm": bench_gpt_grad_comm,
 }
 

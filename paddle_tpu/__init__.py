@@ -86,7 +86,8 @@ _LAZY = ("nn", "optimizer", "amp", "metric", "io", "vision", "distributed", "jit
          "incubate", "utils", "autograd", "regularizer", "callbacks", "linalg", "fft",
          "signal", "sparse", "onnx", "device", "framework", "inference",
          "quantization", "compat", "sysconfig", "hub", "reader", "dataset",
-         "serving", "telemetry", "gateway")
+         "serving", "telemetry", "gateway", "faults", "simulation",
+         "autoscaler")
 
 
 def __getattr__(name):

@@ -1,0 +1,45 @@
+"""unbounded-retry fixture: retry loops with constant sleeps.
+
+Case 1 loops forever with a fixed cadence and no exit at all (the
+strong "unbounded" diagnosis); cases 2-4 can exit (success break,
+attempt bound, deadline raise) but still re-hammer at a constant
+interval — synchronized clients hit the recovering service in lockstep.
+"""
+
+import time
+
+
+def resubmit_forever(fetch, sink):
+    while True:                           # no exit at all: unbounded
+        result = fetch()
+        if result is not None:
+            sink.append(result)
+        time.sleep(0.5)                   # BAD: unbounded + constant
+
+
+def retry_until_success(fetch):
+    while True:                           # exits only on success
+        result = fetch()
+        if result is not None:
+            break
+        time.sleep(0.5)                   # BAD: constant cadence
+    return result
+
+
+def retry_counted(fetch):
+    for _attempt in range(5):             # bounded, but constant cadence
+        result = fetch()
+        if result is not None:
+            return result
+        time.sleep(1.0)                   # BAD: no backoff/jitter
+    return None
+
+
+def retry_deadline(fetch, deadline):
+    while True:
+        result = fetch()
+        if result is not None:
+            return result
+        if time.time() > deadline:
+            raise TimeoutError("gave up")
+        time.sleep(0.2)                   # BAD: bounded, constant cadence

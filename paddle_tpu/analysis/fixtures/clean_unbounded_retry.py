@@ -1,0 +1,47 @@
+"""unbounded-retry near-misses: every loop here is silent.
+
+Backoff that GROWS (with jitter) is the compliant retry shape; a
+condition-bounded poll or data iteration paces work rather than
+retrying it, and a ``while True`` event loop without sleeps is not a
+retry at all.
+"""
+
+import random
+import time
+
+
+def retry_with_backoff(fetch):
+    for attempt in range(5):
+        result = fetch()
+        if result is not None:
+            return result
+        time.sleep(0.1 * (2 ** attempt) + random.uniform(0.0, 0.05))
+    return None
+
+
+def retry_with_variable_delay(fetch, delay):
+    for _attempt in range(3):
+        result = fetch()
+        if result is not None:
+            return result
+        time.sleep(delay)                 # computed by the caller
+    return None
+
+
+def poll_until(done):
+    while not done():                     # condition-bounded poll loop
+        time.sleep(0.1)
+
+
+def paced_iteration(items, handle):
+    for item in items:                    # data iteration, not retries
+        handle(item)
+        time.sleep(0.2)
+
+
+def event_loop(queue, handle):
+    while True:                           # no sleeps: not a retry loop
+        item = queue.get()
+        if item is None:
+            return
+        handle(item)

@@ -700,3 +700,97 @@ class BlockingFetchInLoop(Rule):
         if name in BLOCKING_FETCH_CALLS:
             return f"{name}() fetch"
         return None
+
+
+# ------------------------------------------------------------------ rule 11
+
+#: resolved fullnames that pause the current thread between attempts
+SLEEP_CALLS = {"time.sleep"}
+
+
+@register
+class UnboundedRetry(Rule):
+    name = "unbounded-retry"
+    hints = ("sleep",)
+    hazard = ("a retry loop that sleeps a CONSTANT between attempts (no "
+              "exponential backoff, no jitter) — or retries forever with "
+              "no attempt bound — turns one transient fault into a "
+              "synchronized retry storm: every client hammers the "
+              "recovering service at the same fixed cadence, exactly the "
+              "overload the gateway's resilience layer exists to absorb "
+              "(docs/RESILIENCE.md retry-budget semantics; gateway.py "
+              "ResiliencePolicy.backoff_s is the compliant shape)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        seen: Set[int] = set()   # nested loops: one sleep reports ONCE
+        # (ast.walk is outermost-first, so the outermost qualifying loop
+        # claims the site — the blocking-fetch-in-loop dedup discipline)
+        for node in ast.walk(ctx.tree):
+            retry_kind = self._retry_loop_kind(ctx, node)
+            if retry_kind is None:
+                continue
+            body = list(node.body) + list(node.orelse)
+            sleeps = [sub for sub in _walk_skipping_nested_defs(body)
+                      if isinstance(sub, ast.Call) and id(sub) not in seen
+                      and self._constant_sleep(ctx, sub)]
+            seen.update(id(call) for call in sleeps)
+            if not sleeps:
+                continue
+            # any exit statement (raise on a deadline, break/return on
+            # success or a counted bound) makes the loop escapable; only
+            # a while-True with NO exit at all earns the stronger
+            # "unbounded" diagnosis — a break-bounded retry is
+            # misdiagnosed as unbounded otherwise
+            bounded = retry_kind == "for-range" or any(
+                isinstance(sub, (ast.Raise, ast.Break, ast.Return))
+                for sub in _walk_skipping_nested_defs(body))
+            for call in sleeps:
+                if not bounded:
+                    yield self.finding(
+                        ctx, call,
+                        "unbounded retry: `while True` with a constant "
+                        "time.sleep and no exit at all (no raise/break/"
+                        "return) — bound the attempts and use "
+                        "exponential backoff with jitter")
+                else:
+                    yield self.finding(
+                        ctx, call,
+                        "retry loop sleeps a constant between attempts — "
+                        "no backoff, no jitter: synchronized clients "
+                        "re-hammer a recovering service in lockstep; use "
+                        "exponential backoff with jitter (or pragma why "
+                        "a fixed cadence is correct here)")
+
+    @staticmethod
+    def _retry_loop_kind(ctx: FileContext, node: ast.AST) -> Optional[str]:
+        """'while-true' for ``while True/1:``, 'for-range' for ``for _ in
+        range(...)`` (the counted-attempts idiom); None for every other
+        loop — a condition-bounded ``while not done():`` poll or a
+        data-iteration ``for item in items:`` is pacing work, not
+        retrying it."""
+        if isinstance(node, ast.While):
+            test = node.test
+            if isinstance(test, ast.Constant) and bool(test.value):
+                return "while-true"
+            return None
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            it = node.iter
+            if isinstance(it, ast.Call) and ctx.resolve(it.func) in (
+                    "range", "builtins.range"):
+                return "for-range"
+            return None
+        return None
+
+    @staticmethod
+    def _constant_sleep(ctx: FileContext, call: ast.Call) -> bool:
+        """``time.sleep(<numeric literal>)`` — a computed argument
+        (``base * 2**i``, a jittered ``random.uniform``, a variable) is
+        treated as backoff and exempt."""
+        if ctx.resolve(call.func) not in SLEEP_CALLS:
+            return False
+        if len(call.args) != 1 or call.keywords:
+            return False
+        arg = call.args[0]
+        return (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, (int, float))
+                and not isinstance(arg.value, bool))

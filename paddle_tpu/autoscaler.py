@@ -17,6 +17,12 @@ without operator babysitting.
   fleet under-provisioned; ``resolved``/``cancelled`` clears it.  The
   autoscaler drives ``slo.evaluate()`` each round, so the alert state
   machine advances on the controller's (injectable) clock.
+- *scale-up (resilience)*: OPEN circuit breakers, read from
+  ``gateway.breakers_open()`` (the PR 12 resilience layer) — a replica
+  whose breaker is open is missing capacity the SLO math has not priced
+  in yet, so breaker-open counts as an under-provisioned signal
+  alongside firing objectives.  Gateways without a resilience policy
+  report none; nothing changes.
 - *scale-down*: sustained low utilization.  Utilization is the fleet's
   outstanding-work occupancy — (in-flight requests + queued requests)
   over total engine slots across ACTIVE replicas — optionally
@@ -268,6 +274,21 @@ class ElasticAutoscaler:
         with self._firing_lock:
             return sorted(self._firing)
 
+    def breakers_open(self) -> List[str]:
+        """Replica names whose gateway circuit breaker is OPEN (the
+        resilience-side scale-up signal); empty when the gateway has no
+        resilience layer — or a broken one (a poll failure must not take
+        the controller down)."""
+        get = getattr(self.gateway, "breakers_open", None)
+        if get is None:
+            return []
+        try:
+            return list(get())
+        except Exception as e:  # noqa: BLE001 — pull-source discipline,
+            # same as the ledger poll
+            self._log.debug("autoscaler: breaker poll failed: %r", e)
+            return []
+
     def utilization(self) -> Dict[str, Any]:
         """The scale-down signal: fleet occupancy — (in-flight + queued)
         requests over total ACTIVE engine slots — plus the raw terms and,
@@ -342,14 +363,20 @@ class ElasticAutoscaler:
                 return None
             return self._spawn(now, reason="min_bound", firing=firing,
                                utilization=util)
-        if firing:
+        breakers = self.breakers_open()
+        if firing or breakers:
             self._idle_since = None          # under-provisioned ≠ idle
             in_up_cooldown = (
                 self._last_up_at is not None
                 and now - self._last_up_at < self.scale_up_cooldown_s)
             if self.fleet_size() < self.max_replicas \
                     and not in_up_cooldown and not self._spawn_backoff(now):
-                return self._spawn(now, reason="slo:" + ",".join(firing),
+                parts = []
+                if firing:
+                    parts.append("slo:" + ",".join(firing))
+                if breakers:
+                    parts.append("breaker:" + ",".join(breakers))
+                return self._spawn(now, reason="+".join(parts),
                                    firing=firing, utilization=util)
             return None
         self._track_idle(now, util["occupancy"])
@@ -645,6 +672,7 @@ class ElasticAutoscaler:
                                    for rep in active + draining]},
             "pending": [s.to_dict() for s in self._pending],
             "signals": {"firing": self.firing(),
+                        "breakers_open": self.breakers_open(),
                         "utilization": self.utilization(),
                         "idle_since": self._idle_since,
                         "idle_for_s": (None if self._idle_since is None

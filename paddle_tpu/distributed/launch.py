@@ -223,6 +223,7 @@ def launch(argv=None) -> int:
                         _drain(alive)
                         alive = {}
                         break
+                # tpulint: disable=unbounded-retry(child-process poll cadence, not a retry against a failing service — the outer restart loop is bounded by max_restarts and the sleep paces p.poll(), where backoff would only delay crash detection)
                 time.sleep(0.5)
         finally:
             for _, log in procs:

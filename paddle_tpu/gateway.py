@@ -49,10 +49,31 @@ its in-flight requests run to completion (zero drops); optionally a
 (PR 6) while the old replica drains, and takes traffic the moment the
 drain completes — the rolling-restart primitive.
 
+**Resilience** (opt-in via ``resilience=ResiliencePolicy(...)``) — the
+failure-response layer above quarantine (docs/RESILIENCE.md): per-replica
+CIRCUIT BREAKERS (closed → open on consecutive dispatch failures /
+stall-timeouts, half-open probe after ``breaker_open_s``, operator-visible
+state), bounded RETRY of :class:`~paddle_tpu.faults
+.TransientDispatchError` dispatches with exponential backoff + seeded
+jitter and a per-request retry budget (exhaustion is a structured
+:class:`RetriesExhausted`, never a silent drop), HEDGED dispatch for
+requests whose TTFT deadline is at risk (a second attempt races on
+another replica; the first token decides the winner and the loser is
+``Engine.cancel``-ed — the consumer stream is single-sourced by
+construction), and a BROWNOUT degradation ladder driven by
+occupancy/SLO burn (``normal`` → clamp ``max_new_tokens`` →
+priority-0-only admission → shed-all; every rung a structured,
+observable state with dwell hysteresis, docs/RESILIENCE.md runbook).
+With ``resilience=None`` (default) none of these paths run — engine
+lowerings and program-cache keys are identical either way (host-side
+control flow only).
+
 The gateway is COOPERATIVE and single-threaded, like the engines it
-fronts: ``step()`` runs one round (health → expiry → drains → dispatch →
-replica steps → harvest → in-flight deadlines), and ``run_to_completion``
-drives it.  With a ``tracer=`` it emits ``gateway`` events
+fronts: ``step()`` runs one round (health → brownout → expiry → drains →
+dispatch → hedging → replica steps → harvest → in-flight deadlines), and
+``run_to_completion`` drives it.  A replica whose ``step()`` RAISES
+mid-tick is quarantined and its in-flight work replayed — one broken
+engine never poisons the whole gateway tick.  With a ``tracer=`` it emits ``gateway`` events
 (shed/expired/dispatch/reroute/quarantine/drain) through the PR 2 Tracer
 — ring buffer, ``summary()``, Prometheus, and chrome exports included —
 and ``ops_server.OpsServer.attach(gateway)`` serves the live
@@ -80,14 +101,17 @@ from __future__ import annotations
 import collections
 import itertools
 import logging
+import random
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .faults import TransientDispatchError
 from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
                           prometheus_text as _prometheus_text)
 
 __all__ = ["ServingGateway", "GatewayRequest", "Replica", "Overloaded",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "ResiliencePolicy", "CircuitBreaker",
+           "RetriesExhausted", "Brownout", "BROWNOUT_LEVELS"]
 
 #: replica lifecycle states
 ACTIVE = "active"
@@ -154,6 +178,307 @@ class DeadlineExceeded:
                 f"tokens_delivered={self.tokens_delivered})")
 
 
+class RetriesExhausted:
+    """Structured terminal failure: every retry of a transiently failing
+    dispatch was spent.  ``attempts`` counts dispatch attempts made (the
+    first try plus ``budget`` retries), ``last_error`` is the repr of
+    the final :class:`~paddle_tpu.faults.TransientDispatchError`.  Lands
+    on ``GatewayRequest.error`` with ``status == "failed"`` — bounded
+    retry never becomes an unbounded silent loop."""
+
+    __slots__ = ("attempts", "budget", "last_error")
+
+    def __init__(self, attempts, budget, last_error):
+        self.attempts = attempts
+        self.budget = budget
+        self.last_error = last_error
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"RetriesExhausted(attempts={self.attempts}, "
+                f"budget={self.budget}, last_error={self.last_error!r})")
+
+
+class Brownout:
+    """Structured brownout rejection: the degradation ladder is at a
+    rung that does not admit this request (``priority_only`` admits only
+    priority 0; ``shed_all`` admits nothing).  Like :class:`Overloaded`
+    it is a retryable-backpressure signal, but it names the LADDER state
+    — the client can distinguish "queue full" from "service degraded"."""
+
+    __slots__ = ("level", "label", "priority")
+
+    def __init__(self, level, label, priority):
+        self.level = level
+        self.label = label
+        self.priority = priority
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"Brownout(level={self.level}, label={self.label!r}, "
+                f"priority={self.priority})")
+
+
+#: brownout ladder rungs, lowest (healthy) first — the gauge encoding
+BROWNOUT_LEVELS = ("normal", "clamp", "priority_only", "shed_all")
+
+
+class CircuitBreaker:
+    """Per-replica dispatch circuit breaker (docs/RESILIENCE.md state
+    machine).  CLOSED counts consecutive failures; ``failures_to_open``
+    of them OPEN the breaker — the replica leaves the routing set.
+    After ``open_s`` the next routing inquiry moves it to HALF_OPEN,
+    which admits exactly ONE probe dispatch: a success CLOSES the
+    breaker, a failure re-OPENS it (and re-arms the window).  Pure host
+    state on the gateway's injectable clock — deterministic under the
+    simulation harness."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    __slots__ = ("failures_to_open", "open_s", "state",
+                 "consecutive_failures", "opened_at", "_probe_inflight",
+                 "probe_gid")
+
+    def __init__(self, failures_to_open: int = 3, open_s: float = 5.0):
+        if int(failures_to_open) < 1:
+            raise ValueError("failures_to_open must be >= 1")
+        if float(open_s) <= 0:
+            raise ValueError("open_s must be > 0")
+        self.failures_to_open = int(failures_to_open)
+        self.open_s = float(open_s)
+        self.state = self.CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self._probe_inflight = False
+        #: gid of the request holding the HALF_OPEN probe claim — the
+        #: probe's verdict (success/failure/release) is keyed to THIS
+        #: request, so an unrelated pre-open in-flight request
+        #: terminating cannot free or fail a probe it never held
+        self.probe_gid: Optional[int] = None
+
+    def allow(self, now: float) -> bool:
+        """May a dispatch be routed here at ``now``?  Advances OPEN →
+        HALF_OPEN once the window has elapsed; HALF_OPEN admits one
+        probe at a time (``note_dispatch`` claims it)."""
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.OPEN:
+            if now - (self.opened_at or 0.0) < self.open_s:
+                return False
+            self.state = self.HALF_OPEN
+            self._probe_inflight = False
+        return not self._probe_inflight
+
+    def note_dispatch(self, now: float, gid: Optional[int] = None):
+        """A dispatch was actually sent (the HALF_OPEN probe claim)."""
+        if self.state == self.HALF_OPEN:
+            self._probe_inflight = True
+            self.probe_gid = gid
+
+    def effectively_open(self, now: float) -> bool:
+        """OPEN *and* still inside the window at ``now`` — the
+        non-mutating form of what ``allow`` would answer.  An OPEN
+        breaker whose window has elapsed is one routing inquiry away
+        from HALF_OPEN, so it is not missing capacity: consumers that
+        never route (an idle fleet, the autoscaler's signal scan) must
+        not treat it as open forever."""
+        return (self.state == self.OPEN
+                and now - (self.opened_at or 0.0) < self.open_s)
+
+    def record_failure(self, now: float) -> bool:
+        """One dispatch failure / stall-timeout; True when this one
+        OPENED (or re-opened) the breaker."""
+        self.consecutive_failures += 1
+        if self.state == self.HALF_OPEN or (
+                self.state == self.CLOSED
+                and self.consecutive_failures >= self.failures_to_open):
+            self.state = self.OPEN
+            self.opened_at = now
+            self._probe_inflight = False
+            self.probe_gid = None
+            return True
+        if self.state == self.OPEN:
+            self.opened_at = now          # still failing: re-arm window
+        return False
+
+    def release_probe(self):
+        """The HALF_OPEN probe ended without a verdict (client cancel
+        before any token): free the claim so the next dispatch can
+        probe — neither a success nor a failure."""
+        self._probe_inflight = False
+        self.probe_gid = None
+
+    def record_success(self) -> bool:
+        """A dispatch delivered (first token or finish); True when this
+        CLOSED a non-closed breaker."""
+        self.consecutive_failures = 0
+        self._probe_inflight = False
+        self.probe_gid = None
+        if self.state != self.CLOSED:
+            self.state = self.CLOSED
+            self.opened_at = None
+            return True
+        return False
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"state": self.state,
+                "consecutive_failures": self.consecutive_failures,
+                "opened_at": self.opened_at,
+                "failures_to_open": self.failures_to_open,
+                "open_s": self.open_s}
+
+    def __repr__(self):
+        return (f"CircuitBreaker({self.state}, "
+                f"failures={self.consecutive_failures}/"
+                f"{self.failures_to_open})")
+
+
+class ResiliencePolicy:
+    """Every resilience knob, explicit (docs/RESILIENCE.md semantics):
+
+    - **retry**: ``retry_budget`` retries per request beyond the first
+      attempt; backoff ``min(retry_backoff_max_s, retry_backoff_s *
+      2**(attempt-1))`` scaled by a seeded jitter in ``[1 - retry_jitter,
+      1 + retry_jitter]`` — the EQuARX discipline applied to retries: the
+      added load is BOUNDED and documented, never an open loop.
+    - **breaker**: ``breaker_failures`` consecutive failures open a
+      replica's breaker for ``breaker_open_s`` (half-open probe after).
+    - **hedge**: with ``hedge=True``, a dispatched request that has no
+      first token by ``hedge_ttft_frac`` of its ``ttft_deadline_s`` gets
+      ONE hedged attempt on another replica, bounded fleet-wide by
+      ``max_hedges`` concurrent hedges (the hedge budget: worst-case
+      extra work is ``max_hedges`` duplicate decodes, never 2× traffic).
+    - **brownout**: occupancy ((in-flight + queued) / active slots)
+      above ``brownout_high`` — or, with ``brownout_use_slo``, any
+      firing SLO — climbs the ladder one rung per ``brownout_up_dwell_s``
+      of sustained pressure; occupancy below ``brownout_low`` descends
+      one rung per ``brownout_down_dwell_s``.  The band between the two
+      thresholds holds the current rung (no flapping).  Rung 1+ clamps
+      dispatched ``max_new_tokens`` to ``brownout_clamp``."""
+
+    __slots__ = ("retry_budget", "retry_backoff_s", "retry_backoff_max_s",
+                 "retry_jitter", "seed", "breaker_failures",
+                 "breaker_open_s", "hedge", "hedge_ttft_frac",
+                 "max_hedges", "brownout", "brownout_high", "brownout_low",
+                 "brownout_up_dwell_s", "brownout_down_dwell_s",
+                 "brownout_clamp", "brownout_use_slo")
+
+    def __init__(self, *, retry_budget: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 retry_backoff_max_s: float = 2.0,
+                 retry_jitter: float = 0.5, seed: int = 0,
+                 breaker_failures: int = 3, breaker_open_s: float = 5.0,
+                 hedge: bool = True, hedge_ttft_frac: float = 0.5,
+                 max_hedges: int = 4, brownout: bool = True,
+                 brownout_high: float = 2.0, brownout_low: float = 0.75,
+                 brownout_up_dwell_s: float = 0.0,
+                 brownout_down_dwell_s: float = 5.0,
+                 brownout_clamp: int = 16,
+                 brownout_use_slo: bool = True):
+        if int(retry_budget) < 0:
+            raise ValueError("retry_budget must be >= 0")
+        if float(retry_backoff_s) < 0 or float(retry_backoff_max_s) < 0:
+            raise ValueError("backoff times must be >= 0")
+        if not 0.0 <= float(retry_jitter) < 1.0:
+            raise ValueError("retry_jitter must be in [0, 1)")
+        if not 0.0 < float(hedge_ttft_frac) <= 1.0:
+            raise ValueError("hedge_ttft_frac must be in (0, 1]")
+        if int(max_hedges) < 0:
+            raise ValueError("max_hedges must be >= 0")
+        if float(brownout_low) >= float(brownout_high):
+            raise ValueError("need brownout_low < brownout_high (the "
+                             "hysteresis band)")
+        if int(brownout_clamp) < 1:
+            raise ValueError("brownout_clamp must be >= 1")
+        self.retry_budget = int(retry_budget)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.retry_backoff_max_s = float(retry_backoff_max_s)
+        self.retry_jitter = float(retry_jitter)
+        self.seed = int(seed)
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_open_s = float(breaker_open_s)
+        self.hedge = bool(hedge)
+        self.hedge_ttft_frac = float(hedge_ttft_frac)
+        self.max_hedges = int(max_hedges)
+        self.brownout = bool(brownout)
+        self.brownout_high = float(brownout_high)
+        self.brownout_low = float(brownout_low)
+        self.brownout_up_dwell_s = float(brownout_up_dwell_s)
+        self.brownout_down_dwell_s = float(brownout_down_dwell_s)
+        self.brownout_clamp = int(brownout_clamp)
+        self.brownout_use_slo = bool(brownout_use_slo)
+
+    def backoff_s(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based): exponential,
+        capped, jittered from the gateway's seeded RNG."""
+        base = min(self.retry_backoff_max_s,
+                   self.retry_backoff_s * (2.0 ** max(attempt - 1, 0)))
+        if self.retry_jitter == 0.0:
+            return base
+        return base * (1.0 + self.retry_jitter * (2.0 * rng.random() - 1.0))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"ResiliencePolicy(retries={self.retry_budget}, "
+                f"breaker={self.breaker_failures}/{self.breaker_open_s}s, "
+                f"hedge={self.hedge}, brownout={self.brownout})")
+
+
+class _BrownoutLadder:
+    """The brownout state machine: one rung at a time, dwell-gated both
+    ways, with the ``[low, high]`` hysteresis band holding the current
+    rung (the telemetry_slo resolve-band discipline — pressure hovering
+    at a threshold cannot flap the ladder)."""
+
+    def __init__(self, policy: ResiliencePolicy):
+        self.policy = policy
+        self.level = 0
+        self.changed_at: Optional[float] = None
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+
+    def evaluate(self, now: float, pressure: float,
+                 slo_firing: bool) -> int:
+        """Advance the ladder; returns +1 / -1 on a rung change this
+        round, else 0."""
+        p = self.policy
+        if pressure >= p.brownout_high or slo_firing:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if self.level < len(BROWNOUT_LEVELS) - 1 \
+                    and now - self._above_since >= p.brownout_up_dwell_s:
+                self.level += 1
+                self.changed_at = now
+                self._above_since = now      # next rung needs its own dwell
+                return +1
+        elif pressure <= p.brownout_low:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if self.level > 0 \
+                    and now - self._below_since >= p.brownout_down_dwell_s:
+                self.level -= 1
+                self.changed_at = now
+                self._below_since = now
+                return -1
+        else:
+            # inside the hysteresis band: hold the rung, reset dwells
+            self._above_since = None
+            self._below_since = None
+        return 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"level": self.level, "label": BROWNOUT_LEVELS[self.level],
+                "changed_at": self.changed_at}
+
+
 class GatewayRequest:
     """One gateway-tracked request (host-side handle).  ``status`` walks
     ``queued`` → ``dispatched`` → ``finished``, or terminates early as
@@ -166,7 +491,8 @@ class GatewayRequest:
                  "status", "tokens", "error", "replica", "engine_rid",
                  "submitted_at", "dispatched_at", "first_token_at",
                  "finished_at", "replays", "trace", "_rerouting",
-                 "_pending_expiry")
+                 "_pending_expiry", "retries", "not_before", "hedged",
+                 "hedge_replica", "hedge_rid", "dispatch_max_new")
 
     def __init__(self, gid, prompt, max_new_tokens, priority,
                  ttft_deadline_s, deadline_s, sampling, on_token,
@@ -195,6 +521,18 @@ class GatewayRequest:
         self.trace = None
         self._rerouting = False
         self._pending_expiry: Optional[DeadlineExceeded] = None
+        # resilience bookkeeping (all inert when resilience is off):
+        # dispatch retries spent, earliest next dispatch (backoff),
+        # hedge-attempt identity (replica name + engine rid of the
+        # SECOND in-flight attempt, None once resolved), and the
+        # possibly-brownout-clamped budget the live attempt was
+        # dispatched with
+        self.retries = 0
+        self.not_before: Optional[float] = None
+        self.hedged = False
+        self.hedge_replica: Optional[str] = None
+        self.hedge_rid: Optional[int] = None
+        self.dispatch_max_new: Optional[int] = None
 
     @property
     def done(self) -> bool:
@@ -217,6 +555,7 @@ class GatewayRequest:
                 "prompt_len": len(self.prompt),
                 "max_new_tokens": self.max_new_tokens,
                 "tokens": len(self.tokens), "replays": self.replays,
+                "retries": self.retries, "hedged": self.hedged,
                 "trace_id": (None if self.trace is None
                              else self.trace.trace_id),
                 "error": (err.to_dict() if hasattr(err, "to_dict")
@@ -225,6 +564,17 @@ class GatewayRequest:
     def __repr__(self):
         return (f"GatewayRequest(gid={self.gid}, status={self.status!r}, "
                 f"replica={self.replica!r}, tokens={len(self.tokens)})")
+
+
+def _engine_slots(engine) -> int:
+    """Slot capacity of one engine — the serving engines expose ``S``
+    (max_slots); anything else counts as one slot.  Shared with the
+    autoscaler's occupancy signal (one definition of "a slot")."""
+    for attr in ("S", "max_slots"):
+        v = getattr(engine, attr, None)
+        if isinstance(v, int) and v > 0:
+            return v
+    return 1
 
 
 class Replica:
@@ -279,6 +629,7 @@ class ServingGateway:
                  priorities: int = 2, stall_threshold_s: float = 30.0,
                  tracer=None, clock: Callable[[], float] = time.monotonic,
                  request_history: int = 4096,
+                 resilience: Optional[ResiliencePolicy] = None,
                  logger: Optional[logging.Logger] = None):
         if int(priorities) < 1:
             raise ValueError("priorities must be >= 1")
@@ -316,6 +667,17 @@ class ServingGateway:
         self._stats = StatRegistry()
         self._stats.histogram("queue_seconds", DEFAULT_TIME_BUCKETS)
         self._stats.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
+        # resilience layer (None = every resilience path is one attribute
+        # check and the pre-resilience control flow byte-for-byte)
+        self.resilience = resilience
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._brownout: Optional[_BrownoutLadder] = None
+        self._hedges_live = 0
+        self._rstats = StatRegistry()
+        self._rrng = random.Random(0 if resilience is None
+                                   else resilience.seed)
+        if resilience is not None and resilience.brownout:
+            self._brownout = _BrownoutLadder(resilience)
         for engine in (replicas or []):
             self.add_replica(engine)
 
@@ -338,6 +700,10 @@ class ServingGateway:
                 self._replicas[name].state != STOPPED:
             raise ValueError(f"replica {name!r} already registered")
         self._replicas[name] = Replica(name, engine)
+        if self.resilience is not None:
+            self._breakers[name] = CircuitBreaker(
+                self.resilience.breaker_failures,
+                self.resilience.breaker_open_s)
         self._stats.add("replicas_added")
         return name
 
@@ -355,6 +721,7 @@ class ServingGateway:
                              f"stopped replicas can be removed (drain it "
                              f"first)")
         del self._replicas[name]
+        self._breakers.pop(name, None)
         self._stats.add("replicas_removed")
         self._emit("removed", replica=name)
         return rep
@@ -411,6 +778,10 @@ class ServingGateway:
         was_draining = rep.state == DRAINING
         rep.state = QUARANTINED
         rep.reason = reason
+        # a quarantine is the stall/timeout form of a dispatch failure:
+        # the breaker opens too, so an operator reinstate() is probed
+        # (half-open) instead of trusted blindly
+        self._breaker_failure(name, self._clock(), reason)
         self._stats.add("quarantines")
         self._emit("quarantine", replica=name, reason=reason,
                    inflight=len(rep.inflight))
@@ -521,6 +892,20 @@ class ServingGateway:
                    prompt_len=len(prompt),
                    max_new_tokens=req.max_new_tokens,
                    **self._trace_fields(req))
+        if self._brownout is not None and self._brownout.level >= 2:
+            lvl = self._brownout.level
+            if lvl >= 3 or req.priority > 0:
+                # the ladder's admission rungs: priority_only admits only
+                # priority 0, shed_all admits nothing — structured, never
+                # silent (same contract as Overloaded)
+                req.error = Brownout(lvl, BROWNOUT_LEVELS[lvl],
+                                     req.priority)
+                self._rstats.add("brownout_sheds")
+                self._finalize(req, "shed", now)
+                self._emit("shed", gid=req.gid, priority=req.priority,
+                           over="brownout", level=lvl,
+                           **self._trace_fields(req))
+                return req
         q = self._queues[req.priority]
         qtok = self._queued_tokens[req.priority]
         over_depth = len(q) >= self.max_queue_depth
@@ -586,20 +971,46 @@ class ServingGateway:
     # -------------------------------------------------------- scheduling --
 
     def step(self):
-        """One gateway round: health-check replicas, expire overdue queued
-        requests, advance drains, dispatch to replicas, step every replica
-        with work, harvest completions, enforce in-flight deadlines."""
+        """One gateway round: health-check replicas, advance the brownout
+        ladder, expire overdue queued requests, advance drains, dispatch
+        to replicas, hedge TTFT-at-risk requests, step every replica with
+        work, harvest completions, enforce in-flight deadlines.  A
+        replica whose ``step()`` raises is quarantined and replayed —
+        the exception never escapes the gateway tick."""
         self._check_health()
         now = self._clock()
+        if self._brownout is not None:
+            self._evaluate_brownout(now)
         self._expire_queued(now)
         self._advance_drains()
         self._dispatch(now)
-        for rep in self._replicas.values():
+        if self.resilience is not None and self.resilience.hedge:
+            self._maybe_hedge(self._clock())
+        for rep in list(self._replicas.values()):
             if rep.state in (ACTIVE, DRAINING) and rep.engine.pending():
-                rep.engine.step()
+                try:
+                    rep.engine.step()
+                except Exception as e:  # noqa: BLE001 — isolation: one
+                    # raising engine must never poison the whole tick
+                    self._on_step_error(rep, e)
         self._harvest()
         self._enforce_inflight_deadlines(self._clock())
         self._advance_drains()
+
+    def _on_step_error(self, rep: Replica, exc: BaseException):
+        """A replica engine raised mid-tick: surface it, open its
+        breaker, quarantine it (in-flight work replays elsewhere after
+        the documented replay signal) — the other replicas' work in this
+        very tick proceeds untouched."""
+        self._stats.add("step_errors")
+        self._log.warning("gateway: replica %s step() raised: %r — "
+                          "quarantining and replaying its in-flight work",
+                          rep.name, exc)
+        self._emit("replica_step_error", replica=rep.name,
+                   error=repr(exc))
+        # quarantine() records the breaker failure (the stall/timeout
+        # form); no separate count here or one event would tick twice
+        self.quarantine(rep.name, reason=f"step raised: {exc!r}")
 
     def pending(self) -> bool:
         if any(self._queues):
@@ -682,7 +1093,9 @@ class ServingGateway:
                                              waited, 0)
                 self._finalize(req, "expired", now)
                 self._stats.add(f"expired_{kind}")
-                self._emit("expired", gid=req.gid, kind=kind,
+                # field name "deadline", not "kind": "kind" is the ring
+                # event's reserved key (Tracer.emit's positional)
+                self._emit("expired", gid=req.gid, deadline=kind,
                            waited_s=waited, where="queued",
                            **self._trace_fields(req))
             self._queues[pri] = keep
@@ -690,6 +1103,12 @@ class ServingGateway:
     def _enforce_inflight_deadlines(self, now: float):
         for rep in self._replicas.values():
             for rid, req in list(rep.inflight.items()):
+                if req.done:
+                    continue    # hedged twin already finalized this round
+                if not (rep.name == req.replica
+                        and rid == req.engine_rid):
+                    continue    # hedge-attempt entry: enforced via its
+                    #             primary (both attempts are cancelled)
                 waited = now - req.submitted_at
                 kind = None
                 if req.deadline_s is not None and waited > req.deadline_s:
@@ -704,11 +1123,12 @@ class ServingGateway:
                     kind, req.deadline_s if kind == "total"
                     else req.ttft_deadline_s, waited, len(req.tokens))
                 self._stats.add(f"expired_{kind}")
-                self._emit("expired", gid=req.gid, kind=kind,
+                self._emit("expired", gid=req.gid, deadline=kind,
                            waited_s=waited, where="inflight",
                            replica=rep.name,
                            tokens_delivered=len(req.tokens),
                            **self._trace_fields(req))
+                self._abort_hedge(req)    # no-op when not hedging
                 if not rep.engine.cancel(rid):
                     # lost the race with retirement: the engine finished
                     # it this very round — harvest delivers it, the
@@ -732,24 +1152,62 @@ class ServingGateway:
 
     def _dispatch(self, now: float):
         """Move queued requests onto replicas, highest priority first,
-        FIFO within a priority, while any replica has admission
-        headroom."""
-        for pri, q in enumerate(self._queues):
+        FIFO within a priority, while any replica has admission headroom.
+        With resilience on, requests inside their retry backoff window
+        (``not_before``) are stepped over — they keep their queue
+        position but never block the requests behind them."""
+        if self.resilience is None:
+            for pri, q in enumerate(self._queues):
+                while q:
+                    target = self._route(q[0], now)
+                    if target is None:
+                        return          # fleet-wide: no headroom anywhere
+                    req = q.popleft()
+                    self._queued_tokens[pri] -= req.est_tokens
+                    self._dispatch_to(target, req, now)
+            return
+        fleet_full = False
+        for pri in range(self.priorities):
+            if fleet_full:
+                break          # _route candidacy is request-independent:
+                #                no headroom for one request this tick
+                #                means none for any (same early exit as
+                #                the non-resilience loop)
+            q = self._queues[pri]
+            deferred: collections.deque = collections.deque()
             while q:
-                target = self._route(q[0])
-                if target is None:
-                    return              # fleet-wide: no headroom anywhere
                 req = q.popleft()
+                if req.not_before is not None and now < req.not_before:
+                    deferred.append(req)      # backing off: hold in place
+                    continue
+                target = self._route(req, now)
+                if target is None:
+                    # no headroom anywhere: put everything back, done
+                    deferred.append(req)
+                    deferred.extend(q)
+                    q.clear()
+                    fleet_full = True
+                    break
                 self._queued_tokens[pri] -= req.est_tokens
-                self._dispatch_to(target, req, now)
+                if self._dispatch_to(target, req, now) is not None:
+                    # transient dispatch failure: the request is backing
+                    # off for a retry — hold it in this queue
+                    self._queued_tokens[pri] += req.est_tokens
+                    deferred.append(req)
+            self._queues[pri] = deferred
 
-    def _route(self, req: GatewayRequest) -> Optional[Replica]:
+    def _route(self, req: GatewayRequest, now: float,
+               exclude: Optional[str] = None) -> Optional[Replica]:
         """Pick the target replica: among ACTIVE replicas with admission
-        headroom, the deepest prefix-cache match wins (prefix affinity);
+        headroom (and, with resilience on, a breaker that allows
+        dispatch), the deepest prefix-cache match wins (prefix affinity);
         ties — including the common no-match case — go to the least
-        outstanding tokens."""
+        outstanding tokens.  ``exclude`` drops one name (the hedge path
+        never hedges onto the primary's replica)."""
         cands = [rep for rep in self._replicas.values()
-                 if rep.state == ACTIVE and rep.slots_available() > 0]
+                 if rep.state == ACTIVE and rep.slots_available() > 0
+                 and rep.name != exclude
+                 and self._breaker_allows(rep.name, now)]
         if not cands:
             return None
         scored = [(-self._prefix_depth(rep.engine, req.prompt),
@@ -780,17 +1238,29 @@ class ServingGateway:
             depth += 1
         return depth
 
-    def _dispatch_to(self, rep: Replica, req: GatewayRequest, now: float):
+    def _dispatch_to(self, rep: Replica, req: GatewayRequest, now: float
+                     ) -> Optional[GatewayRequest]:
+        """Dispatch one queued request onto ``rep``.  Returns None when
+        the request left the queue (dispatched, or terminally failed);
+        returns the request itself when a TRANSIENT failure put it into
+        retry backoff and the caller must hold it queued."""
         queue_s = now - req.submitted_at
         # one child span per engine attempt (reroute re-dispatches mint a
         # fresh one): the engine binds its rid to this context, so the
         # attempt's whole timeline carries the shared trace_id
         ctx = req.trace.child() if req.trace is not None else None
+        mnt = req.max_new_tokens
+        if self._brownout is not None and self._brownout.level >= 1:
+            # rung 1+ clamps the generation budget — the service sheds
+            # WORK before it sheds REQUESTS
+            mnt = min(mnt, self.resilience.brownout_clamp)
         try:
             rid = rep.engine.add_request(
-                req.prompt, req.max_new_tokens,
+                req.prompt, mnt,
                 on_token=self._make_on_token(rep, req), trace_ctx=ctx,
                 **req.sampling)
+        except TransientDispatchError as e:
+            return self._on_transient_dispatch_error(rep, req, now, e)
         except (ValueError, TypeError, NotImplementedError) as e:
             # a structurally unservable request (prompt over max_len,
             # sampling knobs the engine rejects): terminal "failed", the
@@ -799,25 +1269,92 @@ class ServingGateway:
             self._finalize(req, "failed", now)
             self._emit("failed", gid=req.gid, replica=rep.name,
                        error=repr(e), **self._trace_fields(req))
-            return
+            return None
+        self._breaker_note_dispatch(rep.name, now, gid=req.gid)
         req.engine_rid = rid
         req.replica = rep.name
         req.dispatched_at = now
+        req.dispatch_max_new = mnt
+        req.not_before = None
         req.status = "dispatched"
         rep.inflight[rid] = req
         self._stats.add("dispatched")
         self._stats.observe("queue_seconds", queue_s)
+        fields = {}
+        if mnt != req.max_new_tokens:
+            self._rstats.add("brownout_clamped")
+            fields["clamped_max_new"] = mnt
+        if req.retries:
+            fields["retries"] = req.retries
         self._emit("dispatch", gid=req.gid, replica=rep.name,
-                   queue_s=queue_s, priority=req.priority,
+                   queue_s=queue_s, priority=req.priority, **fields,
                    **self._trace_fields(req, ctx))
+        return None
+
+    def _on_transient_dispatch_error(self, rep: Replica,
+                                     req: GatewayRequest, now: float,
+                                     exc: TransientDispatchError
+                                     ) -> Optional[GatewayRequest]:
+        """A retryable dispatch failure: count it on the replica's
+        breaker and either schedule a backed-off retry (within the
+        per-request budget) or terminate with a structured
+        :class:`RetriesExhausted`.  Without a resilience policy the
+        failure is terminal immediately (still structured, never
+        silent)."""
+        self._breaker_failure(rep.name, now, repr(exc))
+        if self.resilience is None:
+            req.error = repr(exc)
+            self._finalize(req, "failed", now)
+            self._emit("failed", gid=req.gid, replica=rep.name,
+                       error=repr(exc), **self._trace_fields(req))
+            return None
+        if req.retries >= self.resilience.retry_budget:
+            # the first attempt plus every budgeted retry failed:
+            # structured terminal, never an unbounded loop
+            req.error = RetriesExhausted(req.retries + 1,
+                                         self.resilience.retry_budget,
+                                         repr(exc))
+            self._rstats.add("retries_exhausted")
+            self._finalize(req, "failed", now)
+            self._remit("retries_exhausted", gid=req.gid,
+                        replica=rep.name, attempts=req.retries + 1,
+                        error=repr(exc))
+            return None
+        req.retries += 1
+        backoff = self.resilience.backoff_s(req.retries, self._rrng)
+        req.not_before = now + backoff
+        self._rstats.add("retries")
+        self._remit("retry", gid=req.gid, replica=rep.name,
+                    attempt=req.retries, backoff_s=round(backoff, 6),
+                    error=repr(exc))
+        return req
 
     def _make_on_token(self, rep: Replica, req: GatewayRequest):
         """The engine-facing streaming callback: forwards to the user's
         ``on_token`` under the GATEWAY id, tracks first-token/TTFT, and
         translates the engines' two sentinel signals — replay
         (``None, False``) resets the stream, terminal (``None, True``)
-        resolves to expired/cancelled per what triggered the cancel."""
+        resolves to expired/cancelled per what triggered the cancel.
+
+        With hedging, a request can have TWO live engine attempts; each
+        gets its own closure over the SAME handle.  Every signal is
+        identity-checked against the request's current attempt fields
+        ((replica, rid) pairs) — a losing/stale attempt's signals only
+        clear bookkeeping, so the consumer stream is single-sourced and
+        tokens are never double-delivered.  The FIRST token decides the
+        hedge winner; the loser is cancelled on its engine right there."""
         def cb(_rid, tok, done):
+            primary = (rep.name == req.replica
+                       and _rid == req.engine_rid)
+            hedge = (rep.name == req.hedge_replica
+                     and _rid == req.hedge_rid)
+            if req.done or not (primary or hedge):
+                # terminal already, or a stale/losing attempt: nothing
+                # reaches the consumer; a terminal signal just clears the
+                # replica's bookkeeping entry
+                if tok is None and done:
+                    rep.inflight.pop(_rid, None)
+                return
             if tok is None and not done:
                 # engine-level preemption replay (paged pool pressure):
                 # reset and forward — the rerun re-delivers from token one
@@ -828,7 +1365,7 @@ class ServingGateway:
                     req.on_token(req.gid, None, False)
                 return
             if tok is None and done:
-                rep.inflight.pop(req.engine_rid, None)
+                rep.inflight.pop(_rid, None)
                 if req._rerouting:
                     return          # quarantine path signals separately
                 now = self._clock()
@@ -845,10 +1382,123 @@ class ServingGateway:
                 # the histogram carries one sample per request — the
                 # surviving attempt (the Tracer's documented semantics)
                 req.first_token_at = self._clock()
+                self._breaker_success(rep.name)
+                if req.hedge_rid is not None:
+                    # the race is decided by THIS token: promote the
+                    # winner, cancel the loser
+                    self._resolve_hedge(req, winner_is_hedge=hedge)
             req.tokens.append(int(tok))
             if req.on_token is not None:
                 req.on_token(req.gid, int(tok), done)
         return cb
+
+    # ----------------------------------------------------------- hedging --
+
+    def _maybe_hedge(self, now: float):
+        """Dispatch hedge attempts for TTFT-at-risk requests (module
+        docstring): a dispatched request with a TTFT deadline, no first
+        token, and ``hedge_ttft_frac`` of its deadline already spent gets
+        ONE second attempt on a different replica — first token wins,
+        loser is cancelled.  Fleet-wide concurrency is bounded by
+        ``max_hedges``."""
+        pol = self.resilience
+        if self._hedges_live >= pol.max_hedges:
+            return
+        for rep in list(self._replicas.values()):
+            for rid, req in list(rep.inflight.items()):
+                if self._hedges_live >= pol.max_hedges:
+                    return
+                if (req.done or req.hedged
+                        or req.ttft_deadline_s is None
+                        or req.first_token_at is not None
+                        or rep.name != req.replica
+                        or rid != req.engine_rid):
+                    continue
+                waited = now - req.submitted_at
+                if waited < pol.hedge_ttft_frac * req.ttft_deadline_s:
+                    continue
+                target = self._route(req, now, exclude=rep.name)
+                if target is None:
+                    continue            # nowhere to hedge right now
+                self._hedge_to(target, rep, req, now, waited)
+
+    def _hedge_to(self, target: Replica, primary: Replica,
+                  req: GatewayRequest, now: float, waited: float):
+        ctx = req.trace.child() if req.trace is not None else None
+        try:
+            rid2 = target.engine.add_request(
+                req.prompt,
+                req.dispatch_max_new or req.max_new_tokens,
+                on_token=self._make_on_token(target, req), trace_ctx=ctx,
+                **req.sampling)
+        except TransientDispatchError as e:
+            # a failed hedge is best-effort: count it on the target's
+            # breaker, burn no retry budget — the primary attempt is
+            # still running
+            self._breaker_failure(target.name, now, repr(e))
+            return
+        except (ValueError, TypeError, NotImplementedError) as e:
+            self._log.debug("gateway: hedge dispatch to %s rejected: %r",
+                            target.name, e)
+            return
+        self._breaker_note_dispatch(target.name, now, gid=req.gid)
+        req.hedged = True
+        req.hedge_replica = target.name
+        req.hedge_rid = rid2
+        target.inflight[rid2] = req
+        self._hedges_live += 1
+        self._rstats.add("hedges")
+        self._remit("hedge", gid=req.gid, primary=primary.name,
+                    hedge=target.name, waited_s=round(waited, 6),
+                    ttft_deadline_s=req.ttft_deadline_s,
+                    **self._trace_fields(req, ctx))
+
+    def _resolve_hedge(self, req: GatewayRequest, winner_is_hedge: bool):
+        """First token arrived while two attempts were racing: promote
+        the winning attempt into the request's primary fields and cancel
+        the loser (its terminal signal is identity-swallowed — no
+        double delivery, no double finalize)."""
+        if winner_is_hedge:
+            loser_name, loser_rid = req.replica, req.engine_rid
+            req.replica, req.engine_rid = req.hedge_replica, req.hedge_rid
+            self._rstats.add("hedges_won")
+            what = "hedge_won"
+        else:
+            loser_name, loser_rid = req.hedge_replica, req.hedge_rid
+            self._rstats.add("hedges_lost")
+            what = "hedge_lost"
+        req.hedge_replica = req.hedge_rid = None
+        self._hedges_live -= 1
+        self._remit(what, gid=req.gid, winner=req.replica,
+                    loser=loser_name)
+        self._cancel_attempt(loser_name, loser_rid)
+
+    def _abort_hedge(self, req: GatewayRequest):
+        """Tear down a still-racing hedge attempt (terminal transition,
+        quarantine of its replica): cancel and clear — no winner, no
+        consumer signal (no tokens were streamed while racing)."""
+        if req.hedge_rid is None:
+            return
+        loser_name, loser_rid = req.hedge_replica, req.hedge_rid
+        req.hedge_replica = req.hedge_rid = None
+        self._hedges_live -= 1
+        self._rstats.add("hedges_aborted")
+        self._cancel_attempt(loser_name, loser_rid)
+
+    def _cancel_attempt(self, replica_name: Optional[str],
+                        rid: Optional[int]):
+        rep = (None if replica_name is None
+               else self._replicas.get(replica_name))
+        if rep is None or rid is None:
+            return
+        rep.inflight.pop(rid, None)
+        try:
+            rep.engine.cancel(rid)
+        except Exception as e:  # noqa: BLE001 — a wedged loser replica
+            # must not break the winner's stream; its state is
+            # best-effort host bookkeeping
+            self._log.debug("gateway: losing-attempt cancel on %s "
+                            "failed: %r", replica_name, e)
 
     def _harvest(self):
         for rep in self._replicas.values():
@@ -857,11 +1507,25 @@ class ServingGateway:
     def _harvest_replica(self, rep: Replica):
         if not hasattr(rep.engine, "pop_finished"):
             return
-        for rid, tokens in rep.engine.pop_finished().items():
+        try:
+            finished = rep.engine.pop_finished()
+        except Exception as e:  # noqa: BLE001 — harvest re-enters the
+            # engine (the quarantine path re-enters the very engine whose
+            # step() just raised); a broken pop_finished must not escape
+            # the isolation that routed us here
+            self._log.warning("gateway: pop_finished on %s raised: %r — "
+                              "skipping harvest this round", rep.name, e)
+            return
+        for rid, tokens in finished.items():
             req = rep.inflight.pop(rid, None)
             if req is None:
                 continue            # not gateway-managed (direct client)
+            if req.done or not (rep.name == req.replica
+                                and rid == req.engine_rid):
+                continue    # stale/losing attempt retired late: the
+                #             winner owns the stream and the finalize
             req.tokens = list(tokens)       # engine list is authoritative
+            self._breaker_success(rep.name)
             if req.first_token_at is not None:
                 ttft = req.first_token_at - req.submitted_at
                 self._stats.observe("ttft_seconds", ttft)
@@ -879,6 +1543,11 @@ class ServingGateway:
         moved = sorted(rep.inflight.items(),
                        key=lambda kv: kv[1].submitted_at, reverse=True)
         for rid, req in moved:
+            if req.done:
+                rep.inflight.pop(rid, None)
+                continue
+            if self._drop_hedge_twin(rep, rid, req):
+                continue        # the other racing attempt carries on
             req._rerouting = True
             try:
                 rep.engine.cancel(rid)
@@ -909,6 +1578,38 @@ class ServingGateway:
             self._emit("reroute", gid=req.gid, from_replica=rep.name,
                        **self._trace_fields(req))
 
+    def _drop_hedge_twin(self, rep: Replica, rid: int,
+                         req: GatewayRequest) -> bool:
+        """Quarantine hit ONE attempt of a still-racing hedged request:
+        drop just that attempt and let the twin on the healthy replica
+        carry the request — no re-queue, no replay signal (no tokens
+        were streamed while racing).  False when the request is not a
+        racing hedge on this replica (the normal reroute applies)."""
+        if req.hedge_rid is None:
+            return False
+        if rep.name == req.replica and rid == req.engine_rid:
+            # the primary died: promote the hedge attempt
+            req.replica, req.engine_rid = req.hedge_replica, req.hedge_rid
+        elif not (rep.name == req.hedge_replica
+                  and rid == req.hedge_rid):
+            return False
+        req.hedge_replica = req.hedge_rid = None
+        self._hedges_live -= 1
+        self._rstats.add("hedges_aborted")
+        req._rerouting = True
+        try:
+            rep.engine.cancel(rid)
+        except Exception as e:  # noqa: BLE001 — the quarantined host
+            # state is best-effort; the surviving attempt carries on
+            self._log.debug("gateway: hedge-twin cancel on %s failed: %r",
+                            rep.name, e)
+        finally:
+            req._rerouting = False
+        rep.inflight.pop(rid, None)
+        self._remit("hedge_twin_dropped", gid=req.gid,
+                    quarantined=rep.name, survivor=req.replica)
+        return True
+
     def _unqueue(self, req: GatewayRequest):
         q = self._queues[req.priority]
         try:
@@ -924,7 +1625,26 @@ class ServingGateway:
         every early termination (shed/expired/cancelled/failed) signals;
         natural completion does not (the engine already delivered the
         last token with ``done=True``)."""
-        req.status = status
+        self._abort_hedge(req)      # a racing twin never outlives its
+        req.status = status         # request (no-op when not hedging)
+        if status != "finished" and req.first_token_at is None \
+                and req.replica is not None:
+            # the attempt ended without ever delivering: a HALF_OPEN
+            # probe must not stay claimed forever (the replica would be
+            # silently lost from routing).  Keyed to the probe REQUEST's
+            # identity — an unrelated pre-open in-flight request
+            # terminating token-less must neither free nor fail a probe
+            # it never held.  A deadline expiry IS the probe's verdict
+            # (the replica failed to deliver in time); a client cancel
+            # is nobody's fault — just free the claim.
+            cb = self._breaker(req.replica)
+            if cb is not None and cb.state == CircuitBreaker.HALF_OPEN \
+                    and cb.probe_gid == req.gid:
+                if status == "expired":
+                    self._breaker_failure(req.replica, now,
+                                          "half-open probe expired")
+                else:
+                    cb.release_probe()
         req.finished_at = now
         self._stats.add(status)
         if self._slo is not None:
@@ -956,6 +1676,137 @@ class ServingGateway:
             return
         self.tracer.emit("gateway", what=what, **fields)
 
+    def _remit(self, what: str, **fields):
+        """A ``resilience`` tracer event (breaker/retry/hedge/brownout
+        transitions — docs/OBSERVABILITY.md table)."""
+        if self.tracer is None:
+            return
+        self.tracer.emit("resilience", what=what, **fields)
+
+    # -------------------------------------------------- circuit breakers --
+
+    def _breaker(self, name: str) -> Optional[CircuitBreaker]:
+        return self._breakers.get(name) if self._breakers else None
+
+    def _breaker_allows(self, name: str, now: float) -> bool:
+        cb = self._breaker(name)
+        if cb is None:
+            return True
+        prev = cb.state
+        ok = cb.allow(now)
+        if prev == CircuitBreaker.OPEN and cb.state == CircuitBreaker.HALF_OPEN:
+            self._rstats.add("breaker_probes")
+            self._remit("breaker_half_open", replica=name)
+        return ok
+
+    def _breaker_note_dispatch(self, name: str, now: float,
+                               gid: Optional[int] = None):
+        cb = self._breaker(name)
+        if cb is not None:
+            cb.note_dispatch(now, gid=gid)
+
+    def _breaker_failure(self, name: str, now: float, reason: str):
+        cb = self._breaker(name)
+        if cb is None:
+            return
+        if cb.record_failure(now):
+            self._rstats.add("breaker_opens")
+            self._remit("breaker_open", replica=name, reason=reason,
+                        consecutive_failures=cb.consecutive_failures)
+            self._log.warning("gateway: circuit breaker OPEN on %s (%s)",
+                              name, reason)
+
+    def _breaker_success(self, name: str):
+        cb = self._breaker(name)
+        if cb is None:
+            return
+        if cb.record_success():
+            self._rstats.add("breaker_closes")
+            self._remit("breaker_close", replica=name)
+
+    def breakers_open(self) -> List[str]:
+        """Names of ACTIVE replicas whose circuit breaker is OPEN and
+        still inside its window right now — the autoscaler consumes this
+        as a scale-up signal alongside firing SLOs (a broken replica is
+        missing capacity even before the SLO math notices).  An OPEN
+        breaker past its window is one routing inquiry from HALF_OPEN,
+        so it stops counting — with no traffic, nothing ever routes, and
+        a stale signal would otherwise pin an idle fleet at max size
+        forever.  Only ACTIVE replicas count: a
+        quarantined/stopped replica's breaker can never half-open (the
+        routing probe is the only OPEN→HALF_OPEN path), and its missing
+        capacity is already the quarantine-reap/min-bound machinery's
+        problem — counting it here would turn one quarantine into a
+        PERMANENT scale-up signal.  Empty without a resilience policy."""
+        now = self._clock()
+        return sorted(
+            name for name, cb in self._breakers.items()
+            if cb.effectively_open(now)
+            and (rep := self._replicas.get(name)) is not None
+            and rep.state == ACTIVE)
+
+    # ----------------------------------------------------------- brownout --
+
+    def _occupancy(self) -> float:
+        """Fleet pressure: (in-flight + queued) requests over total
+        ACTIVE engine slots — the same occupancy the autoscaler's
+        scale-down signal reads."""
+        active = [rep for rep in self._replicas.values()
+                  if rep.state == ACTIVE]
+        slots = sum(_engine_slots(rep.engine) for rep in active)
+        busy = sum(len(rep.inflight) for rep in active)
+        queued = sum(len(q) for q in self._queues)
+        return (busy + queued) / max(slots, 1)
+
+    def _evaluate_brownout(self, now: float):
+        pressure = self._occupancy()
+        slo_firing = False
+        if self.resilience.brownout_use_slo and self._slo is not None:
+            try:
+                slo_firing = any(
+                    state == "firing"
+                    for state in self._slo.alert_states().values())
+            except Exception as e:  # noqa: BLE001 — a broken monitor
+                # must not stall the admission plane
+                self._log.debug("gateway: slo poll failed: %r", e)
+        delta = self._brownout.evaluate(now, pressure, slo_firing)
+        if delta == 0:
+            return
+        lvl = self._brownout.level
+        self._rstats.add("brownout_ups" if delta > 0 else "brownout_downs")
+        self._remit("brownout_up" if delta > 0 else "brownout_down",
+                    level=lvl, label=BROWNOUT_LEVELS[lvl],
+                    pressure=round(pressure, 4), slo_firing=slo_firing)
+        self._log.warning("gateway: brownout %s to level %d (%s), "
+                          "pressure=%.2f", "UP" if delta > 0 else "down",
+                          lvl, BROWNOUT_LEVELS[lvl], pressure)
+
+    @property
+    def brownout_level(self) -> int:
+        """Current brownout rung (0 = normal; index into
+        :data:`BROWNOUT_LEVELS`)."""
+        return 0 if self._brownout is None else self._brownout.level
+
+    def resilience_snapshot(self) -> Optional[Dict[str, Any]]:
+        """JSON-able live resilience view — what ``ops_server``'s
+        ``/resilience`` route serves and the FlightRecorder dumps:
+        policy knobs, per-replica breaker states, the brownout rung,
+        live hedges, and every resilience counter.  None when no
+        resilience policy is attached."""
+        if self.resilience is None:
+            return None
+        return {
+            "policy": self.resilience.to_dict(),
+            "breakers": {name: cb.to_dict()
+                         for name, cb in sorted(self._breakers.items())},
+            "breakers_open": self.breakers_open(),
+            "brownout": (None if self._brownout is None
+                         else self._brownout.to_dict()),
+            "hedges_inflight": self._hedges_live,
+            "occupancy": round(self._occupancy(), 4),
+            "counters": dict(self._rstats.snapshot()),
+        }
+
     # --------------------------------------------------------- telemetry --
 
     def queue_depths(self) -> Dict[int, Dict[str, int]]:
@@ -970,7 +1821,7 @@ class ServingGateway:
         h_q = self._stats.histogram("queue_seconds")
         h_t = self._stats.histogram("ttft_seconds")
         counters = {k: v for k, v in self._stats.snapshot().items()}
-        return {
+        out = {
             "replicas": [rep.to_dict() for rep in self._replicas.values()],
             "queues": self.queue_depths(),
             "counters": counters,
@@ -981,6 +1832,11 @@ class ServingGateway:
             "ttft_s": {"p50": h_t.percentile(0.50),
                        "p99": h_t.percentile(0.99)},
         }
+        if self.resilience is not None:
+            # breaker/brownout state rides every snapshot consumer —
+            # /gateway, and the FlightRecorder's crash dumps
+            out["resilience"] = self.resilience_snapshot()
+        return out
 
     summary = gateway_snapshot
 
@@ -992,7 +1848,7 @@ class ServingGateway:
         return out
 
     def prometheus_text(self, namespace: str = "paddle_tpu_gateway") -> str:
-        return _prometheus_text(
+        text = _prometheus_text(
             self._stats, namespace=namespace,
             extra_gauges={
                 "queued": sum(len(q) for q in self._queues),
@@ -1001,3 +1857,17 @@ class ServingGateway:
                 "replicas_active": sum(
                     1 for rep in self._replicas.values()
                     if rep.state == ACTIVE)})
+        if self.resilience is not None:
+            breakers = list(self._breakers.values())
+            text += _prometheus_text(
+                self._rstats, namespace="paddle_tpu_resilience",
+                extra_gauges={
+                    "brownout_level": self.brownout_level,
+                    "breakers_open": sum(
+                        1 for cb in breakers
+                        if cb.state == CircuitBreaker.OPEN),
+                    "breakers_half_open": sum(
+                        1 for cb in breakers
+                        if cb.state == CircuitBreaker.HALF_OPEN),
+                    "hedges_inflight": self._hedges_live})
+        return text

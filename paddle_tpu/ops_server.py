@@ -39,6 +39,11 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     (gateway root → per-dispatch engine attempts → queued/prefill/
     decode phases, preempt markers) plus the raw event sequence (404
     for an unknown trace).
+``GET /resilience``
+    the attached gateway's resilience view (PR 12): per-replica circuit
+    breaker states, the brownout ladder rung, live hedges, and the
+    retry/hedge/brownout counters (404 when no attached gateway carries
+    a resilience policy).
 ``GET /slo``
     the attached :class:`~paddle_tpu.telemetry_slo.SLOMonitor` snapshot:
     objectives, live burn rates, alert states, SLIs, and the recent
@@ -169,6 +174,15 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/resilience":
+                payload = ops._render_resilience()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no resilience-enabled gateway "
+                                  "attached"}), "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             elif route == "/slo":
                 payload = ops._render_slo()
                 if payload is None:
@@ -192,7 +206,7 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
-                      "/slo", "/autoscaler"]}),
+                      "/resilience", "/slo", "/autoscaler"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -465,6 +479,26 @@ class OpsServer:
         if not trace_id:
             return None
         return self._trace_index().trace(trace_id)
+
+    def _render_resilience(self) -> Optional[Dict[str, Any]]:
+        """Resilience views of attached gateways; None when no attached
+        gateway has a resilience policy (their ``resilience_snapshot``
+        returns None)."""
+        with self._lock:
+            gateways = list(self._gateways)
+        views = []
+        for name, gw in gateways:
+            snap_fn = getattr(gw, "resilience_snapshot", None)
+            if snap_fn is None:
+                continue
+            snap = snap_fn()
+            if snap is not None:
+                views.append((name, snap))
+        if not views:
+            return None
+        if len(views) == 1:
+            return views[0][1]
+        return dict(views)
 
     def _render_slo(self) -> Optional[Dict[str, Any]]:
         with self._lock:

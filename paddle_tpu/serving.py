@@ -1099,16 +1099,32 @@ class ContinuousBatchingEngine:
         telemetry — host wall time, queue depth, counter deltas, packed
         rows, program labels; with ``tracer=None`` (default) this wrapper
         is ONE attribute check and a tail call: no event allocation, no
-        tracer lock, no extra operands anywhere near a compiled program."""
+        tracer lock, no extra operands anywhere near a compiled program.
+
+        An exception escaping ``_step_impl`` is SURFACED before it
+        propagates — the ``step_errors`` counter ticks and (with a
+        tracer) an ``engine_error`` event lands in the ring — so a
+        replica that dies mid-tick leaves evidence in the observability
+        plane even when its caller (the gateway's step isolation, a bare
+        serving loop) swallows or crashes on the re-raise."""
         tr = self.tracer
         if tr is None:
-            return self._step_impl()
+            try:
+                return self._step_impl()
+            except Exception:
+                self._stats.add("step_errors")
+                raise
         t0 = time.perf_counter()
         self._tick_note = {}
         s = self._stats
         base = {k: s.value(k) for k in self._TICK_COUNTERS}
         try:
             return self._step_impl()
+        except Exception as e:
+            self._stats.add("step_errors")
+            tr.emit("engine_error", what="step_error",
+                    engine=type(self).__name__, error=repr(e))
+            raise
         finally:
             fields = {k: s.value(k) - base[k] for k in self._TICK_COUNTERS}
             fields.update(self._tick_gauges())
@@ -1197,6 +1213,7 @@ class ContinuousBatchingEngine:
         "tokens_per_sec": ("gauge", float),
         "compile_hits": ("counter", int),
         "compile_misses": ("counter", int),
+        "step_errors": ("counter", int),
     }
 
     @classmethod
@@ -1230,7 +1247,8 @@ class ContinuousBatchingEngine:
                 "mean_latency_s": float(s.value("latency_seconds_sum")) / n,
                 "tokens_per_sec": toks / dt,
                 "compile_hits": self._compile_hits,
-                "compile_misses": self._compile_misses}
+                "compile_misses": self._compile_misses,
+                "step_errors": int(s.value("step_errors"))}
 
     def prometheus_text(self, namespace: str = "paddle_tpu_serving") -> str:
         """Prometheus text exposition of this engine's registry plus the

@@ -22,9 +22,12 @@ Pieces:
   ``step()``, deterministic token streams (stream equality pins
   zero-drop/replay correctness), a program-cache model that emits real
   tracer compile events (so warmup vs in-serve compile accounting — the
-  PR 2/6 contracts — is exercised), and ``kill()`` for replica-death
-  injection (the engine stops ticking while holding work; the gateway's
-  stall health-check quarantines it as simulated time advances).
+  PR 2/6 contracts — is exercised), and fault modes for chaos scenarios
+  (``paddle_tpu.faults``): ``kill()`` replica death, ``stall(ticks)``
+  temporary freeze, ``slow(factor)`` straggler, ``flaky(n)`` transient
+  dispatch errors (the engine stops ticking / slows / raises while
+  holding work; the gateway's stall health-check, hedging, and
+  retry/breaker paths each get their natural trigger).
 - workload generators — ``steady`` (Poisson), ``diurnal`` (sinusoid-
   modulated Poisson), ``flash_crowd`` (step spike) — seconds → rate
   callables, sampled per tick with a seeded Poisson draw.
@@ -58,6 +61,7 @@ import math
 import random
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from .faults import TransientDispatchError
 from .telemetry import Tracer
 from .utils.stats import StatRegistry, prometheus_text as _prometheus_text
 
@@ -161,6 +165,14 @@ class SimEngine:
         self.warmed = False
         self.dead = False
         self.in_serve_compiles = 0
+        # fault modes beyond kill() (paddle_tpu.faults integration):
+        # stall freezes N rounds (silent — the stall health-check sees a
+        # dead tracer), slow delivers work only every factor-th round
+        # (but stays visibly alive), flaky fails the next N dispatches
+        self._stall_ticks = 0
+        self._slow_factor = 1
+        self._slow_phase = 0
+        self._flaky = 0
         self.stats = StatRegistry()
 
     # -------------------------------------------------------------- grid --
@@ -222,6 +234,11 @@ class SimEngine:
             raise ValueError("empty prompt")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self._flaky > 0:
+            self._flaky -= 1
+            self.stats.add("dispatch_errors")
+            raise TransientDispatchError(
+                "sim engine flaky dispatch (injected)")
         rid = self._rids
         self._rids += 1
         req = _SimRequest(rid, prompt, max_new_tokens, on_token)
@@ -240,6 +257,20 @@ class SimEngine:
         stall health-check fires."""
         if self.dead:
             return
+        if self._stall_ticks > 0:
+            self._stall_ticks -= 1      # frozen AND silent: no tracer
+            return                      # event, so the stall check fires
+        if self._slow_factor > 1:
+            self._slow_phase += 1
+            if self._slow_phase % self._slow_factor != 0:
+                # a straggler is SLOW, not dead: it shows liveness (the
+                # tick event below keeps the stall health-check green)
+                # but moves no tokens this round
+                if self.tracer is not None:
+                    self.tracer.tick("sim", 0.0,
+                                     active=len(self._active),
+                                     queued=len(self._queue), slow=True)
+                return
         while self._queue and len(self._active) < self.S:
             req = self._queue.pop(0)
             self._fetch(self._bucket_label(len(req.prompt)))
@@ -297,6 +328,34 @@ class SimEngine:
     def kill(self):
         """Replica-death injection: freeze the engine mid-work."""
         self.dead = True
+
+    def stall(self, ticks: int):
+        """Stall injection: freeze for ``ticks`` scheduler rounds —
+        silent (no tracer events), so the gateway's stall health-check
+        quarantines it if the freeze outlasts ``stall_threshold_s`` —
+        then resume where it left off."""
+        if int(ticks) < 0:
+            raise ValueError("ticks must be >= 0")
+        self._stall_ticks = int(ticks)
+
+    def slow(self, factor: int):
+        """Straggler injection: serve one real round per ``factor``
+        ``step()`` calls (``factor=1`` restores full speed).  Unlike a
+        stall the engine stays visibly alive — slow replicas are the
+        hedging workload, not the quarantine workload."""
+        if int(factor) < 1:
+            raise ValueError("factor must be >= 1")
+        self._slow_factor = int(factor)
+        self._slow_phase = 0
+
+    def flaky(self, n: int):
+        """Transient-dispatch-error injection: the next ``n``
+        ``add_request`` calls raise
+        :class:`~paddle_tpu.faults.TransientDispatchError` (the
+        retryable class the gateway's retry/breaker path keys on)."""
+        if int(n) < 0:
+            raise ValueError("n must be >= 0")
+        self._flaky = int(n)
 
     # --------------------------------------------------------- telemetry --
 
@@ -392,6 +451,8 @@ class TrafficSim:
                  max_new: Tuple[int, int] = (4, 8), vocab: int = 997,
                  priority: int = 0, autoscaler=None,
                  sample_every_s: float = 1.0,
+                 ttft_deadline_s: Optional[float] = None,
+                 deadline_s: Optional[float] = None,
                  logger: Optional[logging.Logger] = None):
         if float(dt) <= 0:
             raise ValueError("dt must be > 0")
@@ -406,6 +467,11 @@ class TrafficSim:
         self.priority = int(priority)
         self.autoscaler = autoscaler
         self.sample_every_s = float(sample_every_s)
+        # optional per-request deadlines: the gateway's hedging trigger
+        # (TTFT-at-risk) and expiry paths need them to exist in the
+        # workload, exactly like real traffic carries them
+        self.ttft_deadline_s = ttft_deadline_s
+        self.deadline_s = deadline_s
         self._log = logger if logger is not None \
             else logging.getLogger(__name__)
         self.handles: List[Any] = []
@@ -431,7 +497,9 @@ class TrafficSim:
             prompt = [rng.randint(1, self.vocab) for _ in range(plen)]
             self.handles.append(self.gateway.submit(
                 prompt, rng.randint(*self.max_new),
-                priority=self.priority))
+                priority=self.priority,
+                ttft_deadline_s=self.ttft_deadline_s,
+                deadline_s=self.deadline_s))
 
     def _fire_due(self, t: float):
         while self._injections and self._injections[0][0] <= t:

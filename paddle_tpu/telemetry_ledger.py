@@ -394,8 +394,12 @@ class FlightRecorder:
 
     def add_source(self, obj, name: Optional[str] = None) -> "FlightRecorder":
         """Attach a dump source: a ``Tracer``/``TrainMonitor`` (anything
-        with ``dump_jsonl``) or a ``RunLedger`` (``to_dict``)."""
-        if not (hasattr(obj, "dump_jsonl") or hasattr(obj, "to_dict")):
+        with ``dump_jsonl``), a ``RunLedger`` (``to_dict``), or a
+        ``ServingGateway`` (``gateway_snapshot`` — the dump then carries
+        replica/queue state and, with a resilience policy, the breaker
+        and brownout state the crash happened under)."""
+        if not (hasattr(obj, "dump_jsonl") or hasattr(obj, "to_dict")
+                or hasattr(obj, "gateway_snapshot")):
             raise TypeError(f"unsupported flight-recorder source: {obj!r}")
         self._sources.append((name or f"{type(obj).__name__.lower()}"
                               f"{len(self._sources)}", obj))
@@ -497,6 +501,10 @@ class FlightRecorder:
                 try:
                     if hasattr(src, "dump_jsonl"):
                         src.dump_jsonl(os.path.join(out, f"{name}.jsonl"))
+                    elif hasattr(src, "gateway_snapshot"):
+                        with open(os.path.join(out, f"{name}.json"),
+                                  "w") as f:
+                            json.dump(src.gateway_snapshot(), f)
                     elif hasattr(src, "to_dict"):
                         with open(os.path.join(out, f"{name}.json"),
                                   "w") as f:

@@ -1,0 +1,286 @@
+"""Fault-injection layer (paddle_tpu/faults.py, ISSUE 12): the typed
+fault vocabulary, the seeded JSON-able FaultPlan, the FaultyEngine
+wrapper's injection points (crash / stall / slow / dispatch_error /
+warmup_fail / garble), the SimEngine fault modes, and whole-trajectory
+seeded replayability through TrafficSim.
+
+Everything runs on the fake clock — no JAX, no sleeps, milliseconds per
+test.  No reference counterpart: the reference snapshot has no failure
+model at all (SURVEY §2.3)."""
+
+import json
+
+import pytest
+
+from paddle_tpu.faults import (Fault, FaultInjectionError, FaultPlan,
+                               FaultyEngine, StreamCorruption,
+                               TransientDispatchError)
+from paddle_tpu.gateway import ServingGateway, ResiliencePolicy
+from paddle_tpu.simulation import (SimClock, SimEngine, SimTracer,
+                                   TrafficSim, sim_tokens, steady)
+
+
+def _gw(clock, resilience=None, **kw):
+    kw.setdefault("stall_threshold_s", 5.0)
+    tracer = SimTracer(clock, capacity=16384)
+    return ServingGateway(clock=clock, tracer=tracer,
+                          resilience=resilience, **kw), tracer
+
+
+def _drive(gw, clock, max_ticks=400, dt=0.25):
+    for _ in range(max_ticks):
+        gw.step()
+        clock.advance(dt)
+        if not gw.pending():
+            return
+    raise AssertionError("gateway did not drain")
+
+
+class TestFaultTypes:
+    def test_kind_validation(self):
+        with pytest.raises(ValueError):
+            Fault("meteor")
+        with pytest.raises(ValueError):
+            Fault("stall", at_s=-1.0)
+        with pytest.raises(ValueError):
+            Fault("stall", duration_s=0.0)
+        with pytest.raises(ValueError):
+            Fault("slow", factor=0.5)
+        with pytest.raises(ValueError):
+            Fault("dispatch_error", count=0)
+
+    def test_window_semantics(self):
+        f = Fault("stall", at_s=10.0, duration_s=5.0)
+        assert not f.active(9.9)
+        assert f.active(10.0) and f.active(14.9)
+        assert not f.active(15.0)
+        crash = Fault("crash", at_s=3.0)          # no end
+        assert crash.active(1e9) and not crash.active(2.9)
+
+    def test_plan_ordering_targeting_and_json_round_trip(self):
+        plan = FaultPlan([Fault("stall", at_s=20.0),
+                          Fault("crash", at_s=5.0, replica="r1")], seed=3)
+        assert [f.at_s for f in plan.faults] == [5.0, 20.0]
+        assert [f.kind for f in plan.for_replica("r0")] == ["stall"]
+        assert [f.kind for f in plan.for_replica("r1")] == ["crash",
+                                                           "stall"]
+        back = FaultPlan.from_dict(plan.to_dict())
+        assert back.seed == 3 and len(back) == 2
+        assert back.to_dict() == plan.to_dict()
+        # bare-list JSON shape (the serve_gateway --chaos CLI input)
+        lst = FaultPlan.from_json(json.dumps(
+            [{"kind": "slow", "at_s": 1.0, "factor": 4.0}]))
+        assert lst.faults[0].kind == "slow" and lst.faults[0].factor == 4.0
+
+    def test_plan_rng_is_per_replica_deterministic(self):
+        plan = FaultPlan(seed=9)
+        a1 = [plan.rng("a").random() for _ in range(3)]
+        a2 = [plan.rng("a").random() for _ in range(3)]
+        b = [plan.rng("b").random() for _ in range(3)]
+        assert a1 == a2 and a1 != b
+
+
+class TestFaultyEngine:
+    def test_crash_freezes_and_gateway_replays_exactly(self):
+        """A crashed replica goes silent mid-work; the stall health-check
+        quarantines it and every stranded request re-delivers its EXACT
+        oracle stream elsewhere — zero drops, zero duplicate tokens."""
+        clock = SimClock()
+        gw, _ = _gw(clock)
+        plan = FaultPlan([Fault("crash", at_s=2.0, replica="bad")])
+        bad = SimEngine(max_slots=2, tracer=SimTracer(clock))
+        gw.add_replica(FaultyEngine(bad, plan, clock, replica="bad"),
+                       "bad")
+        gw.add_replica(SimEngine(max_slots=2, tracer=SimTracer(clock)),
+                       "ok")
+        streams = {}
+        handles = [gw.submit([i + 1, i + 2], 30, on_token=lambda g, t, d:
+                             streams.setdefault(g, []).append((t, d)))
+                   for i in range(4)]
+        _drive(gw, clock)
+        for h in handles:
+            assert h.status == "finished"
+            assert h.tokens == sim_tokens(h.prompt, 30)
+            s = streams[h.gid]
+            cut = max((i for i, (t, d) in enumerate(s)
+                       if t is None and not d), default=-1)
+            assert [t for t, d in s[cut + 1:] if t is not None] == h.tokens
+        assert gw.replica("bad").state == "quarantined"
+        assert any(ev["kind"] == "crash"
+                   for ev in gw.replica("bad").engine.injected())
+
+    def test_stall_window_resumes(self):
+        """A stall shorter than the quarantine threshold: the engine
+        freezes, then resumes and finishes its own work — no quarantine,
+        no replay."""
+        clock = SimClock()
+        gw, _ = _gw(clock, stall_threshold_s=50.0)
+        plan = FaultPlan([Fault("stall", at_s=1.0, duration_s=3.0)])
+        eng = SimEngine(max_slots=2, tracer=SimTracer(clock))
+        gw.add_replica(FaultyEngine(eng, plan, clock, replica="s"), "s")
+        h = gw.submit([5, 6], 40)
+        _drive(gw, clock)
+        assert h.status == "finished" and h.replays == 0
+        assert h.tokens == sim_tokens([5, 6], 40)
+        assert gw.replica("s").state == "active"
+
+    def test_slow_is_alive_but_straggling(self):
+        """slow delivers ~1/factor of the token rate but emits liveness
+        ticks — the stall health-check must NOT quarantine a straggler."""
+        clock = SimClock()
+        gw, _ = _gw(clock, stall_threshold_s=1.0)   # hair-trigger
+        plan = FaultPlan([Fault("slow", at_s=0.0, factor=8)])
+        eng = SimEngine(max_slots=2, tracer=SimTracer(clock))
+        gw.add_replica(FaultyEngine(eng, plan, clock, replica="slow"),
+                       "slow")
+        h = gw.submit([3], 4)
+        ticks = 0
+        while gw.pending():
+            gw.step()
+            clock.advance(0.25)
+            ticks += 1
+            assert ticks < 500
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens([3], 4)
+        assert gw.replica("slow").state == "active"     # never benched
+        assert ticks >= 8 * 4                           # actually slow
+
+    def test_dispatch_error_window_and_count(self):
+        clock = SimClock()
+        plan = FaultPlan([Fault("dispatch_error", at_s=0.0,
+                                duration_s=10.0, count=2)])
+        eng = FaultyEngine(SimEngine(max_slots=2), plan, clock)
+        with pytest.raises(TransientDispatchError):
+            eng.add_request([1], 2)
+        with pytest.raises(TransientDispatchError):
+            eng.add_request([1], 2)
+        rid = eng.add_request([1], 2)             # count exhausted
+        assert isinstance(rid, int)
+        clock.advance(20.0)                       # window over anyway
+        eng.add_request([2], 2)
+        assert [e["kind"] for e in eng.injected()] == ["dispatch_error",
+                                                       "dispatch_error"]
+
+    def test_warmup_fail_count(self):
+        clock = SimClock()
+        plan = FaultPlan([Fault("warmup_fail", count=1)])
+        eng = FaultyEngine(SimEngine(max_slots=2), plan, clock)
+        with pytest.raises(FaultInjectionError):
+            eng.warmup()
+        report = eng.warmup()                     # second call succeeds
+        assert report["programs"] > 0 and eng.warmed
+
+    def test_garble_raises_after_partial_delivery(self):
+        """The truncated/garbled-stream fault: step forwards the tick
+        (a partial prefix reaches the consumer) then raises
+        StreamCorruption — and only ``count`` times."""
+        clock = SimClock()
+        plan = FaultPlan([Fault("garble", at_s=0.0, count=1)])
+        eng = FaultyEngine(SimEngine(max_slots=2), plan, clock)
+        got = []
+        eng.add_request([4, 4], 5,
+                        on_token=lambda r, t, d: got.append(t))
+        with pytest.raises(StreamCorruption):
+            eng.step()
+        assert got == sim_tokens([4, 4], 5)[:len(got)] and got
+        for _ in range(10):                       # count spent: clean now
+            eng.step()
+        assert eng.pop_finished()
+
+    def test_delegation_preserves_engine_surface(self):
+        clock = SimClock()
+        inner = SimEngine(max_slots=3, tracer=SimTracer(clock),
+                          prompt_buckets=(4, 8))
+        eng = FaultyEngine(inner, FaultPlan(), clock)
+        assert eng.tracer is inner.tracer
+        assert eng.compile_grid() == inner.compile_grid()
+        assert len(eng._free_slots()) == 3
+        assert eng.S == 3 and eng._queue == []
+        rid = eng.add_request([1, 2], 3)
+        assert eng.pending()
+        assert eng.cancel(rid) and not eng.pending()
+
+
+class TestSimEngineModes:
+    def test_stall_mode_counts_down(self):
+        eng = SimEngine(max_slots=1)
+        got = []
+        eng.add_request([2], 3, on_token=lambda r, t, d: got.append(t))
+        eng.stall(3)
+        for _ in range(3):
+            eng.step()
+        assert got == []
+        for _ in range(3):
+            eng.step()
+        assert got == sim_tokens([2], 3)
+
+    def test_slow_mode_emits_liveness(self):
+        clock = SimClock()
+        tr = SimTracer(clock)
+        eng = SimEngine(max_slots=1, tracer=tr)
+        eng.add_request([7], 2)
+        eng.slow(4)
+        before = len(tr.events("tick"))
+        for _ in range(3):
+            eng.step()
+            clock.advance(1.0)
+        assert not eng.pop_finished()             # nothing served yet
+        assert len(tr.events("tick")) > before    # but visibly alive
+        eng.step()                                # 4th call: real round
+        eng.slow(1)
+        eng.step()
+        assert eng.pop_finished()
+
+    def test_flaky_mode_raises_then_recovers(self):
+        eng = SimEngine(max_slots=1)
+        eng.flaky(2)
+        for _ in range(2):
+            with pytest.raises(TransientDispatchError):
+                eng.add_request([1], 1)
+        assert isinstance(eng.add_request([1], 1), int)
+        assert eng.metrics()["dispatch_errors"] == 2
+
+
+class TestSeededReplay:
+    def _run_chaos(self, seed):
+        plan = FaultPlan([
+            Fault("slow", at_s=5.0, duration_s=20.0, factor=10,
+                  replica="r0"),
+            Fault("crash", at_s=10.0, replica="r1"),
+            Fault("dispatch_error", at_s=15.0, duration_s=4.0,
+                  replica="r2"),
+        ], seed=seed)
+        clock = SimClock()
+        pol = ResiliencePolicy(retry_budget=3, retry_backoff_s=0.25,
+                               seed=seed, breaker_failures=3,
+                               breaker_open_s=2.0, hedge=True,
+                               hedge_ttft_frac=0.1, brownout=False)
+        gw, tracer = _gw(clock, resilience=pol)
+        wrappers = []
+        for i in range(3):
+            eng = SimEngine(max_slots=4, tracer=SimTracer(clock))
+            w = FaultyEngine(eng, plan, clock, replica=f"r{i}")
+            wrappers.append(w)
+            gw.add_replica(w, f"r{i}")
+        sim = TrafficSim(gw, clock, steady(2.0), dt=0.25, seed=seed,
+                         ttft_deadline_s=30.0)
+        rep = sim.run(40.0)
+        rep["resilience_events"] = [
+            (e["what"], e.get("replica"), e.get("gid"))
+            for e in tracer.events("resilience")]
+        rep["injected"] = [(w.replica, e["kind"], e["t"])
+                           for w in wrappers for e in w.injected()]
+        del rep["timeline"]
+        return rep
+
+    def test_whole_trajectory_replays_identically(self):
+        """The chaos contract: one (plan seed, workload seed) value IS
+        one trajectory — outcomes, TTFT percentiles, every resilience
+        transition, every injected fault, in the same order."""
+        a = self._run_chaos(11)
+        b = self._run_chaos(11)
+        assert a["dropped"] == [] and b["dropped"] == []
+        assert a["outcomes"] == b["outcomes"]
+        assert a["ttft_s"] == b["ttft_s"]
+        assert a["resilience_events"] == b["resilience_events"]
+        assert a["injected"] == b["injected"]

@@ -28,6 +28,7 @@ BAD_FIXTURE = {
     "no-print": "bad_no_print.py",
     "jit-in-hot-loop": "bad_jit_in_hot_loop.py",
     "blocking-fetch-in-loop": "bad_blocking_fetch_in_loop.py",
+    "unbounded-retry": "bad_unbounded_retry.py",
 }
 CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
                  for rule, path in BAD_FIXTURE.items()}

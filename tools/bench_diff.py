@@ -42,6 +42,13 @@ percentiles, compile misses, goodput, MFU, model FLOPs/s — see
 thresholds, so a TTFT p99 regression or a goodput/MFU drop is flagged
 even when the headline throughput number held.
 
+``chaos`` attachments (the ``gpt_chaos`` record shape: fault-plan A/B
+with per-phase outcome counts and resilience counters) expand the same
+way through ``_CHAOS_FIELDS`` — a drop in the resilience-on finished
+count, a rise in retries-exhausted, or a shrinking p99-TTFT improvement
+factor between rounds is a resilience regression even when the headline
+p99 held.
+
 Exit codes:
   0  comparable data found, no regression beyond --threshold
   1  at least one regression beyond --threshold
@@ -79,6 +86,20 @@ _TELEMETRY_FIELDS = {
     "tokens_per_sec": ("tokens/s", "higher"),
 }
 
+#: chaos-attachment fields worth diffing (bench.py gpt_chaos record
+#: shape): leaf name -> (synthetic unit, direction).  Counts of hedges/
+#: breaker transitions are scenario-shaped context, not judged.
+_CHAOS_FIELDS = {
+    "p99_ttft_improvement": ("x", "higher"),
+    "ttft_s_p99": ("s", "lower"),
+    "finished": ("count", "higher"),
+    "failed": ("count", "lower"),
+    "expired": ("count", "lower"),
+    "shed_rate": ("frac", "lower"),
+    "retries": ("count", "lower"),
+    "retries_exhausted": ("count", "lower"),
+}
+
 
 def _flatten(prefix, obj, out):
     for k, v in obj.items():
@@ -90,33 +111,36 @@ def _flatten(prefix, obj, out):
 
 
 def expand_telemetry(records):
-    """records + synthetic ``<metric>.telemetry.<field>`` rows for every
-    whitelisted telemetry leaf on a comparable record.  Synthetic rows
-    carry their own unit and explicit ``direction`` so the comparison
-    stays direction-aware per field."""
+    """records + synthetic ``<metric>.<attachment>.<field>`` rows for
+    every whitelisted leaf of a comparable record's ``telemetry`` /
+    ``chaos`` attachment.  Synthetic rows carry their own unit and
+    explicit ``direction`` so the comparison stays direction-aware per
+    field."""
     out = []
     for rec in records:
         out.append(rec)
         if classify(rec) != "ok":
             continue
-        tel = rec.get("telemetry")
-        if not isinstance(tel, dict):
-            continue
-        leaves = []
-        _flatten("telemetry", tel, leaves)
-        for path, leaf, value in leaves:
-            spec = _TELEMETRY_FIELDS.get(leaf)
-            if spec is None:
+        for attachment, fields in (("telemetry", _TELEMETRY_FIELDS),
+                                   ("chaos", _CHAOS_FIELDS)):
+            sub = rec.get(attachment)
+            if not isinstance(sub, dict):
                 continue
-            unit, direction = spec
-            row = {"metric": f"{rec['metric']}.{path}",
-                   "value": value, "unit": unit,
-                   "direction": direction}
-            if rec.get("backend") is not None:
-                # synthetic rows inherit the parent's backend so the
-                # cross-backend non-comparability guard covers them too
-                row["backend"] = rec["backend"]
-            out.append(row)
+            leaves = []
+            _flatten(attachment, sub, leaves)
+            for path, leaf, value in leaves:
+                spec = fields.get(leaf)
+                if spec is None:
+                    continue
+                unit, direction = spec
+                row = {"metric": f"{rec['metric']}.{path}",
+                       "value": value, "unit": unit,
+                       "direction": direction}
+                if rec.get("backend") is not None:
+                    # synthetic rows inherit the parent's backend so the
+                    # cross-backend non-comparability guard covers them
+                    row["backend"] = rec["backend"]
+                out.append(row)
     return out
 
 

@@ -330,6 +330,62 @@ then
     echo "COLLECT SMOKE FAILED: autoscaler / simulation round trip"
     exit 1
 fi
+# fault-injection + gateway resilience: faults.py must import clean (no
+# JAX — it is the host-only chaos layer), and a tiny fake-clock
+# crash -> retry -> recover round trip must close the loop — a flaky
+# dispatch window retried within budget, the breaker opening and
+# re-closing through a half-open probe, a crashed replica quarantined
+# with its work replayed token-exactly — with breaker/brownout state
+# served by a live /resilience scrape
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'RESEOF'
+import json, urllib.request
+from paddle_tpu.faults import (Fault, FaultPlan, FaultyEngine,
+                               TransientDispatchError)
+from paddle_tpu.gateway import ResiliencePolicy, ServingGateway
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.simulation import SimClock, SimEngine, SimTracer, sim_tokens
+clock = SimClock()
+tracer = SimTracer(clock, capacity=8192)
+pol = ResiliencePolicy(retry_budget=3, retry_backoff_s=0.1,
+                       retry_jitter=0.0, breaker_failures=2,
+                       breaker_open_s=2.0, hedge=False, brownout=False)
+gw = ServingGateway(clock=clock, tracer=tracer, stall_threshold_s=4.0,
+                    resilience=pol)
+plan = FaultPlan([Fault("dispatch_error", at_s=0.0, duration_s=1.0),
+                  Fault("crash", at_s=6.0)])
+bad = SimEngine(max_slots=2, tracer=SimTracer(clock))
+gw.add_replica(FaultyEngine(bad, plan, clock, replica="bad"), "bad")
+gw.add_replica(SimEngine(max_slots=2, tracer=SimTracer(clock)), "ok")
+hs = [gw.submit([i + 1, 2], 20) for i in range(4)]
+for _ in range(200):
+    gw.step()
+    clock.advance(0.25)
+    if not gw.pending():
+        break
+assert all(h.status == "finished" for h in hs), [h.status for h in hs]
+assert all(h.tokens == sim_tokens(h.prompt, 20) for h in hs)
+snap = gw.resilience_snapshot()
+assert snap["counters"]["retries"] >= 1
+assert snap["counters"]["breaker_opens"] >= 1
+assert all(h.retries <= pol.retry_budget for h in hs)
+assert gw.replica("bad").state == "quarantined"   # the crash, detected
+whats = [e["what"] for e in tracer.events("resilience")]
+assert "retry" in whats and "breaker_open" in whats
+srv = OpsServer()
+srv.attach(gw, "gw")
+url = srv.start()
+live = json.loads(urllib.request.urlopen(url + "/resilience",
+                                         timeout=10).read())
+assert live["breakers"]["bad"]["state"] in ("closed", "open", "half_open")
+assert live["brownout"] is None                    # disabled -> honest
+txt = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+assert "paddle_tpu_resilience_retries" in txt
+srv.stop()
+RESEOF
+then
+    echo "COLLECT SMOKE FAILED: faults / gateway-resilience round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

@@ -18,10 +18,20 @@ Examples::
     python tools/serve_gateway.py --replicas 2 --demo 8 --drain-one
     python tools/serve_gateway.py --replicas 1 --demo 24 --autoscale \\
         --max-replicas 3 --up-cooldown 0 --ops-port 9100
+    python tools/serve_gateway.py --replicas 2 --demo 12 --resilience \\
+        --chaos crash
+    python tools/serve_gateway.py --replicas 2 --demo 12 --resilience \\
+        --chaos '[{"kind": "slow", "at_s": 0, "factor": 10}]'
 
 ``--drain-one`` gracefully drains replica 0 mid-workload — the rolling-
 restart rehearsal: the report asserts every admitted request still
 finished (zero drops).
+
+``--chaos`` wraps every replica in a ``paddle_tpu.faults.FaultyEngine``
+and injects the named preset (or inline/`@file` JSON plan) on the real
+clock — the chaos rehearsal; pair it with ``--resilience`` to watch the
+breaker/retry/hedge/brownout layer absorb the faults (report
+``resilience`` section + live ``/resilience`` with ``--ops-port``).
 
 ``--autoscale`` closes the loop: a TTFT-p99 + shed-rate ``SLOMonitor``
 feeds an ``ElasticAutoscaler`` (min/max/cooldown knobs below) that
@@ -34,12 +44,43 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 ENGINES = ("ragged", "paged", "contiguous")
 PRESETS = ("tiny", "gpt2-small", "gpt2-medium", "gpt2-large")
+
+#: --chaos presets (fault at_s are seconds after startup; every preset
+#: targets r0 so a >= 2 replica fleet demonstrates the recovery)
+CHAOS_PRESETS = {
+    "crash": [{"kind": "crash", "at_s": 0.5, "replica": "r0"}],
+    "stall": [{"kind": "stall", "at_s": 0.5, "duration_s": 8.0,
+               "replica": "r0"}],
+    "slow": [{"kind": "slow", "at_s": 0.0, "duration_s": 60.0,
+              "factor": 10.0, "replica": "r0"}],
+    "flaky": [{"kind": "dispatch_error", "at_s": 0.0, "duration_s": 10.0,
+               "count": 4, "replica": "r0"}],
+    "mixed": [{"kind": "slow", "at_s": 0.0, "duration_s": 60.0,
+               "factor": 10.0, "replica": "r0"},
+              {"kind": "dispatch_error", "at_s": 0.0, "duration_s": 10.0,
+               "count": 3, "replica": "r1"}],
+}
+
+
+def _chaos_plan(spec):
+    """--chaos value → FaultPlan (or None): a preset name, inline JSON
+    (plan dict or bare fault list), or @path to a JSON file."""
+    if spec is None:
+        return None
+    from paddle_tpu.faults import FaultPlan
+    if spec in CHAOS_PRESETS:
+        return FaultPlan.from_dict({"faults": CHAOS_PRESETS[spec]})
+    if spec.startswith("@"):
+        with open(spec[1:]) as f:
+            return FaultPlan.from_json(f.read())
+    return FaultPlan.from_json(spec)
 
 
 def _build_model(args):
@@ -137,25 +178,56 @@ def main(argv=None):
     ap.add_argument("--ops-port", type=int, default=None,
                     help="start the live ops endpoint on this port "
                          "(/gateway /metrics /healthz /ledger /trace "
-                         "/autoscaler)")
+                         "/resilience /autoscaler)")
+    ap.add_argument("--chaos", default=None, metavar="PLAN",
+                    help="inject a fault plan (paddle_tpu.faults): a "
+                         f"preset name ({'/'.join(sorted(CHAOS_PRESETS))})"
+                         ", inline JSON (a plan dict or bare fault "
+                         "list), or @path to a JSON file; fault at_s "
+                         "times are seconds after startup")
+    ap.add_argument("--resilience", action="store_true",
+                    help="attach the gateway resilience layer (circuit "
+                         "breakers, retry/backoff, TTFT hedging, "
+                         "brownout ladder) at ResiliencePolicy defaults "
+                         "— the report and /resilience gain breaker/"
+                         "brownout state")
+    ap.add_argument("--stall-threshold", type=float, default=None,
+                    help="replica stall-quarantine threshold in seconds "
+                         "(default 30, or 5 with --chaos so injected "
+                         "crashes resolve on demo timescales)")
     args = ap.parse_args(argv)
 
     import numpy as np
     import paddle_tpu as paddle
-    from paddle_tpu.gateway import ServingGateway
+    from paddle_tpu.gateway import ResiliencePolicy, ServingGateway
     from paddle_tpu.telemetry import Tracer
 
     paddle.seed(0)
     cfg, model, params = _build_model(args)
     tracer = Tracer(capacity=16384)
+    plan = _chaos_plan(args.chaos)
+    stall_s = args.stall_threshold
+    if stall_s is None:
+        stall_s = 5.0 if plan is not None else 30.0
+    t0 = time.monotonic()
+    clock = lambda: time.monotonic() - t0       # noqa: E731 — fault at_s
+    # times are relative to startup; gateway + wrappers share the clock
     gw = ServingGateway(max_queue_depth=args.max_queue_depth,
                         max_queued_tokens=args.max_queued_tokens,
-                        tracer=tracer)
+                        stall_threshold_s=stall_s, clock=clock,
+                        tracer=tracer,
+                        resilience=(ResiliencePolicy() if args.resilience
+                                    else None))
     names = []
+    wrappers = []
     for i in range(args.replicas):
         eng = _build_engine(args, model, params, Tracer())
         if args.warmup_cache_dir:
             eng.warmup(cache_dir=args.warmup_cache_dir)
+        if plan is not None:
+            from paddle_tpu.faults import FaultyEngine
+            eng = FaultyEngine(eng, plan, clock, replica=f"r{i}")
+            wrappers.append(eng)
         names.append(gw.add_replica(eng, f"r{i}"))
 
     asc = None
@@ -211,18 +283,23 @@ def main(argv=None):
                               deadline_s=args.deadline))
     if args.drain_one and names:
         gw.drain(names[0])
-    if asc is None:
+    if asc is None and plan is None:
         gw.run_to_completion(max_ticks=100000)
     else:
         # the autoscaler gets one control round per gateway round — the
-        # same interleave the simulation harness drives
+        # same interleave the simulation harness drives; under --chaos
+        # the wall clock must actually advance for fault windows and
+        # stall detection, so the drive loop paces itself
         ticks = 0
         while gw.pending():
             gw.step()
-            asc.evaluate()
+            if asc is not None:
+                asc.evaluate()
             ticks += 1
-            if ticks > 100000:
-                raise RuntimeError("not done after 100000 ticks")
+            if ticks > 200000:
+                raise RuntimeError("not done after 200000 ticks")
+            if plan is not None:
+                time.sleep(0.01)
         gw.pop_finished()
 
     outcomes = {}
@@ -247,6 +324,12 @@ def main(argv=None):
         report["autoscaler"] = {"fleet": asnap["fleet"],
                                 "decisions": asnap["decisions"],
                                 "counters": asnap["counters"]}
+    if plan is not None:
+        report["chaos"] = {"plan": plan.to_dict(),
+                           "injected": [ev for w in wrappers
+                                        for ev in w.injected()]}
+    if args.resilience:
+        report["resilience"] = gw.resilience_snapshot()
     print(json.dumps(report))
     if srv is not None:
         srv.stop()
