@@ -493,10 +493,17 @@ def bench_gpt_serving(on_tpu):
                                                       buckets[-1] + 1))],
              int(rng.randint(lo_new, hi_new + 1))) for _ in range(n_reqs)]
 
-    def run_once(tracer=None):
+    def run_once(tracer=None, spec=False):
+        # the speculative arm SELF-drafts (draft == target): the upper
+        # bound on acceptance (~1.0 — draft and verify argmax the same
+        # weights), so the A/B isolates the scheduling win (one host
+        # sync per K+1 tokens) from draft quality
+        kw = (dict(draft_model=model, draft_params=params, draft_k=4)
+              if spec else {})
         eng = RaggedPagedContinuousBatchingEngine(
             model, params, max_slots=slots, max_len=max_len, block_size=bs,
-            prompt_buckets=buckets, token_budget=budget, tracer=tracer)
+            prompt_buckets=buckets, token_budget=budget, tracer=tracer,
+            **kw)
         added = 0
         while added < len(reqs) or eng.pending():
             # staggered arrivals: two new requests per tick, so admission
@@ -516,13 +523,45 @@ def bench_gpt_serving(on_tpu):
     # leaks into tokens/s, tick/TTFT percentiles, or the MFU denominator
     warm_tracer = Tracer(capacity=16384, attribute_cost=True)
     run_once(warm_tracer)
-    tracer = Tracer(capacity=16384, attribute_cost=True)
-    for _lbl, _cost in warm_tracer.program_costs().items():
-        tracer.record_cost(_lbl, _cost)
-    t0 = time.perf_counter()
-    total, eng = run_once(tracer)
-    dt = time.perf_counter() - t0
-    assert total == sum(n for _, n in reqs), (total, "tokens dropped")
+
+    def timed(warm, spec):
+        # a FRESH measured tracer per attempt, pre-seeded with the warm
+        # run's program costs, so no probe work or stale events leak in
+        tr = Tracer(capacity=16384, attribute_cost=True)
+        for _lbl, _cost in warm.program_costs().items():
+            tr.record_cost(_lbl, _cost)
+        t0 = time.perf_counter()
+        n, e = run_once(tr, spec=spec)
+        wall = time.perf_counter() - t0
+        assert n == sum(x for _, x in reqs), (n, spec, "tokens dropped")
+        return n, e, wall, tr
+
+    total, eng, dt, tracer = timed(warm_tracer, False)
+
+    # ---- speculative A/B: the SAME seeded mixed-arrival load through
+    # the ragged engine's fused draft+verify tick (ISSUE 13) ----
+    spec_warm = Tracer(capacity=16384, attribute_cost=True)
+    run_once(spec_warm, spec=True)
+    stotal, seng, sdt, spec_tracer = timed(spec_warm, True)
+    # the acceptance pin: at self-draft acceptance (>= 0.5 by huge
+    # margin — argmax of identical weights) the spec-ragged tick must
+    # STRICTLY beat plain ragged decode, or the config fails instead of
+    # shading a number.  One bounded re-measure of BOTH arms absorbs
+    # scheduler jitter on small-margin hosts — the re-measured numbers
+    # are the ones recorded, so the record stays honest either way.
+    if float(seng.metrics()["acceptance_rate"]) >= 0.5 \
+            and stotal / sdt <= total / dt:
+        total, eng, dt, tracer = timed(warm_tracer, False)
+        stotal, seng, sdt, spec_tracer = timed(spec_warm, True)
+    sm = seng.metrics()
+    spec_tok_s = stotal / sdt
+    acceptance = float(sm["acceptance_rate"])
+    stel = spec_tracer.summary()
+    if acceptance >= 0.5:
+        assert spec_tok_s > total / dt, (spec_tok_s, total / dt,
+                                         acceptance)
+    # telemetry snapshot for the (possibly re-measured) plain run the
+    # headline number reports
     tel = tracer.summary()
     tick = tel["tick_wall_s"] or {}
     req = tel["requests"]
@@ -531,7 +570,7 @@ def bench_gpt_serving(on_tpu):
     def ms(v):
         return None if v is None else round(v * 1e3, 3)
 
-    return {"metric": "gpt_serving_tokens_per_sec",
+    out = {"metric": "gpt_serving_tokens_per_sec",
             "value": round(total / dt, 1), "unit": "tokens/s/chip",
             # null unless PADDLE_TPU_PEAK_FLOPS declares the roofline;
             # the raw model-FLOPs attribution reports either way
@@ -563,7 +602,26 @@ def bench_gpt_serving(on_tpu):
                 "model_flops_per_s": mfu["model_flops_per_s"],
                 "arithmetic_intensity": mfu["arithmetic_intensity"],
                 "mfu": mfu["mfu"],
+                # spec-ragged A/B fields (tools/bench_diff.py judges
+                # these direction-aware between rounds)
+                "acceptance_rate": round(acceptance, 4),
+                "accepted_tokens_per_s": round(
+                    float(sm["tokens_accepted"]) / sdt, 1),
+                "spec_tokens_per_sec": round(spec_tok_s, 1),
             }}
+    out["speculative"] = {
+        "draft": "self", "draft_k": int(seng.K),
+        "tokens_per_sec": round(spec_tok_s, 1),
+        "speedup_vs_plain": round(spec_tok_s / (total / dt), 3),
+        "acceptance_rate": round(acceptance, 4),
+        "spec_rounds": int(seng.spec_rounds),
+        "tokens_drafted": int(sm["tokens_drafted"]),
+        "tokens_accepted": int(sm["tokens_accepted"]),
+        # MFU attribution over the spec run (accepted-token roofline)
+        "mfu": stel["mfu"]["mfu"],
+        "model_flops_per_s": stel["mfu"]["model_flops_per_s"],
+    }
+    return out
 
 
 def bench_gpt_serving_warmup(on_tpu):

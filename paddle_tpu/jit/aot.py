@@ -25,7 +25,10 @@ path.  This module is the TPU-native equivalent for a framework whose
 3. **Warmup planner** (:func:`run_warmup` / :func:`warmup_async`): engines
    and step builders declare their compile grid (``engine.compile_grid()``
    enumerates the bucket/table-width program families behind
-   ``serving_paged.py``; training steps AOT-compile via
+   ``serving_paged.py`` — the ragged engine's grid is one program per
+   (token_budget, table-width) bucket whether or not a draft model is
+   attached: speculation swaps the family, it never widens the grid;
+   training steps AOT-compile via
    :func:`compile_aot`), and the planner precompiles it — optionally on a
    background thread — before traffic.  Progress reports through the
    telemetry tracer: compile events gain a ``provenance`` tag

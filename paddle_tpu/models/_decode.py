@@ -358,6 +358,36 @@ def make_token_sampler(temperature, top_k, top_p, greedy):
     return sample
 
 
+def greedy_verify(d, tpred, active=None):
+    """THE greedy speculative-acceptance contract, shared by
+    ``generate_speculative`` and the ragged serving engine's fused
+    draft+verify step so the semantics cannot drift: accept the longest
+    prefix of the draft proposals ``d`` (B, K) that matches the target's
+    argmax predictions ``tpred`` (B, K+1) position for position, and
+    emit the target's own prediction at the first mismatch (or the bonus
+    position when everything matched) — by construction the emitted
+    stream equals plain greedy decode token for token.
+
+    ``active`` (B,) bool optionally masks rows whose proposals are
+    garbage (a mixed spec/non-spec batch): masked rows get ``lead`` 0,
+    so their emitted token is simply ``tpred[:, 0]`` — plain greedy
+    decode through the same code path.
+
+    Returns ``(lead, block)``: per-row accepted counts and the (B, K+1)
+    token block whose first ``lead + 1`` entries are the round's emitted
+    tokens (``d_0..d_{lead-1}``, then the replacement at ``lead``)."""
+    B, K = d.shape
+    lead = jnp.sum(jnp.cumprod(
+        (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
+    if active is not None:
+        lead = jnp.where(active, lead, 0)
+    repl = jnp.take_along_axis(
+        tpred, jnp.minimum(lead, K)[:, None], 1)[:, 0]
+    block = jnp.concatenate([d, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    block = block.at[jnp.arange(B), lead].set(repl)
+    return lead, block
+
+
 def speculative_accept(q_probs, p_probs, d_tokens, key):
     """Leviathan/Chen acceptance-rejection for one speculative round — the
     output token sequence is distributed EXACTLY as autoregressive sampling
@@ -802,21 +832,21 @@ class CausalDecoderMixin:
                                               slot(n - 1))
                 _, dc = draft_model.decode_step(dparams, dh, dc, slot(n - 1))
                 if greedy:
+                    # ONE copy of the greedy acceptance rule (greedy_verify)
+                    # shared with the ragged serving engine's fused
+                    # draft+verify step; only the first lead+1 entries of
+                    # the block are ever read (rows advance by lead + 1)
                     tpred = jnp.argmax(tl, -1).astype(jnp.int32)
-                    lead = jnp.sum(jnp.cumprod(
-                        (d == tpred[:, :K]).astype(jnp.int32), axis=1),
-                        axis=1)
-                    repl_src = tpred                            # (B, K+1)
-                    repl = jnp.take_along_axis(
-                        repl_src, jnp.minimum(lead, K)[:, None], 1)[:, 0]
+                    lead, cand = greedy_verify(d, tpred)
                 else:
                     q_probs = jnp.swapaxes(qp, 0, 1)            # (B, K, V)
                     p_probs = jax.nn.softmax(tl, -1)            # (B, K+1, V)
                     lead, repl = speculative_accept(q_probs, p_probs, d, ka)
-                d_ext = jnp.concatenate(
-                    [d, jnp.zeros((B, 1), jnp.int32)], axis=1)  # (B, K+1)
-                cand = jnp.where(jnp.arange(K + 1)[None] < lead[:, None],
-                                 d_ext, repl[:, None])
+                    d_ext = jnp.concatenate(
+                        [d, jnp.zeros((B, 1), jnp.int32)], axis=1)
+                    cand = jnp.where(
+                        jnp.arange(K + 1)[None] < lead[:, None],
+                        d_ext, repl[:, None])
                 slots = n[:, None] + jnp.arange(K + 1)[None]
                 buf = buf.at[rows[:, None], slots].set(cand)
                 n = jnp.minimum(n + lead + 1, P + N)

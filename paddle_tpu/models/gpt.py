@@ -364,7 +364,13 @@ class GPTModel(CausalDecoderMixin, Layer):
         ``pools`` = (pool_ck, pool_cv) stacked over layers (int8
         ``(values, scales)`` pairs included), table (S, C) shared across
         layers, row metadata per ops/ragged_paged_attention.ragged_rows.
-        Returns (h_out, pools)."""
+        Returns (h_out, pools).
+
+        Speculative VERIFY chunks are just another ragged row group: a
+        slot's [prev, d_0..d_{K-1}] rows at kv positions [t, t+K] ride
+        the same write-then-attend order (each draft row attends its
+        predecessors' freshly written k/v), so the ragged spec engine
+        needs no separate verify program — the pack IS the verify."""
         stacked = {k: params[k] for k in self.stacked_param_names()}
 
         def body(carry, xs):

@@ -13,6 +13,13 @@ with ``q_len == 1`` and a prefill chunk one with ``q_len == n``.  Causality
 is per ROW (query at kv position p attends positions <= p), so any mixture
 of admission prefill and in-flight decode runs as one program.
 
+A speculative VERIFY chunk is the same shape by construction: a slot's
+``[prev, d_0..d_{K-1}]`` rows at kv positions ``[t, t+K]`` are a
+``q_len == K+1`` sequence — each draft row attends its predecessors'
+freshly scattered k/v under the per-row causal rule, so both the kernel
+and the gather fallback are verify-aware with no extra code path (the
+ragged spec engine's fused draft+verify tick rides exactly this).
+
 int8 ``(values, scales)`` pools (models/_decode.py quantize_kv layout) are
 supported IN-KERNEL: the scale plane rides its own block spec and the
 dequantize multiply fuses into the k/v read — no fp copy of the pool ever

@@ -96,6 +96,15 @@ def _program_cost(run, a, kw):
         return None
 
 
+def _default_buckets(max_len: int) -> List[int]:
+    """The engines' default prompt-bucket ladder for ``max_len`` — ONE
+    copy shared by the base constructor and the speculative shims (which
+    need the resolved ladder before construction to derive a block
+    size)."""
+    return [b for b in (16, 32, 64, 128, 256, 512, 1024)
+            if b <= max_len] or [int(max_len)]
+
+
 def _slot_write(slot):
     """Tree-mapper writing one slot's region of a global cache leaf
     (rank-generic: int8 caches pair a 5D value plane with a 4D scale
@@ -187,8 +196,7 @@ class ContinuousBatchingEngine:
         self.S = int(max_slots)
         self.max_len = int(max_len)
         if prompt_buckets is None:
-            prompt_buckets = [b for b in (16, 32, 64, 128, 256, 512, 1024)
-                              if b <= max_len] or [max_len]
+            prompt_buckets = _default_buckets(max_len)
         self.buckets = sorted(set(int(b) for b in prompt_buckets))
         self.eos_token_id = eos_token_id
         self.ticks_per_sync = int(ticks_per_sync)
@@ -1279,309 +1287,24 @@ class ContinuousBatchingEngine:
         return self.pop_finished()
 
 
-class SpeculativeBatchingEngine(ContinuousBatchingEngine):
-    """Continuous batching WITH speculative decoding: every scheduler round
-    the draft proposes ``draft_k`` tokens for all slots, ONE target chunk
-    verifies them (cached_attention's k-query form, per-row clocks), and
-    each slot advances by its own accepted count — bit-lossless vs greedy
-    (the acceptance rule is the longest argmax-matching prefix, exactly
-    models/_decode.py's greedy speculative contract), so outputs equal the
-    plain engine's token for token while rounds shrink by the acceptance
-    rate.
-
-    The draft keeps its own slot cache, prefilled at admission alongside the
-    target's; both caches self-heal — each round's chunk rewrites
-    [t, t+K+1) BEFORE reading any of it, so leftover k/v from rejected
-    proposals (and inactive slots' parked stale writes) are never read.
-    v1 scope: greedy only, no processors, whole-bucket prefill only
-    (the paged composition lifts the prefill restriction).
-    """
-
-    _SUPPORTED_CACHE_KW = frozenset({"tracer"})
-
-    def __init__(self, model, params, draft_model, draft_params,
-                 max_slots: int, max_len: int, draft_k: int = 4,
-                 prompt_buckets=None, eos_token_id: Optional[int] = None,
-                 key=None, mesh=None, **cache_kw):
-        if mesh is not None:
-            raise NotImplementedError("speculative engine v1 is single-mesh")
-        # cache_kw forwards ONLY the class-supported extras (the paged
-        # composition widens _SUPPORTED_CACHE_KW: storage layout, prefix
-        # caching, chunked prefill); everything else - e.g. sampler knobs
-        # the greedy spec round would silently ignore - is rejected loudly
-        bad = set(cache_kw) - self._SUPPORTED_CACHE_KW
-        if bad:
-            raise NotImplementedError(
-                f"{type(self).__name__} does not support {sorted(bad)}")
-        super().__init__(model, params, max_slots, max_len,
-                         prompt_buckets=prompt_buckets, greedy=True,
-                         eos_token_id=eos_token_id, key=key,
-                         # round write-span is K+1: reuse the base class's
-                         # parking/room arithmetic by declaring it the sync
-                         # width (step() below never uses it as tick count)
-                         ticks_per_sync=int(draft_k) + 1, **cache_kw)
-        dc = draft_model.config
-        if dc.vocab_size != model.config.vocab_size:
-            raise ValueError(f"draft vocab ({dc.vocab_size}) != target "
-                             f"vocab ({model.config.vocab_size})")
-        if max_len > dc.max_position_embeddings:
-            raise ValueError(f"max_len {max_len} exceeds the DRAFT's "
-                             f"max_position_embeddings "
-                             f"({dc.max_position_embeddings})")
-        self.draft_model = draft_model
-        self.draft_params = draft_params
-        self.K = int(draft_k)
-        if self.K < 1:
-            raise ValueError("draft_k must be >= 1")
-        self.draft_caches = self._alloc_draft_caches()
-        self.rounds = 0          # spec rounds run (for efficiency reporting)
-
-    def _alloc_draft_caches(self):
-        """Draft-cache storage seam (mirrors _alloc_caches): the paged
-        composition replaces this with a block pool sharing the target's
-        tables - the dense draft cache is never materialized there."""
-        return self.draft_model.init_cache(self.S, self.max_len)
-
-    @property
-    def _sig(self):
-        d = self.draft_model.config
-        return ("spec", self.S, self.max_len, self.K,
-                (type(self.draft_model).__name__, d.num_layers,
-                 d.hidden_size, d.vocab_size), self._sample_sig)
-
-    def _cached_prog(self, cache_key, build):
-        """Overrides the base cache with a DRAFT-identity check (the
-        _spec_program pattern): the compiled closures capture the draft
-        model object, and the config tuple in _sig is not a complete
-        architecture signature — an engine over the same target but a
-        different draft instance must rebuild, never reuse.  Same
-        hit/miss telemetry as the base cache (_note_prog)."""
-        import weakref
-        progs = self.model.__dict__.setdefault("_serving_programs", {})
-        entry = progs.get(cache_key)
-        if entry is not None:
-            ref, cached = entry
-            if ref() is self.draft_model:
-                self._note_prog(cache_key, True)
-                return cached
-        run = build()
-        # bare program in the cache, wrapper only on the local return
-        # (same tracer-lifetime reasoning as the base _cached_prog)
-        progs[cache_key] = (weakref.ref(self.draft_model), run)
-        return self._note_prog(cache_key, False, run)
-
-    def _positions_needed(self, P: int, mnt: int) -> int:
-        # budget 1 completes at admission prefill — no round, no slack;
-        # otherwise the LAST round can start at t = P + budget - 2 and
-        # write its full K+1-wide chunk (draft_k over-proposal slack)
-        return P if mnt == 1 else P + mnt + self.K - 1
-
-    def _prefill_prog(self, P: int):
-        """Admission prefill for BOTH caches (target + draft) + tok0."""
-        model, draft = self.model, self.draft_model
-
-        def build():
-            tail = self._first_token_tail()
-
-            @partial(jax.jit, donate_argnums=(1, 2))
-            def run(params_pair, big, dbig, ids, pad_len, slot, key,
-                    presence):
-                params, dparams = params_pair
-                big_ck, big_cv = big
-                dbig_ck, dbig_cv = dbig
-
-                put = _slot_write(slot)
-                h, (ck, cv) = model.prefill(params, ids, P,
-                                            pad_lens=pad_len[None])
-                big_ck = jax.tree.map(put, big_ck, ck)
-                big_cv = jax.tree.map(put, big_cv, cv)
-                _, (dck, dcv) = draft.prefill(dparams, ids, P,
-                                              pad_lens=pad_len[None])
-                dbig_ck = jax.tree.map(put, dbig_ck, dck)
-                dbig_cv = jax.tree.map(put, dbig_cv, dcv)
-                tok, presence = tail(params, h[:, -1:], presence, slot, key)
-                return (big_ck, big_cv), (dbig_ck, dbig_cv), tok, presence
-
-            return run
-
-        return self._cached_prog(("spec_prefill", P, self._sig), build)
-
-    def _admit(self):
-        free = self._free_slots()
-        while self._queue and free:
-            slot = free.pop(0)
-            req = self._queue.pop(0)
-            P = select_bucket(len(req.prompt), self.buckets)
-            pad = P - len(req.prompt)
-            ids = [0] * pad + req.prompt
-            self._set_planes(slot, req)     # classic mode: telemetry only
-            run = self._prefill_prog(P)
-            big, dbig, tok0, self._presence = run(
-                (self.params, self.draft_params), self.caches,
-                self.draft_caches, jnp.asarray([ids], jnp.int32),
-                jnp.int32(pad), jnp.int32(slot), self._next_key(),
-                self._presence)
-            self.caches, self.draft_caches = big, dbig
-            self._note("prefill_tokens", P)
-            self._activate(slot, req, P, pad, int(tok0))
-
-    def _spec_round_prog(self):
-        """One speculative round for all S slots: draft K sequential
-        proposals (per-row clocks), one target verify chunk, greedy
-        longest-prefix acceptance.  Returns per-row accepted counts and the
-        (S, K+1) token block (d_0..d_{K-1}, replacement at position lead)."""
-        model, draft = self.model, self.draft_model
-        K, S = self.K, self.S
-
-        def build():
-            return self._make_spec_round(model, draft, K, S)
-
-        return self._cached_prog(("spec_round", self._sig), build)
-
-    @staticmethod
-    def _make_spec_round(model, draft, K, S):
-        core = SpeculativeBatchingEngine._spec_round_core
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params_pair, big, dbig, toks, ts, pads):
-            return core(model, draft, K, S, params_pair, big, dbig, toks,
-                        ts, pads)
-        return run
-
-    @staticmethod
-    def _spec_round_core(model, draft, K, S, params_pair, big, dbig, toks,
-                         ts, pads):
-        """One speculative round over any cache layout — the paged
-        composition wraps pools as PagedKV and calls this same core, so
-        the acceptance semantics cannot drift between layouts."""
-        # greedy + host-side discard: no randomness, no device-side
-        # active masking — inactive rows compute and their writes park
-        params, dparams = params_pair
-        rows = jnp.arange(S)
-
-        def dstep(carry, i):
-            tok, dc = carry
-            hh = draft._embed_one(dparams, tok, ts + i, pad_lens=pads)
-            hh, dc = draft.decode_step(dparams, hh, dc, ts + i,
-                                       pad_lens=pads)
-            ql = draft.decode_logits(dparams, hh)[:, -1]
-            ntok = jnp.argmax(ql, -1).astype(jnp.int32)
-            return (ntok, dc), ntok
-
-        (_, dbig), d = jax.lax.scan(dstep, (toks, dbig), jnp.arange(K))
-        d = d.T                                             # (S, K)
-
-        # ONE verify chunk per row over [prev, d_0..d_{K-1}] at clocks
-        # [ts, ts+K] (prev's kv lands at ts, matching plain decode)
-        inp = jnp.concatenate([toks[:, None], d], axis=1)   # (S, K+1)
-        hin = model._embed_chunk(params, inp, ts, pad_lens=pads)
-        hv, big = model.decode_step(params, hin, big, ts, pad_lens=pads)
-        tl = model.decode_logits(params, hv)                # (S, K+1, V)
-        tpred = jnp.argmax(tl, -1).astype(jnp.int32)        # (S, K+1)
-        lead = jnp.sum(jnp.cumprod(
-            (d == tpred[:, :K]).astype(jnp.int32), axis=1), axis=1)
-        repl = jnp.take_along_axis(
-            tpred, jnp.minimum(lead, K)[:, None], 1)[:, 0]  # (S,)
-        # emitted block: d_0..d_{lead-1}, then repl at position lead
-        block = d  # (S, K) proposals
-        block = jnp.concatenate([block, jnp.zeros((S, 1), jnp.int32)],
-                                axis=1)
-        block = block.at[rows, lead].set(repl)              # (S, K+1)
-
-        # draft self-heal (the round-3 hole fix): the draft scan
-        # already wrote kv for [prev, d_0..d_{K-2}] at [ts, ts+K-1];
-        # only d_{K-1}'s kv at ts+K is missing — one draft step fills
-        # it at ~1/(K+1) the cost of re-ingesting the whole chunk
-        dh = draft._embed_one(dparams, d[:, K - 1], ts + K,
-                              pad_lens=pads)
-        _, dbig = draft.decode_step(dparams, dh, dbig, ts + K,
-                                    pad_lens=pads)
-
-        return big, dbig, lead, block
-
-    # ------------------------------------------------------------- warmup --
-
-    def _warmup_tasks(self):
-        from .jit.aot import WarmupTask
-        tasks = [WarmupTask(f"spec_prefill:{P}",
-                            partial(self._warmup_prefill, P))
-                 for P in self.buckets]
-        tasks.append(WarmupTask("spec_round", self._warmup_spec_round))
-        return tasks
-
-    def _warmup_prefill(self, P: int):
-        run = self._prefill_prog(P)
-        big = self._alloc_caches()
-        dbig = self._alloc_draft_caches()
-        jax.block_until_ready(run(
-            (self.params, self.draft_params), big, dbig,
-            jnp.zeros((1, P), jnp.int32), jnp.int32(0), jnp.int32(0),
-            self._warmup_key(), self._scratch_presence()))
-
-    def _warmup_spec_round(self):
-        run = self._spec_round_prog()
-        big = self._alloc_caches()
-        dbig = self._alloc_draft_caches()
-        z = jnp.zeros(self.S, jnp.int32)
-        jax.block_until_ready(run(
-            (self.params, self.draft_params), big, dbig, z, z, z))
-
-    def _step_impl(self):
-        """One scheduler round: admit (advancing any chunked fills in
-        the paged composition), then one speculative round; each active
-        slot advances by its own accepted count + 1."""
-        self._admit()
-        if self._filling:
-            self._fill_segments()
-        if not self._active.any():
-            return
-        res = self._run_spec_round()
-        if res is None:
-            return
-        active_before, lead, block = res
-        self.rounds += 1
-        for slot in np.flatnonzero(active_before):
-            m = int(lead[slot]) + 1                 # tokens this round
-            for j in range(m):
-                if not self._active[slot]:
-                    break                           # retired mid-round
-                self._t[slot] += 1
-                self._tok[slot] = block[slot, j]
-                self._record(int(slot), int(block[slot, j]))
-            # room safety net at round boundaries (admission guarantees it
-            # never fires for valid budgets)
-            if self._active[slot] and \
-                    int(self._t[slot]) + self.K + 1 > self.max_len:
-                self._retire(int(slot))
-
-    def _run_spec_round(self):
-        """Run one speculative round over the engine's cache storage;
-        returns (active_before, lead, block) or None.  The paged
-        composition overrides this to grow block tables first."""
-        run = self._spec_round_prog()
-        active_before = self._active.copy()
-        self._note("decode_rows", int(active_before.sum()))
-        big, dbig, lead, block = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, jnp.asarray(self._tok),
-            jnp.asarray(self._t), jnp.asarray(self._pad))
-        self.caches, self.draft_caches = big, dbig
-        return active_before, np.asarray(lead), np.asarray(block)
-
-
-# paged (block-table) variant — defined in serving_paged.py, re-exported
-# here LAZILY (PEP 562) so `paddle_tpu.serving` is the single public
-# serving namespace without a circular import (serving_paged imports this
-# module at its top)
-__all__ += ["PagedContinuousBatchingEngine",
-            "PagedSpeculativeBatchingEngine",
-            "RaggedPagedContinuousBatchingEngine"]
+# The speculative engines and every paged (block-table) variant are
+# defined in serving_paged.py and re-exported here LAZILY (PEP 562) so
+# `paddle_tpu.serving` stays the single public serving namespace without
+# a circular import (serving_paged imports this module at its top).
+# `SpeculativeBatchingEngine` / `PagedSpeculativeBatchingEngine` are now
+# deprecation SHIMS over the unified ragged engine: speculation runs
+# inside `RaggedPagedContinuousBatchingEngine` as part of the one-
+# program-per-tick ragged pack (draft_model=/draft_k= constructor args),
+# so the legacy engines' separate program families are gone.
+_PAGED_NAMES = ("PagedContinuousBatchingEngine",
+                "PagedSpeculativeBatchingEngine",
+                "RaggedPagedContinuousBatchingEngine",
+                "SpeculativeBatchingEngine")
+__all__ += [n for n in _PAGED_NAMES if n not in __all__]
 
 
 def __getattr__(name):
-    if name in ("PagedContinuousBatchingEngine",
-                "PagedSpeculativeBatchingEngine",
-                "RaggedPagedContinuousBatchingEngine"):
+    if name in _PAGED_NAMES:
         from . import serving_paged
         return getattr(serving_paged, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

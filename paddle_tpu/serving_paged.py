@@ -45,6 +45,8 @@ from __future__ import annotations
 
 import collections
 import logging
+import math
+import time
 from functools import partial
 from typing import Optional
 
@@ -52,15 +54,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .serving import (ContinuousBatchingEngine,
-                      SpeculativeBatchingEngine)
+from .serving import ContinuousBatchingEngine, _default_buckets
 from .jit.bucketing import pow2_bucket, pow2_grid, select_bucket
 from .models._decode import (PagedKV, apply_repetition_penalty,
-                             seed_presence, suppress_eos, suppress_eos_rows)
+                             greedy_verify, seed_presence, suppress_eos,
+                             suppress_eos_rows)
 
 __all__ = ["PagedContinuousBatchingEngine",
            "PagedSpeculativeBatchingEngine",
-           "RaggedPagedContinuousBatchingEngine"]
+           "RaggedPagedContinuousBatchingEngine",
+           "SpeculativeBatchingEngine"]
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -566,9 +569,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         prompt_l = [int(t) for t in prompt]
         if prompt_l:
             P = select_bucket(len(prompt_l), self.buckets)
-            worst = -(-self._positions_needed(P, int(max_new_tokens))
-                      // self.bs)
-            if worst > self.NB:
+            need = self._positions_needed(P, int(max_new_tokens))
+            worst = -(-need // self.bs)
+            # a request that exceeds max_len outright belongs to the base
+            # validation (its error names the real limit); the pool guard
+            # covers only requests the cache COULD hold
+            if need <= self.max_len and worst > self.NB:
                 raise ValueError(
                     f"request needs up to {worst} blocks; the pool has "
                     f"{self.NB} — raise num_blocks or lower "
@@ -849,7 +855,8 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
     """
 
     def __init__(self, model, params, max_slots: int, max_len: int,
-                 token_budget: Optional[int] = None, **kw):
+                 token_budget: Optional[int] = None, draft_model=None,
+                 draft_params=None, draft_k: int = 4, **kw):
         if kw.get("prefill_chunk") is not None:
             raise ValueError(
                 "the ragged engine chunks prefill via token_budget; "
@@ -863,14 +870,67 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
                 f"{type(model).__name__} has no decode_ragged path; the "
                 f"ragged engine needs the model-side ragged chunk support "
                 f"(models/gpt.py) — use PagedContinuousBatchingEngine")
+        # ---- speculative decoding INSIDE the ragged tick (ISSUE 13) ----
+        # a draft model folds draft proposal + target verification into
+        # the SAME one-program-per-(token_budget, table-width) pack; set
+        # before super().__init__ — _sig and the program cache key on it
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.K = int(draft_k)
+        if draft_model is not None:
+            dc = draft_model.config
+            if dc.vocab_size != model.config.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({dc.vocab_size}) != target vocab "
+                    f"({model.config.vocab_size})")
+            if max_len > dc.max_position_embeddings:
+                raise ValueError(
+                    f"max_len {max_len} exceeds the DRAFT's "
+                    f"max_position_embeddings "
+                    f"({dc.max_position_embeddings})")
+            if self.K < 1:
+                raise ValueError("draft_k must be >= 1")
+            if not hasattr(draft_model, "decode_ragged"):
+                raise NotImplementedError(
+                    f"{type(draft_model).__name__} has no decode_ragged "
+                    f"path — the ragged spec step ingests the pack into "
+                    f"the draft pool through it")
+            # the greedy speculative contract (models/_decode.py): the
+            # acceptance rule compares ARGMAX predictions, so sampling
+            # and the logits processors are out of scope — exactly the
+            # legacy spec engines' v1 scope, now enforced here
+            if kw.get("per_request_sampling"):
+                raise NotImplementedError(
+                    "ragged speculation is greedy-only; "
+                    "per_request_sampling is the plain engines' knob")
+            if not kw.get("greedy", True):
+                raise NotImplementedError(
+                    "ragged speculation is greedy-only (the acceptance "
+                    "rule is the longest argmax-matching prefix)")
+            if float(kw.get("repetition_penalty", 1.0)) != 1.0 \
+                    or int(kw.get("min_new_tokens", 0) or 0) != 0:
+                raise NotImplementedError(
+                    "ragged speculation does not support "
+                    "repetition_penalty/min_new_tokens yet")
         super().__init__(model, params, max_slots, max_len, **kw)
+        rows_per_slot = (self.K + 1) if draft_model is not None else 1
         tb = (int(token_budget) if token_budget is not None
-              else int(max_slots) + max(self.buckets))
+              else int(max_slots) * rows_per_slot + max(self.buckets))
         if tb < int(max_slots):
             raise ValueError(
                 f"token_budget ({tb}) must cover every decode slot "
                 f"(max_slots={max_slots})")
         self.token_budget = tb
+        # per-slot speculation flag (set at admission from the request's
+        # effective spec budget) + the add_request validation seam
+        self._spec_slot = np.zeros(self.S, bool)
+        self._pending_spec: Optional[bool] = None
+        if draft_model is not None:
+            # the draft keeps its own block POOL but shares the target's
+            # tables and allocator: one allocation covers both models'
+            # k/v for a position (the paged-spec composition's design,
+            # now on the unified engine)
+            self.draft_caches = self._build_pool(dc)
 
     @property
     def ragged_steps(self) -> int:
@@ -880,6 +940,99 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
     def mixed_steps(self) -> int:
         """Steps that carried prefill AND decode rows."""
         return int(self._stats.value("mixed_steps"))
+
+    @property
+    def spec_rounds(self) -> int:
+        """Steps that carried at least one slot's draft+verify rows."""
+        return int(self._stats.value("spec_rounds"))
+
+    # legacy spec engines' efficiency-reporting attribute (the shims'
+    # oracle tests and tools/serve_bench.py read it)
+    rounds = spec_rounds
+
+    @property
+    def tokens_drafted(self) -> int:
+        return int(self._stats.value("tokens_drafted"))
+
+    @property
+    def tokens_accepted(self) -> int:
+        return int(self._stats.value("tokens_accepted"))
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.tokens_accepted / max(self.tokens_drafted, 1)
+
+    @property
+    def _sig(self):
+        base = PagedContinuousBatchingEngine._sig.fget(self)
+        if self.draft_model is None:
+            return base
+        d = self.draft_model.config
+        # the draft's architecture signature rides the program-cache key;
+        # _cached_prog additionally pins draft IDENTITY (weakref) — the
+        # config tuple alone is not a complete architecture signature
+        return base + ("rspec", self.K,
+                       (type(self.draft_model).__name__, d.num_layers,
+                        d.hidden_size, d.vocab_size,
+                        getattr(d, "kv_cache_dtype", None)))
+
+    def _cached_prog(self, cache_key, build):
+        """Draft-identity-checked program cache (the legacy spec engines'
+        pattern): compiled closures capture the draft model object, so an
+        engine over the same target but a different draft instance must
+        rebuild, never reuse.  Draft-less engines use the base cache."""
+        if self.draft_model is None:
+            return super()._cached_prog(cache_key, build)
+        import weakref
+        progs = self.model.__dict__.setdefault("_serving_programs", {})
+        entry = progs.get(cache_key)
+        if entry is not None:
+            ref, cached = entry
+            if ref() is self.draft_model:
+                return self._note_prog(cache_key, True, cached)
+        run = build()
+        # bare program in the cache, wrapper only on the local return
+        # (same tracer-lifetime reasoning as the base _cached_prog)
+        progs[cache_key] = (weakref.ref(self.draft_model), run)
+        return self._note_prog(cache_key, False, run)
+
+    def _positions_needed(self, P: int, mnt: int) -> int:
+        spec = (self._pending_spec if self._pending_spec is not None
+                else self.draft_model is not None)
+        if self.draft_model is not None and spec:
+            # budget 1 completes at admission prefill — no round, no
+            # slack; otherwise the LAST round can start at t = P + mnt -
+            # 2 and write its full K+1-wide verify chunk
+            return P if mnt == 1 else P + mnt + self.K - 1
+        return super()._positions_needed(P, mnt)
+
+    def add_request(self, prompt, max_new_tokens: int, on_token=None,
+                    trace_ctx=None, spec: Optional[bool] = None,
+                    **sampling) -> int:
+        """The base contract plus the per-request speculative budget:
+        ``spec=None`` (default) speculates iff the engine has a draft
+        model; ``spec=False`` opts this request out (plain greedy decode
+        rows — it shares every tick with speculating neighbours);
+        ``spec=True`` requires a draft.  The flag only changes HOW FAST
+        the request decodes, never its tokens (greedy contract)."""
+        if spec and self.draft_model is None:
+            raise ValueError(
+                "add_request(spec=True) needs an engine constructed "
+                "with draft_model=/draft_params=")
+        eff = (self.draft_model is not None) if spec is None else bool(spec)
+        self._pending_spec = eff
+        try:
+            rid = super().add_request(prompt, max_new_tokens,
+                                      on_token=on_token,
+                                      trace_ctx=trace_ctx, **sampling)
+        finally:
+            self._pending_spec = None
+        self._queue[-1].spec = eff     # base add_request just appended it
+        return rid
+
+    def _set_planes(self, slot, req):
+        super()._set_planes(slot, req)
+        self._spec_slot[slot] = bool(getattr(req, "spec", False))
 
     # --------------------------------------------------------- scheduling --
 
@@ -932,7 +1085,15 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
         the youngest when dry), then prefill chunks oldest-first into the
         remaining budget (a dry pool shrinks the chunk — the filler
         stalls while decode retirements free blocks).  Returns None when
-        there is nothing to run."""
+        there is nothing to run.
+
+        With a draft model, a speculating slot claims K extra rows right
+        after its next-token row — the verify chunk [prev, d_0..d_{K-1}]
+        at kv positions [t, t+K].  The draft TOKEN VALUES are filled
+        in-program (the host cannot know them); only row metadata is
+        packed here.  Speculation is per-slot OPPORTUNISTIC: a tight
+        budget, a dry pool, or missing cache room degrades the slot to a
+        plain decode row for this step — never stalls it."""
         T = self.token_budget
         if self._active.any():
             self._prepare_decode()        # table growth + preemption loop
@@ -941,16 +1102,32 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
         row_pos = np.full(T, -1, np.int32)
         sample_rows = np.zeros(self.S, np.int32)
         sample_active = np.zeros(self.S, bool)
+        spec_row0 = np.zeros(self.S, np.int32)
+        spec_active = np.zeros(self.S, bool)
+        K = self.K if self.draft_model is not None else 0
         n = 0
         dec_slots = []
-        for slot in np.flatnonzero(self._active):
+        act = [int(s) for s in np.flatnonzero(self._active)]
+        for idx, slot in enumerate(act):
             toks[n] = self._tok[slot]
             row_seq[n] = slot
             row_pos[n] = self._t[slot]
             sample_rows[slot] = n
             sample_active[slot] = True
-            dec_slots.append(int(slot))
+            dec_slots.append(slot)
             n += 1
+            remaining = len(act) - idx - 1    # slots still owed 1 row
+            t = int(self._t[slot])
+            if (K and self._spec_slot[slot]
+                    and t + K + 1 <= self.max_len
+                    and n + K + remaining <= T
+                    and self._ensure_blocks(slot, t + K + 1)):
+                for j in range(K):
+                    row_seq[n] = slot
+                    row_pos[n] = t + 1 + j
+                    n += 1
+                spec_row0[slot] = n - K
+                spec_active[slot] = True
         fill_adv = {}
         for slot in sorted(self._filling,
                            key=lambda s: int(self._admit_seq[s])):
@@ -995,18 +1172,25 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
         if dec_slots and fill_adv:
             self._stats.add("mixed_steps")
         return (toks, row_seq, row_pos, C, sample_rows, sample_active,
-                dec_slots, fill_adv)
+                dec_slots, fill_adv, spec_row0, spec_active)
 
     def _step_impl(self):
         """One scheduler round = ONE device program: admit, pack, run the
         ragged step, unpack sampled tokens (decode slots advance;
-        completed prompts activate with their first token)."""
+        completed prompts activate with their first token).  With a
+        draft model the same round runs the fused draft+verify program
+        instead — still one compiled program per (token_budget,
+        table-width) bucket."""
         self._admit()
         pack = self._build_pack()
         if pack is None:
             return
         (toks, row_seq, row_pos, C, sample_rows, sample_active, dec_slots,
-         fill_adv) = pack
+         fill_adv, spec_row0, spec_active) = pack
+        if self.draft_model is not None:
+            return self._run_spec_pack(toks, row_seq, row_pos, C,
+                                       sample_rows, dec_slots, fill_adv,
+                                       spec_row0, spec_active)
         if self.tracer is not None:
             pf = int(sum(fill_adv.values()))
             note = self._tick_note
@@ -1101,14 +1285,188 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
 
         return run
 
+    # ------------------------------------------- speculative ragged step --
+
+    def _run_spec_pack(self, toks, row_seq, row_pos, C, sample_rows,
+                       dec_slots, fill_adv, spec_row0, spec_active):
+        """Dispatch one fused draft+verify ragged step and unpack: each
+        speculating slot advances by its accepted count + 1 (greedy
+        contract — outputs equal plain decode by construction), plain
+        decode slots and completing prompts advance by their single
+        sampled token through the SAME program."""
+        K = self.K
+        n_spec = int(spec_active.sum())
+        if self.tracer is not None:
+            pf = int(sum(fill_adv.values()))
+            note = self._tick_note
+            note["decode_rows"] = note.get("decode_rows", 0) \
+                + len(dec_slots)
+            note["spec_rows"] = note.get("spec_rows", 0) + n_spec * K
+            note["prefill_tokens"] = note.get("prefill_tokens", 0) + pf
+            note["budget_used"] = note.get("budget_used", 0) \
+                + len(dec_slots) + n_spec * K + pf
+            note["token_budget"] = self.token_budget
+            note["table_cols"] = C
+        run = self._ragged_spec_prog(C)
+        ck, cv, dck, dcv, lead, block = run(
+            (self.params, self.draft_params), self.caches[0],
+            self.caches[1], self.draft_caches[0], self.draft_caches[1],
+            jnp.asarray(toks), jnp.asarray(row_seq), jnp.asarray(row_pos),
+            jnp.asarray(self._table[:, :C]), jnp.asarray(self._pad),
+            jnp.asarray(sample_rows), jnp.asarray(spec_row0),
+            jnp.asarray(spec_active), jnp.asarray(self._tok),
+            jnp.asarray(self._t))
+        self.caches = (ck, cv)
+        self.draft_caches = (dck, dcv)
+        self._stats.add("ragged_steps")
+        if n_spec:
+            self._stats.add("spec_rounds")
+            self._stats.add("tokens_drafted", n_spec * K)
+        lead = np.asarray(lead)
+        block = np.asarray(block)
+        for slot in dec_slots:
+            m = int(lead[slot]) + 1 if spec_active[slot] else 1
+            if spec_active[slot]:
+                self._stats.add("tokens_accepted", int(lead[slot]))
+            for j in range(m):
+                if not self._active[slot]:
+                    break              # retired/cancelled mid-round:
+                self._t[slot] += 1     # discard the round's tail
+                self._tok[slot] = int(block[slot, j])
+                self._record(slot, int(block[slot, j]))
+            if self._active[slot]:
+                if int(self._t[slot]) + 1 > self.max_len:
+                    self._retire(slot)         # room safety net
+                elif spec_active[slot]:
+                    # KV rollback: whole blocks past the accepted clock
+                    # held only REJECTED draft pages — return them to
+                    # the pool now instead of stranding them until
+                    # retirement (self-healing writes make the next
+                    # round's fresh blocks safe by construction)
+                    self._rollback_blocks(slot)
+        for slot, m in fill_adv.items():
+            st = self._filling[slot]
+            st["filled"] += m
+            if st["filled"] == st["P"]:
+                del self._filling[slot]
+                self._register_prompt_blocks(slot, st["ids"], st["pad"],
+                                             st["P"])
+                # a completing prompt's first token rides block[:, 0]
+                # (its lead is 0 through the shared acceptance gather)
+                self._activate(slot, st["req"], st["P"], st["pad"],
+                               int(block[slot, 0]))
+
+    def _rollback_blocks(self, slot: int):
+        """Free the slot's table columns past the accepted clock — the
+        pages that only ever held rejected draft k/v.  Columns holding
+        any accepted position are kept; prompt/prefix blocks sit below
+        the decode clock and are never touched."""
+        keep = -(-int(self._t[slot]) // self.bs)
+        have = int(self._nblk[slot])
+        if have <= keep:
+            return
+        for c in range(have - 1, keep - 1, -1):
+            self._release(int(self._table[slot, c]))
+            self._table[slot, c] = 0
+        self._nblk[slot] = keep
+
+    def _ragged_spec_prog(self, C: int):
+        """ONE fused draft+verify program per (token_budget, table-width
+        bucket) — speculation adds ZERO program families on top of the
+        ragged grid (the draft's prompt ingestion rides the same pack)."""
+        return self._cached_prog(
+            ("ragged_spec", self.token_budget, C, self._sig),
+            lambda: self._build_ragged_spec_step(self.token_budget, C))
+
+    def _build_ragged_spec_step(self, T: int, C: int):
+        """The whole speculative tick as ONE compiled program: (1) the
+        draft proposes K greedy tokens per speculating slot over its
+        paged pool (table gated to speculating rows — everyone else's
+        writes land in trash); (2) the proposals are scattered into the
+        flattened pack at their host-assigned rows; (3) the target runs
+        the WHOLE mixed pack (prefill chunks + plain decode rows +
+        verify chunks) through decode_ragged; (4) the draft ingests the
+        SAME pack — prompt rows keep its pool current (so a draft-less
+        admission never exists, and non-spec steps still feed it), and
+        the verify rows write d_{K-1}'s k/v (the legacy self-heal, for
+        free); (5) greedy verification gathers each slot's K+1 rows and
+        applies the shared models/_decode.greedy_verify contract."""
+        model, draft = self.model, self.draft_model
+        K, S = self.K, self.S
+        Ld = draft.config.num_layers
+
+        @partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def run(params_pair, pool_ck, pool_cv, dpool_ck, dpool_cv, toks,
+                row_seq, row_pos, table, pads, sample_rows, spec_row0,
+                spec_active, dec_tok, dec_t):
+            params, dparams = params_pair
+            # (1) draft proposal scan (S-wide; non-spec rows compute
+            # garbage into the trash block via the gated table)
+            tb = jnp.where(spec_active[:, None], table, 0)
+            tbD = jnp.broadcast_to(tb[None], (Ld,) + tb.shape)
+            dkv = (PagedKV(dpool_ck, tbD), PagedKV(dpool_cv, tbD))
+
+            def dstep(carry, i):
+                tok, dc = carry
+                hh = draft._embed_one(dparams, tok, dec_t + i,
+                                      pad_lens=pads)
+                hh, dc = draft.decode_step(dparams, hh, dc, dec_t + i,
+                                           pad_lens=pads)
+                ntok = jnp.argmax(
+                    draft.decode_logits(dparams, hh)[:, -1],
+                    -1).astype(jnp.int32)
+                return (ntok, dc), ntok
+
+            (_, dkv), d = jax.lax.scan(dstep, (dec_tok, dkv),
+                                       jnp.arange(K))
+            d = d.T                                        # (S, K)
+            dpool_ck, dpool_cv = dkv[0].pool, dkv[1].pool
+            # (2) scatter proposals into the pack; non-spec rows target
+            # index T (out of bounds) and DROP
+            drows = jnp.where(spec_active[:, None],
+                              spec_row0[:, None] + jnp.arange(K)[None],
+                              T)
+            toks = toks.at[drows].set(d, mode="drop")
+            # (3) one target pass over the whole mixed pack
+            h = model._embed_ragged(params, toks, row_seq, row_pos, pads)
+            h, (pool_ck, pool_cv) = model.decode_ragged(
+                params, h, (pool_ck, pool_cv), table, row_seq, row_pos,
+                pads)
+            # (4) the draft ingests the same pack (prompt currency +
+            # d_{K-1} self-heal)
+            hd = draft._embed_ragged(dparams, toks, row_seq, row_pos,
+                                     pads)
+            _, (dpool_ck, dpool_cv) = draft.decode_ragged(
+                dparams, hd, (dpool_ck, dpool_cv), table, row_seq,
+                row_pos, pads)
+            # (5) greedy verification: gather each slot's K+1 rows (non-
+            # spec slots gather their single row K+1 times — their lead
+            # is forced to 0, so block[:, 0] is plain greedy decode)
+            grows = sample_rows[:, None] + jnp.arange(K + 1)[None] \
+                * spec_active[:, None].astype(jnp.int32)
+            h_s = h[0, grows]                              # (S, K+1, H)
+            tpred = jnp.argmax(model.decode_logits(params, h_s),
+                               -1).astype(jnp.int32)       # (S, K+1)
+            lead, block = greedy_verify(d, tpred, active=spec_active)
+            return pool_ck, pool_cv, dpool_ck, dpool_cv, lead, block
+
+        return run
+
     # ------------------------------------------------------------- warmup --
 
     def _warmup_tasks(self):
         """The ragged engine's whole compile grid is ONE program per
         (token_budget, table-width bucket) — pow2_grid(MB) enumerates it
         completely, so a warmed engine never compiles on the serving
-        path (compile count 0 for ANY arrival pattern)."""
+        path (compile count 0 for ANY arrival pattern).  With a draft
+        model the grid is the same SIZE: the fused draft+verify program
+        replaces the plain one bucket for bucket (speculation adds zero
+        program families — the draft prefills through the same pack)."""
         from .jit.aot import WarmupTask
+        if self.draft_model is not None:
+            return [WarmupTask(f"ragged_spec:{self.token_budget}:{C}",
+                               partial(self._warmup_ragged_spec, C))
+                    for C in pow2_grid(self.MB)]
         return [WarmupTask(f"ragged_step:{self.token_budget}:{C}",
                            partial(self._warmup_ragged, C))
                 for C in pow2_grid(self.MB)]
@@ -1133,35 +1491,122 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
         run = self._ragged_prog(C)
         jax.block_until_ready(run(*self._ragged_scratch_args(C)))
 
+    def _ragged_spec_scratch_args(self, C: int):
+        """Scratch operands for one fused draft+verify program (fresh
+        donated pools for BOTH models; rows parked on slot 0 / trash —
+        shapes and dtypes ARE the signature, values are irrelevant)."""
+        ck, cv = self._alloc_caches()
+        dck, dcv = self._build_pool(self.draft_model.config)
+        T, S = self.token_budget, self.S
+        z = jnp.zeros(S, jnp.int32)
+        return ((self.params, self.draft_params), ck, cv, dck, dcv,
+                jnp.zeros(T, jnp.int32), jnp.zeros(T, jnp.int32),
+                jnp.minimum(jnp.arange(T, dtype=jnp.int32),
+                            C * self.bs - 1),
+                jnp.zeros((S, C), jnp.int32), z, z, z,
+                jnp.zeros(S, bool), z, z)
+
+    def _warmup_ragged_spec(self, C: int):
+        run = self._ragged_spec_prog(C)
+        jax.block_until_ready(run(*self._ragged_spec_scratch_args(C)))
+
+    _TICK_COUNTERS = (PagedContinuousBatchingEngine._TICK_COUNTERS
+                      + ("tokens_drafted", "tokens_accepted"))
+
     METRICS_SCHEMA = {
         "ragged_steps": ("counter", float),
         "mixed_steps": ("counter", float),
+        # present only with a draft model (ragged speculation):
+        "spec_rounds": ("counter", int),
+        "tokens_drafted": ("counter", int),
+        "tokens_accepted": ("counter", int),
+        "acceptance_rate": ("gauge", float),
+        "accepted_tokens_per_s": ("gauge", float),
     }
 
     def metrics(self):
         m = super().metrics()
         m["ragged_steps"] = float(self.ragged_steps)
         m["mixed_steps"] = float(self.mixed_steps)
+        if self.draft_model is not None:
+            dt = max(time.monotonic() - self._started, 1e-9)
+            m["spec_rounds"] = self.spec_rounds
+            m["tokens_drafted"] = self.tokens_drafted
+            m["tokens_accepted"] = self.tokens_accepted
+            m["acceptance_rate"] = float(self.acceptance_rate)
+            m["accepted_tokens_per_s"] = self.tokens_accepted / dt
         return m
 
 
-class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
-                                     PagedContinuousBatchingEngine):
-    """Speculative continuous batching OVER the paged KV cache — the two
-    serving accelerations composed.  The draft keeps its own block POOL
-    but shares the target's block TABLES and allocator: target and draft
-    k/v for a position live under the same block id, so admission,
-    lazy growth (to t + K + 1 per round), retirement, and preemption
-    manage one allocation for both caches.  The spec round runs the SAME
-    `_spec_round_core` as the contiguous engine with pools wrapped as
-    PagedKV (verify chunks take the gather fallback; per-position writes
-    scatter through the tables), so acceptance semantics are shared by
-    construction — outputs stay bit-lossless vs plain greedy.
+# ---------------------------------------------------------------------------
+# legacy speculative engines — deprecation shims over the ragged spec path
+# ---------------------------------------------------------------------------
 
-    Scope: greedy only (like the contiguous speculative engine), but
-    BOTH chunked prefill and prefix caching compose here — the paged
-    allocator's deferral/preemption included.
-    """
+_SPEC_SHIM_WARNED: set = set()
+
+
+def _warn_spec_shim(name: str):
+    """Warn ONCE per legacy engine class (the deprecation contract)."""
+    if name in _SPEC_SHIM_WARNED:
+        return
+    _SPEC_SHIM_WARNED.add(name)
+    import warnings
+    warnings.warn(
+        f"{name} is deprecated: speculative decoding now runs INSIDE "
+        f"RaggedPagedContinuousBatchingEngine (draft_model=/draft_k= "
+        f"constructor args) as part of the one-program-per-tick ragged "
+        f"pack; this shim maps the legacy constructor onto the unified "
+        f"engine", DeprecationWarning, stacklevel=3)
+
+
+class SpeculativeBatchingEngine(RaggedPagedContinuousBatchingEngine):
+    """DEPRECATED shim: the pre-ragged speculative engine (its own
+    spec_prefill-per-bucket + spec_round program family) is gone —
+    speculation now runs inside the ragged engine's single fused
+    draft+verify program per (token_budget, table-width) bucket.  This
+    shim maps the legacy contiguous constructor (no storage knobs) onto
+    the unified engine, deriving a block size from max_len and the
+    bucket ladder.  Outputs keep the greedy contract: token for token
+    equal to plain decode, with rounds shrinking by the acceptance rate
+    (``engine.rounds`` still reports them)."""
+
+    _SUPPORTED_CACHE_KW = frozenset({"tracer"})
+
+    def __init__(self, model, params, draft_model, draft_params,
+                 max_slots: int, max_len: int, draft_k: int = 4,
+                 prompt_buckets=None, eos_token_id=None, key=None,
+                 mesh=None, **cache_kw):
+        _warn_spec_shim(type(self).__name__)
+        if mesh is not None:
+            raise NotImplementedError(
+                "speculative engine v1 is single-mesh")
+        # the legacy scope guard: sampler knobs the greedy round would
+        # silently ignore (and storage knobs this shim has no notion of)
+        # are rejected loudly, exactly as before
+        bad = set(cache_kw) - self._SUPPORTED_CACHE_KW
+        if bad:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support {sorted(bad)}")
+        buckets = (_default_buckets(max_len) if prompt_buckets is None
+                   else sorted(set(int(b) for b in prompt_buckets)))
+        # the contiguous engine had no block size; pick the largest one
+        # that divides max_len and every bucket (>= 1 always works)
+        bs = math.gcd(int(max_len), *[int(b) for b in buckets])
+        super().__init__(model, params, max_slots, max_len,
+                         draft_model=draft_model,
+                         draft_params=draft_params, draft_k=draft_k,
+                         prompt_buckets=buckets,
+                         eos_token_id=eos_token_id, key=key,
+                         block_size=bs, **cache_kw)
+
+
+class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine):
+    """DEPRECATED shim: the paged-speculative composition (dual-pool
+    prefill/seg programs + spec_round_paged per table width) is gone —
+    the unified ragged engine already keeps the draft pool behind the
+    target's tables and allocator, so this shim only forwards the
+    storage knobs.  ``prefill_chunk`` is accepted and dropped: the
+    ragged engine chunks prefill inherently via token_budget."""
 
     _SUPPORTED_CACHE_KW = frozenset({"block_size", "num_blocks",
                                      "enable_prefix_cache",
@@ -1171,252 +1616,15 @@ class PagedSpeculativeBatchingEngine(SpeculativeBatchingEngine,
                  max_slots: int, max_len: int, draft_k: int = 4,
                  prompt_buckets=None, eos_token_id=None, key=None,
                  block_size: int = 16, num_blocks=None, **kw):
-        # unknown kw flows to the spec base, whose scope guard admits
-        # only _SUPPORTED_CACHE_KW (this composition: prefix caching and
-        # chunked prefill) plus the storage args below
-        super().__init__(model, params, draft_model, draft_params,
-                         max_slots, max_len, draft_k=draft_k,
-                         prompt_buckets=prompt_buckets,
-                         eos_token_id=eos_token_id, key=key,
-                         block_size=block_size, num_blocks=num_blocks,
-                         **kw)
-    def _alloc_draft_caches(self):
-        # a pool sharing the target's tables — the dense draft cache is
-        # never materialized (the seam exists for exactly this override)
-        return self._build_pool(self.draft_model.config)
-
-    @property
-    def _sig(self):
-        return (SpeculativeBatchingEngine._sig.fget(self)
-                + self._paged_sig_suffix())
-
-    # the paged base's _admit scheduling loop is reused whole — its
-    # PREFIX branch dispatches to _run_cached_prefill and its CHUNKED
-    # branch parks fillers advanced by _run_fill_segment, both overridden
-    # below with dual-pool programs.  The explicit alias is needed
-    # because the MRO would otherwise pick SpeculativeBatchingEngine's
-    # contiguous _admit
-    _admit = PagedContinuousBatchingEngine._admit
-
-    def _run_admission_prefill(self, slot, req, P, pad, ids):
-        run = self._prefill_prog(P)
-        blkrow = jnp.asarray(self._table[slot, :P // self.bs])
-        pools, dpools, tok0, self._presence = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, jnp.asarray([ids], jnp.int32),
-            jnp.int32(pad), blkrow, self._next_key(), self._presence,
-            jnp.int32(slot))
-        self.caches, self.draft_caches = pools, dpools
-        self._register_prompt_blocks(slot, ids, pad, P)
-        self._activate(slot, req, P, pad, int(tok0))
-
-    def _prefill_prog(self, P: int):
-        """Admission prefill scattering BOTH pools' prompt blocks."""
-        model, draft = self.model, self.draft_model
-        bs, nblk = self.bs, P // self.bs
-
-        def build():
-            tail = self._first_token_tail()
-
-            @partial(jax.jit, donate_argnums=(1, 2))
-            def run(params_pair, pools, dpools, ids, pad_len, blkrow, key,
-                    presence, slot):
-                params, dparams = params_pair
-
-                def put(pool, new):                # new: (L, 1, P, …)
-                    r = new.reshape((new.shape[0], nblk, bs)
-                                    + new.shape[3:])
-                    return pool.at[:, blkrow].set(r.astype(pool.dtype))
-
-                h, (ck, cv) = model.prefill(params, ids, P,
-                                            pad_lens=pad_len[None])
-                pools = (jax.tree.map(put, pools[0], ck),
-                         jax.tree.map(put, pools[1], cv))
-                _, (dck, dcv) = draft.prefill(dparams, ids, P,
-                                              pad_lens=pad_len[None])
-                dpools = (jax.tree.map(put, dpools[0], dck),
-                          jax.tree.map(put, dpools[1], dcv))
-                tok, presence = tail(params, h[:, -1:], presence, slot,
-                                     key)
-                return pools, dpools, tok, presence
-
-            return run
-
-        return self._cached_prog(("spec_prefill_paged", P, self._sig),
-                                 build)
-
-    def _run_cached_prefill(self, slot, req, P, pad, ids, F):
-        """Prefix-hit admission for the composition: shared tables mean
-        the cached blocks already hold BOTH models' k/v — only the two
-        SUFFIXES are computed."""
-        run = self._cached_prog(("spec_cpre", P, F, self._sig),
-                                lambda: self._build_spec_cached_prefill(
-                                    P, F))
-        pools, dpools, tok0, self._presence = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, jnp.asarray([ids], jnp.int32),
-            jnp.int32(pad), jnp.asarray(self._table[slot]),
-            self._next_key(), self._presence, jnp.int32(slot))
-        self.caches, self.draft_caches = pools, dpools
-        self._register_prompt_blocks(slot, ids, pad, P)
-        self._activate(slot, req, P, pad, int(tok0))
-
-    def _build_spec_cached_prefill(self, P: int, F: int):
-        model, draft = self.model, self.draft_model
-        bs = self.bs
-        t0 = F * bs
-        tail = self._first_token_tail()
-        suffix_prefill = self._suffix_prefill
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params_pair, pools, dpools, ids, pad, tabrow, key,
-                presence, slot):
-            params, dparams = params_pair
-            h, pools = suffix_prefill(model, params, pools, ids[:, t0:],
-                                      t0, pad, tabrow, bs)
-            _, dpools = suffix_prefill(draft, dparams, dpools,
-                                       ids[:, t0:], t0, pad, tabrow, bs)
-            tok, presence = tail(params, h[:, -1:], presence, slot, key)
-            return pools, dpools, tok, presence
-
-        return run
-
-    def _run_fill_segment(self, slot, st, i, first, last):
-        """One chunked-prefill segment filling BOTH pools (the spec
-        composition of the paged base's seam).  The filler's parked
-        clock keeps concurrent SPEC ROUNDS' K+1-wide stale writes in
-        trash exactly as plain decode ticks.  Returns the device-array
-        first token (dummy unless ``last``)."""
-        seg = self.prefill_chunk
-        toks = jnp.asarray([st["ids"][i * seg:(i + 1) * seg]], jnp.int32)
-        run = self._cached_prog(("spec_seg", seg, last, self._sig),
-                                lambda: self._build_spec_seg(seg, last))
-        pools, dpools, tok0, self._presence = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, toks, jnp.int32(i * seg),
-            jnp.int32(st["pad"]), jnp.int32(slot), self._presence,
-            self._next_key(), jnp.asarray(self._table[slot]))
-        self.caches, self.draft_caches = pools, dpools
-        return tok0                        # device value; caller converts
-
-    def _build_spec_seg(self, seg: int, last: bool):
-        model, draft = self.model, self.draft_model
-        bs = self.bs
-        tail = self._first_token_tail()
-        suffix_prefill = self._suffix_prefill
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params_pair, pools, dpools, toks, t0, pad, slot, presence,
-                key, tabrow):
-            params, dparams = params_pair
-            h, pools = suffix_prefill(model, params, pools, toks, t0, pad,
-                                      tabrow, bs)
-            _, dpools = suffix_prefill(draft, dparams, dpools, toks, t0,
-                                       pad, tabrow, bs)
-            tok = jnp.int32(0)
-            if last:
-                tok, presence = tail(params, h[:, -1:], presence, slot,
-                                     key)
-            return pools, dpools, tok, presence
-
-        return run
-
-    def _run_spec_round(self):
-        # grow every active slot's table to cover this round's write span
-        # [t, t + K + 1) — _prepare_decode's loop with ticks_per_sync
-        # already equal to K + 1 — preempting the youngest when dry
-        if not self._prepare_decode():
-            return None
-        C = self._view_cols()
-        run = self._cached_prog(("spec_round_paged", C, self._sig),
-                                lambda: self._build_spec_round_paged(C))
-        active_before = self._active.copy()
-        self._note("decode_rows", int(active_before.sum()))
-        # inactive rows pre-zeroed: their parked writes land in trash even
-        # where the clamped column lookup would alias a real block
-        gated = np.where(active_before[:, None], self._table[:, :C], 0)
-        pools, dpools, lead, block = run(
-            (self.params, self.draft_params), self.caches,
-            self.draft_caches, jnp.asarray(gated), jnp.asarray(self._tok),
-            jnp.asarray(self._t), jnp.asarray(self._pad))
-        self.caches, self.draft_caches = pools, dpools
-        return active_before, np.asarray(lead), np.asarray(block)
-
-    def _build_spec_round_paged(self, C: int):
-        model, draft, K, S = self.model, self.draft_model, self.K, self.S
-        L = model.config.num_layers
-        Ld = draft.config.num_layers
-        core = SpeculativeBatchingEngine._spec_round_core
-
-        @partial(jax.jit, donate_argnums=(1, 2))
-        def run(params_pair, pools, dpools, table, toks, ts, pads):
-            tbT = jnp.broadcast_to(table[None], (L,) + table.shape)
-            tbD = jnp.broadcast_to(table[None], (Ld,) + table.shape)
-            big = (PagedKV(pools[0], tbT), PagedKV(pools[1], tbT))
-            dbig = (PagedKV(dpools[0], tbD), PagedKV(dpools[1], tbD))
-            big, dbig, lead, block = core(model, draft, K, S, params_pair,
-                                          big, dbig, toks, ts, pads)
-            return ((big[0].pool, big[1].pool),
-                    (dbig[0].pool, dbig[1].pool), lead, block)
-
-        return run
-
-    # ------------------------------------------------------------- warmup --
-
-    def _warmup_tasks(self):
-        """The composition's grid: dual-pool prefill per (unchunked)
-        bucket, both spec-seg variants when chunking, and one spec round
-        per table-width bucket.  Prefix-hit (spec_cpre) families compile
-        on demand, as in the paged base."""
-        from .jit.aot import WarmupTask
-        tasks = []
-        chunk = self.prefill_chunk
-        for P in self.buckets:
-            if chunk is not None and P > chunk:
-                continue
-            tasks.append(WarmupTask(f"spec_prefill_paged:{P}",
-                                    partial(self._warmup_prefill, P)))
-        if chunk is not None and any(P > chunk for P in self.buckets):
-            # chunked buckets always have >= 2 segments, so both the
-            # non-final and final seg variants exist
-            for last in (False, True):
-                tasks.append(WarmupTask(f"spec_seg:{chunk}:{int(last)}",
-                                        partial(self._warmup_spec_seg,
-                                                last)))
-        for C in pow2_grid(self.MB):
-            tasks.append(WarmupTask(
-                f"spec_round_paged:{C}",
-                partial(self._warmup_spec_round_cols, C)))
-        return tasks
-
-    def _warmup_prefill(self, P: int):
-        run = self._prefill_prog(P)
-        pools = self._alloc_caches()
-        dpools = self._alloc_draft_caches()
-        jax.block_until_ready(run(
-            (self.params, self.draft_params), pools, dpools,
-            jnp.zeros((1, P), jnp.int32), jnp.int32(0),
-            jnp.zeros(P // self.bs, jnp.int32), self._warmup_key(),
-            self._scratch_presence(), jnp.int32(0)))
-
-    def _warmup_spec_seg(self, last: bool):
-        seg = self.prefill_chunk
-        run = self._cached_prog(("spec_seg", seg, last, self._sig),
-                                lambda: self._build_spec_seg(seg, last))
-        pools = self._alloc_caches()
-        dpools = self._alloc_draft_caches()
-        jax.block_until_ready(run(
-            (self.params, self.draft_params), pools, dpools,
-            jnp.zeros((1, seg), jnp.int32), jnp.int32(0), jnp.int32(0),
-            jnp.int32(0), self._scratch_presence(), self._warmup_key(),
-            jnp.zeros(self.MB, jnp.int32)))
-
-    def _warmup_spec_round_cols(self, C: int):
-        run = self._cached_prog(("spec_round_paged", C, self._sig),
-                                lambda: self._build_spec_round_paged(C))
-        pools = self._alloc_caches()
-        dpools = self._alloc_draft_caches()
-        z = jnp.zeros(self.S, jnp.int32)
-        jax.block_until_ready(run(
-            (self.params, self.draft_params), pools, dpools,
-            jnp.zeros((self.S, C), jnp.int32), z, z, z))
+        _warn_spec_shim(type(self).__name__)
+        bad = set(kw) - self._SUPPORTED_CACHE_KW
+        if bad:
+            raise NotImplementedError(
+                f"{type(self).__name__} does not support {sorted(bad)}")
+        kw.pop("prefill_chunk", None)   # ragged chunks via token_budget
+        RaggedPagedContinuousBatchingEngine.__init__(
+            self, model, params, max_slots, max_len,
+            draft_model=draft_model, draft_params=draft_params,
+            draft_k=draft_k, prompt_buckets=prompt_buckets,
+            eos_token_id=eos_token_id, key=key, block_size=block_size,
+            num_blocks=num_blocks, **kw)

@@ -143,13 +143,56 @@ class SimEngine:
                  tracer: Optional[Tracer] = None,
                  compile_wall_s: float = 0.0,
                  warmup_unsupported: bool = False,
+                 draft_k: int = 0, acceptance=0.0, spec_seed: int = 0,
                  logger: Optional[logging.Logger] = None):
+        """``draft_k > 0`` enables the SEEDED speculative-acceptance
+        model: each ``step()`` becomes one spec round per active request
+        — ``draft_k`` tokens drafted, a per-request acceptance
+        probability decides the leading accepted run, and the request
+        advances by ``lead + 1`` tokens (so throughput scales with
+        acceptance exactly like the real ragged spec engine, while the
+        token STREAM stays ``sim_tokens`` — replay/reroute equality
+        checks hold unchanged).  ``acceptance`` is either one
+        probability for every request or a ``(lo, hi)`` pair from which
+        each request draws its own (seeded by ``spec_seed`` and the
+        request id).  Everything is deterministic: same seeds, same
+        arrival order → the same lead sequence, tick for tick.  The
+        ``spec_rounds`` / ``tokens_drafted`` / ``tokens_accepted``
+        counters mirror the real engine's registry names."""
         if int(max_slots) < 1:
             raise ValueError("max_slots must be >= 1")
         if int(tokens_per_tick) < 1:
             raise ValueError("tokens_per_tick must be >= 1")
+        if int(draft_k) < 0:
+            raise ValueError("draft_k must be >= 0")
+        if int(draft_k) > 0 and int(tokens_per_tick) != 1:
+            # the spec model paces by acceptance (lead + 1 per round);
+            # a conflicting tokens_per_tick would be silently ignored
+            raise ValueError(
+                "tokens_per_tick and draft_k are mutually exclusive "
+                "pacing knobs — the acceptance model replaces the fixed "
+                "burst")
+        if int(draft_k) == 0 and (acceptance != 0.0 or spec_seed != 0):
+            # the symmetric guard: acceptance knobs without draft_k would
+            # silently measure non-speculative pacing
+            raise ValueError(
+                "acceptance/spec_seed need draft_k > 0 (the speculative "
+                "acceptance model is off without a draft budget)")
         self.S = self.max_slots = int(max_slots)
         self.tokens_per_tick = int(tokens_per_tick)
+        self.draft_k = int(draft_k)
+        self._acceptance = (tuple(float(a) for a in acceptance)
+                           if isinstance(acceptance, (tuple, list))
+                           else float(acceptance))
+        probs = (self._acceptance if isinstance(self._acceptance, tuple)
+                 else (self._acceptance,))
+        if (len(probs) not in (1, 2)
+                or any(not 0.0 <= a <= 1.0 for a in probs)
+                or (len(probs) == 2 and probs[0] > probs[1])):
+            raise ValueError(
+                "acceptance must be a probability in [0, 1] or an "
+                "ordered (lo, hi) pair of them")
+        self._spec_seed = int(spec_seed)
         self.buckets = tuple(sorted(int(b) for b in prompt_buckets))
         self.tracer = tracer
         self.compile_wall_s = float(compile_wall_s)
@@ -277,9 +320,22 @@ class SimEngine:
             self._active[req.rid] = req
         if self._active:
             self._fetch("decode")
+            if self.draft_k:
+                self.stats.add("spec_rounds")
         retired = []
         for rid, req in list(self._active.items()):
-            for _ in range(self.tokens_per_tick):
+            if self.draft_k:
+                # seeded acceptance model: one spec round — draft_k
+                # drafted, the leading accepted run + 1 delivered.  The
+                # STREAM is unchanged (sim_tokens), only pacing scales
+                # with acceptance, mirroring the real ragged spec engine.
+                lead = self._spec_lead(req)
+                self.stats.add("tokens_drafted", self.draft_k)
+                self.stats.add("tokens_accepted", lead)
+                burst = lead + 1
+            else:
+                burst = self.tokens_per_tick
+            for _ in range(burst):
                 tok = req.stream[req.emitted]
                 req.emitted += 1
                 done = req.emitted >= req.max_new
@@ -297,6 +353,31 @@ class SimEngine:
         if self.tracer is not None:
             self.tracer.tick("sim", 0.0, active=len(self._active),
                              queued=len(self._queue))
+
+    def _req_acceptance(self, rid: int) -> float:
+        """The request's own acceptance probability: fixed when
+        ``acceptance`` is a float, drawn once (seeded by rid) from the
+        ``(lo, hi)`` range otherwise."""
+        a = self._acceptance
+        if isinstance(a, tuple):
+            lo, hi = a
+            rng = random.Random((self._spec_seed << 20)
+                                ^ (rid * 2654435761))
+            return lo + (hi - lo) * rng.random()
+        return a
+
+    def _spec_lead(self, req: "_SimRequest") -> int:
+        """Deterministic accepted-run draw for one spec round: count
+        leading Bernoulli(p) successes over draft_k trials, seeded by
+        (spec_seed, rid, tokens emitted so far) — same seeds replay the
+        identical lead sequence."""
+        p = self._req_acceptance(req.rid)
+        rng = random.Random((self._spec_seed * 1000003)
+                            ^ (req.rid * 7919) ^ (req.emitted << 1))
+        lead = 0
+        while lead < self.draft_k and rng.random() < p:
+            lead += 1
+        return lead
 
     def cancel(self, rid: int) -> bool:
         """Release one in-flight request (queued or active) and deliver
@@ -363,6 +444,10 @@ class SimEngine:
         out = dict(self.stats.snapshot())
         out["active"] = float(len(self._active))
         out["queued"] = float(len(self._queue))
+        if self.draft_k:
+            out["acceptance_rate"] = (
+                float(out.get("tokens_accepted", 0))
+                / max(float(out.get("tokens_drafted", 0)), 1.0))
         return out
 
     def prometheus_text(self, namespace: str = "paddle_tpu_sim_engine"

@@ -453,7 +453,13 @@ class Tracer:
         program labels (``programs``) have recorded cost-analysis numbers
         the tick additionally carries its model-FLOPs (``flops`` /
         ``bytes``) — the per-tick roofline attribution ``summary()``
-        folds into MFU."""
+        folds into MFU.  Engines add free-form composition fields; the
+        ragged spec engine notes ``spec_rows`` (draft+verify rows packed
+        this tick) next to ``decode_rows``/``prefill_tokens``, and its
+        per-engine registry carries the acceptance counters
+        (``tokens_drafted``/``tokens_accepted``) whose per-tick deltas
+        ride the tick event — accepted-tokens/s over the same MFU
+        attribution is the spec roofline story."""
         self.registry.add("ticks")
         self.registry.observe("tick_seconds", dur_s)
         progs = fields.get("programs")

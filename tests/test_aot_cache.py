@@ -393,9 +393,11 @@ class TestEngineWarmup:
 
     @pytest.mark.slow
     def test_speculative_engines_warmup(self):
-        """Both speculative compositions declare complete grids: zero
-        in-serve misses after warmup (dual-pool prefill, seg variants,
-        spec round per table width)."""
+        """The legacy speculative engines are now shims over the unified
+        ragged spec path: their grid is ONE fused draft+verify program
+        per table-width bucket (the dual-pool prefill / seg / spec-round
+        families are gone), and a warmed shim still serves with zero
+        in-serve misses."""
         from paddle_tpu.serving import (PagedSpeculativeBatchingEngine,
                                         SpeculativeBatchingEngine)
         model, params = _model()
@@ -405,6 +407,9 @@ class TestEngineWarmup:
         eng = SpeculativeBatchingEngine(
             model, params, draft, dparams, max_slots=2, max_len=32,
             draft_k=2, prompt_buckets=[8])
+        labels = eng.compile_grid()
+        assert labels == [f"ragged_spec:{eng.token_budget}:{C}"
+                          for C in pow2_grid(eng.MB)]
         eng.warmup(max_workers=1)
         m0 = eng._compile_misses
         rid = eng.add_request([1, 2, 3], 4)
@@ -415,15 +420,55 @@ class TestEngineWarmup:
         eng2 = PagedSpeculativeBatchingEngine(
             model, params, draft, dparams, max_slots=2, max_len=32,
             draft_k=2, prompt_buckets=[8, 16], block_size=8,
-            prefill_chunk=8)
+            prefill_chunk=8)       # legacy knob: accepted and dropped
         labels = eng2.compile_grid()
-        assert "spec_seg:8:0" in labels and "spec_round_paged:1" in labels
+        assert all(lbl.startswith("ragged_spec:") for lbl in labels)
+        assert len(labels) == len(pow2_grid(eng2.MB))
         eng2.warmup(max_workers=1)
         m0 = eng2._compile_misses
         eng2.add_request([1, 2, 3], 4)
-        eng2.add_request(list(range(1, 13)), 3)      # chunked bucket 16
+        eng2.add_request(list(range(1, 13)), 3)      # bucket 16 spans steps
         eng2.run_to_completion(max_ticks=200)
         assert eng2._compile_misses == m0
+
+    def test_ragged_spec_grid_zero_compiles_and_purity(self):
+        """The spec-enabled ragged grid: SAME SIZE as the plain ragged
+        grid (speculation adds zero program families), zero in-serve
+        compiles after warmup, and the fused program's lowering is
+        byte-identical between a warmed+traced engine and a bare cold
+        one (warmup instrumentation never reaches a compiled program)."""
+        model, params = _model()
+        paddle.seed(2)
+        draft = GPTModel(GPTConfig(**CFG))
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+
+        def make(tracer=None):
+            return RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=8,
+                prompt_buckets=[8, 16], token_budget=12, tracer=tracer,
+                draft_model=draft, draft_params=dparams, draft_k=2)
+
+        cold = make()
+        want = _serve(cold)
+        tr = Tracer()
+        eng = make(tracer=tr)
+        report = eng.warmup(max_workers=1)
+        grid = eng.compile_grid()
+        assert report["programs"] == len(grid)
+        assert grid == [f"ragged_spec:12:{C}" for C in pow2_grid(eng.MB)]
+        _, plain = _ragged()
+        assert len(grid) == len(plain.compile_grid())
+        misses0 = eng._compile_misses
+        events0 = len(tr.events("compile"))
+        assert _serve(eng) == want
+        assert eng._compile_misses == misses0
+        assert len(tr.events("compile")) == events0
+        C = 2
+        text_inst = eng._build_ragged_spec_step(eng.token_budget, C).lower(
+            *eng._ragged_spec_scratch_args(C)).as_text()
+        text_bare = cold._build_ragged_spec_step(cold.token_budget, C).lower(
+            *cold._ragged_spec_scratch_args(C)).as_text()
+        assert text_inst == text_bare
 
 
 # ------------------------------------------------------------- hapi flops --

@@ -264,6 +264,199 @@ class TestRaggedAllocator:
                 prefill_chunk=8)
 
 
+class TestRaggedSpec:
+    """Speculative decoding INSIDE the ragged engine (ISSUE 13): the
+    draft's K proposals and the target's verification ride the SAME
+    flattened pack as plain decode rows and admission prefill chunks —
+    one fused compiled program per (token_budget, table-width) bucket,
+    outputs equal to plain greedy decode by the models/_decode.py
+    greedy_verify contract."""
+
+    @pytest.fixture(scope="class")
+    def draft_and_params(self):
+        paddle.seed(77)
+        dcfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=1,
+                         num_attention_heads=4,
+                         max_position_embeddings=96,
+                         compute_dtype="float32")
+        draft = GPTModel(dcfg)
+        return draft, {n: p._data for n, p in draft.named_parameters()}
+
+    def test_mixed_spec_nonspec_single_program(self, model_and_params,
+                                               draft_and_params):
+        """THE tentpole pin: spec and non-spec requests share a tick
+        (admission prefill included), and the whole workload dispatches
+        ONLY the fused ragged_spec family — one program per
+        (token_budget, table-width) bucket, asserted via the PR 2
+        compile counters."""
+        model, params = model_and_params
+        draft, dparams = draft_and_params
+        model.__dict__.pop("_serving_programs", None)
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=3, max_len=48, block_size=4,
+            prompt_buckets=[8, 16], draft_model=draft,
+            draft_params=dparams, draft_k=3)
+        r0 = eng.add_request(PROMPTS[0], 9)              # speculates
+        eng.step()                                       # r0 activates
+        r1 = eng.add_request(PROMPTS[5], 6, spec=False)  # plain rows
+        r2 = eng.add_request(PROMPTS[1], 5)              # speculates
+        got = eng.run_to_completion(max_ticks=300)
+        kinds = {k[0] for k in model._serving_programs}
+        assert kinds == {"ragged_spec"}, kinds
+        # one compiled program per (token_budget, C) bucket, nothing else
+        assert eng._compile_misses == len(model._serving_programs)
+        assert eng.mixed_steps >= 1 and eng.spec_rounds >= 1
+        assert eng.tokens_drafted > 0
+        for rid, p, n in [(r0, PROMPTS[0], 9), (r1, PROMPTS[5], 6),
+                          (r2, PROMPTS[1], 5)]:
+            assert got[rid] == _solo_greedy(model, params, p, n), rid
+        assert eng.blocks_in_use == 0
+
+    def test_perfect_draft_rounds_stats_rollback(self, model_and_params):
+        """Self-draft: every proposal accepted — minimal round count,
+        acceptance_rate exactly 1.0 on the registry-backed stats, spec
+        counters in the Prometheus exposition (the gateway /metrics
+        merge concatenates it), and the rejected-page rollback leaves a
+        clean allocator."""
+        model, params = model_and_params
+        K, N = 3, 13
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=1, max_len=48, block_size=4,
+            prompt_buckets=[8], draft_model=model, draft_params=params,
+            draft_k=K)
+        rid = eng.add_request([5, 17, 3], N)
+        got = eng.run_to_completion(max_ticks=100)
+        assert got[rid] == _solo_greedy(model, params, [5, 17, 3], N)
+        assert eng.spec_rounds == -(-(N - 1) // (K + 1))
+        assert eng.rounds == eng.spec_rounds       # legacy-compat alias
+        m = eng.metrics()
+        assert m["acceptance_rate"] == 1.0
+        assert m["tokens_drafted"] == eng.spec_rounds * K
+        assert m["tokens_accepted"] == m["tokens_drafted"]
+        assert eng.blocks_in_use == 0
+        text = eng.prometheus_text()
+        assert "tokens_accepted" in text and "acceptance_rate" in text
+        assert m["blocks_allocated"] == m["blocks_released"]
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_stream_prefix_fuzz_with_cancels(self, model_and_params,
+                                             draft_and_params, seed):
+        """Prefix-of-oracle parity under chaos: random spec/non-spec
+        mixes over tight pools (preemption replays mid-round) with
+        random mid-flight cancels — every finished request equals solo
+        generate, every cancelled stream is a PREFIX of it (after the
+        documented replay reset), and the allocator quiesces clean."""
+        model, params = model_and_params
+        draft, dparams = draft_and_params
+        rng = np.random.RandomState(300 + seed)
+        K = int(rng.choice([1, 2, 4]))
+        bs = int(rng.choice([2, 4]))
+        worst = -(-(16 + 11 + K - 1) // bs)
+        nb = int(rng.randint(worst, worst * 2))
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=int(rng.randint(1, 4)), max_len=48,
+            block_size=bs, num_blocks=nb, prompt_buckets=[8, 16],
+            draft_model=draft, draft_params=dparams, draft_k=K)
+        streams = {}
+
+        def on_token(rid, tok, done):
+            if tok is None and not done:
+                streams[rid] = []            # replay reset: discard
+            elif tok is not None:
+                streams.setdefault(rid, []).append(tok)
+
+        reqs = []
+        for _ in range(int(rng.randint(4, 8))):
+            p = [int(t) for t in rng.randint(1, 97, rng.randint(1, 15))]
+            n = int(rng.randint(1, 12))
+            rid = eng.add_request(p, n, on_token=on_token,
+                                  spec=bool(rng.rand() < 0.7))
+            reqs.append((rid, p, n))
+            for _ in range(int(rng.randint(0, 3))):
+                eng.step()
+            if rng.rand() < 0.3:
+                eng.cancel(reqs[int(rng.randint(0, len(reqs)))][0])
+        got = eng.run_to_completion(max_ticks=800)
+        for rid, p, n in reqs:
+            want = _solo_greedy(model, params, p, n)
+            stream = streams.get(rid, [])
+            if rid in got:
+                assert got[rid] == want, (seed, rid, K, bs, nb)
+                assert stream == want, (seed, rid)
+            else:
+                assert stream == want[:len(stream)], (seed, rid)
+        assert eng.blocks_in_use == 0
+        m = eng.metrics()
+        assert m["blocks_allocated"] == m["blocks_released"]
+
+    def test_moe_target_plain_and_spec_ragged(self):
+        """ErnieMoe's new decode_ragged path on the unified engine: a
+        plain (non-spec) ragged run AND a GPT-drafted spec run over the
+        same MoE target both match the MoE's solo generation — the
+        mixin-contract coverage for the non-GPT family."""
+        from paddle_tpu.models.ernie_moe import (ErnieMoeConfig,
+                                                 ErnieMoeModel)
+        paddle.seed(41)
+        cfg = ErnieMoeConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                             num_attention_heads=4, num_experts=4,
+                             top_k=2, max_position_embeddings=96,
+                             compute_dtype="float32")
+        moe = ErnieMoeModel(cfg)
+        mparams = {n: p._data for n, p in moe.named_parameters()}
+        paddle.seed(79)
+        dcfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=1,
+                         num_attention_heads=4,
+                         max_position_embeddings=96,
+                         compute_dtype="float32")
+        draft = GPTModel(dcfg)
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+        for kw in ({}, dict(draft_model=draft, draft_params=dparams,
+                            draft_k=2)):
+            eng = RaggedPagedContinuousBatchingEngine(
+                moe, mparams, max_slots=2, max_len=48, block_size=4,
+                prompt_buckets=[8], **kw)
+            rids = [eng.add_request(p, n)
+                    for p, n in zip(PROMPTS[:3], (7, 5, 6))]
+            got = eng.run_to_completion(max_ticks=300)
+            for rid, p, n in zip(rids, PROMPTS[:3], (7, 5, 6)):
+                assert got[rid] == _solo_greedy(moe, mparams, p, n), \
+                    (bool(kw), rid)
+            assert eng.blocks_in_use == 0
+
+    def test_spec_true_needs_draft_and_guards(self, model_and_params):
+        model, params = model_and_params
+        eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=32, block_size=4,
+            prompt_buckets=[8])
+        with pytest.raises(ValueError, match="draft_model"):
+            eng.add_request([1, 2, 3], 4, spec=True)
+        paddle.seed(78)
+        dcfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=1,
+                         num_attention_heads=4,
+                         max_position_embeddings=96,
+                         compute_dtype="float32")
+        draft = GPTModel(dcfg)
+        dparams = {n: p._data for n, p in draft.named_parameters()}
+        with pytest.raises(NotImplementedError, match="greedy-only"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=4,
+                prompt_buckets=[8], draft_model=draft,
+                draft_params=dparams, per_request_sampling=True)
+        with pytest.raises(NotImplementedError, match="repetition"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=32, block_size=4,
+                prompt_buckets=[8], draft_model=draft,
+                draft_params=dparams, repetition_penalty=2.0)
+        # over-proposal slack is charged on spec requests only
+        spec_eng = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=1, max_len=20, block_size=4,
+            prompt_buckets=[8], draft_model=draft, draft_params=dparams,
+            draft_k=4)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            spec_eng.add_request([1, 2, 3], 10)    # 8 + 10 + 3 > 20
+        spec_eng.add_request([1, 2, 3], 10, spec=False)   # plain: fits
+
+
 class TestRaggedFuzz:
     @pytest.mark.slow
     @pytest.mark.parametrize("seed", [0, 1, 2])

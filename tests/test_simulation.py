@@ -232,3 +232,78 @@ class TestTrafficSim:
         for h in sim.handles:
             assert h.tokens == sim_tokens(h.prompt, h.max_new_tokens)
         assert gw.metrics().get("rerouted", 0) >= 0
+
+
+class TestSpecAcceptanceModel:
+    """SimEngine's seeded speculative-acceptance model (ISSUE 13): the
+    pacing scales with acceptance exactly like the real ragged spec
+    engine, the token STREAM stays the sim_tokens oracle, and everything
+    replays deterministically from (spec_seed, rid, emitted)."""
+
+    def _drain(self, eng):
+        ticks = 0
+        while eng.pending():
+            eng.step()
+            ticks += 1
+            assert ticks < 1000
+        return ticks
+
+    def test_deterministic_and_stream_exact(self):
+        def run():
+            eng = SimEngine(max_slots=2, draft_k=3, acceptance=0.8,
+                            spec_seed=5)
+            streams = {}
+            rids = [eng.add_request([3, 1, 4], 12,
+                                    on_token=lambda r, t, d:
+                                    streams.setdefault(r, []).append(t)),
+                    eng.add_request([2, 7], 9)]
+            return eng, streams, rids, self._drain(eng)
+
+        e1, s1, rids1, t1 = run()
+        _e2, s2, _rids2, t2 = run()
+        assert t1 == t2 and s1 == s2             # same seeds, same replay
+        assert s1[rids1[0]] == sim_tokens([3, 1, 4], 12)
+        m = e1.metrics()
+        assert m["spec_rounds"] > 0 and m["tokens_drafted"] > 0
+        assert 0.0 < m["acceptance_rate"] <= 1.0
+        # acceptance > 0 shortens the trajectory vs plain 1-token ticks
+        plain = SimEngine(max_slots=2)
+        plain.add_request([3, 1, 4], 12)
+        plain.add_request([2, 7], 9)
+        assert t1 < self._drain(plain)
+
+    def test_per_request_acceptance_range(self):
+        eng = SimEngine(max_slots=1, draft_k=4, acceptance=(0.1, 0.9),
+                        spec_seed=2)
+        ps = {eng._req_acceptance(rid) for rid in range(16)}
+        assert len(ps) > 1                       # genuinely per-request
+        assert all(0.1 <= p <= 0.9 for p in ps)
+        assert eng._req_acceptance(3) == eng._req_acceptance(3)
+
+    def test_mixed_spec_fleet_through_gateway(self):
+        """A spec replica and a plain replica behind a real gateway on
+        the fake clock: zero drops, spec counters tick, and the whole
+        scenario replays identically — the deterministic mixed-spec
+        traffic the autoscaler/chaos suites can now draw on."""
+        def run():
+            clock = SimClock()
+            gw = ServingGateway(clock=clock, tracer=SimTracer(clock))
+            gw.add_replica(SimEngine(max_slots=4,
+                                     tracer=SimTracer(clock),
+                                     draft_k=4, acceptance=(0.3, 0.9),
+                                     spec_seed=1), "spec")
+            gw.add_replica(SimEngine(max_slots=4,
+                                     tracer=SimTracer(clock)), "plain")
+            sim = TrafficSim(gw, clock, steady(2.0), dt=0.25, seed=3)
+            rep = sim.run(30.0)
+            return gw, rep
+
+        gw1, rep1 = run()
+        _gw2, rep2 = run()
+        assert rep1["dropped"] == []
+        m = gw1.replica("spec").engine.metrics()
+        assert m["tokens_drafted"] > 0 and m["acceptance_rate"] > 0.0
+        assert gw1.replica("plain").engine.metrics().get(
+            "tokens_drafted", 0) == 0
+        assert rep1["outcomes"] == rep2["outcomes"]
+        assert rep1["ttft_s"] == rep2["ttft_s"]

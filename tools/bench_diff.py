@@ -84,6 +84,10 @@ _TELEMETRY_FIELDS = {
     "model_flops_per_s": ("flops/s", "higher"),
     "arithmetic_intensity": ("flops/byte", "higher"),
     "tokens_per_sec": ("tokens/s", "higher"),
+    # spec-ragged serving A/B (bench.py gpt_serving speculative arm)
+    "accepted_tokens_per_s": ("tokens/s", "higher"),
+    "acceptance_rate": ("frac", "higher"),
+    "spec_tokens_per_sec": ("tokens/s", "higher"),
 }
 
 #: chaos-attachment fields worth diffing (bench.py gpt_chaos record

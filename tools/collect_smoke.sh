@@ -386,6 +386,48 @@ then
     echo "COLLECT SMOKE FAILED: faults / gateway-resilience round trip"
     exit 1
 fi
+# ragged speculative surface: a tiny draft+target round trip through the
+# unified ragged spec engine — warmed grid (ZERO in-serve compiles), a
+# mixed spec/non-spec tick, the stream equal to the plain-decode oracle
+# (the greedy contract), and acceptance stats live in metrics/Prometheus
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'SPECEOF'
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                num_attention_heads=2, max_position_embeddings=64,
+                compute_dtype="float32")
+paddle.seed(0)
+model = GPTModel(cfg)
+params = {n: p._data for n, p in model.named_parameters()}
+paddle.seed(1)
+draft = GPTModel(cfg)
+dparams = {n: p._data for n, p in draft.named_parameters()}
+eng = RaggedPagedContinuousBatchingEngine(
+    model, params, max_slots=2, max_len=32, block_size=8,
+    prompt_buckets=[8], draft_model=draft, draft_params=dparams,
+    draft_k=2)
+report = eng.warmup(max_workers=1)
+assert report["programs"] == len(eng.compile_grid()) >= 1, report
+before = eng._compile_misses
+rid = eng.add_request([1, 2, 3], 4)
+rid2 = eng.add_request([4, 5], 3, spec=False)   # mixed spec/non-spec tick
+out = eng.run_to_completion(max_ticks=100)
+assert eng._compile_misses == before, "spec grid missed a family"
+oracle = model.generate(params, jnp.asarray([[1, 2, 3]], jnp.int32), 4,
+                        greedy=True)
+assert out[rid] == [int(t) for t in np.asarray(oracle)[0]], out
+assert len(out[rid2]) == 3, out
+m = eng.metrics()
+assert m["tokens_drafted"] > 0 and 0.0 <= m["acceptance_rate"] <= 1.0
+assert "tokens_accepted" in eng.prometheus_text()
+SPECEOF
+then
+    echo "COLLECT SMOKE FAILED: ragged speculative round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via
