@@ -701,6 +701,147 @@ def bench_gpt_serving_warmup(on_tpu):
                 cold["first_token_ms"] / warmed["first_token_ms"], 3)}
 
 
+def bench_gpt_kv_tier(on_tpu):
+    """Tiered-KV A/B for a long shared system prompt (ISSUE 14): (a)
+    COLD recompute — no prefix reuse, the prompt pays its full ragged
+    prefill every time; (b) WARM lower-tier restore — the prompt's KV
+    pages sit in the TieredKVStore's host-DRAM tier (flushed out of HBM
+    between repeats), admission restores them device-side and computes
+    only the bucket's last block; (c) CROSS-REPLICA migration — a
+    prefill-role replica produces the pages, the gateway migrates them
+    under a byte budget into a decode-role replica's store, and the
+    request decodes there token-for-token equal to the solo oracle.
+    The acceptance pin: warm-tier p50 TTFT strictly beats cold
+    recompute (one bounded re-measure absorbs scheduler jitter; the
+    re-measured numbers are the ones recorded).  All engines are AOT
+    warmed, so zero in-serve compiles pollute any arm — asserted."""
+    import numpy as np
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel
+    from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+    from paddle_tpu.gateway import ServingGateway
+    from paddle_tpu.kv_store import TieredKVStore
+
+    kv = os.environ.get("PADDLE_TPU_DECODE_KV") or None
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_attention_heads=12,
+                        max_position_embeddings=1024,
+                        compute_dtype="bfloat16", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 4, 512, 16, 64
+        buckets, plen, n_new, reps = [64, 256], 240, 16, 5
+    else:
+        cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=128,
+                        compute_dtype="float32", kv_cache_dtype=kv)
+        slots, max_len, bs, budget = 2, 96, 8, 16
+        buckets, plen, n_new, reps = [16, 64], 60, 6, 5
+    paddle.seed(0)
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    rng = np.random.RandomState(0)
+    # the shared system prompt: spans many blocks, so the warm arm's
+    # suffix (one block) is a fraction of the cold arm's prefill ticks
+    prompt = [int(t) for t in rng.randint(1, cfg.vocab_size, plen)]
+    oracle = [int(t) for t in np.asarray(model.generate(
+        params, jnp.asarray([prompt], jnp.int32), n_new, greedy=True))[0]]
+
+    def mk(store=None, prefix=None):
+        return RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=slots, max_len=max_len,
+            block_size=bs, prompt_buckets=buckets, token_budget=budget,
+            enable_prefix_cache=(store is not None if prefix is None
+                                 else prefix), kv_store=store)
+
+    def ttft_once(eng):
+        first = []
+        eng.add_request(list(prompt), n_new,
+                        on_token=lambda r, t, d:
+                        first.append(time.perf_counter())
+                        if t is not None and not first else None)
+        t0 = time.perf_counter()
+        while eng.pending():
+            eng.step()
+        out = eng.pop_finished()
+        toks = next(iter(out.values()))
+        assert toks == oracle, "tiered serving diverged from the oracle"
+        return (first[0] - t0) * 1e3
+
+    def measure_cold_warm():
+        cold_eng = mk(prefix=False)       # no reuse: every repeat recomputes
+        cold_eng.warmup(max_workers=1)
+        cold = sorted(ttft_once(cold_eng) for _ in range(reps))
+        store = TieredKVStore()
+        warm_eng = mk(store=store)
+        warm_eng.warmup(max_workers=1)
+        misses0 = warm_eng._compile_misses
+        ttft_once(warm_eng)               # prime: publishes the pages
+        warm = []
+        for _ in range(reps):
+            # HBM emptied every repeat: the hit is a LOWER-TIER restore,
+            # never a resident-HBM shortcut
+            warm_eng.flush_prefix()
+            warm.append(ttft_once(warm_eng))
+        warm.sort()
+        assert warm_eng._compile_misses == misses0, "in-serve compiles"
+        return cold, warm, store, warm_eng
+
+    def p(vals, q):
+        return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
+
+    cold, warm, store, warm_eng = measure_cold_warm()
+    if p(warm, 0.5) >= p(cold, 0.5):
+        # one bounded re-measure absorbs jitter on small-margin hosts;
+        # the re-measured numbers are the ones recorded either way
+        cold, warm, store, warm_eng = measure_cold_warm()
+    assert p(warm, 0.5) < p(cold, 0.5), (warm, cold)
+
+    # ---- cross-replica migration arm: fresh engines per repeat so every
+    # pass really migrates (a shared decode replica would HBM-hit) ----
+    mig_ttfts, migrated_bytes = [], 0
+    for _ in range(3):
+        gw = ServingGateway(migration_bytes_per_tick=None)
+        prefill_eng, decode_eng = mk(prefix=True), mk(store=TieredKVStore())
+        prefill_eng.warmup(max_workers=1)
+        decode_eng.warmup(max_workers=1)
+        m0 = prefill_eng._compile_misses + decode_eng._compile_misses
+        gw.add_replica(prefill_eng, "pf", role="prefill")
+        gw.add_replica(decode_eng, "dc", role="decode")
+        h = gw.submit(list(prompt), n_new)
+        while gw.pending():
+            gw.step()
+        out = gw.pop_finished()
+        assert h.status == "finished" and out[h.gid] == oracle, h
+        assert h.replica == "dc", h.replica
+        snap = gw.kvstore_snapshot()
+        assert snap["counters"]["migrations_completed"] == 1, snap
+        migrated_bytes = int(snap["counters"]["migrated_bytes"])
+        assert prefill_eng._compile_misses + decode_eng._compile_misses \
+            == m0, "in-serve compiles in the migration arm"
+        mig_ttfts.append((h.first_token_at - h.submitted_at) * 1e3)
+    mig_ttfts.sort()
+
+    hit_rate = store.hit_rate()
+    return {"metric": "gpt_kv_tier_restore_ttft_ms",
+            "value": round(p(warm, 0.5), 3), "unit": "ms",
+            "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
+            "loss": 0.0, "backend": "tpu" if on_tpu else "cpu",
+            "prompt_tokens": plen, "blocks": plen // bs,
+            "kv_tier": {
+                "cold_ttft_ms_p50": round(p(cold, 0.5), 3),
+                "warm_ttft_ms_p50": round(p(warm, 0.5), 3),
+                "restore_ttft_p99": round(p(warm, 0.99), 3),
+                "warm_speedup": round(p(cold, 0.5) / p(warm, 0.5), 3),
+                "tier_hit_rate": (None if hit_rate is None
+                                  else round(hit_rate, 4)),
+                "restored_blocks": int(warm_eng.metrics()
+                                       ["kvstore_restored_blocks"]),
+                "migrated_bytes": migrated_bytes,
+                "migration_ttft_ms_p50": round(p(mig_ttfts, 0.5), 3),
+            }}
+
+
 def bench_gpt_gateway(on_tpu):
     """Overload A/B through the serving gateway (ISSUE 9): the SAME
     offered load — more requests than the replica fleet can hold — is
@@ -1099,6 +1240,7 @@ CONFIGS = {
     "gpt_decode": bench_gpt_decode,
     "gpt_serving": bench_gpt_serving,
     "gpt_serving_warmup": bench_gpt_serving_warmup,
+    "gpt_kv_tier": bench_gpt_kv_tier,
     "gpt_gateway": bench_gpt_gateway,
     "gpt_autoscale": bench_gpt_autoscale,
     "gpt_chaos": bench_gpt_chaos,

@@ -183,6 +183,7 @@ class ElasticAutoscaler:
                  idle_utilization: float = 0.15,
                  idle_dwell_s: float = 60.0,
                  idle_resume_ratio: float = 1.5,
+                 decode_pool_high: Optional[float] = None,
                  cache_dir: Optional[str] = None,
                  warm_async: bool = False,
                  reap_quarantined: bool = True,
@@ -209,6 +210,15 @@ class ElasticAutoscaler:
         self.idle_utilization = float(idle_utilization)
         self.idle_dwell_s = float(idle_dwell_s)
         self.idle_resume_ratio = float(idle_resume_ratio)
+        # disaggregation-aware signal (docs/KV_TIERING.md): when set,
+        # gateway.decode_pool_pressure() at or above this threshold is a
+        # scale-up trigger alongside firing SLOs and open breakers — a
+        # drowning decode pool behind idle prefill replicas would
+        # otherwise hide inside fleet-wide occupancy
+        if decode_pool_high is not None and float(decode_pool_high) <= 0:
+            raise ValueError("decode_pool_high must be > 0 (or None)")
+        self.decode_pool_high = (None if decode_pool_high is None
+                                 else float(decode_pool_high))
         self.cache_dir = cache_dir
         self.warm_async = bool(warm_async)
         self.reap_quarantined = bool(reap_quarantined)
@@ -289,6 +299,32 @@ class ElasticAutoscaler:
             self._log.debug("autoscaler: breaker poll failed: %r", e)
             return []
 
+    def decode_pool_pressure(self) -> Optional[float]:
+        """The gateway's decode-pool occupancy ((in-flight + queued +
+        migrating) over ACTIVE non-prefill slots), or None when the
+        gateway predates the disaggregation surface or the poll fails
+        (pull-source discipline — a broken signal never takes the
+        controller down)."""
+        get = getattr(self.gateway, "decode_pool_pressure", None)
+        if get is None:
+            return None
+        try:
+            return float(get())
+        except Exception as e:  # noqa: BLE001 — same guard as the
+            # breaker/ledger polls
+            self._log.debug("autoscaler: decode-pool poll failed: %r", e)
+            return None
+
+    def _decode_pool_hot(self) -> Optional[float]:
+        """The pressure value when it is at/over ``decode_pool_high``
+        (the scale-up trigger), else None (signal disabled or cool)."""
+        if self.decode_pool_high is None:
+            return None
+        p = self.decode_pool_pressure()
+        if p is not None and p >= self.decode_pool_high:
+            return p
+        return None
+
     def utilization(self) -> Dict[str, Any]:
         """The scale-down signal: fleet occupancy — (in-flight + queued)
         requests over total ACTIVE engine slots — plus the raw terms and,
@@ -364,7 +400,8 @@ class ElasticAutoscaler:
             return self._spawn(now, reason="min_bound", firing=firing,
                                utilization=util)
         breakers = self.breakers_open()
-        if firing or breakers:
+        decode_hot = self._decode_pool_hot()
+        if firing or breakers or decode_hot is not None:
             self._idle_since = None          # under-provisioned ≠ idle
             in_up_cooldown = (
                 self._last_up_at is not None
@@ -376,6 +413,8 @@ class ElasticAutoscaler:
                     parts.append("slo:" + ",".join(firing))
                 if breakers:
                     parts.append("breaker:" + ",".join(breakers))
+                if decode_hot is not None:
+                    parts.append(f"decode_pool:{decode_hot:.2f}")
                 return self._spawn(now, reason="+".join(parts),
                                    firing=firing, utilization=util)
             return None
@@ -673,6 +712,8 @@ class ElasticAutoscaler:
             "pending": [s.to_dict() for s in self._pending],
             "signals": {"firing": self.firing(),
                         "breakers_open": self.breakers_open(),
+                        "decode_pool_pressure": self.decode_pool_pressure(),
+                        "decode_pool_high": self.decode_pool_high,
                         "utilization": self.utilization(),
                         "idle_since": self._idle_since,
                         "idle_for_s": (None if self._idle_since is None

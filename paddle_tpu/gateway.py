@@ -68,6 +68,20 @@ With ``resilience=None`` (default) none of these paths run — engine
 lowerings and program-cache keys are identical either way (host-side
 control flow only).
 
+**Disaggregated prefill/decode + tiered KV migration** (docs/
+KV_TIERING.md) — replicas register with a ``role``: ``prefill``
+replicas only run gateway-internal prompt prefills whose KV pages are
+exported (``engine.export_prefix_pages``) and migrated under a
+``migration_bytes_per_tick`` budget into a ``decode`` replica's
+:class:`~paddle_tpu.kv_store.TieredKVStore`; the request then
+dispatches there and admission restores the pages device-side.  The
+prefix-affinity router reads the engines' PUBLIC tier-aware
+``prefix_match`` API (a deep DRAM hit outranks a shallow HBM hit), and
+``gateway.prefix_index()`` aggregates the fleet-wide index.  Every
+pipeline failure — quarantine, stall, meta mismatch, lost destination —
+falls back to plain recompute dispatch: slower, never wrong, zero
+drops.
+
 The gateway is COOPERATIVE and single-threaded, like the engines it
 fronts: ``step()`` runs one round (health → brownout → expiry → drains →
 dispatch → hedging → replica steps → harvest → in-flight deadlines), and
@@ -102,6 +116,7 @@ import collections
 import itertools
 import logging
 import random
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -111,13 +126,20 @@ from .utils.stats import (DEFAULT_TIME_BUCKETS, StatRegistry,
 
 __all__ = ["ServingGateway", "GatewayRequest", "Replica", "Overloaded",
            "DeadlineExceeded", "ResiliencePolicy", "CircuitBreaker",
-           "RetriesExhausted", "Brownout", "BROWNOUT_LEVELS"]
+           "RetriesExhausted", "Brownout", "BROWNOUT_LEVELS", "ROLES"]
 
 #: replica lifecycle states
 ACTIVE = "active"
 DRAINING = "draining"
 QUARANTINED = "quarantined"
 STOPPED = "stopped"
+
+#: replica roles (disaggregated prefill/decode serving — docs/KV_TIERING.md).
+#: ``unified`` replicas serve whole requests (the pre-disaggregation
+#: behaviour); ``prefill`` replicas ONLY run gateway-internal prompt
+#: prefills whose KV pages are then migrated out; ``decode`` replicas
+#: serve requests and receive migrated pages through their kv_store.
+ROLES = ("unified", "prefill", "decode")
 
 #: gateway-request terminal states (plus the live "queued"/"dispatched")
 _TERMINAL = frozenset({"finished", "shed", "expired", "cancelled",
@@ -492,7 +514,8 @@ class GatewayRequest:
                  "submitted_at", "dispatched_at", "first_token_at",
                  "finished_at", "replays", "trace", "_rerouting",
                  "_pending_expiry", "retries", "not_before", "hedged",
-                 "hedge_replica", "hedge_rid", "dispatch_max_new")
+                 "hedge_replica", "hedge_rid", "dispatch_max_new",
+                 "no_disagg")
 
     def __init__(self, gid, prompt, max_new_tokens, priority,
                  ttft_deadline_s, deadline_s, sampling, on_token,
@@ -533,6 +556,10 @@ class GatewayRequest:
         self.hedge_replica: Optional[str] = None
         self.hedge_rid: Optional[int] = None
         self.dispatch_max_new: Optional[int] = None
+        # a disaggregated-pipeline fallback sets this: the request is
+        # served the normal recompute way and never re-enters the
+        # pipeline (one fallback would otherwise loop forever)
+        self.no_disagg = False
 
     @property
     def done(self) -> bool:
@@ -581,9 +608,10 @@ class Replica:
     """One engine replica under gateway management: lifecycle state plus
     the gateway's view of its in-flight work (engine rid → request)."""
 
-    def __init__(self, name: str, engine):
+    def __init__(self, name: str, engine, role: str = "unified"):
         self.name = name
         self.engine = engine
+        self.role = role
         self.state = ACTIVE
         self.inflight: Dict[int, GatewayRequest] = {}
         self.reason: Optional[str] = None          # quarantine reason
@@ -605,10 +633,48 @@ class Replica:
 
     def to_dict(self) -> Dict[str, Any]:
         return {"name": self.name, "state": self.state,
+                "role": self.role,
                 "inflight": len(self.inflight),
                 "outstanding_tokens": self.outstanding_tokens(),
                 "engine": type(self.engine).__name__,
                 "reason": self.reason}
+
+
+class _DisaggJob:
+    """One request's disaggregated prefill→decode pipeline state
+    (docs/KV_TIERING.md): the prompt runs on a ``prefill``-role replica
+    (``max_new_tokens=1`` — the ragged pack's admission prefill IS the
+    work; the sampled token is discarded, the decode replica re-derives
+    it from the migrated pages), its KV pages are exported and migrated
+    under a byte budget into a decode replica's
+    :class:`~paddle_tpu.kv_store.TieredKVStore`, and the request is then
+    dispatched there — admission restores the pages device-side, so the
+    decode replica computes only the bucket's last block.  Every failure
+    along the way (quarantine, stall, meta mismatch, dry destination)
+    FALLS BACK to plain recompute dispatch: slower, never wrong, zero
+    drops."""
+
+    __slots__ = ("req", "src", "prefill_rid", "phase", "phase_at",
+                 "prefill_done", "prefill_failed", "migration", "dest",
+                 "pages")
+
+    def __init__(self, req: GatewayRequest, src: str, now: float):
+        self.req = req
+        self.src = src                     # prefill replica name
+        self.prefill_rid: Optional[int] = None
+        self.phase = "prefill"             # -> migrate -> handoff
+        self.phase_at = now
+        self.prefill_done = False
+        self.prefill_failed = False
+        self.migration = None              # kv_store.PageMigration
+        self.dest: Optional[str] = None    # decode replica name
+        self.pages = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"gid": self.req.gid, "phase": self.phase,
+                "src": self.src, "dest": self.dest,
+                "migration": (None if self.migration is None
+                              else self.migration.to_dict())}
 
 
 class ServingGateway:
@@ -630,6 +696,7 @@ class ServingGateway:
                  tracer=None, clock: Callable[[], float] = time.monotonic,
                  request_history: int = 4096,
                  resilience: Optional[ResiliencePolicy] = None,
+                 migration_bytes_per_tick: Optional[int] = 8 << 20,
                  logger: Optional[logging.Logger] = None):
         if int(priorities) < 1:
             raise ValueError("priorities must be >= 1")
@@ -664,6 +731,28 @@ class ServingGateway:
         self._terminal_order: collections.deque = collections.deque()
         self._finished: Dict[int, List[int]] = {}
         self._gids = itertools.count()
+        # disaggregated prefill/decode pipeline (docs/KV_TIERING.md):
+        # gid -> _DisaggJob while a request's pages are being produced /
+        # migrated; the byte budget paces each migration per step()
+        if migration_bytes_per_tick is not None \
+                and int(migration_bytes_per_tick) < 1:
+            raise ValueError("migration_bytes_per_tick must be >= 1 "
+                             "(or None for unbounded)")
+        self.migration_bytes_per_tick = (
+            None if migration_bytes_per_tick is None
+            else int(migration_bytes_per_tick))
+        self._disagg: Dict[int, _DisaggJob] = {}
+        # _disagg is read by ops-server scrape threads (GET /kvstore /
+        # /gateway) while step() inserts/pops jobs — every mutation and
+        # every iteration-snapshot goes through this lock (the PR 12
+        # SLOMonitor._firing discipline)
+        self._disagg_lock = threading.Lock()
+        # per-tick prefix-match memo (gid, replica) -> match: the
+        # disagg coverage gate and _route's affinity scoring both walk
+        # the chain digests for the same request in the same tick —
+        # ONE walk per (request, replica) per step(), cleared each round
+        self._match_memo: Dict[Tuple[int, str], Dict[str, Any]] = {}
+        self._kvstats = StatRegistry()
         self._stats = StatRegistry()
         self._stats.histogram("queue_seconds", DEFAULT_TIME_BUCKETS)
         self._stats.histogram("ttft_seconds", DEFAULT_TIME_BUCKETS)
@@ -683,14 +772,43 @@ class ServingGateway:
 
     # ------------------------------------------------------------ fleet --
 
-    def add_replica(self, engine, name: Optional[str] = None) -> str:
+    def add_replica(self, engine, name: Optional[str] = None,
+                    role: str = "unified") -> str:
         """Register an engine replica (any of the five serving classes —
         it only needs the shared scheduling surface: ``add_request`` /
-        ``step`` / ``pop_finished`` / ``cancel`` / ``pending``)."""
+        ``step`` / ``pop_finished`` / ``cancel`` / ``pending``).
+
+        ``role`` (docs/KV_TIERING.md): ``"unified"`` (default) serves
+        whole requests; ``"prefill"`` only runs gateway-internal prompt
+        prefills whose KV pages migrate out (it is excluded from request
+        routing); ``"decode"`` serves requests and receives migrated
+        pages — it needs a :class:`~paddle_tpu.kv_store.TieredKVStore`
+        (one is auto-attached when the engine supports
+        ``attach_kv_store`` and has none).  Both disaggregated roles
+        need a prefix-caching engine: pages are addressed by its chain
+        digests."""
         if not hasattr(engine, "cancel"):
             raise TypeError(
                 f"{type(engine).__name__} has no cancel(rid) — the gateway "
                 f"needs the serving-engine cancellation primitive")
+        if role not in ROLES:
+            raise ValueError(f"unknown replica role {role!r}; want one of "
+                             f"{ROLES}")
+        if role != "unified" and not getattr(engine, "prefix_caching",
+                                             False):
+            raise ValueError(
+                f"role {role!r} needs a prefix-caching engine "
+                f"(enable_prefix_cache=True): KV pages are addressed by "
+                f"prefix-cache chain digests")
+        if role == "decode" and getattr(engine, "kv_store", None) is None:
+            attach = getattr(engine, "attach_kv_store", None)
+            if attach is None:
+                raise ValueError(
+                    f"role 'decode' needs an engine with a kv_store "
+                    f"(TieredKVStore) to receive migrated pages; "
+                    f"{type(engine).__name__} supports neither")
+            from .kv_store import TieredKVStore
+            attach(TieredKVStore(tracer=self.tracer))
         if name is None:
             i = len(self._replicas)
             while f"r{i}" in self._replicas:     # auto-names never collide
@@ -699,7 +817,7 @@ class ServingGateway:
         if name in self._replicas and \
                 self._replicas[name].state != STOPPED:
             raise ValueError(f"replica {name!r} already registered")
-        self._replicas[name] = Replica(name, engine)
+        self._replicas[name] = Replica(name, engine, role=role)
         if self.resilience is not None:
             self._breakers[name] = CircuitBreaker(
                 self.resilience.breaker_failures,
@@ -952,6 +1070,16 @@ class ServingGateway:
         req = self._requests.get(gid)
         if req is None or req.done:
             return False
+        job = self._disagg.get(gid)
+        if job is not None:
+            # mid-pipeline (prefill/migrate/handoff): tear the job down
+            # — the prefill attempt is cancelled, host-side pages are
+            # dropped with the plan — and finalize here
+            self._drop_job(job)
+            self._finalize(req, "cancelled", self._clock())
+            self._emit("cancel", gid=gid, where="migration",
+                       **self._trace_fields(req))
+            return True
         if req.status == "queued":
             self._unqueue(req)
             self._finalize(req, "cancelled", self._clock())
@@ -978,6 +1106,7 @@ class ServingGateway:
         replica whose ``step()`` raises is quarantined and replayed —
         the exception never escapes the gateway tick."""
         self._check_health()
+        self._match_memo.clear()       # affinity walks memoized per round
         now = self._clock()
         if self._brownout is not None:
             self._evaluate_brownout(now)
@@ -994,6 +1123,10 @@ class ServingGateway:
                     # raising engine must never poison the whole tick
                     self._on_step_error(rep, e)
         self._harvest()
+        if self._disagg:
+            # after harvest: a prefill that completed THIS tick exports
+            # and starts migrating immediately (overlap with serving)
+            self._advance_disagg(self._clock())
         self._enforce_inflight_deadlines(self._clock())
         self._advance_drains()
 
@@ -1013,7 +1146,7 @@ class ServingGateway:
         self.quarantine(rep.name, reason=f"step raised: {exc!r}")
 
     def pending(self) -> bool:
-        if any(self._queues):
+        if any(self._queues) or self._disagg:
             return True
         return any(rep.inflight or (rep.state in (ACTIVE, DRAINING)
                                     and rep.engine.pending())
@@ -1159,10 +1292,17 @@ class ServingGateway:
         if self.resilience is None:
             for pri, q in enumerate(self._queues):
                 while q:
-                    target = self._route(q[0], now)
+                    req = q[0]
+                    prep = self._disagg_route(req, now)
+                    if prep is not None \
+                            and self._begin_prefill(prep, req, now):
+                        q.popleft()
+                        self._queued_tokens[pri] -= req.est_tokens
+                        continue
+                    target = self._route(req, now)
                     if target is None:
                         return          # fleet-wide: no headroom anywhere
-                    req = q.popleft()
+                    q.popleft()
                     self._queued_tokens[pri] -= req.est_tokens
                     self._dispatch_to(target, req, now)
             return
@@ -1179,6 +1319,11 @@ class ServingGateway:
                 req = q.popleft()
                 if req.not_before is not None and now < req.not_before:
                     deferred.append(req)      # backing off: hold in place
+                    continue
+                prep = self._disagg_route(req, now)
+                if prep is not None \
+                        and self._begin_prefill(prep, req, now):
+                    self._queued_tokens[pri] -= req.est_tokens
                     continue
                 target = self._route(req, now)
                 if target is None:
@@ -1198,45 +1343,59 @@ class ServingGateway:
 
     def _route(self, req: GatewayRequest, now: float,
                exclude: Optional[str] = None) -> Optional[Replica]:
-        """Pick the target replica: among ACTIVE replicas with admission
-        headroom (and, with resilience on, a breaker that allows
-        dispatch), the deepest prefix-cache match wins (prefix affinity);
-        ties — including the common no-match case — go to the least
-        outstanding tokens.  ``exclude`` drops one name (the hedge path
-        never hedges onto the primary's replica)."""
+        """Pick the target replica: among ACTIVE non-``prefill`` replicas
+        with admission headroom (and, with resilience on, a breaker that
+        allows dispatch), the deepest TIER-AWARE prefix match wins: a
+        deep lower-tier hit (restorable from DRAM/disk, no recompute)
+        outranks a shallow HBM hit; equal total depth prefers the warmer
+        (HBM-deeper) replica; ties — including the common no-match case
+        — go to the least outstanding tokens.  ``exclude`` drops one
+        name (the hedge path never hedges onto the primary's
+        replica)."""
         cands = [rep for rep in self._replicas.values()
-                 if rep.state == ACTIVE and rep.slots_available() > 0
+                 if rep.state == ACTIVE and rep.role != "prefill"
+                 and rep.slots_available() > 0
                  and rep.name != exclude
                  and self._breaker_allows(rep.name, now)]
         if not cands:
             return None
-        scored = [(-self._prefix_depth(rep.engine, req.prompt),
-                   rep.outstanding_tokens(), i)
-                  for i, rep in enumerate(cands)]
-        return cands[min(scored)[2]]
+        scored = []
+        for i, rep in enumerate(cands):
+            m = self._match_of(rep, req)
+            scored.append((-m["total"], -m["hbm"],
+                           rep.outstanding_tokens(), i))
+        return cands[min(scored)[3]]
+
+    def _match_of(self, rep: Replica, req: GatewayRequest
+                  ) -> Dict[str, Any]:
+        """Memoized tier-aware affinity read for this round (the memo
+        clears at every ``step()``): the disagg coverage gate and the
+        router score the SAME (request, replica) pairs back to back —
+        one chain-digest walk serves both."""
+        key = (req.gid, rep.name)
+        m = self._match_memo.get(key)
+        if m is None:
+            m = self._prefix_match(rep.engine, req.prompt)
+            self._match_memo[key] = m
+        return m
 
     @staticmethod
-    def _prefix_depth(engine, prompt: List[int]) -> int:
-        """Length (in blocks) of the prompt's chain-digest prefix already
-        resident in the replica's prefix cache — a pure READ of the chain
-        keys (no LRU touch, no pinning: ``_lookup_prefix`` does those at
-        admission)."""
-        if not getattr(engine, "prefix_caching", False):
-            return 0
+    def _prefix_match(engine, prompt: List[int]) -> Dict[str, Any]:
+        """Tier-aware affinity read through the engines' PUBLIC
+        ``prefix_match`` API (serving.py contract — the router no longer
+        reaches into ``engine._prefix_cache``): a pure read, no LRU
+        touch, no pinning.  Engines without the API (or with a broken
+        one) score zero rather than breaking routing."""
+        fn = getattr(engine, "prefix_match", None)
+        if fn is None:
+            return {"hbm": 0, "total": 0, "tiers": []}
         try:
-            from .jit.bucketing import select_bucket
-            P = select_bucket(len(prompt), engine.buckets)
-        except ValueError:
-            return 0
-        pad = P - len(prompt)
-        ids = [0] * pad + prompt
-        depth = 0
-        for chain in engine._chain_keys(ids, pad, max(P // engine.bs - 1,
-                                                      0)):
-            if chain not in engine._prefix_cache:
-                break
-            depth += 1
-        return depth
+            return fn(prompt)
+        except Exception as e:  # noqa: BLE001 — affinity is advisory;
+            # a broken read must not take the dispatch loop down
+            logging.getLogger(__name__).debug(
+                "gateway: prefix_match failed: %r", e)
+            return {"hbm": 0, "total": 0, "tiers": []}
 
     def _dispatch_to(self, rep: Replica, req: GatewayRequest, now: float
                      ) -> Optional[GatewayRequest]:
@@ -1533,6 +1692,403 @@ class ServingGateway:
                     self._slo.observe("ttft_s", ttft)
             self._finalize(req, "finished", self._clock(), signal=False)
             self._finished[req.gid] = req.tokens
+
+    # ------------------------------- disaggregated prefill/decode -------
+    # (docs/KV_TIERING.md: prompt prefills on a `prefill` replica, the
+    # resulting KV pages migrate under a byte budget into a `decode`
+    # replica's TieredKVStore, then the request dispatches there and
+    # admission restores the pages device-side.  Every failure falls
+    # back to plain recompute dispatch — slower, never wrong.)
+
+    def _kvemit(self, what: str, **fields):
+        """A ``kvstore`` tracer event (migration/fallback transitions —
+        docs/OBSERVABILITY.md table)."""
+        if self.tracer is None:
+            return
+        self.tracer.emit("kvstore", what=what, **fields)
+
+    def _disagg_route(self, req: GatewayRequest, now: float
+                      ) -> Optional[Replica]:
+        """The pipeline's admission gate: an ACTIVE ``prefill`` replica
+        with headroom, for a prompt wide enough to export (>= 2 full
+        blocks — the last bucket block is always recomputed, so anything
+        narrower migrates nothing), with at least one page-receiving
+        destination alive.  None -> the normal (recompute) path."""
+        if req.no_disagg or req.gid in self._disagg:
+            return None
+        preps = [rep for rep in self._replicas.values()
+                 if rep.state == ACTIVE and rep.role == "prefill"
+                 and rep.slots_available() > 0
+                 and self._breaker_allows(rep.name, now)]
+        if not preps:
+            return None
+        cands = [rep for rep in preps
+                 if self._exportable(rep.engine, req.prompt)]
+        if not cands:
+            return None
+        if not any(rep.state == ACTIVE and rep.role != "prefill"
+                   and getattr(rep.engine, "kv_store", None) is not None
+                   for rep in self._replicas.values()):
+            return None
+        # LAST (it is the only chain-digest walk here): a routable
+        # replica that ALREADY covers the prompt (full depth in any
+        # tier) makes the pipeline pure overhead — the tier-aware
+        # router sends the request straight to the warm replica, and
+        # _route's scoring walk right after is the one that actually
+        # uses the warmth; re-prefilling and re-migrating resident
+        # pages would only burn budget and a prefill turn
+        for rep in self._replicas.values():
+            if rep.state != ACTIVE or rep.role == "prefill":
+                continue
+            bs = getattr(rep.engine, "bs", None)
+            if isinstance(bs, int) and bs >= 1:
+                m = self._match_of(rep, req)
+                if (m["total"] + 1) * bs >= len(req.prompt):
+                    return None
+        return min(cands, key=lambda rep: rep.outstanding_tokens())
+
+    @staticmethod
+    def _exportable(engine, prompt: List[int]) -> bool:
+        """Cheap width gate: the prompt spans >= 2 of the engine's KV
+        blocks, so at least one full block sits below the
+        always-recomputed last one.  Engines without a block size
+        (contiguous) never qualify."""
+        bs = getattr(engine, "bs", None)
+        if not isinstance(bs, int) or bs < 1:
+            return False
+        return len(prompt) >= 2 * bs
+
+    def _begin_prefill(self, prep: Replica, req: GatewayRequest,
+                       now: float) -> bool:
+        """Dispatch the gateway-internal prefill attempt (max_new 1 —
+        the admission prefill IS the work; the sampled token is
+        discarded, the decode replica re-derives it from the migrated
+        pages, so the consumer stream is single-sourced).  False on any
+        dispatch failure — the caller serves the request normally."""
+        job = _DisaggJob(req, prep.name, now)
+
+        def cb(_rid, tok, done, _job=job):
+            # gateway-internal consumer: only terminal transitions
+            # matter; a preemption replay signal (None, False) just
+            # means the prefill reruns
+            if tok is None and done:
+                _job.prefill_failed = True         # cancelled under us
+            elif done:
+                _job.prefill_done = True
+
+        ctx = req.trace.child() if req.trace is not None else None
+        try:
+            rid = prep.engine.add_request(req.prompt, 1, on_token=cb,
+                                          trace_ctx=ctx, **req.sampling)
+        except Exception as e:  # noqa: BLE001 — ANY prefill admission
+            # failure (transient or structural) degrades to the normal
+            # recompute path; the request is never lost to the pipeline
+            self._log.debug("gateway: disagg prefill dispatch on %s "
+                            "rejected (%r) — recompute path",
+                            prep.name, e)
+            return False
+        self._breaker_note_dispatch(prep.name, now, gid=req.gid)
+        job.prefill_rid = rid
+        req.status = "dispatched"        # in the pipeline, not a queue
+        with self._disagg_lock:
+            self._disagg[req.gid] = job
+        self._kvstats.add("prefill_dispatches")
+        self._kvemit("prefill_start", gid=req.gid, replica=prep.name,
+                     prompt_len=len(req.prompt),
+                     **self._trace_fields(req, ctx))
+        return True
+
+    def _drop_job(self, job: _DisaggJob):
+        """Remove the job and cancel its prefill attempt if still live
+        (best-effort — a wedged prefill replica's host state must not
+        block the fallback)."""
+        with self._disagg_lock:
+            self._disagg.pop(job.req.gid, None)
+        if job.prefill_rid is not None and not job.prefill_done:
+            src = self._replicas.get(job.src)
+            if src is not None:
+                try:
+                    src.engine.cancel(job.prefill_rid)
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    self._log.debug("gateway: disagg prefill cancel on "
+                                    "%s failed: %r", job.src, e)
+        # the internal prefill attempt never reaches _finalize/_harvest,
+        # so a HALF_OPEN probe it claimed must be released HERE or the
+        # prefill replica stays probe-locked (and pipeline-excluded)
+        # forever; completion resolves it via _breaker_success instead
+        cb = self._breaker(job.src)
+        if cb is not None and cb.state == CircuitBreaker.HALF_OPEN \
+                and cb.probe_gid == job.req.gid:
+            cb.release_probe()
+
+    def _disagg_fallback(self, job: _DisaggJob, reason: str):
+        """Degrade to plain recompute: the request rejoins the FRONT of
+        its priority queue (it has waited longest) flagged
+        ``no_disagg``, and the normal router serves it — slower, never
+        wrong, zero drops."""
+        req = job.req
+        self._drop_job(job)
+        self._kvstats.add("migration_fallbacks")
+        self._kvemit("fallback", gid=req.gid, reason=reason,
+                     phase=job.phase, **self._trace_fields(req))
+        self._log.debug("gateway: disagg pipeline for %d fell back (%s, "
+                        "phase %s)", req.gid, reason, job.phase)
+        req.no_disagg = True
+        req.status = "queued"
+        self._queues[req.priority].appendleft(req)
+        self._queued_tokens[req.priority] += req.est_tokens
+
+    def _pick_dest(self, job: _DisaggJob, now: float) -> bool:
+        """Choose the page-receiving destination: ACTIVE non-prefill
+        replicas with a kv_store whose page meta matches the exported
+        pages; ``decode`` role preferred over ``unified``, least
+        outstanding tokens within a role.  False when none qualifies."""
+        meta = job.pages[0].meta if job.pages else None
+        best = None
+        for rep in self._replicas.values():
+            if rep.state != ACTIVE or rep.role == "prefill":
+                continue
+            if getattr(rep.engine, "kv_store", None) is None:
+                continue
+            if meta is not None:
+                try:
+                    emeta = rep.engine.kv_page_meta()
+                except Exception as e:  # noqa: BLE001 — an engine that
+                    # cannot state its page meta cannot receive pages
+                    self._log.debug("gateway: kv_page_meta on %s failed: "
+                                    "%r", rep.name, e)
+                    continue
+                from .kv_store import _freeze_meta
+                if _freeze_meta(emeta) != meta:
+                    continue
+            key = (rep.role != "decode", rep.outstanding_tokens())
+            if best is None or key < best[0]:
+                best = (key, rep)
+        if best is None:
+            return False
+        job.dest = best[1].name
+        return True
+
+    def _advance_disagg(self, now: float):
+        """One tick of every disaggregated pipeline: deadlines/timeouts,
+        prefill completion -> page export -> budgeted migration chunks ->
+        handoff dispatch.  Runs after harvest so a prefill that finished
+        THIS tick exports immediately."""
+        with self._disagg_lock:
+            jobs = list(self._disagg.items())
+        for gid, job in jobs:
+            req = job.req
+            if req.done:                 # cancelled/finalized elsewhere
+                self._drop_job(job)
+                continue
+            waited = now - req.submitted_at
+            kind = None
+            if req.deadline_s is not None and waited > req.deadline_s:
+                kind = "total"
+            elif (req.ttft_deadline_s is not None
+                    and waited > req.ttft_deadline_s):
+                kind = "ttft"
+            if kind is not None:
+                self._drop_job(job)
+                req.error = DeadlineExceeded(
+                    kind, req.deadline_s if kind == "total"
+                    else req.ttft_deadline_s, waited, 0)
+                self._stats.add(f"expired_{kind}")
+                self._emit("expired", gid=gid, deadline=kind,
+                           waited_s=waited, where="migration",
+                           **self._trace_fields(req))
+                self._finalize(req, "expired", now)
+                continue
+            if now - job.phase_at > self.stall_threshold_s:
+                self._disagg_fallback(job, f"{job.phase} timed out")
+                continue
+            if job.phase == "prefill":
+                src = self._replicas.get(job.src)
+                if src is None or src.state not in (ACTIVE, DRAINING) \
+                        or job.prefill_failed:
+                    self._disagg_fallback(job, "prefill replica lost")
+                    continue
+                if not job.prefill_done:
+                    continue
+                # a delivered prefill is a delivered dispatch: resolve
+                # the breaker (closing a HALF_OPEN probe this attempt
+                # claimed — harvest never sees the internal rid)
+                self._breaker_success(job.src)
+                try:
+                    pages = src.engine.export_prefix_pages(req.prompt)
+                except Exception as e:  # noqa: BLE001 — export is
+                    # best-effort: recompute is always available
+                    self._log.debug("gateway: page export on %s failed: "
+                                    "%r", job.src, e)
+                    pages = []
+                if not pages:
+                    self._disagg_fallback(job, "no exportable pages")
+                    continue
+                from .kv_store import PageMigration
+                job.pages = pages
+                job.migration = PageMigration(
+                    pages, self.migration_bytes_per_tick)
+                if not self._pick_dest(job, now):
+                    self._disagg_fallback(
+                        job, "no page-receiving decode replica")
+                    continue
+                job.phase = "migrate"
+                job.phase_at = now
+                self._kvstats.add("migrations_started")
+                self._kvemit("migrate_start", gid=gid, src=job.src,
+                             dest=job.dest, pages=len(pages),
+                             bytes=job.migration.total_bytes,
+                             **self._trace_fields(req))
+                # fall through: the first chunk moves this very tick
+            if job.phase == "migrate":
+                dest = self._replicas.get(job.dest)
+                if dest is None or dest.state != ACTIVE \
+                        or getattr(dest.engine, "kv_store", None) is None:
+                    # destination lost mid-transfer: RESUME into another
+                    # one (pages live host-side in the plan), or degrade
+                    old = job.dest
+                    if not self._pick_dest(job, now):
+                        self._disagg_fallback(job, "destination lost")
+                        continue
+                    job.migration.restart()
+                    self._kvemit("migrate_resume", gid=gid,
+                                 from_dest=old, dest=job.dest,
+                                 **self._trace_fields(req))
+                    dest = self._replicas[job.dest]
+                moved0 = job.migration.transferred_bytes
+                delivered = job.migration.advance()
+                if job.migration.transferred_bytes > moved0:
+                    # BYTE progress is liveness (a page wider than the
+                    # budget spans many ticks with nothing delivered):
+                    # the stall timeout bounds no-progress time, never
+                    # total transfer time
+                    job.phase_at = now
+                ok = True
+                for page in delivered:
+                    try:
+                        dest.engine.kv_store.put(page)
+                    except Exception as e:  # noqa: BLE001 — a broken
+                        # store degrades to recompute, never corrupts
+                        self._log.debug("gateway: page delivery to %s "
+                                        "failed: %r", job.dest, e)
+                        ok = False
+                        break
+                if not ok:
+                    self._disagg_fallback(job, "page delivery failed")
+                    continue
+                if delivered:
+                    self._kvstats.add("migrated_pages", len(delivered))
+                    self._kvstats.add("migrated_bytes",
+                                      sum(p.nbytes for p in delivered))
+                if not job.migration.done:
+                    continue
+                job.phase = "handoff"
+                job.phase_at = now
+                self._kvstats.add("migrations_completed")
+                self._kvemit("migrate_done", gid=gid, dest=job.dest,
+                             bytes=job.migration.total_bytes,
+                             ticks=job.migration.ticks,
+                             **self._trace_fields(req))
+            if job.phase == "handoff":
+                dest = self._replicas.get(job.dest)
+                if dest is None or dest.state != ACTIVE:
+                    self._disagg_fallback(job,
+                                          "destination lost at handoff")
+                    continue
+                if req.not_before is not None and now < req.not_before:
+                    continue             # retry backoff (resilience)
+                if dest.slots_available() <= 0 \
+                        or not self._breaker_allows(dest.name, now):
+                    continue             # wait for headroom
+                held = self._dispatch_to(dest, req, now)
+                if held is None:
+                    # dispatched (admission will restore the migrated
+                    # pages), or terminally failed inside _dispatch_to —
+                    # either way the pipeline is done with it
+                    with self._disagg_lock:
+                        self._disagg.pop(gid, None)
+
+    def decode_pool_pressure(self) -> float:
+        """Occupancy of the DECODE pool: (in-flight + queued + migrating)
+        over ACTIVE non-prefill slots — the autoscaler's
+        disaggregation-aware scale-up signal (prefill replicas can sit
+        idle while the decode pool drowns; fleet-wide occupancy would
+        average that away)."""
+        reps = [r for r in self._replicas.values()
+                if r.state == ACTIVE and r.role != "prefill"]
+        slots = sum(_engine_slots(r.engine) for r in reps)
+        busy = sum(len(r.inflight) for r in reps)
+        queued = sum(len(q) for q in self._queues) + len(self._disagg)
+        return (busy + queued) / max(slots, 1)
+
+    def prefix_index(self, prompt=None) -> Dict[str, Dict[str, Any]]:
+        """The FLEET-WIDE prefix index (ROADMAP item 1): per-replica
+        tier-aware views through the engines' PUBLIC prefix API.
+        Without a prompt: each live replica's resident-page census
+        (``{"pages": {tier: count}}``).  With one: each replica's
+        tier-aware depth map for THAT prompt — exactly what the router
+        scores, exposed for operators, the ops ``/kvstore`` view and
+        tests."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for name, rep in self._replicas.items():
+            if rep.state not in (ACTIVE, DRAINING):
+                continue
+            entry: Dict[str, Any] = {"role": rep.role,
+                                     "state": rep.state}
+            if prompt is not None:
+                entry.update(self._prefix_match(rep.engine,
+                                                [int(t) for t in prompt]))
+            else:
+                tiers: Dict[str, int] = {}
+                idx_fn = getattr(rep.engine, "prefix_index", None)
+                if idx_fn is not None:
+                    try:
+                        for tier in idx_fn().values():
+                            tiers[tier] = tiers.get(tier, 0) + 1
+                    except Exception as e:  # noqa: BLE001 — census is
+                        # advisory, a broken engine view reads as empty
+                        self._log.debug("gateway: prefix_index on %s "
+                                        "failed: %r", name, e)
+                entry["pages"] = tiers
+            out[name] = entry
+        return out
+
+    def _kv_stores(self):
+        """Distinct attached stores (decode replicas may share one)."""
+        stores, seen = [], set()
+        for rep in self._replicas.values():
+            st = getattr(rep.engine, "kv_store", None)
+            if st is not None and id(st) not in seen:
+                seen.add(id(st))
+                stores.append(st)
+        return stores
+
+    def has_kv_surface(self) -> bool:
+        return (bool(self._disagg) or bool(self._kvstats.snapshot())
+                or bool(self._kv_stores())
+                or any(rep.role != "unified"
+                       for rep in self._replicas.values()))
+
+    def kvstore_snapshot(self) -> Dict[str, Any]:
+        """JSON-able live KV-tiering view — what ``GET /kvstore``
+        serves: migration counters + in-flight pipelines, per-replica
+        role/store state, the fleet prefix index."""
+        replicas = {}
+        for name, rep in self._replicas.items():
+            store = getattr(rep.engine, "kv_store", None)
+            replicas[name] = {
+                "role": rep.role, "state": rep.state,
+                "store": None if store is None else store.snapshot()}
+        with self._disagg_lock:
+            jobs = list(self._disagg.values())
+        return {
+            "migration_bytes_per_tick": self.migration_bytes_per_tick,
+            "migrations_inflight": [job.to_dict() for job in jobs],
+            "counters": dict(self._kvstats.snapshot()),
+            "decode_pool_pressure": round(self.decode_pool_pressure(), 4),
+            "replicas": replicas,
+            "prefix_index": self.prefix_index(),
+        }
 
     def _reroute_inflight(self, rep: Replica):
         """Quarantine re-admission: completed work is harvested (never
@@ -1836,6 +2392,13 @@ class ServingGateway:
             # breaker/brownout state rides every snapshot consumer —
             # /gateway, and the FlightRecorder's crash dumps
             out["resilience"] = self.resilience_snapshot()
+        if self.has_kv_surface():
+            # the light view; GET /kvstore serves the full one
+            out["kvstore"] = {
+                "counters": dict(self._kvstats.snapshot()),
+                "migrations_inflight": len(self._disagg),
+                "decode_pool_pressure": round(
+                    self.decode_pool_pressure(), 4)}
         return out
 
     summary = gateway_snapshot
@@ -1870,4 +2433,19 @@ class ServingGateway:
                         1 for cb in breakers
                         if cb.state == CircuitBreaker.HALF_OPEN),
                     "hedges_inflight": self._hedges_live})
+        if self.has_kv_surface():
+            # fleet-aggregated tier gauges (stores deduped — decode
+            # replicas may share one) under the kvstore namespace
+            tier = {"dram_pages": 0.0, "dram_bytes": 0.0,
+                    "disk_pages": 0.0, "disk_bytes": 0.0}
+            for st in self._kv_stores():
+                m = st.metrics()
+                for k in tier:
+                    tier[k] += float(m.get(k, 0.0))
+            text += _prometheus_text(
+                self._kvstats, namespace="paddle_tpu_kvstore",
+                extra_gauges={
+                    "migrations_inflight": len(self._disagg),
+                    "decode_pool_pressure": self.decode_pool_pressure(),
+                    **tier})
         return text

@@ -55,6 +55,13 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     (firing objectives, utilization, idle dwell), and the bounded
     decision history (404 when none is attached).  A pure read — it
     never advances the control loop.
+``GET /kvstore``
+    the KV-tiering view (docs/KV_TIERING.md): attached gateways'
+    ``kvstore_snapshot()`` (migration counters + in-flight pipelines,
+    per-replica role/store state, the fleet-wide tier-aware prefix
+    index) plus any directly attached
+    :class:`~paddle_tpu.kv_store.TieredKVStore` snapshots (404 when
+    nothing KV-tiered is attached).
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -75,6 +82,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 import threading
 import time
 import urllib.parse
@@ -201,12 +209,22 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/kvstore":
+                payload = ops._render_kvstore()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "nothing KV-tiered attached (no "
+                                  "kv-surface gateway, no TieredKVStore)"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
-                      "/resilience", "/slo", "/autoscaler"]}),
+                      "/resilience", "/slo", "/autoscaler", "/kvstore"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -254,6 +272,7 @@ class OpsServer:
         self._gateways: List[Tuple[str, Any]] = []
         self._slos: List[Tuple[str, Any]] = []      # SLOMonitor
         self._autoscalers: List[Tuple[str, Any]] = []
+        self._kvstores: List[Tuple[str, Any]] = []  # TieredKVStore
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -268,6 +287,9 @@ class OpsServer:
           /autoscaler + /metrics fleet/decision gauges;
         - ``ServingGateway`` (has ``gateway_snapshot``) → /gateway +
           /metrics (its ``.tracer``, when set, is attached too);
+        - ``TieredKVStore`` (has ``tier_of``/``put``) → /kvstore +
+          /metrics tier gauges (attached gateways contribute their
+          replicas' stores to /kvstore without this);
         - ``SLOMonitor`` (has ``add_objective``/``evaluate``) → /slo +
           /metrics burn-rate/alert gauges;
         - ``Tracer`` / ``TrainMonitor`` (has ``events`` +
@@ -296,6 +318,10 @@ class OpsServer:
                 tracer = getattr(obj, "tracer", None)
                 if tracer is not None:
                     self._tracers.append((f"{base}.tracer", tracer))
+            elif hasattr(obj, "tier_of") and hasattr(obj, "put"):
+                # TieredKVStore: /kvstore + its gauges on /metrics
+                self._kvstores.append(
+                    (name or f"kvstore{len(self._kvstores)}", obj))
             elif hasattr(obj, "snapshot") and hasattr(obj, "record"):
                 self._ledgers.append(
                     (name or f"ledger{len(self._ledgers)}", obj))
@@ -383,6 +409,7 @@ class OpsServer:
         tracers, engines, ledgers = self._sources()
         with self._lock:
             slos = list(self._slos)
+            kvstores = list(self._kvstores)
         parts = []
         for _name, obj in tracers + engines:
             parts.append(obj.prometheus_text())
@@ -390,6 +417,14 @@ class OpsServer:
             parts.append(led.prometheus_text())
         for _name, slo in slos:
             parts.append(slo.prometheus_text())
+        for kname, store in kvstores:
+            # namespaced per attachment so two attached stores cannot
+            # collide in one exposition; the user-supplied name is
+            # sanitized — one bad character would make the WHOLE
+            # exposition unparseable, not just this store's family
+            safe = re.sub(r"[^a-zA-Z0-9_]", "_", kname)
+            parts.append(store.prometheus_text(
+                namespace=f"paddle_tpu_kvstore_{safe}"))
         from .utils.stats import StatRegistry, prometheus_text as _pt
         parts.append(_pt(
             StatRegistry(), namespace="paddle_tpu_ops",
@@ -508,6 +543,30 @@ class OpsServer:
         if len(slos) == 1:
             return slos[0][1].snapshot()
         return {name: slo.snapshot() for name, slo in slos}
+
+    def _render_kvstore(self) -> Optional[Dict[str, Any]]:
+        """KV-tiering views: every attached gateway with a live KV
+        surface (roles, stores, or migration traffic) plus directly
+        attached stores; None when nothing KV-tiered is attached."""
+        with self._lock:
+            gateways = list(self._gateways)
+            kvstores = list(self._kvstores)
+        views: Dict[str, Any] = {}
+        for name, gw in gateways:
+            snap_fn = getattr(gw, "kvstore_snapshot", None)
+            surface = getattr(gw, "has_kv_surface", None)
+            if snap_fn is None:
+                continue
+            if surface is not None and not surface():
+                continue
+            views[name] = snap_fn()
+        for name, store in kvstores:
+            views[name] = store.snapshot()
+        if not views:
+            return None
+        if len(views) == 1:
+            return next(iter(views.values()))
+        return views
 
     def _render_autoscaler(self) -> Optional[Dict[str, Any]]:
         with self._lock:

@@ -40,7 +40,7 @@ import itertools
 import logging
 import time
 from functools import partial
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -883,6 +883,31 @@ class ContinuousBatchingEngine:
     def pending(self) -> bool:
         return bool(self._queue) or bool(self._active.any()) \
             or bool(self._filling)
+
+    # ------------------------------------------------------ prefix index --
+
+    #: engines without a prefix cache answer the routing plane honestly
+    prefix_caching = False
+
+    def prefix_index(self) -> Dict[str, str]:
+        """PUBLIC prefix-cache view: ``{chain_hex: tier}`` for every
+        resident prefix page (``"hbm"`` here; the paged engines merge
+        their attached :class:`~paddle_tpu.kv_store.TieredKVStore`'s
+        ``"dram"``/``"disk"`` tiers under it).  The gateway's
+        fleet-wide ``prefix_index()`` and the ops ``/kvstore`` view read
+        this instead of reaching into engine internals.  Empty for
+        engines without prefix caching."""
+        return {}
+
+    def prefix_match(self, prompt) -> Dict[str, Any]:
+        """PUBLIC tier-aware prefix-affinity read for one prompt:
+        ``{"hbm": leading blocks resident in HBM, "total": leading
+        blocks resident in ANY tier, "tiers": per-block tier labels}``.
+        A pure read — no LRU touch, no pinning, no restore (admission
+        does those).  The gateway's router scores replicas with this:
+        a deep lower-tier hit (restorable, no recompute) outranks a
+        shallow HBM hit."""
+        return {"hbm": 0, "total": 0, "tiers": []}
 
     def pop_finished(self) -> Dict[int, List[int]]:
         out, self._finished = self._finished, {}

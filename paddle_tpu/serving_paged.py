@@ -56,6 +56,7 @@ import numpy as np
 
 from .serving import ContinuousBatchingEngine, _default_buckets
 from .jit.bucketing import pow2_bucket, pow2_grid, select_bucket
+from .kv_store import KVPage, chain_hex
 from .models._decode import (PagedKV, apply_repetition_penalty,
                              greedy_verify, seed_presence, suppress_eos,
                              suppress_eos_rows)
@@ -64,6 +65,31 @@ __all__ = ["PagedContinuousBatchingEngine",
            "PagedSpeculativeBatchingEngine",
            "RaggedPagedContinuousBatchingEngine",
            "SpeculativeBatchingEngine"]
+
+
+# ---------------------------------------------------------------------------
+# KV-page transport: pool block <-> host page (paddle_tpu/kv_store.py)
+# ---------------------------------------------------------------------------
+# ONE compiled program per pool-leaf signature for ALL block ids (the id
+# is a traced operand, never a static index) — tiering/migration adds a
+# fixed pair of tiny programs per engine config, zero per-block families.
+# Module-level jit: these live OUTSIDE the engines' program caches, so
+# engine compile counters (the zero-in-serve-compile pins) are untouched;
+# the kvio warmup task pre-compiles them for warmed engines.
+
+@partial(jax.jit, donate_argnums=(0,))
+def _kv_block_put(pool, block, bid):
+    """Write one block's content at pool[:, bid] (pool donated — the
+    update is in place, no transient pool copy)."""
+    return jax.lax.dynamic_update_slice_in_dim(
+        pool, block[:, None].astype(pool.dtype), bid, axis=1)
+
+
+@jax.jit
+def _kv_block_get(pool, bid):
+    """Read one block's content pool[:, bid] (device-side; the caller
+    batches the host fetch across leaves)."""
+    return jax.lax.dynamic_slice_in_dim(pool, bid, 1, axis=1)[:, 0]
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -77,12 +103,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def __init__(self, model, params, max_slots: int, max_len: int,
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 enable_prefix_cache: bool = False, **kw):
+                 enable_prefix_cache: bool = False, kv_store=None, **kw):
         if kw.get("mesh") is not None:
             raise NotImplementedError(
                 "paged engine v1 is single-mesh (TP serving uses the "
                 "contiguous engine)")
         self.prefix_caching = bool(enable_prefix_cache)
+        # tiered page store (paddle_tpu/kv_store.py): prefix-cache
+        # eviction DEMOTES pages into it instead of dropping, and
+        # admission lookups that miss HBM RESTORE from it device-side —
+        # host-side only, program signatures identical with or without
+        if kv_store is not None and not self.prefix_caching:
+            raise ValueError(
+                "kv_store needs enable_prefix_cache=True — pages are "
+                "addressed by prefix-cache chain digests")
+        self.kv_store = kv_store
+        self._kv_meta = None           # kv_page_meta() computes it once
         self.bs = int(block_size)
         if self.bs < 1:
             raise ValueError("block_size must be >= 1")
@@ -211,6 +247,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 chain = next(ev)
                 bid = self._prefix_cache.pop(chain)
                 del self._key_of[bid]
+                if self.kv_store is not None:
+                    # eviction DEMOTES instead of dropping: the page
+                    # moves down the tier ladder (HBM -> DRAM -> disk)
+                    self._demote_page(chain, bid)
                 out.append(bid)
         for bid in out:
             self._refs[bid] = 1
@@ -285,16 +325,255 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
     def _lookup_prefix(self, ids, pad, P):
         """Longest cached chain of FULL prompt blocks, capped at
         P/bs - 1 so the last prompt block is always recomputed (its
-        forward pass yields the first-token hidden state for free)."""
+        forward pass yields the first-token hidden state for free).
+
+        With a ``kv_store`` attached, a chain that misses HBM but hits a
+        lower tier (host DRAM / disk) is RESTORED device-side right here
+        — before any fill tick — so the caller sees it as a plain HBM
+        hit and the admitted request's stream is token-identical to the
+        cold-recompute oracle (tests/test_kv_store.py pins it)."""
+        chains = self._chain_keys(ids, pad, P // self.bs - 1)
         F, bids = 0, []
-        for chain in self._chain_keys(ids, pad, P // self.bs - 1):
-            bid = self._prefix_cache.get(chain)
-            if bid is None:
-                break
-            self._prefix_cache.move_to_end(chain)     # LRU touch
-            bids.append(bid)
-            F += 1
+        if self.kv_store is None:
+            for chain in chains:
+                bid = self._prefix_cache.get(chain)
+                if bid is None:
+                    break
+                self._prefix_cache.move_to_end(chain)     # LRU touch
+                bids.append(bid)
+                F += 1
+            return F, bids
+        # store-aware walk: a restore ALLOCATES a block, and allocation
+        # can evict other refs-0 cached blocks — TEMP-PIN every matched
+        # block for the walk's duration so a later restore can never
+        # evict an earlier match out from under the caller.  The pins
+        # are released before returning (the caller re-pins immediately,
+        # single-threaded), and each 0->1/1->0 pair counts allocator
+        # traffic symmetrically — blocks_allocated == blocks_released
+        # still holds at quiescence (the fuzz pins it).
+        held = []
+        try:
+            for chain in chains:
+                bid = self._prefix_cache.get(chain)
+                if bid is not None:
+                    self._prefix_cache.move_to_end(chain)     # LRU touch
+                    self._pin(bid)
+                else:
+                    bid = self._restore_page(chain)
+                    if bid is None:
+                        break
+                held.append(bid)
+                bids.append(bid)
+                F += 1
+        finally:
+            for bid in held:
+                self._release(bid)
         return F, bids
+
+    # ---------------------------------------------------- tiered kv store --
+
+    def attach_kv_store(self, store):
+        """Attach (or with None detach) a
+        :class:`~paddle_tpu.kv_store.TieredKVStore`: prefix-cache
+        eviction demotes pages into it, admission lookups restore from
+        it, and the gateway's migration path delivers cross-replica
+        pages through it (docs/KV_TIERING.md)."""
+        if store is not None and not self.prefix_caching:
+            raise ValueError(
+                "kv_store needs enable_prefix_cache=True — pages are "
+                "addressed by prefix-cache chain digests")
+        self.kv_store = store
+        return store
+
+    def kv_page_meta(self):
+        """Portable page signature (JSON-able): block size, the PROMPT
+        BUCKET ladder, and each pool leaf's dtype and per-block shape —
+        int8 pools list their fp32 scale planes as just another leaf.
+        The bucket ladder matters: chain digests are seeded with the
+        bucket-dependent pad, so engines with different ladders derive
+        DIFFERENT chains for the same prompt — their pages would never
+        restore; carrying the ladder makes the migration dest-picker
+        reject the mismatch up front and fall back cleanly.  Two
+        engines exchange pages iff their metas match.  Computed ONCE
+        (it is a constant of the engine config): restores sit on the
+        TTFT-critical admission path, one tree-flatten per block would
+        tax exactly what the tier speeds up."""
+        if self._kv_meta is None:
+            leaves, _ = jax.tree.flatten(self.caches)
+            self._kv_meta = ["kv1", self.bs, list(self.buckets),
+                             [[str(leaf.dtype),
+                               [int(leaf.shape[0])]
+                               + [int(s) for s in leaf.shape[2:]]]
+                              for leaf in leaves]]
+        return self._kv_meta
+
+    def _gather_page(self, bid: int):
+        """One block's k/v for every pool leaf, device -> host (one
+        batched fetch, not one sync per leaf)."""
+        leaves, _ = jax.tree.flatten(self.caches)
+        vals = [_kv_block_get(leaf, jnp.int32(bid)) for leaf in leaves]
+        return tuple(jax.device_get(vals))
+
+    def _scatter_page(self, bid: int, payload):
+        """Write one page's leaves into pool block ``bid`` (donated
+        in-place updates; ONE fixed program per leaf signature)."""
+        leaves, treedef = jax.tree.flatten(self.caches)
+        new = [_kv_block_put(leaf, jnp.asarray(arr), jnp.int32(bid))
+               for leaf, arr in zip(leaves, payload)]
+        self.caches = jax.tree.unflatten(treedef, new)
+
+    def _demote_page(self, chain, bid: int):
+        """Move one evicted block's content into the attached store (ONE
+        host sync per demotion — the explicit price of keeping the page
+        instead of dropping it).  A failing store degrades to the
+        pre-store behaviour (page dropped, recompute stays correct)."""
+        try:
+            page = KVPage(chain, self._gather_page(bid),
+                          self.kv_page_meta())
+            self.kv_store.put(page)
+        except Exception:  # noqa: BLE001 — a broken store must never
+            # take the allocator down; dropping the page is always safe
+            logging.getLogger(__name__).exception(
+                "kv_store demotion failed; page dropped")
+            return
+        self._stats.add("kvstore_demoted_blocks")
+        if self.tracer is not None:
+            self.tracer.emit("kvstore", what="demote",
+                             chain=chain_hex(chain)[:16],
+                             bytes=page.nbytes,
+                             engine=type(self).__name__)
+
+    def _restore_page(self, chain) -> Optional[int]:
+        """Restore one lower-tier page into a freshly allocated HBM
+        block; returns the block id (held at refs=1 by the allocation —
+        the caller releases) or None on a store miss / dry pool."""
+        page = self.kv_store.lookup(chain, meta=self.kv_page_meta())
+        if page is None or isinstance(page.payload, bytes):
+            return None
+        got = self._alloc_blocks(1)
+        if got is None:
+            return None          # pool dry: the page stays in the store
+        bid = got[0]
+        self._scatter_page(bid, page.payload)
+        self._prefix_cache[chain] = bid
+        self._key_of[bid] = chain
+        self._stats.add("kvstore_restored_blocks")
+        if self.tracer is not None:
+            self.tracer.emit("kvstore", what="restore",
+                             chain=chain_hex(chain)[:16],
+                             bytes=page.nbytes,
+                             engine=type(self).__name__)
+        return bid
+
+    def flush_prefix(self) -> int:
+        """Demote every UNREFERENCED cached prefix block to the attached
+        store and free it from HBM — the operator / bench primitive
+        behind the warm-lower-tier A/B (``gpt_kv_tier``) and the smoke
+        gate's demote→evict→restore round trip.  Pinned blocks (live
+        requests) stay.  Returns the demoted block count."""
+        if self.kv_store is None:
+            raise ValueError("flush_prefix needs an attached kv_store")
+        n = 0
+        for chain, bid in list(self._prefix_cache.items()):
+            if self._refs.get(bid, 0) != 0:
+                continue                   # pinned by a live request
+            self._prefix_cache.pop(chain)
+            del self._key_of[bid]
+            self._demote_page(chain, bid)
+            self._free.append(bid)
+            n += 1
+        return n
+
+    def export_prefix_pages(self, prompt) -> list:
+        """The migration source's primitive: the prompt's resident KV
+        pages (the bucket's first ``P/bs - 1`` blocks, chain order —
+        the cap ``_lookup_prefix`` restores to; the last bucket block is
+        always recomputed by the consumer, so its page would only burn
+        transfer budget and destination DRAM) as portable
+        :class:`~paddle_tpu.kv_store.KVPage` objects.  Walks HBM first,
+        then the attached store; stops at the first miss (pages past a
+        hole are unreachable by the chain walk anyway).  Empty when
+        prefix caching is off or nothing is resident."""
+        if not self.prefix_caching:
+            return []
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            return []
+        try:
+            P = select_bucket(len(prompt), self.buckets)
+        except ValueError:
+            return []
+        pad = P - len(prompt)
+        ids = [0] * pad + prompt
+        meta = self.kv_page_meta()
+        pages = []
+        for chain in self._chain_keys(ids, pad,
+                                      max(P // self.bs - 1, 0)):
+            bid = self._prefix_cache.get(chain)
+            if bid is not None:
+                pages.append(KVPage(chain, self._gather_page(bid), meta))
+                continue
+            if self.kv_store is not None:
+                page = self.kv_store.lookup(chain, meta=meta)
+                if page is not None:
+                    pages.append(page)
+                    continue
+            break
+        return pages
+
+    def prefix_index(self):
+        """PUBLIC tier map ``{chain_hex: tier}`` (serving.py contract):
+        HBM-resident chains first, the attached store's DRAM/disk tiers
+        merged under them."""
+        idx = {chain_hex(c): "hbm" for c in self._prefix_cache}
+        if self.kv_store is not None:
+            for c, tier in self.kv_store.index().items():
+                idx.setdefault(chain_hex(c), tier)
+        return idx
+
+    def prefix_match(self, prompt):
+        """PUBLIC tier-aware affinity read (serving.py contract): pure —
+        no LRU touch, no pin, no restore.  ``hbm`` counts the leading
+        blocks already device-resident; ``total`` counts leading blocks
+        resident in ANY tier (a restore away from warm)."""
+        out = {"hbm": 0, "total": 0, "tiers": []}
+        if not self.prefix_caching:
+            return out
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            return out
+        try:
+            P = select_bucket(len(prompt), self.buckets)
+        except ValueError:
+            return out
+        pad = P - len(prompt)
+        ids = [0] * pad + prompt
+        leading_hbm = True
+        for chain in self._chain_keys(ids, pad,
+                                      max(P // self.bs - 1, 0)):
+            if chain in self._prefix_cache:
+                tier = "hbm"
+            else:
+                tier = (self.kv_store.tier_of(chain)
+                        if self.kv_store is not None else None)
+                if tier is None:
+                    break
+            if tier != "hbm":
+                leading_hbm = False
+            if leading_hbm:
+                out["hbm"] += 1
+            out["total"] += 1
+            out["tiers"].append(tier)
+        return out
+
+    def _warmup_kvio(self):
+        """Compile the page gather/scatter programs (one fixed pair per
+        pool-leaf signature, module-level jit cache — NOT engine program
+        families): a round trip through the TRASH block, which is never
+        read, writing back the very bytes just gathered — live state is
+        value-identical.  Warmed engines restore/migrate pages with zero
+        in-serve compiles."""
+        self._scatter_page(0, self._gather_page(0))
 
     def _register_prompt_blocks(self, slot, ids, pad, P):
         """Publish the slot's (now content-final) prompt blocks into the
@@ -757,6 +1036,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         for C in pow2_grid(self.MB):
             tasks.append(WarmupTask(f"decode:{C}",
                                     partial(self._warmup_decode_cols, C)))
+        if self.prefix_caching:
+            # kvio rides EVERY prefix-caching grid, not just stores:
+            # a store-less prefill-role replica still gathers pages at
+            # export time and must not pay that compile in serve
+            tasks.append(WarmupTask("kvio", self._warmup_kvio))
         return tasks
 
     def _warmup_prefill(self, P: int):
@@ -798,6 +1082,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         "blocks_cached": ("gauge", float),
         "prefix_hits": ("counter", float),
         "prefix_blocks_reused": ("counter", float),
+        # present only with an attached kv_store (tiered page store):
+        "kvstore_restored_blocks": ("counter", float),
+        "kvstore_demoted_blocks": ("counter", float),
     }
 
     def metrics(self):
@@ -811,6 +1098,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             m["blocks_cached"] = float(self._evictable_count())
             m["prefix_hits"] = float(self.prefix_hits)
             m["prefix_blocks_reused"] = float(self.prefix_blocks_reused)
+        if self.kv_store is not None:
+            m["kvstore_restored_blocks"] = float(
+                self._stats.value("kvstore_restored_blocks"))
+            m["kvstore_demoted_blocks"] = float(
+                self._stats.value("kvstore_demoted_blocks"))
         return m
 
 
@@ -1464,12 +1756,18 @@ class RaggedPagedContinuousBatchingEngine(PagedContinuousBatchingEngine):
         program families — the draft prefills through the same pack)."""
         from .jit.aot import WarmupTask
         if self.draft_model is not None:
-            return [WarmupTask(f"ragged_spec:{self.token_budget}:{C}",
-                               partial(self._warmup_ragged_spec, C))
-                    for C in pow2_grid(self.MB)]
-        return [WarmupTask(f"ragged_step:{self.token_budget}:{C}",
-                           partial(self._warmup_ragged, C))
-                for C in pow2_grid(self.MB)]
+            tasks = [WarmupTask(f"ragged_spec:{self.token_budget}:{C}",
+                                partial(self._warmup_ragged_spec, C))
+                     for C in pow2_grid(self.MB)]
+        else:
+            tasks = [WarmupTask(f"ragged_step:{self.token_budget}:{C}",
+                                partial(self._warmup_ragged, C))
+                     for C in pow2_grid(self.MB)]
+        if self.prefix_caching:
+            # same reasoning as the paged grid: export-side gathers on
+            # store-less prefill-role replicas are part of the grid too
+            tasks.append(WarmupTask("kvio", self._warmup_kvio))
+        return tasks
 
     def _ragged_scratch_args(self, C: int):
         """Scratch operand tuple for one table-width bucket's ragged

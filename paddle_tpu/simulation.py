@@ -55,6 +55,7 @@ this is the traffic side of the elastic-serving control plane
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import logging
 import math
@@ -112,7 +113,7 @@ def sim_tokens(prompt: Sequence[int], n: int) -> List[int]:
 
 class _SimRequest:
     __slots__ = ("rid", "prompt", "max_new", "on_token", "emitted",
-                 "stream")
+                 "stream", "prefill_left")
 
     def __init__(self, rid, prompt, max_new, on_token):
         self.rid = rid
@@ -121,6 +122,18 @@ class _SimRequest:
         self.on_token = on_token
         self.emitted = 0
         self.stream = sim_tokens(prompt, max_new)
+        self.prefill_left = 0        # prefill ticks before tokens flow
+
+
+def sim_chain_keys(prompt: Sequence[int], block_size: int) -> List[str]:
+    """The sim engines' chain-digest model: one string key per FULL
+    prompt block, a pure function of the tokens — identical across
+    replicas, so migrated pages address the same chains everywhere
+    (the real engines' (pad, tokens) rolling digest, minus the
+    bucketing)."""
+    toks = [int(t) for t in prompt]
+    return [f"sim:{block_size}:{tuple(toks[:(i + 1) * block_size])!r}"
+            for i in range(len(toks) // block_size)]
 
 
 class SimEngine:
@@ -144,6 +157,10 @@ class SimEngine:
                  compile_wall_s: float = 0.0,
                  warmup_unsupported: bool = False,
                  draft_k: int = 0, acceptance=0.0, spec_seed: int = 0,
+                 prefix_caching: bool = False, block_size: int = 4,
+                 kv_store=None, prefix_capacity_blocks: int = 64,
+                 page_bytes: int = 1024,
+                 prefill_ticks_per_block: int = 0,
                  logger: Optional[logging.Logger] = None):
         """``draft_k > 0`` enables the SEEDED speculative-acceptance
         model: each ``step()`` becomes one spec round per active request
@@ -193,6 +210,31 @@ class SimEngine:
                 "acceptance must be a probability in [0, 1] or an "
                 "ordered (lo, hi) pair of them")
         self._spec_seed = int(spec_seed)
+        # ---- tier / migration model (docs/KV_TIERING.md) ----
+        # the real paged engines' prefix-cache + TieredKVStore surface,
+        # host-only: chains are pure token functions (sim_chain_keys),
+        # the "HBM" tier is a capacity-bounded LRU of chains, eviction
+        # demotes into the attached kv_store, admission restores from
+        # it, and ``prefill_ticks_per_block`` makes warmth VISIBLE on
+        # the fake clock (a warm block skips its prefill ticks — the
+        # TTFT benefit tier-aware routing and the migration A/B pin).
+        self.prefix_caching = bool(prefix_caching)
+        self.bs = int(block_size)
+        if self.bs < 1:
+            raise ValueError("block_size must be >= 1")
+        if kv_store is not None and not self.prefix_caching:
+            raise ValueError("kv_store needs prefix_caching=True — pages "
+                             "are addressed by prefix chain keys")
+        self.kv_store = kv_store
+        self.prefix_capacity_blocks = int(prefix_capacity_blocks)
+        if self.prefix_capacity_blocks < 1:
+            raise ValueError("prefix_capacity_blocks must be >= 1")
+        self.page_bytes = int(page_bytes)
+        self.prefill_ticks_per_block = int(prefill_ticks_per_block)
+        if self.prefill_ticks_per_block < 0:
+            raise ValueError("prefill_ticks_per_block must be >= 0")
+        self._prefix: "collections.OrderedDict[str, None]" = \
+            collections.OrderedDict()
         self.buckets = tuple(sorted(int(b) for b in prompt_buckets))
         self.tracer = tracer
         self.compile_wall_s = float(compile_wall_s)
@@ -317,6 +359,17 @@ class SimEngine:
         while self._queue and len(self._active) < self.S:
             req = self._queue.pop(0)
             self._fetch(self._bucket_label(len(req.prompt)))
+            nblocks = len(req.prompt) // self.bs
+            if self.prefix_caching:
+                # warm blocks (HBM hit or lower-tier restore) skip their
+                # prefill ticks — the tier benefit on the fake clock
+                warm = self._warm_prefix(req.prompt)
+                req.prefill_left = max(nblocks - warm, 0) \
+                    * self.prefill_ticks_per_block
+                if req.prefill_left == 0:
+                    self._register_chains(req.prompt)
+            else:
+                req.prefill_left = nblocks * self.prefill_ticks_per_block
             self._active[req.rid] = req
         if self._active:
             self._fetch("decode")
@@ -324,6 +377,11 @@ class SimEngine:
                 self.stats.add("spec_rounds")
         retired = []
         for rid, req in list(self._active.items()):
+            if req.prefill_left > 0:
+                req.prefill_left -= 1
+                if req.prefill_left == 0 and self.prefix_caching:
+                    self._register_chains(req.prompt)
+                continue
             if self.draft_k:
                 # seeded acceptance model: one spec round — draft_k
                 # drafted, the leading accepted run + 1 delivered.  The
@@ -378,6 +436,137 @@ class SimEngine:
         while lead < self.draft_k and rng.random() < p:
             lead += 1
         return lead
+
+    # ---------------------------------------------- tier / migration --
+    # (the real paged engines' public KV-tiering surface, host-only —
+    # docs/KV_TIERING.md; the gateway's disaggregated pipeline and the
+    # tier-aware router drive sim fleets through these exactly as they
+    # drive real ones)
+
+    def kv_page_meta(self):
+        """Portable page signature (JSON-able lists, the KVPage meta
+        contract): sim engines exchange pages iff block size and page
+        width match."""
+        return ["sim", self.bs, self.page_bytes]
+
+    def attach_kv_store(self, store):
+        if store is not None and not self.prefix_caching:
+            raise ValueError("kv_store needs prefix_caching=True — pages "
+                             "are addressed by prefix chain keys")
+        self.kv_store = store
+        return store
+
+    def _enforce_prefix_capacity(self):
+        from .kv_store import KVPage
+        while len(self._prefix) > self.prefix_capacity_blocks:
+            chain, _ = self._prefix.popitem(last=False)       # LRU first
+            if self.kv_store is None:
+                continue        # no lower tier: the page is DROPPED —
+                #                 counting a "demotion" here would fake
+                #                 tier traffic that never happened (the
+                #                 real engines' store-gated discipline)
+            self.kv_store.put(KVPage(chain, bytes(self.page_bytes),
+                                     self.kv_page_meta()))
+            self.stats.add("kvstore_demoted_blocks")
+            if self.tracer is not None:
+                self.tracer.emit("kvstore", what="demote",
+                                 chain=chain[:48], bytes=self.page_bytes,
+                                 engine="sim")
+
+    def _register_chains(self, prompt):
+        for chain in sim_chain_keys(prompt, self.bs):
+            self._prefix[chain] = None
+            self._prefix.move_to_end(chain)
+        self._enforce_prefix_capacity()
+
+    def _warm_prefix(self, prompt) -> int:
+        """Leading warm blocks at admission: HBM hits LRU-touch, lower-
+        tier hits RESTORE (store → prefix LRU), a miss stops the walk —
+        the sim mirror of the real engines' restore-before-fill."""
+        depth = 0
+        for chain in sim_chain_keys(prompt, self.bs):
+            if chain in self._prefix:
+                self._prefix.move_to_end(chain)
+            elif self.kv_store is not None and self.kv_store.lookup(
+                    chain, meta=self.kv_page_meta()) is not None:
+                self._prefix[chain] = None
+                self.stats.add("kvstore_restored_blocks")
+                if self.tracer is not None:
+                    self.tracer.emit("kvstore", what="restore",
+                                     chain=chain[:48],
+                                     bytes=self.page_bytes, engine="sim")
+            else:
+                break
+            depth += 1
+        self._enforce_prefix_capacity()
+        return depth
+
+    def flush_prefix(self) -> int:
+        """Demote every cached chain to the attached store (the bench /
+        smoke primitive); returns the demoted count."""
+        if self.kv_store is None:
+            raise ValueError("flush_prefix needs an attached kv_store")
+        from .kv_store import KVPage
+        n = 0
+        while self._prefix:
+            chain, _ = self._prefix.popitem(last=False)
+            self.kv_store.put(KVPage(chain, bytes(self.page_bytes),
+                                     self.kv_page_meta()))
+            n += 1
+        self.stats.add("kvstore_demoted_blocks", n)
+        return n
+
+    def export_prefix_pages(self, prompt) -> List[Any]:
+        """Leading resident pages for ``prompt`` (migration source
+        primitive); stops at the first miss."""
+        if not self.prefix_caching:
+            return []
+        from .kv_store import KVPage
+        pages: List[Any] = []
+        for chain in sim_chain_keys(prompt, self.bs):
+            if chain in self._prefix:
+                pages.append(KVPage(chain, bytes(self.page_bytes),
+                                    self.kv_page_meta()))
+                continue
+            if self.kv_store is not None:
+                page = self.kv_store.lookup(chain,
+                                            meta=self.kv_page_meta())
+                if page is not None:
+                    pages.append(page)
+                    continue
+            break
+        return pages
+
+    def prefix_index(self) -> Dict[str, str]:
+        """PUBLIC tier map (serving.py contract)."""
+        idx = {chain: "hbm" for chain in self._prefix}
+        if self.kv_store is not None:
+            for chain, tier in self.kv_store.index().items():
+                idx.setdefault(chain, tier)
+        return idx
+
+    def prefix_match(self, prompt) -> Dict[str, Any]:
+        """PUBLIC tier-aware affinity read (serving.py contract): pure —
+        no LRU touch, no restore."""
+        out: Dict[str, Any] = {"hbm": 0, "total": 0, "tiers": []}
+        if not self.prefix_caching:
+            return out
+        leading_hbm = True
+        for chain in sim_chain_keys(prompt, self.bs):
+            if chain in self._prefix:
+                tier = "hbm"
+            else:
+                tier = (self.kv_store.tier_of(chain)
+                        if self.kv_store is not None else None)
+                if tier is None:
+                    break
+            if tier != "hbm":
+                leading_hbm = False
+            if leading_hbm:
+                out["hbm"] += 1
+            out["total"] += 1
+            out["tiers"].append(tier)
+        return out
 
     def cancel(self, rid: int) -> bool:
         """Release one in-flight request (queued or active) and deliver

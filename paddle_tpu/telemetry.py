@@ -761,6 +761,22 @@ class Tracer:
                      if ev.get("what") == "dispatch"
                      and ev.get("queue_s") is not None]),
             }
+        kv = self.events("kvstore")
+        kv_summary = None
+        if kv:
+            kv_counts: Dict[str, int] = {}
+            for ev in kv:
+                kv_counts[ev.get("what", "?")] = \
+                    kv_counts.get(ev.get("what", "?"), 0) + 1
+            kv_summary = {
+                "events": kv_counts,
+                # bytes that completed a migration (the transfer-volume
+                # headline; per-chunk accounting rides the gateway's
+                # paddle_tpu_kvstore_* counters)
+                "migrated_bytes": sum(
+                    ev.get("bytes", 0) for ev in kv
+                    if ev.get("what") == "migrate_done"),
+            }
         out = {
             "ticks": len(ticks),
             "ticks_total": int(reg.value("ticks")),
@@ -781,6 +797,8 @@ class Tracer:
         }
         if gw_summary is not None:     # only gateway-fed tracers carry it
             out["gateway"] = gw_summary
+        if kv_summary is not None:     # only kv-tiering-fed tracers
+            out["kvstore"] = kv_summary
         return out
 
     def mfu_summary(self) -> Dict[str, Any]:

@@ -1,0 +1,756 @@
+"""Tiered KV store + disaggregated prefill/decode (ISSUE 14).
+
+Three layers under test:
+
+- ``paddle_tpu/kv_store.py`` in isolation: the DRAM-over-disk page
+  store (LRU demotion/promotion, byte capacities, bit-exact
+  serialization, corruption-degrades-to-miss) and the byte-budgeted
+  :class:`PageMigration` schedule;
+- the paged engines' tiering surface: a prefix lookup that misses HBM
+  but hits a lower tier restores pages device-side and produces
+  TOKEN-IDENTICAL streams vs the cold-recompute oracle (the acceptance
+  pin), eviction demotes instead of dropping, allocator balance holds,
+  and the public ``prefix_index``/``prefix_match`` API replaces the
+  gateway's old private-dict reach-in;
+- the gateway's disaggregated pipeline, on real engines (end-to-end
+  migration, zero in-serve compiles on warmed engines) and on the
+  fake-clock simulation (byte-budget pacing, quarantine-mid-migration
+  falling back to recompute with zero drops, tier-aware routing,
+  the autoscaler's decode-pool signal, ``GET /kvstore``).
+"""
+
+import json
+import tempfile
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.kv_store import (KVPage, PageMigration, TieredKVStore,
+                                 chain_hex)
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import (PagedContinuousBatchingEngine,
+                                RaggedPagedContinuousBatchingEngine)
+from paddle_tpu.simulation import (SimClock, SimEngine, SimTracer,
+                                   sim_chain_keys, sim_tokens)
+from paddle_tpu.telemetry import Tracer
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    paddle.seed(11)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_attention_heads=4, max_position_embeddings=96,
+                    compute_dtype="float32")
+    model = GPTModel(cfg)
+    params = {n: p._data for n, p in model.named_parameters()}
+    return model, params
+
+
+def _solo(model, params, prompt, n):
+    out = model.generate(params, jnp.asarray([prompt], jnp.int32), n,
+                         greedy=True)
+    return [int(t) for t in np.asarray(out)[0]]
+
+
+def _page(i, meta=None, nbytes=2048):
+    meta = meta if meta is not None else ["t", 1]
+    arr = np.full(nbytes // 4, i, np.float32)
+    return KVPage(bytes([i]) * 32, (arr,), meta)
+
+
+# ---------------------------------------------------------------------------
+# the store in isolation
+# ---------------------------------------------------------------------------
+
+class TestTieredKVStore:
+    def test_put_lookup_lru_and_meta_mismatch(self):
+        st = TieredKVStore(dram_capacity_bytes=1 << 20)
+        pages = [_page(i) for i in range(3)]
+        for p in pages:
+            st.put(p)
+        got = st.lookup(pages[0].chain, meta=["t", 1])
+        assert got is not None
+        assert np.array_equal(got.payload[0], pages[0].payload[0])
+        assert st.lookup(b"zz" * 16) is None                   # miss
+        assert st.lookup(pages[1].chain, meta=["other"]) is None
+        c = st.counters()
+        assert c["hits_dram"] == 1 and c["misses"] == 1
+        assert c["meta_mismatches"] == 1
+        assert st.tier_of(pages[2].chain) == "dram"
+        assert set(st.index().values()) == {"dram"}
+
+    def test_dram_demotes_to_disk_and_promotes_back(self):
+        st = TieredKVStore(dram_capacity_bytes=2 * 2048 + 100,
+                           disk_dir=tempfile.mkdtemp())
+        pages = [_page(i) for i in range(4)]
+        for p in pages:
+            st.put(p)
+        snap = st.snapshot()
+        assert snap["dram"]["pages"] == 2 and snap["disk"]["pages"] == 2
+        # the two OLDEST pages demoted (LRU)
+        assert st.tier_of(pages[0].chain) == "disk"
+        assert st.tier_of(pages[3].chain) == "dram"
+        # disk hit promotes back to DRAM, bit-exact
+        got = st.lookup(pages[0].chain)
+        assert np.array_equal(got.payload[0], pages[0].payload[0])
+        assert st.tier_of(pages[0].chain) == "dram"
+        assert st.counters()["promotions"] == 1
+        txt = st.prometheus_text()
+        assert "paddle_tpu_kvstore_dram_pages" in txt
+
+    def test_without_disk_eviction_drops(self):
+        st = TieredKVStore(dram_capacity_bytes=2048 + 100)
+        st.put(_page(0))
+        st.put(_page(1))                      # evicts page 0, no disk
+        assert st.tier_of(_page(0).chain) is None
+        assert st.counters()["evictions_dram"] == 1
+
+    def test_disk_capacity_evicts_oldest(self):
+        st = TieredKVStore(dram_capacity_bytes=2048 + 100,
+                           disk_dir=tempfile.mkdtemp(),
+                           disk_capacity_bytes=3 * 2048)
+        for i in range(5):
+            st.put(_page(i))
+        snap = st.snapshot()
+        assert snap["disk"]["bytes"] <= 3 * 2048
+        assert st.counters()["evictions_disk"] >= 1
+
+    def test_corrupt_disk_page_is_a_miss_not_a_wrong_page(self):
+        st = TieredKVStore(dram_capacity_bytes=2048 + 100,
+                           disk_dir=tempfile.mkdtemp())
+        p0 = _page(0)
+        st.put(p0)
+        st.put(_page(1))                      # p0 -> disk
+        path = st._disk[p0.chain][0]
+        with open(path, "wb") as f:
+            f.write(b"garbage")
+        assert st.lookup(p0.chain) is None
+        assert st.counters()["corrupt_pages"] == 1
+        assert st.tier_of(p0.chain) is None   # dropped, not retried
+
+    def test_page_serialization_roundtrip(self):
+        meta = ["kv1", 8, [["int8", [2, 8, 4, 8]], ["float32", [2, 8, 4]]]]
+        page = KVPage(b"\x01" * 32,
+                      (np.arange(64, dtype=np.int8).reshape(2, 8, 4)[..., None]
+                       .repeat(8, -1),
+                       np.linspace(0, 1, 64, dtype=np.float32)
+                       .reshape(2, 8, 4)),
+                      meta)
+        back = KVPage.from_bytes(page.to_bytes())
+        assert back.chain == page.chain and back.meta == page.meta
+        for a, b in zip(page.payload, back.payload):
+            assert a.dtype == b.dtype and np.array_equal(a, b)
+        # bytes payloads (the sim engines' pages) round-trip too
+        simpage = KVPage("sim:4:(1, 2, 3, 4)", b"\x07" * 100, ["sim", 4])
+        back = KVPage.from_bytes(simpage.to_bytes())
+        assert back.chain == simpage.chain
+        assert back.payload == simpage.payload
+        assert chain_hex(simpage.chain) == simpage.chain
+
+    def test_extension_dtype_pages_survive_the_disk_tier(self):
+        """bfloat16 — the TPU pool dtype — must round-trip the disk
+        tier with its REAL dtype: np.savez returns raw void '|V2'
+        arrays for ml_dtypes extension dtypes, which the meta check
+        (dtype STRINGS) cannot catch and which would crash the engine
+        mid-restore instead of missing."""
+        import ml_dtypes
+        arr = (np.arange(32, dtype=np.float32) / 7.0) \
+            .astype(ml_dtypes.bfloat16).reshape(2, 16)
+        meta = ["kv1", 8, [["bfloat16", [2, 16]]]]
+        page = KVPage(b"\x02" * 32, (arr,), meta)
+        back = KVPage.from_bytes(page.to_bytes())
+        assert back.payload[0].dtype == arr.dtype
+        assert np.array_equal(back.payload[0].view(np.uint16),
+                              arr.view(np.uint16))       # bit-exact
+        # and through a real disk tier: still the real dtype on lookup
+        st = TieredKVStore(dram_capacity_bytes=80,
+                           disk_dir=tempfile.mkdtemp())
+        st.put(page)
+        st.put(KVPage(b"\x03" * 32, (arr,), meta))   # page -> disk
+        assert st.tier_of(page.chain) == "disk"
+        got = st.lookup(page.chain, meta=meta)
+        assert got is not None and got.payload[0].dtype == arr.dtype
+
+    def test_long_string_chains_get_distinct_disk_files(self):
+        """Sim chains share long leading text; disk file names are a
+        fixed-length digest of the chain, so near-identical chains must
+        land in distinct files (a truncated-name collision would let
+        the integrity check destroy both pages as 'corrupt')."""
+        st = TieredKVStore(dram_capacity_bytes=1,
+                           disk_dir=tempfile.mkdtemp())
+        long_a = "sim:4:" + repr(tuple(range(100)))
+        long_b = "sim:4:" + repr(tuple(range(101)))
+        pa = KVPage(long_a, b"\x01" * 64, ["sim", 4])
+        pb = KVPage(long_b, b"\x02" * 64, ["sim", 4])
+        st.put(pa)                 # over the 1-byte DRAM cap -> disk
+        st.put(pb)
+        assert st.tier_of(long_a) == "disk"
+        assert st.tier_of(long_b) == "disk"
+        ga = st.lookup(long_a)
+        assert ga is not None and ga.payload == pa.payload
+        gb = st.lookup(long_b)
+        assert gb is not None and gb.payload == pb.payload
+        assert st.counters().get("corrupt_pages", 0) == 0
+
+    def test_oversized_page_promotion_does_not_flush_dram(self):
+        """A disk page wider than the whole DRAM budget is served
+        disk-resident: promoting it would flush the entire warm DRAM
+        tier before spilling it right back out."""
+        st = TieredKVStore(dram_capacity_bytes=3000,
+                           disk_dir=tempfile.mkdtemp())
+        st.put(_page(0))                              # 2048 B, warm
+        big = KVPage(b"\x09" * 32, (np.zeros(2000, np.float32),),
+                     ["t", 1])                        # 8000 B > cap
+        assert st.put(big) == "disk"
+        got = st.lookup(big.chain)
+        assert got is not None
+        assert st.tier_of(big.chain) == "disk"        # stayed put
+        assert st.tier_of(_page(0).chain) == "dram"   # tier untouched
+
+    def test_migration_byte_budget_pacing_and_restart(self):
+        pages = [_page(i) for i in range(4)]          # 4 x 2048 B
+        m = PageMigration(pages, bytes_per_tick=2048)
+        ticks = []
+        while not m.done:
+            ticks.append(len(m.advance()))
+        assert ticks == [1, 1, 1, 1]                  # one page per tick
+        assert m.transferred_bytes == m.total_bytes == 4 * 2048
+        # a page wider than the budget spans ticks, delivery page-granular
+        m2 = PageMigration(pages[:1], bytes_per_tick=1000)
+        assert m2.advance() == [] and m2.advance() == []
+        assert m2.advance() == [pages[0]] and m2.done
+        m2.restart()
+        assert m2.remaining_bytes == 2048 and not m2.done
+        # unbounded: everything in one tick
+        m3 = PageMigration(pages, bytes_per_tick=None)
+        assert len(m3.advance()) == 4 and m3.done
+
+
+# ---------------------------------------------------------------------------
+# engine-side tiering (real paged engines)
+# ---------------------------------------------------------------------------
+
+PROMPT = list(range(1, 25))          # 24 tokens -> bucket 32 (bs 8)
+
+
+def _engine(cls, model, params, store=None, tracer=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", [8, 32])
+    return cls(model, params, enable_prefix_cache=True, kv_store=store,
+               tracer=tracer, **kw)
+
+
+class TestEngineTiering:
+    @pytest.mark.parametrize("cls", [PagedContinuousBatchingEngine,
+                                     RaggedPagedContinuousBatchingEngine])
+    def test_lower_tier_restore_is_token_exact(self, model_and_params,
+                                               cls):
+        """THE pinned contract: prime -> flush out of HBM -> re-serve;
+        the restored stream equals the cold-recompute oracle byte for
+        byte, allocator balanced at quiescence, demote/restore events
+        emitted."""
+        model, params = model_and_params
+        oracle = _solo(model, params, PROMPT, 6)
+        tracer = Tracer()
+        store = TieredKVStore()
+        eng = _engine(cls, model, params, store=store, tracer=tracer)
+        r1 = eng.add_request(PROMPT, 6)
+        assert eng.run_to_completion(max_ticks=300)[r1] == oracle
+        demoted = eng.flush_prefix()
+        assert demoted > 0 and len(eng._prefix_cache) == 0
+        assert store.snapshot()["dram"]["pages"] == demoted
+        r2 = eng.add_request(PROMPT, 6)
+        out = eng.run_to_completion(max_ticks=300)
+        assert out[r2] == oracle, "lower-tier restore diverged"
+        m = eng.metrics()
+        assert m["kvstore_restored_blocks"] >= 1
+        assert m["kvstore_demoted_blocks"] >= demoted
+        # quiescence balance: every pin/alloc matched by a release
+        # (cached refs-0 blocks count released at their 1->0)
+        assert m["blocks_allocated"] == m["blocks_released"]
+        kinds = {e["what"] for e in tracer.events("kvstore")}
+        assert {"demote", "restore"} <= kinds
+        # prefix API is PUBLIC (the gateway router's new read)
+        pm = eng.prefix_match(PROMPT)
+        assert pm["total"] >= 1 and set(pm["tiers"]) <= {"hbm", "dram",
+                                                         "disk"}
+        assert set(eng.prefix_index().values()) <= {"hbm", "dram", "disk"}
+
+    def test_int8_pages_ride_the_disk_tier_token_exact(self):
+        """int8 pools ship value + fp32 scale planes as page leaves; a
+        tiny DRAM cap forces the DISK tier (serialization) into the
+        restore path — still token-exact."""
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=96,
+                        compute_dtype="float32", kv_cache_dtype="int8")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        oracle = _solo(model, params, PROMPT, 6)
+        store = TieredKVStore(dram_capacity_bytes=2048,
+                              disk_dir=tempfile.mkdtemp())
+        eng = _engine(RaggedPagedContinuousBatchingEngine, model, params,
+                      store=store)
+        r1 = eng.add_request(PROMPT, 6)
+        assert eng.run_to_completion(max_ticks=300)[r1] == oracle
+        eng.flush_prefix()
+        assert store.snapshot()["disk"]["pages"] >= 1
+        r2 = eng.add_request(PROMPT, 6)
+        assert eng.run_to_completion(max_ticks=300)[r2] == oracle
+
+    def test_eviction_demotes_instead_of_dropping(self, model_and_params):
+        """A pool sized so serving a second prompt EVICTS the first
+        one's cached pages: they land in the store, and re-serving
+        prompt 1 restores instead of recomputing."""
+        model, params = model_and_params
+        p1, p2 = PROMPT, [int(t) for t in range(40, 64)]
+        o1 = _solo(model, params, p1, 4)
+        store = TieredKVStore()
+        # 7 blocks: p2 shares p1's all-pad first block (a real prefix
+        # hit), takes 3 fresh for its admission, and its decode growth
+        # then finds the free list EMPTY — eviction must demote one of
+        # p1's cached pages instead of dropping it
+        eng = _engine(RaggedPagedContinuousBatchingEngine, model, params,
+                      store=store, num_blocks=7)
+        r1 = eng.add_request(p1, 4)
+        eng.run_to_completion(max_ticks=300)
+        r2 = eng.add_request(p2, 4)
+        eng.run_to_completion(max_ticks=300)
+        assert eng.metrics()["kvstore_demoted_blocks"] >= 1
+        r3 = eng.add_request(p1, 4)
+        out = eng.run_to_completion(max_ticks=300)
+        assert out[r3] == o1
+        assert eng.metrics()["kvstore_restored_blocks"] >= 1
+
+    def test_kvio_in_warmup_grid_and_zero_in_serve_compiles(
+            self, model_and_params):
+        """A warmed engine restores lower-tier pages with ZERO in-serve
+        compiles — the page gather/scatter programs are part of the
+        warmup grid (the ``kvio`` task)."""
+        model, params = model_and_params
+        store = TieredKVStore()
+        eng = _engine(RaggedPagedContinuousBatchingEngine, model, params,
+                      store=store)
+        assert "kvio" in eng.compile_grid()
+        # store-LESS prefix engines gather pages at EXPORT time
+        # (prefill-role replicas) — kvio rides their grid too
+        plain = _engine(RaggedPagedContinuousBatchingEngine, model,
+                        params)
+        assert "kvio" in plain.compile_grid()
+        eng.warmup(max_workers=1)
+        misses = eng._compile_misses
+        r1 = eng.add_request(PROMPT, 4)
+        eng.run_to_completion(max_ticks=300)
+        eng.flush_prefix()
+        r2 = eng.add_request(PROMPT, 4)
+        eng.run_to_completion(max_ticks=300)
+        assert eng.metrics()["kvstore_restored_blocks"] >= 1
+        assert eng._compile_misses == misses, "in-serve compiles"
+
+    def test_kv_store_requires_prefix_cache(self, model_and_params):
+        model, params = model_and_params
+        with pytest.raises(ValueError, match="enable_prefix_cache"):
+            RaggedPagedContinuousBatchingEngine(
+                model, params, max_slots=2, max_len=64, block_size=8,
+                prompt_buckets=[8, 32], kv_store=TieredKVStore())
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode — real engines
+# ---------------------------------------------------------------------------
+
+class TestDisaggRealEngines:
+    def test_migration_end_to_end_token_exact_zero_compiles(
+            self, model_and_params):
+        """The acceptance pin: a prefill-role replica produces the
+        pages, the gateway migrates them into the decode replica's
+        store, the request completes there token-for-token equal to the
+        solo oracle — and neither warmed engine compiles anything on the
+        serving path (zero extra program families)."""
+        model, params = model_and_params
+        oracle = _solo(model, params, PROMPT, 6)
+        tracer = Tracer()
+        gw = ServingGateway(tracer=tracer, migration_bytes_per_tick=4096)
+        prefill = _engine(RaggedPagedContinuousBatchingEngine, model,
+                          params, tracer=Tracer())
+        decode = _engine(RaggedPagedContinuousBatchingEngine, model,
+                         params, store=TieredKVStore(), tracer=Tracer())
+        prefill.warmup(max_workers=1)
+        decode.warmup(max_workers=1)
+        misses0 = prefill._compile_misses + decode._compile_misses
+        gw.add_replica(prefill, "pf", role="prefill")
+        gw.add_replica(decode, "dc", role="decode")
+        toks = []
+        req = gw.submit(PROMPT, 6, on_token=lambda g, t, d:
+                        toks.append(t) if t is not None else None)
+        n = 0
+        while gw.pending():
+            gw.step()
+            n += 1
+            assert n < 500
+        out = gw.pop_finished()
+        assert req.status == "finished" and out[req.gid] == oracle
+        assert toks == oracle                    # single-sourced stream
+        assert req.replica == "dc"
+        snap = gw.kvstore_snapshot()
+        assert snap["counters"]["migrations_completed"] == 1
+        assert snap["counters"]["migrated_bytes"] > 0
+        assert decode.metrics()["kvstore_restored_blocks"] >= 1
+        assert prefill._compile_misses + decode._compile_misses \
+            == misses0, "migration added in-serve compiles"
+        kinds = [e["what"] for e in tracer.events("kvstore")]
+        for want in ("prefill_start", "migrate_start", "migrate_done"):
+            assert want in kinds, kinds
+        # fleet index: both replicas now hold the prompt's pages
+        idx = gw.prefix_index(PROMPT)
+        assert idx["dc"]["total"] >= 1
+        assert "paddle_tpu_kvstore" in gw.prometheus_text()
+
+    def test_mismatched_bucket_ladders_fall_back_cleanly(
+            self, model_and_params):
+        """Chain digests are seeded with the bucket-dependent pad: a
+        destination with a DIFFERENT prompt-bucket ladder could never
+        restore the migrated pages.  The page meta carries the ladder,
+        so the dest-picker rejects it and the pipeline degrades to
+        recompute — counted, never a silent always-miss migration."""
+        model, params = model_and_params
+        oracle = _solo(model, params, PROMPT, 4)
+        gw = ServingGateway(migration_bytes_per_tick=None)
+        prefill = _engine(RaggedPagedContinuousBatchingEngine, model,
+                          params)                    # buckets [8, 32]
+        decode = RaggedPagedContinuousBatchingEngine(
+            model, params, max_slots=2, max_len=64, block_size=8,
+            prompt_buckets=[16, 32], enable_prefix_cache=True,
+            kv_store=TieredKVStore())                # different ladder
+        gw.add_replica(prefill, "pf", role="prefill")
+        gw.add_replica(decode, "dc", role="decode")
+        req = gw.submit(PROMPT, 4)
+        gw.run_to_completion(max_ticks=500)
+        assert req.status == "finished" and req.tokens == oracle
+        c = gw.kvstore_snapshot()["counters"]
+        assert c.get("migration_fallbacks", 0) == 1
+        assert c.get("migrations_completed", 0) == 0
+
+    def test_prefill_role_excluded_from_request_routing(
+            self, model_and_params):
+        """With no decode-capable replica a prefill-only fleet never
+        admits (no silent half-service), and a narrow prompt skips the
+        pipeline onto the unified path."""
+        model, params = model_and_params
+        gw = ServingGateway(migration_bytes_per_tick=None)
+        prefill = _engine(RaggedPagedContinuousBatchingEngine, model,
+                          params)
+        unified = _engine(RaggedPagedContinuousBatchingEngine, model,
+                          params)
+        gw.add_replica(prefill, "pf", role="prefill")
+        gw.add_replica(unified, "un")            # unified, no store
+        # narrow prompt (< 2 blocks): not exportable -> plain dispatch
+        req = gw.submit([5, 17, 3], 4)
+        gw.run_to_completion(max_ticks=300)
+        assert req.status == "finished" and req.replica == "un"
+        assert gw.kvstore_snapshot()["counters"].get(
+            "prefill_dispatches", 0) == 0
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill/decode — fake-clock simulation
+# ---------------------------------------------------------------------------
+
+def _sim_fleet(clock, *, bytes_per_tick=1024, stall_threshold_s=30.0,
+               page_bytes=1024, prefill_ticks_per_block=2,
+               extra_unified=False):
+    tr = SimTracer(clock, capacity=8192)
+    gw = ServingGateway(clock=clock, tracer=tr,
+                        stall_threshold_s=stall_threshold_s,
+                        migration_bytes_per_tick=bytes_per_tick)
+    pf = SimEngine(max_slots=2, tracer=SimTracer(clock),
+                   prefix_caching=True, block_size=4,
+                   page_bytes=page_bytes,
+                   prefill_ticks_per_block=prefill_ticks_per_block)
+    dc = SimEngine(max_slots=2, tracer=SimTracer(clock),
+                   prefix_caching=True, block_size=4,
+                   page_bytes=page_bytes,
+                   prefill_ticks_per_block=prefill_ticks_per_block,
+                   kv_store=TieredKVStore())
+    gw.add_replica(pf, "pf", role="prefill")
+    gw.add_replica(dc, "dc", role="decode")
+    if extra_unified:
+        gw.add_replica(SimEngine(max_slots=2, tracer=SimTracer(clock)),
+                       "un")
+    return gw, tr, pf, dc
+
+
+def _drive(gw, clock, dt=0.25, limit=500):
+    n = 0
+    while gw.pending():
+        gw.step()
+        clock.advance(dt)
+        n += 1
+        assert n < limit, "sim did not drain"
+    return n
+
+
+class TestDisaggSim:
+    def test_migration_byte_budget_pacing_and_stream(self):
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=1024)
+        prompt = list(range(1, 17))              # 4 blocks of 4
+        h = gw.submit(prompt, 8)
+        _drive(gw, clock)
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens(prompt, 8)
+        done = [e for e in tr.events("kvstore")
+                if e["what"] == "migrate_done"][0]
+        assert done["bytes"] == 4 * 1024
+        assert done["ticks"] >= 4                 # 1 KiB budget, 4 pages
+        assert dc.stats.value("kvstore_restored_blocks") >= 1
+        assert tr.summary()["kvstore"]["migrated_bytes"] == 4096
+
+    def test_slow_budgeted_migration_outlives_stall_threshold(self):
+        """Delivery is liveness: a migration legitimately paced over
+        many more ticks than ``stall_threshold_s`` by the byte budget
+        must COMPLETE (the timeout bounds no-progress time, not total
+        transfer time)."""
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=64,
+                                    stall_threshold_s=2.0,
+                                    prefill_ticks_per_block=0)
+        prompt = list(range(1, 17))      # 4 KiB at 64 B/tick: 64 ticks
+        h = gw.submit(prompt, 4)         # = 16 sim-seconds >> 2.0
+        _drive(gw, clock, limit=2000)
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens(prompt, 4)
+        c = gw.kvstore_snapshot()["counters"]
+        assert c["migrations_completed"] == 1
+        assert c.get("migration_fallbacks", 0) == 0
+
+    def test_warm_destination_skips_repeat_migration(self):
+        """A routable replica already covering the prompt makes the
+        pipeline pure overhead: the repeat request dispatches straight
+        to the warm replica — no second prefill, no re-migrated
+        bytes."""
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=None)
+        prompt = list(range(1, 17))
+        h1 = gw.submit(prompt, 4)
+        _drive(gw, clock)
+        assert gw.kvstore_snapshot()["counters"][
+            "migrations_started"] == 1
+        h2 = gw.submit(prompt, 4)
+        _drive(gw, clock)
+        assert h2.status == "finished"
+        assert h2.tokens == sim_tokens(prompt, 4)
+        assert h2.replica == "dc"            # affinity found the warmth
+        c = gw.kvstore_snapshot()["counters"]
+        assert c["migrations_started"] == 1  # no re-migration
+        assert c["prefill_dispatches"] == 1
+
+    def test_quarantine_mid_migration_falls_back_zero_drops(self):
+        """The fake-clock chaos pin: the destination dies while pages
+        are mid-flight — the pipeline degrades to recompute on another
+        replica, the stream stays exact, nothing drops."""
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=256,
+                                    stall_threshold_s=1000.0,
+                                    extra_unified=True)
+        prompt = list(range(1, 17))
+        h = gw.submit(prompt, 6)
+        killed = False
+        n = 0
+        while gw.pending():
+            gw.step()
+            clock.advance(0.25)
+            n += 1
+            if not killed and any(
+                    j["phase"] == "migrate" for j in
+                    gw.kvstore_snapshot()["migrations_inflight"]):
+                gw.quarantine("dc", "chaos")
+                killed = True
+            assert n < 500
+        assert killed, "never caught the migrate phase"
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens(prompt, 6)
+        counters = gw.kvstore_snapshot()["counters"]
+        assert counters.get("migration_fallbacks", 0) >= 1
+        assert any(e["what"] == "fallback"
+                   for e in tr.events("kvstore"))
+
+    def test_prefill_replica_lost_falls_back(self):
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, prefill_ticks_per_block=50)
+        prompt = list(range(1, 17))
+        h = gw.submit(prompt, 6)
+        gw.step()                    # prefill dispatched
+        assert gw.kvstore_snapshot()["migrations_inflight"]
+        gw.quarantine("pf", "chaos")
+        _drive(gw, clock)
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens(prompt, 6)
+        assert gw.kvstore_snapshot()["counters"][
+            "migration_fallbacks"] >= 1
+
+    def test_cancel_and_deadline_mid_pipeline(self):
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, prefill_ticks_per_block=50)
+        prompt = list(range(1, 17))
+        # client cancel mid-prefill
+        h1 = gw.submit(prompt, 6)
+        gw.step()
+        assert gw.kvstore_snapshot()["migrations_inflight"]
+        assert gw.cancel(h1.gid) is True
+        assert h1.status == "cancelled"
+        assert not gw.kvstore_snapshot()["migrations_inflight"]
+        # ttft deadline expires mid-pipeline: structured, zero leaks
+        h2 = gw.submit(prompt, 6, ttft_deadline_s=1.0)
+        n = 0
+        while gw.pending():
+            gw.step()
+            clock.advance(0.5)
+            n += 1
+            assert n < 200
+        assert h2.status == "expired"
+        assert h2.error is not None and h2.error.kind == "ttft"
+
+    def test_prefill_breaker_probe_resolves_and_releases(self):
+        """The gateway-internal prefill attempt never reaches harvest/
+        _finalize, so the HALF_OPEN probe it claims must resolve through
+        the pipeline itself: a completed prefill CLOSES the breaker, a
+        cancelled pipeline RELEASES the claim — a single breaker trip
+        must not disable disaggregation forever."""
+        from paddle_tpu.gateway import CircuitBreaker, ResiliencePolicy
+        clock = SimClock()
+        pol = ResiliencePolicy(retry_budget=1, retry_jitter=0.0,
+                               breaker_failures=1, breaker_open_s=1.0,
+                               hedge=False, brownout=False)
+        gw = ServingGateway(clock=clock, tracer=SimTracer(clock),
+                            resilience=pol, stall_threshold_s=1000.0,
+                            migration_bytes_per_tick=None)
+        pf = SimEngine(max_slots=2, tracer=SimTracer(clock),
+                       prefix_caching=True, block_size=4,
+                       prefill_ticks_per_block=5)
+        dc = SimEngine(max_slots=2, tracer=SimTracer(clock),
+                       prefix_caching=True, block_size=4,
+                       kv_store=TieredKVStore())
+        gw.add_replica(pf, "pf", role="prefill")
+        gw.add_replica(dc, "dc", role="decode")
+        gw.quarantine("pf", "chaos")     # breaker failure -> OPEN
+        gw.reinstate("pf")
+        assert gw._breakers["pf"].state == CircuitBreaker.OPEN
+        clock.advance(1.5)               # past open_s
+        prompt = list(range(1, 17))
+        h = gw.submit(prompt, 4)
+        _drive(gw, clock)
+        assert h.status == "finished"
+        assert h.tokens == sim_tokens(prompt, 4)
+        assert gw._breakers["pf"].state == CircuitBreaker.CLOSED
+        c = gw.kvstore_snapshot()["counters"]
+        assert c["migrations_completed"] == 1
+        # cancel mid-prefill: the claim is released, not leaked
+        gw.quarantine("pf", "chaos2")
+        gw.reinstate("pf")
+        clock.advance(1.5)
+        h2 = gw.submit([t + 50 for t in prompt], 4)
+        gw.step()                        # prefill dispatched (slow)
+        cb = gw._breakers["pf"]
+        assert cb.state == CircuitBreaker.HALF_OPEN \
+            and cb.probe_gid == h2.gid
+        assert gw.cancel(h2.gid) is True
+        assert cb.probe_gid is None      # claim freed for the next probe
+        _drive(gw, clock)
+
+    def test_tier_aware_routing_deep_dram_beats_shallow_hbm(self):
+        """The fleet-index contract: a replica whose DRAM tier holds a
+        DEEP prefix outranks one with a shallow HBM hit."""
+        clock = SimClock()
+        gw = ServingGateway(clock=clock)
+        shallow = SimEngine(max_slots=2, prefix_caching=True,
+                            block_size=4)
+        deep = SimEngine(max_slots=2, prefix_caching=True, block_size=4,
+                         kv_store=TieredKVStore())
+        gw.add_replica(shallow, "shallow")
+        gw.add_replica(deep, "deep", role="decode")
+        prompt = list(range(1, 17))              # 4 blocks
+        chains = sim_chain_keys(prompt, 4)
+        shallow._register_chains(prompt[:4])     # 1 HBM block
+        for chain in chains[:3]:                 # 3 DRAM blocks
+            deep.kv_store.put(KVPage(chain, b"\0" * 16,
+                                     deep.kv_page_meta()))
+        assert gw.prefix_index(prompt)["deep"]["total"] == 3
+        assert gw.prefix_index(prompt)["shallow"]["total"] == 1
+        h = gw.submit(prompt, 4)
+        _drive(gw, clock)
+        assert h.replica == "deep"
+        assert h.tokens == sim_tokens(prompt, 4)
+
+    def test_autoscaler_decode_pool_signal(self):
+        from paddle_tpu.autoscaler import ElasticAutoscaler
+        clock = SimClock()
+        gw = ServingGateway(clock=clock, max_queue_depth=256)
+        dc = SimEngine(max_slots=1, prefix_caching=True, block_size=4,
+                       kv_store=TieredKVStore())
+        gw.add_replica(dc, "dc", role="decode")
+        asc = ElasticAutoscaler(gw, factory=lambda: SimEngine(max_slots=1),
+                                clock=clock, decode_pool_high=2.0,
+                                min_replicas=1, max_replicas=3)
+        for _ in range(6):
+            gw.submit([1, 2, 3], 4)
+        made = asc.evaluate()
+        assert any(d["action"] == "scale_up"
+                   and "decode_pool" in d.get("reason", "")
+                   for d in made), made
+        snap = asc.autoscaler_snapshot()
+        assert snap["signals"]["decode_pool_pressure"] is not None
+        assert snap["signals"]["decode_pool_high"] == 2.0
+
+    def test_ops_kvstore_route_live_and_404(self):
+        from paddle_tpu.ops_server import OpsServer
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=None)
+        h = gw.submit(list(range(1, 17)), 4)
+        _drive(gw, clock)
+        assert h.status == "finished"
+        srv = OpsServer()
+        srv.attach(gw, "gw")
+        url = srv.start()
+        try:
+            live = json.loads(urllib.request.urlopen(
+                url + "/kvstore", timeout=10).read())
+            assert live["counters"]["migrations_completed"] == 1
+            assert live["replicas"]["dc"]["store"] is not None
+            assert live["prefix_index"]["dc"]["role"] == "decode"
+            txt = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            assert "paddle_tpu_kvstore_migrated_bytes" in txt
+        finally:
+            srv.stop()
+        # 404 without any KV surface
+        srv2 = OpsServer()
+        srv2.attach(ServingGateway(), "plain")
+        url2 = srv2.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(url2 + "/kvstore", timeout=10)
+            assert err.value.code == 404
+        finally:
+            srv2.stop()
+
+    def test_standalone_store_attach_serves_kvstore(self):
+        from paddle_tpu.ops_server import OpsServer
+        st = TieredKVStore()
+        st.put(_page(1))
+        srv = OpsServer()
+        srv.attach(st, "solo")
+        url = srv.start()
+        try:
+            live = json.loads(urllib.request.urlopen(
+                url + "/kvstore", timeout=10).read())
+            assert live["dram"]["pages"] == 1
+        finally:
+            srv.stop()

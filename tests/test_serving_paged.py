@@ -315,8 +315,13 @@ class TestPagedFuzz:
         the terminal ``(None, True)`` signal, and at quiescence the
         allocator must balance exactly — ``blocks_allocated ==
         blocks_released`` with zero blocks in use (cancel leaks nothing,
-        whatever lifecycle stage it hit)."""
+        whatever lifecycle stage it hit).  TIER demotion/promotion rides
+        along (ISSUE 14): most draws attach a TieredKVStore and
+        interleave random ``flush_prefix()`` calls — pages bounce
+        HBM -> DRAM -> HBM mid-traffic, and the same oracle/stream/
+        balance contracts must hold through every restore."""
         import paddle_tpu as _paddle
+        from paddle_tpu.kv_store import TieredKVStore
         from paddle_tpu.models.gpt import GPTConfig, GPTModel
         rng = np.random.RandomState(seed)
         kv = "int8" if rng.rand() < 0.5 else None
@@ -332,6 +337,8 @@ class TestPagedFuzz:
         penalty = float(rng.choice([1.0, 4.0]))
         eos = int(rng.randint(0, 97)) if rng.rand() < 0.5 else None
         bs = int(rng.choice([2, 4, 8]))
+        tiered = bool(rng.rand() < 0.7)
+        store = TieredKVStore() if tiered else None
         # worst single request: bucket 16 + chunk-rounded budget of 11
         worst = -(-(16 + -(-(11 - 1) // ticks) * ticks) // bs)
         nb = int(rng.randint(worst, worst * 3))
@@ -339,7 +346,8 @@ class TestPagedFuzz:
             model, params, max_slots=int(rng.randint(1, 4)), max_len=48,
             block_size=bs, num_blocks=nb, prompt_buckets=[8, 16],
             ticks_per_sync=ticks, prefill_chunk=chunk or None,
-            repetition_penalty=penalty, eos_token_id=eos)
+            repetition_penalty=penalty, eos_token_id=eos,
+            enable_prefix_cache=tiered, kv_store=store)
 
         streams = {}
         closed = set()
@@ -368,6 +376,10 @@ class TestPagedFuzz:
                 rid = to_cancel.pop()
                 if eng.cancel(rid):          # False: already finished
                     cancelled.add(rid)
+            if store is not None and rng.rand() < 0.15:
+                # mid-traffic demotion: unpinned cached pages leave HBM
+                # for the DRAM tier; later admissions restore them
+                eng.flush_prefix()
             steps += 1
             assert steps < 800, "not done after 800 ticks"
         got = eng.pop_finished()
@@ -379,7 +391,8 @@ class TestPagedFuzz:
                 want = want[:want.index(eos) + 1]
             ctx = (f"seed={seed} ticks={ticks} chunk={chunk} bs={bs} "
                    f"nb={nb} penalty={penalty} eos={eos} kv={kv} "
-                   f"preempt={eng.preemptions} cancelled={cancelled}")
+                   f"tiered={tiered} preempt={eng.preemptions} "
+                   f"cancelled={cancelled}")
             if rid in cancelled:
                 assert rid not in got, ctx
                 assert rid in closed, ctx     # terminal (None, True) seen
@@ -388,6 +401,10 @@ class TestPagedFuzz:
             else:
                 assert got[rid] == want, ctx
                 assert streams[rid] == want, ctx
+        if store is not None:
+            # cached pages linger in HBM by design; a final demotion
+            # sweep must leave the pool completely empty
+            eng.flush_prefix()
         assert eng.blocks_in_use == 0
         assert int(eng._stats.value("blocks_allocated")) == \
             int(eng._stats.value("blocks_released")), \

@@ -90,6 +90,20 @@ _TELEMETRY_FIELDS = {
     "spec_tokens_per_sec": ("tokens/s", "higher"),
 }
 
+#: kv-tier attachment fields worth diffing (bench.py gpt_kv_tier record
+#: shape): leaf name -> (synthetic unit, direction).  migrated_bytes is
+#: judged LOWER-is-better: moving fewer bytes for the same warm handoff
+#: is less wire; restored_blocks/migration counts are scenario context.
+_KVTIER_FIELDS = {
+    "cold_ttft_ms_p50": ("ms", "lower"),
+    "warm_ttft_ms_p50": ("ms", "lower"),
+    "restore_ttft_p99": ("ms", "lower"),
+    "migration_ttft_ms_p50": ("ms", "lower"),
+    "warm_speedup": ("x", "higher"),
+    "tier_hit_rate": ("frac", "higher"),
+    "migrated_bytes": ("bytes", "lower"),
+}
+
 #: chaos-attachment fields worth diffing (bench.py gpt_chaos record
 #: shape): leaf name -> (synthetic unit, direction).  Counts of hedges/
 #: breaker transitions are scenario-shaped context, not judged.
@@ -126,7 +140,8 @@ def expand_telemetry(records):
         if classify(rec) != "ok":
             continue
         for attachment, fields in (("telemetry", _TELEMETRY_FIELDS),
-                                   ("chaos", _CHAOS_FIELDS)):
+                                   ("chaos", _CHAOS_FIELDS),
+                                   ("kv_tier", _KVTIER_FIELDS)):
             sub = rec.get(attachment)
             if not isinstance(sub, dict):
                 continue

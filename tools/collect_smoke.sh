@@ -428,6 +428,79 @@ then
     echo "COLLECT SMOKE FAILED: ragged speculative round trip"
     exit 1
 fi
+# tiered KV store + disaggregation surface: kv_store must import, a tiny
+# real-engine demote -> evict-from-HBM -> lookup -> restore round trip
+# must stay token-exact vs the solo oracle with the allocator balanced,
+# and a sim disaggregated fleet (prefill role -> byte-budgeted migration
+# -> decode role) must serve a live /kvstore scrape with the migration
+# counted and the kvstore gauge family on /metrics
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'KVEOF'
+import json, urllib.request
+import numpy as np
+import jax.numpy as jnp
+import paddle_tpu as paddle
+from paddle_tpu.kv_store import KVPage, PageMigration, TieredKVStore
+from paddle_tpu.models.gpt import GPTConfig, GPTModel
+from paddle_tpu.serving import RaggedPagedContinuousBatchingEngine
+cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                num_attention_heads=2, max_position_embeddings=64,
+                compute_dtype="float32")
+paddle.seed(0)
+model = GPTModel(cfg)
+params = {n: p._data for n, p in model.named_parameters()}
+store = TieredKVStore()
+eng = RaggedPagedContinuousBatchingEngine(
+    model, params, max_slots=2, max_len=48, block_size=8,
+    prompt_buckets=[8, 32], enable_prefix_cache=True, kv_store=store)
+prompt = list(range(1, 21))
+rid = eng.add_request(prompt, 4)
+out1 = eng.run_to_completion(max_ticks=200)
+n = eng.flush_prefix()                     # demote: HBM empties
+assert n > 0 and len(eng._prefix_cache) == 0
+assert store.snapshot()["dram"]["pages"] == n
+rid2 = eng.add_request(prompt, 4)          # lookup -> restore
+out2 = eng.run_to_completion(max_ticks=200)
+oracle = model.generate(params, jnp.asarray([prompt], jnp.int32), 4,
+                        greedy=True)
+want = [int(t) for t in np.asarray(oracle)[0]]
+assert out1[rid] == want and out2[rid2] == want, "restore diverged"
+m = eng.metrics()
+assert m["kvstore_restored_blocks"] >= 1
+assert m["blocks_allocated"] == m["blocks_released"]
+assert eng.prefix_match(prompt)["total"] >= 1
+# sim disaggregated fleet + live /kvstore
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.simulation import SimClock, SimEngine, SimTracer, sim_tokens
+clock = SimClock()
+gw = ServingGateway(clock=clock, tracer=SimTracer(clock),
+                    migration_bytes_per_tick=1024)
+gw.add_replica(SimEngine(max_slots=2, prefix_caching=True, block_size=4,
+                         tracer=SimTracer(clock)), "pf", role="prefill")
+gw.add_replica(SimEngine(max_slots=2, prefix_caching=True, block_size=4,
+                         kv_store=TieredKVStore(),
+                         tracer=SimTracer(clock)), "dc", role="decode")
+h = gw.submit(list(range(1, 17)), 6)
+for _ in range(200):
+    gw.step(); clock.advance(0.25)
+    if not gw.pending():
+        break
+assert h.status == "finished" and h.tokens == sim_tokens(h.prompt, 6)
+assert gw.kvstore_snapshot()["counters"]["migrations_completed"] == 1
+srv = OpsServer(); srv.attach(gw, "gw")
+url = srv.start()
+live = json.loads(urllib.request.urlopen(url + "/kvstore",
+                                         timeout=10).read())
+assert live["counters"]["migrated_bytes"] > 0
+assert live["replicas"]["dc"]["store"] is not None
+txt = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+assert "paddle_tpu_kvstore_migrations_completed" in txt
+srv.stop()
+KVEOF
+then
+    echo "COLLECT SMOKE FAILED: kv_store tiering / disaggregation round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

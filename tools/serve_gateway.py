@@ -195,7 +195,22 @@ def main(argv=None):
                     help="replica stall-quarantine threshold in seconds "
                          "(default 30, or 5 with --chaos so injected "
                          "crashes resolve on demo timescales)")
+    ap.add_argument("--prefill-replicas", type=int, default=0,
+                    help="disaggregated serving (docs/KV_TIERING.md): "
+                         "the first N replicas register role='prefill' "
+                         "and the rest role='decode' (TieredKVStore "
+                         "auto-attached) — prompts prefill on one pool, "
+                         "KV pages migrate, decode runs on the other; "
+                         "implies --prefix-cache, needs a paged engine")
     args = ap.parse_args(argv)
+    if args.prefill_replicas:
+        if args.engine == "contiguous":
+            ap.error("--prefill-replicas needs a paged engine "
+                     "(pages are block-table KV)")
+        if args.prefill_replicas >= args.replicas:
+            ap.error("--prefill-replicas must leave at least one "
+                     "decode replica")
+        args.prefix_cache = True
 
     import numpy as np
     import paddle_tpu as paddle
@@ -222,13 +237,16 @@ def main(argv=None):
     wrappers = []
     for i in range(args.replicas):
         eng = _build_engine(args, model, params, Tracer())
+        role = "unified"
+        if args.prefill_replicas:
+            role = ("prefill" if i < args.prefill_replicas else "decode")
         if args.warmup_cache_dir:
             eng.warmup(cache_dir=args.warmup_cache_dir)
         if plan is not None:
             from paddle_tpu.faults import FaultyEngine
             eng = FaultyEngine(eng, plan, clock, replica=f"r{i}")
             wrappers.append(eng)
-        names.append(gw.add_replica(eng, f"r{i}"))
+        names.append(gw.add_replica(eng, f"r{i}", role=role))
 
     asc = None
     if args.autoscale:
@@ -330,6 +348,12 @@ def main(argv=None):
                                         for ev in w.injected()]}
     if args.resilience:
         report["resilience"] = gw.resilience_snapshot()
+    if gw.has_kv_surface():
+        ksnap = gw.kvstore_snapshot()
+        report["kvstore"] = {"counters": ksnap["counters"],
+                             "decode_pool_pressure":
+                                 ksnap["decode_pool_pressure"],
+                             "prefix_index": ksnap["prefix_index"]}
     print(json.dumps(report))
     if srv is not None:
         srv.stop()
