@@ -1,0 +1,25 @@
+"""raw-partition-spec positives.  (Fixture: parsed by tpulint, never
+imported.)
+
+Every spelling of a literal PartitionSpec construction outside
+distributed/sharding_rules.py: the aliased import, the attribute chain,
+and the unaliased name — each one is a layout decision the rule table
+(and its AOT cache-invalidation digest) cannot see.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec as P
+
+
+def aliased_spec(mesh):
+    return NamedSharding(mesh, P("data", None))     # BAD: aliased P(...)
+
+
+def attribute_chain_spec(mesh):
+    spec = jax.sharding.PartitionSpec("model")      # BAD: dotted spelling
+    return NamedSharding(mesh, spec)
+
+
+def unaliased_spec():
+    return PartitionSpec(None, "data")              # BAD: unaliased name

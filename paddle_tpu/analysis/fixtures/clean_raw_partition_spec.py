@@ -1,0 +1,26 @@
+"""raw-partition-spec near-misses: every layout here rides the rule
+table.  (Fixture: parsed by tpulint, never imported.)
+
+Specs come from sharding_rules' constructors (the sanctioned authority),
+and merely NAMING PartitionSpec — a type annotation, an isinstance
+check — is not a layout decision.
+"""
+
+from jax.sharding import NamedSharding, PartitionSpec
+from paddle_tpu.distributed.sharding_rules import (batch_spec, make_spec,
+                                                   replicated_spec)
+
+
+def resolver_backed_specs(mesh):
+    return (NamedSharding(mesh, make_spec("data", None)),
+            NamedSharding(mesh, replicated_spec()),
+            NamedSharding(mesh, batch_spec(mesh)))
+
+
+def spec_predicate(spec) -> bool:
+    # referencing the type without constructing it is fine
+    return isinstance(spec, PartitionSpec)
+
+
+def annotated(spec: PartitionSpec) -> PartitionSpec:
+    return spec

@@ -794,3 +794,47 @@ class UnboundedRetry(Rule):
         return (isinstance(arg, ast.Constant)
                 and isinstance(arg.value, (int, float))
                 and not isinstance(arg.value, bool))
+
+
+# ------------------------------------------------------------------ rule 12
+
+#: resolved fullnames that construct a PartitionSpec directly (jax's
+#: spellings plus the top-level ``jax.P`` alias newer jax exposes)
+PARTITION_SPEC_NAMES = {"jax.sharding.PartitionSpec", "jax.P",
+                        "jax.sharding.partition_spec.PartitionSpec",
+                        "jax.experimental.pjit.PartitionSpec"}
+
+#: the one file allowed to construct PartitionSpec: the sharding-rules
+#: resolver (distributed/sharding_rules.py) is the single authority for
+#: array layouts — every other site goes through its constructors
+PARTITION_SPEC_AUTHORITY = "paddle_tpu/distributed/sharding_rules.py"
+
+
+@register
+class RawPartitionSpec(Rule):
+    name = "raw-partition-spec"
+    hints = ("PartitionSpec",)
+    hazard = ("a literal PartitionSpec(...) outside distributed/"
+              "sharding_rules.py is a layout decision the resolver cannot "
+              "see: it bypasses the rule table (scalar exemption, "
+              "divisibility fallback accounting) AND the sharding-rules "
+              "digest, so the AOT executable cache cannot invalidate "
+              "programs that baked the spec in when layouts change — "
+              "route it through sharding_rules' constructors "
+              "(make_spec/replicated_spec/batch_spec/...)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel_path == PARTITION_SPEC_AUTHORITY:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if name in PARTITION_SPEC_NAMES:
+                yield self.finding(
+                    ctx, node,
+                    f"raw {name}(...) constructed outside "
+                    f"sharding_rules.py — use the sharding_rules "
+                    f"constructors (make_spec/replicated_spec/batch_spec/"
+                    f"...) so the layout rides the rule table and its "
+                    f"cache-invalidation digest")

@@ -12,7 +12,10 @@ from .dgc import make_dgc_train_step  # noqa: F401
 from .grad_comm import (GradCommPolicy, compressed_all_reduce,  # noqa: F401
                         compressed_reduce_scatter, resolve_policy)
 from .localsgd import make_localsgd_train_step  # noqa: F401
+from .sharding_rules import (ShardingRules, match_partition_rules,  # noqa: F401
+                             sharding_rules_digest, spec_tree_digest)
 from .spmd import make_spmd_train_step, shard_batch  # noqa: F401
+from .update_sharding import make_dp_update_sharded_train_step  # noqa: F401
 from .zero import make_zero_train_step, per_device_state_bytes  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 

@@ -25,9 +25,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from .grad_comm import ef_accumulate, ef_residual
+from .sharding_rules import make_spec, replica_stacked_spec, replicated_spec
 from .spmd import shard_map as _shard_map
 
 __all__ = ["make_dgc_train_step"]
@@ -70,14 +71,14 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         "v": jax.tree_util.tree_map(stack, params0),
         "count": jnp.zeros([], jnp.int32),
     }
-    rep_spec = lambda leaf: P()
-    resid_spec = lambda leaf: P(axis, *([None] * (np.ndim(leaf) - 1)))
+    rep_spec = lambda leaf: replicated_spec()
+    resid_spec = lambda leaf: replica_stacked_spec(leaf, axis)
     specs = {
         "params": jax.tree_util.tree_map(rep_spec, state0["params"]),
         "opt": jax.tree_util.tree_map(rep_spec, state0["opt"]),
         "u": jax.tree_util.tree_map(resid_spec, state0["u"]),
         "v": jax.tree_util.tree_map(resid_spec, state0["v"]),
-        "count": P(),
+        "count": replicated_spec(),
     }
     state0 = jax.tree_util.tree_map(
         lambda leaf, sp: jax.device_put(leaf, NamedSharding(mesh, sp)),
@@ -161,8 +162,8 @@ def make_dgc_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
         # replication through a scatter, so P() out_specs would be rejected
         w = _shard_map(
             body, mesh=mesh,
-            in_specs=(specs, P()) + (P(axis),) * n_batch,
-            out_specs=(specs, P()),
+            in_specs=(specs, replicated_spec()) + (make_spec(axis),) * n_batch,
+            out_specs=(specs, replicated_spec()),
             check_vma=False)
         return jax.jit(w, donate_argnums=(0,) if donate else ())
 

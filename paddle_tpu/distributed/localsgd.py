@@ -20,8 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
+from .sharding_rules import make_spec, replica_stacked_spec, replicated_spec
 from .spmd import shard_map as _shard_map
 
 __all__ = ["make_localsgd_train_step"]
@@ -62,16 +63,16 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
     state0 = {"params": params_r, "opt": opt_r,
               "count": jnp.zeros([], jnp.int32)}
 
-    rep_spec = lambda leaf: P(axis, *([None] * (np.ndim(leaf) - 1)))
+    stacked = lambda leaf: replica_stacked_spec(leaf, axis)
     state_specs = {
-        "params": jax.tree_util.tree_map(rep_spec, params_r),
-        "opt": jax.tree_util.tree_map(rep_spec, opt_r),
-        "count": P(),
+        "params": jax.tree_util.tree_map(stacked, params_r),
+        "opt": jax.tree_util.tree_map(stacked, opt_r),
+        "count": replicated_spec(),
     }
     if policy.stateful:
         e0 = policy.residual_for(params0, axis_size=R)
         state0["comm_e"] = jnp.zeros((R,) + e0.shape, e0.dtype)
-        state_specs["comm_e"] = P(axis, None)
+        state_specs["comm_e"] = make_spec(axis, None)
     state0 = jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
         state0, state_specs)
@@ -125,15 +126,15 @@ def make_localsgd_train_step(loss_of: Callable, params0: Dict[str, Any],
             out["comm_e"] = new_e[None]
         return out, lax.pmean(loss, axis)
 
-    batch_spec = P(axis)
+    batch_spec = make_spec(axis)
 
     # shard_map specs are positional; rebuild per-call for variadic batches
     @functools.lru_cache(maxsize=8)
     def _compiled(n_batch):
         w = _shard_map(
             body, mesh=mesh,
-            in_specs=(state_specs, P()) + (batch_spec,) * n_batch,
-            out_specs=(state_specs, P()),
+            in_specs=(state_specs, replicated_spec()) + (batch_spec,) * n_batch,
+            out_specs=(state_specs, replicated_spec()),
             # non-fp32: the quantized exchange rebuilds values from
             # all_to_all'd payloads the VMA checker cannot statically prove
             # replicated (same rationale as dgc.py's scatter-add)

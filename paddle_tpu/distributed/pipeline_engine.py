@@ -32,8 +32,10 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
+from .sharding_rules import (make_spec, override_leading_axis,
+                             replicated_spec)
 from .spmd import shard_map as _shard_map
 
 from ..core import rng
@@ -125,21 +127,14 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
     opt_state0 = optimizer.init_state(params0)
     state0 = {"params": params0, "opt": opt_state0, "buffers": {}}
     p_specs = build_param_specs(params0, mesh, layer, 0)
-    for k in stacked_keys:
-        entries = list(p_specs[k])
-        while len(entries) < 1:
-            entries.append(None)
-        if S > 1:
-            ent = [None] * len(params0[k].shape)
-            old = list(p_specs[k])
-            for i, a in enumerate(old):
-                ent[i] = a
-            ent[0] = "pipe"
-            p_specs[k] = P(*ent)
+    if S > 1:
+        for k in stacked_keys:
+            p_specs[k] = override_leading_axis(
+                p_specs[k], len(params0[k].shape), "pipe")
     state_sh = build_state_shardings(state0, p_specs, mesh, 0, params0)
 
-    in_specs_pipe = {k: (P("pipe") if k in stacked_keys else P())
-                     for k in params0}
+    in_specs_pipe = {k: (make_spec("pipe") if k in stacked_keys
+                         else replicated_spec()) for k in params0}
 
     def loss_of(params, key, x, labels):
         h = embed_fn(params, x, key)
@@ -172,8 +167,10 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
 
             out_mb = _shard_map(
                 pipelined, mesh=mesh,
-                in_specs=({k: P("pipe") for k in stacked_keys}, P()),
-                out_specs=P(), axis_names={"pipe"})(block_params, mb)
+                in_specs=({k: make_spec("pipe") for k in stacked_keys},
+                          replicated_spec()),
+                out_specs=replicated_spec(),
+                axis_names={"pipe"})(block_params, mb)
         elif S > 1:
             block_params = {k: params[k] for k in stacked_keys}
 
@@ -188,8 +185,10 @@ def make_stacked_pipeline_step(embed_fn: Callable, block_fn: Callable,
             # real replication bugs
             out_mb = _shard_map(
                 pipelined, mesh=mesh,
-                in_specs=({k: P("pipe") for k in stacked_keys}, P()),
-                out_specs=P(), axis_names={"pipe"})(block_params, mb)
+                in_specs=({k: make_spec("pipe") for k in stacked_keys},
+                          replicated_spec()),
+                out_specs=replicated_spec(),
+                axis_names={"pipe"})(block_params, mb)
         else:
             out_mb = run_blocks(mb.reshape((-1,) + mb.shape[2:]),
                                 {k: params[k] for k in stacked_keys})

@@ -30,87 +30,17 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
 from ..core import rng
 from ..core.tensor import Tensor
 
-
-# --------------------------------------------------------------------------
-# sharding-spec inference
-# --------------------------------------------------------------------------
-
-def _spec_for_param(name: str, p, mesh: Mesh, named_params: Dict, zero_stage: int,
-                    stacked_pipe: bool) -> P:
-    ndim = len(p.shape)
-    entries = [None] * ndim
-    meta = getattr(named_params.get(name), "_dims_mapping", None) \
-        if named_params else None
-    if meta is None:
-        meta = getattr(p, "_dims_mapping", None) or {}
-    for dim, axis in meta.items():
-        if axis in mesh.axis_names and mesh.shape[axis] > 1 and \
-                p.shape[int(dim)] % mesh.shape[axis] == 0:
-            entries[int(dim)] = axis
-    if stacked_pipe and "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1 \
-            and ndim >= 1 and entries[0] is None and \
-            p.shape[0] % mesh.shape["pipe"] == 0 and \
-            getattr(named_params.get(name), "_pipe_stacked", False):
-        entries[0] = "pipe"
-    if zero_stage >= 3 and "sharding" in mesh.axis_names and \
-            mesh.shape["sharding"] > 1:
-        for d in range(ndim):
-            if entries[d] is None and p.shape[d] % mesh.shape["sharding"] == 0:
-                entries[d] = "sharding"
-                break
-    return P(*entries)
-
-
-def build_param_specs(params: Dict[str, Any], mesh: Mesh, layer=None,
-                      zero_stage: int = 0) -> Dict[str, P]:
-    named = dict(layer.named_parameters()) if layer is not None else {}
-    return {name: _spec_for_param(name, p, mesh, named, zero_stage, True)
-            for name, p in params.items()}
-
-
-def _slot_spec(param_spec: P, p, mesh: Mesh, zero_stage: int) -> P:
-    """Optimizer slots follow param sharding; ZeRO-1/2 additionally shards
-    them over "sharding" (reference DygraphShardingOptimizer /
-    ShardingOptimizerStage2 semantics, without the manual bucketing)."""
-    entries = list(param_spec) + [None] * (len(p.shape) - len(param_spec))
-    if zero_stage >= 1 and "sharding" in mesh.axis_names and \
-            mesh.shape["sharding"] > 1 and "sharding" not in entries:
-        for d in range(len(p.shape)):
-            if entries[d] is None and p.shape[d] % mesh.shape["sharding"] == 0:
-                entries[d] = "sharding"
-                break
-    return P(*entries)
-
-
-def build_state_shardings(state, params_specs: Dict[str, P], mesh: Mesh,
-                          zero_stage: int, params):
-    """Shardings for the full TrainState pytree {params, opt, buffers}."""
-    def param_sh(name):
-        return NamedSharding(mesh, params_specs[name])
-
-    p_sh = {k: param_sh(k) for k in state["params"]}
-    rep = NamedSharding(mesh, P())
-
-    def slot_sh(path_name, slots):
-        out = {}
-        for sname, val in slots.items():
-            if hasattr(val, "shape") and len(val.shape) > 0:
-                out[sname] = NamedSharding(
-                    mesh, _slot_spec(params_specs[path_name], params[path_name],
-                                     mesh, zero_stage))
-            else:
-                out[sname] = rep
-        return out
-
-    opt_sh = {"step": rep,
-              "slots": {k: slot_sh(k, v) for k, v in state["opt"]["slots"].items()}}
-    buf_sh = {k: rep for k in state["buffers"]}
-    return {"params": p_sh, "opt": opt_sh, "buffers": buf_sh}
+# Sharding-spec inference lives in sharding_rules.py (THE array-layout
+# module) since PR 16; re-exported here because every trainer and half the
+# test suite historically imported it from spmd.
+from .sharding_rules import (_slot_spec, _spec_for_param, batch_spec,
+                             build_param_specs, build_state_shardings,
+                             replicated_spec)
 
 
 # --------------------------------------------------------------------------
@@ -333,11 +263,9 @@ def make_spmd_train_step(layer, loss_fn, optimizer, hcg, zero_stage: int = 0,
     state_sh = build_state_shardings(state0, p_specs, mesh, zero_stage, params0)
     if policy.stateful:
         state0["comm_e"] = policy.residual_for(params0)
-        state_sh["comm_e"] = NamedSharding(mesh, P())
-    batch_spec = P("data") if "data" in mesh.axis_names and \
-        mesh.shape["data"] > 1 else P()
-    batch_sh = NamedSharding(mesh, batch_spec)
-    rep = NamedSharding(mesh, P())
+        state_sh["comm_e"] = NamedSharding(mesh, replicated_spec())
+    batch_sh = NamedSharding(mesh, batch_spec(mesh))
+    rep = NamedSharding(mesh, replicated_spec())
 
     def place(state):
         return jax.tree_util.tree_map(
@@ -431,7 +359,7 @@ def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
                                      max(zero_stage, 1), params0)
     if policy.stateful:
         state0["comm_e"] = policy.residual_for(params0)
-        state_sh["comm_e"] = NamedSharding(mesh, P())
+        state_sh["comm_e"] = NamedSharding(mesh, replicated_spec())
     step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate, policy)
     state0 = jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), state0, state_sh,
@@ -441,8 +369,7 @@ def make_gspmd_step_from_loss(loss_of, params0, optimizer, mesh, layer=None,
 
 def shard_batch(batch, hcg):
     mesh = hcg.mesh
-    spec = P("data") if "data" in mesh.axis_names and mesh.shape["data"] > 1 else P()
-    sh = NamedSharding(mesh, spec)
+    sh = NamedSharding(mesh, batch_spec(mesh))
     return jax.tree_util.tree_map(
         lambda x: jax.device_put(getattr(x, "_data", x), sh), batch)
 
@@ -477,7 +404,7 @@ def make_gspmd_sharded_init_step(loss_of, build_params, optimizer, mesh,
     state_sh = build_state_shardings(state_abs, p_specs, mesh,
                                      max(zero_stage, 1), abs_params)
     if policy.stateful:
-        state_sh["comm_e"] = NamedSharding(mesh, P())
+        state_sh["comm_e"] = NamedSharding(mesh, replicated_spec())
     # tpulint: disable=jit-in-hot-loop(one-shot sharded init at builder time, never per step)
     state0 = jax.jit(init_state, out_shardings=state_sh)(key0)
     step = _make_gspmd_step(loss_of, optimizer, mesh, p_specs, donate, policy)

@@ -41,9 +41,10 @@ from typing import Any, Callable, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding
 
-from .spmd import build_param_specs, _slot_spec
+from .sharding_rules import (_slot_spec, build_param_specs,
+                             replicated_spec, resolve_flat_shard_spec)
 
 _HALF_DTYPES = (jnp.bfloat16, jnp.float16)
 
@@ -160,7 +161,7 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     if policy.stateful:
         state0["comm_e"] = policy.residual_for(params0)
 
-    rep = NamedSharding(mesh, P())
+    rep = NamedSharding(mesh, replicated_spec())
     p_sh = {k: NamedSharding(mesh, p_specs[k]) for k in params0}
     s_sh = {k: NamedSharding(mesh, s_specs[k]) for k in params0}
 
@@ -178,12 +179,14 @@ def make_zero_train_step(loss_of: Callable, params0: Dict[str, Any], optimizer,
     }
     if policy.stateful:
         # flat EF residual rides the "sharding" axis when divisible (block
-        # padding makes power-of-two degrees always divide), so ZeRO's
-        # memory story extends to the comm state
-        deg = mesh.shape.get("sharding", 1)
-        e_len = int(state0["comm_e"].shape[0])
+        # padding makes power-of-two degrees always divide); an indivisible
+        # length degrades to replication WITH byte accounting
+        # (resolve_flat_shard_spec warns + bumps
+        # sharding_replicated_fallback_bytes — never silently)
         state_sh["comm_e"] = NamedSharding(
-            mesh, P("sharding") if deg > 1 and e_len % deg == 0 else P())
+            mesh, resolve_flat_shard_spec(
+                "comm_e", int(state0["comm_e"].shape[0]), mesh, "sharding",
+                tracer=getattr(monitor, "tracer", None)))
 
     @functools.partial(jax.jit, donate_argnums=(0,) if donate else ())
     def step(state, lr, *batch):

@@ -86,18 +86,28 @@ def mesh_signature(mesh) -> Optional[str]:
     return f"axes={axes}|kinds={kinds}|n={len(devs)}"
 
 
+def _rules_digest() -> str:
+    """The active sharding-rules digest (distributed/sharding_rules.py).
+    Lazy import: jit/ must stay importable without the distributed layer
+    (and vice versa — sharding_rules itself never imports jit/)."""
+    from ..distributed.sharding_rules import sharding_rules_digest
+    return sharding_rules_digest()
+
+
 def fingerprint(*parts, mesh=None, backend: Optional[str] = None,
                 include_env: bool = True) -> str:
     """Stable hex digest over ``parts`` — THE cache-key helper.  By default
-    the compile environment (jax + jaxlib version, backend, mesh signature)
-    is folded in, so a key computed under one toolchain can never alias an
-    executable built under another.  Parts are ``repr``-canonicalized;
-    pass shapes/dtypes, program text, or config tuples — not live arrays."""
+    the compile environment (jax + jaxlib version, backend, mesh signature,
+    sharding-rules digest) is folded in, so a key computed under one
+    toolchain — or one sharding-rule table — can never alias an executable
+    built under another.  Parts are ``repr``-canonicalized; pass
+    shapes/dtypes, program text, or config tuples — not live arrays."""
     h = hashlib.blake2b(digest_size=16)
     env: Tuple[Any, ...] = ()
     if include_env:
         jaxv, jaxlibv = _versions()
-        env = (jaxv, jaxlibv, backend_name(backend), mesh_signature(mesh))
+        env = (jaxv, jaxlibv, backend_name(backend), mesh_signature(mesh),
+               _rules_digest())
     for p in env + tuple(parts):
         h.update(repr(p).encode())
         h.update(b"\x00")
@@ -226,8 +236,8 @@ class ExecutableCache:
             manifest["entries"][digest] = {
                 "key": str(key), "file": fname, "jax": jaxv,
                 "jaxlib": jaxlibv, "backend": self.backend,
-                "mesh": mesh_signature(mesh), "bytes": len(blob),
-                "created_at": time.time()}
+                "mesh": mesh_signature(mesh), "rules": _rules_digest(),
+                "bytes": len(blob), "created_at": time.time()}
             self._write_atomic(self._manifest_path,
                                json.dumps(manifest, indent=2,
                                           sort_keys=True).encode())
@@ -236,8 +246,10 @@ class ExecutableCache:
 
     def get(self, key, mesh=None):
         """The executable cached under ``key``, or None on a miss OR an
-        environment mismatch (jax/jaxlib/backend/mesh drift invalidates
-        the entry — a recompile is cheaper than a wrong program)."""
+        environment mismatch (jax/jaxlib/backend/mesh/sharding-rules drift
+        invalidates the entry — a recompile is cheaper than a wrong
+        program; a stale-spec executable restored from disk must be
+        impossible)."""
         digest = self._digest(key)
         with self._lock:
             if digest in self._mem:
@@ -249,7 +261,7 @@ class ExecutableCache:
             return None
         jaxv, jaxlibv = _versions()
         want = {"jax": jaxv, "jaxlib": jaxlibv, "backend": self.backend,
-                "mesh": mesh_signature(mesh)}
+                "mesh": mesh_signature(mesh), "rules": _rules_digest()}
         for field, expect in want.items():
             if entry.get(field) != expect:
                 self.invalidated += 1

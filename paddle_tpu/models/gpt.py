@@ -214,14 +214,14 @@ class GPTModel(CausalDecoderMixin, Layer):
             # context parallelism: activations stay sequence-sharded on "sep";
             # ring/Ulysses attention inside a partial-manual shard_map region
             # (only "sep" is manual — dp/mp stay under GSPMD)
-            from jax.sharding import PartitionSpec as P
+            from ..distributed.sharding_rules import sep_activation_spec
             from ..distributed.spmd import shard_map
             from ..ops.ring_attention import sequence_parallel_attention
             att = shard_map(
                 functools.partial(sequence_parallel_attention, axis_name="sep",
                                   causal=True, mode=sp_mode),
-                mesh=mesh, in_specs=P(None, "sep", None, None),
-                out_specs=P(None, "sep", None, None), axis_names={"sep"},
+                mesh=mesh, in_specs=sep_activation_spec(),
+                out_specs=sep_activation_spec(), axis_names={"sep"},
             )(q, k, v)
         else:
             att = flash_attention(q, k, v, causal=True)
@@ -421,7 +421,8 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
                         remat: bool = True, donate: bool = True,
                         zero_stage: int = 0, dynamic_loss_scale: bool = False,
                         virtual_pp_degree: Optional[int] = None,
-                        monitor=None, grad_comm=None):
+                        monitor=None, grad_comm=None,
+                        update_sharding: bool = False):
     """Build the full hybrid train step for GPT over the mesh.
 
     dp/mp/sharding/sep via GSPMD; pp via the stacked shard_map pipeline when
@@ -436,11 +437,18 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
     or a ``distributed.grad_comm.GradCommPolicy``), forwarded to the zero
     or GSPMD builder; not wired for pp_degree>1 (the pipeline step owns
     its own exchange schedule).
+    ``update_sharding``: on a plain data-parallel mesh, shard the weight
+    update over the replicas (arXiv:2004.13336 via
+    ``distributed.update_sharding``): optimizer-state HBM and update
+    FLOPs per replica drop ~dp_degree×, token/loss-parity with the
+    replicated update.  Mutually exclusive with zero_stage>0, pp>1, and
+    sequence_parallel (those regimes own their own state layouts).
     """
     from ..distributed.grad_comm import comm_info, resolve_policy
     from ..distributed.pipeline_engine import make_stacked_pipeline_step
+    from ..distributed.sharding_rules import activation_batch_spec
     from ..distributed.spmd import make_gspmd_step_from_loss
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import NamedSharding
 
     policy = resolve_policy(grad_comm)
     mesh = hcg.mesh
@@ -476,11 +484,7 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
             donate=donate, remat=remat, virtual_pp_degree=virtual_pp_degree,
             monitor=monitor)
 
-    seq_spec = None
-    if "sep" in mesh.shape and mesh.shape["sep"] > 1:
-        seq_spec = P("data", "sep", None)
-    elif "data" in mesh.shape and mesh.shape["data"] > 1:
-        seq_spec = P("data", None, None)
+    seq_spec = activation_batch_spec(mesh)
 
     def loss_of(params, key, x, labels):
         h = model.embed_fn(params, x, key)
@@ -491,11 +495,35 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
 
     raw_step = None
     if zero_stage > 0:
+        if update_sharding:
+            raise ValueError(
+                "update_sharding composes the plain-DP regime; zero_stage>0 "
+                "already shards the optimizer state over 'sharding' — pick "
+                "one")
         from ..distributed.zero import make_zero_train_step
         inner_step, state0 = make_zero_train_step(
             loss_of, params0, optimizer, mesh, layer=model,
             zero_stage=zero_stage, dynamic_loss_scale=dynamic_loss_scale,
             donate=donate, monitor=monitor, grad_comm=policy)
+    elif update_sharding:
+        if sp_mesh is not None:
+            raise NotImplementedError(
+                "update_sharding with sequence_parallel is not wired: the "
+                "dp shard_map cannot nest the 'sep' shard_map region")
+        from ..distributed.update_sharding import \
+            make_dp_update_sharded_train_step
+
+        # inside the dp shard_map the batch is already local — no GSPMD
+        # activation constraint to thread (seq_spec is a GSPMD-path hint)
+        def loss_of_local(params, key, x, labels):
+            h = model.embed_fn(params, x, key)
+            h = model.scan_blocks(params, h, key, remat=remat)
+            return model.head_loss_fn(params, h, labels)
+
+        # batch layout: (key, x, labels) — the key rides replicated
+        inner_step, state0 = make_dp_update_sharded_train_step(
+            loss_of_local, params0, optimizer, mesh, donate=donate,
+            monitor=monitor, grad_comm=policy, replicated_args=(0,))
     else:
         from ..telemetry import instrument_train_step
         raw_step, state0 = make_gspmd_step_from_loss(
@@ -523,9 +551,9 @@ def make_gpt_train_step(model: GPTModel, optimizer, hcg, n_microbatches: int = 1
         # (corrupting its first-call compile accounting) — refuse loudly
         def _no_lower(*args, **kwargs):
             raise NotImplementedError(
-                "AOT lowering for zero_stage>0 gpt steps is not wired "
-                "(the ZeRO builder owns its state layout); warm the "
-                "zero_stage=0 GSPMD path, or rely on jit.aot."
+                "AOT lowering for zero_stage>0 / update_sharding gpt steps "
+                "is not wired (those builders own their state layouts); "
+                "warm the plain GSPMD path, or rely on jit.aot."
                 "enable_persistent_compilation_cache for cross-process "
                 "reuse")
         step.lower = _no_lower

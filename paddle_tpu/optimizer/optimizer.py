@@ -104,6 +104,11 @@ class Optimizer:
     # exclude_from_weight_decay) set this; the rule then receives
     # ``param_name`` (Parameter.name eagerly, the pytree key functionally)
     _wants_param_name = False
+    # subclasses whose rule reduces over the WHOLE parameter tensor (Lamb/
+    # Lars trust-ratio norms) set this; such a rule is not valid on a
+    # fused flat shard that spans parameter boundaries, so
+    # update_sharding's elementwise-only guard refuses them
+    _per_tensor_norms = False
 
     def _use_coupled_wd(self, p) -> bool:
         """L2Decay folds into the gradient (decoupled optimizers override)."""

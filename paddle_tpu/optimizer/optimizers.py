@@ -183,6 +183,8 @@ class RMSProp(Optimizer):
 class Lamb(Optimizer):
     """Reference: lamb_op.h — layerwise trust ratio * adam update."""
 
+    _per_tensor_norms = True
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
@@ -236,6 +238,7 @@ class Lars(Optimizer):
         self._exclude = list(exclude_from_weight_decay or [])
 
     _wants_param_name = True
+    _per_tensor_norms = True
 
     def _init_slots(self, p):
         return {"velocity": jnp.zeros_like(p)}

@@ -87,6 +87,22 @@ class TestKeyHelper:
         assert a != fingerprint("prog", backend="tpu-imaginary")
         assert a == fingerprint("prog", backend=jax.default_backend())
 
+    def test_fingerprint_folds_in_sharding_rules_digest(self):
+        """Editing the sharding-rule table (ISSUE 16) changes the default
+        env fold-in, so layout-sensitive keys miss instead of aliasing."""
+        from paddle_tpu.distributed import sharding_rules as sr
+        a = fingerprint("prog")
+        sr.register_rules(sr.ShardingRules([(r".*", None)],
+                                           name="test_fp_rules"))
+        try:
+            assert fingerprint("prog") != a
+            # explicit env exclusion stays rule-blind (compile_aot's key)
+            assert (fingerprint("prog", include_env=False)
+                    == fingerprint("prog", include_env=False))
+        finally:
+            sr.unregister_rules("test_fp_rules")
+        assert fingerprint("prog") == a
+
     def test_pow2_grid_is_exactly_the_view_cols_image(self):
         assert pow2_grid(8) == (1, 2, 4, 8)
         assert pow2_grid(1) == (1,)
@@ -157,6 +173,31 @@ class TestExecutableCache:
         assert fresh.invalidated == 1
         # matching mesh=None still loads
         assert fresh.get("prog") is not None
+
+    def test_sharding_rules_mismatch_invalidates(self, tmp_path):
+        """A stale-SPEC executable restored from disk must be impossible
+        (ISSUE 16): the manifest records the sharding-rules digest, so an
+        entry serialized under one rule table refuses to load under
+        another — same observable path as jax/backend/mesh drift."""
+        from paddle_tpu.distributed import sharding_rules as sr
+        compiled, _ = self._compiled()
+        ExecutableCache(tmp_path).put("prog", compiled)
+        # manifest tamper = an entry written by a process with other rules
+        self._tamper(tmp_path, "rules", "0" * 32)
+        fresh = ExecutableCache(tmp_path)
+        assert fresh.get("prog") is None and fresh.invalidated == 1
+        # the live direction too: put under today's rules, register a new
+        # rule set, and a fresh-process get must invalidate
+        ExecutableCache(tmp_path).put("prog", compiled)
+        sr.register_rules(sr.ShardingRules([(r".*", ("data",))],
+                                           name="test_aot_rules"))
+        try:
+            fresh2 = ExecutableCache(tmp_path)
+            assert fresh2.get("prog") is None and fresh2.invalidated == 1
+        finally:
+            sr.unregister_rules("test_aot_rules")
+        # rules restored: the entry loads again
+        assert ExecutableCache(tmp_path).get("prog") is not None
 
     def test_corrupt_payload_degrades_to_recompile(self, tmp_path):
         compiled, _ = self._compiled()

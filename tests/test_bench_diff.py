@@ -227,3 +227,64 @@ class TestTelemetryExpansion:
         assert bench_diff.main(["--scan", str(tmp_path)]) == 1
         out = capsys.readouterr().err
         assert "1 regression" in out
+
+
+class TestUpdateShardingExpansion:
+    """The gpt_weight_update_sharding attachment (ISSUE 16) expands into
+    per-arm synthetic rows: bytes/step-wall/wire regress when they RISE,
+    the reduction factor and throughput when they DROP, and a growing
+    loss_delta flags the parity pin eroding."""
+
+    def _us_rec(self, value, sharded_bytes, reduction, sharded_ms,
+                loss_delta=0.0):
+        rec = _rec("gpt_weight_update_sharding_tokens_per_sec", value)
+        rec["update_sharding"] = {
+            "replicas": 2,
+            "replicated": {"opt_bytes_per_replica": 3829760,
+                           "step_ms": 850.0, "tokens_per_sec": 300.0,
+                           "wire_bytes": 3829760, "loss": 5.62},
+            "sharded": {"opt_bytes_per_replica": sharded_bytes,
+                        "step_ms": sharded_ms, "tokens_per_sec": value,
+                        "wire_bytes": 3829760, "loss": 5.62},
+            "opt_bytes_reduction": reduction,
+            "loss_delta": loss_delta,
+        }
+        return rec
+
+    def test_expansion_covers_both_arms_with_directions(self):
+        rows = bench_diff.expand_telemetry(
+            [self._us_rec(6000.0, 1914880, 2.0, 42.0)])
+        by = {r["metric"]: r for r in rows}
+        pre = "gpt_weight_update_sharding_tokens_per_sec.update_sharding"
+        assert f"{pre}.sharded.opt_bytes_per_replica" in by
+        assert f"{pre}.replicated.opt_bytes_per_replica" in by
+        assert f"{pre}.opt_bytes_reduction" in by
+        assert f"{pre}.loss_delta" in by
+        assert by[f"{pre}.sharded.opt_bytes_per_replica"][
+            "direction"] == "lower"
+        assert by[f"{pre}.opt_bytes_reduction"]["direction"] == "higher"
+        # scenario context (replica count, absolute loss) stays out
+        assert not any(m.endswith(".replicas") for m in by)
+        assert not any(m.endswith(".loss") for m in by)
+
+    def test_bytes_rise_and_reduction_drop_regress(self):
+        old = bench_diff.expand_telemetry(
+            [self._us_rec(6000.0, 1914880, 2.0, 42.0)])
+        new = bench_diff.expand_telemetry(
+            [self._us_rec(6000.0, 3829760, 1.0, 42.0)])
+        rows, n_reg, _ = bench_diff.compare(old, new, 0.1)
+        names = {r["metric"].split(".")[-1] for r in rows
+                 if "REGRESSION" in r["status"]}
+        # the sharded arm's bytes doubled AND the reduction factor halved
+        assert "opt_bytes_per_replica" in names
+        assert "opt_bytes_reduction" in names
+
+    def test_step_wall_rise_regresses_headline_held(self):
+        old = bench_diff.expand_telemetry(
+            [self._us_rec(6000.0, 1914880, 2.0, 42.0)])
+        new = bench_diff.expand_telemetry(
+            [self._us_rec(6000.0, 1914880, 2.0, 90.0)])
+        rows, n_reg, _ = bench_diff.compare(old, new, 0.1)
+        bad = [r for r in rows if "REGRESSION" in r["status"]]
+        assert n_reg == 1
+        assert bad[0]["metric"].endswith("sharded.step_ms")

@@ -29,6 +29,7 @@ BAD_FIXTURE = {
     "jit-in-hot-loop": "bad_jit_in_hot_loop.py",
     "blocking-fetch-in-loop": "bad_blocking_fetch_in_loop.py",
     "unbounded-retry": "bad_unbounded_retry.py",
+    "raw-partition-spec": "bad_raw_partition_spec.py",
 }
 CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
                  for rule, path in BAD_FIXTURE.items()}
@@ -172,6 +173,19 @@ def test_jit_in_hot_loop_flags_all_four_shapes():
     assert sum("while loop" in m for m in msgs) == 1
     assert sum("one expression" in m for m in msgs) == 1
     assert sum("@jit-decorated" in m for m in msgs) == 1
+
+
+def test_raw_partition_spec_exempts_only_the_authority_file():
+    """sharding_rules.py IS the sanctioned constructor site; the same
+    source anywhere else in the tree must trip."""
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "def spec():\n"
+           "    return P('data')\n")
+    assert lint_source("paddle_tpu/distributed/sharding_rules.py", src,
+                       rules=[RULES["raw-partition-spec"]]) == []
+    findings = lint_source("paddle_tpu/distributed/spmd.py", src,
+                           rules=[RULES["raw-partition-spec"]])
+    assert [f.rule for f in findings] == ["raw-partition-spec"]
 
 
 def test_jit_in_hot_loop_ignores_shard_map_invoked_inside_traced_body():

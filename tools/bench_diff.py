@@ -104,6 +104,23 @@ _KVTIER_FIELDS = {
     "migrated_bytes": ("bytes", "lower"),
 }
 
+#: weight-update-sharding attachment fields worth diffing (bench.py
+#: gpt_weight_update_sharding record shape): leaf name -> (synthetic
+#: unit, direction).  ``opt_bytes_per_replica``/``step_ms``/
+#: ``wire_bytes`` appear once per arm (…replicated.* and …sharded.*
+#: synthetic rows) and regress when they RISE; the reduction factor and
+#: per-arm throughput regress when they DROP.  ``loss_delta`` rising
+#: means the parity pin is eroding — judged lower-is-better; ``loss``
+#: and ``replicas`` are scenario context, not health signals.
+_UPDATE_SHARDING_FIELDS = {
+    "opt_bytes_per_replica": ("bytes", "lower"),
+    "opt_bytes_reduction": ("x", "higher"),
+    "step_ms": ("ms", "lower"),
+    "wire_bytes": ("bytes", "lower"),
+    "tokens_per_sec": ("tokens/s", "higher"),
+    "loss_delta": ("abs", "lower"),
+}
+
 #: chaos-attachment fields worth diffing (bench.py gpt_chaos record
 #: shape): leaf name -> (synthetic unit, direction).  Counts of hedges/
 #: breaker transitions are scenario-shaped context, not judged.
@@ -141,7 +158,9 @@ def expand_telemetry(records):
             continue
         for attachment, fields in (("telemetry", _TELEMETRY_FIELDS),
                                    ("chaos", _CHAOS_FIELDS),
-                                   ("kv_tier", _KVTIER_FIELDS)):
+                                   ("kv_tier", _KVTIER_FIELDS),
+                                   ("update_sharding",
+                                    _UPDATE_SHARDING_FIELDS)):
             sub = rec.get(attachment)
             if not isinstance(sub, dict):
                 continue

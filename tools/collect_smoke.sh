@@ -501,6 +501,54 @@ then
     echo "COLLECT SMOKE FAILED: kv_store tiering / disaggregation round trip"
     exit 1
 fi
+# sharding-rules surface: the resolver must import clean and round-trip a
+# tiny rule table, the rules digest must be LIVE in the AOT fingerprint
+# environment (register -> fingerprint moves -> unregister -> restores),
+# and a 2-replica weight-update-sharded train step (arXiv:2004.13336)
+# must train while holding exactly half the replicated optimizer HBM
+if ! JAX_PLATFORMS=cpu \
+     XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+     python - >/dev/null 2>&1 <<'SREOF'
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec
+from paddle_tpu.distributed import sharding_rules as sr
+from paddle_tpu.distributed.update_sharding import (
+    make_dp_update_sharded_train_step, update_sharding_rules)
+from paddle_tpu.distributed.zero import per_device_state_bytes
+from paddle_tpu.jit.aot import fingerprint
+from paddle_tpu.optimizer import Adam
+assert jax.device_count() == 2
+specs = sr.ShardingRules([(r"w", ("data", None)), (r".*", None)]).resolve(
+    {"w": np.zeros((8, 4), np.float32), "step": np.zeros((), np.float32)})
+assert specs["w"] == PartitionSpec("data")
+assert specs["step"] == PartitionSpec()
+fp0 = fingerprint("smoke")
+sr.register_rules(sr.ShardingRules([(r".*", ("data",))],
+                                   name="smoke_probe"))
+assert fingerprint("smoke") != fp0          # digest is in the env
+sr.unregister_rules("smoke_probe")
+assert fingerprint("smoke") == fp0
+mesh = Mesh(np.array(jax.devices()), ("data",))
+params = {"w": jnp.ones((8, 4), jnp.float32)}
+def loss_of(p, x):
+    return jnp.mean((x @ p["w"]) ** 2)
+step, state = make_dp_update_sharded_train_step(
+    loss_of, params, Adam(0.05), mesh)
+assert per_device_state_bytes(state) == 2 * 8 * 4 * 4 // 2  # Adam m+v / R
+x = jnp.ones((4, 8), jnp.float32)
+state, l0 = step(state, np.float32(0.05), x)
+state, l1 = step(state, np.float32(0.05), x)
+assert float(l1) < float(l0)
+flat = update_sharding_rules().resolve(
+    {"opt": {"slots": {"flat": np.zeros((4,), np.float32)}}})
+assert flat["opt"]["slots"]["flat"] == PartitionSpec("data")
+SREOF
+then
+    echo "COLLECT SMOKE FAILED: sharding-rules / update-sharding round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via
