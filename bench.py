@@ -173,6 +173,23 @@ def _result(name, unit, items_per_step, iters, dt, flops_per_step, on_tpu, loss)
     return out
 
 
+def _memory_block(ledger):
+    """Per-pool live + peak bytes from a ``telemetry_memory.MemoryLedger``
+    — the ``memory`` attachment a bench record carries when its byte
+    claims are MEASURED (ISSUE 17).  All-zero pools/tiers are dropped so
+    the record stays readable; ``tools/bench_diff.py`` diffs the rest."""
+    snap = ledger.memory_snapshot()
+    pools = {p: {k: int(v) for k, v in row.items()}
+             for p, row in snap["pools"].items() if any(row.values())}
+    out = {"pools": pools,
+           "totals": {k: int(v) for k, v in snap["totals"].items()}}
+    tiers = {t: {k: int(v) for k, v in row.items()}
+             for t, row in snap["kv_tiers"].items() if any(row.values())}
+    if tiers:
+        out["kv_tiers"] = tiers
+    return out
+
+
 def _fleet_hcg(**degrees):
     from paddle_tpu.distributed import fleet
     strategy = fleet.DistributedStrategy()
@@ -790,39 +807,56 @@ def bench_gpt_kv_tier(on_tpu):
     def p(vals, q):
         return vals[min(len(vals) - 1, int(round(q * (len(vals) - 1))))]
 
-    cold, warm, store, warm_eng = measure_cold_warm()
-    if p(warm, 0.5) >= p(cold, 0.5):
-        # one bounded re-measure absorbs jitter on small-margin hosts;
-        # the re-measured numbers are the ones recorded either way
+    from paddle_tpu.telemetry_memory import MemoryLedger
+    mem = MemoryLedger()
+    with mem:   # active ledger: every TieredKVStore mutation resyncs its
+        # dram/disk tier bytes; a census pins the device-resident side
         cold, warm, store, warm_eng = measure_cold_warm()
-    assert p(warm, 0.5) < p(cold, 0.5), (warm, cold)
+        if p(warm, 0.5) >= p(cold, 0.5):
+            # one bounded re-measure absorbs jitter on small-margin hosts;
+            # the re-measured numbers are the ones recorded either way
+            cold, warm, store, warm_eng = measure_cold_warm()
+        assert p(warm, 0.5) < p(cold, 0.5), (warm, cold)
 
-    # ---- cross-replica migration arm: fresh engines per repeat so every
-    # pass really migrates (a shared decode replica would HBM-hit) ----
-    mig_ttfts, migrated_bytes = [], 0
-    for _ in range(3):
-        gw = ServingGateway(migration_bytes_per_tick=None)
-        prefill_eng, decode_eng = mk(prefix=True), mk(store=TieredKVStore())
-        prefill_eng.warmup(max_workers=1)
-        decode_eng.warmup(max_workers=1)
-        m0 = prefill_eng._compile_misses + decode_eng._compile_misses
-        gw.add_replica(prefill_eng, "pf", role="prefill")
-        gw.add_replica(decode_eng, "dc", role="decode")
-        h = gw.submit(list(prompt), n_new)
-        while gw.pending():
-            gw.step()
-        out = gw.pop_finished()
-        assert h.status == "finished" and out[h.gid] == oracle, h
-        assert h.replica == "dc", h.replica
-        snap = gw.kvstore_snapshot()
-        assert snap["counters"]["migrations_completed"] == 1, snap
-        migrated_bytes = int(snap["counters"]["migrated_bytes"])
-        assert prefill_eng._compile_misses + decode_eng._compile_misses \
-            == m0, "in-serve compiles in the migration arm"
-        mig_ttfts.append((h.first_token_at - h.submitted_at) * 1e3)
-    mig_ttfts.sort()
+        # device-side KV bytes (the hbm tier row): register the warm
+        # engine's params + paged caches, then one census
+        warm_eng.attach_memory(mem)
+        warm_eng.refresh_memory()
+        mem.census()
+
+        # ---- cross-replica migration arm: fresh engines per repeat so
+        # every pass really migrates (a shared decode replica would
+        # HBM-hit) ----
+        mig_ttfts, migrated_bytes = [], 0
+        for _ in range(3):
+            gw = ServingGateway(migration_bytes_per_tick=None)
+            prefill_eng, decode_eng = mk(prefix=True), \
+                mk(store=TieredKVStore())
+            prefill_eng.warmup(max_workers=1)
+            decode_eng.warmup(max_workers=1)
+            m0 = prefill_eng._compile_misses + decode_eng._compile_misses
+            gw.add_replica(prefill_eng, "pf", role="prefill")
+            gw.add_replica(decode_eng, "dc", role="decode")
+            h = gw.submit(list(prompt), n_new)
+            while gw.pending():
+                gw.step()
+            out = gw.pop_finished()
+            assert h.status == "finished" and out[h.gid] == oracle, h
+            assert h.replica == "dc", h.replica
+            snap = gw.kvstore_snapshot()
+            assert snap["counters"]["migrations_completed"] == 1, snap
+            migrated_bytes = int(snap["counters"]["migrated_bytes"])
+            assert prefill_eng._compile_misses + decode_eng._compile_misses \
+                == m0, "in-serve compiles in the migration arm"
+            mig_ttfts.append((h.first_token_at - h.submitted_at) * 1e3)
+        mig_ttfts.sort()
 
     hit_rate = store.hit_rate()
+    mem_snap = mem.memory_snapshot()
+    tier_bytes = {t: int(r["bytes"])
+                  for t, r in mem_snap["kv_tiers"].items()}
+    tier_peak_bytes = {t: int(r["peak_bytes"])
+                       for t, r in mem_snap["kv_tiers"].items()}
     return {"metric": "gpt_kv_tier_restore_ttft_ms",
             "value": round(p(warm, 0.5), 3), "unit": "ms",
             "mfu": None, "vs_baseline": None, "vs_a100_flops": None,
@@ -839,7 +873,13 @@ def bench_gpt_kv_tier(on_tpu):
                                        ["kvstore_restored_blocks"]),
                 "migrated_bytes": migrated_bytes,
                 "migration_ttft_ms_p50": round(p(mig_ttfts, 0.5), 3),
-            }}
+                # measured per-tier KV bytes from the memory ledger
+                # (ISSUE 17): hbm from the census over the warm engine's
+                # paged caches, dram/disk from the store tier counters
+                "tier_bytes": tier_bytes,
+                "tier_peak_bytes": tier_peak_bytes,
+            },
+            "memory": _memory_block(mem)}
 
 
 def bench_gpt_gateway(on_tpu):
@@ -1277,30 +1317,42 @@ def bench_gpt_weight_update_sharding(on_tpu):
         hcg = _fleet_hcg(dp_degree=R)
         mon = TrainMonitor()
         model = GPTModel(cfg)
-        step, state = make_gpt_train_step(
-            model, AdamW(3e-4, weight_decay=0.01), hcg, remat=False,
-            monitor=mon, update_sharding=update_sharding)
-        opt_bytes = per_device_state_bytes(state)
-        wb = wire_bytes(state["params"], "fp32")
-        # no AOT here: the update-sharded step owns its layout and
-        # refuses .lower (models/gpt.py) — warm with one live dispatch,
-        # then time the compiled program the same way on both arms
-        state, loss = step(state, key, np.float32(3e-4), x, y)
-        float(np.asarray(loss))
-        t0 = time.perf_counter()
-        for _ in range(iters):
+        from paddle_tpu.telemetry_memory import MemoryLedger
+        mem = MemoryLedger()
+        with mem:   # active ledger: the builder registers state0 and the
+            # instrument seam re-registers the donated state every step
+            step, state = make_gpt_train_step(
+                model, AdamW(3e-4, weight_decay=0.01), hcg, remat=False,
+                monitor=mon, update_sharding=update_sharding)
+            opt_bytes = per_device_state_bytes(state)
+            wb = wire_bytes(state["params"], "fp32")
+            # no AOT here: the update-sharded step owns its layout and
+            # refuses .lower (models/gpt.py) — warm with one live dispatch,
+            # then time the compiled program the same way on both arms
             state, loss = step(state, key, np.float32(3e-4), x, y)
-        final_loss = float(np.asarray(loss))
-        dt = time.perf_counter() - t0
+            float(np.asarray(loss))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                state, loss = step(state, key, np.float32(3e-4), x, y)
+            final_loss = float(np.asarray(loss))
+            dt = time.perf_counter() - t0
+            # the MEASURED per-pool bytes (ISSUE 17): register the final
+            # donated state, then one census over addressable shards —
+            # replicated opt state on R devices counts R×, a 1/R flat
+            # shard counts 1×, so per-replica = pool bytes / R
+            mem.register_train_state(state, name="final_state")
+            walk = mem.census()
         assert np.isfinite(final_loss), f"non-finite loss {final_loss}"
+        measured = int(walk["pools"]["optimizer_state"]) // R
         return {"opt_bytes_per_replica": opt_bytes,
+                "opt_bytes_per_replica_measured": measured,
                 "step_ms": round(dt / iters * 1e3, 3),
                 "tokens_per_sec": round(B * L * iters / dt, 1),
                 "wire_bytes": wb["post_bytes"],
-                "loss": final_loss}, dt
+                "loss": final_loss}, dt, _memory_block(mem)
 
-    replicated, _ = run_arm(False)
-    sharded, dt_sh = run_arm(True)
+    replicated, _, mem_rep = run_arm(False)
+    sharded, dt_sh, mem_sh = run_arm(True)
 
     # THE paper's claim, pinned: optimizer HBM per replica drops ~R x
     # while the schedule stays loss-identical (reduce-scatter + sharded
@@ -1309,6 +1361,13 @@ def bench_gpt_weight_update_sharding(on_tpu):
         sharded["opt_bytes_per_replica"], 1)
     assert reduction >= 1.8, (
         f"opt-state reduction {reduction:.2f}x < 1.8x at R={R}")
+    # the same claim, now MEASURED from the memory ledger's census rather
+    # than the analytic shard arithmetic — the two must agree
+    measured_reduction = replicated["opt_bytes_per_replica_measured"] / max(
+        sharded["opt_bytes_per_replica_measured"], 1)
+    assert measured_reduction >= 1.8, (
+        f"measured opt-state reduction {measured_reduction:.2f}x < 1.8x "
+        f"at R={R}")
     loss_delta = abs(sharded["loss"] - replicated["loss"])
     assert np.isclose(sharded["loss"], replicated["loss"],
                       rtol=1e-4, atol=1e-6), (
@@ -1326,8 +1385,12 @@ def bench_gpt_weight_update_sharding(on_tpu):
         "replicated": replicated,
         "sharded": sharded,
         "opt_bytes_reduction": round(reduction, 3),
+        "opt_bytes_reduction_measured": round(measured_reduction, 3),
         "loss_delta": round(loss_delta, 6),
     }
+    # per-arm memory ledgers: pool live/peak bytes at steady state, so the
+    # HBM claim above is a measured record, not a formula
+    out["memory"] = {"replicated": mem_rep, "sharded": mem_sh}
     return out
 
 
@@ -1399,9 +1462,26 @@ t0 = time.time(); d = len(jax.devices()); t1 = time.time()
 x = jnp.ones((2048, 2048), jnp.bfloat16)
 y = jax.jit(lambda a: a @ a)(x)
 v = float(np.asarray(y[0, 0])); t2 = time.time()
+k = getattr(jax.devices()[0], 'device_kind', '?').replace(' ', '_')
 print(f'COMPUTE_HEALTHY backend={jax.default_backend()} devices={d} '
-      f'dial={t1-t0:.1f}s compute={t2-t1:.1f}s v={v}', flush=True)
+      f'kind={k} dial={t1-t0:.1f}s compute={t2-t1:.1f}s v={v}', flush=True)
 """
+
+
+def _probe_health(healthy, rc, out):
+    """The backend-health stamp every BENCH record header carries (ISSUE
+    17): the probe's verdict plus the backend/device identity it saw, so
+    a perf number is never read without knowing what produced it —
+    ``tools/bench_diff.py`` refuses to call cross-backend pairs
+    comparable and warns when A/B health stamps disagree."""
+    detail = next((ln for ln in (out or "").splitlines()
+                   if ln.startswith("COMPUTE_HEALTHY")), "")
+    fields = dict(kv.split("=", 1) for kv in detail.split() if "=" in kv)
+    devices = fields.get("devices")
+    return {"compute_healthy": bool(healthy), "probe_rc": rc,
+            "backend": fields.get("backend"),
+            "devices": int(devices) if devices else None,
+            "device_kind": fields.get("kind")}
 
 
 def _health_log(line):
@@ -1498,7 +1578,7 @@ def _parent(names, attempts, timeout):
     remaining = list(names)
     probe_tries = int(os.environ.get("PADDLE_TPU_BENCH_PROBE_ATTEMPTS", "3"))
     probe_backoff = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_BACKOFF", "90"))
-    probe_ok = False
+    probe_ok, probe_rc, probe_err = False, None, ""
     probe_errors = []
     for p in range(probe_tries):  # transient tunnel wedge ≠ dead round
         probe_ok, probe_rc, probe_err = _probe_backend(
@@ -1514,6 +1594,7 @@ def _parent(names, attempts, timeout):
         # safe even for the half-up wedge case
         if p < probe_tries - 1:
             time.sleep(probe_backoff)
+    health = _probe_health(probe_ok, probe_rc, probe_err)
     if not probe_ok:
         # backend unhealthy ≠ benchmark failure: emit "skipped" records
         # carrying the probe tail, so the perf trajectory stays parseable
@@ -1523,6 +1604,7 @@ def _parent(names, attempts, timeout):
                 "metric": f"{name}_train_throughput", "value": None,
                 "unit": "skipped", "vs_baseline": None,
                 "vs_a100_flops": None,
+                "health": health,
                 "skipped": {"reason": "backend unhealthy (compute "
                                       "round-trip probe failed — see "
                                       "HEALTH.log)",
@@ -1549,11 +1631,14 @@ def _parent(names, attempts, timeout):
                            "tail": stderr[-600:]})
     for name in names:
         if name in results:
-            print(json.dumps(results[name]), flush=True)
+            rec = results[name]
+            rec["health"] = health    # probe verdict stamped on success too
+            print(json.dumps(rec), flush=True)
         else:
             print(json.dumps({
                 "metric": f"{name}_train_throughput", "value": None,
                 "unit": "error", "vs_baseline": None, "vs_a100_flops": None,
+                "health": health,
                 "error": {"attempts": len(errors), "detail": errors},
             }), flush=True)
     return 0  # structured error on stdout IS the artifact; don't die raw

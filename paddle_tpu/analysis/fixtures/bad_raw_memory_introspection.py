@@ -1,0 +1,24 @@
+"""raw-memory-introspection positives.  (Fixture: parsed by tpulint,
+never imported.)
+
+Every spelling of a direct memory read outside telemetry_memory.py:
+the live-array walk (bare and dotted) and the PJRT allocator-stats
+method — each one is a second accounting point whose bytes bypass the
+memory ledger's pool attribution.
+"""
+
+import jax
+from jax import live_arrays
+
+
+def bare_walk():
+    return sum(a.nbytes for a in live_arrays())     # BAD: imported name
+
+
+def dotted_walk():
+    return len(jax.live_arrays())                   # BAD: dotted spelling
+
+
+def allocator_read():
+    dev = jax.local_devices()[0]
+    return dev.memory_stats()                       # BAD: raw stats read

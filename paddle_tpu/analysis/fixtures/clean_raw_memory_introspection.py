@@ -1,0 +1,26 @@
+"""raw-memory-introspection near-misses: every read here routes through
+the memory ledger's sanctioned surface.  (Fixture: parsed by tpulint,
+never imported.)
+
+The census classifier and the allocator-stats delegate are the single
+accounting point; merely naming the functions — a docstring, a variable
+called live_arrays, an unrelated attribute — is not a memory read.
+"""
+
+from paddle_tpu.telemetry_memory import (device_allocator_stats,
+                                         live_array_census)
+
+
+def census_backed(params, opt):
+    # the sanctioned walk: one classification, conservation auditable
+    return live_array_census({"params": params, "opt": opt})
+
+
+def allocator_backed():
+    return device_allocator_stats(0)
+
+
+def unrelated_names(stats):
+    live_arrays = [a for a in stats if a]          # a variable, not a call
+    memory_stats = {"peak": 0}                     # a dict, not a method
+    return live_arrays, memory_stats["peak"]

@@ -838,3 +838,54 @@ class RawPartitionSpec(Rule):
                     f"constructors (make_spec/replicated_spec/batch_spec/"
                     f"...) so the layout rides the rule table and its "
                     f"cache-invalidation digest")
+
+
+# ------------------------------------------------------------------ rule 13
+
+#: resolved fullnames that walk the live-array set directly
+LIVE_ARRAYS_NAMES = {"jax.live_arrays", "jax.lib.xla_bridge.live_arrays"}
+
+#: the one file allowed raw memory introspection: the memory ledger
+#: (telemetry_memory.py) is the single accounting point — every census,
+#: classifier, and allocator-stats read routes through it
+MEMORY_INTROSPECTION_AUTHORITY = "paddle_tpu/telemetry_memory.py"
+
+
+@register
+class RawMemoryIntrospection(Rule):
+    name = "raw-memory-introspection"
+    hints = ("live_arrays", "memory_stats")
+    hazard = ("a direct jax.live_arrays() walk or device .memory_stats() "
+              "read outside telemetry_memory.py is a second memory "
+              "accounting point: its bytes bypass the ledger's pool "
+              "attribution, so the conservation invariant (sum of pools "
+              "== census total) can no longer be audited, and ad-hoc "
+              "walks over thousands of live arrays on a hot path are a "
+              "latency hazard the ledger's census batching exists to "
+              "contain — route reads through telemetry_memory "
+              "(live_array_census / device_allocator_stats / "
+              "MemoryLedger.census)")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel_path == MEMORY_INTROSPECTION_AUTHORITY:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if name in LIVE_ARRAYS_NAMES:
+                yield self.finding(
+                    ctx, node,
+                    f"raw {name}() walk outside telemetry_memory.py — "
+                    f"use telemetry_memory.live_array_census (or a "
+                    f"MemoryLedger census) so the bytes land in the "
+                    f"pool ledger's conservation audit")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "memory_stats"):
+                yield self.finding(
+                    ctx, node,
+                    "raw device .memory_stats() read outside "
+                    "telemetry_memory.py — use telemetry_memory."
+                    "device_allocator_stats (utils.stats."
+                    "device_memory_stats delegates there) so allocator "
+                    "reads share one accounting point")

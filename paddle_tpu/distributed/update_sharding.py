@@ -208,5 +208,12 @@ def make_dp_update_sharded_train_step(loss_of: Callable,
                                      *batch)
 
     from ..telemetry import instrument_train_step
+    from ..telemetry_memory import current_memory_ledger
+    _ml = current_memory_ledger()
+    if _ml is not None:
+        # allocation-site registration: the sharded flat slots land in
+        # the `optimizer_state` pool as 1/R addressable shards, so a
+        # census MEASURES the paper's ~R× HBM saving (bench pins it)
+        _ml.register_train_state(state0, name="dp_update_sharded")
     return instrument_train_step(step, monitor, "dp_update_sharded",
                                  comm=comm_info(params0, policy)), state0

@@ -11,9 +11,11 @@ scenario.  This module makes fault injection a first-class subsystem:
   a window, then resumes), **slow** straggler (a latency multiplier for a
   window), transient **dispatch_error** (``add_request`` raises the
   retryable :class:`TransientDispatchError`), **warmup_fail** (the AOT
-  warmup path raises), and **garble** (a truncated/garbled token stream:
+  warmup path raises), **garble** (a truncated/garbled token stream:
   the engine delivers a partial prefix, then its integrity check raises
-  :class:`StreamCorruption` mid-tick);
+  :class:`StreamCorruption` mid-tick), and **alloc_fail** (``step()``
+  raises :class:`InjectedAllocationError`, a :class:`MemoryError` — the
+  OOM shape that drives the flight recorder's memory forensics);
 - a :class:`FaultPlan` — an ordered, seeded, JSON-able collection of
   faults, optionally targeted per replica name, so one plan describes a
   whole chaos scenario and the SAME plan replays the SAME scenario;
@@ -50,11 +52,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Fault", "FaultPlan", "FaultyEngine", "FAULT_KINDS",
            "TransientDispatchError", "StreamCorruption",
-           "FaultInjectionError"]
+           "InjectedAllocationError", "FaultInjectionError"]
 
 #: the typed fault vocabulary (docs/RESILIENCE.md taxonomy table)
 FAULT_KINDS = ("crash", "stall", "slow", "dispatch_error", "warmup_fail",
-               "garble")
+               "garble", "alloc_fail")
 
 
 class FaultInjectionError(RuntimeError):
@@ -69,6 +71,16 @@ class TransientDispatchError(FaultInjectionError):
     may succeed.  The gateway's resilience layer catches exactly this
     class for its retry/backoff/circuit-breaker path; anything else an
     engine raises stays a structural (non-retryable) failure."""
+
+
+class InjectedAllocationError(FaultInjectionError, MemoryError):
+    """An injected device-allocation failure (the OOM shape).  Raised
+    from ``step()`` BEFORE the inner engine runs — the tick's allocation
+    "failed", no tokens moved.  Subclasses :class:`MemoryError` so the
+    crash flight-recorder's OOM-forensics path (``telemetry_memory``'s
+    ``forensics()`` section in :meth:`FlightRecorder.dump`) exercises
+    under chaos exactly as it would under a real allocator failure,
+    while tests can still assert the chaos-layer origin."""
 
 
 class StreamCorruption(FaultInjectionError):
@@ -94,7 +106,12 @@ class Fault:
       (time-independent: warmup happens before traffic);
     - ``garble``: ``count`` — at most N corruption events (each one
       raises :class:`StreamCorruption` after the tick's partial
-      delivery).
+      delivery);
+    - ``alloc_fail``: ``count`` — at most N injected allocation
+      failures (each ``step()`` in the window raises
+      :class:`InjectedAllocationError` before the inner engine runs —
+      the OOM shape the flight recorder's forensics dump is tested
+      against).
 
     ``replica=None`` matches every replica; a name targets one (the
     :meth:`FaultPlan.for_replica` selector)."""
@@ -308,6 +325,11 @@ class FaultyEngine:
                 if tr is not None and hasattr(tr, "tick"):
                     tr.tick(type(self.engine).__name__, 0.0, slow=True)
                 return
+        alloc = self._active("alloc_fail", now)
+        if alloc is not None and self._consume(alloc):
+            self._note("alloc_fail")
+            raise InjectedAllocationError(
+                f"injected allocation failure (t={now:g})")
         garble = self._active("garble", now)
         fire_garble = (garble is not None and self._pending_inner()
                        and self._consume(garble))

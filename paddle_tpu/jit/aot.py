@@ -242,6 +242,11 @@ class ExecutableCache:
                                json.dumps(manifest, indent=2,
                                           sort_keys=True).encode())
             self._mem[digest] = compiled
+        # serialized-blob bytes feed the memory ledger's `executables`
+        # pool (a host-side proxy for compiled-program size) — one
+        # attribute check when no ledger is active
+        from ..telemetry_memory import account_bytes
+        account_bytes("executables", len(blob), space="host")
         return True
 
     def get(self, key, mesh=None):
@@ -291,6 +296,9 @@ class ExecutableCache:
         with self._lock:
             self._mem[digest] = compiled
             self.hits_disk += 1
+        # a disk restore brings the blob into process memory too
+        from ..telemetry_memory import account_bytes
+        account_bytes("executables", len(blob), space="host")
         return compiled
 
     def contains(self, key) -> bool:

@@ -210,6 +210,13 @@ def make_train_step(layer, loss_fn, optimizer, donate: bool = True,
                 **scaler_state, **comm_state}, (loss, out)
 
     from ..telemetry import instrument_train_step
+    from ..telemetry_memory import current_memory_ledger
+    _ml = current_memory_ledger()
+    if _ml is not None:
+        # allocation-site registration: the initial state's pools are
+        # attributable before the first step (instrument_train_step
+        # re-registers the fresh state after each donated rebuild)
+        _ml.register_train_state(state0, name="train_step")
     return instrument_train_step(_tracks_compiled_calls(step), monitor,
                                  "train_step",
                                  comm=comm_info(params0, policy)), state0
@@ -263,6 +270,10 @@ def make_accum_train_step(layer, loss_fn, optimizer, accum_steps: int,
         return new_state, (loss, out)
 
     from ..telemetry import instrument_train_step
+    from ..telemetry_memory import current_memory_ledger
+    _ml = current_memory_ledger()
+    if _ml is not None:
+        _ml.register_train_state(state0, name="accum_train_step")
     comm = comm_info(params0, policy)
     if comm is not None:
         # the exchange only runs every accum_steps-th call — amortize so

@@ -54,6 +54,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
+from .telemetry_memory import current_memory_ledger
 from .utils.stats import StatRegistry, prometheus_text as _prometheus_text
 
 __all__ = ["KVPage", "TieredKVStore", "PageMigration", "chain_hex"]
@@ -241,26 +242,29 @@ class TieredKVStore:
             raise TypeError(f"put() wants a KVPage, got "
                             f"{type(page).__name__}")
         with self._lock:
-            self._stats.add("puts")
-            if page.nbytes > self.dram_capacity_bytes:
-                # same same-chain cleanup as the normal path: a stale
-                # DRAM copy left behind would SHADOW the fresh disk
-                # page on every later lookup
+            try:
+                self._stats.add("puts")
+                if page.nbytes > self.dram_capacity_bytes:
+                    # same same-chain cleanup as the normal path: a stale
+                    # DRAM copy left behind would SHADOW the fresh disk
+                    # page on every later lookup
+                    old = self._dram.pop(page.chain, None)
+                    if old is not None:
+                        self._dram_bytes -= old.nbytes
+                    if self._spill_to_disk(page):
+                        return "disk"
+                    self._stats.add("evictions_dram")
+                    return "dropped"
                 old = self._dram.pop(page.chain, None)
                 if old is not None:
                     self._dram_bytes -= old.nbytes
-                if self._spill_to_disk(page):
-                    return "disk"
-                self._stats.add("evictions_dram")
-                return "dropped"
-            old = self._dram.pop(page.chain, None)
-            if old is not None:
-                self._dram_bytes -= old.nbytes
-            self._drop_disk(page.chain)       # DRAM copy supersedes disk
-            self._dram[page.chain] = page
-            self._dram_bytes += page.nbytes
-            self._enforce_dram()
-            return "dram"
+                self._drop_disk(page.chain)   # DRAM copy supersedes disk
+                self._dram[page.chain] = page
+                self._dram_bytes += page.nbytes
+                self._enforce_dram()
+                return "dram"
+            finally:
+                self._sync_memory()
 
     def _enforce_dram(self):
         while self._dram_bytes > self.dram_capacity_bytes and self._dram:
@@ -361,6 +365,7 @@ class TieredKVStore:
                 self._remove_file(path)
                 self._stats.add("corrupt_pages")
                 self._stats.add("misses")
+                self._sync_memory()
                 return None
             if frozen is not None and page.meta != frozen:
                 self._stats.add("meta_mismatches")
@@ -384,6 +389,7 @@ class TieredKVStore:
             self._emit("promote", chain=chain_hex(chain),
                        bytes=page.nbytes)
             self._enforce_dram()
+            self._sync_memory()
             return page
 
     def tier_of(self, chain) -> Optional[str]:
@@ -416,6 +422,7 @@ class TieredKVStore:
                 self._dram_bytes -= page.nbytes
             had_disk = chain in self._disk
             self._drop_disk(chain)
+            self._sync_memory()
             return page is not None or had_disk
 
     # -------------------------------------------------------- telemetry --
@@ -424,6 +431,20 @@ class TieredKVStore:
         if self.tracer is None:
             return
         self.tracer.emit("kvstore", what=what, **fields)
+
+    def _sync_memory(self):
+        """Mirror the tier byte totals into the active memory ledger
+        (``telemetry_memory``): every tier transition resyncs the
+        ``kv_pages`` host pool and its dram/disk tier counters as
+        absolute values, so the ledger cannot drift from the store's own
+        accounting.  One attribute check when no ledger is active."""
+        ml = current_memory_ledger()
+        if ml is None:
+            return
+        ml.set_bytes("kv_pages", self._dram_bytes, space="host",
+                     tier="dram")
+        ml.set_bytes("kv_pages", self._disk_bytes, space="host",
+                     tier="disk")
 
     def counters(self) -> Dict[str, float]:
         return dict(self._stats.snapshot())

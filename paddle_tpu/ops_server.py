@@ -62,6 +62,13 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     index) plus any directly attached
     :class:`~paddle_tpu.kv_store.TieredKVStore` snapshots (404 when
     nothing KV-tiered is attached).
+``GET /memory``
+    the attached :class:`~paddle_tpu.telemetry_memory.MemoryLedger`
+    snapshot(s): per-pool live/peak bytes in device and host space, KV
+    tier bytes, per-device totals from the last census, and the
+    watermark-crossing tail (404 when none is attached).  A pure read —
+    it never runs a census; callers decide when the live-array walk
+    happens.
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -219,12 +226,22 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/memory":
+                payload = ops._render_memory()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no memory ledger attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
-                      "/resilience", "/slo", "/autoscaler", "/kvstore"]}),
+                      "/resilience", "/slo", "/autoscaler", "/kvstore",
+                      "/memory"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -273,6 +290,7 @@ class OpsServer:
         self._slos: List[Tuple[str, Any]] = []      # SLOMonitor
         self._autoscalers: List[Tuple[str, Any]] = []
         self._kvstores: List[Tuple[str, Any]] = []  # TieredKVStore
+        self._memories: List[Tuple[str, Any]] = []  # MemoryLedger
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -283,6 +301,8 @@ class OpsServer:
         """Attach a telemetry source; kind is detected:
 
         - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
+        - ``MemoryLedger`` (has ``memory_snapshot``) → /memory +
+          /metrics pool/watermark byte gauges;
         - ``ElasticAutoscaler`` (has ``autoscaler_snapshot``) →
           /autoscaler + /metrics fleet/decision gauges;
         - ``ServingGateway`` (has ``gateway_snapshot``) → /gateway +
@@ -322,6 +342,11 @@ class OpsServer:
                 # TieredKVStore: /kvstore + its gauges on /metrics
                 self._kvstores.append(
                     (name or f"kvstore{len(self._kvstores)}", obj))
+            elif hasattr(obj, "memory_snapshot"):
+                # MemoryLedger: checked before the RunLedger shape — both
+                # expose prometheus_text, only this one serves /memory
+                self._memories.append(
+                    (name or f"memory{len(self._memories)}", obj))
             elif hasattr(obj, "snapshot") and hasattr(obj, "record"):
                 self._ledgers.append(
                     (name or f"ledger{len(self._ledgers)}", obj))
@@ -410,10 +435,11 @@ class OpsServer:
         with self._lock:
             slos = list(self._slos)
             kvstores = list(self._kvstores)
+            memories = list(self._memories)
         parts = []
         for _name, obj in tracers + engines:
             parts.append(obj.prometheus_text())
-        for _name, led in ledgers:
+        for _name, led in ledgers + memories:
             parts.append(led.prometheus_text())
         for _name, slo in slos:
             parts.append(slo.prometheus_text())
@@ -457,6 +483,15 @@ class OpsServer:
         if len(ledgers) == 1:
             return ledgers[0][1].snapshot()
         return {name: led.snapshot() for name, led in ledgers}
+
+    def _render_memory(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            memories = list(self._memories)
+        if not memories:
+            return None
+        if len(memories) == 1:
+            return memories[0][1].memory_snapshot()
+        return {name: ml.memory_snapshot() for name, ml in memories}
 
     def _render_gateway(self) -> Optional[Dict[str, Any]]:
         with self._lock:

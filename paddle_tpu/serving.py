@@ -344,6 +344,7 @@ class ContinuousBatchingEngine:
         self._compile_hits = 0
         self._compile_misses = 0
         self._tick_note: Dict[str, object] = {}
+        self._memory = None          # telemetry_memory.MemoryLedger
 
     def _alloc_caches(self):
         """Cache storage seam: the contiguous engine allocates one
@@ -443,6 +444,35 @@ class ContinuousBatchingEngine:
                 "tracer=Tracer() — the ledger consumes its event stream")
         self.tracer.set_ledger(ledger)
         return ledger
+
+    def attach_memory(self, ledger):
+        """Register this engine's device arrays with a
+        ``telemetry_memory.MemoryLedger``: params → the ``params`` pool,
+        the KV caches → ``kv_pages`` (the hbm tier of the census).
+        ``metrics()`` then carries ``memory_device_bytes`` /
+        ``memory_host_bytes``.  Tick programs rebuild the caches
+        functionally, so their registration goes stale between ticks —
+        call :meth:`refresh_memory` before a census (the bench/ops
+        pattern); steady-state ticks stay untouched."""
+        self._memory = ledger
+        if self.tracer is not None and getattr(ledger, "_tracer", None) \
+                is None:
+            ledger.set_tracer(self.tracer)
+        self.refresh_memory()
+        return ledger
+
+    def refresh_memory(self):
+        """Re-register params + current KV caches with the attached
+        memory ledger (no-op without one — one attribute check)."""
+        ml = self._memory
+        if ml is None:
+            return
+        ml.register_tree("params", self.params,
+                         name=f"engine{id(self)}.params")
+        caches = getattr(self, "caches", None)
+        if caches is not None:
+            ml.register_tree("kv_pages", caches,
+                             name=f"engine{id(self)}.kv")
 
     def _note(self, key: str, value=1):
         """Accumulate one per-tick telemetry field (no-op when tracing is
@@ -1247,6 +1277,9 @@ class ContinuousBatchingEngine:
         "compile_hits": ("counter", int),
         "compile_misses": ("counter", int),
         "step_errors": ("counter", int),
+        # present only with attach_memory(MemoryLedger):
+        "memory_device_bytes": ("gauge", float),
+        "memory_host_bytes": ("gauge", float),
     }
 
     @classmethod
@@ -1273,15 +1306,20 @@ class ContinuousBatchingEngine:
         n = max(nreq, 1)
         toks = int(s.value("tokens_emitted"))
         dt = max(time.monotonic() - self._started, 1e-9)
-        return {"requests_finished": nreq,
-                "requests_cancelled": int(s.value("requests_cancelled")),
-                "tokens_emitted": toks,
-                "mean_ttft_s": float(s.value("ttft_seconds_sum")) / n,
-                "mean_latency_s": float(s.value("latency_seconds_sum")) / n,
-                "tokens_per_sec": toks / dt,
-                "compile_hits": self._compile_hits,
-                "compile_misses": self._compile_misses,
-                "step_errors": int(s.value("step_errors"))}
+        out = {"requests_finished": nreq,
+               "requests_cancelled": int(s.value("requests_cancelled")),
+               "tokens_emitted": toks,
+               "mean_ttft_s": float(s.value("ttft_seconds_sum")) / n,
+               "mean_latency_s": float(s.value("latency_seconds_sum")) / n,
+               "tokens_per_sec": toks / dt,
+               "compile_hits": self._compile_hits,
+               "compile_misses": self._compile_misses,
+               "step_errors": int(s.value("step_errors"))}
+        if self._memory is not None:
+            totals = self._memory.memory_snapshot()["totals"]
+            out["memory_device_bytes"] = float(totals["device_bytes"])
+            out["memory_host_bytes"] = float(totals["host_bytes"])
+        return out
 
     def prometheus_text(self, namespace: str = "paddle_tpu_serving") -> str:
         """Prometheus text exposition of this engine's registry plus the

@@ -1349,34 +1349,22 @@ class TrainMonitor:
 
     # -------------------------------------------------------- HBM census --
     def hbm_census(self, params=None, opt=None) -> Dict[str, int]:
-        """Live-array byte census: every ``jax.live_arrays()`` entry is
-        classified param / opt-state / other by identity against the passed
-        pytrees (logical bytes — size × itemsize; sharded arrays count
-        their global shape).  Gauges land on the registry with
-        ``set_max``-tracked peaks; returns the census dict."""
-        import jax
-        import numpy as np
+        """Live-array byte census: every live array is classified param /
+        opt-state / other by identity against the passed pytrees (logical
+        bytes — size × itemsize; sharded arrays count their global
+        shape).  The raw ``jax.live_arrays()`` walk lives in
+        ``telemetry_memory.live_array_census`` — the single accounting
+        point (tpulint ``raw-memory-introspection``).  Gauges land on the
+        registry with ``set_max``-tracked peaks; returns the census
+        dict."""
+        from .telemetry_memory import live_array_census
 
-        def _ids(tree):
-            return {id(l) for l in jax.tree_util.tree_leaves(tree)
-                    if hasattr(l, "dtype")}
-
-        pid, oid = _ids(params), _ids(opt)
-        counts = {"params_bytes": 0, "opt_bytes": 0, "other_bytes": 0}
-        n_arrays = 0
-        for a in jax.live_arrays():
-            if getattr(a, "is_deleted", lambda: False)():
-                continue
-            n_arrays += 1
-            b = int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize \
-                if a.shape else np.dtype(a.dtype).itemsize
-            if id(a) in pid:
-                counts["params_bytes"] += b
-            elif id(a) in oid:
-                counts["opt_bytes"] += b
-            else:
-                counts["other_bytes"] += b
-        total = sum(counts.values())
+        walk = live_array_census({"params": params, "opt": opt})
+        counts = {"params_bytes": walk["params_bytes"],
+                  "opt_bytes": walk["opt_bytes"],
+                  "other_bytes": walk["other_bytes"]}
+        n_arrays = walk["arrays"]
+        total = walk["total_bytes"]
         reg = self.registry
         for k, v in counts.items():
             reg.set(f"hbm_{k}", v)
@@ -1581,16 +1569,31 @@ def instrument_train_step(step: Callable, monitor: Optional[TrainMonitor],
 
     ``comm``: optional ``{"policy", "pre_bytes", "post_bytes"}`` dict (a
     ``grad_comm`` policy's wire estimate for one step's reduction) — each
-    steady-state call additionally records a ``comm`` accounting event."""
+    steady-state call additionally records a ``comm`` accounting event.
+
+    With an active ``telemetry_memory.MemoryLedger`` the fresh state is
+    re-registered after every call (donated state is rebuilt each step,
+    so the previous ids go stale) — one ``is None`` check when no ledger
+    is active, a tree flatten when one is."""
     if monitor is None:
         return step
     import jax
     first = [True]
 
+    def _reregister_state(out):
+        from .telemetry_memory import current_memory_ledger
+        ml = current_memory_ledger()
+        if ml is None:
+            return
+        state = out[0] if isinstance(out, tuple) and out else out
+        if isinstance(state, dict):
+            ml.register_train_state(state, name=name)
+
     @functools.wraps(step)
     def wrapped(*args, **kwargs):
         t0 = time.perf_counter()
         out = step(*args, **kwargs)
+        _reregister_state(out)
         if first[0]:
             # the first call pays trace + XLA compile inside its dispatch
             # (jit blocks through compilation) — it becomes ONLY the compile

@@ -394,10 +394,14 @@ class FlightRecorder:
 
     def add_source(self, obj, name: Optional[str] = None) -> "FlightRecorder":
         """Attach a dump source: a ``Tracer``/``TrainMonitor`` (anything
-        with ``dump_jsonl``), a ``RunLedger`` (``to_dict``), or a
-        ``ServingGateway`` (``gateway_snapshot`` — the dump then carries
-        replica/queue state and, with a resilience policy, the breaker
-        and brownout state the crash happened under)."""
+        with ``dump_jsonl``), a ``RunLedger`` or ``telemetry_memory
+        .MemoryLedger`` (``to_dict``), or a ``ServingGateway``
+        (``gateway_snapshot`` — the dump then carries replica/queue state
+        and, with a resilience policy, the breaker and brownout state the
+        crash happened under).  Sources exposing ``forensics()`` (the
+        memory ledger) additionally get an OOM-forensics section —
+        ``<name>-forensics.json`` with top pools, recent growth, and the
+        largest live arrays with tree paths."""
         if not (hasattr(obj, "dump_jsonl") or hasattr(obj, "to_dict")
                 or hasattr(obj, "gateway_snapshot")):
             raise TypeError(f"unsupported flight-recorder source: {obj!r}")
@@ -509,6 +513,13 @@ class FlightRecorder:
                         with open(os.path.join(out, f"{name}.json"),
                                   "w") as f:
                             json.dump(src.to_dict(), f)
+                    if hasattr(src, "forensics"):
+                        # the OOM post-mortem section: small, human-first
+                        # (top pools / recent growth / largest arrays),
+                        # separate from the full series payload above
+                        with open(os.path.join(
+                                out, f"{name}-forensics.json"), "w") as f:
+                            json.dump(src.forensics(), f, indent=2)
                 except Exception as e:
                     self._log.warning("flight recorder: source %s failed "
                                       "to dump: %s", name, e)

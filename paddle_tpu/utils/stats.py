@@ -9,8 +9,8 @@ mechanism for everything that counts (the serving engines keep a private
 ``paddle_tpu.telemetry`` exports either).  An op-summary view joins the
 profiler's RecordEvent timings.  TPU-native notes: device-side numbers
 (memory in use, per-op time) come from XLA/JAX introspection rather than a
-CUDA allocator hook — ``device_memory_stats`` reads
-``jax.local_devices()[i].memory_stats()``.
+CUDA allocator hook — ``device_memory_stats`` delegates to the memory
+ledger's ``device_allocator_stats`` (the single accounting point).
 """
 
 from __future__ import annotations
@@ -290,13 +290,13 @@ def prometheus_text(registry: Optional[StatRegistry] = None,
 
 def device_memory_stats(device_index: int = 0) -> Dict[str, int]:
     """Per-device allocator stats from the PJRT client (≙ the reference's
-    STAT_gpu0_mem_size family fed by the CUDA allocator)."""
-    import jax
-    devs = jax.local_devices()
-    if device_index >= len(devs):
-        return {}
-    stats = devs[device_index].memory_stats() or {}
-    return {k: int(v) for k, v in stats.items()}
+    STAT_gpu0_mem_size family fed by the CUDA allocator).  Delegates to
+    ``telemetry_memory.device_allocator_stats`` — the memory ledger is
+    the single accounting point for raw ``memory_stats()`` calls
+    (tpulint ``raw-memory-introspection``); lazy import, stats stays a
+    leaf module."""
+    from ..telemetry_memory import device_allocator_stats
+    return device_allocator_stats(device_index)
 
 
 def op_summary(top: int = 20) -> List[Tuple[str, int, float]]:

@@ -1,0 +1,426 @@
+"""Memory ledger (ISSUE 17): exhaustive byte attribution for device and
+host pools, the way the goodput ledger attributes wall clock.
+
+The tentpole contracts under test:
+
+- **conservation** — one ``census()`` classifies every live array by
+  identity; the residual lands in ``other``, so ``sum(pool device
+  bytes) == census total`` holds BY CONSTRUCTION (over- and
+  under-registration stay visible, never silently clipped);
+- **watermarks** — per-pool and per-space peaks are monotone, and every
+  crossing lands in the bounded ring (and the Tracer, when attached);
+- **surfaces** — ``GET /memory`` beside ``/ledger``, pool gauges merged
+  into ``/metrics``, engine ``metrics()`` conditional keys, chrome
+  counter tracks, and the flight recorder's ``*-forensics.json`` OOM
+  section under a faults.py-injected allocation failure;
+- **zero-cost-off** — no active ledger by default, every seam is one
+  ``is None`` check, and the compiled train step is byte-identical with
+  and without an active ledger (the PR 2 telemetry-off parity pin).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import telemetry_memory as tm
+from paddle_tpu.faults import (Fault, FaultPlan, FaultyEngine,
+                               InjectedAllocationError)
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.kv_store import KVPage, TieredKVStore
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.telemetry import Tracer, TrainMonitor
+from paddle_tpu.telemetry_ledger import FlightRecorder
+from paddle_tpu.telemetry_memory import (MemoryLedger, account_bytes,
+                                         current_memory_ledger)
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read().decode())
+
+
+def _tiny_step(monitor=None, seed=0):
+    paddle.seed(seed)
+    layer = nn.Linear(4, 3)
+    step, state = make_train_step(layer, nn.MSELoss(),
+                                  Momentum(learning_rate=0.1, momentum=0.9),
+                                  donate=False, monitor=monitor)
+    x = jnp.ones((8, 4))
+    y = jnp.zeros((8, 3))
+    return step, state, (jax.random.key(0), np.float32(0.1), [x], [y])
+
+
+# ---------------------------------------------------------------------------
+# the ledger in isolation
+# ---------------------------------------------------------------------------
+
+class TestConservation:
+    def test_sum_of_pools_equals_census_total(self):
+        """THE invariant: pool device bytes sum exactly to the census
+        total, with unregistered arrays visible in ``other``."""
+        ml = MemoryLedger()
+        params = {"w": jnp.ones((64, 64)), "b": jnp.ones((64,))}
+        stray = jnp.ones((32, 32))          # deliberately unregistered
+        ml.register_tree("params", params)
+        walk = ml.census()
+        assert sum(walk["pools"].values()) == walk["total_bytes"]
+        assert walk["pools"]["params"] >= 64 * 64 * 4
+        # the stray array is live and classified, just not registered
+        assert walk["pools"]["other"] >= stray.nbytes
+        snap = ml.memory_snapshot()
+        assert sum(p["device_bytes"] for p in snap["pools"].values()) \
+            == snap["totals"]["device_bytes"]
+
+    def test_reregistration_replaces_and_unregister_demotes(self):
+        ml = MemoryLedger()
+        a = jnp.ones((16, 16))
+        ml.register_tree("params", {"a": a}, name="t")
+        assert ml.census()["pools"]["params"] >= a.nbytes
+        ml.unregister_tree("t")
+        walk = ml.census()
+        # same bytes, now honest residual
+        assert walk["pools"]["params"] == 0
+        assert walk["pools"]["other"] >= a.nbytes
+
+    def test_register_train_state_key_table(self):
+        ml = MemoryLedger()
+        state = {"params": {"w": jnp.ones((8,))},
+                 "opt": {"m": jnp.ones((8,))},
+                 "comm_e": jnp.ones((4,))}
+        ml.register_train_state(state, name="s")
+        walk = ml.census()
+        assert walk["pools"]["params"] >= 32
+        assert walk["pools"]["optimizer_state"] >= 32
+        assert walk["pools"]["grads_comm_buffers"] >= 16
+        # a re-registered state WITHOUT comm_e drops the stale bucket
+        ml.register_train_state({"params": state["params"],
+                                 "opt": state["opt"]}, name="s")
+        assert ml.census()["pools"]["grads_comm_buffers"] == 0
+
+    def test_rejects_other_and_unknown_pools(self):
+        ml = MemoryLedger()
+        with pytest.raises(ValueError, match="unknown pool"):
+            ml.register_tree("other", {})
+        with pytest.raises(ValueError, match="unknown pool"):
+            ml.account("nope", 1)
+        with pytest.raises(ValueError, match="unknown kv tier"):
+            ml.account("kv_pages", 1, tier="l2")
+
+
+class TestWatermarks:
+    def test_peaks_are_monotone_and_ring_records_crossings(self):
+        ml = MemoryLedger()
+        ml.account("executables", 1000)
+        ml.account("executables", -600)
+        ml.account("executables", 100)      # 500 live, below the 1000 peak
+        snap = ml.memory_snapshot()
+        assert snap["pools"]["executables"]["host_bytes"] == 500
+        assert snap["pools"]["executables"]["host_peak_bytes"] == 1000
+        ml.account("executables", 5000)     # new watermark
+        snap2 = ml.memory_snapshot()
+        assert snap2["pools"]["executables"]["host_peak_bytes"] == 5500
+        # peaks never decrease across any sequence of accounts
+        assert snap2["pools"]["executables"]["host_peak_bytes"] \
+            >= snap["pools"]["executables"]["host_peak_bytes"]
+        assert snap2["totals"]["host_peak_bytes"] >= \
+            snap["totals"]["host_peak_bytes"]
+        crossings = snap2["watermarks"]
+        assert crossings, "watermark ring is empty"
+        for ev in crossings:
+            assert ev["bytes"] > ev["prev_bytes"]
+
+    def test_release_below_zero_clamps_with_warning(self, caplog):
+        ml = MemoryLedger()
+        with caplog.at_level("WARNING"):
+            ml.account("executables", -100)
+        assert ml.memory_snapshot()["pools"]["executables"]["host_bytes"] \
+            == 0
+        assert any("below zero" in r.message for r in caplog.records)
+
+    def test_watermark_emits_tracer_event(self):
+        tr = Tracer()
+        ml = MemoryLedger(tracer=tr)
+        ml.account("kv_pages", 4096, tier="dram")
+        kinds = [e["kind"] for e in tr.events()]
+        assert "memory" in kinds
+        ev = [e for e in tr.events() if e["kind"] == "memory"][0]
+        assert ev["what"] == "watermark" and ev["pool"] == "kv_pages"
+
+
+class TestTiersAndSeams:
+    def test_set_bytes_tiers_sum_into_kv_pool(self):
+        ml = MemoryLedger()
+        ml.set_bytes("kv_pages", 1000, tier="dram")
+        ml.set_bytes("kv_pages", 300, tier="disk")
+        ml.set_bytes("kv_pages", 700, tier="dram")    # absolute resync down
+        snap = ml.memory_snapshot()
+        assert snap["kv_tiers"]["dram"]["bytes"] == 700
+        assert snap["kv_tiers"]["dram"]["peak_bytes"] == 1000
+        assert snap["kv_tiers"]["disk"]["bytes"] == 300
+        assert snap["pools"]["kv_pages"]["host_bytes"] == 1000
+
+    def test_kv_store_mutations_resync_tier_bytes(self):
+        ml = MemoryLedger()
+        with ml:
+            st = TieredKVStore(dram_capacity_bytes=1 << 20)
+            pg = KVPage(b"k" * 32, (np.ones((64,), np.float32),), ["m"])
+            st.put(pg)
+            assert ml.memory_snapshot()["kv_tiers"]["dram"]["bytes"] \
+                == pg.nbytes
+            st.drop(pg.chain)
+            assert ml.memory_snapshot()["kv_tiers"]["dram"]["bytes"] == 0
+            assert ml.memory_snapshot()["kv_tiers"]["dram"]["peak_bytes"] \
+                == pg.nbytes
+
+    def test_active_ledger_protocol(self):
+        assert current_memory_ledger() is None
+        ml = MemoryLedger()
+        with ml:
+            assert current_memory_ledger() is ml
+            inner = MemoryLedger()
+            with inner:
+                assert current_memory_ledger() is inner
+            assert current_memory_ledger() is ml
+        assert current_memory_ledger() is None
+        account_bytes("executables", 123)    # no active ledger: a no-op
+        assert ml.memory_snapshot()["pools"]["executables"]["host_bytes"] \
+            == 0
+
+
+# ---------------------------------------------------------------------------
+# exports: prometheus round-trip, chrome counters, /memory endpoint
+# ---------------------------------------------------------------------------
+
+class TestExports:
+    def test_prometheus_round_trip_preserves_conservation(self):
+        ml = MemoryLedger()
+        ml.register_tree("params", {"w": jnp.ones((32, 32))})
+        ml.census()
+        ml.account("executables", 2048)
+        text = ml.prometheus_text()
+        gauges = {}
+        for line in text.splitlines():
+            if line.startswith("#") or not line.strip():
+                continue
+            name, val = line.rsplit(" ", 1)
+            gauges[name] = float(val)
+        total = gauges["paddle_tpu_memory_total_device_bytes"]
+        summed = sum(v for k, v in gauges.items()
+                     if k.endswith("_device_bytes")
+                     and not k.endswith("peak_bytes")
+                     and "total" not in k and "kv_" not in k)
+        assert summed == total
+        assert gauges["paddle_tpu_memory_executables_host_bytes"] == 2048
+        assert gauges["paddle_tpu_memory_watermark_events_total"] >= 1
+        assert gauges["paddle_tpu_memory_census_runs_total"] == 1
+
+    def test_chrome_counters_one_track_per_space(self):
+        ml = MemoryLedger()
+        ml.account("executables", 100)
+        ml.register_tree("params", {"w": jnp.ones((8,))})
+        ml.census()
+        evs = ml.to_chrome_counters()
+        counters = [e for e in evs if e.get("ph") == "C"]
+        assert counters
+        names = {e["name"] for e in counters}
+        assert names <= {"device_memory_bytes", "host_memory_bytes"}
+        assert all("args" in e and "ts" in e for e in counters)
+        # the offline twin consumes dump_json output identically
+        offline = tm.chrome_counters_from_memory_dump(ml.to_dict())
+        assert [e for e in offline if e.get("ph") == "C"]
+
+    def test_memory_endpoint_schema_and_metrics_merge(self):
+        ml = MemoryLedger()
+        ml.register_tree("params", {"w": jnp.ones((16, 16))})
+        ml.census()
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer().attach(ml, name="mem")
+        url = srv.start()
+        try:
+            status, body = _get_json(url + "/memory")
+            assert status == 200
+            for key in ("pools", "kv_tiers", "totals", "per_device",
+                        "census", "counts", "watermarks"):
+                assert key in body, key
+            for pool, row in body["pools"].items():
+                assert set(row) == {"device_bytes", "host_bytes",
+                                    "device_peak_bytes", "host_peak_bytes"}
+            assert sum(r["device_bytes"] for r in body["pools"].values()) \
+                == body["totals"]["device_bytes"]
+            with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+                text = r.read().decode()
+            assert "paddle_tpu_memory_params_device_bytes" in text
+        finally:
+            srv.stop()
+
+    def test_memory_endpoint_404_without_ledger(self):
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer().attach(Tracer(), name="t")
+        url = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/memory", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+import urllib.error  # noqa: E402  (used by the 404 test above)
+
+
+# ---------------------------------------------------------------------------
+# trainer seam: builder registration + per-step re-registration
+# ---------------------------------------------------------------------------
+
+class TestTrainerSeam:
+    def test_builder_registers_state_and_steps_reregister(self):
+        ml = MemoryLedger()
+        with ml:
+            step, state, args = _tiny_step(monitor=TrainMonitor())
+            walk = ml.census()
+            assert walk["pools"]["params"] > 0
+            assert walk["pools"]["optimizer_state"] > 0
+            state, _ = step(state, *args)
+            # the donated/rebuilt state re-registered: the fresh ids
+            # classify, so a census right after a step stays attributed
+            walk2 = ml.census()
+            assert walk2["pools"]["params"] > 0
+            assert walk2["pools"]["optimizer_state"] > 0
+
+    def test_forensics_names_largest_arrays_with_paths(self):
+        ml = MemoryLedger()
+        big = {"w": jnp.ones((128, 128))}
+        ml.register_tree("params", big, name="model")
+        ml.census()
+        f = ml.forensics()
+        assert f["largest_arrays"], "no largest-array rows"
+        top = f["largest_arrays"][0]
+        assert top["pool"] in ("params", "other")
+        named = [r for r in f["largest_arrays"] if r["pool"] == "params"]
+        assert named and named[0]["path"]      # tree path survives
+        assert f["top_pools"] and f["allocator"] is not None
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics: injected allocation failure -> flight-recorder section
+# ---------------------------------------------------------------------------
+
+class _TickEngine:
+    def step(self):
+        return None
+
+    def pending(self):
+        return False
+
+
+class TestOOMForensics:
+    def test_alloc_fail_dump_carries_forensics_section(self, tmp_path):
+        """The OOM post-mortem path end to end: a faults.py-injected
+        allocation failure raises a real ``MemoryError``; the crash dump
+        gains ``<name>-forensics.json`` with top pools, recent growth,
+        and the largest arrays (with tree paths) — the evidence an OOM
+        debug needs, captured at death."""
+        ml = MemoryLedger()
+        ml.register_tree("params", {"w": jnp.ones((64, 64))}, name="m")
+        ml.census()
+        ml.account("kv_pages", 1 << 19, tier="dram")   # growth to report:
+        ml.account("kv_pages", 1 << 19, tier="dram")   # two samples delta
+        fr = FlightRecorder(str(tmp_path / "crash"), sources=[ml])
+        eng = FaultyEngine(_TickEngine(),
+                           FaultPlan([Fault("alloc_fail", count=1)]),
+                           clock=lambda: 1.0)
+        with pytest.raises(MemoryError) as ei:
+            eng.step()
+        assert isinstance(ei.value, InjectedAllocationError)
+        assert fr.dump(f"oom: {ei.value}") is not None
+        dumps = list((tmp_path / "crash").glob("crash-*"))
+        assert len(dumps) == 1
+        payload = json.loads(
+            (dumps[0] / "memoryledger0-forensics.json").read_text())
+        assert payload["top_pools"]
+        assert any(r["pool"] == "kv_pages" for r in payload["top_pools"])
+        growth = {(g["space"], g["pool"]): g["delta_bytes"]
+                  for g in payload["recent_growth"]}
+        assert growth.get(("host", "kv_pages"), 0) >= 1 << 19
+        assert payload["largest_arrays"]
+        assert payload["watermarks"]
+        # the full ledger payload rides beside it, kind-tagged
+        full = json.loads((dumps[0] / "memoryledger0.json").read_text())
+        assert full["kind"] == "memory" and full["series"]
+        # the injected fault is honest about itself
+        assert eng.injected()[0]["kind"] == "alloc_fail"
+        # count=1: the next tick is healthy again
+        assert eng.step() is None
+
+    def test_alloc_fail_never_touches_inner_engine(self):
+        calls = []
+
+        class Probe(_TickEngine):
+            def step(self):
+                calls.append(1)
+
+        eng = FaultyEngine(Probe(),
+                           FaultPlan([Fault("alloc_fail", count=2)]),
+                           clock=lambda: 0.0)
+        for _ in range(2):
+            with pytest.raises(InjectedAllocationError):
+                eng.step()
+        assert calls == []                  # the "allocation" failed first
+        eng.step()
+        assert calls == [1]
+
+
+# ---------------------------------------------------------------------------
+# zero-cost-off parity (the PR 2 pin, extended to the memory plane)
+# ---------------------------------------------------------------------------
+
+class TestOffPathParity:
+    def test_no_active_ledger_by_default(self):
+        assert current_memory_ledger() is None
+
+    def test_identical_lowering_with_and_without_ledger(self):
+        """THE parity pin: an active memory ledger changes nothing inside
+        the jit boundary — the compiled program text is byte-identical."""
+        step_off, st, rest = _tiny_step(seed=3)
+        off = step_off.lower(st, *rest).as_text()
+        with MemoryLedger():
+            step_on, st2, rest2 = _tiny_step(seed=3)
+            on = step_on.lower(st2, *rest2).as_text()
+        assert off == on
+
+    def test_engine_metrics_memory_keys_are_conditional(self):
+        """The ``memory_*`` metrics appear ONLY after attach_memory —
+        the off path is one attribute check on a None field."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTModel
+        from paddle_tpu.serving import ContinuousBatchingEngine
+        paddle.seed(5)
+        cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                        num_attention_heads=4, max_position_embeddings=64,
+                        compute_dtype="float32")
+        model = GPTModel(cfg)
+        params = {n: p._data for n, p in model.named_parameters()}
+        eng = ContinuousBatchingEngine(model, params, max_slots=2,
+                                       max_len=32)
+        assert eng._memory is None
+        m = eng.metrics()
+        assert "memory_device_bytes" not in m
+        ml = MemoryLedger()
+        eng.attach_memory(ml)
+        ml.census()
+        m2 = eng.metrics()
+        assert m2["memory_device_bytes"] > 0     # params registered
+        assert "memory_host_bytes" in m2
+        assert "memory_device_bytes" in eng.prometheus_text()
+
+    def test_kv_store_without_ledger_accounts_nothing(self):
+        assert current_memory_ledger() is None
+        st = TieredKVStore(dram_capacity_bytes=1 << 20)
+        st.put(KVPage(b"q" * 32, (np.ones((8,), np.float32),), ["m"]))
+        assert st.lookup(b"q" * 32, meta=["m"]) is not None

@@ -30,6 +30,7 @@ BAD_FIXTURE = {
     "blocking-fetch-in-loop": "bad_blocking_fetch_in_loop.py",
     "unbounded-retry": "bad_unbounded_retry.py",
     "raw-partition-spec": "bad_raw_partition_spec.py",
+    "raw-memory-introspection": "bad_raw_memory_introspection.py",
 }
 CLEAN_FIXTURE = {rule: path.replace("bad_", "clean_")
                  for rule, path in BAD_FIXTURE.items()}

@@ -47,7 +47,17 @@ with per-phase outcome counts and resilience counters) expand the same
 way through ``_CHAOS_FIELDS`` — a drop in the resilience-on finished
 count, a rise in retries-exhausted, or a shrinking p99-TTFT improvement
 factor between rounds is a resilience regression even when the headline
-p99 held.
+p99 held.  ``memory`` attachments (the memory-ledger block bench records
+carry when their byte claims are measured) expand through
+``_MEMORY_FIELDS`` — every leaf is a byte gauge judged lower-is-better,
+so an HBM-footprint creep is flagged like a latency creep.
+
+Records also carry a ``health`` stamp (the parent's backend probe
+verdict: backend, device count/kind, ``compute_healthy``).  When the
+probe backends of a pair disagree the pair is not comparable — the
+probe actually touched the device, so it outranks the record label;
+a device-kind change or an unhealthy probe on either side prints a
+WARNING next to the row instead.
 
 Exit codes:
   0  comparable data found, no regression beyond --threshold
@@ -102,6 +112,12 @@ _KVTIER_FIELDS = {
     "warm_speedup": ("x", "higher"),
     "tier_hit_rate": ("frac", "higher"),
     "migrated_bytes": ("bytes", "lower"),
+    # measured per-tier KV bytes (the memory-ledger rows under
+    # tier_bytes/tier_peak_bytes): holding more bytes for the same
+    # scenario is a capacity regression
+    "hbm": ("bytes", "lower"),
+    "dram": ("bytes", "lower"),
+    "disk": ("bytes", "lower"),
 }
 
 #: weight-update-sharding attachment fields worth diffing (bench.py
@@ -114,11 +130,26 @@ _KVTIER_FIELDS = {
 #: and ``replicas`` are scenario context, not health signals.
 _UPDATE_SHARDING_FIELDS = {
     "opt_bytes_per_replica": ("bytes", "lower"),
+    "opt_bytes_per_replica_measured": ("bytes", "lower"),
     "opt_bytes_reduction": ("x", "higher"),
+    "opt_bytes_reduction_measured": ("x", "higher"),
     "step_ms": ("ms", "lower"),
     "wire_bytes": ("bytes", "lower"),
     "tokens_per_sec": ("tokens/s", "higher"),
     "loss_delta": ("abs", "lower"),
+}
+
+#: memory-ledger attachment fields worth diffing (the ``memory`` block a
+#: record carries when its byte claims are measured — bench.py
+#: ``_memory_block``): every leaf is a byte gauge, and holding MORE
+#: bytes for the same scenario is the regression direction.
+_MEMORY_FIELDS = {
+    "device_bytes": ("bytes", "lower"),
+    "host_bytes": ("bytes", "lower"),
+    "device_peak_bytes": ("bytes", "lower"),
+    "host_peak_bytes": ("bytes", "lower"),
+    "bytes": ("bytes", "lower"),
+    "peak_bytes": ("bytes", "lower"),
 }
 
 #: chaos-attachment fields worth diffing (bench.py gpt_chaos record
@@ -160,7 +191,8 @@ def expand_telemetry(records):
                                    ("chaos", _CHAOS_FIELDS),
                                    ("kv_tier", _KVTIER_FIELDS),
                                    ("update_sharding",
-                                    _UPDATE_SHARDING_FIELDS)):
+                                    _UPDATE_SHARDING_FIELDS),
+                                   ("memory", _MEMORY_FIELDS)):
             sub = rec.get(attachment)
             if not isinstance(sub, dict):
                 continue
@@ -178,6 +210,8 @@ def expand_telemetry(records):
                     # synthetic rows inherit the parent's backend so the
                     # cross-backend non-comparability guard covers them
                     row["backend"] = rec["backend"]
+                if isinstance(rec.get("health"), dict):
+                    row["health"] = rec["health"]
                 out.append(row)
     return out
 
@@ -287,6 +321,25 @@ def compare(old_records, new_records, threshold):
             row["status"] = f"not comparable (backend {ob} -> {nb})"
             rows.append(row)
             continue
+        oh = old.get("health") or {}
+        nh = new.get("health") or {}
+        hb_o, hb_n = oh.get("backend"), nh.get("backend")
+        if hb_o and hb_n and hb_o != hb_n:
+            # the probe's verdict contradicts the record labels — trust
+            # the probe: it actually touched the device
+            row["status"] = ("not comparable (probe backend "
+                             f"{hb_o} -> {hb_n})")
+            rows.append(row)
+            continue
+        warns = []
+        ok_o, ok_n = oh.get("device_kind"), nh.get("device_kind")
+        if ok_o and ok_n and ok_o != ok_n:
+            warns.append(f"device kind changed ({ok_o} -> {ok_n})")
+        for side, h in (("old", oh), ("new", nh)):
+            if h and h.get("compute_healthy") is False:
+                warns.append(f"{side} round's backend probe was unhealthy")
+        if warns:
+            row["warnings"] = warns
         ov, nv = float(old["value"]), float(new["value"])
         if ov == 0.0:
             row["status"] = "not comparable (old value 0)"
@@ -336,6 +389,8 @@ def _print_rows(rows, out):
               + (f" ({row['delta_frac']:+.1%})"
                  if row["delta_frac"] is not None else "")
               + f"  [{row['status']}]", file=out)
+        for w in row.get("warnings", ()):
+            print(f"  WARNING: {w}", file=out)
 
 
 def main(argv=None):

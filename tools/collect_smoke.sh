@@ -549,6 +549,63 @@ then
     echo "COLLECT SMOKE FAILED: sharding-rules / update-sharding round trip"
     exit 1
 fi
+# memory-ledger surface: telemetry_memory must import clean, one train
+# step under an active ledger must attribute params + optimizer state,
+# one census must conserve bytes (sum of pools == total, residual
+# honest in `other`), the KV-store seam must resync tier bytes, and a
+# LIVE /memory scrape beside /metrics must serve the snapshot with the
+# memory gauge family merged in
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'MEMEOF'
+import json, urllib.request
+import numpy as np
+import jax.numpy as jnp
+import jax
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.kv_store import KVPage, TieredKVStore
+from paddle_tpu.ops_server import OpsServer
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.telemetry import TrainMonitor
+from paddle_tpu.telemetry_memory import (MemoryLedger,
+                                         current_memory_ledger)
+assert current_memory_ledger() is None      # off by default
+paddle.seed(0)
+ml = MemoryLedger()
+with ml:
+    # monitor= is the re-registration seam: the donated state is rebuilt
+    # each step and the fresh ids re-registered after the call
+    step, state = make_train_step(nn.Linear(4, 3), nn.MSELoss(),
+                                  Momentum(learning_rate=0.1, momentum=0.9),
+                                  monitor=TrainMonitor())
+    state, _ = step(state, jax.random.key(0), np.float32(0.1),
+                    [jnp.ones((8, 4))], [jnp.zeros((8, 3))])
+    store = TieredKVStore()
+    store.put(KVPage(b"k" * 32, (np.ones((64,), np.float32),), ["m"]))
+walk = ml.census()
+assert sum(walk["pools"].values()) == walk["total_bytes"], walk
+assert walk["pools"]["params"] > 0 and walk["pools"]["optimizer_state"] > 0
+snap = ml.memory_snapshot()
+assert snap["kv_tiers"]["dram"]["bytes"] == 64 * 4, snap["kv_tiers"]
+assert current_memory_ledger() is None      # symmetric teardown
+srv = OpsServer()
+srv.attach(ml, name="mem")
+url = srv.start()
+live = json.loads(urllib.request.urlopen(url + "/memory",
+                                         timeout=10).read())
+assert sum(p["device_bytes"] for p in live["pools"].values()) \
+    == live["totals"]["device_bytes"], live["totals"]
+txt = urllib.request.urlopen(url + "/metrics", timeout=10).read().decode()
+assert "paddle_tpu_memory_params_device_bytes" in txt, txt[:400]
+assert "paddle_tpu_memory_total_device_bytes" in txt
+srv.stop()
+counters = [e for e in ml.to_chrome_counters() if e.get("ph") == "C"]
+assert counters, "no chrome counter events"
+MEMEOF
+then
+    echo "COLLECT SMOKE FAILED: memory-ledger round trip"
+    exit 1
+fi
 # tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
 # (exit 1, rule id + file:line printed above); a STALE baseline (violations
 # burned down but baseline not shrunk) fails with exit 3 — regenerate via

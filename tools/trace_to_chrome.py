@@ -19,8 +19,12 @@ dispatch span id, carrying the request's trace_id) still link rows
 ACROSS files, so a request's journey through the fleet draws as arrows
 in Perfetto.  ``--ledger`` merges a goodput-ledger dump
 (``RunLedger.dump_json``) as a stacked counter track — cumulative
-seconds per wall-clock bucket next to the event rows.  Open the output
-in chrome://tracing or https://ui.perfetto.dev.
+seconds per wall-clock bucket next to the event rows.  ``--memory``
+merges a memory-ledger dump (``MemoryLedger.dump_json``) as per-pool
+byte counter tracks (one per space: device HBM and host bytes stack
+separately), so a watermark crossing lines up against the tick/compile
+spans that caused it.  Open the output in chrome://tracing or
+https://ui.perfetto.dev.
 """
 
 import argparse
@@ -62,6 +66,17 @@ def _load_ledger(path):
     return {"traceEvents": chrome_counters_from_dump(data)}
 
 
+def _load_memory(path):
+    """MemoryLedger ``dump_json`` file → chrome-trace dict of counter
+    events (per-pool byte gauges, one stacked track per space)."""
+    with open(path) as f:
+        data = json.load(f)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from paddle_tpu.telemetry_memory import chrome_counters_from_memory_dump
+    return {"traceEvents": chrome_counters_from_memory_dump(data)}
+
+
 def _merge(device_payload, engine):
     """Append the engine trace's events to the device trace JSON."""
     data = json.loads(device_payload)
@@ -99,6 +114,9 @@ def main(argv=None):
     ap.add_argument("--ledger", default=None,
                     help="goodput-ledger dump (RunLedger.dump_json) to "
                          "merge as a stacked counter track")
+    ap.add_argument("--memory", default=None,
+                    help="memory-ledger dump (MemoryLedger.dump_json) to "
+                         "merge as per-pool byte counter tracks")
     args = ap.parse_args(argv)
 
     paths = glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
@@ -131,6 +149,10 @@ def main(argv=None):
         if isinstance(payload, bytes):
             payload = payload.decode("utf-8")
         payload = _merge(payload, _load_ledger(args.ledger))
+    if args.memory is not None:
+        if isinstance(payload, bytes):
+            payload = payload.decode("utf-8")
+        payload = _merge(payload, _load_memory(args.memory))
     mode = "wb" if isinstance(payload, bytes) else "w"
     with open(args.output, mode) as f:
         f.write(payload)
