@@ -6,10 +6,14 @@ sweep costs parse time, not framework import time.  Keep jax/numpy out of
 this package.
 
 Entry points: :func:`lint_paths` / :func:`lint_source` run the registered
-rules; ``RULES`` is the registry; ``PRINT_ALLOWLIST`` is the frozen
-no-print inventory that tests/test_no_print.py wraps.  Baseline ratchet
-helpers (``load_baseline`` / ``write_baseline`` / ``diff_baseline``) back
-the CI gate.  See docs/STATIC_ANALYSIS.md.
+per-file rules; ``RULES`` is the registry; ``PRINT_ALLOWLIST`` is the
+frozen no-print inventory that tests/test_no_print.py wraps.  Baseline
+ratchet helpers (``load_baseline`` / ``write_baseline`` /
+``diff_baseline``) back the CI gate.  The whole-program concurrency
+passes (``--program``: thread-entry reachability, guarded-by race
+detection) live in :mod:`.concurrency` over the :mod:`.program` model;
+their runtime complement — the lock-discipline test sanitizer — is
+:mod:`.lock_sanitizer`.  See docs/STATIC_ANALYSIS.md.
 """
 
 from .engine import (Finding, Rule, RULES, SCHEMA_VERSION, diff_baseline,  # noqa: F401
@@ -17,3 +21,6 @@ from .engine import (Finding, Rule, RULES, SCHEMA_VERSION, diff_baseline,  # noq
                      load_baseline, register, render_json, render_text,
                      write_baseline)
 from .rules import PRINT_ALLOWLIST  # noqa: F401
+from .concurrency import PROGRAM_RULES, ProgramReport, analyze_program  # noqa: F401
+from .lock_sanitizer import LockSanitizer  # noqa: F401
+from .program import Program  # noqa: F401

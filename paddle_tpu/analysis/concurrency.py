@@ -1,0 +1,271 @@
+"""tpulint whole-program concurrency passes (``tools/tpulint.py --program``).
+
+Three passes over the :mod:`.program` model, each grounded in a race this
+tree has already shipped and hand-fixed:
+
+1. **Thread-entry reachability** — seed from the concurrent entry points
+   the repo actually has (``ThreadingHTTPServer`` route handlers,
+   ``SLOMonitor.subscribe`` callbacks, ``threading.Thread``/pool
+   ``submit`` targets, signal/excepthook paths) and flow the labels
+   through the call graph.  The result feeds pass 2 and is exported as a
+   seed table in the ``--program --json`` report.
+
+2. **Guarded-by inference + race detection** — infer which ``self._*``
+   attributes are guarded by which locks from ``with self._lock:`` blocks
+   (aliases, nesting, multi-item ``with`` handled; bare ``.acquire()``
+   deliberately not guessed), honor explicit ``# guarded-by: <lock>``
+   annotations, then flag:
+
+   - ``guarded-by-race`` — the attr has a guard (inferred from locked
+     writes, or declared) but is touched without it on a path a second
+     thread reaches: the exact post-PR-8 ``gateway._disagg`` shape before
+     its lock landed;
+   - ``unguarded-shared-state`` — the attr is container-mutated or
+     iterated across thread classes with NO lock anywhere: the pre-PR-11
+     ``autoscaler._firing`` set-churn shape;
+   - ``publish-before-init`` — ``__init__`` hands ``self`` to another
+     thread (Thread target / subscriber / pool task) BEFORE assigning an
+     attribute that thread's entry path reads;
+   - ``bad-guarded-by`` — a ``# guarded-by:`` annotation naming a lock
+     the class never defines (meta: the annotation layer must not rot).
+
+   Plain unlocked scalar rebinds/reads are deliberately NOT flagged —
+   CPython makes single-reference publication effectively atomic, and
+   flagging them would bury the iterate-while-mutated signal the pass
+   exists for.  Findings ride the engine's pragma + ratchet-baseline
+   machinery; ``# guarded-by: none`` on the init line declares an attr
+   deliberately unguarded (say why in the trailing text).
+
+3. The dynamic complement — the runtime lock-order/guard sanitizer —
+   lives in :mod:`.lock_sanitizer`; its fixtures validate these static
+   verdicts against the real threaded suites.
+
+Stdlib-only, like the rest of the package: the full ``--program`` sweep
+parses the tree once and never imports JAX.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, _pragmas
+from .program import (ITERATE, MUTATE, READ, WRITE, Access, ClassInfo,
+                      FunctionInfo, Program)
+
+#: rule id → hazard line (mirrors engine.Rule.hazard; surfaced by
+#: --list-rules and docs/STATIC_ANALYSIS.md)
+PROGRAM_RULES: Dict[str, str] = {
+    "guarded-by-race": (
+        "an attribute written under a lock on one path is read/iterated "
+        "without it on a path another thread reaches — a scrape thread can "
+        "observe a torn tick (the gateway._disagg shape)"),
+    "unguarded-shared-state": (
+        "a container attribute is mutated and iterated across thread "
+        "classes with no lock anywhere — set/dict churn from a callback "
+        "thread tears iteration on the main path (the autoscaler._firing "
+        "shape)"),
+    "publish-before-init": (
+        "__init__ hands self to another thread (Thread target, subscriber, "
+        "pool task) before assigning an attribute that thread reads — the "
+        "new thread can observe the half-constructed object"),
+    "bad-guarded-by": (
+        "a # guarded-by: annotation names a lock the class never defines — "
+        "the declared discipline can't be checked and will rot"),
+}
+
+#: attrs never analyzed: locks themselves, thread-locals, and the
+#: back-reference shapes that are written once and read structurally
+_SKIP_ATTRS = ("_tls",)
+
+
+@dataclasses.dataclass
+class ProgramReport:
+    """Machine-readable side-channel of a --program run (JSON output)."""
+
+    seed_table: List[Dict[str, object]]
+    shared_methods: Dict[str, List[str]]   # qualname → sorted labels
+    guarded_attrs: List[Dict[str, object]]
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"thread_entries": self.seed_table,
+                "shared_methods": {k: sorted(v)
+                                   for k, v in sorted(self.shared_methods.items())},
+                "guarded_attrs": self.guarded_attrs}
+
+
+def analyze_program(paths: Sequence[Path], root: Path,
+                    ) -> Tuple[List[Finding], ProgramReport]:
+    """Build the program model over ``paths`` and run all passes.
+    Returns (pragma-filtered findings, report)."""
+    prog = Program.build(paths, root)
+    shared = prog.propagate()
+    inherited = prog.inherited_locks()
+    findings: List[Finding] = []
+    guarded_rows: List[Dict[str, object]] = []
+    for ci in prog.classes.values():
+        findings.extend(_race_pass(ci, guarded_rows, inherited))
+        findings.extend(_publish_pass(ci))
+        findings.extend(_annotation_pass(ci))
+    findings = _apply_pragmas(prog, findings)
+    report = ProgramReport(seed_table=prog.seed_table(),
+                           shared_methods=shared,
+                           guarded_attrs=guarded_rows)
+    return sorted(findings), report
+
+
+# ------------------------------------------------------------- race pass
+
+def _race_pass(ci: ClassInfo, guarded_rows: List[Dict[str, object]],
+               inherited: Dict[str, frozenset]) -> Iterable[Finding]:
+    by_attr: Dict[str, List[Tuple[FunctionInfo, Access]]] = {}
+    for fn, a in ci.all_accesses():
+        if a.attr in ci.lock_attrs or a.attr in _SKIP_ATTRS \
+                or a.attr.startswith("__"):
+            continue
+        by_attr.setdefault(a.attr, []).append((fn, a))
+
+    def eff(fn: FunctionInfo, a: Access) -> frozenset:
+        # locks visibly held at the site, plus locks provably held on
+        # entry to the method (private helper called only under a lock)
+        return a.locks | inherited.get(fn.qualname, frozenset())
+
+    out: List[Finding] = []
+    for attr, sites in sorted(by_attr.items()):
+        declared = ci.guard_declaration(attr)
+        if declared is not None and declared[0] == "none":
+            continue          # deliberately unguarded, annotated as such
+
+        non_init = [(fn, a) for fn, a in sites if fn.name != "__init__"]
+        writes = [(fn, a) for fn, a in non_init
+                  if a.kind in (WRITE, MUTATE)]
+        if not writes:
+            continue          # immutable after construction: no race
+
+        # guard inference: the lock most often held at a write/mutate
+        locked_writes = [(fn, a) for fn, a in writes if eff(fn, a)]
+        guard: Optional[str] = None
+        source = ""
+        if declared is not None:
+            guard, source = declared[0], "declared"
+        elif locked_writes:
+            tally: Dict[str, int] = {}
+            for fn, a in locked_writes:
+                for lk in eff(fn, a):
+                    tally[lk] = tally.get(lk, 0) + 1
+            guard = max(sorted(tally), key=lambda k: tally[k])
+            source = f"inferred from {tally[guard]} locked write(s)"
+
+        shared_fns = [fn for fn, _ in sites if fn.thread_labels]
+        if not shared_fns:
+            continue          # nothing else ever threads through this attr
+        labels = sorted({lb for fn in shared_fns for lb in fn.thread_labels})
+
+        if guard is not None:
+            guarded_rows.append({
+                "class": ci.qualname, "attr": attr, "lock": guard,
+                "source": source, "threads": labels})
+            for fn, a in non_init:
+                if guard in eff(fn, a):
+                    continue
+                # unlocked plain reads only matter on the concurrent path;
+                # unlocked writes/mutates/iterates race the locked side
+                # from anywhere once a second thread is in the class
+                if a.kind == READ and not fn.thread_labels:
+                    continue
+                out.append(Finding(
+                    path=ci.module.rel_path, line=a.line, col=a.col,
+                    rule="guarded-by-race",
+                    message=(f"self.{attr} is guarded by self.{guard} "
+                             f"({source}) but this {a.kind} in "
+                             f"{fn.qualname} runs without it; threads "
+                             f"reaching the attr: {', '.join(labels)}")))
+        else:
+            mutates = [(fn, a) for fn, a in non_init if a.kind == MUTATE]
+            iterates = [(fn, a) for fn, a in non_init if a.kind == ITERATE]
+            if not mutates:
+                continue      # plain rebinds: atomic publication, allowed
+            threaded_mutate = any(fn.thread_labels for fn, _ in mutates)
+            if not (iterates or threaded_mutate):
+                continue
+            for fn, a in mutates + iterates:
+                shape = ("iterated while mutated" if iterates
+                         else "mutated from a second thread")
+                out.append(Finding(
+                    path=ci.module.rel_path, line=a.line, col=a.col,
+                    rule="unguarded-shared-state",
+                    message=(f"self.{attr} is {shape} with no lock anywhere "
+                             f"(this {a.kind} in {fn.qualname}; threads "
+                             f"reaching the attr: {', '.join(labels)}) — "
+                             f"add a lock, or declare `# guarded-by: none` "
+                             f"on its init line with the reason")))
+    return out
+
+
+# ---------------------------------------------------------- publish pass
+
+def _publish_pass(ci: ClassInfo) -> Iterable[Finding]:
+    if not ci.init_publishes:
+        return []
+    out: List[Finding] = []
+    for publish_line, seed in sorted(ci.init_publishes):
+        # attrs the published entry path reads: the seed's resolved target
+        # methods (and, over-approximating, every thread-labelled method of
+        # this class — the publish IS what creates the label)
+        reached_attrs: Set[str] = set()
+        target_names = {seed.target.name}
+        for m in ci.methods.values():
+            if m.name in target_names or m.thread_labels:
+                reached_attrs.update(a.attr for a in m.accesses)
+        for attr, line in sorted(ci.init_assign_line.items(),
+                                 key=lambda kv: kv[1]):
+            if line <= publish_line or attr not in reached_attrs:
+                continue
+            if attr in ci.lock_attrs:
+                continue
+            out.append(Finding(
+                path=ci.module.rel_path, line=line, col=1,
+                rule="publish-before-init",
+                message=(f"self.{attr} is assigned after __init__ already "
+                         f"published self to a {seed.label} at line "
+                         f"{publish_line} ({seed.target.name}) — the new "
+                         f"thread can read the attribute before it exists; "
+                         f"assign state first, publish last")))
+    return out
+
+
+# ------------------------------------------------------- annotation pass
+
+def _annotation_pass(ci: ClassInfo) -> Iterable[Finding]:
+    out: List[Finding] = []
+    for attr, (lock, line) in sorted(ci.guarded_by.items()):
+        if lock == "none":
+            continue
+        if lock not in ci.all_lock_attrs():
+            out.append(Finding(
+                path=ci.module.rel_path, line=line, col=1,
+                rule="bad-guarded-by",
+                message=(f"# guarded-by: {lock} on self.{attr} names a lock "
+                         f"{ci.name} never defines (known locks: "
+                         f"{', '.join(sorted(ci.all_lock_attrs())) or 'none'})")))
+    return out
+
+
+# ------------------------------------------------------------ suppression
+
+def _apply_pragmas(prog: Program, findings: List[Finding]) -> List[Finding]:
+    """Program findings honor the same per-line ``# tpulint: disable=``
+    pragmas as the per-file rules (bad-pragma findings are the per-file
+    stage's job — not duplicated here)."""
+    supp_by_path: Dict[str, Dict[int, set]] = {}
+    for mod in prog.modules.values():
+        supp, _bad = _pragmas(mod.source)
+        supp_by_path[mod.rel_path] = supp
+    out = []
+    for f in findings:
+        allowed = supp_by_path.get(f.path, {}).get(f.line, ())
+        if f.rule in allowed or "all" in allowed:
+            continue
+        out.append(f)
+    return out

@@ -269,11 +269,17 @@ def write_baseline(path: Path, findings: Iterable[Finding],
 
 
 def diff_baseline(findings: Sequence[Finding],
-                  baseline: Dict[str, Dict[str, int]]):
+                  baseline: Dict[str, Dict[str, int]],
+                  active_rules: Optional[set] = None):
     """Returns (new, stale): ``new`` — findings in (file, rule) buckets whose
     count exceeds baseline (all sites listed, since the AST can't know which
     one was just added); ``stale`` — (path, rule, current, baselined) buckets
-    the tree has burned below the frozen count."""
+    the tree has burned below the frozen count.
+
+    ``active_rules``: when given, baseline entries for rules OUTSIDE the
+    set are ignored for the stale check — a run that skipped a stage
+    (e.g. the per-file sweep without ``--program``) must not read that
+    stage's frozen counts as burned-down violations."""
     current = finding_counts(findings)
     new: List[Finding] = []
     stale: List[Tuple[str, str, int, int]] = []
@@ -284,6 +290,8 @@ def diff_baseline(findings: Sequence[Finding],
                            if f.path == path and f.rule == rule)
     for path, rules in sorted(baseline.items()):
         for rule, n in sorted(rules.items()):
+            if active_rules is not None and rule not in active_rules:
+                continue
             cur = current.get(path, {}).get(rule, 0)
             if cur < n:
                 stale.append((path, rule, cur, n))

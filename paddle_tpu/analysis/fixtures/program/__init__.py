@@ -1,0 +1,22 @@
+"""Whole-program fixture corpus for the ``--program`` concurrency passes.
+
+Unlike the per-file fixtures one directory up, these are PACKAGES: the
+bug only exists across files (thread entry in one module, shared state in
+another), which is exactly what the whole-program model exists to see.
+One ``bad_*``/``clean_*`` package pair per pass:
+
+- ``bad_disagg``/``clean_disagg`` — guarded-by-race: a dict written under
+  its lock on the tick path but iterated bare from an HTTP scrape handler
+  in a different module (the ``gateway._disagg`` shape);
+- ``bad_firing``/``clean_firing`` — unguarded-shared-state: a set churned
+  from monitor subscriber callbacks with no lock anywhere (the pre-fix
+  ``autoscaler._firing`` shape);
+- ``bad_publish.py``/``clean_publish.py`` — publish-before-init:
+  ``__init__`` starts a thread before assigning the state it reads;
+- ``bad_annotation.py``/``clean_annotation.py`` — bad-guarded-by: a
+  ``# guarded-by:`` declaration naming a lock the class never defines.
+
+Parsed, never imported — same contract as the rest of the corpus.  The CI
+sweep lints these in place, so every program rule keeps a baselined
+true-positive: a pass going silently blind shows up as a STALE baseline.
+"""

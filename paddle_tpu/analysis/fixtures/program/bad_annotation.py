@@ -1,0 +1,8 @@
+"""bad-guarded-by positive: the declaration names a lock the class never
+defines — undetectable discipline rots.  (Fixture: parsed, never
+imported.)"""
+
+
+class BadAnnotation:
+    def __init__(self):
+        self._items = {}    # guarded-by: _items_lock

@@ -1,0 +1,2 @@
+"""guarded-by-race positive: locked tick-path writes, bare scrape-path
+iteration, across two modules.  (Fixture: parsed, never imported.)"""

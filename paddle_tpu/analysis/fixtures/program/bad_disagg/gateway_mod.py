@@ -1,0 +1,25 @@
+"""The shared-state side: ``_jobs`` is written under ``_jobs_lock`` on the
+tick path — the pass must infer the guard from those locked writes — but
+``snapshot()`` iterates it bare, and the handler module drives
+``snapshot()`` from an HTTP server thread."""
+
+import threading
+
+
+class MiniGateway:
+    def __init__(self):
+        self._jobs_lock = threading.Lock()
+        self._jobs = {}
+
+    def step(self):
+        with self._jobs_lock:
+            self._jobs[len(self._jobs)] = "migrating"
+
+    def finish(self, job_id):
+        with self._jobs_lock:
+            self._jobs.pop(job_id, None)
+
+    def snapshot(self):
+        # trips guarded-by-race: iterating the guarded dict without the
+        # lock, on a path the scrape thread reaches
+        return {k: v for k, v in self._jobs.items()}

@@ -1,0 +1,16 @@
+"""The thread-entry side: a BaseHTTPRequestHandler subclass — every
+method runs on a server thread — reaching ``MiniGateway.snapshot`` in the
+sibling module through a typed local."""
+
+from http.server import BaseHTTPRequestHandler
+
+from .gateway_mod import MiniGateway
+
+
+class ScrapeHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        gw: "MiniGateway" = self.server.gw
+        body = str(gw.snapshot()).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)

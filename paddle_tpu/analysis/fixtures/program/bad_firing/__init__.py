@@ -1,0 +1,2 @@
+"""unguarded-shared-state positive: subscriber-callback set churn with no
+lock anywhere, across two modules.  (Fixture: parsed, never imported.)"""

@@ -1,0 +1,14 @@
+"""The entry side: ``subscribe()`` registers callbacks that run on
+whatever thread drives ``evaluate()`` — the subscriber seed."""
+
+
+class MiniMonitor:
+    def __init__(self):
+        self._subs = []
+
+    def subscribe(self, fn):
+        self._subs.append(fn)
+
+    def evaluate(self, name, active):
+        for fn in list(self._subs):
+            fn(name, active)

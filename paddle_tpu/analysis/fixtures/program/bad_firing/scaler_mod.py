@@ -1,0 +1,21 @@
+"""The state side: ``_firing`` is a bare set churned from the subscriber
+callback and iterated on the main path — the pre-fix autoscaler shape."""
+
+from .monitor_mod import MiniMonitor
+
+
+class MiniScaler:
+    def __init__(self, monitor: MiniMonitor):
+        self._firing = set()
+        monitor.subscribe(self._on_alert)
+
+    def _on_alert(self, name, active):
+        # trips unguarded-shared-state: mutate on the subscriber thread
+        if active:
+            self._firing.add(name)
+        else:
+            self._firing.discard(name)
+
+    def firing(self):
+        # trips unguarded-shared-state: iterate while the callback churns
+        return sorted(self._firing)

@@ -1,0 +1,17 @@
+"""publish-before-init positive: ``__init__`` starts the worker thread
+BEFORE assigning the state the worker reads — the thread can observe the
+half-constructed object.  (Fixture: parsed, never imported.)"""
+
+import threading
+
+
+class BadPublisher:
+    def __init__(self):
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+        self._results = []      # trips: assigned after self was published
+
+    def _run(self):
+        # read-only so ONLY the publish ordering is at fault here
+        print_len = len(self._results)
+        del print_len

@@ -1,0 +1,12 @@
+"""bad-guarded-by near-misses: a declaration naming a real lock, and a
+deliberate ``none`` — both must stay silent.  (Fixture: parsed, never
+imported.)"""
+
+import threading
+
+
+class CleanAnnotation:
+    def __init__(self):
+        self._items_lock = threading.Lock()
+        self._items = {}    # guarded-by: _items_lock
+        self._scratch = []  # guarded-by: none (per-call scratch, never shared)

@@ -1,0 +1,3 @@
+"""guarded-by-race near-miss: same two-module shape as ``bad_disagg``,
+but the scrape path snapshots under the lock — must stay silent.
+(Fixture: parsed, never imported.)"""

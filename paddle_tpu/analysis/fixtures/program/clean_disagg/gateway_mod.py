@@ -1,0 +1,29 @@
+"""Same state and guard as the bad twin; every access holds the lock,
+including through a local alias and a private helper only ever called
+under the lock (the inherited-locks corner)."""
+
+import threading
+
+
+class MiniGateway:
+    def __init__(self):
+        self._jobs_lock = threading.Lock()
+        self._jobs = {}
+
+    def step(self):
+        with self._jobs_lock:
+            self._jobs[len(self._jobs)] = "migrating"
+            self._note()
+
+    def finish(self, job_id):
+        lk = self._jobs_lock            # alias form must still count
+        with lk:
+            self._jobs.pop(job_id, None)
+
+    def _note(self):
+        # called only with _jobs_lock held: inherited, not a race
+        self._jobs["last"] = "noted"
+
+    def snapshot(self):
+        with self._jobs_lock:
+            return {k: v for k, v in self._jobs.items()}

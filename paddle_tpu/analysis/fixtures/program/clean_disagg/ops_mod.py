@@ -1,0 +1,15 @@
+"""Same thread entry as the bad twin — the silence must come from the
+locking in ``gateway_mod``, not from missing reachability."""
+
+from http.server import BaseHTTPRequestHandler
+
+from .gateway_mod import MiniGateway
+
+
+class ScrapeHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        gw: "MiniGateway" = self.server.gw
+        body = str(gw.snapshot()).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)

@@ -1,0 +1,3 @@
+"""unguarded-shared-state near-miss: same subscriber churn, but the set
+rides a lock — the post-fix autoscaler shape; must stay silent.
+(Fixture: parsed, never imported.)"""

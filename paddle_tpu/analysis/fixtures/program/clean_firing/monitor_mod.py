@@ -1,0 +1,13 @@
+"""Same entry side as the bad twin."""
+
+
+class MiniMonitor:
+    def __init__(self):
+        self._subs = []
+
+    def subscribe(self, fn):
+        self._subs.append(fn)
+
+    def evaluate(self, name, active):
+        for fn in list(self._subs):
+            fn(name, active)

@@ -1,0 +1,26 @@
+"""Same churn as the bad twin, every access under ``_firing_lock`` —
+nested ``with`` on the callback path must not confuse the lock stack."""
+
+import threading
+
+from .monitor_mod import MiniMonitor
+
+
+class MiniScaler:
+    def __init__(self, monitor: MiniMonitor):
+        self._firing_lock = threading.Lock()
+        self._firing = set()
+        self._log_lock = threading.Lock()
+        monitor.subscribe(self._on_alert)
+
+    def _on_alert(self, name, active):
+        with self._log_lock:
+            with self._firing_lock:     # nested with: inner lock counts
+                if active:
+                    self._firing.add(name)
+                else:
+                    self._firing.discard(name)
+
+    def firing(self):
+        with self._firing_lock:
+            return sorted(self._firing)

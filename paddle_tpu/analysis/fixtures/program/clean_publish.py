@@ -1,0 +1,16 @@
+"""publish-before-init near-miss: state first, publish last — must stay
+silent.  (Fixture: parsed, never imported.)"""
+
+import threading
+
+
+class CleanPublisher:
+    def __init__(self):
+        self._results = []
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        # read-only so ONLY the publish ordering is at fault here
+        print_len = len(self._results)
+        del print_len

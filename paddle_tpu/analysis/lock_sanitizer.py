@@ -1,0 +1,367 @@
+"""Lock-discipline sanitizer — the runtime complement to the static
+``--program`` concurrency passes (:mod:`.concurrency`).
+
+The static pass proves "this attr is guarded by that lock on every path
+it can see"; this module checks the claim against what threads actually
+do in the threaded test suites:
+
+- :class:`LockSanitizer` wraps ``threading.Lock``/``RLock`` objects in
+  recording proxies.  Every acquisition appends an edge (held → acquired)
+  to a process-wide-per-sanitizer lock-order graph; an acquisition that
+  closes a cycle is a **lock-order inversion** (the deadlock shape) and
+  is recorded as a violation with both conflicting edges' call sites.
+- :meth:`LockSanitizer.guard` wraps a container attribute (dict / set /
+  list / deque) in a checking proxy that records a **guarded-by
+  violation** whenever the declared lock is not held by the accessing
+  thread at a read, iteration, or mutation.  Declarations can be wired
+  by hand or harvested from the same ``# guarded-by: <lock>`` source
+  annotations the static pass reads (:meth:`instrument_guards`), so the
+  two layers can never drift.
+- Violations are RECORDED, not raised, at the access site (raising inside
+  an instrumented ``__iter__`` would turn a diagnosis into a new crash in
+  someone else's thread); the pytest fixture asserts ``violations() ==
+  []`` at teardown, so the test that provoked the race is the test that
+  fails, with every site listed.
+
+Opt-in and stdlib-only: nothing in the serving stack imports this; tests
+construct a sanitizer, ``instrument()`` the objects under test, run the
+threaded scenario, and the fixture fails on anything recorded.  See
+``tests/conftest.py`` (``lock_sanitizer`` fixture) and
+docs/STATIC_ANALYSIS.md § Lock-discipline sanitizer.
+"""
+
+from __future__ import annotations
+
+import inspect
+import re
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .program import GUARDED_BY_RE, _SELF_ATTR_ASSIGN_RE
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+def _site(skip: int = 3) -> str:
+    """Caller's file:line, skipping sanitizer frames — the violation
+    message must point at the racing code, not at this module."""
+    for frame in traceback.extract_stack()[-(skip + 6)::][::-1]:
+        if "lock_sanitizer" not in frame.filename:
+            return f"{frame.filename}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _InstrumentedLock:
+    """Recording proxy over a Lock/RLock: same acquire/release/context
+    surface, plus owner tracking (which threads hold it now) feeding the
+    sanitizer's order graph and guard checks."""
+
+    def __init__(self, sanitizer: "LockSanitizer", inner, name: str):
+        self._san = sanitizer
+        self._inner = inner
+        self.name = name
+        self._owners: Dict[int, int] = {}        # thread ident → depth
+        self._owners_guard = threading.Lock()
+
+    # -- lock protocol ---------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san._on_acquire(self)
+            ident = threading.get_ident()
+            with self._owners_guard:
+                self._owners[ident] = self._owners.get(ident, 0) + 1
+        return got
+
+    def release(self):
+        ident = threading.get_ident()
+        with self._owners_guard:
+            depth = self._owners.get(ident, 0)
+            if depth <= 1:
+                self._owners.pop(ident, None)
+            else:
+                self._owners[ident] = depth - 1
+        self._san._on_release(self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def held_by_current_thread(self) -> bool:
+        with self._owners_guard:
+            return self._owners.get(threading.get_ident(), 0) > 0
+
+    def __repr__(self):
+        return f"<sanitized {self.name} over {self._inner!r}>"
+
+
+class _GuardedContainer:
+    """Checking proxy over a container: every read/iterate/mutate records
+    a violation unless the declared lock is held by the CURRENT thread.
+    ``__class__`` is forwarded so ``isinstance`` checks in instrumented
+    code keep passing."""
+
+    _MUTATORS = {"add", "append", "appendleft", "clear", "discard",
+                 "extend", "extendleft", "insert", "pop", "popitem",
+                 "popleft", "remove", "setdefault", "update"}
+
+    def __init__(self, sanitizer: "LockSanitizer", inner, attr: str,
+                 lock: _InstrumentedLock):
+        object.__setattr__(self, "_san", sanitizer)
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_attr", attr)
+        object.__setattr__(self, "_lock", lock)
+
+    def _check(self, op: str):
+        if not self._lock.held_by_current_thread():
+            self._san._record_guard_violation(self._attr, self._lock.name, op)
+
+    # -- reads -----------------------------------------------------------
+    def __iter__(self):
+        self._check("iterate")
+        return iter(self._inner)
+
+    def __len__(self):
+        self._check("len")
+        return len(self._inner)
+
+    def __contains__(self, item):
+        self._check("contains")
+        return item in self._inner
+
+    def __getitem__(self, key):
+        self._check("getitem")
+        return self._inner[key]
+
+    def __bool__(self):
+        self._check("bool")
+        return bool(self._inner)
+
+    # -- mutations -------------------------------------------------------
+    def __setitem__(self, key, value):
+        self._check("setitem")
+        self._inner[key] = value
+
+    def __delitem__(self, key):
+        self._check("delitem")
+        del self._inner[key]
+
+    def __getattr__(self, name):
+        value = getattr(self._inner, name)
+        if callable(value):
+            op = "mutate" if name in self._MUTATORS else "read"
+
+            def checked(*a, _value=value, _op=op, **kw):
+                self._check(_op)
+                return _value(*a, **kw)
+            return checked
+        return value
+
+    @property
+    def __class__(self):      # isinstance(proxy, dict/set/...) keeps working
+        return type(self._inner)
+
+    def __repr__(self):
+        return f"<guarded {self._attr} by {self._lock.name}: {self._inner!r}>"
+
+
+class LockSanitizer:
+    """Opt-in runtime recorder of lock-order inversions and guarded-by
+    violations.  One sanitizer per test; ``assert_clean()`` at teardown."""
+
+    def __init__(self, name: str = "sanitizer"):
+        self.name = name
+        self._graph_lock = threading.Lock()
+        #: (a, b) → first acquisition site proving a-held-then-b
+        self._edges: Dict[Tuple[str, str], str] = {}
+        self._held = threading.local()
+        self._violations: List[Dict[str, Any]] = []
+        self._wrapped: List[_InstrumentedLock] = []
+
+    # -- wrapping --------------------------------------------------------
+    def wrap(self, lock, name: str) -> _InstrumentedLock:
+        if isinstance(lock, _InstrumentedLock):
+            return lock
+        w = _InstrumentedLock(self, lock, name)
+        self._wrapped.append(w)
+        return w
+
+    def instrument(self, obj, names: Optional[List[str]] = None,
+                   prefix: str = "") -> List[str]:
+        """Replace every ``threading.Lock``/``RLock`` attribute on ``obj``
+        (or just ``names``) with a recording proxy.  Returns the wrapped
+        attribute names.  Idempotent."""
+        wrapped: List[str] = []
+        prefix = prefix or type(obj).__name__
+        candidates = names if names is not None else [
+            n for n, v in vars(obj).items() if isinstance(v, _LOCK_TYPES)]
+        for n in candidates:
+            v = getattr(obj, n, None)
+            if isinstance(v, _InstrumentedLock):
+                continue
+            if not isinstance(v, _LOCK_TYPES):
+                continue
+            setattr(obj, n, self.wrap(v, f"{prefix}.{n}"))
+            wrapped.append(n)
+        return wrapped
+
+    def guard(self, obj, attr: str, lock_attr: str) -> bool:
+        """Wrap container ``obj.<attr>`` so every access checks that
+        ``obj.<lock_attr>`` (instrumenting it first if needed) is held by
+        the accessing thread.  Returns False when the attr isn't a
+        wrappable container."""
+        lock = getattr(obj, lock_attr, None)
+        if not isinstance(lock, _InstrumentedLock):
+            got = self.instrument(obj, names=[lock_attr])
+            if not got:
+                return False
+            lock = getattr(obj, lock_attr)
+        value = getattr(obj, attr, None)
+        if isinstance(value, _GuardedContainer):
+            return True
+        if not isinstance(value, (dict, set, list)) \
+                and not hasattr(value, "__iter__"):
+            return False
+        setattr(obj, attr, _GuardedContainer(
+            self, value, f"{type(obj).__name__}.{attr}", lock))
+        return True
+
+    def instrument_guards(self, obj) -> List[Tuple[str, str]]:
+        """Harvest ``# guarded-by: <lock>`` annotations from the object's
+        class source (the SAME syntax the static pass reads) and wire a
+        :meth:`guard` for each — statically-declared discipline becomes a
+        runtime assertion with zero duplicate bookkeeping.  Returns the
+        (attr, lock) pairs wired; ``guarded-by: none`` attrs are skipped."""
+        try:
+            src = inspect.getsource(type(obj))
+        except (OSError, TypeError):
+            return []
+        wired: List[Tuple[str, str]] = []
+        lines = src.splitlines()
+        for i, line in enumerate(lines):
+            m = GUARDED_BY_RE.search(line)
+            if not m or m.group(1) == "none":
+                continue
+            am = _SELF_ATTR_ASSIGN_RE.search(line)
+            if am is None and line.lstrip().startswith("#"):
+                # comment-only annotation covers the next code line,
+                # skipping further comment lines (same as the static scan)
+                j = i + 1
+                while j < len(lines) and lines[j].lstrip().startswith("#"):
+                    j += 1
+                if j < len(lines):
+                    am = _SELF_ATTR_ASSIGN_RE.search(lines[j])
+            if not am:
+                continue
+            attr, lock_attr = am.group(1), m.group(1)
+            if self.guard(obj, attr, lock_attr):
+                wired.append((attr, lock_attr))
+        return wired
+
+    # -- recording -------------------------------------------------------
+    def _held_stack(self) -> List[str]:
+        stack = getattr(self._held, "stack", None)
+        if stack is None:
+            stack = self._held.stack = []
+        return stack
+
+    def _on_acquire(self, lock: _InstrumentedLock):
+        stack = self._held_stack()
+        if lock.name in stack:          # RLock re-entry: no new ordering
+            stack.append(lock.name)
+            return
+        site = _site()
+        with self._graph_lock:
+            for held in set(stack):
+                edge = (held, lock.name)
+                if edge not in self._edges:
+                    self._edges[edge] = site
+                    cycle = self._find_cycle(lock.name, held)
+                    if cycle:
+                        self._violations.append({
+                            "kind": "lock-order-inversion",
+                            "thread": threading.current_thread().name,
+                            "edge": f"{held} -> {lock.name}",
+                            "site": site,
+                            "conflicts_with": " -> ".join(cycle),
+                            "conflict_sites": [
+                                self._edges.get((a, b), "?")
+                                for a, b in zip(cycle, cycle[1:])],
+                        })
+        stack.append(lock.name)
+
+    def _find_cycle(self, start: str, goal: str) -> Optional[List[str]]:
+        """Path start → … → goal through recorded edges = the reverse
+        ordering that, combined with the edge just added, closes a cycle."""
+        path = [start]
+        seen: Set[str] = set()
+
+        def dfs(node: str) -> bool:
+            if node == goal:
+                return True
+            seen.add(node)
+            for (a, b) in self._edges:
+                if a == node and b not in seen:
+                    path.append(b)
+                    if dfs(b):
+                        return True
+                    path.pop()
+            return False
+
+        if dfs(start):
+            return path + [start] if path[-1] != goal else path
+        return None
+
+    def _on_release(self, lock: _InstrumentedLock):
+        stack = self._held_stack()
+        if lock.name in stack:
+            stack.reverse()
+            stack.remove(lock.name)     # innermost occurrence
+            stack.reverse()
+
+    def _record_guard_violation(self, attr: str, lock_name: str, op: str):
+        with self._graph_lock:
+            self._violations.append({
+                "kind": "guarded-by",
+                "thread": threading.current_thread().name,
+                "attr": attr, "lock": lock_name, "op": op,
+                "site": _site(),
+            })
+
+    # -- results ---------------------------------------------------------
+    def violations(self) -> List[Dict[str, Any]]:
+        with self._graph_lock:
+            return list(self._violations)
+
+    def lock_order_edges(self) -> Dict[Tuple[str, str], str]:
+        with self._graph_lock:
+            return dict(self._edges)
+
+    def assert_clean(self):
+        vs = self.violations()
+        if vs:
+            lines = []
+            for v in vs:
+                if v["kind"] == "lock-order-inversion":
+                    lines.append(
+                        f"  lock-order inversion on {v['thread']}: "
+                        f"{v['edge']} at {v['site']} conflicts with "
+                        f"{v['conflicts_with']} "
+                        f"(first seen at {', '.join(v['conflict_sites'])})")
+                else:
+                    lines.append(
+                        f"  guarded-by violation on {v['thread']}: "
+                        f"{v['op']} of {v['attr']} without {v['lock']} "
+                        f"at {v['site']}")
+            raise AssertionError(
+                f"LockSanitizer({self.name}) recorded {len(vs)} "
+                f"violation(s):\n" + "\n".join(lines))

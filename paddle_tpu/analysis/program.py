@@ -1,0 +1,1031 @@
+"""tpulint whole-program model — the cross-file substrate under
+:mod:`paddle_tpu.analysis.concurrency`.
+
+The per-file rules in :mod:`.rules` see one AST at a time; the concurrency
+bug class this repo keeps hand-finding (`gateway._disagg` iterated by an
+ops-server scrape thread while ``step()`` mutates it, the autoscaler's
+``_firing`` set churned from SLO subscriber callbacks) is only visible
+across files: the thread ENTRY lives in one module (``ops_server``'s
+``ThreadingHTTPServer`` handler, ``SLOMonitor.subscribe``), the shared
+state in another.  This module builds the project-wide model those passes
+run on:
+
+- module map: dotted module name → parsed :class:`ModuleInfo` (imports
+  resolved, including relative imports — fixture packages use them);
+- class map: ``module.Class`` → :class:`ClassInfo` (methods, resolved
+  bases, ``self._*`` attribute accesses WITH the lock set held at each
+  access site, lock inventory, ``# guarded-by:`` annotations);
+- call graph over methods/functions: ``self.m()``, constructor-typed and
+  annotation-typed attributes/locals (``ops: "OpsServer" = ...``),
+  imported names, and a unique-method-name fallback for duck-typed calls
+  (an attr call resolves to ``Cls.m`` only when exactly ONE program class
+  defines ``m`` — over-approximate on purpose: reachability wants recall,
+  the ratchet baseline absorbs precision misses).
+
+Deliberately stdlib-only (``ast``/``re``) like the rest of the package:
+the ``--program`` sweep re-parses the tree in ~1 s and never imports JAX.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import iter_py_files
+
+# access kinds, ordered by how loudly they race
+READ = "read"          # plain load of the attribute value
+ITERATE = "iterate"    # for/comprehension over it, list()/sorted()/dict() of it
+WRITE = "write"        # rebinding assignment: self._x = ...
+MUTATE = "mutate"      # in-place: .add()/.pop()/augassign/subscript-store/del
+
+#: container methods that mutate the receiver in place
+_MUTATOR_METHODS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "extendleft",
+    "insert", "pop", "popitem", "popleft", "remove", "setdefault", "update",
+    "__setitem__", "__delitem__",
+}
+#: container methods whose return value walks the container (racy to call
+#: while another thread mutates — dict.items() during insert raises)
+_ITERATOR_METHODS = {"items", "keys", "values", "copy", "most_common"}
+#: builtins that iterate their (sole relevant) argument
+_ITERATING_BUILTINS = {"list", "sorted", "tuple", "set", "frozenset", "dict",
+                       "sum", "min", "max", "any", "all", "enumerate"}
+
+#: attribute names that look like locks even without a visible
+#: ``threading.Lock()`` assignment (conservative: suffix match)
+_LOCKISH = re.compile(r"(?:^|_)r?lock$")
+
+_LOCK_CTORS = {"threading.Lock", "threading.RLock", "Lock", "RLock",
+               "threading.Condition", "Condition"}
+
+#: ``# guarded-by: <lock>`` annotation on the line initializing an attr —
+#: declares the guard (``none`` declares "deliberately unguarded" and
+#: silences the race passes for that attr; state why in the trailing text)
+GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*|none)\b")
+_SELF_ATTR_ASSIGN_RE = re.compile(r"self\.(\w+)\s*(?::[^=]+)?=")
+
+#: method names never resolved through the unique-name fallback — dunders
+#: plus names whose duck-typed ubiquity makes "defined once" a coincidence
+_NEVER_UNIQUE = {"__init__", "__enter__", "__exit__", "__call__", "get",
+                 "put", "close", "start", "stop", "run", "step", "submit"}
+
+
+@dataclasses.dataclass
+class Access:
+    """One ``self._attr`` touch: where, what kind, and which of the
+    enclosing class's locks were held (``with self._lock:`` nesting,
+    local aliases of ``self._*lock`` included)."""
+
+    attr: str
+    kind: str
+    locks: frozenset
+    line: int
+    col: int
+
+
+@dataclasses.dataclass
+class CallSite:
+    """Unresolved call edge recorded while scanning a body; resolved
+    against the finished program by :meth:`Program.resolve_calls`.
+
+    shape ∈ {"self" (self.m()), "typed" (x.m() with x: Cls known),
+    "name" (dotted fullname through imports), "unique" (o.m() untyped)}.
+    ``locks`` is the lock set held AT the call site — the guarded-by pass
+    uses it to infer that a private helper called only under a lock runs
+    with that lock held (the ``emit() → _append()`` shape).
+    """
+
+    shape: str
+    name: str                  # method/function name
+    qualifier: str = ""        # class qualname for "typed", dotted for "name"
+    line: int = 0
+    locks: frozenset = frozenset()
+
+
+@dataclasses.dataclass
+class Seed:
+    """A concurrent entry point: ``target`` is a CallSite-shaped reference
+    to the callable that runs off the constructing thread."""
+
+    label: str                 # thread-target | pool-task | subscriber | ...
+    target: CallSite
+    line: int
+
+
+class FunctionInfo:
+    """One function or method body's scan results."""
+
+    def __init__(self, module: "ModuleInfo", node: ast.AST,
+                 cls: Optional["ClassInfo"] = None):
+        self.module = module
+        self.cls = cls
+        self.node = node
+        self.name = node.name
+        self.accesses: List[Access] = []
+        self.calls: List[CallSite] = []
+        self.seeds: List[Seed] = []
+        #: thread labels this body is reachable from (filled by propagate)
+        self.thread_labels: Set[str] = set()
+
+    @property
+    def qualname(self) -> str:
+        if self.cls is not None:
+            return f"{self.cls.qualname}.{self.name}"
+        return f"{self.module.name}.{self.name}"
+
+    def __repr__(self):
+        return f"<fn {self.qualname}>"
+
+
+class ClassInfo:
+    def __init__(self, module: "ModuleInfo", node: ast.ClassDef):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.qualname = f"{module.name}.{node.name}"
+        #: resolved dotted base names (through imports); program classes
+        #: among them are linked in Program.finish()
+        self.base_names: List[str] = []
+        self.bases: List["ClassInfo"] = []
+        self.methods: Dict[str, FunctionInfo] = {}
+        #: attrs assigned a Lock()/RLock()/Condition() (or *_lock names)
+        self.lock_attrs: Set[str] = set()
+        #: attr → class qualname, from ``self._x = Cls(...)`` / annotations
+        self.attr_types: Dict[str, str] = {}
+        #: attr → lock attr name (or "none"), from guarded-by annotations
+        self.guarded_by: Dict[str, Tuple[str, int]] = {}
+        #: __init__ publication point (stmt line) → seeds fired there
+        self.init_publishes: List[Tuple[int, Seed]] = []
+        #: attr → first-assignment line inside __init__
+        self.init_assign_line: Dict[str, int] = {}
+
+    def method(self, name: str) -> Optional[FunctionInfo]:
+        c: Optional[ClassInfo] = self
+        seen = set()
+        while c is not None and c.qualname not in seen:
+            seen.add(c.qualname)
+            if name in c.methods:
+                return c.methods[name]
+            c = c.bases[0] if c.bases else None
+        return None
+
+    def guard_declaration(self, attr: str) -> Optional[Tuple[str, int]]:
+        """# guarded-by: declaration for ``attr``, walking base classes —
+        a container declared on ``Layer.__init__``'s line covers every
+        subclass that mutates it."""
+        c: Optional[ClassInfo] = self
+        seen = set()
+        while c is not None and c.qualname not in seen:
+            seen.add(c.qualname)
+            if attr in c.guarded_by:
+                return c.guarded_by[attr]
+            c = c.bases[0] if c.bases else None
+        return None
+
+    def all_lock_attrs(self) -> Set[str]:
+        """Lock inventory including inherited locks."""
+        out: Set[str] = set()
+        c: Optional[ClassInfo] = self
+        seen = set()
+        while c is not None and c.qualname not in seen:
+            seen.add(c.qualname)
+            out.update(c.lock_attrs)
+            c = c.bases[0] if c.bases else None
+        return out
+
+    def all_accesses(self) -> Iterable[Tuple[FunctionInfo, Access]]:
+        for m in self.methods.values():
+            for a in m.accesses:
+                yield m, a
+
+    def is_http_handler(self) -> bool:
+        """BaseHTTPRequestHandler subclasses (by resolved base name or the
+        do_GET/do_POST shape): every method runs on a server thread."""
+        for b in self.base_names:
+            if "HTTPRequestHandler" in b or "StreamRequestHandler" in b:
+                return True
+        return any(n.startswith("do_") for n in self.methods)
+
+    def __repr__(self):
+        return f"<class {self.qualname}>"
+
+
+class ModuleInfo:
+    def __init__(self, name: str, rel_path: str, source: str, tree: ast.AST):
+        self.name = name
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.imports = _module_imports(tree, name, rel_path)
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+
+    def resolve_name(self, node: ast.AST) -> Optional[str]:
+        """Dotted fullname of a Name/Attribute chain through this module's
+        imports (relative imports resolved); None when dynamic."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        head = self.imports.get(node.id, node.id)
+        parts.append(head)
+        return ".".join(reversed(parts))
+
+
+def _module_imports(tree: ast.AST, module_name: str,
+                    rel_path: str) -> Dict[str, str]:
+    """Local name → dotted fullname, RELATIVE imports included (the
+    engine's per-file map skips them; fixture packages and intra-package
+    code need them to cross files)."""
+    pkg_parts = module_name.split(".")
+    is_pkg = rel_path.endswith("__init__.py")
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    alias.name if alias.asname else alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ""
+            else:
+                # from .sibling import X — resolve against our package
+                up = node.level - (1 if is_pkg else 0)
+                anchor = pkg_parts[:len(pkg_parts) - up] if up else pkg_parts
+                base = ".".join(anchor + ([node.module] if node.module else []))
+            for alias in node.names:
+                full = f"{base}.{alias.name}" if base else alias.name
+                out[alias.asname or alias.name] = full
+    return out
+
+
+# ----------------------------------------------------------- body scanning
+
+class _BodyScanner:
+    """One pass over a function/method body: records self-attribute
+    accesses with the lock set held at each site, call sites, and thread
+    seeds.  Locks are tracked through ``with self._lock:`` (multi-item,
+    nested) and simple local aliases (``lk = self._lock; with lk:``);
+    bare ``.acquire()`` is deliberately NOT modelled — a conditional
+    acquire makes the held set path-dependent, and guessing would turn
+    missed races into false confidence."""
+
+    def __init__(self, fn: FunctionInfo):
+        self.fn = fn
+        self.cls = fn.cls
+        self.module = fn.module
+        # guarded-by: none on all scanner state: instances are per-body,
+        # single-threaded; labels reaching here are unique-name
+        # over-approximation (something thread-labelled calls a .scan())
+        self.locks: List[str] = []          # guarded-by: none (per-body scanner) — held-lock stack
+        self.lock_aliases: Dict[str, str] = {}  # guarded-by: none (per-body scanner)
+        self.local_types: Dict[str, str] = {}   # guarded-by: none (per-body scanner) — var → class qualname
+        #: nested `def run(): ...` names — a Thread(target=run) seed on a
+        #: local closure labels THIS body (its accesses were scanned here)
+        self.nested_defs: Set[str] = set()  # guarded-by: none (per-body scanner)
+
+    # -- entry ----------------------------------------------------------
+    def scan(self):
+        node = self.fn.node
+        self._collect_param_types(node)
+        self._stmts(node.body)
+
+    def _collect_param_types(self, node):
+        for arg in list(node.args.posonlyargs) + list(node.args.args):
+            t = self._annotation_type(arg.annotation)
+            if t:
+                self.local_types[arg.arg] = t
+
+    def _annotation_type(self, ann) -> Optional[str]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            # string annotation: 'OpsServer' / "gateway.ServingGateway"
+            name = ann.value.strip().strip('"\'')
+            return self._dotted_to_class(name)
+        resolved = self.module.resolve_name(ann)
+        return self._dotted_to_class(resolved) if resolved else None
+
+    def _dotted_to_class(self, dotted: str) -> Optional[str]:
+        # Resolution against the finished program happens later; store the
+        # dotted guess, Program.resolve_calls maps it to a ClassInfo.
+        return self.module.imports.get(dotted, dotted)
+
+    # -- statements ------------------------------------------------------
+    def _stmts(self, body: Sequence[ast.stmt]):
+        for stmt in body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt: ast.stmt):
+        if isinstance(stmt, ast.With):
+            pushed = 0
+            for item in stmt.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self.locks.append(lock)
+                    pushed += 1
+                else:
+                    self._expr(item.context_expr)
+            self._stmts(stmt.body)
+            for _ in range(pushed):
+                self.locks.pop()
+        elif isinstance(stmt, ast.Assign):
+            self._expr(stmt.value)
+            self._track_assign(stmt)
+            for t in stmt.targets:
+                self._target(t)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            self._track_annassign(stmt)
+            self._target(stmt.target)
+        elif isinstance(stmt, ast.AugAssign):
+            self._expr(stmt.value)
+            attr = self._self_attr(stmt.target)
+            if attr:
+                self._record(attr, MUTATE, stmt)
+            else:
+                self._target(stmt.target)
+        elif isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = self._self_attr(t.value)
+                    if attr:
+                        self._record(attr, MUTATE, t)
+                        self._expr(t.slice)
+                        continue
+                attr = self._self_attr(t)
+                if attr:
+                    self._record(attr, WRITE, t)
+                else:
+                    self._expr(t)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            attr = self._iterable_attr(stmt.iter)
+            if attr:
+                self._record(attr, ITERATE, stmt.iter)
+            else:
+                self._expr(stmt.iter)
+            self._target(stmt.target)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.While):
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.If):
+            self._expr(stmt.test)
+            self._stmts(stmt.body)
+            self._stmts(stmt.orelse)
+        elif isinstance(stmt, ast.Try):
+            self._stmts(stmt.body)
+            for h in stmt.handlers:
+                self._stmts(h.body)
+            self._stmts(stmt.orelse)
+            self._stmts(stmt.finalbody)
+        elif isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: its body runs when called — commonly a thread
+            # target; scan it as part of this fn (accesses attributed
+            # here, which is where the closure's locks visibly aren't)
+            self.nested_defs.add(stmt.name)
+            held, self.locks = self.locks, []   # defs run without our locks
+            self._stmts(stmt.body)
+            self.locks = held
+        elif isinstance(stmt, ast.ClassDef):
+            pass
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for v in (getattr(stmt, "exc", None), getattr(stmt, "cause", None),
+                      getattr(stmt, "test", None), getattr(stmt, "msg", None)):
+                if v is not None:
+                    self._expr(v)
+        else:
+            for child in ast.iter_child_nodes(stmt):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+                elif isinstance(child, ast.stmt):
+                    self._stmt(child)
+
+    # -- assignment bookkeeping -----------------------------------------
+    def _track_assign(self, stmt: ast.Assign):
+        if len(stmt.targets) != 1:
+            return
+        t = stmt.targets[0]
+        if isinstance(t, ast.Attribute):
+            dotted = self.module.resolve_name(t)
+            if dotted in ("sys.excepthook", "threading.excepthook"):
+                self._seed_from("signal-handler", stmt.value, stmt.lineno)
+        if isinstance(t, ast.Name):
+            # lock alias: lk = self._lock
+            src = self._self_attr(stmt.value)
+            if src and self._is_lock_name(src):
+                self.lock_aliases[t.id] = src
+            # local type: x = Cls(...)
+            qual = self._ctor_type(stmt.value)
+            if qual:
+                self.local_types[t.id] = qual
+        attr = self._self_attr(t)
+        if attr and self.cls is not None:
+            qual = self._ctor_type(stmt.value)
+            if qual:
+                self.cls.attr_types.setdefault(attr, qual)
+            if self._is_lock_ctor(stmt.value) or _LOCKISH.search(attr):
+                self.cls.lock_attrs.add(attr)
+
+    def _track_annassign(self, stmt: ast.AnnAssign):
+        t = stmt.target
+        qual = self._annotation_type(stmt.annotation)
+        if isinstance(t, ast.Name):
+            if qual:
+                self.local_types[t.id] = qual
+        attr = self._self_attr(t)
+        if attr and self.cls is not None:
+            if qual:
+                self.cls.attr_types.setdefault(attr, qual)
+            if (stmt.value is not None and self._is_lock_ctor(stmt.value)) \
+                    or _LOCKISH.search(attr):
+                self.cls.lock_attrs.add(attr)
+
+    def _is_lock_ctor(self, node) -> bool:
+        return (isinstance(node, ast.Call)
+                and (self.module.resolve_name(node.func) or "") in _LOCK_CTORS)
+
+    def _ctor_type(self, node) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        name = self.module.resolve_name(node.func)
+        if name and name[0].isupper() or (name and "." in name
+                                          and name.rsplit(".", 1)[1][:1].isupper()):
+            return name
+        return None
+
+    # -- targets (stores) ------------------------------------------------
+    def _target(self, t: ast.expr):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+            return
+        if isinstance(t, ast.Subscript):
+            attr = self._self_attr(t.value)
+            if attr:
+                self._record(attr, MUTATE, t)
+            else:
+                self._expr(t.value)
+            self._expr(t.slice)
+            return
+        attr = self._self_attr(t)
+        if attr:
+            self._record(attr, WRITE, t)
+        elif isinstance(t, ast.Attribute):
+            self._expr(t.value)
+
+    # -- expressions -----------------------------------------------------
+    def _expr(self, node: ast.expr):
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, ast.Attribute):
+            attr = self._self_attr(node)
+            if attr:
+                self._record(attr, READ, node)
+                return
+            self._expr(node.value)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                attr = self._iterable_attr(gen.iter)
+                if attr:
+                    self._record(attr, ITERATE, gen.iter)
+                else:
+                    self._expr(gen.iter)
+                for cond in gen.ifs:
+                    self._expr(cond)
+            for part in ([node.key, node.value] if isinstance(node, ast.DictComp)
+                         else [node.elt]):
+                self._expr(part)
+            return
+        if isinstance(node, ast.Lambda):
+            self._expr(node.body)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _call(self, node: ast.Call):
+        func = node.func
+        resolved = self.module.resolve_name(func)
+        # ---- thread seeds ------------------------------------------------
+        self._maybe_seed(node, resolved)
+        # ---- iterating builtins over a self attr -------------------------
+        if isinstance(func, ast.Name) and func.id in _ITERATING_BUILTINS \
+                and node.args:
+            attr = self._self_attr(node.args[0]) \
+                or self._iterable_attr(node.args[0])
+            if attr:
+                self._record(attr, ITERATE, node)
+                for a in node.args[1:]:
+                    self._expr(a)
+                for kw in node.keywords:
+                    self._expr(kw.value)
+                return
+        # ---- method call on a self attribute -----------------------------
+        if isinstance(func, ast.Attribute):
+            recv_attr = self._self_attr(func.value)
+            if recv_attr:
+                if func.attr in _MUTATOR_METHODS:
+                    self._record(recv_attr, MUTATE, node)
+                elif func.attr in _ITERATOR_METHODS:
+                    self._record(recv_attr, ITERATE, node)
+                else:
+                    self._record(recv_attr, READ, node)
+                # typed attr → call edge into that class
+                if self.cls is not None:
+                    qual = self.cls.attr_types.get(recv_attr)
+                    if qual:
+                        self.fn.calls.append(CallSite(
+                            "typed", func.attr, qual, node.lineno,
+                            locks=frozenset(self.locks)))
+                    else:
+                        self.fn.calls.append(CallSite(
+                            "unique", func.attr, "", node.lineno,
+                            locks=frozenset(self.locks)))
+            elif isinstance(func.value, ast.Name) and func.value.id == "self":
+                self.fn.calls.append(CallSite("self", func.attr,
+                                              line=node.lineno,
+                                              locks=frozenset(self.locks)))
+            else:
+                # x.m() — typed local, else unique-name fallback
+                base = func.value
+                if isinstance(base, ast.Name) \
+                        and base.id in self.local_types:
+                    self.fn.calls.append(CallSite(
+                        "typed", func.attr, self.local_types[base.id],
+                        node.lineno, locks=frozenset(self.locks)))
+                else:
+                    self.fn.calls.append(CallSite("unique", func.attr, "",
+                                                  node.lineno,
+                                                  locks=frozenset(self.locks)))
+                self._expr(func.value)
+        elif resolved:
+            self.fn.calls.append(CallSite("name", resolved.rsplit(".", 1)[-1],
+                                          resolved, node.lineno,
+                                          locks=frozenset(self.locks)))
+        else:
+            self._expr(func)
+        for a in node.args:
+            self._expr(a)
+        for kw in node.keywords:
+            self._expr(kw.value)
+
+    # -- seeds -----------------------------------------------------------
+    def _maybe_seed(self, node: ast.Call, resolved: Optional[str]):
+        label = None
+        target_expr = None
+        name = resolved or ""
+        meth = node.func.attr if isinstance(node.func, ast.Attribute) else ""
+        if name in ("threading.Thread", "Thread") or name.endswith(".Thread"):
+            label = "thread-target"
+            target_expr = self._kwarg(node, "target")
+        elif name in ("threading.Timer", "Timer"):
+            label = "thread-target"
+            target_expr = node.args[1] if len(node.args) > 1 \
+                else self._kwarg(node, "function")
+        elif meth == "submit" and (node.args or node.keywords):
+            label = "pool-task"
+            target_expr = node.args[0] if node.args else None
+        elif meth == "subscribe" and node.args:
+            label = "subscriber"
+            target_expr = node.args[0]
+        elif name == "signal.signal" and len(node.args) > 1:
+            label = "signal-handler"
+            target_expr = node.args[1]
+        elif name in ("faulthandler.register",) and len(node.args) > 1:
+            label = "signal-handler"
+            target_expr = node.args[1]
+        elif meth in ("map",) and isinstance(node.func, ast.Attribute) \
+                and "executor" in ast.dump(node.func.value).lower():
+            label = "pool-task"
+            target_expr = node.args[0] if node.args else None
+        if label is None or target_expr is None:
+            return
+        self._seed_from(label, target_expr, node.lineno)
+
+    def _seed_from(self, label: str, target_expr: ast.expr, lineno: int):
+        # a target that is a nested def of THIS body labels this body
+        # directly — its statements were scanned into fn.accesses
+        if isinstance(target_expr, ast.Name) \
+                and target_expr.id in self.nested_defs:
+            self.fn.thread_labels.add(label)
+            self.fn.seeds.append(Seed(
+                label, CallSite("name", target_expr.id,
+                                self.fn.qualname, lineno), lineno))
+            return
+        site = self._callable_ref(target_expr)
+        if site is not None:
+            self.fn.seeds.append(Seed(label, site, lineno))
+
+    def _callable_ref(self, expr: ast.expr) -> Optional[CallSite]:
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return CallSite("self", expr.attr, line=expr.lineno)
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id in self.local_types:
+                return CallSite("typed", expr.attr,
+                                self.local_types[base.id], expr.lineno)
+            return CallSite("unique", expr.attr, "", expr.lineno)
+        if isinstance(expr, ast.Name):
+            dotted = self.module.imports.get(expr.id, None)
+            if dotted:
+                return CallSite("name", dotted.rsplit(".", 1)[-1], dotted,
+                                expr.lineno)
+            return CallSite("name", expr.id,
+                            f"{self.module.name}.{expr.id}", expr.lineno)
+        if isinstance(expr, ast.Lambda):
+            # seed every call the lambda body makes
+            body_calls: List[CallSite] = []
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    ref = self._callable_ref(sub.func)
+                    if ref is not None:
+                        body_calls.append(ref)
+            return body_calls[0] if body_calls else None
+        return None
+
+    @staticmethod
+    def _kwarg(node: ast.Call, name: str) -> Optional[ast.expr]:
+        for kw in node.keywords:
+            if kw.arg == name:
+                return kw.value
+        return None
+
+    # -- attr/lock helpers -----------------------------------------------
+    @staticmethod
+    def _self_attr(node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _iterable_attr(self, node) -> Optional[str]:
+        """self._x, self._x.items()/keys()/values(), or alias thereof —
+        the receiver attr being walked."""
+        attr = self._self_attr(node)
+        if attr:
+            return attr
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _ITERATOR_METHODS:
+            return self._self_attr(node.func.value)
+        return None
+
+    def _is_lock_name(self, attr: str) -> bool:
+        if self.cls is not None and attr in self.cls.lock_attrs:
+            return True
+        return bool(_LOCKISH.search(attr))
+
+    def _lock_of(self, expr) -> Optional[str]:
+        attr = self._self_attr(expr)
+        if attr and self._is_lock_name(attr):
+            return attr
+        if isinstance(expr, ast.Name) and expr.id in self.lock_aliases:
+            return self.lock_aliases[expr.id]
+        return None
+
+    def _record(self, attr: str, kind: str, node):
+        self.fn.accesses.append(Access(
+            attr=attr, kind=kind, locks=frozenset(self.locks),
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0) + 1))
+
+
+# --------------------------------------------------------------- program
+
+class Program:
+    """The whole-program model: build once, query from the passes."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: method name → every FunctionInfo defining it (unique-name edges)
+        self.methods_by_name: Dict[str, List[FunctionInfo]] = {}
+        self.seeds: List[Tuple[FunctionInfo, Seed]] = []
+        self.skipped: List[str] = []     # unparseable files (reported per-file)
+        #: memoized resolution — propagate() and inherited_locks() both
+        #: walk every call edge repeatedly; suffix-matching classes per
+        #: visit would be quadratic in tree size
+        self._dotted_cache: Dict[str, Optional[ClassInfo]] = {}
+        self._edge_cache: Dict[int, List[Tuple[CallSite, List[FunctionInfo]]]] = {}
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def build(cls, paths: Sequence[Path], root: Path) -> "Program":
+        prog = cls(root)
+        for f, rel in iter_py_files(paths, root):
+            try:
+                source = f.read_text(encoding="utf-8")
+                tree = ast.parse(source, filename=rel)
+            except (UnicodeDecodeError, SyntaxError):
+                prog.skipped.append(rel)   # per-file stage already reports it
+                continue
+            name = _module_name(rel)
+            prog.modules[name] = ModuleInfo(name, rel, source, tree)
+        for mod in prog.modules.values():
+            prog._scan_module(mod)
+        prog._finish()
+        return prog
+
+    def _scan_module(self, mod: ModuleInfo):
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                ci = ClassInfo(mod, node)
+                ci.base_names = [b for b in
+                                 (mod.resolve_name(base) for base in node.bases)
+                                 if b]
+                mod.classes[ci.name] = ci
+                self.classes[ci.qualname] = ci
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = FunctionInfo(mod, sub, ci)
+                        ci.methods[fi.name] = fi
+                        self.functions[fi.qualname] = fi
+                        self.methods_by_name.setdefault(fi.name, []).append(fi)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FunctionInfo(mod, node)
+                mod.functions[fi.name] = fi
+                self.functions[fi.qualname] = fi
+                self.methods_by_name.setdefault(fi.name, []).append(fi)
+        for fi in list(mod.functions.values()):
+            _BodyScanner(fi).scan()
+        for ci in mod.classes.values():
+            for fi in ci.methods.values():
+                _BodyScanner(fi).scan()
+            self._scan_guarded_by(ci)
+            self._scan_init_order(ci)
+
+    def _scan_guarded_by(self, ci: ClassInfo):
+        """# guarded-by: annotations inside the class body (usually
+        __init__): trailing on the attr's assignment line, or — mirroring
+        the pragma convention — on comment-only line(s) directly above it
+        (long reasons don't fit a trailing comment)."""
+        start = ci.node.lineno
+        end = max((getattr(n, "end_lineno", start) or start
+                   for n in ast.walk(ci.node)), default=start)
+        end = min(end, len(ci.module.lines))
+        for lineno in range(start, end + 1):
+            text = ci.module.lines[lineno - 1]
+            m = GUARDED_BY_RE.search(text)
+            if not m:
+                continue
+            am = _SELF_ATTR_ASSIGN_RE.search(text)
+            target_line = lineno
+            if am is None and text.lstrip().startswith("#"):
+                # comment-only annotation: covers the next code line,
+                # skipping further comment-only lines
+                nxt = lineno + 1
+                while nxt <= end and ci.module.lines[nxt - 1].lstrip() \
+                        .startswith("#"):
+                    nxt += 1
+                if nxt <= end:
+                    am = _SELF_ATTR_ASSIGN_RE.search(ci.module.lines[nxt - 1])
+                    target_line = nxt
+            if not am:
+                continue
+            ci.guarded_by[am.group(1)] = (m.group(1), target_line)
+
+    def _scan_init_order(self, ci: ClassInfo):
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        for a in init.accesses:
+            if a.kind == WRITE and a.attr not in ci.init_assign_line:
+                ci.init_assign_line[a.attr] = a.line
+        for seed in init.seeds:
+            ci.init_publishes.append((seed.line, seed))
+        # a Thread assigned in __init__ and .start()ed later in __init__:
+        # the seed is recorded at Thread(...); treat its line as publish.
+
+    def _finish(self):
+        # link base classes
+        for ci in self.classes.values():
+            for b in ci.base_names:
+                target = self._class_by_dotted(b)
+                if target is not None:
+                    ci.bases.append(target)
+        # collect seeds: explicit ones + http-handler classes
+        for fi in self.functions.values():
+            for seed in fi.seeds:
+                self.seeds.append((fi, seed))
+        for ci in self.classes.values():
+            if ci.is_http_handler():
+                for m in ci.methods.values():
+                    m.thread_labels.add("http-handler")
+
+    # -- resolution ------------------------------------------------------
+    def _class_by_dotted(self, dotted: str) -> Optional[ClassInfo]:
+        if dotted in self._dotted_cache:
+            return self._dotted_cache[dotted]
+        out = self._class_by_dotted_uncached(dotted)
+        self._dotted_cache[dotted] = out
+        return out
+
+    def _class_by_dotted_uncached(self, dotted: str) -> Optional[ClassInfo]:
+        if dotted in self.classes:
+            return self.classes[dotted]
+        # suffix match: imports may resolve to a shorter path than the
+        # file-derived module name (e.g. "gateway.ServingGateway" vs
+        # "paddle_tpu.gateway.ServingGateway")
+        tail = dotted.rsplit(".", 1)
+        if len(tail) == 2:
+            mod_tail, cls_name = tail
+            hits = [c for q, c in self.classes.items()
+                    if q.endswith(f"{mod_tail}.{cls_name}")
+                    or (q.split(".")[-1] == cls_name
+                        and q.split(".")[-2] == mod_tail.split(".")[-1])]
+            if len(hits) == 1:
+                return hits[0]
+        hits = [c for c in self.classes.values() if c.name == dotted]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+    def resolve_call(self, fn: FunctionInfo,
+                     site: CallSite) -> List[FunctionInfo]:
+        if site.shape == "self" and fn.cls is not None:
+            m = fn.cls.method(site.name)
+            return [m] if m is not None else []
+        if site.shape == "typed":
+            ci = self._class_by_dotted(site.qualifier)
+            if ci is not None:
+                m = ci.method(site.name)
+                if m is not None:
+                    return [m]
+            return self._unique(site.name)
+        if site.shape == "name":
+            dotted = site.qualifier
+            # module-level function?
+            if dotted in self.functions:
+                return [self.functions[dotted]]
+            mod_name, _, tail = dotted.rpartition(".")
+            mod = self.modules.get(mod_name)
+            if mod is not None and tail in mod.functions:
+                return [mod.functions[tail]]
+            # constructor → __init__
+            ci = self._class_by_dotted(dotted)
+            if ci is not None:
+                init = ci.method("__init__")
+                return [init] if init is not None else []
+            # suffix match on function qualnames
+            hits = [f for q, f in self.functions.items()
+                    if q.endswith("." + dotted.rsplit(".", 1)[-1])
+                    and f.cls is None]
+            if len(hits) == 1:
+                return hits
+            return []
+        if site.shape == "unique":
+            return self._unique(site.name)
+        return []
+
+    def _unique(self, name: str) -> List[FunctionInfo]:
+        if name in _NEVER_UNIQUE or name.startswith("__"):
+            return []
+        hits = self.methods_by_name.get(name, [])
+        return hits if len(hits) == 1 else []
+
+    def resolved_calls(self, fn: FunctionInfo,
+                       ) -> List[Tuple[CallSite, List[FunctionInfo]]]:
+        """fn's call sites with resolved targets, memoized — both fixpoint
+        walks (labels, inherited locks) revisit every edge per iteration."""
+        key = id(fn)
+        cached = self._edge_cache.get(key)
+        if cached is None:
+            cached = [(site, self.resolve_call(fn, site))
+                      for site in fn.calls]
+            self._edge_cache[key] = cached
+        return cached
+
+    # -- inherited locks -------------------------------------------------
+    def entry_points(self) -> Set[str]:
+        """Qualnames callable from OUTSIDE the modelled call graph with no
+        locks held: direct seed targets plus every http-handler method."""
+        out: Set[str] = set()
+        for fn, seed in self.seeds:
+            for t in self.resolve_call(fn, seed.target):
+                out.add(t.qualname)
+        for ci in self.classes.values():
+            if ci.is_http_handler():
+                out.update(m.qualname for m in ci.methods.values())
+        return out
+
+    def inherited_locks(self) -> Dict[str, frozenset]:
+        """Locks provably held on ENTRY to each body: the intersection,
+        over every resolved call site, of the locks held at the site plus
+        the caller's own inherited set (fixpoint).  Externally callable
+        bodies — public methods, dunders, module-level functions, direct
+        thread seeds, http-handler methods — start at ∅, since anyone can
+        call them bare.  This is what keeps the caller-holds-the-lock
+        helper convention (``emit() { with self._lock: self._append() }``,
+        the ``*_locked`` suffix family) from reading as unlocked access:
+        a private method ONLY ever called under ``self._lock`` inherits
+        it.  Private methods with no resolved caller at all resolve to ∅
+        too — dead code gets flagged rather than silently trusted."""
+        TOP = None                     # lattice top: unconstrained (no caller seen)
+        entries = self.entry_points()
+        inh: Dict[str, Optional[frozenset]] = {}
+        for q, fi in self.functions.items():
+            if (fi.cls is None or not fi.name.startswith("_")
+                    or fi.name.startswith("__") or q in entries):
+                inh[q] = frozenset()
+            else:
+                inh[q] = TOP
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.functions.items():
+                base = inh[q]
+                if base is TOP:
+                    continue           # caller itself unconstrained: no info yet
+                for site, targets in self.resolved_calls(fi):
+                    for target in targets:
+                        tq = target.qualname
+                        # lock names are class-scoped attrs: a cross-class
+                        # call can't carry the CALLER's lock names into the
+                        # callee — its contribution is ∅ (correctly meets
+                        # the target down to "no lock assumed").  self.m()
+                        # is always same-object, even when resolution lands
+                        # in a base class.
+                        same_cls = site.shape == "self" or (
+                            fi.cls is not None and target.cls is fi.cls)
+                        contribution = (site.locks | base) if same_cls \
+                            else frozenset()
+                        cur = inh[tq]
+                        new = contribution if cur is TOP else (cur & contribution)
+                        if new != cur:
+                            inh[tq] = new
+                            changed = True
+        return {q: (v if v is not None else frozenset())
+                for q, v in inh.items()}
+
+    # -- reachability ----------------------------------------------------
+    def propagate(self) -> Dict[str, Set[str]]:
+        """Flow thread labels from seeds through the call graph.  Returns
+        {method qualname → labels} for every labelled body (http-handler
+        classes are pre-labelled in _finish)."""
+        work: List[Tuple[FunctionInfo, str]] = []
+        for fn, seed in self.seeds:
+            for target in self.resolve_call(fn, seed.target):
+                work.append((target, seed.label))
+        for fi in self.functions.values():
+            for label in fi.thread_labels:
+                work.append((fi, label))
+        seen: Set[Tuple[str, str]] = set()
+        while work:
+            fn, label = work.pop()
+            key = (fn.qualname, label)
+            if key in seen:
+                continue
+            seen.add(key)
+            fn.thread_labels.add(label)
+            for _site, targets in self.resolved_calls(fn):
+                for target in targets:
+                    if (target.qualname, label) not in seen:
+                        work.append((target, label))
+        return {fi.qualname: set(fi.thread_labels)
+                for fi in self.functions.values() if fi.thread_labels}
+
+    # -- reporting -------------------------------------------------------
+    def seed_table(self) -> List[Dict[str, object]]:
+        rows = []
+        for fn, seed in self.seeds:
+            targets = [t.qualname for t in self.resolve_call(fn, seed.target)]
+            rows.append({"label": seed.label, "in": fn.qualname,
+                         "path": fn.module.rel_path, "line": seed.line,
+                         "target": seed.target.name,
+                         "resolved": sorted(targets)})
+        for ci in self.classes.values():
+            if ci.is_http_handler():
+                rows.append({"label": "http-handler", "in": ci.qualname,
+                             "path": ci.module.rel_path,
+                             "line": ci.node.lineno,
+                             "target": "*", "resolved":
+                             sorted(m.qualname for m in ci.methods.values())})
+        return sorted(rows, key=lambda r: (r["path"], r["line"]))
+
+
+def _module_name(rel_path: str) -> str:
+    parts = Path(rel_path).with_suffix("").parts
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else rel_path

@@ -235,7 +235,12 @@ class ElasticAutoscaler:
         # every access goes through _firing_lock
         self._firing_lock = threading.Lock()
         self._firing: set = set()
-        self._pending: List[_PendingSpawn] = []
+        # pending spawns and the decision ring are appended on the
+        # evaluate path but read by ops-server scrape threads
+        # (/autoscaler, metrics, prometheus_text); _state_lock is held
+        # only for list/deque ops, never across warmup or logging
+        self._state_lock = threading.Lock()
+        self._pending: List[_PendingSpawn] = []  # guarded-by: _state_lock
         self._draining: List[str] = []     # names this controller drained
         self._spawn_seq = 0
         self._last_up_at: Optional[float] = None
@@ -244,7 +249,7 @@ class ElasticAutoscaler:
         self._idle_since: Optional[float] = None
         self._last_decision = "none"
         self._last_decision_at: Optional[float] = None
-        self._decisions: collections.deque = collections.deque(
+        self._decisions: collections.deque = collections.deque(  # guarded-by: _state_lock
             maxlen=int(decision_history))
         # held-open expected-compile windows, keyed by replica name: the
         # entered context managers are exited on drain/close
@@ -361,7 +366,9 @@ class ElasticAutoscaler:
         draining + pending spawns — what the max bound is checked
         against."""
         active, draining = self._fleet()
-        return len(active) + len(draining) + len(self._pending)
+        with self._state_lock:
+            pending = len(self._pending)
+        return len(active) + len(draining) + pending
 
     # ---------------------------------------------------------- evaluate --
 
@@ -394,7 +401,9 @@ class ElasticAutoscaler:
         # dead replica that left the fleet short is replaced NOW (only
         # the spawn-FAILURE backoff gates it — a persistently broken
         # factory must not be retried every round)
-        if len(active) + len(self._pending) < self.min_replicas:
+        with self._state_lock:
+            n_pending = len(self._pending)
+        if len(active) + n_pending < self.min_replicas:
             if self._spawn_backoff(now):
                 return None
             return self._spawn(now, reason="min_bound", firing=firing,
@@ -504,8 +513,9 @@ class ElasticAutoscaler:
             # an unwarmed replica is strictly better than no replica
             self._log.warning("autoscaler: warmup failed for %s: %r",
                               name, e)
-        self._pending.append(_PendingSpawn(engine, name, future, report,
-                                           warmed, now, reason))
+        with self._state_lock:
+            self._pending.append(_PendingSpawn(engine, name, future, report,
+                                               warmed, now, reason))
         self._last_up_at = now
         self._stats.add("scale_ups")
         return self._record(
@@ -515,10 +525,13 @@ class ElasticAutoscaler:
 
     def _activate_ready(self, now: float) -> List[Dict[str, Any]]:
         made = []
-        for spawn in list(self._pending):
+        with self._state_lock:
+            pending = list(self._pending)
+        for spawn in pending:
             if not spawn.ready():
                 continue
-            self._pending.remove(spawn)
+            with self._state_lock:
+                self._pending.remove(spawn)
             if spawn.future is not None:
                 try:
                     spawn.report = spawn.future.result()
@@ -658,12 +671,15 @@ class ElasticAutoscaler:
 
     def _record(self, now: float, action: str, **fields) -> Dict[str, Any]:
         active, draining = self._fleet()
+        with self._state_lock:
+            pending = len(self._pending)
         ev = {"ts": now, "action": action,
               "fleet_active": len(active),
               "fleet_draining": len(draining),
-              "pending_spawns": len(self._pending)}
+              "pending_spawns": pending}
         ev.update({k: v for k, v in fields.items() if v is not None})
-        self._decisions.append(ev)
+        with self._state_lock:
+            self._decisions.append(ev)
         self._last_decision = action
         self._last_decision_at = now
         if self.tracer is not None:
@@ -681,7 +697,8 @@ class ElasticAutoscaler:
 
     def decisions(self) -> List[Dict[str, Any]]:
         """The bounded decision history, oldest first."""
-        return list(self._decisions)
+        with self._state_lock:
+            return list(self._decisions)
 
     def autoscaler_snapshot(self) -> Dict[str, Any]:
         """JSON-able live view — what ``ops_server``'s ``/autoscaler``
@@ -689,6 +706,8 @@ class ElasticAutoscaler:
         spawns, cooldown/dwell clocks, and the decision history."""
         now = self._clock()
         active, draining = self._fleet()
+        with self._state_lock:
+            pending = list(self._pending)
         return {
             "now": now,
             "policy": {
@@ -706,10 +725,10 @@ class ElasticAutoscaler:
                                else sorted(self._watched)),
             },
             "fleet": {"active": len(active), "draining": len(draining),
-                      "pending_spawns": len(self._pending),
+                      "pending_spawns": len(pending),
                       "replicas": [rep.to_dict()
                                    for rep in active + draining]},
-            "pending": [s.to_dict() for s in self._pending],
+            "pending": [s.to_dict() for s in pending],
             "signals": {"firing": self.firing(),
                         "breakers_open": self.breakers_open(),
                         "decode_pool_pressure": self.decode_pool_pressure(),
@@ -734,20 +753,26 @@ class ElasticAutoscaler:
         out = dict(self._stats.snapshot())
         out["fleet_active"] = float(len(active))
         out["fleet_draining"] = float(len(draining))
-        out["pending_spawns"] = float(len(self._pending))
-        out["alerts_firing"] = float(len(self._firing))
+        with self._state_lock:
+            out["pending_spawns"] = float(len(self._pending))
+        with self._firing_lock:
+            out["alerts_firing"] = float(len(self._firing))
         return out
 
     def prometheus_text(self, namespace: str = "paddle_tpu_autoscaler"
                         ) -> str:
         active, draining = self._fleet()
+        with self._state_lock:
+            pending = len(self._pending)
+        with self._firing_lock:
+            firing = len(self._firing)
         return _prometheus_text(
             self._stats, namespace=namespace,
             extra_gauges={
                 "fleet_size": len(active),
                 "fleet_draining": len(draining),
-                "pending_spawns": len(self._pending),
-                "alerts_firing": len(self._firing),
+                "pending_spawns": pending,
+                "alerts_firing": firing,
                 "min_replicas": self.min_replicas,
                 "max_replicas": self.max_replicas,
                 # enum gauge: index into DECISIONS (0 = no decision yet)

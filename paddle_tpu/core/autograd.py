@@ -88,6 +88,8 @@ class Node:
         # rebuild(*input_datas) -> tuple of differentiable raw outputs
         self.rebuild = rebuild
         self.diff_inputs = list(diff_inputs)  # Tensors we differentiate w.r.t.
+        # guarded-by: none (autograd tapes are built and walked on one
+        # thread; pool-task label is unique-name over-approximation)
         self.out_refs: List[weakref.ref] = []  # weakrefs to output Tensors
         self.name = name
         # re-installs ambient dispatch state (e.g. amp autocast) so backward's

@@ -2018,7 +2018,9 @@ class ServingGateway:
                 if r.state == ACTIVE and r.role != "prefill"]
         slots = sum(_engine_slots(r.engine) for r in reps)
         busy = sum(len(r.inflight) for r in reps)
-        queued = sum(len(q) for q in self._queues) + len(self._disagg)
+        with self._disagg_lock:
+            migrating = len(self._disagg)
+        queued = sum(len(q) for q in self._queues) + migrating
         return (busy + queued) / max(slots, 1)
 
     def prefix_index(self, prompt=None) -> Dict[str, Dict[str, Any]]:
@@ -2064,7 +2066,9 @@ class ServingGateway:
         return stores
 
     def has_kv_surface(self) -> bool:
-        return (bool(self._disagg) or bool(self._kvstats.snapshot())
+        with self._disagg_lock:
+            migrating = bool(self._disagg)
+        return (migrating or bool(self._kvstats.snapshot())
                 or bool(self._kv_stores())
                 or any(rep.role != "unified"
                        for rep in self._replicas.values()))
@@ -2393,10 +2397,12 @@ class ServingGateway:
             # /gateway, and the FlightRecorder's crash dumps
             out["resilience"] = self.resilience_snapshot()
         if self.has_kv_surface():
+            with self._disagg_lock:
+                migrating = len(self._disagg)
             # the light view; GET /kvstore serves the full one
             out["kvstore"] = {
                 "counters": dict(self._kvstats.snapshot()),
-                "migrations_inflight": len(self._disagg),
+                "migrations_inflight": migrating,
                 "decode_pool_pressure": round(
                     self.decode_pool_pressure(), 4)}
         return out
@@ -2442,10 +2448,12 @@ class ServingGateway:
                 m = st.metrics()
                 for k in tier:
                     tier[k] += float(m.get(k, 0.0))
+            with self._disagg_lock:
+                migrating = len(self._disagg)
             text += _prometheus_text(
                 self._kvstats, namespace="paddle_tpu_kvstore",
                 extra_gauges={
-                    "migrations_inflight": len(self._disagg),
+                    "migrations_inflight": migrating,
                     "decode_pool_pressure": self.decode_pool_pressure(),
                     **tier})
         return text

@@ -34,7 +34,10 @@ def _unique_name(prefix: str) -> str:
 
 class HookRemoveHelper:
     def __init__(self, hooks: dict, hid: int):
-        self._hooks, self._hid = hooks, hid
+        # guarded-by: none (hook registration/removal is module-build-time,
+        # single-threaded; pool-task label is unique-name over-approximation)
+        self._hooks = hooks
+        self._hid = hid
 
     def remove(self):
         self._hooks.pop(self._hid, None)
@@ -50,6 +53,8 @@ class Layer:
         self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
         self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
         self._non_persistable_buffer_names = set()
+        # guarded-by: none (layer trees are built and mutated on one thread
+        # before serving; thread labels here are unique-name over-approximation)
         self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
         self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
         self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()

@@ -905,7 +905,10 @@ class RequestTraceIndex:
     first event."""
 
     def __init__(self, sources=()):
-        self._sources: List[Tuple[str, Any]] = []
+        # attach happens at wiring time but the ops scrape thread scans
+        # concurrently; held only for list ops, never across a ring read
+        self._sources_lock = threading.Lock()
+        self._sources: List[Tuple[str, Any]] = []  # guarded-by: _sources_lock
         for src in sources:
             if isinstance(src, tuple):
                 self.add_source(src[1], src[0])
@@ -921,8 +924,9 @@ class RequestTraceIndex:
             raise TypeError(
                 f"unsupported trace source: {type(tracer).__name__} "
                 f"(want a Tracer or something carrying one)")
-        self._sources.append(
-            (name or f"source{len(self._sources)}", inner))
+        with self._sources_lock:
+            self._sources.append(
+                (name or f"source{len(self._sources)}", inner))
         return self
 
     # ------------------------------------------------------------- scans --
@@ -932,7 +936,9 @@ class RequestTraceIndex:
         """(source, event, absolute_ts) for every ring event carrying a
         trace_id (optionally one specific trace)."""
         out = []
-        for name, tr in self._sources:
+        with self._sources_lock:          # snapshot; ring reads outside
+            sources = list(self._sources)
+        for name, tr in sources:
             t0 = tr.t0
             for ev in tr.events():
                 tid = ev.get("trace_id")

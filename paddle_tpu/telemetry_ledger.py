@@ -145,7 +145,9 @@ class RunLedger:
         buckets on the same thread are absorbed — their wall time is
         already covered by the enclosing span, and double-attribution
         would break the buckets-sum-to-elapsed invariant."""
-        if bucket not in self._sec:
+        # validate against the static schema, not the live dict — reading
+        # self._sec here would race reset()'s locked rebind of it
+        if bucket not in _ATTRIBUTED:
             raise ValueError(f"unknown bucket {bucket!r}; one of {_ATTRIBUTED}")
         excl = getattr(self._tls, "exclusive", None)
         if excl and excl[-1] != bucket:
@@ -165,7 +167,9 @@ class RunLedger:
         ``exclusive=True`` additionally absorbs same-thread records for
         other buckets inside the block (``Model.evaluate`` uses it: the
         eval loop's data waits and fetches ARE eval time)."""
-        if bucket not in self._sec:
+        # validate against the static schema, not the live dict — reading
+        # self._sec here would race reset()'s locked rebind of it
+        if bucket not in _ATTRIBUTED:
             raise ValueError(f"unknown bucket {bucket!r}; one of {_ATTRIBUTED}")
         if exclusive:
             stack = getattr(self._tls, "exclusive", None)
@@ -376,7 +380,11 @@ class FlightRecorder:
     def __init__(self, crash_dir: str, sources=(),
                  logger: Optional[logging.Logger] = None):
         self.crash_dir = str(crash_dir)
-        self._sources: List[Tuple[str, Any]] = []
+        # dump() runs on signal/excepthook paths while the main thread may
+        # still be attaching sources; the lock is held only for list ops,
+        # never across a source dump, so the crash path can't deadlock
+        self._sources_lock = threading.Lock()
+        self._sources: List[Tuple[str, Any]] = []  # guarded-by: _sources_lock
         self._log = logger if logger is not None \
             else logging.getLogger(__name__)
         self._installed = False
@@ -405,8 +413,9 @@ class FlightRecorder:
         if not (hasattr(obj, "dump_jsonl") or hasattr(obj, "to_dict")
                 or hasattr(obj, "gateway_snapshot")):
             raise TypeError(f"unsupported flight-recorder source: {obj!r}")
-        self._sources.append((name or f"{type(obj).__name__.lower()}"
-                              f"{len(self._sources)}", obj))
+        with self._sources_lock:
+            self._sources.append((name or f"{type(obj).__name__.lower()}"
+                                  f"{len(self._sources)}", obj))
         return self
 
     # ------------------------------------------------------------- hooks --
@@ -501,7 +510,9 @@ class FlightRecorder:
                 json.dump(meta, f, indent=2)
             with open(os.path.join(out, "threads.txt"), "w") as f:
                 faulthandler.dump_traceback(file=f, all_threads=True)
-            for name, src in self._sources:
+            with self._sources_lock:      # snapshot; dump outside the lock
+                sources = list(self._sources)
+            for name, src in sources:
                 try:
                     if hasattr(src, "dump_jsonl"):
                         src.dump_jsonl(os.path.join(out, f"{name}.jsonl"))
@@ -525,7 +536,7 @@ class FlightRecorder:
                                       "to dump: %s", name, e)
             self._dumped = True
             self._log.warning("flight recorder: dumped %d source(s) to %s "
-                              "(%s)", len(self._sources), out, reason)
+                              "(%s)", len(sources), out, reason)
             return out
         except Exception as e:
             self._log.warning("flight recorder: dump failed: %s", e)

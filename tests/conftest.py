@@ -53,3 +53,16 @@ def _seed_all():
     paddle.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture
+def lock_sanitizer():
+    """Opt-in runtime lock-discipline recorder (docs/STATIC_ANALYSIS.md
+    § Lock-discipline sanitizer).  Tests ``instrument()`` the objects
+    under threaded exercise; any lock-order inversion or guarded-by
+    violation recorded during the test fails it at teardown with every
+    racing site listed."""
+    from paddle_tpu.analysis import LockSanitizer
+    san = LockSanitizer("pytest")
+    yield san
+    san.assert_clean()

@@ -568,6 +568,51 @@ class TestObservability:
         finally:
             srv.stop()
 
+    def test_scrape_races_decision_churn_guard_clean(self, lock_sanitizer):
+        """Regression for the unlocked ``_pending``/``_decisions``/
+        ``_firing`` reads: ``decisions()`` used to iterate the deque bare
+        while ``_record`` appended from the evaluate path (a RuntimeError
+        on a real ops thread), and ``metrics()`` read ``len(_firing)``
+        outside the lock the class itself documents.  The sanitizer
+        harvests the ``# guarded-by:`` declarations straight from the
+        source, so EVERY access — scrape thread or evaluate path — must
+        now hold the declared lock or this test fails at teardown."""
+        clk = SimClock()
+        fl = _Fleet(clk, replicas=1, scale_up_cooldown_s=0.0)
+        asc = fl.asc
+        wired = lock_sanitizer.instrument_guards(asc)
+        assert ("_pending", "_state_lock") in wired
+        assert ("_decisions", "_state_lock") in wired
+        assert lock_sanitizer.guard(asc, "_firing", "_firing_lock")
+        errors, stop = [], threading.Event()
+
+        def scrape():
+            try:
+                while not stop.is_set():
+                    asc.decisions()
+                    asc.metrics()
+                    asc.autoscaler_snapshot()
+                    asc.prometheus_text()
+                    asc.firing()
+                    asc.fleet_size()
+            except Exception as e:  # noqa: BLE001 — repro harness
+                errors.append(e)
+
+        threads = [threading.Thread(target=scrape, name=f"scrape{i}")
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            sim = TrafficSim(fl.gw, clk, flash_crowd(2.0, 30.0, 2.0, 10.0),
+                             autoscaler=asc)
+            sim.run(30.0)                 # spawn + activate churn
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+        assert asc.decisions()            # churn actually happened
+
     def test_slo_subscription_seeds_from_firing_state(self):
         """An autoscaler attached mid-incident sees the already-firing
         alert (alert_states seeding) and unsubscribes on close()."""

@@ -665,6 +665,50 @@ class TestDisaggSim:
         assert cb.probe_gid is None      # claim freed for the next probe
         _drive(gw, clock)
 
+    def test_scrape_threads_race_clean_during_migration(self,
+                                                        lock_sanitizer):
+        """Regression for the unlocked ``_disagg`` reads: scrape-surface
+        calls (``decode_pool_pressure`` / ``has_kv_surface`` / the
+        snapshots / prometheus) used to read the migration table bare
+        while ``step()`` popped completed jobs — a torn iterate on the
+        ops thread.  Hammer the whole scrape surface from three threads
+        while migrations start and finish; every lock is sanitizer-
+        instrumented, so an inversion or a raced iterate fails here."""
+        import threading
+
+        clock = SimClock()
+        gw, tr, pf, dc = _sim_fleet(clock, bytes_per_tick=64,
+                                    prefill_ticks_per_block=0)
+        lock_sanitizer.instrument(gw)
+        errors, stop = [], threading.Event()
+
+        def scrape():
+            try:
+                while not stop.is_set():
+                    gw.decode_pool_pressure()
+                    gw.has_kv_surface()
+                    gw.gateway_snapshot()
+                    gw.kvstore_snapshot()
+                    gw.prometheus_text()
+            except Exception as e:  # noqa: BLE001 — repro harness
+                errors.append(e)
+
+        threads = [threading.Thread(target=scrape, name=f"scrape{i}")
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(6):
+                prompt = [t + 20 * i for t in range(1, 17)]
+                h = gw.submit(prompt, 4)
+                _drive(gw, clock, limit=2000)
+                assert h.status == "finished"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+
     def test_tier_aware_routing_deep_dram_beats_shallow_hbm(self):
         """The fleet-index contract: a replica whose DRAM tier holds a
         DEEP prefix outranks one with a shallow HBM hit."""

@@ -466,6 +466,42 @@ class TestFlightRecorder:
         with pytest.raises(TypeError):
             FlightRecorder(str(tmp_path), sources=[object()])
 
+    def test_add_source_races_dump_guard_clean(self, tmp_path,
+                                               lock_sanitizer):
+        """Regression for the unlocked ``_sources`` list: ``dump()`` runs
+        on signal/excepthook paths and used to iterate the list bare
+        while the main thread was still ``add_source``-ing — the crash
+        handler could tear mid-append and destroy the evidence.  The
+        sanitizer harvests the ``# guarded-by: _sources_lock``
+        declaration, so the snapshot-under-lock discipline is checked at
+        every access while dumps and attaches genuinely overlap."""
+        fr = FlightRecorder(str(tmp_path / "crash"))
+        wired = lock_sanitizer.instrument_guards(fr)
+        assert ("_sources", "_sources_lock") in wired
+        errors, stop = [], threading.Event()
+
+        def dumper():
+            try:
+                i = 0
+                while not stop.is_set():
+                    fr.dump(f"overlap-{i}")
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — repro harness
+                errors.append(e)
+
+        t = threading.Thread(target=dumper, name="dumper")
+        t.start()
+        try:
+            for i in range(20):
+                led = RunLedger()
+                led.record("compute", 0.01)
+                fr.add_source(led, f"src{i}")
+        finally:
+            stop.set()
+            t.join()
+        assert not errors, errors
+        assert fr.dump("final") is not None      # all 20 attached
+
 
 class TestFitExceptionTeardown:
     def test_raise_mid_fit_never_leaks_active_ledger(self):

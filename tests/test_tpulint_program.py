@@ -1,0 +1,544 @@
+"""tpulint --program stage: whole-program concurrency passes + sanitizer.
+
+Layers, mirroring test_tpulint_gate.py's structure for the per-file stage:
+
+1. the frozen fixture corpus — every bad_* fixture trips EXACTLY its one
+   rule and every clean_* twin is silent, so each program rule has a
+   CI-exercised true positive and a near-miss;
+2. the program model itself (reachability seeds + label propagation,
+   guarded-by inference corner cases, inherited-locks fixpoint) over
+   scratch trees;
+3. the runtime lock sanitizer (order-graph inversions, guarded-container
+   violations, annotation harvesting) — the dynamic complement;
+4. the CLI: --program JSON schema, stage-aware ratchet, --changed-only,
+   and the per-file result cache.
+
+Everything here is stdlib-only — no JAX import, same as the linter.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from paddle_tpu.analysis import (PROGRAM_RULES, LockSanitizer, Program,
+                                 analyze_program)
+
+ROOT = pathlib.Path(__file__).parent.parent
+CLI = ROOT / "tools" / "tpulint.py"
+FIXTURES = ROOT / "paddle_tpu" / "analysis" / "fixtures" / "program"
+
+
+def _run(*args, **kw):
+    return subprocess.run([sys.executable, str(CLI), *map(str, args)],
+                          capture_output=True, text=True, **kw)
+
+
+def _analyze(path):
+    findings, report = analyze_program([path], root=ROOT)
+    return findings, report
+
+
+def _analyze_src(tmp_path, source, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return analyze_program([tmp_path], root=tmp_path)
+
+
+def _build(tmp_path, source, name="mod.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return Program.build([tmp_path], root=tmp_path)
+
+
+# ----------------------------------------------------------- fixture corpus
+
+@pytest.mark.parametrize("fixture, rule", [
+    ("bad_disagg", "guarded-by-race"),
+    ("bad_firing", "unguarded-shared-state"),
+    ("bad_publish.py", "publish-before-init"),
+    ("bad_annotation.py", "bad-guarded-by"),
+])
+def test_bad_fixture_trips_exactly_its_rule(fixture, rule):
+    findings, _ = _analyze(FIXTURES / fixture)
+    rules = {f.rule for f in findings}
+    assert rules == {rule}, (
+        f"{fixture} must trip ONLY {rule}, got: "
+        + "\n".join(f.render() for f in findings))
+
+
+@pytest.mark.parametrize("fixture", [
+    "clean_disagg", "clean_firing", "clean_publish.py",
+    "clean_annotation.py",
+])
+def test_clean_twin_is_silent(fixture):
+    findings, _ = _analyze(FIXTURES / fixture)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_disagg_acceptance_shape():
+    """ISSUE acceptance: the race pass must flag the exact post-PR-8
+    gateway._disagg reproduction — unlocked iterate of a lock-written
+    dict from an http-handler path — naming the guard and the threads."""
+    findings, _ = _analyze(FIXTURES / "bad_disagg")
+    [f] = findings
+    assert f.path.endswith("bad_disagg/gateway_mod.py")
+    assert "_jobs" in f.message and "_jobs_lock" in f.message
+    assert "http-handler" in f.message
+
+
+def test_firing_acceptance_shape():
+    """ISSUE acceptance: unlocked set churn from a subscriber callback
+    against main-path iteration — the pre-PR-11 autoscaler._firing
+    shape — with every racing site listed."""
+    findings, _ = _analyze(FIXTURES / "bad_firing")
+    assert len(findings) == 3          # add + discard + sorted() iterate
+    assert all("_firing" in f.message for f in findings)
+    assert any("subscriber" in f.message for f in findings)
+
+
+def test_every_program_rule_has_a_fixture_true_positive():
+    findings, _ = _analyze(FIXTURES)
+    assert {f.rule for f in findings} == set(PROGRAM_RULES)
+
+
+# ------------------------------------------------- reachability + seeding
+
+def test_thread_seed_labels(tmp_path):
+    _, report = _analyze_src(tmp_path, """\
+        import concurrent.futures
+        import signal
+        import threading
+
+        class Widget:
+            def __init__(self, monitor, pool):
+                threading.Thread(target=self._spin, daemon=True).start()
+                monitor.subscribe(self._on_alert)
+                pool.submit(self._crunch)
+                signal.signal(signal.SIGTERM, self._on_term)
+
+            def _spin(self): pass
+            def _on_alert(self, alert): pass
+            def _crunch(self): pass
+            def _on_term(self, *a): pass
+        """)
+    by_target = {row["target"]: row["label"] for row in report.seed_table}
+    assert by_target["_spin"] == "thread-target"
+    assert by_target["_on_alert"] == "subscriber"
+    assert by_target["_crunch"] == "pool-task"
+    assert by_target["_on_term"] == "signal-handler"
+
+
+def test_http_handler_methods_are_entry_points(tmp_path):
+    prog = _build(tmp_path, """\
+        from http.server import BaseHTTPRequestHandler
+
+        class Routes(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self._render()
+            def _render(self): pass
+        """)
+    shared = prog.propagate()
+    assert "http-handler" in shared["mod.Routes.do_GET"]
+    # the label flows through the call graph, not just the entry method
+    assert "http-handler" in shared["mod.Routes._render"]
+
+
+def test_labels_propagate_transitively(tmp_path):
+    prog = _build(tmp_path, """\
+        import threading
+
+        class Deep:
+            def __init__(self):
+                threading.Thread(target=self._a).start()
+            def _a(self): self._b()
+            def _b(self): self._c()
+            def _c(self): pass
+            def _unreached(self): pass
+        """)
+    shared = prog.propagate()
+    assert "thread-target" in shared["mod.Deep._c"]
+    assert "mod.Deep._unreached" not in shared
+
+
+# ---------------------------------------------------- guarded-by corners
+
+def test_aliased_lock_counts_as_held(tmp_path):
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Aliased:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                with self._lock:
+                    self._jobs["k"] = 1
+            def snapshot(self):
+                lk = self._lock
+                with lk:
+                    return dict(self._jobs)
+        """)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_multi_item_and_nested_with(tmp_path):
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Nested:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+                self._jobs = {}
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                with self._a, self._b:
+                    self._jobs["k"] = 1
+            def snapshot(self):
+                with self._a:
+                    with self._b:
+                        return dict(self._jobs)
+        """)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_comment_above_annotation_is_recognized(tmp_path):
+    prog = _build(tmp_path, """\
+        import threading
+
+        class Annotated:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded-by: none (scratch rebuilt per call; the extra
+                # comment line here must not break the attachment)
+                self._scratch = []
+                self._live = {}     # guarded-by: _lock
+        """)
+    ci = prog.classes["mod.Annotated"]
+    assert ci.guarded_by["_scratch"][0] == "none"
+    assert ci.guarded_by["_live"][0] == "_lock"
+
+
+def test_declared_guard_flags_unlocked_threaded_read(tmp_path):
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Declared:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._jobs = {}     # guarded-by: _lock
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                for k in self._jobs:     # iterate without the lock
+                    pass
+            def put(self, k):
+                with self._lock:
+                    self._jobs[k] = 1
+        """)
+    [f] = [f for f in findings if f.rule == "guarded-by-race"]
+    assert "declared" in f.message and "_spin" in f.message
+
+
+def test_inherited_locks_suppress_helper_false_positive(tmp_path):
+    """A private helper called ONLY with the lock held must not read as
+    an unlocked access — the Tracer._append shape the fixpoint exists
+    for.  The unlocked-caller twin below must still be flagged."""
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Held:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                with self._lock:
+                    self._append(1)
+            def put(self, x):
+                with self._lock:
+                    self._append(x)
+            def _append(self, x):
+                self._rows.append(x)     # caller provably holds _lock
+        """)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Leaky:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rows = []
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                with self._lock:
+                    self._append(1)
+            def put(self, x):
+                self._append(x)          # one unlocked caller breaks it
+            def _append(self, x):
+                self._rows.append(x)
+        """, name="leaky.py")
+    assert any(f.rule in ("guarded-by-race", "unguarded-shared-state")
+               for f in findings), "\n".join(f.render() for f in findings)
+
+
+def test_base_class_declaration_covers_subclass(tmp_path):
+    """A # guarded-by: none on the base's init line must silence the
+    subclass's mutations too (the Layer/LayerDict shape)."""
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Base:
+            def __init__(self):
+                # guarded-by: none (built on one thread, frozen after)
+                self._subs = {}
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                for k in self._subs:
+                    pass
+
+        class Child(Base):
+            def add(self, k, v):
+                self._subs[k] = v
+        """)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_pragma_suppresses_program_finding(tmp_path):
+    findings, _ = _analyze_src(tmp_path, """\
+        import threading
+
+        class Pragmad:
+            def __init__(self):
+                self._jobs = {}
+                threading.Thread(target=self._spin).start()
+            def _spin(self):
+                self._jobs["k"] = 1  # tpulint: disable=unguarded-shared-state (test)
+            def snapshot(self):
+                return dict(self._jobs)  # tpulint: disable=unguarded-shared-state (test)
+        """)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+# ------------------------------------------------------- runtime sanitizer
+
+def test_sanitizer_records_lock_order_inversion():
+    san = LockSanitizer("inversion")
+    a = san.wrap(threading.Lock(), "a")
+    b = san.wrap(threading.Lock(), "b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:                       # reverse order closes the cycle
+            pass
+    [v] = san.violations()
+    assert v["kind"] == "lock-order-inversion"
+    assert v["edge"] == "b -> a"
+    assert __file__ in v["site"]
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        san.assert_clean()
+
+
+def test_sanitizer_consistent_order_is_clean():
+    san = LockSanitizer("ordered")
+    a = san.wrap(threading.Lock(), "a")
+    b = san.wrap(threading.Lock(), "b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    san.assert_clean()
+    assert ("a", "b") in san.lock_order_edges()
+
+
+def test_guarded_container_records_unlocked_access():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = {}
+
+    box = Box()
+    san = LockSanitizer("guard")
+    assert san.guard(box, "_jobs", "_lock")
+    with box._lock:
+        box._jobs["k"] = 1            # held: clean
+    assert isinstance(box._jobs, dict)  # __class__ forwarding
+    for _k in box._jobs:              # iterate without the lock: recorded
+        pass
+    box._jobs.pop("k")                # mutate without the lock: recorded
+    kinds = [(v["kind"], v["op"]) for v in san.violations()]
+    assert kinds == [("guarded-by", "iterate"), ("guarded-by", "mutate")]
+    with pytest.raises(AssertionError, match="guarded-by violation"):
+        san.assert_clean()
+
+
+def test_guard_violation_recorded_not_raised_in_thread():
+    """The proxy must RECORD from a second thread, never raise into it —
+    raising inside __iter__ would turn a diagnosis into a new crash."""
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._jobs = {"k": 1}
+
+    box = Box()
+    san = LockSanitizer("threaded")
+    san.guard(box, "_jobs", "_lock")
+    errors = []
+
+    def reader():
+        try:
+            for _k in box._jobs:
+                pass
+        except BaseException as e:     # pragma: no cover - the bug case
+            errors.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join()
+    assert not errors
+    [v] = san.violations()
+    assert v["thread"] == t.name and v["op"] == "iterate"
+
+
+def test_instrument_guards_harvests_annotations():
+    """Statically-declared discipline becomes a runtime assertion with no
+    duplicate bookkeeping — trailing AND comment-above forms."""
+    class Annotated:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._live = {}      # guarded-by: _lock
+            # guarded-by: _lock
+            self._also = []
+            self._free = set()   # guarded-by: none (never shared)
+
+    obj = Annotated()
+    san = LockSanitizer("harvest")
+    wired = san.instrument_guards(obj)
+    assert sorted(wired) == [("_also", "_lock"), ("_live", "_lock")]
+    with obj._lock:
+        obj._live["k"] = 1
+        obj._also.append(1)
+    san.assert_clean()
+    obj._live["k"] = 2               # unlocked: recorded
+    assert [v["attr"] for v in san.violations()] == ["Annotated._live"]
+
+
+def test_instrument_wraps_all_lock_attrs_idempotently():
+    class Two:
+        def __init__(self):
+            self._a = threading.Lock()
+            self._b = threading.RLock()
+            self._not_a_lock = 7
+
+    obj = Two()
+    san = LockSanitizer("wrap")
+    assert sorted(san.instrument(obj)) == ["_a", "_b"]
+    assert san.instrument(obj) == []   # second pass: nothing left to wrap
+    with obj._a:
+        assert obj._a.held_by_current_thread()
+    assert not obj._a.held_by_current_thread()
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_program_json_schema(tmp_path):
+    res = _run("--no-baseline", "--json", "--program", "--no-cache",
+               FIXTURES / "bad_disagg", cwd=ROOT)
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["guarded-by-race"]
+    prog = doc["program"]
+    assert set(prog) == {"thread_entries", "shared_methods", "guarded_attrs"}
+    labels = {row["label"] for row in prog["thread_entries"]}
+    assert "http-handler" in labels
+    [row] = prog["guarded_attrs"]
+    assert row["attr"] == "_jobs" and row["lock"] == "_jobs_lock"
+
+
+def test_cli_program_ratchet_is_stage_aware(tmp_path):
+    """A baseline written WITH --program must not read as stale in a
+    per-file-only run (and vice versa) — the two stages share one file."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "a.py").write_text((FIXTURES / "bad_publish.py").read_text())
+    baseline = tmp_path / "baseline.json"
+
+    def run(*extra):
+        return _run("--root", tmp_path, "--baseline", baseline,
+                    "--no-cache", "proj", *extra)
+
+    assert run("--write-baseline", "--program").returncode == 0
+    assert run("--program").returncode == 0
+    # per-file-only run: frozen program counts are out of scope, not stale
+    assert run().returncode == 0
+    # burning the program finding down IS stale for a --program run
+    (proj / "a.py").write_text("x = 1\n")
+    assert run().returncode == 0
+    res = run("--program")
+    assert res.returncode == 3
+    assert "STALE" in res.stderr
+    assert run("--write-baseline", "--program").returncode == 0
+    assert run("--program").returncode == 0
+
+
+def test_cli_changed_only_lints_only_git_changed(tmp_path):
+    git = ["git", "-C", str(tmp_path)]
+    subprocess.run(git + ["init", "-q"], check=True)
+    subprocess.run(git + ["-c", "user.email=t@t", "-c", "user.name=t",
+                          "commit", "-q", "--allow-empty", "-m", "seed"],
+                   check=True)
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    # committed file carries a violation; only the NEW file should be seen
+    (proj / "old.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+    subprocess.run(git + ["add", "proj/old.py"], check=True)
+    subprocess.run(git + ["-c", "user.email=t@t", "-c", "user.name=t",
+                          "commit", "-q", "-m", "old"], check=True)
+    (proj / "new.py").write_text("x = 1\n")
+    res = _run("--root", tmp_path, "--no-baseline", "--no-cache",
+               "--changed-only", "--json", "proj")
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    paths = {f["path"] for f in doc["findings"]}
+    assert "proj/old.py" not in paths  # unchanged: skipped entirely
+
+    (proj / "new.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+    res = _run("--root", tmp_path, "--no-baseline", "--no-cache",
+               "--changed-only", "--json", "proj")
+    assert res.returncode == 1
+    doc = json.loads(res.stdout)
+    assert {f["path"] for f in doc["findings"]} == {"proj/new.py"}
+
+
+def test_cli_cache_round_trip(tmp_path):
+    """Second run over an unchanged tree must serve from the memo (same
+    findings), and an edit must invalidate just that file."""
+    proj = tmp_path / "proj"
+    proj.mkdir()
+    (proj / "a.py").write_text(
+        "try:\n    pass\nexcept Exception:\n    pass\n")
+    cache = tmp_path / "cache.json"
+
+    def run():
+        res = _run("--root", tmp_path, "--no-baseline", "--json",
+                   "--cache", cache, "proj")
+        return res.returncode, json.loads(res.stdout)["findings"]
+
+    rc1, f1 = run()
+    assert rc1 == 1 and cache.exists()
+    cached = json.loads(cache.read_text())
+    assert "proj/a.py" in cached["files"]
+    rc2, f2 = run()
+    assert (rc2, f2) == (rc1, f1)      # memo hit: identical verdict
+    (proj / "a.py").write_text("x = 1\n")
+    rc3, f3 = run()
+    assert rc3 == 0 and f3 == []       # stale entry replaced, not reused

@@ -606,16 +606,65 @@ then
     echo "COLLECT SMOKE FAILED: memory-ledger round trip"
     exit 1
 fi
-# tpulint gate: any NEW violation vs tools/tpulint_baseline.json fails
-# (exit 1, rule id + file:line printed above); a STALE baseline (violations
-# burned down but baseline not shrunk) fails with exit 3 — regenerate via
-# `python tools/tpulint.py --write-baseline paddle_tpu tools`.  The linter
-# is stdlib-only (no JAX import), so this stage costs seconds.
-python tools/tpulint.py paddle_tpu tools
+# tpulint gate, per-file rules + whole-program concurrency passes: any NEW
+# violation vs tools/tpulint_baseline.json fails (exit 1, rule id +
+# file:line printed above); a STALE baseline (violations burned down but
+# baseline not shrunk) fails with exit 3 — regenerate via
+# `python tools/tpulint.py --write-baseline --program paddle_tpu tools`.
+# The linter is stdlib-only (no JAX import), so the full sweep costs
+# seconds (<30 s is the budget tests/test_tpulint_gate.py enforces).
+python tools/tpulint.py --program paddle_tpu tools
 lint_rc=$?
 if [ "$lint_rc" -ne 0 ]; then
     echo "COLLECT SMOKE FAILED: tpulint (rc=$lint_rc; 1=new violations," \
          "3=stale baseline — see docs/STATIC_ANALYSIS.md)"
+    exit 1
+fi
+# lock-discipline sanitizer smoke: the runtime complement to --program.
+# A sanitizer-instrumented threaded round trip over a real gateway's
+# scrape surface must record ZERO violations, and the sanitizer itself
+# must still CATCH a deliberate lock-order inversion (the detector is
+# alive, not just silent).
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'SANEOF'
+import threading
+from paddle_tpu.analysis import LockSanitizer
+from paddle_tpu.gateway import ServingGateway
+from paddle_tpu.simulation import SimClock, SimEngine, SimTracer
+san = LockSanitizer("smoke")
+clock = SimClock()
+gw = ServingGateway(clock=clock, tracer=SimTracer(clock))
+gw.add_replica(SimEngine(max_slots=2, tracer=SimTracer(clock)), "r0")
+san.instrument(gw)
+stop = threading.Event()
+errors = []
+def scrape():
+    try:
+        while not stop.is_set():
+            gw.gateway_snapshot(); gw.prometheus_text()
+    except Exception as e:
+        errors.append(e)
+t = threading.Thread(target=scrape)
+t.start()
+h = gw.submit([1, 2, 3], 8)
+for _ in range(200):
+    gw.step(); clock.advance(0.25)
+    if not gw.pending():
+        break
+stop.set(); t.join()
+assert not errors, errors
+assert h.status == "finished"
+san.assert_clean()
+bad = LockSanitizer("canary")
+a = bad.wrap(threading.Lock(), "a")
+b = bad.wrap(threading.Lock(), "b")
+with a:
+    with b: pass
+with b:
+    with a: pass
+assert any(v["kind"] == "lock-order-inversion" for v in bad.violations())
+SANEOF
+then
+    echo "COLLECT SMOKE FAILED: lock-sanitizer threaded smoke"
     exit 1
 fi
 echo "collect smoke OK"

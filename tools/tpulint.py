@@ -2,9 +2,13 @@
 """tpulint CLI — JAX/TPU correctness lint with a ratcheted baseline.
 
 Usage:
-    python tools/tpulint.py paddle_tpu tools            # CI gate (baseline)
+    python tools/tpulint.py paddle_tpu tools            # per-file CI gate
+    python tools/tpulint.py --program paddle_tpu tools  # + whole-program
+                                                        #   concurrency passes
+    python tools/tpulint.py --changed-only              # pre-commit: only
+                                                        #   git-changed files
     python tools/tpulint.py --no-baseline some/file.py  # raw findings
-    python tools/tpulint.py --write-baseline paddle_tpu tools
+    python tools/tpulint.py --write-baseline --program paddle_tpu tools
     python tools/tpulint.py --json paddle_tpu tools     # machine-readable
     python tools/tpulint.py --list-rules
 
@@ -17,6 +21,18 @@ Exit codes (the contract tools/collect_smoke.sh and CI key off):
        records; shrink it with --write-baseline so the ratchet only
        turns one way
 
+Stages: the per-file rule sweep always runs; ``--program`` adds the
+whole-program concurrency passes (thread-entry reachability, guarded-by
+race detection — docs/STATIC_ANALYSIS.md § Whole-program passes).  The
+baseline diff is stage-aware: a per-file-only run never reads the frozen
+program-pass counts as stale, and vice versa.
+
+``--changed-only`` lints just the files git reports modified/untracked
+(pre-commit speed path; implies skipping the program stage and the stale
+check, both of which need the whole tree).  Per-file results are
+memoized in ``.tpulint_cache.json`` keyed by content hash + engine
+digest, so an unchanged file costs a dict lookup, not a parse.
+
 The engine lives in paddle_tpu/analysis/, loaded here by file path so the
 lint never imports JAX (paddle_tpu/__init__.py pulls in the full
 framework; a commit-time linter must not pay that).
@@ -25,14 +41,21 @@ framework; a commit-time linter must not pay that).
 from __future__ import annotations
 
 import argparse
+import hashlib
 import importlib.util
 import json
+import subprocess
 import sys
 import time
 from pathlib import Path
 
 ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_BASELINE = ROOT / "tools" / "tpulint_baseline.json"
+DEFAULT_CACHE = ROOT / ".tpulint_cache.json"
+CACHE_VERSION = 1
+
+#: engine sources whose content invalidates every memoized result
+_ENGINE_FILES = ("engine.py", "rules.py", "program.py", "concurrency.py")
 
 
 def load_analysis():
@@ -45,6 +68,98 @@ def load_analysis():
     sys.modules["_tpulint_analysis"] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def engine_digest() -> str:
+    h = hashlib.blake2b(digest_size=16)
+    for name in _ENGINE_FILES:
+        p = ROOT / "paddle_tpu" / "analysis" / name
+        if p.exists():
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def changed_files(root: Path):
+    """Repo-relative paths git reports as modified (vs HEAD) or untracked.
+    None when git is unavailable (caller falls back to a full sweep)."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    names = set(diff.stdout.splitlines()) | set(untracked.stdout.splitlines())
+    return sorted(n for n in names if n.endswith(".py"))
+
+
+class ResultCache:
+    """Per-file finding memo keyed by source content hash; the engine
+    digest gates the whole cache so a rule edit re-lints everything."""
+
+    def __init__(self, path: Path, digest: str):
+        self.path = path
+        self.digest = digest
+        self.files = {}
+        self.dirty = False
+        try:
+            data = json.loads(path.read_text())
+            if data.get("version") == CACHE_VERSION \
+                    and data.get("engine") == digest:
+                self.files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, rel: str, content_hash: str):
+        entry = self.files.get(rel)
+        if entry and entry.get("hash") == content_hash:
+            return entry["findings"]
+        return None
+
+    def put(self, rel: str, content_hash: str, findings):
+        self.files[rel] = {"hash": content_hash, "findings": findings}
+        self.dirty = True
+
+    def save(self):
+        if not self.dirty:
+            return
+        try:
+            self.path.write_text(json.dumps(
+                {"version": CACHE_VERSION, "engine": self.digest,
+                 "files": self.files}, sort_keys=True))
+        except OSError:
+            pass                       # a cache must never fail the lint
+
+
+def lint_files_cached(analysis, paths, root: Path, cache):
+    """Per-file sweep with memoization; findings round-trip the cache as
+    plain dicts (same schema as --json)."""
+    import dataclasses
+    out = []
+    for f, rel in analysis.iter_py_files(paths, root):
+        try:
+            source = f.read_text(encoding="utf-8")
+        except UnicodeDecodeError as e:
+            out.append(analysis.Finding(path=rel, line=1, col=1,
+                                        rule="syntax-error",
+                                        message=f"not valid UTF-8: {e.reason}"))
+            continue
+        if cache is not None:
+            h = hashlib.blake2b(source.encode("utf-8"),
+                                digest_size=16).hexdigest()
+            hit = cache.get(rel, h)
+            if hit is not None:
+                out.extend(analysis.Finding(**d) for d in hit)
+                continue
+        findings = analysis.lint_source(rel, source)
+        if cache is not None:
+            cache.put(rel, h, [dataclasses.asdict(x) for x in findings])
+        out.extend(findings)
+    return sorted(out)
 
 
 def main(argv=None) -> int:
@@ -63,6 +178,16 @@ def main(argv=None) -> int:
                     help="emit findings + counts as JSON on stdout")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--program", action="store_true",
+                    help="also run the whole-program concurrency passes "
+                         "(thread-entry reachability + guarded-by races)")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="lint only git-modified/untracked files (pre-commit "
+                         "path; skips the program stage and the stale check)")
+    ap.add_argument("--cache", type=Path, default=DEFAULT_CACHE,
+                    help="per-file memo file (default: %(default)s)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="disable the per-file result memo")
     ap.add_argument("--root", type=Path, default=ROOT,
                     help="repo root for relative paths (default: %(default)s)")
     args = ap.parse_args(argv)
@@ -72,22 +197,74 @@ def main(argv=None) -> int:
     if args.list_rules:
         for name, rule in sorted(analysis.RULES.items()):
             print(f"{name}\n    {rule.hazard}")
+        for name, hazard in sorted(analysis.PROGRAM_RULES.items()):
+            print(f"{name} [--program]\n    {hazard}")
         return 0
 
+    if args.changed_only and args.write_baseline:
+        print("tpulint: --write-baseline needs the full sweep, not "
+              "--changed-only (the baseline would silently shrink to the "
+              "changed subset)", file=sys.stderr)
+        return 2
+
     t0 = time.monotonic()
+    req_paths = list(args.paths or ["paddle_tpu", "tools"])
     paths = [Path(p) if Path(p).is_absolute() else args.root / p
-             for p in (args.paths or ["paddle_tpu", "tools"])]
+             for p in req_paths]
     for p in paths:
         if not p.exists():
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
-    findings = analysis.lint_paths(paths, root=args.root)
+
+    if args.changed_only:
+        rels = changed_files(args.root)
+        if rels is None:
+            print("tpulint: --changed-only: git unavailable, falling back "
+                  "to the full sweep", file=sys.stderr)
+        else:
+            roots = [p.resolve() for p in paths]
+            selected = []
+            for rel in rels:
+                f = (args.root / rel).resolve()
+                if f.exists() and any(r == f or r in f.parents
+                                      for r in roots):
+                    selected.append(f)
+            if not selected:
+                print("tpulint: --changed-only: no changed .py files under "
+                      f"{req_paths}; nothing to lint", file=sys.stderr)
+                return 0
+            paths = selected
+
+    cache = None
+    if not args.no_cache:
+        cache = ResultCache(args.cache, engine_digest())
+    findings = lint_files_cached(analysis, paths, args.root, cache)
+    if cache is not None:
+        cache.save()
+    file_elapsed = time.monotonic() - t0
+
+    program_report = None
+    if args.program and not args.changed_only:
+        tp = time.monotonic()
+        prog_findings, program_report = analysis.analyze_program(
+            paths, root=args.root)
+        findings = sorted(findings + prog_findings)
+        program_elapsed = time.monotonic() - tp
+    else:
+        program_elapsed = 0.0
     elapsed = time.monotonic() - t0
+    timing = (f"{elapsed:.1f}s"
+              + (f" (files {file_elapsed:.1f}s + program "
+                 f"{program_elapsed:.1f}s)" if args.program else ""))
+
+    active_rules = set(analysis.RULES) | {"bad-pragma", "syntax-error"}
+    if args.program:
+        active_rules |= set(analysis.PROGRAM_RULES)
 
     if args.write_baseline:
         # guard: rewriting an existing baseline from a DIFFERENT path set
         # would silently truncate it to the subset's counts
-        norm = sorted(str(p) for p in (args.paths or ["paddle_tpu", "tools"]))
+        norm = sorted(str(p) for p in req_paths)
         if args.baseline.exists():
             try:
                 prior = json.loads(args.baseline.read_text()).get("paths")
@@ -102,16 +279,19 @@ def main(argv=None) -> int:
                 return 2
         analysis.write_baseline(args.baseline, findings, paths=norm)
         print(f"tpulint: wrote {len(findings)} baselined finding(s) to "
-              f"{args.baseline} ({elapsed:.1f}s)")
+              f"{args.baseline} ({timing})")
         return 0
 
     if args.as_json:
-        print(analysis.render_json(findings))
+        doc = json.loads(analysis.render_json(findings))
+        if program_report is not None:
+            doc["program"] = program_report.to_dict()
+        print(json.dumps(doc, indent=2, sort_keys=True))
 
     if args.no_baseline:
         if not args.as_json and findings:
             print(analysis.render_text(findings))
-        print(f"tpulint: {len(findings)} finding(s) in {elapsed:.1f}s "
+        print(f"tpulint: {len(findings)} finding(s) in {timing} "
               f"(no baseline)", file=sys.stderr)
         return 1 if findings else 0
 
@@ -122,14 +302,17 @@ def main(argv=None) -> int:
               f"  (generate one with --write-baseline)", file=sys.stderr)
         return 2
 
-    new, stale = analysis.diff_baseline(findings, baseline)
+    new, stale = analysis.diff_baseline(findings, baseline,
+                                        active_rules=active_rules)
+    if args.changed_only:
+        stale = []       # a subset sweep can't judge tree-wide burn-down
     if new:
         if not args.as_json:
             print(analysis.render_text(new))
         buckets = sorted({(f.path, f.rule) for f in new})
         print(f"tpulint: NEW violation(s) above baseline in "
               f"{len(buckets)} file+rule bucket(s) "
-              f"({elapsed:.1f}s) — all sites for each bucket are listed; "
+              f"({timing}) — all sites for each bucket are listed; "
               f"fix the new one or (rarely) pragma it with a reason",
               file=sys.stderr)
         return 1
@@ -139,11 +322,12 @@ def main(argv=None) -> int:
                   file=sys.stderr)
         print("tpulint: STALE baseline — violations were burned down "
               "(good!); shrink the ratchet:\n"
-              "  python tools/tpulint.py --write-baseline paddle_tpu tools",
+              "  python tools/tpulint.py --write-baseline --program "
+              "paddle_tpu tools",
               file=sys.stderr)
         return 3
     print(f"tpulint: OK — {len(findings)} baselined finding(s), 0 new, "
-          f"{elapsed:.1f}s", file=sys.stderr)
+          f"{timing}", file=sys.stderr)
     return 0
 
 
