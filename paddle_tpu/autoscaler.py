@@ -170,7 +170,12 @@ class ElasticAutoscaler:
     drive scale-up (``objectives=`` restricts to a subset of names; None
     watches all).  ``ledger``: optional
     :class:`~paddle_tpu.telemetry_ledger.RunLedger` whose goodput gauge
-    rides along in the utilization signal.  ``cache_dir``: the PR 6
+    rides along in the utilization signal.  ``fleet`` +
+    ``fleet_ttft_high``: optional
+    :class:`~paddle_tpu.telemetry_fleet.FleetCollector` whose MERGED
+    fleet TTFT p99 at/over the threshold is a scale-up trigger — the
+    cross-process signal a purely local monitor cannot see.
+    ``cache_dir``: the PR 6
     persistent executable cache new replicas warm from.  ``clock``:
     injectable monotonic-seconds callable — the whole policy is
     deterministic under a fake clock."""
@@ -184,6 +189,7 @@ class ElasticAutoscaler:
                  idle_dwell_s: float = 60.0,
                  idle_resume_ratio: float = 1.5,
                  decode_pool_high: Optional[float] = None,
+                 fleet=None, fleet_ttft_high: Optional[float] = None,
                  cache_dir: Optional[str] = None,
                  warm_async: bool = False,
                  reap_quarantined: bool = True,
@@ -219,6 +225,20 @@ class ElasticAutoscaler:
             raise ValueError("decode_pool_high must be > 0 (or None)")
         self.decode_pool_high = (None if decode_pool_high is None
                                  else float(decode_pool_high))
+        # fleet-level signal (docs/OBSERVABILITY.md "Fleet"): when a
+        # telemetry_fleet.FleetCollector is attached, the MERGED TTFT
+        # p99 at/over fleet_ttft_high seconds is a scale-up trigger — a
+        # replica group can be drowning fleet-wide while this process's
+        # local SLO monitor, seeing only its own slice, stays quiet
+        if fleet is not None and not hasattr(fleet, "fleet_snapshot"):
+            raise TypeError(f"fleet= wants a FleetCollector-like object "
+                            f"with fleet_snapshot(), got "
+                            f"{type(fleet).__name__}")
+        if fleet_ttft_high is not None and float(fleet_ttft_high) <= 0:
+            raise ValueError("fleet_ttft_high must be > 0 (or None)")
+        self.fleet = fleet
+        self.fleet_ttft_high = (None if fleet_ttft_high is None
+                                else float(fleet_ttft_high))
         self.cache_dir = cache_dir
         self.warm_async = bool(warm_async)
         self.reap_quarantined = bool(reap_quarantined)
@@ -330,6 +350,32 @@ class ElasticAutoscaler:
             return p
         return None
 
+    def fleet_ttft_p99(self) -> Optional[float]:
+        """The attached collector's merged fleet TTFT p99 (seconds), or
+        None when no collector is attached, it has not scraped yet, or
+        the poll fails (pull-source discipline — a broken signal never
+        takes the controller down)."""
+        if self.fleet is None:
+            return None
+        try:
+            rollup = self.fleet.fleet_snapshot().get("rollup") or {}
+            v = rollup.get("fleet_ttft_p99")
+            return None if v is None else float(v)
+        except Exception as e:  # noqa: BLE001 — same guard as the
+            # breaker/ledger/decode-pool polls
+            self._log.debug("autoscaler: fleet poll failed: %r", e)
+            return None
+
+    def _fleet_hot(self) -> Optional[float]:
+        """The merged TTFT p99 when it is at/over ``fleet_ttft_high``
+        (the scale-up trigger), else None (signal disabled or cool)."""
+        if self.fleet_ttft_high is None:
+            return None
+        v = self.fleet_ttft_p99()
+        if v is not None and v >= self.fleet_ttft_high:
+            return v
+        return None
+
     def utilization(self) -> Dict[str, Any]:
         """The scale-down signal: fleet occupancy — (in-flight + queued)
         requests over total ACTIVE engine slots — plus the raw terms and,
@@ -410,7 +456,9 @@ class ElasticAutoscaler:
                                utilization=util)
         breakers = self.breakers_open()
         decode_hot = self._decode_pool_hot()
-        if firing or breakers or decode_hot is not None:
+        fleet_hot = self._fleet_hot()
+        if firing or breakers or decode_hot is not None \
+                or fleet_hot is not None:
             self._idle_since = None          # under-provisioned ≠ idle
             in_up_cooldown = (
                 self._last_up_at is not None
@@ -424,6 +472,8 @@ class ElasticAutoscaler:
                     parts.append("breaker:" + ",".join(breakers))
                 if decode_hot is not None:
                     parts.append(f"decode_pool:{decode_hot:.2f}")
+                if fleet_hot is not None:
+                    parts.append(f"fleet_ttft:{fleet_hot:.3f}")
                 return self._spawn(now, reason="+".join(parts),
                                    firing=firing, utilization=util)
             return None
@@ -733,6 +783,8 @@ class ElasticAutoscaler:
                         "breakers_open": self.breakers_open(),
                         "decode_pool_pressure": self.decode_pool_pressure(),
                         "decode_pool_high": self.decode_pool_high,
+                        "fleet_ttft_p99": self.fleet_ttft_p99(),
+                        "fleet_ttft_high": self.fleet_ttft_high,
                         "utilization": self.utilization(),
                         "idle_since": self._idle_since,
                         "idle_for_s": (None if self._idle_since is None

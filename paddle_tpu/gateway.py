@@ -2311,12 +2311,19 @@ class ServingGateway:
         """Fleet pressure: (in-flight + queued) requests over total
         ACTIVE engine slots — the same occupancy the autoscaler's
         scale-down signal reads."""
+        return self._occupancy_terms()["value"]
+
+    def _occupancy_terms(self) -> Dict[str, Any]:
+        """Occupancy with its raw terms (busy/slots/queued) — the
+        ``occupancy`` block of ``gateway_snapshot()``."""
         active = [rep for rep in self._replicas.values()
                   if rep.state == ACTIVE]
         slots = sum(_engine_slots(rep.engine) for rep in active)
         busy = sum(len(rep.inflight) for rep in active)
         queued = sum(len(q) for q in self._queues)
-        return (busy + queued) / max(slots, 1)
+        return {"value": round((busy + queued) / max(slots, 1), 4),
+                "busy_slots": busy, "total_slots": slots,
+                "queued": queued}
 
     def _evaluate_brownout(self, now: float):
         pressure = self._occupancy()
@@ -2391,6 +2398,10 @@ class ServingGateway:
                         "p99": h_q.percentile(0.99)},
             "ttft_s": {"p50": h_t.percentile(0.50),
                        "p99": h_t.percentile(0.99)},
+            # fleet pressure with its raw terms — what a FleetCollector
+            # reads per target (resilience carries the same scalar, but
+            # only when a resilience policy is configured)
+            "occupancy": self._occupancy_terms(),
         }
         if self.resilience is not None:
             # breaker/brownout state rides every snapshot consumer —

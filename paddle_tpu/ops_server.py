@@ -69,6 +69,13 @@ live: a ``ThreadingHTTPServer`` (stdlib only, no new deps) that any engine,
     watermark-crossing tail (404 when none is attached).  A pure read —
     it never runs a census; callers decide when the live-array walk
     happens.
+``GET /fleet``
+    the attached :class:`~paddle_tpu.telemetry_fleet.FleetCollector`
+    snapshot(s): per-target scrape status (``ok``/``stale``/``down``
+    with ages and last errors), the fleet rollups (global goodput,
+    fleet MFU, merged TTFT/ITL percentiles, straggler skew), fleet SLO
+    burn, and spool stats (404 when none is attached).  A pure read of
+    the LAST scrape — it never triggers one.
 
 Zero cost when not started: constructing the server binds nothing and
 touches no hot path — sources are only read inside request handlers.
@@ -235,13 +242,22 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/fleet":
+                payload = ops._render_fleet()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no fleet collector attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
                       "/resilience", "/slo", "/autoscaler", "/kvstore",
-                      "/memory"]}),
+                      "/memory", "/fleet"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -291,6 +307,7 @@ class OpsServer:
         self._autoscalers: List[Tuple[str, Any]] = []
         self._kvstores: List[Tuple[str, Any]] = []  # TieredKVStore
         self._memories: List[Tuple[str, Any]] = []  # MemoryLedger
+        self._fleets: List[Tuple[str, Any]] = []    # FleetCollector
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -300,6 +317,8 @@ class OpsServer:
     def attach(self, obj, name: Optional[str] = None) -> "OpsServer":
         """Attach a telemetry source; kind is detected:
 
+        - ``FleetCollector`` (has ``fleet_snapshot``) → /fleet + its
+          ``paddle_tpu_fleet_*`` federation gauges on /metrics;
         - ``RunLedger`` (has ``snapshot``/``record``) → /ledger + gauges;
         - ``MemoryLedger`` (has ``memory_snapshot``) → /memory +
           /metrics pool/watermark byte gauges;
@@ -325,7 +344,14 @@ class OpsServer:
         cross-replica timelines.
         """
         with self._lock:
-            if hasattr(obj, "autoscaler_snapshot"):
+            if hasattr(obj, "fleet_snapshot"):
+                # FleetCollector: checked first — it also exposes
+                # prometheus_text, and must not fall through to the
+                # engine shape; its federation gauges still join /metrics
+                base = name or f"fleet{len(self._fleets)}"
+                self._fleets.append((base, obj))
+                self._engines.append((base, obj))   # /metrics exposition
+            elif hasattr(obj, "autoscaler_snapshot"):
                 base = name or f"autoscaler{len(self._autoscalers)}"
                 self._autoscalers.append((base, obj))
                 self._engines.append((base, obj))   # /metrics exposition
@@ -612,3 +638,38 @@ class OpsServer:
             return autoscalers[0][1].autoscaler_snapshot()
         return {name: asc.autoscaler_snapshot()
                 for name, asc in autoscalers}
+
+    def _render_fleet(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            fleets = list(self._fleets)
+        if not fleets:
+            return None
+        if len(fleets) == 1:
+            return fleets[0][1].fleet_snapshot()
+        return {name: fc.fleet_snapshot() for name, fc in fleets}
+
+    #: JSON routes a FleetCollector scrapes, mapped to their renderers —
+    #: the in-process (server=) scrape path of ``render()``
+    _RENDERS = {"/metrics": "_render_metrics",
+                "/ledger": "_render_ledger",
+                "/slo": "_render_slo",
+                "/gateway": "_render_gateway",
+                "/kvstore": "_render_kvstore",
+                "/memory": "_render_memory",
+                "/autoscaler": "_render_autoscaler",
+                "/resilience": "_render_resilience",
+                "/fleet": "_render_fleet"}
+
+    def render(self, route: str):
+        """Render one scrape surface WITHOUT a socket: the text
+        exposition for ``/metrics``, the JSON payload (or ``None`` when
+        nothing of that kind is attached — the 404 case) for the other
+        scrapeable routes.  This is how a ``FleetCollector`` federates an
+        in-process server (``add_target(name, server=srv)``) — bench and
+        the sim fleet scrape unstarted servers through it, so no test or
+        benchmark needs to bind a port to get fleet rollups."""
+        fn = self._RENDERS.get(route)
+        if fn is None:
+            raise ValueError(f"unrenderable route {route!r} "
+                             f"(want one of {sorted(self._RENDERS)})")
+        return getattr(self, fn)()

@@ -67,7 +67,8 @@ from .telemetry import Tracer
 from .utils.stats import StatRegistry, prometheus_text as _prometheus_text
 
 __all__ = ["SimClock", "SimTracer", "SimEngine", "TrafficSim",
-           "steady", "diurnal", "flash_crowd", "sim_tokens"]
+           "steady", "diurnal", "flash_crowd", "sim_tokens",
+           "SimFleetHost", "build_sim_fleet"]
 
 
 class SimClock:
@@ -396,6 +397,10 @@ class SimEngine:
             for _ in range(burst):
                 tok = req.stream[req.emitted]
                 req.emitted += 1
+                # same counter name as the real serving engines, so a
+                # FleetCollector's tokens/s rollup reads sim and real
+                # targets through one suffix
+                self.stats.add("tokens_emitted")
                 done = req.emitted >= req.max_new
                 if req.on_token is not None:
                     req.on_token(rid, tok, done)
@@ -878,3 +883,79 @@ class TrafficSim:
             report["decisions"] = self.autoscaler.decisions()
             report["fleet"] = self.autoscaler.autoscaler_snapshot()["fleet"]
         return report
+
+# ---------------------------------------------------------------------------
+# simulated fleet (rank-0 collector over fake-clock hosts)
+# ---------------------------------------------------------------------------
+
+class SimFleetHost:
+    """One simulated fleet member: a :class:`SimEngine` + its
+    :class:`SimTracer` + a per-host ``SLOMonitor``, all on the shared
+    :class:`SimClock`, behind an UNSTARTED
+    :class:`~paddle_tpu.ops_server.OpsServer` — a scrape target a
+    :class:`~paddle_tpu.telemetry_fleet.FleetCollector` federates
+    through ``OpsServer.render()`` without binding a single port.  This
+    is the multi-host rehearsal shape (one ops server per host, a rank-0
+    collector scraping them) on deterministic time."""
+
+    def __init__(self, clock: SimClock, *, name: str = "sim0",
+                 slo_resolution_s: float = 5.0, **engine_kwargs):
+        from .ops_server import OpsServer
+        from .telemetry_ledger import RunLedger
+        from .telemetry_slo import SLOMonitor
+        self.name = str(name)
+        self.clock = clock
+        self.tracer = SimTracer(clock)
+        self.engine = SimEngine(tracer=self.tracer, **engine_kwargs)
+        self.slo = SLOMonitor(clock=clock, resolution_s=slo_resolution_s)
+        self.tracer.set_slo(self.slo)
+        self.ledger = RunLedger(clock=clock)
+        self.server = OpsServer()
+        self.server.attach(self.engine, name=f"{self.name}.engine")
+        self.server.attach(self.slo, name=f"{self.name}.slo")
+        self.server.attach(self.ledger, name=f"{self.name}.ledger")
+
+    def submit(self, prompt, max_new_tokens: int, **sampling) -> int:
+        """Admit one request through the host's request timeline: the
+        tracer sees queued/first_token/token/retired, so TTFT and ITL
+        samples flow into the host's SLO monitor (and from there into a
+        federating collector's merged sketches) — the bookkeeping the
+        gateway layer does in a full deployment, collapsed to one
+        host."""
+        state = {"started": False}
+
+        def on_token(rid, _tok, done):
+            if not state["started"]:
+                state["started"] = True
+                self.tracer.request_event(rid, "admitted")
+                self.tracer.request_event(rid, "first_token")
+            self.tracer.request_event(rid, "token")
+            if done:
+                self.tracer.request_event(rid, "retired")
+
+        rid = self.engine.add_request(prompt, max_new_tokens,
+                                      on_token=on_token, **sampling)
+        self.tracer.request_event(rid, "queued",
+                                  prompt_len=len(list(prompt)))
+        return rid
+
+
+def build_sim_fleet(clock: SimClock, n_hosts: int = 3, *,
+                    interval_s: float = 5.0, objectives=(),
+                    spool_dir: Optional[str] = None, **engine_kwargs):
+    """A rank-0 :class:`~paddle_tpu.telemetry_fleet.FleetCollector` on
+    the shared fake clock over ``n_hosts`` :class:`SimFleetHost` s —
+    returns ``(collector, hosts)``.  Drive hosts (``host.engine.step()``
+    etc.), ``clock.advance(...)``, then ``collector.scrape_once()``: the
+    whole federation pipeline (scrape → parse → merge → rollups → spool)
+    runs deterministically with zero sockets and zero sleeps."""
+    from .telemetry_fleet import FleetCollector
+    if int(n_hosts) < 1:
+        raise ValueError("n_hosts must be >= 1")
+    hosts = [SimFleetHost(clock, name=f"sim{i}", **engine_kwargs)
+             for i in range(int(n_hosts))]
+    collector = FleetCollector(interval_s=interval_s, clock=clock,
+                               objectives=objectives, spool_dir=spool_dir)
+    for host in hosts:
+        collector.add_target(host.name, server=host.server)
+    return collector, hosts

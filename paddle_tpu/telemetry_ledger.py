@@ -69,7 +69,7 @@ import signal as _signal
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 __all__ = ["RunLedger", "FlightRecorder", "BUCKETS", "set_active_ledger",
            "current_ledger", "ledger_span", "chrome_counters_from_dump"]
@@ -96,11 +96,16 @@ class RunLedger:
     """
 
     def __init__(self, capacity: int = 4096,
-                 logger: Optional[logging.Logger] = None):
+                 logger: Optional[logging.Logger] = None,
+                 clock: Optional[Callable[[], float]] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
         self._lock = threading.Lock()
-        self._t0 = time.monotonic()
+        # injectable clock so sim hosts attribute against SIM elapsed time
+        # (a real-clock denominator under sim-second compute makes goodput
+        # meaningless); real runs keep time.monotonic
+        self._clock = time.monotonic if clock is None else clock
+        self._t0 = self._clock()
         self._closed_at: Optional[float] = None
         self._sec: Dict[str, float] = {b: 0.0 for b in _ATTRIBUTED}
         self._n: Dict[str, int] = {b: 0 for b in _ATTRIBUTED}
@@ -112,26 +117,26 @@ class RunLedger:
 
     # ------------------------------------------------------------- clock --
     def now(self) -> float:
-        return time.monotonic() - self._t0
+        return self._clock() - self._t0
 
     def elapsed_s(self) -> float:
         if self._closed_at is not None:
             return self._closed_at - self._t0
-        return time.monotonic() - self._t0
+        return self._clock() - self._t0
 
     def close(self):
         """Freeze elapsed time (idempotent).  Later ``record`` calls are
         dropped — the run is over; a closed ledger is a stable artifact."""
         with self._lock:
             if self._closed_at is None:
-                self._closed_at = time.monotonic()
+                self._closed_at = self._clock()
 
     def reset(self):
         """Clear all attribution and restart the elapsed clock — what
         ``GoodputCallback`` does at train begin so ``elapsed`` measures
         exactly the fit window, not construction-to-fit dead time."""
         with self._lock:
-            self._t0 = time.monotonic()
+            self._t0 = self._clock()
             self._closed_at = None
             self._sec = {b: 0.0 for b in _ATTRIBUTED}
             self._n = {b: 0 for b in _ATTRIBUTED}
@@ -159,7 +164,7 @@ class RunLedger:
                 return
             self._sec[bucket] += dur_s
             self._n[bucket] += count
-            self._series.append((time.monotonic() - self._t0, bucket, dur_s))
+            self._series.append((self._clock() - self._t0, bucket, dur_s))
 
     @contextlib.contextmanager
     def span(self, bucket: str, exclusive: bool = False):
@@ -402,8 +407,12 @@ class FlightRecorder:
 
     def add_source(self, obj, name: Optional[str] = None) -> "FlightRecorder":
         """Attach a dump source: a ``Tracer``/``TrainMonitor`` (anything
-        with ``dump_jsonl``), a ``RunLedger`` or ``telemetry_memory
-        .MemoryLedger`` (``to_dict``), or a ``ServingGateway``
+        with ``dump_jsonl``), a ``RunLedger``, ``telemetry_memory
+        .MemoryLedger`` or ``telemetry_fleet.FleetCollector``
+        (``to_dict`` — ``add_source(collector, "fleet")`` makes the dump
+        carry ``fleet.json``: the last fleet snapshot plus the spool
+        tail, so a post-mortem shows what the REST of the fleet looked
+        like when this process died), or a ``ServingGateway``
         (``gateway_snapshot`` — the dump then carries replica/queue state
         and, with a resilience policy, the breaker and brownout state the
         crash happened under).  Sources exposing ``forensics()`` (the

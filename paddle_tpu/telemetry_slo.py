@@ -96,12 +96,16 @@ class PercentileSketch:
         self.alpha = float(alpha)
         self._gamma = (1.0 + self.alpha) / (1.0 - self.alpha)
         self._lg = math.log(self._gamma)
-        self.counts: Dict[int, int] = {}
-        self.zero = 0
-        self.n = 0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
-        self.sum = 0.0
+        # guarded-by: none on all sketch state: sketches are owned by a
+        # single SLOMonitor windowed store and only touched under its
+        # _lock; standalone sketches (fleet rollup merges) are per-call
+        # locals that never escape one thread
+        self.counts: Dict[int, int] = {}  # guarded-by: none (owner-locked, see above)
+        self.zero = 0                     # guarded-by: none (owner-locked, see above)
+        self.n = 0                        # guarded-by: none (owner-locked, see above)
+        self.min: Optional[float] = None  # guarded-by: none (owner-locked, see above)
+        self.max: Optional[float] = None  # guarded-by: none (owner-locked, see above)
+        self.sum = 0.0                    # guarded-by: none (owner-locked, see above)
 
     def _index(self, v: float) -> int:
         return math.ceil(math.log(v) / self._lg)
@@ -169,6 +173,29 @@ class PercentileSketch:
                 "p50": self.quantile(0.50), "p95": self.quantile(0.95),
                 "p99": self.quantile(0.99)}
 
+    def to_dict(self) -> Dict[str, Any]:
+        """Lossless wire form (exact bucket counts, JSON-safe keys) —
+        what ``/slo`` ships per time bucket so a fleet collector can
+        reconstruct and MERGE sketches across processes: the merged
+        quantile is then a true quantile of the union of samples, not an
+        average of per-process quantiles."""
+        return {"alpha": self.alpha,
+                "counts": {str(i): c for i, c in self.counts.items()},
+                "zero": self.zero, "n": self.n, "min": self.min,
+                "max": self.max, "sum": self.sum}
+
+    @classmethod
+    def from_dict(cls, blob: Dict[str, Any]) -> "PercentileSketch":
+        sk = cls(alpha=float(blob.get("alpha", 0.02)))
+        sk.counts = {int(i): int(c)
+                     for i, c in (blob.get("counts") or {}).items()}
+        sk.zero = int(blob.get("zero", 0))
+        sk.n = int(blob.get("n", 0))
+        sk.min = blob.get("min")
+        sk.max = blob.get("max")
+        sk.sum = float(blob.get("sum", 0.0))
+        return sk
+
 
 class _TimeBuckets:
     """Ring of per-time-bucket payloads: ``resolution_s``-wide buckets,
@@ -179,6 +206,8 @@ class _TimeBuckets:
     def __init__(self, resolution_s: float, horizon_s: float):
         self.resolution = float(resolution_s)
         self.horizon = float(horizon_s)
+        # guarded-by: none (rings are owned by SLOMonitor's _samples /
+        # _counters maps and only touched under its _lock)
         self.buckets: Dict[float, Any] = {}
 
     def _key(self, now: float) -> float:
@@ -387,7 +416,8 @@ class SLOMonitor:
         of the goodput-floor objective."""
         if not hasattr(ledger, "snapshot"):
             raise TypeError(f"not a ledger: {type(ledger).__name__}")
-        self._ledgers.append(ledger)
+        with self._lock:
+            self._ledgers.append(ledger)
         return self
 
     # ---------------------------------------------------------- ingest --
@@ -406,6 +436,22 @@ class SLOMonitor:
                 tb = self._samples[metric] = _TimeBuckets(
                     self.resolution_s, self.horizon_s)
             tb.bucket(now, PercentileSketch).add(float(value))
+
+    def observe_sketch(self, metric: str, sketch: PercentileSketch,
+                       now: Optional[float] = None):
+        """Merge a whole sketch of samples into ``metric``'s time bucket
+        at ``now`` — the federation ingest path: a fleet collector
+        merges each target's CLOSED sketch buckets (exactly once) into
+        its own series, so fleet-level burn rates are evaluated over
+        true merged quantiles."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            tb = self._samples.get(metric)
+            if tb is None:
+                tb = self._samples[metric] = _TimeBuckets(
+                    self.resolution_s, self.horizon_s)
+            tb.bucket(now, lambda: PercentileSketch(
+                alpha=sketch.alpha)).merge(sketch)
 
     def count(self, metric: str, n: int = 1, now: Optional[float] = None):
         """Record ``n`` EVENTS of ``metric`` (a counter increment) into
@@ -508,7 +554,9 @@ class SLOMonitor:
         transition (``_lock`` alone guards the windowed stores, which
         observers keep feeding while an evaluation runs)."""
         now = self._clock() if now is None else float(now)
-        for led in self._ledgers:
+        with self._lock:
+            ledgers = list(self._ledgers)
+        for led in ledgers:
             try:
                 self.observe("goodput", float(led.snapshot()["goodput"]),
                              now=now)
@@ -585,12 +633,29 @@ class SLOMonitor:
 
     # --------------------------------------------------------- exports --
 
+    def sketch_export(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Serialized per-time-bucket sample sketches, keyed by metric
+        then bucket start (stringified for JSON) — the mergeable payload
+        ``snapshot()`` ships as ``sketch_buckets`` for cross-process
+        federation (``telemetry_fleet.FleetCollector``)."""
+        now = self._clock() if now is None else float(now)
+        with self._lock:
+            metrics = {}
+            for name, tb in self._samples.items():
+                tb.prune(now)
+                if tb.buckets:
+                    metrics[name] = {str(k): sk.to_dict()
+                                     for k, sk in tb.buckets.items()}
+        return {"resolution_s": self.resolution_s, "now": now,
+                "metrics": metrics}
+
     def snapshot(self, now: Optional[float] = None) -> Dict[str, Any]:
         """The ``GET /slo`` payload: objective definitions, live alert
-        states and burn rates, SLIs, and the recent transition ring."""
+        states and burn rates, SLIs, the recent transition ring, and the
+        mergeable ``sketch_buckets`` export."""
         now = self._clock() if now is None else float(now)
         rows = self.evaluate(now)
-        with self._lock:
+        with self._eval_lock:
             transitions = list(self._transitions)
         return {
             "now": now,
@@ -599,6 +664,7 @@ class SLOMonitor:
             "alerts_firing": sum(1 for r in rows
                                  if r["state"] == "firing"),
             "transitions": transitions,
+            "sketch_buckets": self.sketch_export(now),
         }
 
     def prometheus_text(self, namespace: str = "paddle_tpu_slo") -> str:

@@ -152,6 +152,23 @@ _MEMORY_FIELDS = {
     "peak_bytes": ("bytes", "lower"),
 }
 
+#: fleet-rollup attachment fields worth diffing (the ``fleet`` block a
+#: record carries when a telemetry_fleet.FleetCollector federated the
+#: run — bench.py gpt_gateway): leaf name -> (synthetic unit,
+#: direction).  Global goodput, fleet MFU, and tokens/s regress when
+#: they DROP; the merged latency percentiles and the straggler skew
+#: (max/mean per-target compute — 1.0 is perfectly balanced) regress
+#: when they RISE.  Target counts are scenario context, not judged.
+_FLEET_FIELDS = {
+    "goodput_global": ("frac", "higher"),
+    "fleet_mfu": ("frac", "higher"),
+    "fleet_ttft_p99": ("s", "lower"),
+    "fleet_ttft_p50": ("s", "lower"),
+    "fleet_itl_p99": ("s", "lower"),
+    "straggler_skew": ("x", "lower"),
+    "tokens_per_s": ("tokens/s", "higher"),
+}
+
 #: chaos-attachment fields worth diffing (bench.py gpt_chaos record
 #: shape): leaf name -> (synthetic unit, direction).  Counts of hedges/
 #: breaker transitions are scenario-shaped context, not judged.
@@ -192,7 +209,8 @@ def expand_telemetry(records):
                                    ("kv_tier", _KVTIER_FIELDS),
                                    ("update_sharding",
                                     _UPDATE_SHARDING_FIELDS),
-                                   ("memory", _MEMORY_FIELDS)):
+                                   ("memory", _MEMORY_FIELDS),
+                                   ("fleet", _FLEET_FIELDS)):
             sub = rec.get(attachment)
             if not isinstance(sub, dict):
                 continue

@@ -606,6 +606,70 @@ then
     echo "COLLECT SMOKE FAILED: memory-ledger round trip"
     exit 1
 fi
+# fleet observability plane: a FleetCollector over TWO live ops servers
+# must federate both (rollups merged), flip a killed target to a labeled
+# `stale` gap without corrupting the survivor's rollups, spool every
+# sample durably, and RESUME the spool (seq continues, no duplicates)
+# across a collector restart — the crash-survival contract
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'FLEETEOF'
+import json, tempfile, urllib.request
+from paddle_tpu.simulation import SimClock, SimFleetHost
+from paddle_tpu.telemetry_fleet import FleetCollector
+from paddle_tpu.ops_server import OpsServer
+
+class FakeClock:
+    t = 0.0
+    def __call__(self):
+        return self.t
+
+clk, fclk = SimClock(), FakeClock()
+spool_dir = tempfile.mkdtemp()
+hosts = [SimFleetHost(clk, name=f"h{i}") for i in range(2)]
+for h in hosts:
+    h.submit([1, 2, 3, 4], 4)
+for _ in range(12):
+    clk.advance(0.05)
+    for h in hosts:
+        h.engine.step()
+        h.ledger.record("compute", 0.05)
+urls = [h.server.start() for h in hosts]
+col = FleetCollector(interval_s=5.0, clock=fclk, timeout_s=5.0,
+                     spool_dir=spool_dir)
+for h, url in zip(hosts, urls):
+    col.add_target(h.name, url)
+snap = col.scrape_once()
+assert snap["rollup"]["targets_ok"] == 2, snap["rollup"]
+assert snap["rollup"]["fleet_ttft_p99"] is not None
+# GET /fleet serves the SAME snapshot the collector holds
+front = OpsServer()
+front.attach(col, name="fleet")
+furl = front.start()
+live = json.loads(urllib.request.urlopen(furl + "/fleet",
+                                         timeout=10).read())
+assert live["rollup"] == json.loads(json.dumps(snap["rollup"]))
+front.stop()
+# kill one host: past the staleness window it is a LABELED gap and the
+# survivor's rollup stands alone
+hosts[1].server.stop()
+fclk.t += 20.0
+snap = col.scrape_once()
+by = {r["target"]: r["status"] for r in snap["targets"]}
+assert by == {"h0": "ok", "h1": "stale"}, by
+assert snap["rollup"]["targets_stale"] == 1
+seq_before = col.spool.stats()["seq"]
+assert seq_before >= 6                   # 2 rounds * (targets + rollup)
+records = col.spool.records()
+col.stop()
+hosts[0].server.stop()
+# restart: the spool resumes — history intact, seq continues, no dups
+col2 = FleetCollector(interval_s=5.0, clock=fclk, spool_dir=spool_dir)
+assert col2.spool.records() == records
+assert col2.spool.append({"kind": "probe"}) == seq_before + 1
+FLEETEOF
+then
+    echo "COLLECT SMOKE FAILED: fleet federation round trip"
+    exit 1
+fi
 # tpulint gate, per-file rules + whole-program concurrency passes: any NEW
 # violation vs tools/tpulint_baseline.json fails (exit 1, rule id +
 # file:line printed above); a STALE baseline (violations burned down but

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Live fleet dashboard: a top-like terminal view over the fleet
+observability plane (``paddle_tpu/telemetry_fleet.py``).
+
+Two modes, one renderer:
+
+- ``--url``      read ``GET /fleet`` from an ops server a FleetCollector
+  is attached to (the rank-0 collector of a multi-host run) and render
+  its snapshot — the dashboard and ``/fleet`` show the SAME object;
+- ``--targets``  run a local collector right here over the named ops
+  endpoints (``name=url`` pairs) and render its snapshots.
+
+Every refresh paints a fleet header (targets up/stale/down, global
+goodput, fleet MFU, merged TTFT p99, tokens/s, straggler skew, firing
+fleet alerts) over one row per target: status, scrape age, goodput,
+TTFT p50/p99, tokens/s, occupancy, queued, open breakers, brownout
+rung.  A stale or down target stays VISIBLE with its age and last
+error — a labeled gap, the same rule the rollups follow.
+
+Examples::
+
+    python tools/fleet_top.py --url http://127.0.0.1:9100
+    python tools/fleet_top.py \\
+        --targets host0=http://10.0.0.1:9100,host1=http://10.0.0.2:9100
+    python tools/fleet_top.py --url http://127.0.0.1:9100 --once  # one frame
+
+``--once`` renders a single frame and exits (scripts / tests);
+``--interval`` paces the refresh loop.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+#: column spec: (header, width, row-key or callable)
+_COLUMNS = (
+    ("TARGET", 14, "target"),
+    ("STATUS", 7, "status"),
+    ("AGE", 7, "age_s"),
+    ("GOODPUT", 8, "goodput"),
+    ("TTFT50", 8, "ttft_p50"),
+    ("TTFT99", 8, "ttft_p99"),
+    ("TOK/S", 8, "tokens_per_s"),
+    ("OCC", 6, "occupancy"),
+    ("QUEUED", 6, "queued"),
+    ("BRKRS", 6, "breakers_open"),
+    ("BROWN", 5, "brownout_level"),
+)
+
+
+def _fmt(v, width):
+    if v is None:
+        s = "-"
+    elif isinstance(v, float):
+        s = f"{v:.3f}" if abs(v) < 100 else f"{v:.1f}"
+    elif isinstance(v, (list, tuple)):
+        s = str(len(v)) if v else "0"
+    else:
+        s = str(v)
+    return s[:width].rjust(width)
+
+
+def render_fleet(snap) -> str:
+    """One dashboard frame from a fleet snapshot — the SAME dict
+    ``GET /fleet`` serves and ``FleetCollector.fleet_snapshot()``
+    returns, so every surface renders one object."""
+    roll = snap.get("rollup") or {}
+    slo = snap.get("slo") or {}
+    lines = []
+    up = roll.get("targets_ok", 0)
+    lines.append(
+        f"fleet: {up}/{roll.get('targets', 0)} up"
+        f"  ({roll.get('targets_stale', 0)} stale,"
+        f" {roll.get('targets_down', 0)} down)"
+        f"   scrape #{snap.get('scrapes', 0)}"
+        f"   t={snap.get('now') if snap.get('now') is not None else '-'}")
+    lines.append(
+        "goodput %s   mfu %s   ttft_p99 %s   tok/s %s   skew %s"
+        "   alerts %s" % (
+            _fmt(roll.get("goodput_global"), 7).strip(),
+            _fmt(roll.get("fleet_mfu"), 7).strip(),
+            _fmt(roll.get("fleet_ttft_p99"), 8).strip(),
+            _fmt(roll.get("tokens_per_s"), 8).strip(),
+            _fmt(roll.get("straggler_skew"), 6).strip(),
+            slo.get("alerts_firing", 0) if slo else "-"))
+    spool = snap.get("spool")
+    if spool:
+        lines.append(f"spool: {spool['segments']} segment(s), "
+                     f"{spool['bytes']} bytes, seq {spool['seq']} "
+                     f"@ {spool['directory']}")
+    lines.append("")
+    lines.append(" ".join(h.rjust(w) for h, w, _k in _COLUMNS))
+    for row in snap.get("targets", []):
+        lines.append(" ".join(_fmt(row.get(k), w) for _h, w, k in _COLUMNS))
+        if row.get("status") != "ok" and row.get("error"):
+            lines.append(f"    !! {row['error'][:120]}")
+    if not snap.get("targets"):
+        lines.append("  (no targets scraped yet)")
+    return "\n".join(lines)
+
+
+def _fetch_fleet(url: str, timeout_s: float):
+    with urllib.request.urlopen(url.rstrip("/") + "/fleet",
+                                timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="top-like live dashboard over the fleet "
+                    "observability plane (GET /fleet, or a local "
+                    "collector over --targets)")
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--url",
+                      help="ops-server base URL whose /fleet to render "
+                           "(a FleetCollector must be attached there)")
+    mode.add_argument("--targets",
+                      help="comma-separated name=url ops endpoints to "
+                           "scrape with a LOCAL collector")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh/scrape seconds")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-request timeout seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--no-clear", action="store_true",
+                    help="append frames instead of repainting the screen")
+    args = ap.parse_args(argv)
+
+    collector = None
+    if args.targets:
+        from paddle_tpu.telemetry_fleet import FleetCollector
+        collector = FleetCollector(interval_s=args.interval,
+                                   timeout_s=args.timeout)
+        for pair in args.targets.split(","):
+            name, _, url = pair.partition("=")
+            if not name or not url:
+                ap.error(f"bad --targets entry {pair!r} (want name=url)")
+            collector.add_target(name.strip(), url.strip())
+
+    try:
+        while True:
+            if collector is not None:
+                snap = collector.scrape_once()
+            else:
+                try:
+                    snap = _fetch_fleet(args.url, args.timeout)
+                except Exception as e:  # noqa: BLE001 — a dashboard must
+                    # outlive its collector's restarts
+                    snap = {"targets": [], "rollup": {},
+                            "error": repr(e)}
+            frame = render_fleet(snap)
+            if snap.get("error"):
+                frame += f"\n  !! fleet endpoint unreachable: " \
+                         f"{snap['error']}"
+            if args.no_clear or args.once:
+                print(frame)
+            else:
+                print("\x1b[2J\x1b[H" + frame, flush=True)
+            if args.once:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,7 +178,15 @@ def main(argv=None):
     ap.add_argument("--ops-port", type=int, default=None,
                     help="start the live ops endpoint on this port "
                          "(/gateway /metrics /healthz /ledger /trace "
-                         "/resilience /autoscaler)")
+                         "/resilience /autoscaler /fleet)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="federate the demo through a "
+                         "telemetry_fleet.FleetCollector scraping the "
+                         "demo's ops surface (in-process; with "
+                         "--ops-port the collector also serves a live "
+                         "GET /fleet) — the report gains the fleet "
+                         "rollup (global goodput, merged TTFT p99, "
+                         "tokens/s) and per-target scrape statuses")
     ap.add_argument("--chaos", default=None, metavar="PLAN",
                     help="inject a fault plan (paddle_tpu.faults): a "
                          f"preset name ({'/'.join(sorted(CHAOS_PRESETS))})"
@@ -289,6 +297,39 @@ def main(argv=None):
             # the monitor driving the autoscaler's decisions
         srv.start()
 
+    fleet = None
+    if args.fleet:
+        from paddle_tpu.telemetry_fleet import FleetCollector
+        if asc is None:
+            # no autoscaler means no SLO monitor yet — the fleet's merged
+            # TTFT percentiles ride the /slo sketch export, so give the
+            # gateway one to feed
+            from paddle_tpu.telemetry_slo import SLOMonitor
+            gw.set_slo(SLOMonitor(resolution_s=1.0))
+            if srv is not None:
+                srv.attach(gw._slo, "slo")
+        if srv is not None:
+            scrape_target = srv
+        else:
+            # no live endpoint requested: federate through an UNSTARTED
+            # ops server — render()-only, no port bound
+            from paddle_tpu.ops_server import OpsServer
+            scrape_target = OpsServer()
+            scrape_target.attach(gw, "gateway")
+            for name in names:
+                scrape_target.attach(gw.replica(name).engine, name)
+            if asc is not None:
+                scrape_target.attach(asc, "autoscaler")
+                scrape_target.attach(asc.slo, "slo")
+            else:
+                scrape_target.attach(gw._slo, "slo")
+        fleet = FleetCollector(interval_s=1.0)
+        fleet.add_target("demo", server=scrape_target)
+        if srv is not None:
+            srv.attach(fleet, "fleet")   # live GET /fleet
+        fleet.scrape_once()   # baseline: the post-run scrape's counter
+        # deltas (tokens/s) measure the demo workload itself
+
     rng = np.random.RandomState(0)
     buckets = [int(b) for b in args.buckets.split(",")]
     reqs = []
@@ -342,6 +383,15 @@ def main(argv=None):
         report["autoscaler"] = {"fleet": asnap["fleet"],
                                 "decisions": asnap["decisions"],
                                 "counters": asnap["counters"]}
+    if fleet is not None:
+        fsnap = fleet.scrape_once()
+        report["fleet"] = {
+            "rollup": fsnap["rollup"],
+            "targets": [{"target": t["target"], "status": t["status"],
+                         "tokens_per_s": t["tokens_per_s"],
+                         "ttft_p99": t["ttft_p99"],
+                         "occupancy": t["occupancy"]}
+                        for t in fsnap["targets"]]}
     if plan is not None:
         report["chaos"] = {"plan": plan.to_dict(),
                            "injected": [ev for w in wrappers
