@@ -1418,6 +1418,151 @@ def bench_gpt_weight_update_sharding(on_tpu):
     return out
 
 
+def bench_gpt_train_resilience(on_tpu):
+    """Supervisor on/off A/B under a seeded crash plan (ISSUE 20): the
+    same tiny-GPT run is hit with an injected allocation failure, a torn
+    checkpoint write, and a preemption request mid-run (the documented
+    SIGTERM-equivalent boundary path — a real signal would chain to the
+    harness's own handler on release).  Supervisor OFF dies at the first
+    alloc_fail; supervisor ON restores from the last committed step,
+    replays, takes a deadline-bounded emergency checkpoint at the
+    preemption boundary, and a fresh supervisor resumes from it.
+    Acceptance pin: the resumed trajectory equals the uninterrupted
+    oracle BIT-EXACTLY (the two-phase commit + fold_in per-step RNG +
+    iterator seek contract), and the torn step is counted-skipped, never
+    loaded.  The record reports the recovery tax: recovery_time_s,
+    steps_replayed, and the goodput fraction lost to replay."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.faults import Fault, FaultPlan, FaultInjectionError
+    from paddle_tpu.models.gpt import GPTConfig, GPTModel, make_gpt_train_step
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.telemetry import Tracer
+    from paddle_tpu.train_resilience import (CheckpointManager,
+                                             PreemptionGuard,
+                                             ResumableIterator,
+                                             TrainSupervisor)
+
+    if on_tpu:
+        cfg_kw = dict(vocab_size=50304, hidden_size=768, num_layers=12,
+                      num_attention_heads=12, max_position_embeddings=1024,
+                      compute_dtype="bfloat16", scan_unroll=12)
+        B, L = 16, 1024
+    else:
+        cfg_kw = dict(vocab_size=256, hidden_size=64, num_layers=1,
+                      num_attention_heads=2, max_position_embeddings=64,
+                      compute_dtype="float32")
+        B, L = 2, 32
+    NUM_STEPS, SAVE_EVERY, FAIL_AT, PREEMPT_AT = 24, 6, 9, 15
+    cfg = GPTConfig(**cfg_kw)
+    rng = np.random.RandomState(0)
+    batches = [(jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L))),
+                jnp.asarray(rng.randint(0, cfg.vocab_size, (B, L))))
+               for _ in range(8)]
+    lr = np.float32(3e-4)
+
+    def build():
+        paddle.seed(0)
+        hcg = _fleet_hcg(dp_degree=1)
+        model = GPTModel(cfg)
+        step, state = make_gpt_train_step(model, AdamW(3e-4), hcg,
+                                          remat=False)
+        return step, state
+
+    import tempfile
+
+    def supervised(root, fault_plan=None, preempt_at=None, num_steps=NUM_STEPS):
+        step, state = build()
+        guard = PreemptionGuard() if preempt_at is not None else None
+        boundary = (lambda t, sup: sup.guard.request()
+                    if t == preempt_at else None) if preempt_at else None
+        sup = TrainSupervisor(
+            step, state, CheckpointManager(root, tracer=Tracer(),
+                                           fault_plan=fault_plan),
+            base_key=jax.random.PRNGKey(0), lr=lr,
+            data=ResumableIterator(batches), save_every=SAVE_EVERY,
+            backoff_s=0.0, guard=guard, fault_plan=fault_plan,
+            on_boundary=boundary)
+        return sup, sup.run(num_steps)
+
+    with tempfile.TemporaryDirectory() as td:
+        # --- uninterrupted oracle
+        t0 = time.perf_counter()
+        _, oracle = supervised(os.path.join(td, "oracle"))
+        oracle_wall = time.perf_counter() - t0
+        assert oracle["completed"] and len(oracle["losses"]) == NUM_STEPS
+
+        # --- supervisor OFF: the crash plan is fatal at the first fault
+        plan_off = FaultPlan([Fault("alloc_fail", at_s=FAIL_AT, count=1)],
+                             seed=7)
+        step, state = build()
+        data = ResumableIterator(batches)
+        key = jax.random.PRNGKey(0)
+        off_steps, off_died = 0, None
+        try:
+            for t in range(NUM_STEPS):
+                for f in plan_off.faults:
+                    if f.active(float(t)) and f.kind == "alloc_fail":
+                        raise MemoryError(f"injected alloc_fail (step {t})")
+                from paddle_tpu.jit.functional import fold_in_step_key
+                state, _loss = step(state, fold_in_step_key(key, t), lr,
+                                    *data.next_batch())
+                off_steps = t + 1
+        except (MemoryError, FaultInjectionError) as e:
+            off_died = type(e).__name__
+
+        # --- supervisor ON: same crash plan + torn write + preemption
+        plan = FaultPlan([Fault("alloc_fail", at_s=FAIL_AT, count=1),
+                          Fault("torn_write", at_s=1, count=1)], seed=7)
+        root = os.path.join(td, "chaos")
+        t0 = time.perf_counter()
+        sup1, phase1 = supervised(root, fault_plan=plan,
+                                  preempt_at=PREEMPT_AT)
+        assert phase1["preempted"] and phase1["final_step"] == PREEMPT_AT
+        # relaunch (the post-preemption restart): resume from the
+        # emergency checkpoint and finish
+        sup2, phase2 = supervised(root)
+        chaos_wall = time.perf_counter() - t0
+        assert phase2["completed"] and phase2["first_step"] == PREEMPT_AT
+
+        # acceptance pin: bit-exact oracle equality across crash+preempt
+        resumed = phase1["losses"] + phase2["losses"]
+        assert resumed == oracle["losses"], "trajectory diverged"
+        skips = dict(sup1.manager.skips)
+        assert skips.get("uncommitted", 0) >= 1, skips  # torn step skipped
+        snap1 = sup1.train_snapshot()
+
+    replayed = phase1["steps_replayed"] + phase2["steps_replayed"]
+    recovery_s = (phase1["recovery_time_s"] + phase2["recovery_time_s"])
+    goodput = NUM_STEPS / (NUM_STEPS + replayed)
+    out = _result("gpt_train_resilience_tokens_per_sec", "tokens/s",
+                  B * L, NUM_STEPS, chaos_wall, None, on_tpu,
+                  phase2["final_loss"])
+    out["train_resilience"] = {
+        "crash_plan": plan.to_dict(),
+        "supervisor_off": {"completed": False, "died": off_died,
+                           "steps_done": off_steps},
+        "supervisor_on": {
+            "completed": True,
+            "restarts": phase1["restarts"] + phase2["restarts"],
+            "steps_replayed": replayed,
+            "recovery_time_s": round(recovery_s, 4),
+            "corrupt_skips": skips,
+            "saves_committed": snap1["saves_committed"],
+            "saves_abandoned": snap1["saves_abandoned"],
+            "final_loss_delta": abs(phase2["final_loss"] -
+                                    oracle["final_loss"]),
+            "goodput": round(goodput, 4),
+            "goodput_delta_vs_oracle": round(1.0 - goodput, 4),
+            "wall_overhead_x": round(chaos_wall / max(oracle_wall, 1e-9),
+                                     3),
+        },
+    }
+    return out
+
+
 CONFIGS = {
     "gpt2s": bench_gpt2s,
     "gpt_long": bench_gpt_long,
@@ -1434,6 +1579,7 @@ CONFIGS = {
     "gpt_chaos": bench_gpt_chaos,
     "gpt_grad_comm": bench_gpt_grad_comm,
     "gpt_weight_update_sharding": bench_gpt_weight_update_sharding,
+    "gpt_train_resilience": bench_gpt_train_resilience,
 }
 
 

@@ -6,10 +6,10 @@ a ``telemetry_ledger.RunLedger`` goodput attribution
 (docs/OBSERVABILITY.md)."""
 
 from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
-                             GoodputCallback, LRScheduler, ModelCheckpoint,
-                             ProgBarLogger, ReduceLROnPlateau,
-                             TelemetryCallback, VisualDL)
+                             GoodputCallback, LRScheduler, ManagedCheckpoint,
+                             ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, TelemetryCallback, VisualDL)
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "VisualDL", "ReduceLROnPlateau",
-           "TelemetryCallback", "GoodputCallback"]
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint",
+           "ManagedCheckpoint", "LRScheduler", "EarlyStopping", "VisualDL",
+           "ReduceLROnPlateau", "TelemetryCallback", "GoodputCallback"]

@@ -43,6 +43,29 @@ _MANIFEST = "manifest.json"
 _MAX_CHUNK_BYTES = 2 << 30  # 2 GiB per chunk file
 
 
+class CorruptCheckpoint(RuntimeError):
+    """The checkpoint directory is structurally damaged: a chunk file is
+    missing, truncated, or disagrees with the manifest's shape/dtype, or
+    the manifest itself is unreadable.  A crash between the per-file
+    ``os.replace`` calls and the manifest replace leaves exactly this
+    shape (old-manifest + new files, or manifest referencing files that
+    never landed) — ``load()`` raises this instead of returning silently
+    wrong arrays.  ``train_resilience.CheckpointManager`` catches it to
+    fall back to the previous committed step."""
+
+
+def _storage_dtype(dtype: np.dtype) -> Optional[np.dtype]:
+    """Raw-bytes storage dtype for numpy *extension* dtypes (bfloat16,
+    fp8 — anything ml_dtypes registers with kind ``'V'``).  ``np.save``
+    writes those with an opaque ``|V2``-style descr that round-trips as
+    void and breaks comparisons on load, so chunks are stored viewed as
+    same-width unsigned ints and viewed back on read; the manifest keeps
+    the logical dtype name."""
+    if dtype.kind == "V" and dtype.itemsize in (1, 2, 4, 8):
+        return np.dtype(f"u{dtype.itemsize}")
+    return None
+
+
 # --------------------------------------------------------------------------
 # pytree <-> flat {key: leaf}
 # --------------------------------------------------------------------------
@@ -210,7 +233,9 @@ def _save_impl(state, path: str, async_save: bool,
                          "_".join(f"{c[0]}-{c[1]}" for c in chunk) +
                          f".p{pidx}.npy") if chunk else f"{_safe(key)}.scalar.p{pidx}.npy"
                 entry["chunks"].append({"file": fname, "box": chunk})
-                writes.append((fname, np.ascontiguousarray(sub)))
+                data = np.ascontiguousarray(sub)
+                st = _storage_dtype(data.dtype)
+                writes.append((fname, data.view(st) if st is not None else data))
         manifest["leaves"][key] = entry
 
     def do_writes():
@@ -250,8 +275,16 @@ def _save_impl(state, path: str, async_save: bool,
 # --------------------------------------------------------------------------
 
 def _merged_manifest(path: str) -> Dict:
-    with open(os.path.join(path, _MANIFEST)) as f:
-        manifest = json.load(f)
+    try:
+        with open(os.path.join(path, _MANIFEST)) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        raise CorruptCheckpoint(
+            f"checkpoint {path!r} has no {_MANIFEST} — save never "
+            f"committed (crash before the manifest replace?)")
+    except (json.JSONDecodeError, OSError) as e:
+        raise CorruptCheckpoint(
+            f"checkpoint {path!r} manifest is unreadable: {e}")
     # multi-host: fold in per-process chunk lists — only from processes that
     # were part of THIS save's cohort (stale partials past process_count are
     # leftovers from an earlier larger-world save)
@@ -273,6 +306,44 @@ def _merged_manifest(path: str) -> Dict:
     return manifest
 
 
+def _load_chunk(path: str, chunk: Dict, entry: Dict) -> np.ndarray:
+    """mmap one chunk file, verifying it structurally matches what the
+    manifest promised.  A crash between array writes and the manifest
+    replace yields old-manifest/new-file (or manifest/no-file) mixes —
+    every mismatch raises :class:`CorruptCheckpoint`, never returns
+    silently wrong data."""
+    fname = chunk["file"]
+    try:
+        src = np.load(os.path.join(path, fname), mmap_mode="r",
+                      allow_pickle=False)
+    except FileNotFoundError:
+        raise CorruptCheckpoint(
+            f"chunk file {fname!r} referenced by the manifest is missing")
+    except (ValueError, OSError, EOFError) as e:
+        raise CorruptCheckpoint(
+            f"chunk file {fname!r} is torn/unreadable: {e}")
+    logical = np.dtype(entry["dtype"])
+    if src.dtype != logical:
+        # extension dtypes (bf16/fp8) are stored as same-width uints
+        # (legacy checkpoints: as raw void) — view back to the logical
+        # dtype; any OTHER mismatch is corruption
+        if logical.kind == "V" and src.dtype.itemsize == logical.itemsize:
+            src = src.view(logical)
+        else:
+            raise CorruptCheckpoint(
+                f"chunk file {fname!r} has dtype {src.dtype}, manifest "
+                f"says {logical} — torn save (mixed-version directory)")
+    expect = tuple(c[1] - c[0] for c in chunk["box"])
+    if tuple(src.shape) != expect and not (
+            expect == () and tuple(src.shape) == (1,)):
+        # mmap_mode promotes 0-d arrays to shape (1,) — not corruption
+        raise CorruptCheckpoint(
+            f"chunk file {fname!r} has shape {tuple(src.shape)}, manifest "
+            f"box {chunk['box']} expects {expect} — torn save "
+            f"(mixed-version directory)")
+    return src
+
+
 def _read_region(path: str, entry: Dict, want: Tuple[slice, ...]) -> np.ndarray:
     """Assemble the requested region of a leaf from its chunk files (mmap —
     reads only the overlapping ranges)."""
@@ -284,15 +355,13 @@ def _read_region(path: str, entry: Dict, want: Tuple[slice, ...]) -> np.ndarray:
     for chunk in entry["chunks"]:
         cbox = chunk["box"]
         if not cbox:  # scalar
-            out[...] = np.load(os.path.join(path, chunk["file"]),
-                               mmap_mode="r", allow_pickle=False)
+            out[...] = _load_chunk(path, chunk, entry)
             return out
         inter = [[max(c[0], w[0]), min(c[1], w[1])]
                  for c, w in zip(cbox, wbox)]
         if any(i[0] >= i[1] for i in inter):
             continue
-        src = np.load(os.path.join(path, chunk["file"]), mmap_mode="r",
-                      allow_pickle=False)
+        src = _load_chunk(path, chunk, entry)
         src_sl = tuple(slice(i[0] - c[0], i[1] - c[0])
                        for i, c in zip(inter, cbox))
         dst_sl = tuple(slice(i[0] - w[0], i[1] - w[0])
@@ -300,9 +369,10 @@ def _read_region(path: str, entry: Dict, want: Tuple[slice, ...]) -> np.ndarray:
         out[dst_sl] = src[src_sl]
         filled[dst_sl] = True
     if sizes and not filled.all():
-        raise ValueError(
+        raise CorruptCheckpoint(
             f"checkpoint region {wbox} has holes — missing chunk files "
-            f"(multi-host save without a shared filesystem?)")
+            f"(multi-host save without a shared filesystem, or a torn "
+            f"multi-file save)")
     return out
 
 

@@ -142,13 +142,34 @@ class ElasticManager:
         return ELASTIC_EXIT_CODE
 
     def run_with_checkpoint(self, train_fn: Callable[[], None],
-                            save_fn: Callable[[], None],
-                            check_every: float = 5.0):
+                            save_fn: Optional[Callable[[], None]] = None,
+                            check_every: float = 5.0, manager=None,
+                            state_fn: Optional[Callable[[], object]] = None,
+                            step_fn: Optional[Callable[[], int]] = None,
+                            deadline_s: Optional[float] = None):
         """Drive ``train_fn`` (which returns per 'epoch'); on membership
-        change, call ``save_fn`` and exit with the protocol code so the
-        launcher relaunches and the job resumes from checkpoint with a
-        freshly compiled mesh."""
+        change, save and exit with the protocol code so the launcher
+        relaunches and the job resumes from checkpoint with a freshly
+        compiled mesh.
+
+        Two save paths: a bare ``save_fn`` callback (legacy), or
+        ``manager=`` (a ``train_resilience.CheckpointManager``) with
+        ``state_fn``/``step_fn`` providers — the rescale save then rides
+        the verified two-phase commit (digest manifest + COMMIT marker),
+        so the relaunched world resumes through ``latest()`` and
+        reshards via the current ``sharding_rules``.  ``deadline_s``
+        bounds the emergency save the same way the preemption path does
+        (a miss abandons uncommitted; the prior step stays valid)."""
         import sys
+        if save_fn is None:
+            if manager is None or state_fn is None or step_fn is None:
+                raise ValueError(
+                    "run_with_checkpoint needs save_fn, or manager= with "
+                    "state_fn=/step_fn= for the managed two-phase path")
+
+            def save_fn():
+                manager.save(state_fn(), step_fn(),
+                             deadline_s=deadline_s).wait()
         last = time.time()
         while True:
             more = train_fn()

@@ -47,16 +47,23 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 __all__ = ["Fault", "FaultPlan", "FaultyEngine", "FAULT_KINDS",
            "TransientDispatchError", "StreamCorruption",
-           "InjectedAllocationError", "FaultInjectionError"]
+           "InjectedAllocationError", "FaultInjectionError",
+           "torn_write", "corrupt_file"]
 
-#: the typed fault vocabulary (docs/RESILIENCE.md taxonomy table)
+#: the typed fault vocabulary (docs/RESILIENCE.md taxonomy table).
+#: ``torn_write``/``corrupt_file`` are FILESYSTEM faults: FaultyEngine
+#: never fires them; the checkpoint layer
+#: (``train_resilience.CheckpointManager``) consults the plan at save
+#: time with a save-ordinal clock and applies them via the
+#: :func:`torn_write`/:func:`corrupt_file` primitives below.
 FAULT_KINDS = ("crash", "stall", "slow", "dispatch_error", "warmup_fail",
-               "garble", "alloc_fail")
+               "garble", "alloc_fail", "torn_write", "corrupt_file")
 
 
 class FaultInjectionError(RuntimeError):
@@ -92,6 +99,43 @@ class StreamCorruption(FaultInjectionError):
     is discarded, never double-delivered."""
 
 
+# ------------------------------------------------------------------------
+# filesystem fault primitives (checkpoint chaos)
+# ------------------------------------------------------------------------
+
+def torn_write(path: str, rng: random.Random) -> int:
+    """Truncate ``path`` at a seeded offset — the on-disk shape a crash
+    mid-``write()`` leaves (a *torn* file: valid prefix, missing tail).
+    The offset is drawn from ``rng`` in ``[1, size)`` so at least one
+    byte survives and at least one byte is lost; returns the new size.
+    Empty/1-byte files are truncated to 0."""
+    size = os.path.getsize(path)
+    keep = rng.randrange(1, size) if size > 1 else 0
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+def corrupt_file(path: str, rng: random.Random, n_bytes: int = 4) -> int:
+    """Flip ``n_bytes`` seeded byte positions in ``path`` (XOR with a
+    seeded nonzero mask) — post-commit bitrot: the file exists, its size
+    is right, its *content* is wrong, so only a content digest catches
+    it.  Returns the number of bytes actually flipped."""
+    size = os.path.getsize(path)
+    if size == 0:
+        return 0
+    flipped = 0
+    with open(path, "r+b") as f:
+        for _ in range(max(1, int(n_bytes))):
+            off = rng.randrange(size)
+            f.seek(off)
+            old = f.read(1)
+            f.seek(off)
+            f.write(bytes([old[0] ^ rng.randrange(1, 256)]))
+            flipped += 1
+    return flipped
+
+
 class Fault:
     """One typed fault.  ``kind`` is one of :data:`FAULT_KINDS`; ``at_s``
     is the (injected-clock) second it arms; ``duration_s`` bounds the
@@ -111,7 +155,14 @@ class Fault:
       failures (each ``step()`` in the window raises
       :class:`InjectedAllocationError` before the inner engine runs —
       the OOM shape the flight recorder's forensics dump is tested
-      against).
+      against);
+    - ``torn_write`` / ``corrupt_file``: filesystem faults — never fired
+      by :class:`FaultyEngine`; ``CheckpointManager`` consults them at
+      save time with its save-ordinal clock (``at_s`` = save index) and
+      applies :func:`torn_write` (truncate mid-save → the step stays
+      uncommitted) or :func:`corrupt_file` (flip bytes *after* commit →
+      only the digest verification in ``latest()`` catches it);
+      ``count`` bounds how many saves are hit.
 
     ``replica=None`` matches every replica; a name targets one (the
     :meth:`FaultPlan.for_replica` selector)."""

@@ -205,6 +205,56 @@ class ModelCheckpoint(Callback):
             self.model.save(os.path.join(self.save_dir, "final"))
 
 
+class ManagedCheckpoint(Callback):
+    """Crash-consistent twin of :class:`ModelCheckpoint`: epoch saves go
+    through a ``train_resilience.CheckpointManager`` two-phase commit
+    (digest manifest + COMMIT marker, bounded retention), and
+    ``on_train_begin`` restores the newest *verified* checkpoint — a kill
+    mid-save can never leave ``fit`` resuming from torn state, which the
+    plain ``model.save`` path cannot promise.
+
+    The bundle is the model's functional TrainState (params, optimizer
+    slots, buffers — AMP scaler state included when configured) plus the
+    epoch index; restored weights are synced back into the eager Layer so
+    ``model.network`` agrees with the resumed state.  Epoch numbering is
+    the step index, so ``manager.keep_every`` pins every N-th epoch.
+    ``resumed_epoch`` reports where training picked up (the fit loop
+    still drives its own epoch range; skip-ahead is the caller's call)."""
+
+    def __init__(self, manager, save_freq: int = 1, resume: bool = True):
+        super().__init__()
+        self.manager = manager
+        self.save_freq = max(1, int(save_freq))
+        self.resume = resume
+        self.resumed_epoch = None
+
+    def on_train_begin(self, logs=None):
+        if not self.resume or self.manager.latest() is None:
+            return
+        self.model._ensure_train_step()
+        template = {"train": self.model._state, "epoch": 0}
+        epoch, bundle = self.manager.restore(template)
+        self.model._state = bundle["train"]
+        from ..jit.functional import sync_state_to_layer
+        sync_state_to_layer(self.model.network, self.model._state)
+        self.resumed_epoch = int(bundle["epoch"])
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch % self.save_freq != 0 or self.model._state is None:
+            return
+        bundle = {"train": self.model._state, "epoch": int(epoch)}
+        self.manager.save(bundle, epoch).wait()
+        self.manager.gc()
+
+    def on_train_end(self, logs=None):
+        if self.model._state is None:
+            return
+        final = {"train": self.model._state,
+                 "epoch": int(self.params.get("epochs", 0))}
+        self.manager.save(final, self.params.get("epochs", 0)).wait()
+        self.manager.gc()
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):

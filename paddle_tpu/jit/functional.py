@@ -319,6 +319,18 @@ def make_eval_step(layer, loss_fn=None):
     return _tracks_compiled_calls(step)
 
 
+def fold_in_step_key(base_key, step: int):
+    """THE per-step RNG derivation: ``key_t = fold_in(base_key, t)``.
+
+    The step key is a pure function of (base key, step index) — no
+    mutable split chain — so a training loop resumed at step ``t`` from
+    a checkpoint (``train_resilience.CheckpointManager`` stores only the
+    base key + the step counter) regenerates bit-identical dropout/noise
+    keys for every subsequent step.  Accepts typed (``jax.random.key``)
+    and legacy ``uint32`` keys alike."""
+    return jax.random.fold_in(base_key, int(step))
+
+
 def sync_state_to_layer(layer, state) -> None:
     """Write a functional TrainState's params/buffers back into the Layer."""
     named_p = dict(layer.named_parameters())

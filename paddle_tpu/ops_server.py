@@ -251,13 +251,22 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._send(200, json.dumps(payload, indent=2),
                                "application/json")
+            elif route == "/train":
+                payload = ops._render_train()
+                if payload is None:
+                    self._send(404, json.dumps(
+                        {"error": "no train supervisor attached"}),
+                        "application/json")
+                else:
+                    self._send(200, json.dumps(payload, indent=2),
+                               "application/json")
             else:
                 self._send(404, json.dumps(
                     {"error": f"unknown route {route!r}", "routes":
                      ["/metrics", "/healthz", "/ledger", "/trace",
                       "/gateway", "/requests", "/request/<trace_id>",
                       "/resilience", "/slo", "/autoscaler", "/kvstore",
-                      "/memory", "/fleet"]}),
+                      "/memory", "/fleet", "/train"]}),
                     "application/json")
         except Exception as e:
             ops._log.warning("ops server: %s failed: %r", route, e)
@@ -308,6 +317,7 @@ class OpsServer:
         self._kvstores: List[Tuple[str, Any]] = []  # TieredKVStore
         self._memories: List[Tuple[str, Any]] = []  # MemoryLedger
         self._fleets: List[Tuple[str, Any]] = []    # FleetCollector
+        self._trains: List[Tuple[str, Any]] = []    # TrainSupervisor
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._started_at = time.monotonic()
@@ -331,6 +341,9 @@ class OpsServer:
           replicas' stores to /kvstore without this);
         - ``SLOMonitor`` (has ``add_objective``/``evaluate``) → /slo +
           /metrics burn-rate/alert gauges;
+        - ``TrainSupervisor`` (has ``train_snapshot``) → /train +
+          /metrics ``paddle_tpu_train_resilience_*`` counters (its
+          ``.tracer``, when set, is attached too);
         - ``Tracer`` / ``TrainMonitor`` (has ``events`` +
           ``prometheus_text``) → /metrics + /trace + liveness;
         - a serving engine (has ``prometheus_text``; its ``.tracer``, when
@@ -373,6 +386,15 @@ class OpsServer:
                 # expose prometheus_text, only this one serves /memory
                 self._memories.append(
                     (name or f"memory{len(self._memories)}", obj))
+            elif hasattr(obj, "train_snapshot"):
+                # TrainSupervisor: /train + its resilience counters on
+                # /metrics (+ its tracer's surfaces)
+                base = name or f"train{len(self._trains)}"
+                self._trains.append((base, obj))
+                self._engines.append((base, obj))   # /metrics exposition
+                tracer = getattr(obj, "tracer", None)
+                if tracer is not None:
+                    self._tracers.append((f"{base}.tracer", tracer))
             elif hasattr(obj, "snapshot") and hasattr(obj, "record"):
                 self._ledgers.append(
                     (name or f"ledger{len(self._ledgers)}", obj))
@@ -648,6 +670,15 @@ class OpsServer:
             return fleets[0][1].fleet_snapshot()
         return {name: fc.fleet_snapshot() for name, fc in fleets}
 
+    def _render_train(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            trains = list(self._trains)
+        if not trains:
+            return None
+        if len(trains) == 1:
+            return trains[0][1].train_snapshot()
+        return {name: sup.train_snapshot() for name, sup in trains}
+
     #: JSON routes a FleetCollector scrapes, mapped to their renderers —
     #: the in-process (server=) scrape path of ``render()``
     _RENDERS = {"/metrics": "_render_metrics",
@@ -658,7 +689,8 @@ class OpsServer:
                 "/memory": "_render_memory",
                 "/autoscaler": "_render_autoscaler",
                 "/resilience": "_render_resilience",
-                "/fleet": "_render_fleet"}
+                "/fleet": "_render_fleet",
+                "/train": "_render_train"}
 
     def render(self, route: str):
         """Render one scrape surface WITHOUT a socket: the text

@@ -91,6 +91,13 @@ the Paddle-profiler/fleet-metrics role for the TRAIN loop):
                 reduction), ``post_bytes`` (the policy's), ``savings``
                 (pre/post) — host-side estimates from the grad-tree
                 shapes, never a device sync.
+``train_resilience``  one crash-consistency decision
+                (``paddle_tpu.train_resilience``): ``what`` in
+                ``save_commit`` / ``save_abandon`` / ``restore`` /
+                ``restart`` / ``corrupt_skip`` / ``preempt_request`` /
+                ``preempt_save`` / ``elastic_exit`` / ``fault_inject`` /
+                ``rules_mismatch`` / ``give_up`` / ``gc``, with ``step``
+                and per-kind fields (reason, bytes, backoff).
 
 Goodput accounting: a ``telemetry_ledger.RunLedger`` attaches to either
 layer via ``set_ledger`` — tick/compile/train_step/sync durations forward
@@ -777,6 +784,20 @@ class Tracer:
                     ev.get("bytes", 0) for ev in kv
                     if ev.get("what") == "migrate_done"),
             }
+        tr_ev = self.events("train_resilience")
+        tr_summary = None
+        if tr_ev:
+            tr_counts: Dict[str, int] = {}
+            for ev in tr_ev:
+                tr_counts[ev.get("what", "?")] = \
+                    tr_counts.get(ev.get("what", "?"), 0) + 1
+            tr_summary = {
+                "events": tr_counts,
+                # the newest durably-committed step (resume point truth)
+                "last_commit_step": max(
+                    (ev.get("step", -1) for ev in tr_ev
+                     if ev.get("what") == "save_commit"), default=None),
+            }
         out = {
             "ticks": len(ticks),
             "ticks_total": int(reg.value("ticks")),
@@ -799,6 +820,8 @@ class Tracer:
             out["gateway"] = gw_summary
         if kv_summary is not None:     # only kv-tiering-fed tracers
             out["kvstore"] = kv_summary
+        if tr_summary is not None:     # only checkpoint/supervisor-fed
+            out["train_resilience"] = tr_summary
         return out
 
     def mfu_summary(self) -> Dict[str, Any]:

@@ -1,0 +1,990 @@
+"""Crash-consistent training: two-phase-commit checkpoints, preemption-aware
+saves, and a self-healing train supervisor.
+
+The serving plane survives chaos (PR 12: fault injection + the gateway's
+resilience layer); this module is the training-side twin.  Three parts:
+
+**CheckpointManager** — versioned ``step-NNNNNNNN/`` directories over the
+existing :mod:`paddle_tpu.distributed.checkpoint` writer, with a real
+two-phase commit:
+
+    write payload chunks → fsync every file → write ``ckpt.manifest.json``
+    (per-file blake2b content digests + byte sizes + the process's
+    ``sharding_rules_digest``) → fsync → atomic ``COMMIT`` marker LAST
+    → fsync the directory
+
+A step directory without a ``COMMIT`` marker never existed as far as
+resolution is concerned; a step whose files disagree with the digests is
+bitrot and is *skipped with a counted reason*, never loaded.  ``latest()``
+therefore always answers "the newest step that is provably whole" — it
+never loads garbage and never crashes on a half-written directory (the
+SIGKILL-mid-save shape).  Retention is bounded (``retain`` newest committed
+steps) with keep-every-N pinning for long-horizon rollback.  Async saves
+ride the existing :class:`~paddle_tpu.distributed.checkpoint.SaveHandle`
+(device→host snapshot is synchronous and attributes to the goodput
+ledger's ``checkpoint_save`` bucket; digesting + commit chain on the same
+background executor).
+
+**PreemptionGuard** — a SIGTERM hook installed with the FlightRecorder
+signal discipline (pinned bound-method handler identity, previous handler
+saved): the handler only *requests* an emergency checkpoint; the
+supervisor honors it at the next step boundary with a hard deadline
+(``deadline_s``) — an emergency save that misses the deadline is abandoned
+*uncommitted* (the prior committed step stays the resume point), then
+:meth:`PreemptionGuard.release` chains the deferred previous handler so
+the process dies exactly as it would have, just after the save window.
+
+**TrainSupervisor** — wraps any ``make_*_train_step``-style loop: a step
+that raises (injected ``alloc_fail``, a watchdog non-finite-loss
+escalation, :class:`~paddle_tpu.faults.TransientDispatchError`) triggers
+restore-from-last-good with exponential backoff and a bounded restart
+budget; every decision is a ``train_resilience`` tracer event
+(``save_commit`` / ``save_abandon`` / ``restore`` / ``restart`` /
+``corrupt_skip`` / ``preempt_request`` / ``preempt_save`` / ``elastic_exit``)
+plus ``paddle_tpu_train_resilience_*`` prometheus counters, and
+``train_snapshot()`` feeds the ops server's ``GET /train`` route.
+``elastic=`` plugs a :class:`~paddle_tpu.distributed.fleet.elastic
+.ElasticManager` into the step boundary so world-size changes exit through
+the same verified save path (resume reshards via ``sharding_rules`` — the
+checkpoint layer loads into whatever mesh the relaunch compiles).
+
+Bit-exact resume contract: a checkpoint bundles params, optimizer state
+(including 1/R update-sharded shards from ``distributed/update_sharding``),
+grad_comm ``comm_e`` error-feedback residual, the *base* RNG key + step
+counter (per-step keys are re-derived via
+:func:`paddle_tpu.jit.functional.fold_in_step_key`, a pure function of
+both), and the data-iterator epoch/offset — so the resumed loss trajectory
+equals the uninterrupted run's exactly.  docs/TRAINING_RESILIENCE.md walks
+the protocol state machine and the runbook.
+
+No reference counterpart: the reference's fleet/elastic checkpoints via
+whole-program pickle with no commit marker, digest, or RNG capture.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import math
+import os
+import shutil
+import signal as _signal
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .distributed import checkpoint as _ckpt
+from .distributed.checkpoint import CorruptCheckpoint
+from .faults import (FaultInjectionError, FaultPlan, InjectedAllocationError,
+                     TransientDispatchError)
+from .faults import corrupt_file as _apply_corrupt_file
+from .faults import torn_write as _apply_torn_write
+from .utils.stats import StatRegistry
+
+__all__ = ["CheckpointManager", "ManagedSaveHandle", "PreemptionGuard",
+           "ResumableIterator", "TrainSupervisor", "CorruptCheckpoint",
+           "NonFiniteLossError", "RestartBudgetExhausted",
+           "pack_train_state", "unpack_train_state"]
+
+_COMMIT = "COMMIT"
+_MANAGER_MANIFEST = "ckpt.manifest.json"
+_STEP_FMT = "step-{:08d}"
+_FS_FAULT_KINDS = ("torn_write", "corrupt_file")
+
+
+class NonFiniteLossError(FloatingPointError):
+    """The numerics watchdog escalated: the loss came back NaN/Inf.  The
+    supervisor raises this AFTER the step returned (the state is already
+    poisoned) so the restore path rolls back to the last committed
+    checkpoint instead of checkpointing the NaN forward."""
+
+
+class RestartBudgetExhausted(RuntimeError):
+    """The supervisor's bounded restart budget ran out — the failure is
+    not transient; a human (or the launcher's own restart policy) has to
+    decide.  Carries the last exception as ``__cause__``."""
+
+
+# --------------------------------------------------------------------------
+# small fs helpers
+# --------------------------------------------------------------------------
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _digest_file(path: str) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _step_dirname(step: int) -> str:
+    return _STEP_FMT.format(int(step))
+
+
+def _parse_step_dirname(name: str) -> Optional[int]:
+    if not name.startswith("step-"):
+        return None
+    digits = name[len("step-"):]
+    return int(digits) if digits.isdigit() else None
+
+
+# --------------------------------------------------------------------------
+# full-state bundling (what "everything needed for bit-exact resume" means)
+# --------------------------------------------------------------------------
+
+def _is_typed_key(key) -> bool:
+    import jax
+    try:
+        return jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def pack_train_state(state, *, step: int, base_key=None,
+                     data_state: Optional[Dict[str, int]] = None) -> Dict:
+    """Bundle a functional TrainState plus the loop-side state a restart
+    needs: the step counter, the *base* RNG key (per-step keys re-derive
+    via ``fold_in_step_key``), and the data-iterator position.  Typed
+    ``jax.random.key`` keys are stored as their ``key_data`` uint32 array
+    (npy-serializable) with a flag to re-wrap on restore."""
+    import jax
+    bundle: Dict[str, Any] = {"train": state, "step": int(step)}
+    if base_key is not None:
+        typed = _is_typed_key(base_key)
+        kd = jax.random.key_data(base_key) if typed else base_key
+        bundle["rng"] = {"key_data": np.asarray(kd), "typed": bool(typed)}
+    if data_state is not None:
+        bundle["data"] = {k: int(v) for k, v in sorted(data_state.items())}
+    return bundle
+
+
+def unpack_train_state(bundle: Dict):
+    """Inverse of :func:`pack_train_state`: returns
+    ``(state, step, base_key, data_state)`` (key/data None when absent)."""
+    import jax
+    key = None
+    if "rng" in bundle:
+        kd = bundle["rng"]["key_data"]
+        key = jax.random.wrap_key_data(np.asarray(kd).astype(np.uint32)) \
+            if bundle["rng"]["typed"] else kd
+    return (bundle["train"], int(bundle["step"]), key, bundle.get("data"))
+
+
+# --------------------------------------------------------------------------
+# CheckpointManager
+# --------------------------------------------------------------------------
+
+class ManagedSaveHandle:
+    """Join handle for a managed (optionally async) save.  ``wait()``
+    joins payload writes AND the commit phase; ``committed`` is the
+    truth bit — False means the step was abandoned (torn payload, missed
+    deadline, injected fault) and the previous committed step is still
+    the resume point."""
+
+    def __init__(self, step: int, path: str, future=None,
+                 committed: bool = False):
+        self.step = int(step)
+        self.path = path
+        self._future = future
+        self._committed = bool(committed)
+
+    def wait(self) -> bool:
+        if self._future is not None:
+            self._committed = bool(self._future.result())
+            self._future = None
+        return self._committed
+
+    result = wait
+
+    def done(self) -> bool:
+        return self._future is None or self._future.done()
+
+    @property
+    def committed(self) -> bool:
+        if self._future is not None and self._future.done():
+            self.wait()
+        return self._committed
+
+
+class CheckpointManager:
+    """Versioned two-phase-commit checkpoints under ``root`` (module
+    docstring for the protocol).  ``fault_plan`` faults of kind
+    ``torn_write``/``corrupt_file`` are consulted at save time with a
+    **save-ordinal clock** (``Fault(at_s=2)`` hits the third save) —
+    chaos tests drive the exact crash shapes through the same plan
+    vocabulary as the serving faults."""
+
+    def __init__(self, root: str, retain: int = 5,
+                 keep_every: Optional[int] = None, tracer=None,
+                 registry: Optional[StatRegistry] = None,
+                 fault_plan: Optional[FaultPlan] = None,
+                 logger: Optional[logging.Logger] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        if int(retain) < 1:
+            raise ValueError("retain must be >= 1")
+        self.retain = int(retain)
+        self.keep_every = None if keep_every is None else int(keep_every)
+        self.tracer = tracer
+        # guarded-by: none — StatRegistry serializes internally (per-stat
+        # locks), safe from the async-commit pool thread
+        self.registry = registry if registry is not None else StatRegistry()
+        self.fault_plan = fault_plan
+        self._mu = threading.Lock()
+        self._fault_spent: Dict[int, int] = {}      # guarded-by: _mu
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._clock = clock
+        self._save_ordinal = 0
+        #: skip-reason counters ``latest()`` accumulates (each torn step
+        #: is counted once per reason, not once per ``latest()`` call)
+        self.skips: Dict[str, int] = {}             # guarded-by: _mu
+        self._counted_skips: set = set()            # guarded-by: _mu
+        self._inflight: Dict[int, ManagedSaveHandle] = {}  # guarded-by: _mu
+        self.rules_mismatch_steps: List[int] = []   # guarded-by: _mu
+
+    # ------------------------------------------------------------- paths --
+    def step_path(self, step: int) -> str:
+        return os.path.join(self.root, _step_dirname(step))
+
+    def steps(self) -> List[int]:
+        """Every step directory under root (committed or not), ascending."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            step = _parse_step_dirname(name)
+            if step is not None and os.path.isdir(os.path.join(self.root, name)):
+                out.append(step)
+        return sorted(out)
+
+    def is_committed(self, step: int) -> bool:
+        return os.path.exists(os.path.join(self.step_path(step), _COMMIT))
+
+    # -------------------------------------------------------------- save --
+    def save(self, bundle, step: int, *, async_save: bool = False,
+             deadline_s: Optional[float] = None,
+             meta: Optional[Dict] = None) -> ManagedSaveHandle:
+        """Two-phase-commit save of ``bundle`` as step ``step``.
+
+        Sync: returns with ``committed`` already known.  Async: payload
+        snapshot is synchronous (rides ``distributed.checkpoint.save``'s
+        ledger-attributed device→host copy); file writes + digest +
+        commit chain on the checkpoint executor; ``wait()`` joins.
+        ``deadline_s`` bounds the WHOLE save wall (emergency-save
+        semantics): past it, the commit marker is withheld and the step
+        abandoned."""
+        step = int(step)
+        path = self.step_path(step)
+        if os.path.isdir(path):
+            # re-save of a step (restart replay): the old dir — committed
+            # or torn — is superseded; drop it so stale files can't mix in
+            shutil.rmtree(path)
+        t0 = self._clock()
+        ordinal = self._save_ordinal
+        self._save_ordinal += 1
+        inner = _ckpt.save(bundle, path, async_save=async_save)
+        if async_save:
+            fut = _ckpt._get_executor().submit(
+                self._commit, inner, path, step, t0, ordinal, deadline_s,
+                meta)
+            handle = ManagedSaveHandle(step, path, future=fut)
+            with self._mu:
+                self._inflight[step] = handle
+            return handle
+        committed = self._commit(inner, path, step, t0, ordinal,
+                                 deadline_s, meta)
+        return ManagedSaveHandle(step, path, committed=committed)
+
+    def _commit(self, inner, path: str, step: int, t0: float, ordinal: int,
+                deadline_s: Optional[float], meta: Optional[Dict]) -> bool:
+        try:
+            inner.wait()
+        except Exception as e:  # noqa: BLE001 — payload failure of ANY
+            # shape (disk full, injected) must abandon, not crash commit
+            self._abandon(step, f"payload_error:{type(e).__name__}")
+            return False
+        if self._maybe_torn_write(path, step, ordinal):
+            # the torn step stays UNCOMMITTED on disk — exactly what a
+            # crash mid-payload leaves — so resolution must skip it
+            self._abandon(step, "torn_write")
+            return False
+        try:
+            digests = self._digest_payload(path)
+        except OSError as e:
+            self._abandon(step, f"digest_error:{type(e).__name__}")
+            return False
+        from .distributed.sharding_rules import sharding_rules_digest
+        manifest = {"format": 1, "step": step, "files": digests,
+                    "sharding_rules_digest": sharding_rules_digest(),
+                    "meta": meta or {}}
+        mtmp = os.path.join(path, _MANAGER_MANIFEST + ".tmp")
+        with open(mtmp, "w") as f:
+            json.dump(manifest, f)
+        _fsync_path(mtmp)
+        os.replace(mtmp, os.path.join(path, _MANAGER_MANIFEST))
+        if deadline_s is not None and self._clock() - t0 > deadline_s:
+            self._abandon(step, "deadline", deadline_s=deadline_s)
+            return False
+        ctmp = os.path.join(path, _COMMIT + ".tmp")
+        with open(ctmp, "w") as f:
+            json.dump({"step": step,
+                       "manifest_blake2b": _digest_file(
+                           os.path.join(path, _MANAGER_MANIFEST))}, f)
+        _fsync_path(ctmp)
+        os.replace(ctmp, os.path.join(path, _COMMIT))
+        _fsync_path(path)
+        with self._mu:
+            self._inflight.pop(step, None)
+        wall = self._clock() - t0
+        nbytes = sum(rec["bytes"] for rec in digests.values())
+        self.registry.add("saves_committed")
+        self.registry.set("last_committed_step", step)
+        self._emit("save_commit", step=step, wall_s=wall, bytes=nbytes,
+                   files=len(digests))
+        self._maybe_corrupt_file(path, step, ordinal)
+        return True
+
+    def _digest_payload(self, path: str) -> Dict[str, Dict]:
+        out: Dict[str, Dict] = {}
+        for fname in sorted(os.listdir(path)):
+            if fname in (_COMMIT, _MANAGER_MANIFEST) or fname.endswith(".tmp"):
+                continue
+            fp = os.path.join(path, fname)
+            _fsync_path(fp)
+            out[fname] = {"blake2b": _digest_file(fp),
+                          "bytes": os.path.getsize(fp)}
+        return out
+
+    def _abandon(self, step: int, reason: str, **fields):
+        with self._mu:
+            self._inflight.pop(step, None)
+        self.registry.add("saves_abandoned")
+        self._emit("save_abandon", step=step, reason=reason, **fields)
+        self._log.warning("checkpoint step %d abandoned uncommitted (%s)",
+                          step, reason)
+
+    # ---------------------------------------------------------- fs faults --
+    def _fs_fault(self, kind: str, ordinal: int):
+        if self.fault_plan is None:
+            return None
+        for f in self.fault_plan.faults:
+            if f.kind != kind or not f.active(float(ordinal)):
+                continue
+            if f.count is not None:
+                with self._mu:
+                    used = self._fault_spent.get(id(f), 0)
+                    if used >= f.count:
+                        continue
+                    self._fault_spent[id(f)] = used + 1
+            return f
+        return None
+
+    def _payload_files(self, path: str) -> List[str]:
+        return sorted(f for f in os.listdir(path)
+                      if f not in (_COMMIT, _MANAGER_MANIFEST)
+                      and not f.endswith(".tmp") and f.endswith(".npy"))
+
+    def _maybe_torn_write(self, path: str, step: int, ordinal: int) -> bool:
+        fault = self._fs_fault("torn_write", ordinal)
+        if fault is None:
+            return False
+        files = self._payload_files(path)
+        if not files:
+            return False
+        rng = self.fault_plan.rng(f"ckpt:{ordinal}")
+        victim = files[rng.randrange(len(files))]
+        kept = _apply_torn_write(os.path.join(path, victim), rng)
+        self._emit("fault_inject", fault="torn_write", step=step,
+                   file=victim, kept_bytes=kept)
+        return True
+
+    def _maybe_corrupt_file(self, path: str, step: int, ordinal: int) -> None:
+        fault = self._fs_fault("corrupt_file", ordinal)
+        if fault is None:
+            return
+        files = self._payload_files(path)
+        if not files:
+            return
+        rng = self.fault_plan.rng(f"ckpt:{ordinal}")
+        victim = files[rng.randrange(len(files))]
+        flipped = _apply_corrupt_file(os.path.join(path, victim), rng)
+        self._emit("fault_inject", fault="corrupt_file", step=step,
+                   file=victim, flipped_bytes=flipped)
+
+    # -------------------------------------------------------- resolution --
+    def verify(self, step: int) -> Tuple[bool, Optional[str]]:
+        """Is step ``step`` provably whole?  ``(True, None)`` or
+        ``(False, reason)`` with reason in ``uncommitted`` /
+        ``bad_manifest`` / ``missing_file`` / ``size_mismatch`` /
+        ``digest_mismatch``.  Never raises on a damaged directory."""
+        path = self.step_path(step)
+        if not os.path.exists(os.path.join(path, _COMMIT)):
+            return False, "uncommitted"
+        try:
+            with open(os.path.join(path, _COMMIT)) as f:
+                marker = json.load(f)
+            with open(os.path.join(path, _MANAGER_MANIFEST)) as f:
+                raw = f.read()
+            manifest = json.loads(raw)
+        except (OSError, ValueError):
+            return False, "bad_manifest"
+        want = marker.get("manifest_blake2b")
+        if want is not None:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(raw.encode())
+            if h.hexdigest() != want:
+                return False, "bad_manifest"
+        for fname, rec in manifest.get("files", {}).items():
+            fp = os.path.join(path, fname)
+            try:
+                size = os.path.getsize(fp)
+            except OSError:
+                return False, "missing_file"
+            if size != rec["bytes"]:
+                return False, "size_mismatch"
+            if _digest_file(fp) != rec["blake2b"]:
+                return False, "digest_mismatch"
+        from .distributed.sharding_rules import sharding_rules_digest
+        if manifest.get("sharding_rules_digest") != sharding_rules_digest() \
+                and step not in self.rules_mismatch_steps:
+            # NOT fatal: an elastic rescale / rule edit legitimately
+            # resumes old checkpoints (resharding happens at load) — but
+            # the operator should know the rules moved under the data
+            with self._mu:
+                self.rules_mismatch_steps.append(step)
+            self._emit("rules_mismatch", step=step)
+            self._log.warning(
+                "checkpoint step %d was saved under different sharding "
+                "rules (resume reshards via the current rules)", step)
+        return True, None
+
+    def latest(self, verify: bool = True) -> Optional[int]:
+        """The newest step that is provably whole (or merely COMMIT-marked
+        with ``verify=False``).  Torn/corrupt/uncommitted steps are
+        skipped with a counted reason — never loaded, never raised on."""
+        for step in reversed(self.steps()):
+            if verify:
+                ok, reason = self.verify(step)
+            else:
+                ok = self.is_committed(step)
+                reason = None if ok else "uncommitted"
+            if ok:
+                return step
+            self._count_skip(step, reason)
+        return None
+
+    def _count_skip(self, step: int, reason: str) -> None:
+        with self._mu:
+            if (step, reason) in self._counted_skips:
+                return
+            self._counted_skips.add((step, reason))
+            self.skips[reason] = self.skips.get(reason, 0) + 1
+        self.registry.add("corrupt_skips")
+        self._emit("corrupt_skip", step=step, reason=reason)
+        self._log.warning("skipping checkpoint step %d (%s)", step, reason)
+
+    def restore(self, target, step: Optional[int] = None, shardings=None):
+        """Load step ``step`` (default: :meth:`latest`) into ``target``'s
+        tree structure; returns ``(step, bundle)``.  Raises
+        :class:`CorruptCheckpoint` when an explicit step fails
+        verification or no valid step exists — latest-resolution itself
+        never loads garbage."""
+        if step is None:
+            step = self.latest()
+            if step is None:
+                raise CorruptCheckpoint(
+                    f"no committed+verified checkpoint under {self.root!r} "
+                    f"(skips so far: {self.skips})")
+        else:
+            ok, reason = self.verify(step)
+            if not ok:
+                raise CorruptCheckpoint(
+                    f"checkpoint step {step} fails verification: {reason}")
+        bundle = _ckpt.load(self.step_path(step), target=target,
+                            shardings=shardings)
+        self.registry.add("restores")
+        self._emit("restore", step=step)
+        return step, bundle
+
+    # ---------------------------------------------------------- retention --
+    def gc(self) -> List[int]:
+        """Bounded retention: keep the ``retain`` newest committed steps
+        plus every ``keep_every``-pinned committed step; delete older
+        committed steps and any uncommitted junk strictly older than the
+        newest committed step (abandoned dirs newer than it may be an
+        in-flight save — untouched).  Returns the removed steps."""
+        steps = self.steps()
+        committed = [s for s in steps if self.is_committed(s)]
+        if not committed:
+            return []
+        newest = committed[-1]
+        keep = set(committed[-self.retain:])
+        if self.keep_every:
+            keep.update(s for s in committed if s % self.keep_every == 0)
+        removed = []
+        for s in steps:
+            if s in keep or s >= newest:
+                continue
+            with self._mu:
+                handle = self._inflight.get(s)
+            if handle is not None and not handle.done():
+                continue
+            shutil.rmtree(self.step_path(s), ignore_errors=True)
+            removed.append(s)
+        if removed:
+            self._emit("gc", removed=len(removed), newest=newest)
+        return removed
+
+    # ------------------------------------------------------------ plumbing --
+    def _emit(self, what: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.emit("train_resilience", what=what, **fields)
+
+    def snapshot(self) -> Dict[str, Any]:
+        reg = self.registry
+        return {"root": self.root,
+                "steps": self.steps(),
+                "latest_committed": self.latest(verify=False),
+                "saves_committed": int(reg.value("saves_committed")),
+                "saves_abandoned": int(reg.value("saves_abandoned")),
+                "corrupt_skips": int(reg.value("corrupt_skips")),
+                "restores": int(reg.value("restores")),
+                "skips": dict(self.skips),
+                "retain": self.retain, "keep_every": self.keep_every}
+
+
+# --------------------------------------------------------------------------
+# preemption
+# --------------------------------------------------------------------------
+
+class PreemptionGuard:
+    """SIGTERM → "emergency checkpoint at the next step boundary".
+
+    Installed with the FlightRecorder signal discipline: the bound-method
+    handler identity is pinned at construction, the previous handler is
+    saved, and uninstall only restores when the slot still holds OUR
+    handler.  The chain is *deferred*, not dropped: the handler merely
+    records the request; after the supervisor's emergency save,
+    :meth:`release` re-delivers to the previous handler (FlightRecorder's
+    dump-then-die, or the default action) so the process terminates
+    exactly as the signal intended — just after the save window.
+    ``request()`` is the imperative twin for tests and benches."""
+
+    def __init__(self, signals: Sequence[int] = (_signal.SIGTERM,),
+                 tracer=None, logger: Optional[logging.Logger] = None):
+        self.signals = tuple(signals)
+        self.tracer = tracer
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._handler = self._on_signal  # pinned bound-method identity
+        self._prev: Dict[int, Any] = {}
+        self._installed = False
+        self._requested = False
+        self._signum: Optional[int] = None
+
+    def install(self) -> "PreemptionGuard":
+        for s in self.signals:
+            self._prev[s] = _signal.getsignal(s)
+            _signal.signal(s, self._handler)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        for s in self.signals:
+            if _signal.getsignal(s) is self._handler:
+                _signal.signal(s, self._prev.get(s, _signal.SIG_DFL))
+        self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self._requested = True
+        self._signum = signum
+        if self.tracer is not None:
+            self.tracer.emit("train_resilience", what="preempt_request",
+                             signum=int(signum))
+        self._log.warning(
+            "signal %d: emergency checkpoint requested at next step "
+            "boundary", signum)
+
+    def request(self) -> None:
+        """Imperative preemption request (the deterministic
+        SIGTERM-equivalent benches and tests use)."""
+        self._requested = True
+
+    @property
+    def requested(self) -> bool:
+        return self._requested
+
+    def reset(self) -> None:
+        self._requested = False
+        self._signum = None
+
+    def release(self) -> None:
+        """Chain the deferred signal to the previous handler (call after
+        the emergency save).  No-op when the request was imperative."""
+        signum = self._signum
+        self.uninstall()
+        if signum is None:
+            return
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, None)
+        elif prev == _signal.SIG_DFL:
+            _signal.signal(signum, _signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+
+# --------------------------------------------------------------------------
+# resumable data
+# --------------------------------------------------------------------------
+
+class ResumableIterator:
+    """Deterministic, seekable batch stream over an indexable dataset:
+    ``(epoch, offset)`` IS the whole iteration state, so a checkpoint
+    stores two ints and ``seek()`` replays from exactly the same batch —
+    the data half of the bit-exact resume contract."""
+
+    def __init__(self, batches: Sequence):
+        if len(batches) == 0:
+            raise ValueError("ResumableIterator needs at least one batch")
+        self._batches = batches
+        self.epoch = 0
+        self.offset = 0
+
+    def state(self) -> Dict[str, int]:
+        return {"epoch": self.epoch, "offset": self.offset}
+
+    def seek(self, state: Dict[str, int]) -> None:
+        self.epoch = int(state.get("epoch", 0))
+        self.offset = int(state.get("offset", 0)) % len(self._batches)
+
+    def next_batch(self):
+        batch = self._batches[self.offset]
+        self.offset += 1
+        if self.offset >= len(self._batches):
+            self.offset = 0
+            self.epoch += 1
+        return batch
+
+    def __len__(self):
+        return len(self._batches)
+
+
+# --------------------------------------------------------------------------
+# TrainSupervisor
+# --------------------------------------------------------------------------
+
+class TrainSupervisor:
+    """Self-healing driver around a functional train step (module
+    docstring).  ``step_fn`` follows the :func:`make_train_step` shape
+    ``step(state, key, lr, inputs, labels) -> (state, (loss, out))`` by
+    default; pass ``call=`` to adapt any other ``make_*_train_step``
+    signature: ``call(step_fn, state, key_t, lr, batch) -> (state, loss)``.
+
+    Recovery: a retryable step exception restores the last committed
+    checkpoint, sleeps an exponential backoff, and replays — at most
+    ``restart_budget`` times (then :class:`RestartBudgetExhausted`).
+    A checkpoint is always taken at the resume point before the first
+    step so a last-good exists even for a step-0 failure.  ``fault_plan``
+    drives deterministic chaos with a **step-valued clock**
+    (``Fault("alloc_fail", at_s=7, count=1)`` fires before step 7)."""
+
+    #: step exceptions the restore path absorbs (everything else is a
+    #: structural bug and propagates — budget or not)
+    RETRYABLE = (FaultInjectionError, TransientDispatchError, MemoryError,
+                 NonFiniteLossError)
+
+    def __init__(self, step_fn, state, manager: CheckpointManager, *,
+                 base_key=None, lr: float = 1e-2,
+                 data: Optional[ResumableIterator] = None,
+                 call: Optional[Callable] = None,
+                 save_every: int = 50, async_save: bool = False,
+                 restart_budget: int = 3, backoff_s: float = 0.5,
+                 backoff_factor: float = 2.0, backoff_max_s: float = 30.0,
+                 escalate_non_finite: bool = True,
+                 guard: Optional[PreemptionGuard] = None,
+                 emergency_deadline_s: float = 30.0,
+                 elastic=None, elastic_exit: Callable[[int], Any] = sys.exit,
+                 fault_plan: Optional[FaultPlan] = None,
+                 shardings=None, tracer=None,
+                 registry: Optional[StatRegistry] = None,
+                 logger: Optional[logging.Logger] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 on_boundary: Optional[Callable[[int, "TrainSupervisor"],
+                                               None]] = None):
+        self.step_fn = step_fn
+        self.state = state
+        self.manager = manager
+        self.base_key = base_key
+        self.lr = lr
+        self.data = data
+        self._call = call if call is not None else self._default_call
+        self.save_every = int(save_every)
+        self.async_save = bool(async_save)
+        self.restart_budget = int(restart_budget)
+        self.backoff_s = float(backoff_s)
+        self.backoff_factor = float(backoff_factor)
+        self.backoff_max_s = float(backoff_max_s)
+        self.escalate_non_finite = bool(escalate_non_finite)
+        self.guard = guard
+        self.emergency_deadline_s = emergency_deadline_s
+        self.elastic = elastic
+        self._elastic_exit = elastic_exit
+        self.fault_plan = fault_plan
+        self._fault_spent: Dict[int, int] = {}
+        self.shardings = shardings
+        self.tracer = tracer if tracer is not None else manager.tracer
+        if manager.tracer is None:
+            manager.tracer = self.tracer
+        self.registry = registry if registry is not None else manager.registry
+        self._log = logger if logger is not None \
+            else logging.getLogger(__name__)
+        self._clock = clock
+        self._sleep = sleep
+        self.on_boundary = on_boundary
+        self._step = 0
+        self._status = "idle"
+        self._restarts = 0
+        self._steps_replayed = 0
+        self._recovery_s = 0.0
+        self._last_handle: Optional[ManagedSaveHandle] = None
+        self._last_loss: Optional[float] = None
+        self._preempted = False
+
+    # -------------------------------------------------------- step adapter --
+    @staticmethod
+    def _default_call(step_fn, state, key_t, lr, batch):
+        out = step_fn(state, key_t, lr, *batch)
+        state, aux = out
+        loss = aux[0] if isinstance(aux, (tuple, list)) else aux
+        return state, loss
+
+    # ------------------------------------------------------------- bundling --
+    def _bundle(self, step: int) -> Dict:
+        return pack_train_state(
+            self.state, step=step, base_key=self.base_key,
+            data_state=self.data.state() if self.data is not None else None)
+
+    def _shardings_bundle(self, template: Dict):
+        if self.shardings is None:
+            return None
+        return {"train": self.shardings}
+
+    # ----------------------------------------------------------- save/restore
+    def _save(self, step: int, *, sync: bool = False,
+              deadline_s: Optional[float] = None) -> ManagedSaveHandle:
+        if self._last_handle is not None and not self._last_handle.done():
+            # one async save in flight at a time: joining here bounds
+            # dirty-state lag and keeps save ordinals deterministic
+            if self._last_handle.wait():
+                self.manager.gc()
+        handle = self.manager.save(
+            self._bundle(step), step,
+            async_save=self.async_save and not sync,
+            deadline_s=deadline_s)
+        self._last_handle = handle
+        if not self.async_save or sync:
+            committed = handle.wait()
+            if committed:
+                self.manager.gc()
+        return handle
+
+    def _restore(self) -> int:
+        t0 = self._clock()
+        template = self._bundle(self._step)
+        step, bundle = self.manager.restore(
+            template, shardings=self._shardings_bundle(template))
+        state, t, key, data_state = unpack_train_state(bundle)
+        self.state = state
+        if key is not None:
+            self.base_key = key
+        if self.data is not None and data_state is not None:
+            self.data.seek(data_state)
+        self.registry.set("last_restored_step", step)
+        self._recovery_s += self._clock() - t0
+        return t
+
+    # -------------------------------------------------------------- chaos --
+    def _maybe_inject(self, t: int) -> None:
+        if self.fault_plan is None:
+            return
+        for f in self.fault_plan.faults:
+            if f.kind not in ("alloc_fail", "dispatch_error") \
+                    or not f.active(float(t)):
+                continue
+            if f.count is not None:
+                used = self._fault_spent.get(id(f), 0)
+                if used >= f.count:
+                    continue
+                self._fault_spent[id(f)] = used + 1
+            self._emit("fault_inject", fault=f.kind, step=t)
+            if f.kind == "alloc_fail":
+                raise InjectedAllocationError(
+                    f"injected allocation failure (step {t})")
+            raise TransientDispatchError(
+                f"injected dispatch failure (step {t})")
+
+    # ---------------------------------------------------------------- run --
+    def run(self, num_steps: int, resume: bool = True) -> Dict[str, Any]:
+        """Drive ``num_steps`` total steps (counting from step 0 of the
+        run's life, not from the resume point) and return the result
+        record.  On entry, resumes from the newest verified checkpoint
+        when one exists; otherwise seeds a step-0 checkpoint so a
+        last-good always exists."""
+        t = 0
+        if resume and self.manager.latest() is not None:
+            t = self._restore()
+        else:
+            self._save(t, sync=True)
+        self._status = "running"
+        self._preempted = False
+        loss_by_step: Dict[int, float] = {}
+        t0_run = t
+        while t < int(num_steps):
+            self._step = t
+            self.registry.set("step", t)
+            try:
+                self._maybe_inject(t)
+                key_t = None
+                if self.base_key is not None:
+                    from .jit.functional import fold_in_step_key
+                    key_t = fold_in_step_key(self.base_key, t)
+                batch = self.data.next_batch() if self.data is not None \
+                    else ()
+                state, loss = self._call(self.step_fn, self.state, key_t,
+                                         self.lr, batch)
+                loss_f = float(loss)
+                if self.escalate_non_finite and not math.isfinite(loss_f):
+                    raise NonFiniteLossError(
+                        f"watchdog escalation: non-finite loss at step {t}")
+            except self.RETRYABLE as e:
+                t = self._recover(t, e)
+                # replayed steps overwrite their loss entries, so the
+                # trajectory stays one value per step (the bit-exact
+                # oracle comparison depends on this)
+                for done in [s for s in loss_by_step if s >= t]:
+                    del loss_by_step[done]
+                continue
+            self.state = state
+            self._last_loss = loss_f
+            loss_by_step[t] = loss_f
+            t += 1
+            self._step = t
+            self.registry.set("steps_done", t)
+            if self.on_boundary is not None:
+                self.on_boundary(t, self)
+            if self.guard is not None and self.guard.requested:
+                self._emergency(t, "preempt")
+                self._status = "preempted"
+                self._preempted = True
+                self.guard.release()
+                break
+            if self.elastic is not None:
+                code = self.elastic.exit_code()
+                if code is not None:
+                    self._emergency(t, f"elastic:{code}")
+                    self._emit("elastic_exit", step=t, code=int(code))
+                    self._status = "rescaling"
+                    self._elastic_exit(code)
+                    break  # reached only when elastic_exit doesn't exit
+            if self.save_every and t % self.save_every == 0:
+                self._save(t)
+        if self._last_handle is not None and self._last_handle.wait():
+            self.manager.gc()
+        if self._status == "running":
+            self._status = "done"
+        result = {"completed": self._status == "done",
+                  "preempted": self._preempted,
+                  "final_step": t,
+                  "first_step": t0_run,
+                  "final_loss": self._last_loss,
+                  "losses": [loss_by_step[s] for s in sorted(loss_by_step)],
+                  "restarts": self._restarts,
+                  "steps_replayed": self._steps_replayed,
+                  "recovery_time_s": self._recovery_s,
+                  "skips": dict(self.manager.skips)}
+        self.result = result
+        return result
+
+    def _recover(self, t: int, exc: BaseException) -> int:
+        if self._restarts >= self.restart_budget:
+            # the failed attempt does NOT count as a restart — the budget
+            # bounds restore+replay cycles, and this one never restores
+            self._status = "failed"
+            self._emit("give_up", step=t, restarts=self._restarts,
+                       error=type(exc).__name__)
+            raise RestartBudgetExhausted(
+                f"restart budget ({self.restart_budget}) exhausted at "
+                f"step {t}") from exc
+        self._restarts += 1
+        self.registry.add("restarts")
+        self._emit("restart", step=t, error=type(exc).__name__,
+                   restarts=self._restarts)
+        self._log.warning("step %d raised %s — restart %d/%d", t,
+                          type(exc).__name__, self._restarts,
+                          self.restart_budget)
+        backoff = min(self.backoff_s *
+                      self.backoff_factor ** (self._restarts - 1),
+                      self.backoff_max_s)
+        self._sleep(backoff)
+        if self._last_handle is not None:
+            self._last_handle.wait()  # an in-flight save may be last-good
+        restored = self._restore()
+        self._steps_replayed += max(0, t - restored)
+        self.registry.add("steps_replayed", max(0, t - restored))
+        return restored
+
+    def _emergency(self, t: int, reason: str) -> None:
+        handle = self._save(t, sync=True,
+                            deadline_s=self.emergency_deadline_s)
+        self.registry.add("preemptions")
+        self._emit("preempt_save", step=t, committed=handle.committed,
+                   reason=reason)
+
+    # ------------------------------------------------------------ surfaces --
+    def _emit(self, what: str, **fields) -> None:
+        if self.tracer is not None:
+            self.tracer.emit("train_resilience", what=what, **fields)
+
+    def train_snapshot(self) -> Dict[str, Any]:
+        """The ops-server surface behind ``GET /train``."""
+        reg = self.registry
+        return {"status": self._status,
+                "step": self._step,
+                "last_loss": self._last_loss,
+                "restarts": self._restarts,
+                "restart_budget": self.restart_budget,
+                "steps_replayed": self._steps_replayed,
+                "recovery_time_s": self._recovery_s,
+                "preempted": self._preempted,
+                "saves_committed": int(reg.value("saves_committed")),
+                "saves_abandoned": int(reg.value("saves_abandoned")),
+                "corrupt_skips": int(reg.value("corrupt_skips")),
+                "restores": int(reg.value("restores")),
+                "checkpoint": self.manager.snapshot()}
+
+    def prometheus_text(self) -> str:
+        from .utils.stats import prometheus_text
+        return prometheus_text(self.registry,
+                               namespace="paddle_tpu_train_resilience")

@@ -174,3 +174,63 @@ def test_resave_smaller_world_ignores_stale_partials(tmp_path, monkeypatch):
     ckpt.save(state_new, d)
     loaded = ckpt.load(d, target=state_new)
     np.testing.assert_array_equal(np.asarray(loaded["w"]), np.arange(8.0))
+
+
+class TestCorruptDetection:
+    """ISSUE 20 satellite: ``load()`` on a torn directory must raise a
+    structured ``CorruptCheckpoint`` naming the damage — never return
+    silently wrong tensors, never crash with a raw numpy error."""
+
+    def test_truncated_npy_raises_corrupt(self, tmp_path):
+        d = str(tmp_path / "torn")
+        state = {"w": jnp.arange(4096.0), "b": jnp.ones((8,))}
+        ckpt.save(state, d)
+        victim = sorted(f for f in os.listdir(d)
+                        if f.startswith("w") and f.endswith(".npy"))[0]
+        p = os.path.join(d, victim)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        with pytest.raises(ckpt.CorruptCheckpoint,
+                           match="torn|unreadable"):
+            ckpt.load(d, target=state)
+
+    def test_missing_chunk_raises_corrupt(self, tmp_path):
+        d = str(tmp_path / "gone")
+        state = {"w": jnp.arange(16.0)}
+        ckpt.save(state, d)
+        for f in os.listdir(d):
+            if f.startswith("w") and f.endswith(".npy"):
+                os.remove(os.path.join(d, f))
+        with pytest.raises(ckpt.CorruptCheckpoint, match="missing"):
+            ckpt.load(d, target=state)
+
+    def test_missing_manifest_raises_corrupt(self, tmp_path):
+        d = str(tmp_path / "nomanifest")
+        state = {"w": jnp.arange(16.0)}
+        ckpt.save(state, d)
+        os.remove(os.path.join(d, "manifest.json"))
+        with pytest.raises(ckpt.CorruptCheckpoint, match="never committed"):
+            ckpt.load(d, target=state)
+
+    def test_wrong_shape_chunk_raises_corrupt(self, tmp_path):
+        d = str(tmp_path / "mixed")
+        state = {"w": jnp.arange(16.0)}
+        ckpt.save(state, d)
+        victim = [f for f in os.listdir(d)
+                  if f.startswith("w") and f.endswith(".npy")][0]
+        np.save(os.path.join(d, victim), np.zeros((3,), np.float32))
+        with pytest.raises(ckpt.CorruptCheckpoint, match="shape"):
+            ckpt.load(d, target=state)
+
+    def test_bf16_roundtrip_bit_exact(self, tmp_path):
+        """Extension dtypes store as same-width uint views; the logical
+        dtype must come back bit-exact (np.save of raw ml_dtypes bf16
+        reloads as void — the regression this pins)."""
+        d = str(tmp_path / "bf16")
+        w = jnp.arange(64.0, dtype=jnp.bfloat16) * jnp.bfloat16(0.1)
+        ckpt.save({"w": w}, d)
+        loaded = ckpt.load(d, target={"w": w})
+        got = np.asarray(loaded["w"])
+        assert got.dtype == np.asarray(w).dtype
+        np.testing.assert_array_equal(got.view(np.uint16),
+                                      np.asarray(w).view(np.uint16))

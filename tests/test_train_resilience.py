@@ -1,0 +1,743 @@
+"""Training resilience (ISSUE 20): the two-phase checkpoint commit
+protocol, torn/corrupt-step resolution, crash-mid-save fuzz, preemption
+discipline, and the supervisor's chaos pin — under a seeded fault plan the
+resumed loss trajectory must equal the uninterrupted oracle bit-exactly,
+and corrupt state must NEVER be loaded (skipped and counted, not raised).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import train_resilience as tr
+from paddle_tpu.distributed.checkpoint import CorruptCheckpoint
+from paddle_tpu.faults import (Fault, FaultPlan, corrupt_file, torn_write)
+from paddle_tpu.jit.functional import fold_in_step_key, make_train_step
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.telemetry import Tracer
+from paddle_tpu.train_resilience import (CheckpointManager, PreemptionGuard,
+                                         RestartBudgetExhausted,
+                                         ResumableIterator, TrainSupervisor,
+                                         pack_train_state, unpack_train_state)
+
+needs8 = pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+
+
+def _bundle_of(step, dtype=jnp.float32):
+    """Deterministic per-step bundle so bit-exactness is checkable from
+    the step number alone (the fuzz children regenerate these)."""
+    base = jnp.arange(64, dtype=jnp.float32) * (step + 1)
+    return {"w": base.astype(dtype), "b": jnp.float32(step * 0.5),
+            "step": step}
+
+
+def _assert_bundle(bundle, step, dtype=jnp.float32):
+    want = _bundle_of(step, dtype)
+    for k in ("w", "b"):
+        a, b = np.asarray(bundle[k]), np.asarray(want[k])
+        assert a.dtype == b.dtype, k
+        assert a.tobytes() == b.tobytes(), k  # bit-exact, any dtype
+    assert int(bundle["step"]) == step
+
+
+# --------------------------------------------------------------------------
+# commit protocol
+# --------------------------------------------------------------------------
+class TestCommitProtocol:
+    def test_two_phase_layout_and_manifest(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        h = m.save(_bundle_of(3), 3)
+        assert h.wait() and h.committed
+        d = m.step_path(3)
+        names = set(os.listdir(d))
+        assert "COMMIT" in names and "ckpt.manifest.json" in names
+        with open(os.path.join(d, "ckpt.manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["step"] == 3
+        from paddle_tpu.distributed.sharding_rules import \
+            sharding_rules_digest
+        assert manifest["sharding_rules_digest"] == sharding_rules_digest()
+        # every payload file is digested with its byte size
+        payload = [n for n in names
+                   if n not in ("COMMIT", "ckpt.manifest.json")]
+        assert set(manifest["files"]) == set(payload)
+        for fname, rec in manifest["files"].items():
+            assert rec["bytes"] == os.path.getsize(os.path.join(d, fname))
+            assert len(rec["blake2b"]) == 32  # blake2b-16 hex
+        # COMMIT seals the manifest, so a swapped manifest is detectable
+        with open(os.path.join(d, "COMMIT")) as f:
+            marker = json.load(f)
+        assert marker["step"] == 3 and marker["manifest_blake2b"]
+        assert m.verify(3) == (True, None)
+
+    def test_latest_skips_uncommitted_step(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_bundle_of(1), 1).wait()
+        m.save(_bundle_of(2), 2).wait()
+        os.remove(os.path.join(m.step_path(2), "COMMIT"))
+        assert m.latest() == 1
+        assert m.skips == {"uncommitted": 1}
+        # counted once per (step, reason), not once per latest() call
+        assert m.latest() == 1
+        assert m.skips == {"uncommitted": 1}
+
+    @pytest.mark.parametrize("damage,reason", [
+        ("truncate", "size_mismatch"),
+        ("flip", "digest_mismatch"),
+        ("delete", "missing_file"),
+        ("manifest", "bad_manifest"),
+    ])
+    def test_latest_skips_damaged_newest(self, tmp_path, damage, reason):
+        m = CheckpointManager(str(tmp_path), tracer=Tracer())
+        m.save(_bundle_of(1), 1).wait()
+        m.save(_bundle_of(2), 2).wait()
+        d = m.step_path(2)
+        victim = sorted(f for f in os.listdir(d) if f.endswith(".npy"))[0]
+        rng = __import__("random").Random(0)
+        if damage == "truncate":
+            torn_write(os.path.join(d, victim), rng)
+        elif damage == "flip":
+            corrupt_file(os.path.join(d, victim), rng)
+        elif damage == "delete":
+            os.remove(os.path.join(d, victim))
+        else:
+            with open(os.path.join(d, "ckpt.manifest.json"), "w") as f:
+                f.write("{not json")
+        assert m.verify(2) == (False, reason)
+        assert m.latest() == 1
+        assert m.skips == {reason: 1}
+        ev = m.tracer.events("train_resilience")
+        assert [e for e in ev if e["what"] == "corrupt_skip"
+                and e["step"] == 2 and e["reason"] == reason]
+        # the skipped step is NEVER loaded; the prior one restores whole
+        step, bundle = m.restore(_bundle_of(0))
+        assert step == 1
+        _assert_bundle(bundle, 1)
+
+    def test_restore_explicit_bad_step_raises_structured(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_bundle_of(1), 1).wait()
+        os.remove(os.path.join(m.step_path(1), "COMMIT"))
+        with pytest.raises(CorruptCheckpoint, match="uncommitted"):
+            m.restore(_bundle_of(0), step=1)
+        with pytest.raises(CorruptCheckpoint, match="no committed"):
+            m.restore(_bundle_of(0))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_roundtrip_bit_exact(self, tmp_path, dtype):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_bundle_of(5, dtype), 5).wait()
+        step, bundle = m.restore(_bundle_of(0, dtype))
+        assert step == 5
+        _assert_bundle(bundle, 5, dtype)
+
+    def test_deadline_miss_abandons_and_prior_stays_valid(self, tmp_path):
+        ticks = iter(range(0, 10_000, 100))  # each clock() read jumps 100s
+        m = CheckpointManager(str(tmp_path), tracer=Tracer(),
+                              clock=lambda: float(next(ticks)))
+        m.save(_bundle_of(1), 1).wait()
+        h = m.save(_bundle_of(2), 2, deadline_s=1.0)
+        assert h.wait() is False and not h.committed
+        assert not os.path.exists(os.path.join(m.step_path(2), "COMMIT"))
+        assert m.latest() == 1            # prior step still the resume point
+        assert m.registry.value("saves_abandoned") == 1
+        ab = [e for e in m.tracer.events("train_resilience")
+              if e["what"] == "save_abandon"]
+        assert ab and ab[0]["reason"] == "deadline"
+
+    def test_gc_retention_and_keep_every_pinning(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), retain=2, keep_every=4)
+        for s in range(1, 11):
+            m.save(_bundle_of(s), s).wait()
+        removed = m.gc()
+        kept = m.steps()
+        assert kept == [4, 8, 9, 10]      # 2 newest + keep_every pins
+        assert removed == [1, 2, 3, 5, 6, 7]
+        # uncommitted junk older than newest committed is swept too
+        os.makedirs(m.step_path(6))
+        m.gc()
+        assert 6 not in m.steps()
+
+    def test_async_save_commit_chain(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        h = m.save(_bundle_of(7), 7, async_save=True)
+        assert h.wait() is True and h.done() and h.committed
+        assert m.latest() == 7
+        step, bundle = m.restore(_bundle_of(0))
+        _assert_bundle(bundle, 7)
+
+    def test_resave_supersedes_torn_dir(self, tmp_path):
+        m = CheckpointManager(str(tmp_path))
+        m.save(_bundle_of(2), 2).wait()
+        os.remove(os.path.join(m.step_path(2), "COMMIT"))
+        m.save(_bundle_of(2), 2).wait()   # restart replays the same step
+        assert m.verify(2) == (True, None)
+        assert m.latest() == 2
+
+    def test_rules_digest_mismatch_is_nonfatal(self, tmp_path):
+        import hashlib
+        m = CheckpointManager(str(tmp_path), tracer=Tracer())
+        m.save(_bundle_of(1), 1).wait()
+        d = m.step_path(1)
+        mpath = os.path.join(d, "ckpt.manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["sharding_rules_digest"] = "stale-rules"
+        raw = json.dumps(manifest)
+        with open(mpath, "w") as f:
+            f.write(raw)
+        # re-seal so ONLY the rules digest disagrees (a legit rule edit)
+        h = hashlib.blake2b(digest_size=16)
+        h.update(raw.encode())
+        with open(os.path.join(d, "COMMIT"), "w") as f:
+            json.dump({"step": 1, "manifest_blake2b": h.hexdigest()}, f)
+        assert m.verify(1) == (True, None)       # warns, does not fail
+        assert m.rules_mismatch_steps == [1]
+        assert [e for e in m.tracer.events("train_resilience")
+                if e["what"] == "rules_mismatch"]
+
+
+# --------------------------------------------------------------------------
+# fault primitives (satellite: faults.py torn_write / corrupt_file)
+# --------------------------------------------------------------------------
+class TestFaultPrimitives:
+    def test_torn_write_truncates_seeded(self, tmp_path):
+        import random
+        p = str(tmp_path / "f.bin")
+        with open(p, "wb") as f:
+            f.write(bytes(range(256)) * 4)
+        kept = torn_write(p, random.Random(3))
+        assert 0 < kept < 1024 and os.path.getsize(p) == kept
+        # same seed, same tear point
+        with open(p, "wb") as f:
+            f.write(bytes(range(256)) * 4)
+        assert torn_write(p, random.Random(3)) == kept
+
+    def test_corrupt_file_flips_in_place(self, tmp_path):
+        import random
+        p = str(tmp_path / "f.bin")
+        payload = bytes(range(256)) * 4
+        with open(p, "wb") as f:
+            f.write(payload)
+        flipped = corrupt_file(p, random.Random(5), n_bytes=4)
+        assert flipped == 4
+        with open(p, "rb") as f:
+            after = f.read()
+        assert len(after) == len(payload) and after != payload
+
+    def test_manager_consumes_fs_faults_on_save_ordinal_clock(self, tmp_path):
+        plan = FaultPlan([Fault("torn_write", at_s=1, count=1),
+                          Fault("corrupt_file", at_s=2, count=1)], seed=11)
+        m = CheckpointManager(str(tmp_path), fault_plan=plan, tracer=Tracer())
+        assert m.save(_bundle_of(0), 0).wait() is True    # ordinal 0: clean
+        assert m.save(_bundle_of(1), 1).wait() is False   # ordinal 1: torn
+        assert m.save(_bundle_of(2), 2).wait() is True    # ordinal 2: commits
+        # ...but the post-commit corruption must be caught by resolution
+        assert m.latest() == 0
+        assert m.skips.get("uncommitted") == 1            # the torn step
+        assert m.skips.get("digest_mismatch") == 1        # the corrupted one
+        step, bundle = m.restore(_bundle_of(0))
+        _assert_bundle(bundle, 0)
+
+
+# --------------------------------------------------------------------------
+# crash-mid-save fuzz (satellite: subprocess SIGKILL at random points)
+# --------------------------------------------------------------------------
+_FUZZ_CHILD = r"""
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax.numpy as jnp
+from paddle_tpu.train_resilience import CheckpointManager
+
+root, delay_us = sys.argv[1], int(sys.argv[2])
+m = CheckpointManager(root)
+for s in range(3):
+    base = jnp.arange(1 << 18, dtype=jnp.float32) * (s + 1)
+    assert m.save({{"w": base, "step": s}}, s).wait()
+# big payload so the async save is genuinely in flight when the kill lands
+s = 3
+big = jnp.arange(1 << 18, dtype=jnp.float32) * (s + 1)
+m.save({{"w": big, "step": s}}, s, async_save=True)
+time.sleep(delay_us / 1e6)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
+@pytest.mark.parametrize("delay_us", [0, 2_000, 15_000, 60_000])
+def test_sigkill_mid_async_save_never_loads_torn(tmp_path, delay_us):
+    """Property: whatever instant the process dies at, ``latest()`` is a
+    COMMITted step whose restore is bit-exact — a torn step-3 dir is
+    skipped, a completed one is used."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = str(tmp_path / "ck")
+    proc = subprocess.run(
+        [sys.executable, "-c", _FUZZ_CHILD.format(repo=repo),
+         root, str(delay_us)],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == -signal.SIGKILL, proc.stderr
+    m = CheckpointManager(root)
+    got = m.latest()
+    assert got in (2, 3)                  # never None, never a torn step
+    assert m.verify(got) == (True, None)
+    template = {"w": jnp.zeros(1 << 18, jnp.float32), "step": 0}
+    step, bundle = m.restore(template)
+    np.testing.assert_array_equal(
+        np.asarray(bundle["w"]),
+        np.arange(1 << 18, dtype=np.float32) * (step + 1))
+    assert int(bundle["step"]) == step
+    # the fsck CLI agrees: the root is resumable
+    from tools.ckpt_fsck import main as fsck
+    assert fsck([root, "verify", "--json"]) == 0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_random_damage_fuzz_always_resolves_prior_step(tmp_path, seed):
+    """In-process fuzz: random damage to the newest step dir (torn file,
+    flipped bytes, deleted payload/COMMIT/manifest, garbage manifest) —
+    resolution must always land on the intact prior step, bit-exact."""
+    import random
+    rng = random.Random(seed)
+    m = CheckpointManager(str(tmp_path / f"r{seed}"))
+    m.save(_bundle_of(1), 1).wait()
+    m.save(_bundle_of(2), 2).wait()
+    d = m.step_path(2)
+    payload = sorted(f for f in os.listdir(d) if f.endswith(".npy"))
+    mode = rng.choice(["torn", "flip", "del_payload", "del_commit",
+                       "garbage_manifest"])
+    if mode == "torn":
+        torn_write(os.path.join(d, rng.choice(payload)), rng)
+    elif mode == "flip":
+        corrupt_file(os.path.join(d, rng.choice(payload)), rng)
+    elif mode == "del_payload":
+        os.remove(os.path.join(d, rng.choice(payload)))
+    elif mode == "del_commit":
+        os.remove(os.path.join(d, "COMMIT"))
+    else:
+        with open(os.path.join(d, "ckpt.manifest.json"), "w") as f:
+            f.write("\x00garbage")
+    assert m.latest() == 1
+    step, bundle = m.restore(_bundle_of(0))
+    assert step == 1
+    _assert_bundle(bundle, 1)
+    assert sum(m.skips.values()) == 1
+
+
+# --------------------------------------------------------------------------
+# full-state capture: typed RNG keys, comm_e residual, update-sharded R=2
+# --------------------------------------------------------------------------
+class TestStateCapture:
+    def test_pack_unpack_typed_key_roundtrip(self):
+        key = jax.random.key(7)
+        b = pack_train_state({"p": jnp.ones(3)}, step=4, base_key=key,
+                             data_state={"epoch": 1, "offset": 9})
+        state, step, key2, data = unpack_train_state(b)
+        assert step == 4 and data == {"epoch": 1, "offset": 9}
+        np.testing.assert_array_equal(jax.random.key_data(key),
+                                      jax.random.key_data(key2))
+        # the restored key derives identical per-step keys
+        np.testing.assert_array_equal(
+            jax.random.key_data(fold_in_step_key(key, 11)),
+            jax.random.key_data(fold_in_step_key(key2, 11)))
+
+    def test_pack_unpack_legacy_uint32_key(self):
+        key = jax.random.PRNGKey(3)
+        b = pack_train_state({}, step=0, base_key=key)
+        _, _, key2, _ = unpack_train_state(b)
+        np.testing.assert_array_equal(np.asarray(key), np.asarray(key2))
+
+    def test_int8_ef_comm_residual_roundtrips(self, tmp_path):
+        layer = nn.Linear(8, 4)
+        step_fn, state = make_train_step(
+            layer, nn.MSELoss(), Momentum(learning_rate=0.1, momentum=0.9),
+            grad_comm="int8_ef")
+        assert "comm_e" in state
+        key = jax.random.PRNGKey(0)
+        x = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+        y = jnp.asarray(np.random.RandomState(2).randn(4, 4), jnp.float32)
+        state, _ = step_fn(state, key, np.float32(0.1), [x], [y])
+        m = CheckpointManager(str(tmp_path))
+        m.save(pack_train_state(state, step=1), 1).wait()
+        _, bundle = m.restore(pack_train_state(state, step=1))
+        restored, *_ = unpack_train_state(bundle)
+        flat_a = jax.tree_util.tree_leaves(state)
+        flat_b = jax.tree_util.tree_leaves(restored)
+        for a, b in zip(flat_a, flat_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @needs8
+    def test_update_sharded_r2_capture_restore_bit_exact(self, tmp_path):
+        """The 1/R flat slot shard + per-replica comm_e round-trip through
+        the manager and the resumed trajectory continues exactly."""
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed import make_dp_update_sharded_train_step
+        from paddle_tpu.optimizer import SGD
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(8, 4)) * 0.1,
+                                   jnp.float32),
+                  "b": jnp.zeros((4,), jnp.float32)}
+
+        def loss_of(p, x, y):
+            return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+        def batch(seed):
+            r = np.random.default_rng(seed)
+            return (jnp.asarray(r.normal(size=(4, 8)), jnp.float32),
+                    jnp.asarray(r.normal(size=(4, 4)), jnp.float32))
+
+        step_fn, state = make_dp_update_sharded_train_step(
+            loss_of, params, SGD(0.05), mesh, grad_comm="int8_ef",
+            donate=False)
+        lr = np.float32(0.05)
+        for s in range(3):
+            state, _ = step_fn(state, lr, *batch(s))
+
+        m = CheckpointManager(str(tmp_path))
+        m.save(pack_train_state(state, step=3), 3).wait()
+        shardings = {"train": jax.tree_util.tree_map(
+            lambda a: a.sharding if isinstance(a, jax.Array) else None,
+            state)}
+        _, bundle = m.restore(pack_train_state(state, step=3),
+                              shardings=shardings)
+        restored, *_ = unpack_train_state(bundle)
+        for a, b in zip(jax.tree_util.tree_leaves(state),
+                        jax.tree_util.tree_leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # trajectory continuity: original vs restored, two more steps
+        sa, sb = state, restored
+        for s in range(3, 5):
+            sa, la = step_fn(sa, lr, *batch(s))
+            sb, lb = step_fn(sb, lr, *batch(s))
+            assert float(la) == float(lb)
+
+
+# --------------------------------------------------------------------------
+# supervisor: the chaos pin
+# --------------------------------------------------------------------------
+def _tiny_trainer(seed=0):
+    paddle.seed(seed)
+    layer = nn.Linear(8, 4)
+    step_fn, state = make_train_step(
+        layer, nn.MSELoss(), Momentum(learning_rate=0.1, momentum=0.9))
+    r = np.random.RandomState(seed + 1)
+    batches = [([jnp.asarray(r.randn(4, 8), jnp.float32)],
+                [jnp.asarray(r.randn(4, 4), jnp.float32)]) for _ in range(8)]
+    return step_fn, state, ResumableIterator(batches)
+
+
+def _supervisor(tmp_path, name, fault_plan=None, **kw):
+    step_fn, state, data = _tiny_trainer()
+    m = CheckpointManager(str(tmp_path / name), tracer=Tracer(),
+                          fault_plan=fault_plan)
+    kw.setdefault("save_every", 4)
+    kw.setdefault("backoff_s", 0.0)
+    return TrainSupervisor(step_fn, state, m,
+                           base_key=jax.random.PRNGKey(0), lr=0.1,
+                           data=data, fault_plan=fault_plan, **kw)
+
+
+class TestSupervisorChaosPin:
+    def test_oracle_equality_under_seeded_fault_plan(self, tmp_path):
+        """THE acceptance pin: alloc_fail x2 + torn_write mid-run; the
+        supervised trajectory equals the uninterrupted oracle bit-exactly,
+        torn state is counted-skipped, never loaded, never raised."""
+        oracle = _supervisor(tmp_path, "oracle").run(20)
+        assert oracle["completed"] and len(oracle["losses"]) == 20
+
+        plan = FaultPlan([Fault("alloc_fail", at_s=7, count=1),
+                          Fault("alloc_fail", at_s=13, count=1),
+                          Fault("torn_write", at_s=3, count=1)], seed=7)
+        sup = _supervisor(tmp_path, "chaos", fault_plan=plan)
+        res = sup.run(20)
+        assert res["completed"]
+        assert res["restarts"] == 2
+        assert res["steps_replayed"] > 0
+        assert res["skips"] == {"uncommitted": 1}     # the torn save
+        assert res["losses"] == oracle["losses"]      # bit-exact
+        ev = sup.tracer.events("train_resilience")
+        whats = {e["what"] for e in ev}
+        assert {"save_commit", "save_abandon", "restart", "restore",
+                "corrupt_skip", "fault_inject"} <= whats
+        # tracer summary section materializes
+        summ = sup.tracer.summary()["train_resilience"]
+        assert summ["events"]["save_commit"] >= 1
+        assert summ["last_commit_step"] == 20
+
+    def test_restart_budget_exhausts_structurally(self, tmp_path):
+        plan = FaultPlan([Fault("alloc_fail", at_s=0)], seed=0)  # every step
+        sup = _supervisor(tmp_path, "budget", fault_plan=plan,
+                          restart_budget=2)
+        with pytest.raises(RestartBudgetExhausted):
+            sup.run(10)
+        assert sup.train_snapshot()["restarts"] == 2
+
+    def test_non_finite_loss_escalates_and_recovers(self, tmp_path):
+        step_fn, state, data = _tiny_trainer()
+        poisoned = {"armed": True}
+
+        def call(fn, st, key, lr, batch):
+            st, (loss, _out) = fn(st, key, lr, *batch)
+            if poisoned["armed"]:
+                poisoned["armed"] = False
+                return st, jnp.float32(np.nan)        # transient NaN blip
+            return st, loss
+
+        m = CheckpointManager(str(tmp_path / "nan"), tracer=Tracer())
+        sup = TrainSupervisor(step_fn, state, m,
+                              base_key=jax.random.PRNGKey(0), lr=0.1,
+                              data=data, call=call, save_every=4,
+                              backoff_s=0.0)
+        res = sup.run(8)
+        assert res["completed"] and res["restarts"] == 1
+        assert all(np.isfinite(res["losses"]))
+
+    def test_preemption_resume_matches_oracle_tail(self, tmp_path):
+        oracle = _supervisor(tmp_path, "o2").run(16)
+
+        def boundary(t, sup):
+            if t == 9:
+                sup.guard.request()
+
+        guard = PreemptionGuard()                      # not installed: no
+        sup = _supervisor(tmp_path, "pre", guard=guard,  # signal plumbing
+                          on_boundary=boundary)
+        res = sup.run(16)
+        assert res["preempted"] and res["final_step"] == 9
+        assert sup.manager.latest() == 9               # emergency committed
+        ev = [e for e in sup.tracer.events("train_resilience")
+              if e["what"] == "preempt_save"]
+        assert ev and ev[0]["committed"]
+
+        step_fn2, state2, data2 = _tiny_trainer()
+        sup2 = TrainSupervisor(step_fn2, state2, sup.manager,
+                               base_key=jax.random.PRNGKey(0), lr=0.1,
+                               data=data2, save_every=4, backoff_s=0.0)
+        res2 = sup2.run(16)
+        assert res2["completed"] and res2["first_step"] == 9
+        assert res2["losses"] == oracle["losses"][9:]
+        assert res2["final_loss"] == oracle["final_loss"]
+
+    def test_elastic_exit_takes_emergency_checkpoint(self, tmp_path):
+        codes = []
+
+        class FakeElastic:
+            def exit_code(self):
+                return 101 if codes == [] and sup._step >= 5 else None
+
+        sup = _supervisor(tmp_path, "el", elastic=FakeElastic(),
+                          elastic_exit=codes.append)
+        sup.run(12)
+        assert codes == [101]
+        assert sup.manager.latest() == sup.train_snapshot()["step"]
+        assert [e for e in sup.tracer.events("train_resilience")
+                if e["what"] == "elastic_exit"]
+
+    def test_async_save_mode_end_to_end(self, tmp_path):
+        oracle = _supervisor(tmp_path, "o3").run(12)
+        sup = _supervisor(tmp_path, "async", async_save=True)
+        res = sup.run(12)
+        assert res["completed"]
+        assert res["losses"] == oracle["losses"]
+        assert sup.manager.latest() == 12
+
+    def test_train_snapshot_and_prometheus(self, tmp_path):
+        sup = _supervisor(tmp_path, "snap")
+        sup.run(6)
+        snap = sup.train_snapshot()
+        for k in ("status", "step", "restarts", "restart_budget",
+                  "steps_replayed", "recovery_time_s", "preempted",
+                  "checkpoint"):
+            assert k in snap, k
+        assert snap["status"] == "done"
+        assert snap["checkpoint"]["saves_committed"] >= 1
+        text = sup.prometheus_text()
+        assert "paddle_tpu_train_resilience_" in text
+
+
+# --------------------------------------------------------------------------
+# preemption guard signal discipline
+# --------------------------------------------------------------------------
+class TestPreemptionGuard:
+    def test_sigterm_defers_then_chains_on_release(self):
+        hits = []
+        prev = signal.signal(signal.SIGTERM, lambda *a: hits.append("prev"))
+        try:
+            g = PreemptionGuard(tracer=Tracer()).install()
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5
+            while not g.requested and time.time() < deadline:
+                time.sleep(0.01)
+            assert g.requested
+            assert hits == []                  # deferred, not delivered
+            assert [e for e in g.tracer.events("train_resilience")
+                    if e["what"] == "preempt_request"]
+            g.release()                        # now the chain fires
+            assert hits == ["prev"]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_uninstall_restores_previous_handler(self):
+        prev = signal.signal(signal.SIGTERM, lambda *a: None)
+        try:
+            g = PreemptionGuard().install()
+            g.uninstall()
+            assert signal.getsignal(signal.SIGTERM) is not g._handler
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+# --------------------------------------------------------------------------
+# integration seams: iterator, elastic, ops route, hapi callback, fsck
+# --------------------------------------------------------------------------
+class TestResumableIterator:
+    def test_wraps_epochs_and_seeks(self):
+        it = ResumableIterator(["a", "b", "c"])
+        got = [it.next_batch() for _ in range(4)]
+        assert got == ["a", "b", "c", "a"]
+        assert it.state() == {"epoch": 1, "offset": 1}
+        it2 = ResumableIterator(["a", "b", "c"])
+        it2.seek({"epoch": 1, "offset": 1})
+        assert it2.next_batch() == "b"
+
+
+class TestElasticManagedSave:
+    def test_run_with_checkpoint_managed_path(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        em = ElasticManager(str(tmp_path / "store"), rank=0)
+        em.exit_code = lambda: 101            # membership change happened
+        m = CheckpointManager(str(tmp_path / "ck"))
+        steps = {"n": 0}
+
+        def train_fn():
+            steps["n"] += 1
+            return True
+
+        with pytest.raises(SystemExit) as ei:
+            em.run_with_checkpoint(
+                train_fn, check_every=0.0, manager=m,
+                state_fn=lambda: _bundle_of(steps["n"]),
+                step_fn=lambda: steps["n"])
+        assert ei.value.code == 101
+        assert m.latest() == steps["n"]       # rescale save committed
+
+    def test_requires_manager_triple_when_no_save_fn(self, tmp_path):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        em = ElasticManager(str(tmp_path / "store"), rank=0)
+        with pytest.raises(ValueError, match="managed two-phase"):
+            em.run_with_checkpoint(lambda: False)
+
+
+class TestOpsRoute:
+    def test_get_train_serves_supervisor_snapshot(self, tmp_path):
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        sup = _supervisor(tmp_path, "ops")
+        sup.run(6)
+        srv = OpsServer()
+        srv.attach(sup, name="trainer")
+        url = srv.start()
+        try:
+            snap = json.loads(urllib.request.urlopen(
+                url + "/train", timeout=10).read())
+            assert snap["status"] == "done"
+            assert snap["checkpoint"]["saves_committed"] >= 1
+            metrics = urllib.request.urlopen(
+                url + "/metrics", timeout=10).read().decode()
+            assert "paddle_tpu_train_resilience_" in metrics
+        finally:
+            srv.stop()
+
+    def test_get_train_404_when_nothing_attached(self):
+        import urllib.error
+        import urllib.request
+        from paddle_tpu.ops_server import OpsServer
+        srv = OpsServer()
+        url = srv.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/train", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+
+class TestManagedCheckpointCallback:
+    def test_fit_saves_and_resumes(self, tmp_path):
+        from paddle_tpu.callbacks import ManagedCheckpoint
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.io import Dataset
+        from paddle_tpu.optimizer import SGD
+
+        rng = np.random.RandomState(0)
+        xs = rng.randn(32, 8).astype("float32")
+        ys = rng.randn(32, 2).astype("float32")
+
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return xs[i], ys[i]
+
+            def __len__(self):
+                return 32
+
+        def fit(cb, epochs):
+            paddle.seed(5)
+            net = nn.Linear(8, 2)
+            model = Model(net)
+            model.prepare(SGD(0.1, parameters=net.parameters()),
+                          nn.MSELoss())
+            model.fit(DS(), batch_size=8, epochs=epochs, verbose=0,
+                      callbacks=[cb])
+            return model
+
+        m = CheckpointManager(str(tmp_path / "hapi"))
+        fit(ManagedCheckpoint(m), epochs=2)
+        assert m.latest() == 2
+        cb2 = ManagedCheckpoint(m)
+        fit(cb2, epochs=3)
+        assert cb2.resumed_epoch == 2
+        assert m.latest() == 3
+
+
+class TestFsckCli:
+    def test_verify_list_gc_and_exit_codes(self, tmp_path, capsys):
+        from tools.ckpt_fsck import main
+        root = str(tmp_path / "ck")
+        m = CheckpointManager(root)
+        for s in (1, 2, 3):
+            m.save(_bundle_of(s), s).wait()
+        os.remove(os.path.join(m.step_path(3), "COMMIT"))
+        assert main([root, "verify"]) == 0       # degraded but resumable
+        out = capsys.readouterr().out
+        assert "resume at step 2" in out and "uncommitted" in out
+        assert main([root, "verify", "--step", "3"]) == 1
+        capsys.readouterr()
+        assert main([root, "verify", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["resume_step"] == 2 and doc["broken"] == 1
+        assert main([root, "gc", "--retain", "1"]) == 0
+        assert main([root, "list", "--json"]) == 0
+        capsys.readouterr()
+        # an all-broken root is NOT resumable: exit 1
+        for s in (1, 2):
+            os.remove(os.path.join(m.step_path(s), "COMMIT")) \
+                if os.path.exists(os.path.join(m.step_path(s), "COMMIT")) \
+                else None
+        # steps may have been gc'd; damage whatever remains
+        for s in m.steps():
+            c = os.path.join(m.step_path(s), "COMMIT")
+            if os.path.exists(c):
+                os.remove(c)
+        assert main([root, "verify"]) == 1
+        assert main(["/nonexistent/root", "verify"]) == 1

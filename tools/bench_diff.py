@@ -183,6 +183,25 @@ _CHAOS_FIELDS = {
     "retries_exhausted": ("count", "lower"),
 }
 
+#: train-resilience attachment fields worth diffing (bench.py
+#: gpt_train_resilience record shape): leaf name -> (synthetic unit,
+#: direction).  The recovery tax regresses when it RISES (more wall in
+#: restore, more steps replayed, more saves lost); goodput and oracle
+#: fidelity regress when they DROP.  Restart/skip counts are the
+#: scenario's shape under a pinned fault plan — judged, because a rise
+#: means recovery started thrashing under the SAME plan.
+_TRAIN_RESILIENCE_FIELDS = {
+    "recovery_time_s": ("s", "lower"),
+    "steps_replayed": ("count", "lower"),
+    "final_loss_delta": ("abs", "lower"),
+    "goodput": ("frac", "higher"),
+    "goodput_delta_vs_oracle": ("frac", "lower"),
+    "wall_overhead_x": ("x", "lower"),
+    "restarts": ("count", "lower"),
+    "saves_abandoned": ("count", "lower"),
+    "saves_committed": ("count", "higher"),
+}
+
 
 def _flatten(prefix, obj, out):
     for k, v in obj.items():
@@ -210,7 +229,9 @@ def expand_telemetry(records):
                                    ("update_sharding",
                                     _UPDATE_SHARDING_FIELDS),
                                    ("memory", _MEMORY_FIELDS),
-                                   ("fleet", _FLEET_FIELDS)):
+                                   ("fleet", _FLEET_FIELDS),
+                                   ("train_resilience",
+                                    _TRAIN_RESILIENCE_FIELDS)):
             sub = rec.get(attachment)
             if not isinstance(sub, dict):
                 continue

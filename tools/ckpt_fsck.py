@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""ckpt_fsck — verify / list / gc a train_resilience checkpoint root.
+
+The operator's answer to "can this job resume, and from where?":
+
+    python tools/ckpt_fsck.py ROOT verify          # exit 0 iff resumable
+    python tools/ckpt_fsck.py ROOT verify --step 120
+    python tools/ckpt_fsck.py ROOT list [--json]
+    python tools/ckpt_fsck.py ROOT gc --retain 5 --keep-every 100
+
+``verify`` walks every step directory through the same digest
+verification ``CheckpointManager.latest()`` uses (COMMIT marker →
+manifest digest → per-file size + blake2b) and exits 0 only when a valid
+resume point exists; any torn/corrupt/uncommitted step is reported with
+its reason (a committed-but-corrupt step makes the root DEGRADED but
+still exit-0 as long as an older valid step remains).  ``gc`` applies the
+same bounded-retention policy the manager applies online.  ``--json``
+emits one machine-readable document on stdout for CI gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _manager(args):
+    from paddle_tpu.train_resilience import CheckpointManager
+    return CheckpointManager(args.root, retain=args.retain,
+                             keep_every=args.keep_every)
+
+
+def _survey(mgr):
+    """Status of every step directory under the root."""
+    rows = []
+    for step in mgr.steps():
+        ok, reason = mgr.verify(step)
+        rows.append({"step": step, "ok": ok,
+                     "status": "valid" if ok else reason})
+    return rows
+
+
+def cmd_verify(args) -> int:
+    mgr = _manager(args)
+    if args.step is not None:
+        ok, reason = mgr.verify(args.step)
+        doc = {"root": args.root, "step": args.step, "ok": ok,
+               "status": "valid" if ok else reason}
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            print(f"step {args.step}: {doc['status']}")
+        return 0 if ok else 1
+    rows = _survey(mgr)
+    valid = [r["step"] for r in rows if r["ok"]]
+    bad = [r for r in rows if not r["ok"]]
+    resume = max(valid) if valid else None
+    doc = {"root": args.root, "steps": rows, "resume_step": resume,
+           "valid": len(valid), "broken": len(bad),
+           "ok": resume is not None}
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        for r in rows:
+            print(f"step {r['step']:>10d}  {r['status']}")
+        if resume is None:
+            print(f"{args.root}: NO VALID RESUME POINT "
+                  f"({len(rows)} step dir(s), all broken or uncommitted)")
+        else:
+            state = "DEGRADED" if bad else "OK"
+            print(f"{args.root}: {state} — resume at step {resume} "
+                  f"({len(valid)} valid, {len(bad)} broken)")
+    return 0 if resume is not None else 1
+
+
+def cmd_list(args) -> int:
+    mgr = _manager(args)
+    rows = _survey(mgr)
+    if args.json:
+        print(json.dumps({"root": args.root, "steps": rows}, indent=2))
+    else:
+        for r in rows:
+            print(f"step {r['step']:>10d}  {r['status']}")
+    return 0
+
+
+def cmd_gc(args) -> int:
+    mgr = _manager(args)
+    removed = mgr.gc()
+    kept = mgr.steps()
+    doc = {"root": args.root, "removed": removed, "kept": kept,
+           "retain": args.retain, "keep_every": args.keep_every}
+    if args.json:
+        print(json.dumps(doc, indent=2))
+    else:
+        print(f"removed {len(removed)} step(s), kept {len(kept)}: "
+              f"{kept}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt_fsck", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("root", help="checkpoint root (the CheckpointManager dir)")
+    ap.add_argument("command", choices=("verify", "list", "gc"),
+                    nargs="?", default="verify")
+    ap.add_argument("--step", type=int, default=None,
+                    help="verify one specific step instead of the root")
+    ap.add_argument("--retain", type=int, default=5,
+                    help="gc: committed steps to keep (default 5)")
+    ap.add_argument("--keep-every", type=int, default=None,
+                    help="gc: additionally pin every N-th step")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        print(f"ckpt_fsck: no such checkpoint root: {args.root}",
+              file=sys.stderr)
+        return 1
+    return {"verify": cmd_verify, "list": cmd_list, "gc": cmd_gc}[
+        args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -670,6 +670,94 @@ then
     echo "COLLECT SMOKE FAILED: fleet federation round trip"
     exit 1
 fi
+# training resilience (ISSUE 20): a tiny train child starts an async
+# two-phase checkpoint save and SIGKILLs itself mid-save; the parent must
+# resume from the newest COMMITted step (the torn dir counted-skipped,
+# never loaded) and the resumed loss curve must equal the uninterrupted
+# oracle BIT-EXACTLY.  ckpt_fsck must agree the root is resumable.
+if ! JAX_PLATFORMS=cpu python - >/dev/null 2>&1 <<'RESEOF'
+import os, signal, subprocess, sys, tempfile
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.train_resilience import (CheckpointManager,
+                                         ResumableIterator, TrainSupervisor)
+
+def trainer():
+    paddle.seed(0)
+    layer = nn.Linear(8, 4)
+    step, state = make_train_step(layer, nn.MSELoss(),
+                                  Momentum(learning_rate=0.1, momentum=0.9))
+    r = np.random.RandomState(1)
+    batches = [([jnp.asarray(r.randn(4, 8), jnp.float32)],
+                [jnp.asarray(r.randn(4, 4), jnp.float32)])
+               for _ in range(8)]
+    return step, state, ResumableIterator(batches)
+
+def supervise(root, **kw):
+    step, state, data = trainer()
+    return TrainSupervisor(step, state, CheckpointManager(root),
+                           base_key=jax.random.PRNGKey(0), lr=0.1,
+                           data=data, save_every=4, backoff_s=0.0, **kw)
+
+td = tempfile.mkdtemp()
+oracle = supervise(os.path.join(td, "oracle")).run(16)
+assert oracle["completed"] and len(oracle["losses"]) == 16
+
+# the child trains 8 steps with async saves, then dies mid-async-save
+root = os.path.join(td, "crash")
+child = r'''
+import os, signal, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %r)
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit.functional import make_train_step
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.train_resilience import (CheckpointManager,
+                                         ResumableIterator, TrainSupervisor)
+paddle.seed(0)
+layer = nn.Linear(8, 4)
+step, state = make_train_step(layer, nn.MSELoss(),
+                              Momentum(learning_rate=0.1, momentum=0.9))
+r = np.random.RandomState(1)
+batches = [([jnp.asarray(r.randn(4, 8), jnp.float32)],
+            [jnp.asarray(r.randn(4, 4), jnp.float32)]) for _ in range(8)]
+def die_mid_save(t, sup):
+    if t == 8:
+        sup._save(t)                      # async save now in flight
+        os.kill(os.getpid(), signal.SIGKILL)
+sup = TrainSupervisor(step, state, CheckpointManager(%r),
+                      base_key=jax.random.PRNGKey(0), lr=0.1,
+                      data=ResumableIterator(batches), save_every=4,
+                      backoff_s=0.0, async_save=True,
+                      on_boundary=die_mid_save)
+sup.run(16)
+''' % (os.getcwd(), root)
+proc = subprocess.run([sys.executable, "-c", child], capture_output=True,
+                      timeout=300)
+assert proc.returncode == -signal.SIGKILL, proc.stderr.decode()[-2000:]
+
+# fsck agrees the root is resumable despite the kill
+import tools.ckpt_fsck as fsck
+assert fsck.main([root, "verify"]) == 0
+
+# resume: the tail of the loss curve must equal the oracle bit-exactly
+sup2 = supervise(root)
+res = sup2.run(16)
+assert res["completed"], res
+first = res["first_step"]
+assert 0 < first <= 8, first              # resumed from a committed step
+assert res["losses"] == oracle["losses"][first:], "loss curve diverged"
+assert res["final_loss"] == oracle["final_loss"]
+RESEOF
+then
+    echo "COLLECT SMOKE FAILED: train-resilience crash/resume round trip"
+    exit 1
+fi
 # tpulint gate, per-file rules + whole-program concurrency passes: any NEW
 # violation vs tools/tpulint_baseline.json fails (exit 1, rule id +
 # file:line printed above); a STALE baseline (violations burned down but
